@@ -1,0 +1,11 @@
+"""cruise_control_tpu — a TPU-native cluster-rebalancing framework.
+
+A ground-up redesign of LinkedIn-style Cruise Control for Apache Kafka
+(reference study: SURVEY.md): the cluster workload model is a device-resident
+struct-of-arrays, goals are vectorized scoring/acceptance kernels, and
+multi-goal proposal generation is a batched constrained-assignment search
+under jit/vmap/pjit, wrapped by host-side monitoring, execution, anomaly
+detection, and a REST API.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,237 @@
+"""Optimization context: constraints, options, and per-round caches.
+
+Tensor-side counterparts of the reference's BalancingConstraint
+(reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/
+analyzer/BalancingConstraint.java:22-232), OptimizationOptions
+(analyzer/OptimizationOptions.java) and the per-goal working state the
+reference scatters across AbstractGoal fields.  Everything a goal kernel
+needs at trace time lives here as a static Python value or a device array.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.state import ClusterState
+
+
+@dataclasses.dataclass(frozen=True)
+class BalancingConstraint:
+    """Static thresholds (reference BalancingConstraint.java:22-31; defaults
+    from config/constants/AnalyzerConfig.java)."""
+
+    # per-resource balance percentage (>= 1): e.g. 1.1 → ±10% around avg
+    resource_balance_percentage: Tuple[float, float, float, float] = (
+        1.1, 1.1, 1.1, 1.1)
+    # per-resource capacity threshold (<= 1): usable fraction of capacity
+    capacity_threshold: Tuple[float, float, float, float] = (
+        0.7, 0.8, 0.8, 0.8)
+    # per-resource low-utilization threshold (0 disables balancing when the
+    # cluster is nearly idle for that resource)
+    low_utilization_threshold: Tuple[float, float, float, float] = (
+        0.0, 0.0, 0.0, 0.0)
+    replica_balance_percentage: float = 1.1
+    leader_replica_balance_percentage: float = 1.1
+    topic_replica_balance_percentage: float = 3.0
+    max_replicas_per_broker: int = 10_000
+    goal_violation_distribution_threshold_multiplier: float = 1.0
+    # To avoid churn a margin is applied to user thresholds:
+    # effective = (pct - 1) * margin (reference ResourceDistributionGoal:52)
+    balance_margin: float = 0.9
+
+    def balance_pct_with_margin(self, resource: int,
+                                triggered_by_violation: bool = False) -> float:
+        pct = self.resource_balance_percentage[resource]
+        if triggered_by_violation:
+            pct *= self.goal_violation_distribution_threshold_multiplier
+        return (pct - 1.0) * self.balance_margin
+
+    def count_pct_with_margin(self, pct: float) -> float:
+        return (pct - 1.0) * self.balance_margin
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizationOptions:
+    """Per-request knobs (reference analyzer/OptimizationOptions.java:133)."""
+
+    excluded_topics: frozenset = frozenset()
+    excluded_brokers_for_leadership: frozenset = frozenset()
+    excluded_brokers_for_replica_move: frozenset = frozenset()
+    requested_destination_broker_ids: frozenset = frozenset()
+    is_triggered_by_goal_violation: bool = False
+    only_move_immigrant_replicas: bool = False
+    fast_mode: bool = False
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class OptimizationContext:
+    """Device-array form of options + constraints + derived static indices.
+
+    Built once per optimize() call by `make_context`; passed through every
+    goal kernel.
+    """
+
+    # bool[R]: replica belongs to an excluded topic (never moved)
+    replica_excluded: jax.Array
+    # bool[R]: replica may move (immigrant-only mode restricts to offline /
+    # replicas on new brokers; reference OptimizationOptions)
+    replica_movable: jax.Array
+    # bool[B]
+    broker_dest_ok: jax.Array        # may receive replicas
+    broker_leader_ok: jax.Array      # may receive leadership
+    # i32[P, RF_MAX]: replica indices per partition, -1 padded.  Membership
+    # of replicas in partitions is immutable during optimization, so this is
+    # computed once on host.
+    partition_replicas: jax.Array
+    # f32[RES] thresholds broadcast later
+    balance_upper_pct: jax.Array     # avg_util * (1 + margin-adjusted pct)
+    balance_lower_pct: jax.Array
+    capacity_threshold: jax.Array    # f32[RES]
+    low_utilization_threshold: jax.Array  # f32[RES]
+    # count-goal absolute bounds are computed inside goals from live counts
+    max_replicas_per_broker: int = dataclasses.field(
+        metadata=dict(static=True), default=10_000)
+    rf_max: int = dataclasses.field(metadata=dict(static=True), default=5)
+    fix_offline_replicas_only: bool = dataclasses.field(
+        metadata=dict(static=True), default=False)
+
+
+def partition_replica_index(state: ClusterState,
+                            rf_max: Optional[int] = None) -> np.ndarray:
+    """i32[P, RF_MAX] — host-side computation of per-partition replica rows.
+
+    Row p lists the replica indices of partition p (−1 padding).  Valid for
+    the whole optimization because moves never change partition membership.
+    """
+    part = np.asarray(state.replica_partition)
+    valid = np.asarray(state.replica_valid)
+    num_p = state.num_partitions
+    rf = np.bincount(part[valid], minlength=num_p)
+    width = int(rf_max or max(int(rf.max(initial=1)), 1))
+    out = np.full((num_p, width), -1, dtype=np.int32)
+    order = np.argsort(part[valid], kind="stable")
+    rows = np.nonzero(valid)[0][order]
+    cols = np.concatenate([np.arange(n) for n in rf]) if rf.sum() else \
+        np.zeros(0, dtype=np.int64)
+    out[part[rows], cols] = rows
+    return out
+
+
+def make_context(state: ClusterState,
+                 constraint: BalancingConstraint,
+                 options: OptimizationOptions,
+                 topology=None,
+                 fix_offline_replicas_only: bool = False
+                 ) -> OptimizationContext:
+    """Assemble the device context from host-side options.
+
+    `topology` (ClusterTopology) translates topic/broker names in the
+    options into indices; without it the exclusion sets must already contain
+    integer indices.
+    """
+    num_t = state.num_topics
+    excluded_topic_mask = np.zeros(num_t, dtype=bool)
+    if options.excluded_topics:
+        if topology is not None:
+            topic_idx = {t: i for i, t in enumerate(topology.topics)}
+            for name in options.excluded_topics:
+                if name in topic_idx:
+                    excluded_topic_mask[topic_idx[name]] = True
+        else:
+            for idx in options.excluded_topics:
+                excluded_topic_mask[int(idx)] = True
+
+    def broker_mask(ids) -> np.ndarray:
+        mask = np.zeros(state.num_brokers, dtype=bool)
+        if ids:
+            if topology is not None:
+                index = topology.broker_index
+                for b in ids:
+                    if b in index:
+                        mask[index[b]] = True
+            else:
+                for b in ids:
+                    mask[int(b)] = True
+        return mask
+
+    excluded_replica_move = broker_mask(options.excluded_brokers_for_replica_move)
+    excluded_leadership = broker_mask(options.excluded_brokers_for_leadership)
+    requested_dest = broker_mask(options.requested_destination_broker_ids)
+
+    topic_of_r = np.asarray(state.partition_topic)[
+        np.asarray(state.replica_partition)]
+    replica_excluded = excluded_topic_mask[topic_of_r]
+
+    alive = np.asarray(state.broker_alive)
+    dest_ok = alive & ~excluded_replica_move
+    if requested_dest.any():
+        dest_ok &= requested_dest
+    leader_ok = (alive & ~excluded_leadership
+                 & ~np.asarray(state.broker_demoted))
+
+    movable = np.asarray(state.replica_valid).copy()
+    if options.only_move_immigrant_replicas:
+        on_new = np.asarray(state.broker_new)[np.asarray(state.replica_broker)]
+        movable &= np.asarray(state.replica_offline) | on_new
+
+    pr = partition_replica_index(state)
+
+    avg_util = np.asarray(S.average_utilization_percentage(state))
+    upper = np.zeros(NUM_RESOURCES, dtype=np.float32)
+    lower = np.zeros(NUM_RESOURCES, dtype=np.float32)
+    for res in range(NUM_RESOURCES):
+        margin = constraint.balance_pct_with_margin(
+            res, options.is_triggered_by_goal_violation)
+        upper[res] = avg_util[res] * (1.0 + margin)
+        lower[res] = avg_util[res] * max(0.0, 1.0 - margin)
+
+    return OptimizationContext(
+        replica_excluded=jnp.asarray(replica_excluded),
+        replica_movable=jnp.asarray(movable),
+        broker_dest_ok=jnp.asarray(dest_ok),
+        broker_leader_ok=jnp.asarray(leader_ok),
+        partition_replicas=jnp.asarray(pr),
+        balance_upper_pct=jnp.asarray(upper),
+        balance_lower_pct=jnp.asarray(lower),
+        capacity_threshold=jnp.asarray(
+            np.asarray(constraint.capacity_threshold, dtype=np.float32)),
+        low_utilization_threshold=jnp.asarray(
+            np.asarray(constraint.low_utilization_threshold, dtype=np.float32)),
+        max_replicas_per_broker=constraint.max_replicas_per_broker,
+        rf_max=pr.shape[1],
+        fix_offline_replicas_only=fix_offline_replicas_only,
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RoundCache:
+    """Derived tensors recomputed at the start of each optimization round
+    and shared by every goal's acceptance check."""
+
+    broker_load: jax.Array        # f32[B, RES]
+    broker_util: jax.Array        # f32[B, RES] load / capacity
+    replica_load: jax.Array       # f32[R, RES] current-role load
+    replica_count: jax.Array      # i32[B]
+    leader_count: jax.Array       # i32[B]
+    partition_rack_count: jax.Array  # i32[P, K]
+
+
+def make_round_cache(state: ClusterState) -> RoundCache:
+    load = S.broker_load(state)
+    cap = jnp.maximum(state.broker_capacity, 1e-9)
+    return RoundCache(
+        broker_load=load,
+        broker_util=load / cap,
+        replica_load=S.replica_current_load(state),
+        replica_count=S.broker_replica_count(state),
+        leader_count=S.broker_leader_count(state),
+        partition_rack_count=S.partition_rack_count(state),
+    )

@@ -1,0 +1,229 @@
+"""Batched balancing-action search kernels.
+
+The reference's inner loop walks brokers one at a time, tries candidate
+replicas against candidate destinations sequentially, and commits the first
+accepted action (reference: cruise-control/src/main/java/com/linkedin/kafka/
+cruisecontrol/analyzer/goals/AbstractGoal.java:179-221 maybeApplyBalancingAction,
+ResourceDistributionGoal.java:307-433 rebalanceForBroker).
+
+The TPU-native reformulation evaluated here instead scores *all* candidate
+(replica, destination) pairs of a round in parallel on the MXU-friendly
+[candidates × brokers] plane, picks one best move per source broker with a
+masked argmax, resolves destination conflicts with a second argmax, and
+commits the whole non-conflicting batch in one scatter.  A full rebalance is
+a `lax.while_loop` of such rounds — O(max-moves-per-broker) sequential steps
+instead of O(total-moves).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.state import ClusterState
+
+NEG = -1e30
+
+
+def per_segment_argmax(score: jax.Array, segment: jax.Array, num_segments: int,
+                       valid: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """For each segment, the index of the max-score valid element.
+
+    Returns (arg[Bseg] index into `score` (-1 if none), max_score[Bseg],
+    has_any[Bseg]).  Deterministic: ties break toward the lowest index.
+    """
+    masked = jnp.where(valid, score, NEG)
+    seg_max = jax.ops.segment_max(masked, segment, num_segments=num_segments)
+    has = seg_max > NEG / 2
+    idx = jnp.arange(score.shape[0], dtype=jnp.int32)
+    big = jnp.iinfo(jnp.int32).max
+    is_max = valid & (masked >= seg_max[segment])
+    arg = jax.ops.segment_min(jnp.where(is_max, idx, big), segment,
+                              num_segments=num_segments)
+    arg = jnp.where(has, arg, -1).astype(jnp.int32)
+    return arg, seg_max, has
+
+
+def resolve_dest_conflicts(dest: jax.Array, gain: jax.Array, valid: jax.Array,
+                           num_brokers: int) -> jax.Array:
+    """Keep at most one winning candidate per destination broker.
+
+    `dest[C]` proposed destination per candidate, `gain[C]` its score.
+    Returns the pruned validity mask.  Losers simply wait for the next round.
+    """
+    seg = jnp.where(valid, dest, 0)
+    arg, _, _ = per_segment_argmax(gain, seg, num_brokers, valid)
+    keep = jnp.zeros_like(valid)
+    # candidate c survives iff it is the argmax of its destination segment
+    idx = jnp.arange(dest.shape[0], dtype=jnp.int32)
+    keep = valid & (arg[seg] == idx)
+    return keep
+
+
+def shed_score(w: jax.Array, excess_r: jax.Array) -> jax.Array:
+    """Score for choosing which replica an overloaded broker sheds.
+
+    Any replica fitting inside the excess beats any that overshoots; within
+    the fitting set prefer the largest (fewer moves), within the overshooting
+    set prefer the smallest (least overshoot).  This mirrors the reference's
+    descending-load candidate ordering (ResourceDistributionGoal sorted
+    replica walk) while staying a single vectorized expression.
+    """
+    return jnp.where(w <= excess_r, w, -w)
+
+
+def move_round(state: ClusterState,
+               w: jax.Array,
+               broker_w: jax.Array,
+               src_excess: jax.Array,
+               movable: jax.Array,
+               dest_ok: jax.Array,
+               dest_headroom: jax.Array,
+               accept_matrix_fn: Callable[[jax.Array, jax.Array], jax.Array],
+               dest_pref: jax.Array,
+               partition_replicas: jax.Array,
+               forced: Optional[jax.Array] = None,
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One round of batched replica-move search.
+
+    Args:
+      w: f32[R] per-replica weight of the balanced metric.
+      broker_w: f32[B] current per-broker totals of `w`.
+      src_excess: f32[B] how much each broker wants to shed (<=0: not a src).
+      movable: bool[R] replicas eligible to move this round.
+      dest_ok: bool[B] broker-level destination eligibility.
+      dest_headroom: f32[B] max additional `w` each destination may take
+        (post-move bound already including the goal's own limit).
+      accept_matrix_fn: (cand_replicas i32[C], all-dest) -> bool[C, B]
+        acceptance of previously-optimized goals + structural feasibility
+        beyond what this kernel enforces.
+      dest_pref: f32[B] destination preference (higher = better).
+      partition_replicas: i32[P, RF] per-partition replica rows (for the
+        no-two-replicas-of-a-partition-on-one-broker constraint).
+      forced: optional bool[R] — replicas that MUST move (offline/self-heal):
+        they bypass the shed-score and excess masking.
+
+    Returns (cand_replica i32[C], cand_dest i32[C], cand_valid bool[C]) with
+    C == num_brokers (one candidate per source broker).
+    """
+    num_b = state.num_brokers
+    rb = state.replica_broker
+
+    is_src = src_excess > 0.0
+    eligible = movable & is_src[rb]
+    if forced is not None:
+        eligible = eligible | (movable & forced)
+        # forced replicas outrank everything else on their broker
+        score = jnp.where(forced, w + 1e12, shed_score(w, src_excess[rb]))
+    else:
+        score = shed_score(w, src_excess[rb])
+
+    cand_r, _, cand_has = per_segment_argmax(score, rb, num_b, eligible)
+    cand_r_safe = jnp.maximum(cand_r, 0)
+
+    # --- destination matrix [C, B] ---
+    cand_w = w[cand_r_safe]                                    # f32[C]
+    fits = (cand_w[:, None] <= dest_headroom[None, :])
+    feasible = fits & dest_ok[None, :] & cand_has[:, None]
+    # not the broker the replica already sits on
+    feasible &= (jnp.arange(num_b)[None, :] != rb[cand_r_safe][:, None])
+    # no second replica of the same partition on the destination
+    # (reference GoalUtils.legitMove)
+    siblings = partition_replicas[state.replica_partition[cand_r_safe]]
+    sib_valid = siblings >= 0                                  # [C, RF]
+    sib_broker = rb[jnp.maximum(siblings, 0)]                  # [C, RF]
+    dup = jnp.any(sib_valid[:, :, None]
+                  & (sib_broker[:, :, None]
+                     == jnp.arange(num_b)[None, None, :]), axis=1)
+    feasible &= ~dup
+    feasible &= accept_matrix_fn(cand_r_safe, None)
+
+    pref = jnp.where(feasible, dest_pref[None, :], NEG)
+    cand_dest = jnp.argmax(pref, axis=1).astype(jnp.int32)
+    cand_valid = cand_has & (jnp.max(pref, axis=1) > NEG / 2)
+
+    # one winner per destination
+    gain = cand_w + (forced is not None) * 0.0
+    cand_valid = resolve_dest_conflicts(cand_dest, gain, cand_valid, num_b)
+    return cand_r, cand_dest, cand_valid
+
+
+def leadership_round(state: ClusterState,
+                     bonus_w: jax.Array,
+                     src_excess: jax.Array,
+                     movable: jax.Array,
+                     leader_ok: jax.Array,
+                     dest_headroom: jax.Array,
+                     accept_fn: Callable[[jax.Array, jax.Array], jax.Array],
+                     dest_pref: jax.Array,
+                     partition_replicas: jax.Array,
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One round of batched leadership-transfer search.
+
+    For every leader replica on an overloaded broker, consider handing
+    leadership to each of its followers (reference ResourceDistributionGoal
+    tries LEADERSHIP_MOVEMENT before replica moves for NW_OUT/CPU,
+    ResourceDistributionGoal.java:307-360).
+
+    Args mirror `move_round`; `bonus_w` is f32[R] — the metric weight that
+    travels with leadership of the replica's partition.
+    Returns (src_replica i32[C], dest_replica i32[C], valid bool[C]).
+    """
+    num_b = state.num_brokers
+    rb = state.replica_broker
+    rf = partition_replicas.shape[1]
+
+    is_src = src_excess > 0.0
+    lead_eligible = (movable & state.replica_is_leader & is_src[rb]
+                     & (bonus_w > 0.0))
+
+    # follower matrix per replica: [R', RF] — evaluate only for leaders is
+    # shape-dynamic, so compute for all R rows (masked); RF is tiny.
+    sib = partition_replicas[state.replica_partition]          # [R, RF]
+    sib_safe = jnp.maximum(sib, 0)
+    sib_is_self = sib == jnp.arange(rb.shape[0])[:, None]
+    sib_ok = (sib >= 0) & ~sib_is_self
+    sib_broker = rb[sib_safe]                                  # [R, RF]
+    sib_offline = state.replica_offline[sib_safe]
+
+    fits = bonus_w[:, None] <= dest_headroom[sib_broker]
+    feasible = (sib_ok & fits & leader_ok[sib_broker] & ~sib_offline
+                & lead_eligible[:, None])
+    feasible &= accept_fn(jnp.arange(rb.shape[0], dtype=jnp.int32)[:, None],
+                          sib_safe)
+
+    pref = jnp.where(feasible, dest_pref[sib_broker], NEG)
+    best_f = jnp.argmax(pref, axis=1)                          # [R]
+    best_pref = jnp.max(pref, axis=1)
+    r_has = best_pref > NEG / 2
+
+    # per-source-broker argmax over its leader replicas: shed the largest
+    # transferable bonus first
+    score = jnp.where(r_has, shed_score(bonus_w, src_excess[rb]), NEG)
+    cand_r, _, cand_has = per_segment_argmax(score, rb, num_b, r_has)
+    cand_r_safe = jnp.maximum(cand_r, 0)
+    cand_dest_replica = sib_safe[cand_r_safe, best_f[cand_r_safe]]
+    cand_dest_broker = rb[cand_dest_replica]
+
+    cand_valid = cand_has
+    cand_valid = resolve_dest_conflicts(cand_dest_broker,
+                                        bonus_w[cand_r_safe], cand_valid,
+                                        num_b)
+    return cand_r, cand_dest_replica.astype(jnp.int32), cand_valid
+
+
+def commit_moves(state: ClusterState, cand_r: jax.Array, cand_dest: jax.Array,
+                 cand_valid: jax.Array) -> ClusterState:
+    return S.apply_moves(state, jnp.maximum(cand_r, 0), cand_dest,
+                         cand_valid & (cand_r >= 0))
+
+
+def commit_leadership(state: ClusterState, cand_r: jax.Array,
+                      cand_dest_replica: jax.Array,
+                      cand_valid: jax.Array) -> ClusterState:
+    return S.apply_leadership_transfers(
+        state, jnp.maximum(cand_r, 0), cand_dest_replica,
+        cand_valid & (cand_r >= 0))

@@ -1,0 +1,71 @@
+"""Resource definitions for the TPU-native cruise-control framework.
+
+Mirrors the semantics of the reference's Resource enum
+(reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/common/Resource.java:18-26):
+four balanced resources with per-resource comparison epsilons and
+host/broker-level distinctions.  Here resources are plain integer ids so they
+can index tensor axes directly (broker_load[B, NUM_RESOURCES]).
+"""
+from __future__ import annotations
+
+import enum
+from typing import List
+
+import numpy as np
+
+
+class Resource(enum.IntEnum):
+    """A balanced resource.
+
+    CPU is a host- and broker-level resource, NW_IN/NW_OUT are host-level,
+    DISK is broker-level (reference Resource.java:14-26).  The integer value
+    is the tensor-axis index.
+    """
+
+    CPU = 0
+    NW_IN = 1
+    NW_OUT = 2
+    DISK = 3
+
+    @property
+    def is_host_resource(self) -> bool:
+        return self in (Resource.CPU, Resource.NW_IN, Resource.NW_OUT)
+
+    @property
+    def is_broker_resource(self) -> bool:
+        return self in (Resource.CPU, Resource.DISK)
+
+    @property
+    def base_epsilon(self) -> float:
+        return _BASE_EPSILON[int(self)]
+
+    def epsilon(self, value1: float, value2: float) -> float:
+        """Comparison epsilon for two utilization values.
+
+        Follows the reference's rule max(base, EPSILON_PERCENT*(v1+v2))
+        (Resource.java:92-94), where EPSILON_PERCENT was tuned on an
+        ~800K-replica stress test (Resource.java:28-32).
+        """
+        return max(self.base_epsilon, EPSILON_PERCENT * (value1 + value2))
+
+    @classmethod
+    def cached_values(cls) -> List["Resource"]:
+        return _CACHED_VALUES
+
+
+# Acceptable relative nuance from float summation over very large replica
+# counts (reference Resource.java:28-32).
+EPSILON_PERCENT = 0.0008
+
+_BASE_EPSILON = (0.001, 10.0, 10.0, 100.0)
+
+_CACHED_VALUES = [Resource.CPU, Resource.NW_IN, Resource.NW_OUT, Resource.DISK]
+
+NUM_RESOURCES = 4
+
+#: Per-resource base epsilons as an array usable inside jitted kernels.
+BASE_EPSILON_ARRAY = np.asarray(_BASE_EPSILON, dtype=np.float32)
+
+#: Resources for which expected utilization is the *average* over windows;
+#: DISK uses the *latest* window (reference model/Load.java:25-120).
+AVG_RESOURCES = (Resource.CPU, Resource.NW_IN, Resource.NW_OUT)

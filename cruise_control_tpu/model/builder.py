@@ -1,0 +1,325 @@
+"""Host-side cluster model builder.
+
+Builds the device-resident :class:`ClusterState` struct-of-arrays from a
+rack → host → broker → disk → replica topology description, mirroring the
+construction API of the reference's mutable model
+(reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/
+model/ClusterModel.java — createRack, createBroker (:866-883), createReplica
+(:745-826), setReplicaLoad (:683-707)) while producing immutable numpy/JAX
+arrays.  Also owns the name ↔ index mappings (topics, racks, hosts, logdirs)
+that the tensor state deliberately does not carry.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model.state import (
+    CPU_WEIGHT_FOLLOWER_BYTES_IN,
+    CPU_WEIGHT_LEADER_BYTES_IN,
+    CPU_WEIGHT_LEADER_BYTES_OUT,
+    ClusterState,
+)
+
+LoadLike = Union[Mapping[Resource, float], Sequence[float], np.ndarray]
+
+
+def _load_vector(load: LoadLike) -> np.ndarray:
+    if isinstance(load, Mapping):
+        vec = np.zeros(NUM_RESOURCES, dtype=np.float64)
+        for res, value in load.items():
+            vec[int(res)] = float(value)
+        return vec
+    vec = np.asarray(load, dtype=np.float64)
+    if vec.shape != (NUM_RESOURCES,):
+        raise ValueError(f"load must have {NUM_RESOURCES} entries, got {vec.shape}")
+    return vec.copy()
+
+
+def estimate_follower_cpu(leader_cpu, leader_nw_in, leader_nw_out):
+    """Follower CPU estimated from the leader's load; scalar- and
+    array-compatible (reference model/ModelUtils.java:54-71 with the static
+    coefficients of ModelParameters.java:22-30)."""
+    denom = (CPU_WEIGHT_LEADER_BYTES_IN * np.asarray(leader_nw_in, np.float64)
+             + CPU_WEIGHT_LEADER_BYTES_OUT * np.asarray(leader_nw_out, np.float64))
+    est = np.where(denom > 0.0,
+                   np.asarray(leader_cpu, np.float64)
+                   * CPU_WEIGHT_FOLLOWER_BYTES_IN
+                   * np.asarray(leader_nw_in, np.float64)
+                   / np.maximum(denom, 1e-300),
+                   0.0)
+    return float(est) if est.ndim == 0 else est
+
+
+@dataclasses.dataclass
+class _Replica:
+    partition: int
+    broker: int
+    is_leader: bool
+    offline: bool
+    load: np.ndarray                  # current-role load
+    disk: int = -1
+
+
+@dataclasses.dataclass
+class _Broker:
+    broker_id: int
+    rack: int
+    host: int
+    capacity: np.ndarray
+    alive: bool = True
+    new: bool = False
+    demoted: bool = False
+    disks: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionId:
+    """(topic, partition) — the reference's TopicPartition key."""
+    topic: str
+    partition: int
+
+    def __str__(self) -> str:  # pragma: no cover - trivial
+        return f"{self.topic}-{self.partition}"
+
+
+@dataclasses.dataclass
+class ClusterTopology:
+    """Host-side name ↔ index mappings accompanying a ClusterState."""
+    broker_ids: List[int]
+    rack_ids: List[str]
+    host_names: List[str]
+    topics: List[str]
+    partitions: List[PartitionId]
+    disk_names: List[Tuple[int, str]]   # (broker index, logdir)
+
+    @property
+    def broker_index(self) -> Dict[int, int]:
+        return {b: i for i, b in enumerate(self.broker_ids)}
+
+    @property
+    def partition_index(self) -> Dict[PartitionId, int]:
+        return {p: i for i, p in enumerate(self.partitions)}
+
+
+class ClusterModelBuilder:
+    """Incrementally describe a cluster, then `build()` the tensor state."""
+
+    def __init__(self):
+        self._racks: Dict[str, int] = {}
+        self._hosts: Dict[str, int] = {}
+        self._brokers: Dict[int, _Broker] = {}
+        self._topics: Dict[str, int] = {}
+        self._partitions: Dict[PartitionId, int] = {}
+        self._partition_list: List[PartitionId] = []
+        self._replicas: List[_Replica] = []
+        self._replica_by_key: Dict[Tuple[int, int], int] = {}
+        self._disk_names: List[Tuple[int, str]] = []
+        self._disk_capacity: List[float] = []
+        self._disk_alive: List[bool] = []
+        self._disk_broker: List[int] = []
+
+    # ---- topology ----
+    def add_rack(self, rack_id: str) -> int:
+        """reference ClusterModel.createRack"""
+        return self._racks.setdefault(rack_id, len(self._racks))
+
+    def add_broker(self, broker_id: int, rack_id: str,
+                   capacity: LoadLike, host: Optional[str] = None,
+                   alive: bool = True, new: bool = False,
+                   disks: Optional[Mapping[str, float]] = None) -> int:
+        """reference ClusterModel.createBroker (ClusterModel.java:866-883)."""
+        if broker_id in self._brokers:
+            raise ValueError(f"broker {broker_id} already exists")
+        rack = self.add_rack(rack_id)
+        host_name = host if host is not None else f"host-{broker_id}"
+        host_idx = self._hosts.setdefault(host_name, len(self._hosts))
+        broker = _Broker(broker_id, rack, host_idx, _load_vector(capacity),
+                         alive=alive, new=new)
+        if disks:
+            for logdir, disk_cap in disks.items():
+                disk_idx = len(self._disk_names)
+                self._disk_names.append((broker_id, logdir))
+                self._disk_capacity.append(float(disk_cap))
+                self._disk_alive.append(disk_cap > 0)
+                self._disk_broker.append(broker_id)
+                broker.disks.append(disk_idx)
+        self._brokers[broker_id] = broker
+        return broker_id
+
+    # ---- replicas ----
+    def add_replica(self, topic: str, partition: int, broker_id: int,
+                    is_leader: bool, load: Optional[LoadLike] = None,
+                    offline: bool = False, logdir: Optional[str] = None) -> int:
+        """reference ClusterModel.createReplica (ClusterModel.java:745-826) +
+        setReplicaLoad (:683-707); load is the replica's *current-role* load."""
+        if broker_id not in self._brokers:
+            raise ValueError(f"unknown broker {broker_id}")
+        pid = PartitionId(topic, partition)
+        if pid not in self._partitions:
+            self._partitions[pid] = len(self._partition_list)
+            self._partition_list.append(pid)
+            self._topics.setdefault(topic, len(self._topics))
+        p_idx = self._partitions[pid]
+        key = (p_idx, broker_id)
+        if key in self._replica_by_key:
+            raise ValueError(f"replica of {pid} already on broker {broker_id}")
+        disk = -1
+        if logdir is not None:
+            for d in self._brokers[broker_id].disks:
+                if self._disk_names[d] == (broker_id, logdir):
+                    disk = d
+                    break
+            else:
+                raise ValueError(f"unknown logdir {logdir} on broker {broker_id}")
+        vec = (np.zeros(NUM_RESOURCES) if load is None else _load_vector(load))
+        on_dead_disk = disk >= 0 and not self._disk_alive[disk]
+        replica = _Replica(p_idx, broker_id, is_leader,
+                           offline or not self._brokers[broker_id].alive
+                           or on_dead_disk,
+                           vec, disk)
+        self._replica_by_key[key] = len(self._replicas)
+        self._replicas.append(replica)
+        return len(self._replicas) - 1
+
+    def add_partition(self, topic: str, partition: int, leader_broker: int,
+                      follower_brokers: Sequence[int],
+                      leader_load: LoadLike,
+                      follower_loads: Optional[Sequence[LoadLike]] = None) -> None:
+        """Convenience: create a whole partition; follower loads default to
+        the reference's derivation from the leader sample — same NW_IN/DISK,
+        zero NW_OUT, estimated CPU (reference monitor/MonitorUtils.java
+        populatePartitionLoad)."""
+        lead_vec = _load_vector(leader_load)
+        self.add_replica(topic, partition, leader_broker, True, lead_vec)
+        for i, fb in enumerate(follower_brokers):
+            if follower_loads is not None:
+                f_vec = _load_vector(follower_loads[i])
+            else:
+                f_vec = lead_vec.copy()
+                f_vec[Resource.NW_OUT] = 0.0
+                f_vec[Resource.CPU] = estimate_follower_cpu(
+                    lead_vec[Resource.CPU], lead_vec[Resource.NW_IN],
+                    lead_vec[Resource.NW_OUT])
+            self.add_replica(topic, partition, fb, False, f_vec)
+
+    def set_replica_load(self, topic: str, partition: int, broker_id: int,
+                         load: LoadLike) -> None:
+        pid = PartitionId(topic, partition)
+        idx = self._replica_by_key[(self._partitions[pid], broker_id)]
+        self._replicas[idx].load = _load_vector(load)
+
+    # ---- build ----
+    def build(self, pad_replicas_to: Optional[int] = None
+              ) -> Tuple[ClusterState, ClusterTopology]:
+        import jax.numpy as jnp
+
+        broker_ids = sorted(self._brokers)
+        broker_index = {b: i for i, b in enumerate(broker_ids)}
+        num_b = len(broker_ids)
+        num_p = len(self._partition_list)
+        num_r = len(self._replicas)
+        pad_r = max(pad_replicas_to or num_r, num_r, 1)
+
+        cap = np.zeros((num_b, NUM_RESOURCES), dtype=np.float32)
+        alive = np.zeros(num_b, dtype=bool)
+        new = np.zeros(num_b, dtype=bool)
+        demoted = np.zeros(num_b, dtype=bool)
+        bad_disks = np.zeros(num_b, dtype=bool)
+        rack = np.zeros(num_b, dtype=np.int32)
+        host = np.zeros(num_b, dtype=np.int32)
+        for b_id, broker in self._brokers.items():
+            i = broker_index[b_id]
+            cap[i] = broker.capacity
+            alive[i] = broker.alive
+            new[i] = broker.new
+            demoted[i] = broker.demoted
+            rack[i] = broker.rack
+            host[i] = broker.host
+            if broker.disks:
+                # JBOD: broker DISK capacity = sum of alive logdir capacities
+                disk_caps = [self._disk_capacity[d] for d in broker.disks
+                             if self._disk_alive[d]]
+                cap[i, Resource.DISK] = float(sum(disk_caps))
+                bad_disks[i] = any(not self._disk_alive[d] for d in broker.disks)
+
+        r_valid = np.zeros(pad_r, dtype=bool)
+        r_part = np.zeros(pad_r, dtype=np.int32)
+        r_broker = np.zeros(pad_r, dtype=np.int32)
+        r_disk = np.full(pad_r, -1, dtype=np.int32)
+        r_leader = np.zeros(pad_r, dtype=bool)
+        r_offline = np.zeros(pad_r, dtype=bool)
+        r_base = np.zeros((pad_r, NUM_RESOURCES), dtype=np.float32)
+        bonus = np.zeros((num_p, NUM_RESOURCES), dtype=np.float32)
+        topic_of_p = np.zeros(num_p, dtype=np.int32)
+        for pid, p_idx in self._partitions.items():
+            topic_of_p[p_idx] = self._topics[pid.topic]
+
+        for i, rep in enumerate(self._replicas):
+            r_valid[i] = True
+            r_part[i] = rep.partition
+            r_broker[i] = broker_index[rep.broker]
+            r_disk[i] = rep.disk
+            r_leader[i] = rep.is_leader
+            r_offline[i] = rep.offline
+            if rep.is_leader:
+                # Split the leader's current-role load into follower base +
+                # leadership bonus (reference Replica.makeFollower semantics).
+                cpu_f = estimate_follower_cpu(rep.load[Resource.CPU],
+                                              rep.load[Resource.NW_IN],
+                                              rep.load[Resource.NW_OUT])
+                base = rep.load.copy()
+                base[Resource.CPU] = cpu_f
+                base[Resource.NW_OUT] = 0.0
+                r_base[i] = base
+                bonus[rep.partition, Resource.CPU] = rep.load[Resource.CPU] - cpu_f
+                bonus[rep.partition, Resource.NW_OUT] = rep.load[Resource.NW_OUT]
+            else:
+                r_base[i] = rep.load
+
+        num_d = max(len(self._disk_broker), 1)
+        d_broker = np.zeros(num_d, dtype=np.int32)
+        d_cap = np.zeros(num_d, dtype=np.float32)
+        d_alive = np.ones(num_d, dtype=bool)
+        for d in range(len(self._disk_broker)):
+            d_broker[d] = broker_index[self._disk_broker[d]]
+            d_cap[d] = self._disk_capacity[d]
+            d_alive[d] = self._disk_alive[d]
+
+        state = ClusterState(
+            replica_valid=jnp.asarray(r_valid),
+            replica_partition=jnp.asarray(r_part),
+            replica_broker=jnp.asarray(r_broker),
+            replica_disk=jnp.asarray(r_disk),
+            replica_is_leader=jnp.asarray(r_leader),
+            replica_offline=jnp.asarray(r_offline),
+            replica_original_offline=jnp.asarray(r_offline),
+            replica_base_load=jnp.asarray(r_base),
+            partition_topic=jnp.asarray(topic_of_p),
+            partition_leader_bonus=jnp.asarray(bonus),
+            broker_alive=jnp.asarray(alive),
+            broker_new=jnp.asarray(new),
+            broker_demoted=jnp.asarray(demoted),
+            broker_bad_disks=jnp.asarray(bad_disks),
+            broker_capacity=jnp.asarray(cap),
+            broker_rack=jnp.asarray(rack),
+            broker_host=jnp.asarray(host),
+            disk_broker=jnp.asarray(d_broker),
+            disk_capacity=jnp.asarray(d_cap),
+            disk_alive=jnp.asarray(d_alive),
+            num_racks=max(len(self._racks), 1),
+            num_hosts=max(len(self._hosts), 1),
+            num_topics=max(len(self._topics), 1),
+        )
+        topology = ClusterTopology(
+            broker_ids=broker_ids,
+            rack_ids=[r for r, _ in sorted(self._racks.items(), key=lambda kv: kv[1])],
+            host_names=[h for h, _ in sorted(self._hosts.items(), key=lambda kv: kv[1])],
+            topics=[t for t, _ in sorted(self._topics.items(), key=lambda kv: kv[1])],
+            partitions=list(self._partition_list),
+            disk_names=list(self._disk_names),
+        )
+        return state, topology

@@ -1,0 +1,89 @@
+"""Cluster model invariant checker.
+
+Port of the reference's ClusterModel.sanityCheck consistency verifier
+(reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/
+model/ClusterModel.java:1080-1230), re-expressed over the tensor state.  Runs
+host-side on numpy copies (it is a debug/test oracle, not a hot path) and
+raises AssertionError with a description of the violated invariant.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import EPSILON_PERCENT, Resource
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.state import ClusterState
+
+
+def sanity_check(state: ClusterState, allow_offline: bool = True) -> None:
+    """Verify structural and load-accounting invariants.
+
+    Mirrors the reference's checks: replica → broker → host → rack → cluster
+    load sums agree; each partition has exactly one leader; no broker holds
+    two replicas of one partition; offline flags match broker/disk liveness;
+    disk membership matches broker assignment.
+    """
+    valid = np.asarray(state.replica_valid)
+    part = np.asarray(state.replica_partition)[valid]
+    broker = np.asarray(state.replica_broker)[valid]
+    leader = np.asarray(state.replica_is_leader)[valid]
+    offline = np.asarray(state.replica_offline)[valid]
+    disk = np.asarray(state.replica_disk)[valid]
+    alive = np.asarray(state.broker_alive)
+    num_b = state.num_brokers
+    num_p = state.num_partitions
+
+    if valid.sum() == 0:
+        return
+
+    # broker indices in range
+    if broker.min() < 0 or broker.max() >= num_b:
+        raise AssertionError("replica assigned to nonexistent broker")
+    if part.min() < 0 or part.max() >= num_p:
+        raise AssertionError("replica assigned to nonexistent partition")
+
+    # exactly one leader per (present) partition
+    leaders_per_p = np.bincount(part[leader], minlength=num_p)
+    present = np.bincount(part, minlength=num_p) > 0
+    if np.any(present & (leaders_per_p != 1)):
+        bad = np.nonzero(present & (leaders_per_p != 1))[0][:5]
+        raise AssertionError(f"partitions without exactly one leader: {bad}")
+
+    # at most one replica of a partition per broker
+    pairs = part.astype(np.int64) * num_b + broker
+    if len(np.unique(pairs)) != len(pairs):
+        raise AssertionError("broker holds multiple replicas of one partition")
+
+    # offline consistency: replica on a dead broker must be offline
+    on_dead = ~alive[broker]
+    if np.any(on_dead & ~offline):
+        raise AssertionError("replica on dead broker not marked offline")
+    if not allow_offline and np.any(offline):
+        raise AssertionError("offline replicas remain after self-healing")
+
+    # disk membership: a replica's disk must belong to its broker
+    has_disk = disk >= 0
+    if np.any(has_disk):
+        disk_broker = np.asarray(state.disk_broker)
+        if np.any(disk_broker[disk[has_disk]] != broker[has_disk]):
+            raise AssertionError("replica disk not on its broker")
+
+    # load accounting: cluster totals equal broker / host / rack aggregates
+    b_load = np.asarray(S.broker_load(state))
+    h_load = np.asarray(S.host_load(state))
+    k_load = np.asarray(S.rack_load(state))
+    r_load = np.asarray(S.replica_current_load(state))[valid]
+    total = r_load.sum(axis=0)
+    for agg, name in ((b_load, "broker"), (h_load, "host"), (k_load, "rack")):
+        agg_total = agg.sum(axis=0)
+        for res in Resource.cached_values():
+            eps = res.epsilon(float(total[res]), float(agg_total[res]))
+            if abs(float(total[res]) - float(agg_total[res])) > eps:
+                raise AssertionError(
+                    f"{name} load sum {agg_total[res]} != cluster load "
+                    f"{total[res]} for {res.name}")
+
+    # follower NW_OUT must be zero: only leaders serve client reads
+    follower_nw_out = r_load[~leader][:, Resource.NW_OUT]
+    if follower_nw_out.size and follower_nw_out.max() > 1e-4:
+        raise AssertionError("follower replica carries NW_OUT load")

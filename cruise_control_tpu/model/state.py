@@ -1,0 +1,387 @@
+"""Tensorized cluster workload model.
+
+The reference keeps a mutable object graph (racks → hosts → brokers → disks →
+replicas; reference: cruise-control/src/main/java/com/linkedin/kafka/
+cruisecontrol/model/ClusterModel.java:47-1331).  The TPU-native design inverts
+this into an immutable struct-of-arrays pytree: every replica/broker/partition
+attribute is a padded, statically-shaped device array, so goal kernels can
+score *batches* of candidate actions with vmap/jit instead of walking objects.
+
+Mutations in the reference — relocateReplica (ClusterModel.java:346-360),
+relocateLeadership (:373-405) — become pure functions returning new states;
+aggregate queries — utilizationMatrix (:1266-1300), variance (:1249-1260),
+potential network outbound load — become segment-sum reductions.
+
+Axes:
+  R  replicas (padded; `replica_valid` masks real rows)
+  P  partitions
+  B  brokers
+  H  hosts, K racks, T topics, D disks (JBOD logdirs)
+
+All load tensors hold *expected utilization* per resource: the reference
+aggregates per-window samples and uses avg-over-windows for CPU/NW and the
+latest window for DISK (model/Load.java:25-120); that collapse happens in the
+monitor plane (host side), so the solver-resident state stays minimal and hot.
+
+Load representation.  The reference moves a "leadership load" bundle between
+replicas when leadership changes (Replica.makeFollower computes {cpu: own -
+estimated-follower-cpu, nw_out: own}, and makeLeader adds it;
+ClusterModel.relocateLeadership, ClusterModel.java:373-405).  The tensor
+equivalent: each replica carries its *follower-role* base load, and each
+partition carries a `partition_leader_bonus` — the extra load carried by
+whichever replica currently leads:
+
+    current_load[r] = replica_base_load[r]
+                      + is_leader[r] * partition_leader_bonus[partition[r]]
+
+The bonus is computed once at model-build time from the original leader's
+load (exactly what the reference computes for the first transfer; repeated
+transfers in the reference would recompute from the then-current leader —
+a minor, intentional divergence that keeps the kernel branch-free).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+
+# CPU-attribution weights for follower load estimated from leader load
+# (reference model/ModelParameters.java:22-30, ModelUtils.java:54-71).
+CPU_WEIGHT_LEADER_BYTES_IN = 0.7
+CPU_WEIGHT_LEADER_BYTES_OUT = 0.15
+CPU_WEIGHT_FOLLOWER_BYTES_IN = 0.15
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ClusterState:
+    """Immutable struct-of-arrays cluster model (device-resident)."""
+
+    # --- replica axis (R) ---
+    replica_valid: jax.Array          # bool[R] padding mask
+    replica_partition: jax.Array      # i32[R]
+    replica_broker: jax.Array         # i32[R] current assignment
+    replica_disk: jax.Array           # i32[R] logdir index, -1 if not JBOD
+    replica_is_leader: jax.Array      # bool[R]
+    replica_offline: jax.Array        # bool[R] on dead broker / broken disk
+    replica_original_offline: jax.Array  # bool[R] offline at model-build time
+    replica_base_load: jax.Array      # f32[R, NUM_RESOURCES] follower-role load
+
+    # --- partition axis (P) ---
+    partition_topic: jax.Array        # i32[P]
+    partition_leader_bonus: jax.Array  # f32[P, NUM_RESOURCES] leadership load
+
+    # --- broker axis (B) ---
+    broker_alive: jax.Array           # bool[B]
+    broker_new: jax.Array             # bool[B] newly added (immigrant target)
+    broker_demoted: jax.Array         # bool[B]
+    broker_bad_disks: jax.Array       # bool[B] alive but has broken logdirs
+    broker_capacity: jax.Array        # f32[B, NUM_RESOURCES]
+    broker_rack: jax.Array            # i32[B]
+    broker_host: jax.Array            # i32[B]
+
+    # --- disk axis (D), JBOD; D == 1 dummy when not modeled ---
+    disk_broker: jax.Array            # i32[D]
+    disk_capacity: jax.Array          # f32[D]
+    disk_alive: jax.Array             # bool[D]
+
+    # --- static metadata (not traced) ---
+    num_racks: int = dataclasses.field(metadata=dict(static=True), default=1)
+    num_hosts: int = dataclasses.field(metadata=dict(static=True), default=1)
+    num_topics: int = dataclasses.field(metadata=dict(static=True), default=1)
+
+    # ----- shape properties -----
+    @property
+    def num_replicas(self) -> int:
+        return self.replica_broker.shape[0]
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partition_topic.shape[0]
+
+    @property
+    def num_brokers(self) -> int:
+        return self.broker_capacity.shape[0]
+
+    @property
+    def num_disks(self) -> int:
+        return self.disk_broker.shape[0]
+
+    def replace(self, **kwargs) -> "ClusterState":
+        return dataclasses.replace(self, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Load queries (reference ClusterModel / Broker / Rack load accounting)
+# ---------------------------------------------------------------------------
+
+def replica_current_load(state: ClusterState) -> jax.Array:
+    """f32[R, RES] — each replica's load in its current role.
+
+    Leadership carries the NW_OUT and the leader share of CPU
+    (reference model/Replica.java leadership load split).
+    """
+    bonus = state.partition_leader_bonus[state.replica_partition]
+    load = (state.replica_base_load
+            + state.replica_is_leader[:, None] * bonus)
+    return load * state.replica_valid[:, None]
+
+
+def replica_leader_role_load(state: ClusterState) -> jax.Array:
+    """f32[R, RES] — the load each replica *would* carry as leader."""
+    bonus = state.partition_leader_bonus[state.replica_partition]
+    return (state.replica_base_load + bonus) * state.replica_valid[:, None]
+
+
+def broker_load(state: ClusterState) -> jax.Array:
+    """f32[B, RES] — per-broker utilization; the tensor equivalent of
+    Broker.load() kept consistent by ClusterModel mutation ops."""
+    return jax.ops.segment_sum(replica_current_load(state),
+                               state.replica_broker,
+                               num_segments=state.num_brokers)
+
+
+def host_load(state: ClusterState) -> jax.Array:
+    """f32[H, RES] — host-level utilization (reference model/Host.java)."""
+    return jax.ops.segment_sum(broker_load(state), state.broker_host,
+                               num_segments=state.num_hosts)
+
+
+def rack_load(state: ClusterState) -> jax.Array:
+    """f32[K, RES] — rack-level utilization (reference model/Rack.java)."""
+    return jax.ops.segment_sum(broker_load(state), state.broker_rack,
+                               num_segments=state.num_racks)
+
+
+def broker_replica_count(state: ClusterState) -> jax.Array:
+    """i32[B] — replicas per broker."""
+    return jax.ops.segment_sum(state.replica_valid.astype(jnp.int32),
+                               state.replica_broker,
+                               num_segments=state.num_brokers)
+
+
+def broker_leader_count(state: ClusterState) -> jax.Array:
+    """i32[B] — leader replicas per broker."""
+    leaders = (state.replica_valid & state.replica_is_leader).astype(jnp.int32)
+    return jax.ops.segment_sum(leaders, state.replica_broker,
+                               num_segments=state.num_brokers)
+
+
+def broker_topic_replica_count(state: ClusterState) -> jax.Array:
+    """i32[B, T] — per-broker per-topic replica counts (used by
+    TopicReplicaDistributionGoal; reference tracks this via
+    Broker.numReplicasOfTopicInBroker)."""
+    topic = state.partition_topic[state.replica_partition]
+    flat = state.replica_broker * state.num_topics + topic
+    counts = jax.ops.segment_sum(
+        state.replica_valid.astype(jnp.int32), flat,
+        num_segments=state.num_brokers * state.num_topics)
+    return counts.reshape(state.num_brokers, state.num_topics)
+
+
+def partition_rack_count(state: ClusterState) -> jax.Array:
+    """i32[P, K] — replicas of each partition per rack (RackAwareGoal's
+    constraint surface; the reference walks partition.replica racks,
+    analyzer/goals/RackAwareGoal.java:43)."""
+    rack = state.broker_rack[state.replica_broker]
+    flat = state.replica_partition * state.num_racks + rack
+    counts = jax.ops.segment_sum(
+        state.replica_valid.astype(jnp.int32), flat,
+        num_segments=state.num_partitions * state.num_racks)
+    return counts.reshape(state.num_partitions, state.num_racks)
+
+
+def partition_broker_count(state: ClusterState) -> jax.Array:
+    """i32[P, B] materialized as flat segment counts — how many replicas of
+    partition p sit on broker b (must be ≤ 1; used for move feasibility)."""
+    flat = state.replica_partition * state.num_brokers + state.replica_broker
+    counts = jax.ops.segment_sum(
+        state.replica_valid.astype(jnp.int32), flat,
+        num_segments=state.num_partitions * state.num_brokers)
+    return counts.reshape(state.num_partitions, state.num_brokers)
+
+
+def partition_leader_replica(state: ClusterState) -> jax.Array:
+    """i32[P] — replica index of each partition's leader, -1 if none."""
+    r_idx = jnp.arange(state.num_replicas, dtype=jnp.int32)
+    is_leader = state.replica_valid & state.replica_is_leader
+    return jax.ops.segment_max(
+        jnp.where(is_leader, r_idx, -1), state.replica_partition,
+        num_segments=state.num_partitions)
+
+
+def partition_replication_factor(state: ClusterState) -> jax.Array:
+    """i32[P] — replica count per partition."""
+    return jax.ops.segment_sum(state.replica_valid.astype(jnp.int32),
+                               state.replica_partition,
+                               num_segments=state.num_partitions)
+
+
+def potential_leadership_load(state: ClusterState) -> jax.Array:
+    """f32[B] — NW_OUT a broker would serve if it led every partition it
+    hosts a replica of (reference ClusterModel.potentialLeadershipLoadFor,
+    used by PotentialNwOutGoal)."""
+    leader_nw_out = (replica_leader_role_load(state)[:, Resource.NW_OUT]
+                     * state.replica_valid)
+    return jax.ops.segment_sum(leader_nw_out, state.replica_broker,
+                               num_segments=state.num_brokers)
+
+
+def disk_load(state: ClusterState) -> jax.Array:
+    """f32[D] — per-logdir DISK utilization (JBOD;
+    reference model/Disk.java)."""
+    disk_idx = jnp.where(state.replica_disk >= 0, state.replica_disk, 0)
+    contrib = (replica_current_load(state)[:, Resource.DISK]
+               * (state.replica_disk >= 0) * state.replica_valid)
+    return jax.ops.segment_sum(contrib, disk_idx,
+                               num_segments=state.num_disks)
+
+
+def utilization_matrix(state: ClusterState) -> jax.Array:
+    """f32[RES, B] utilization-percentage matrix over alive brokers — the
+    tensor the reference computes in ClusterModel.utilizationMatrix()
+    (ClusterModel.java:1266-1300), already the natural device layout here."""
+    load = broker_load(state)
+    cap = jnp.maximum(state.broker_capacity, 1e-9)
+    return jnp.where(state.broker_alive[None, :], (load / cap).T, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Mutation ops — pure-function equivalents of the reference's model mutations
+# ---------------------------------------------------------------------------
+
+def move_replica(state: ClusterState, replica: jax.Array,
+                 dest_broker: jax.Array,
+                 dest_disk: Optional[jax.Array] = None) -> ClusterState:
+    """Relocate one replica to `dest_broker`
+    (reference ClusterModel.relocateReplica, ClusterModel.java:346-360).
+
+    Moving an offline replica to an alive broker brings it online — the
+    self-healing move (reference Replica.markOnline path)."""
+    new_broker = state.replica_broker.at[replica].set(dest_broker.astype(jnp.int32))
+    new_disk = state.replica_disk.at[replica].set(
+        -1 if dest_disk is None else dest_disk.astype(jnp.int32))
+    new_offline = state.replica_offline.at[replica].set(
+        ~state.broker_alive[dest_broker])
+    return state.replace(replica_broker=new_broker, replica_disk=new_disk,
+                         replica_offline=new_offline)
+
+
+def apply_moves(state: ClusterState, replicas: jax.Array,
+                dest_brokers: jax.Array, valid: jax.Array) -> ClusterState:
+    """Batched replica relocation: commit K (replica → dest) moves at once.
+
+    Invalid rows (valid=False) are redirected to a no-op by writing the
+    replica's current broker back.  This is the round-commit primitive of the
+    batched optimizer — the reference commits one move at a time inside
+    rebalanceForBroker (AbstractGoal.java:179-221); here a whole round of
+    non-conflicting moves lands in one scatter."""
+    replicas = replicas.astype(jnp.int32)
+    cur = state.replica_broker[replicas]
+    tgt = jnp.where(valid, dest_brokers.astype(jnp.int32), cur)
+    new_broker = state.replica_broker.at[replicas].set(tgt)
+    moved = valid & (tgt != cur)
+    new_disk = state.replica_disk.at[replicas].set(
+        jnp.where(moved, -1, state.replica_disk[replicas]))
+    new_offline = state.replica_offline.at[replicas].set(
+        jnp.where(moved, ~state.broker_alive[tgt],
+                  state.replica_offline[replicas]))
+    return state.replace(replica_broker=new_broker, replica_disk=new_disk,
+                         replica_offline=new_offline)
+
+
+def transfer_leadership(state: ClusterState, src_replica: jax.Array,
+                        dest_replica: jax.Array) -> ClusterState:
+    """Move leadership of a partition from `src_replica` to `dest_replica`
+    (reference ClusterModel.relocateLeadership, ClusterModel.java:373-405):
+    NW_OUT and the leader CPU share follow the leader flag."""
+    flags = state.replica_is_leader.at[src_replica].set(False)
+    flags = flags.at[dest_replica].set(True)
+    return state.replace(replica_is_leader=flags)
+
+
+def apply_leadership_transfers(state: ClusterState, src_replicas: jax.Array,
+                               dest_replicas: jax.Array,
+                               valid: jax.Array) -> ClusterState:
+    """Batched leadership transfer: K (leader → follower) handoffs at once."""
+    src = src_replicas.astype(jnp.int32)
+    dst = dest_replicas.astype(jnp.int32)
+    flags = state.replica_is_leader
+    flags = flags.at[src].set(jnp.where(valid, False, flags[src]))
+    flags = flags.at[dst].set(jnp.where(valid, True, flags[dst]))
+    return state.replace(replica_is_leader=flags)
+
+
+def set_broker_state(state: ClusterState, broker: int, *, alive: bool = None,
+                     new: bool = None, demoted: bool = None,
+                     bad_disks: bool = None) -> ClusterState:
+    """Host-side broker state change (reference ClusterModel.setBrokerState).
+    Killing a broker marks its replicas offline."""
+    updates = {}
+    if alive is not None:
+        broker_alive = state.broker_alive.at[broker].set(alive)
+        updates["broker_alive"] = broker_alive
+        on_broker = state.replica_broker == broker
+        # reviving a broker keeps replicas on its broken logdirs offline
+        on_dead_disk = ((state.replica_disk >= 0)
+                        & ~state.disk_alive[jnp.maximum(state.replica_disk, 0)])
+        offline = jnp.where(on_broker & state.replica_valid,
+                            (not alive) | on_dead_disk, state.replica_offline)
+        updates["replica_offline"] = offline
+        if not alive:
+            updates["replica_original_offline"] = (
+                state.replica_original_offline | (on_broker & state.replica_valid))
+    if new is not None:
+        updates["broker_new"] = state.broker_new.at[broker].set(new)
+    if demoted is not None:
+        updates["broker_demoted"] = state.broker_demoted.at[broker].set(demoted)
+    if bad_disks is not None:
+        updates["broker_bad_disks"] = state.broker_bad_disks.at[broker].set(bad_disks)
+    return state.replace(**updates)
+
+
+def mark_disk_dead(state: ClusterState, disk: int) -> ClusterState:
+    """Mark one logdir broken: its replicas become offline while the broker
+    stays alive with bad disks (reference Disk.State / BAD_DISKS broker
+    state, model/Disk.java + Broker.java)."""
+    disk_alive = state.disk_alive.at[disk].set(False)
+    on_disk = (state.replica_disk == disk) & state.replica_valid
+    broker = state.disk_broker[disk]
+    return state.replace(
+        disk_alive=disk_alive,
+        replica_offline=state.replica_offline | on_disk,
+        replica_original_offline=state.replica_original_offline | on_disk,
+        broker_bad_disks=state.broker_bad_disks.at[broker].set(True))
+
+
+# ---------------------------------------------------------------------------
+# Derived statistics helpers
+# ---------------------------------------------------------------------------
+
+def cluster_capacity(state: ClusterState) -> jax.Array:
+    """f32[RES] — total capacity over alive brokers
+    (reference ClusterModel.capacityFor)."""
+    return jnp.sum(state.broker_capacity * state.broker_alive[:, None], axis=0)
+
+
+def cluster_load(state: ClusterState) -> jax.Array:
+    """f32[RES] — total expected utilization."""
+    return jnp.sum(replica_current_load(state), axis=0)
+
+
+def average_utilization_percentage(state: ClusterState) -> jax.Array:
+    """f32[RES] — cluster load / cluster capacity, the pivot for balance
+    thresholds (reference ResourceDistributionGoal.java:927-944)."""
+    return cluster_load(state) / jnp.maximum(cluster_capacity(state), 1e-9)
+
+
+def self_healing_eligible(state: ClusterState) -> jax.Array:
+    """bool[R] — replicas that *must* move: currently offline
+    (reference ClusterModel.selfHealingEligibleReplicas,
+    ClusterModel.java:56,87,185-187)."""
+    return state.replica_valid & state.replica_offline

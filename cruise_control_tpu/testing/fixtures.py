@@ -1,0 +1,122 @@
+"""Deterministic cluster fixtures.
+
+The framework's analog of the reference's hand-built test models
+(reference: cruise-control/src/test/java/com/linkedin/kafka/cruisecontrol/
+common/DeterministicCluster.java:28-540 — smallClusterModel, unbalanced,
+rackAwareSatisfiable/Unsatisfiable, deadBroker).  These are *new* fixtures
+designed for the tensor model, with fully known loads so tests can assert
+exact numbers.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+from cruise_control_tpu.common.resources import Resource as R
+from cruise_control_tpu.model.builder import (ClusterModelBuilder,
+                                              ClusterTopology)
+from cruise_control_tpu.model.state import ClusterState
+
+# Uniform broker capacity used by most fixtures.
+CAPACITY = {R.CPU: 100.0, R.NW_IN: 1000.0, R.NW_OUT: 1000.0, R.DISK: 2000.0}
+
+
+def small_cluster() -> Tuple[ClusterState, ClusterTopology]:
+    """2 racks, 3 brokers, 2 topics, 3 partitions, RF=2 — modest skew.
+
+    Broker layout (leader=L, follower=f):
+        b0 (rack A): L(T1-0)  f(T2-0)
+        b1 (rack A): L(T1-1)  f(T1-0)
+        b2 (rack B): L(T2-0)  f(T1-1)
+    """
+    b = ClusterModelBuilder()
+    b.add_broker(0, "A", CAPACITY)
+    b.add_broker(1, "A", CAPACITY)
+    b.add_broker(2, "B", CAPACITY)
+    b.add_partition("T1", 0, 0, [1],
+                    {R.CPU: 20.0, R.NW_IN: 100.0, R.NW_OUT: 130.0, R.DISK: 75.0})
+    b.add_partition("T1", 1, 1, [2],
+                    {R.CPU: 18.0, R.NW_IN: 90.0, R.NW_OUT: 110.0, R.DISK: 55.0})
+    b.add_partition("T2", 0, 2, [0],
+                    {R.CPU: 15.0, R.NW_IN: 60.0, R.NW_OUT: 80.0, R.DISK: 45.0})
+    return b.build()
+
+
+def unbalanced_cluster() -> Tuple[ClusterState, ClusterTopology]:
+    """All leaders and heavy load concentrated on broker 0; brokers 1-2 hold
+    only light followers.  The canonical rebalance-me fixture (analog of the
+    reference's DeterministicCluster.unbalanced, :52-178)."""
+    b = ClusterModelBuilder()
+    b.add_broker(0, "A", CAPACITY)
+    b.add_broker(1, "A", CAPACITY)
+    b.add_broker(2, "B", CAPACITY)
+    for p in range(6):
+        b.add_partition("T1", p, 0, [1 if p % 2 else 2],
+                        {R.CPU: 12.0, R.NW_IN: 120.0, R.NW_OUT: 140.0,
+                         R.DISK: 250.0})
+    return b.build()
+
+
+def rack_aware_satisfiable() -> Tuple[ClusterState, ClusterTopology]:
+    """RF=2 partitions doubled up in rack A while rack B has room — rack
+    awareness violated but fixable (reference rackAwareSatisfiable :178)."""
+    b = ClusterModelBuilder()
+    b.add_broker(0, "A", CAPACITY)
+    b.add_broker(1, "A", CAPACITY)
+    b.add_broker(2, "B", CAPACITY)
+    load = {R.CPU: 5.0, R.NW_IN: 50.0, R.NW_OUT: 60.0, R.DISK: 40.0}
+    b.add_partition("T1", 0, 0, [1], load)   # both replicas in rack A
+    b.add_partition("T1", 1, 2, [0], load)   # already rack-aware
+    return b.build()
+
+
+def rack_aware_unsatisfiable() -> Tuple[ClusterState, ClusterTopology]:
+    """RF=3 with only two racks — rack awareness cannot be satisfied
+    (reference rackAwareUnsatisfiable :208)."""
+    b = ClusterModelBuilder()
+    b.add_broker(0, "A", CAPACITY)
+    b.add_broker(1, "A", CAPACITY)
+    b.add_broker(2, "B", CAPACITY)
+    load = {R.CPU: 5.0, R.NW_IN: 50.0, R.NW_OUT: 60.0, R.DISK: 40.0}
+    b.add_partition("T1", 0, 0, [1, 2], load)
+    return b.build()
+
+
+def dead_broker_cluster() -> Tuple[ClusterState, ClusterTopology]:
+    """small_cluster with broker 2 dead — its replicas are offline and must
+    be healed onto alive brokers (reference deadBroker :356)."""
+    b = ClusterModelBuilder()
+    b.add_broker(0, "A", CAPACITY)
+    b.add_broker(1, "A", CAPACITY)
+    b.add_broker(2, "B", CAPACITY, alive=False)
+    b.add_partition("T1", 0, 0, [1],
+                    {R.CPU: 20.0, R.NW_IN: 100.0, R.NW_OUT: 130.0, R.DISK: 75.0})
+    b.add_partition("T1", 1, 1, [2],
+                    {R.CPU: 18.0, R.NW_IN: 90.0, R.NW_OUT: 110.0, R.DISK: 55.0})
+    b.add_partition("T2", 0, 2, [0],
+                    {R.CPU: 15.0, R.NW_IN: 60.0, R.NW_OUT: 80.0, R.DISK: 45.0})
+    # broker 2 was added dead; its replicas must be flagged offline
+    state, topo = b.build()
+    return state, topo
+
+
+def jbod_cluster() -> Tuple[ClusterState, ClusterTopology]:
+    """3 brokers with two logdirs each; one broken logdir on broker 0."""
+    b = ClusterModelBuilder()
+    disks = {"/d1": 1000.0, "/d2": 1000.0}
+    b.add_broker(0, "A", CAPACITY, disks={"/d1": -1.0, "/d2": 1000.0})
+    b.add_broker(1, "A", CAPACITY, disks=disks)
+    b.add_broker(2, "B", CAPACITY, disks=disks)
+    load = {R.CPU: 10.0, R.NW_IN: 50.0, R.NW_OUT: 60.0, R.DISK: 200.0}
+    b.add_replica("T1", 0, 0, True, load, logdir="/d2")
+    b.add_replica("T1", 0, 1, False, _follower(load), logdir="/d1")
+    b.add_replica("T1", 1, 1, True, load, logdir="/d2")
+    b.add_replica("T1", 1, 2, False, _follower(load), logdir="/d1")
+    return b.build()
+
+
+def _follower(load):
+    from cruise_control_tpu.model.builder import estimate_follower_cpu
+    f = dict(load)
+    f[R.CPU] = estimate_follower_cpu(load[R.CPU], load[R.NW_IN], load[R.NW_OUT])
+    f[R.NW_OUT] = 0.0
+    return f

@@ -1,0 +1,174 @@
+"""Vectorized random-cluster generator.
+
+The framework's analog of the reference's RandomCluster test generator
+(reference: cruise-control/src/test/java/com/linkedin/kafka/cruisecontrol/
+model/RandomCluster.java:38-568), redesigned to build the tensor state
+directly with numpy so that 2.6K-broker / 200K-partition models (the
+BASELINE.json scale configs) materialize in well under a second — the
+reference builds an object per replica; here a cluster is a handful of array
+ops regardless of size.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model.builder import (ClusterTopology, PartitionId,
+                                              estimate_follower_cpu)
+from cruise_control_tpu.model.state import ClusterState
+
+
+@dataclasses.dataclass
+class RandomClusterSpec:
+    """Knobs mirroring the reference's ClusterProperty map."""
+    num_brokers: int = 200
+    num_partitions: int = 20_000
+    replication_factor: int = 3
+    num_racks: int = 10
+    num_topics: int = 50
+    seed: int = 0
+    # mean leader loads; actual loads are lognormal around these
+    mean_cpu: float = 0.04
+    mean_nw_in: float = 40.0
+    mean_nw_out: float = 50.0
+    mean_disk: float = 120.0
+    load_sigma: float = 1.0
+    # broker capacity (uniform); chosen so a balanced cluster sits ~50% util
+    capacity_margin: float = 2.0
+    # fraction of partitions whose leader is forced onto a small hot set of
+    # brokers, creating realistic skew for the optimizer to undo
+    skew_fraction: float = 0.3
+    skew_brokers: int = 0  # 0 → num_brokers // 20 + 1
+    dead_brokers: int = 0
+    new_brokers: int = 0   # brokers appended empty (add-broker scenario)
+
+
+def _distinct_brokers(rng: np.random.Generator, num_p: int, rf: int,
+                      num_b: int) -> np.ndarray:
+    """i32[P, rf] distinct broker picks per partition, vectorized."""
+    if num_b <= 64:
+        order = np.argsort(rng.random((num_p, num_b)), axis=1)
+        return order[:, :rf].astype(np.int32)
+    picks = rng.integers(0, num_b, size=(num_p, rf), dtype=np.int64)
+    for _ in range(64):  # rejection-resample colliding rows (rare: rf << B)
+        sorted_picks = np.sort(picks, axis=1)
+        dup = (sorted_picks[:, 1:] == sorted_picks[:, :-1]).any(axis=1)
+        if not dup.any():
+            break
+        picks[dup] = rng.integers(0, num_b, size=(int(dup.sum()), rf))
+    return picks.astype(np.int32)
+
+
+def random_cluster(spec: RandomClusterSpec
+                   ) -> Tuple[ClusterState, ClusterTopology]:
+    """Generate a random cluster per `spec` as (ClusterState, topology)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(spec.seed)
+    num_b = spec.num_brokers + spec.new_brokers
+    num_p = spec.num_partitions
+    rf = spec.replication_factor
+    num_r = num_p * rf
+
+    # ---- topology ----
+    rack_of_broker = (np.arange(num_b) % spec.num_racks).astype(np.int32)
+    host_of_broker = np.arange(num_b, dtype=np.int32)  # one broker per host
+    topic_of_p = rng.integers(0, spec.num_topics, size=num_p).astype(np.int32)
+
+    # replica placement: rf distinct brokers per partition, leader at col 0,
+    # chosen only among the original (non-new) brokers
+    placement = _distinct_brokers(rng, num_p, rf, spec.num_brokers)
+    if spec.skew_fraction > 0:
+        hot = spec.skew_brokers or (spec.num_brokers // 20 + 1)
+        skewed = rng.random(num_p) < spec.skew_fraction
+        hot_pick = rng.integers(0, hot, size=num_p).astype(np.int32)
+        # force leader onto a hot broker unless a follower already sits there
+        conflict = (placement[:, 1:] == hot_pick[:, None]).any(axis=1)
+        take = skewed & ~conflict
+        placement[take, 0] = hot_pick[take]
+
+    # ---- loads (leader-role, per partition) ----
+    def lognormal(mean: float) -> np.ndarray:
+        mu = np.log(mean) - 0.5 * spec.load_sigma ** 2
+        return rng.lognormal(mu, spec.load_sigma, size=num_p)
+
+    lead_cpu = lognormal(spec.mean_cpu)
+    lead_nw_in = lognormal(spec.mean_nw_in)
+    lead_nw_out = lognormal(spec.mean_nw_out)
+    lead_disk = lognormal(spec.mean_disk)
+
+    follower_cpu = estimate_follower_cpu(lead_cpu, lead_nw_in, lead_nw_out)
+
+    # ---- replica-major arrays: layout [partition-major, position] ----
+    r_part = np.repeat(np.arange(num_p, dtype=np.int32), rf)
+    r_broker = placement.reshape(-1)
+    r_leader = np.zeros(num_r, dtype=bool)
+    r_leader[::rf] = True
+
+    base = np.zeros((num_r, NUM_RESOURCES), dtype=np.float32)
+    base[:, Resource.CPU] = np.repeat(follower_cpu, rf)
+    base[:, Resource.NW_IN] = np.repeat(lead_nw_in, rf)
+    base[:, Resource.DISK] = np.repeat(lead_disk, rf)
+
+    bonus = np.zeros((num_p, NUM_RESOURCES), dtype=np.float32)
+    bonus[:, Resource.CPU] = lead_cpu - follower_cpu
+    bonus[:, Resource.NW_OUT] = lead_nw_out
+
+    # ---- capacities: sized so the loaded cluster averages ~1/margin ----
+    per_broker_load = np.zeros(NUM_RESOURCES)
+    per_broker_load[Resource.CPU] = (lead_cpu.sum()
+                                     + follower_cpu.sum() * (rf - 1)) / spec.num_brokers
+    per_broker_load[Resource.NW_IN] = lead_nw_in.sum() * rf / spec.num_brokers
+    per_broker_load[Resource.NW_OUT] = lead_nw_out.sum() / spec.num_brokers
+    per_broker_load[Resource.DISK] = lead_disk.sum() * rf / spec.num_brokers
+    capacity = np.tile((per_broker_load * spec.capacity_margin
+                        ).astype(np.float32), (num_b, 1))
+
+    alive = np.ones(num_b, dtype=bool)
+    if spec.dead_brokers:
+        dead = rng.choice(spec.num_brokers, size=spec.dead_brokers,
+                          replace=False)
+        alive[dead] = False
+    new = np.zeros(num_b, dtype=bool)
+    new[spec.num_brokers:] = True
+
+    offline = ~alive[r_broker]
+
+    state = ClusterState(
+        replica_valid=jnp.ones(num_r, dtype=bool),
+        replica_partition=jnp.asarray(r_part),
+        replica_broker=jnp.asarray(r_broker),
+        replica_disk=jnp.full(num_r, -1, dtype=jnp.int32),
+        replica_is_leader=jnp.asarray(r_leader),
+        replica_offline=jnp.asarray(offline),
+        replica_original_offline=jnp.asarray(offline),
+        replica_base_load=jnp.asarray(base),
+        partition_topic=jnp.asarray(topic_of_p),
+        partition_leader_bonus=jnp.asarray(bonus),
+        broker_alive=jnp.asarray(alive),
+        broker_new=jnp.asarray(new),
+        broker_demoted=jnp.zeros(num_b, dtype=bool),
+        broker_bad_disks=jnp.zeros(num_b, dtype=bool),
+        broker_capacity=jnp.asarray(capacity),
+        broker_rack=jnp.asarray(rack_of_broker),
+        broker_host=jnp.asarray(host_of_broker),
+        disk_broker=jnp.zeros(1, dtype=jnp.int32),
+        disk_capacity=jnp.zeros(1, dtype=jnp.float32),
+        disk_alive=jnp.ones(1, dtype=bool),
+        num_racks=spec.num_racks,
+        num_hosts=num_b,
+        num_topics=spec.num_topics,
+    )
+    topology = ClusterTopology(
+        broker_ids=list(range(num_b)),
+        rack_ids=[f"rack-{k}" for k in range(spec.num_racks)],
+        host_names=[f"host-{b}" for b in range(num_b)],
+        topics=[f"topic-{t}" for t in range(spec.num_topics)],
+        partitions=[PartitionId(f"topic-{topic_of_p[p]}", p)
+                    for p in range(num_p)],
+        disk_names=[],
+    )
+    return state, topology

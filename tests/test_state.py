@@ -1,0 +1,167 @@
+"""Tests for the tensorized cluster model: load accounting, mutation ops,
+sanity invariants (mirrors what the reference asserts via
+ClusterModel.sanityCheck and its model unit tests)."""
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import Resource as R
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.builder import ClusterModelBuilder
+from cruise_control_tpu.model.sanity import sanity_check
+from cruise_control_tpu.model.stats import compute_stats
+from cruise_control_tpu.testing import fixtures
+from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
+                                                       random_cluster)
+
+
+def test_small_cluster_broker_loads():
+    state, topo = fixtures.small_cluster()
+    sanity_check(state)
+    load = np.asarray(S.broker_load(state))
+    # broker 0: leader T1-0 (NW_OUT 130) + follower T2-0 (NW_OUT 0)
+    assert load[0, R.NW_OUT] == pytest.approx(130.0)
+    assert load[1, R.NW_OUT] == pytest.approx(110.0)
+    assert load[2, R.NW_OUT] == pytest.approx(80.0)
+    # disk: current-role load is same for leader/follower
+    assert load[0, R.DISK] == pytest.approx(75.0 + 45.0)
+    assert load[1, R.DISK] == pytest.approx(55.0 + 75.0)
+    assert load[2, R.DISK] == pytest.approx(45.0 + 55.0)
+    # NW_IN is replicated: every replica carries the partition bytes-in
+    assert load[0, R.NW_IN] == pytest.approx(100.0 + 60.0)
+
+
+def test_counts_and_topology_queries():
+    state, topo = fixtures.small_cluster()
+    assert np.asarray(S.broker_replica_count(state)).tolist() == [2, 2, 2]
+    assert np.asarray(S.broker_leader_count(state)).tolist() == [1, 1, 1]
+    prc = np.asarray(S.partition_rack_count(state))
+    # T1-0 on b0,b1 → both rack A(0)
+    assert prc[0, 0] == 2 and prc[0, 1] == 0
+    rf = np.asarray(S.partition_replication_factor(state))
+    assert rf.tolist() == [2, 2, 2]
+    leaders = np.asarray(S.partition_leader_replica(state))
+    assert (leaders >= 0).all()
+
+
+def test_move_replica_conserves_load():
+    state, _ = fixtures.small_cluster()
+    total_before = np.asarray(S.cluster_load(state))
+    # move follower of T2-0 (replica on broker 0) to broker 1
+    r = 4  # T2-0 leader is index 4? find follower on broker 0
+    broker = np.asarray(state.replica_broker)
+    part = np.asarray(state.replica_partition)
+    lead = np.asarray(state.replica_is_leader)
+    idx = int(np.nonzero((part == 2) & ~lead)[0][0])
+    import jax.numpy as jnp
+    state2 = S.move_replica(state, jnp.asarray(idx), jnp.asarray(1))
+    sanity_check(state2)
+    total_after = np.asarray(S.cluster_load(state2))
+    np.testing.assert_allclose(total_before, total_after, rtol=1e-6)
+    assert int(np.asarray(state2.replica_broker)[idx]) == 1
+
+
+def test_leadership_transfer_moves_bonus():
+    state, _ = fixtures.small_cluster()
+    import jax.numpy as jnp
+    part = np.asarray(state.replica_partition)
+    lead = np.asarray(state.replica_is_leader)
+    src = int(np.nonzero((part == 0) & lead)[0][0])
+    dst = int(np.nonzero((part == 0) & ~lead)[0][0])
+    src_broker = int(np.asarray(state.replica_broker)[src])
+    dst_broker = int(np.asarray(state.replica_broker)[dst])
+    before = np.asarray(S.broker_load(state))
+    state2 = S.transfer_leadership(state, jnp.asarray(src), jnp.asarray(dst))
+    sanity_check(state2)
+    after = np.asarray(S.broker_load(state2))
+    # NW_OUT of the partition (130) moved between brokers
+    assert after[src_broker, R.NW_OUT] == pytest.approx(
+        before[src_broker, R.NW_OUT] - 130.0)
+    assert after[dst_broker, R.NW_OUT] == pytest.approx(
+        before[dst_broker, R.NW_OUT] + 130.0)
+    # totals conserved
+    np.testing.assert_allclose(before.sum(0), after.sum(0), rtol=1e-6)
+
+
+def test_dead_broker_marks_offline():
+    state, _ = fixtures.dead_broker_cluster()
+    sanity_check(state)
+    offline = np.asarray(S.self_healing_eligible(state))
+    broker = np.asarray(state.replica_broker)
+    assert (offline == (broker == 2)).all()
+
+
+def test_kill_broker_dynamically():
+    state, _ = fixtures.small_cluster()
+    state2 = S.set_broker_state(state, 1, alive=False)
+    sanity_check(state2)
+    offline = np.asarray(S.self_healing_eligible(state2))
+    broker = np.asarray(state2.replica_broker)
+    assert (offline == (broker == 1)).all()
+
+
+def test_jbod_disk_loads_and_dead_disk():
+    state, topo = fixtures.jbod_cluster()
+    sanity_check(state)
+    dl = np.asarray(S.disk_load(state))
+    assert dl.sum() == pytest.approx(800.0)  # 4 replicas x 200
+    # broker 0's /d1 is broken (capacity -1): flagged bad_disks
+    assert bool(np.asarray(state.broker_bad_disks)[0])
+    # break broker 1's /d1 (disk index 3)
+    d1_idx = topo.disk_names.index((1, "/d1"))
+    state2 = S.mark_disk_dead(state, d1_idx)
+    offline = np.asarray(state2.replica_offline)
+    on_disk = np.asarray(state2.replica_disk) == d1_idx
+    assert (offline >= on_disk).all() and on_disk.sum() == 1
+
+
+def test_stats_and_utilization_matrix():
+    state, _ = fixtures.unbalanced_cluster()
+    stats = compute_stats(state)
+    util = np.asarray(S.utilization_matrix(state))
+    assert util.shape == (4, 3)
+    # broker 0 leads everything → max NW_OUT util is broker 0's
+    assert float(stats.util_max[R.NW_OUT]) == pytest.approx(util[R.NW_OUT, 0])
+    assert float(stats.util_std[R.NW_OUT]) > 0
+    assert int(stats.num_replicas) == 12
+    assert int(stats.num_alive_brokers) == 3
+
+
+def test_batched_moves_noop_rows():
+    state, _ = fixtures.small_cluster()
+    import jax.numpy as jnp
+    before = np.asarray(state.replica_broker).copy()
+    # one real move (replica 0 -> broker 2 would duplicate? T1-0 is on b0,b1;
+    # move to b2 is safe), one masked-out row
+    state2 = S.apply_moves(state, jnp.asarray([0, 1]), jnp.asarray([2, 2]),
+                           jnp.asarray([True, False]))
+    after = np.asarray(state2.replica_broker)
+    assert after[0] == 2
+    assert after[1] == before[1]
+    sanity_check(state2)
+
+
+def test_random_cluster_generation_and_sanity():
+    spec = RandomClusterSpec(num_brokers=20, num_partitions=200,
+                             replication_factor=3, num_racks=4,
+                             num_topics=8, seed=7)
+    state, topo = random_cluster(spec)
+    sanity_check(state)
+    assert state.num_replicas == 600
+    # every partition has rf distinct brokers
+    pbc = np.asarray(S.partition_broker_count(state))
+    assert pbc.max() == 1
+    # capacity sized so average utilization ≈ 1/margin
+    avg_util = np.asarray(S.average_utilization_percentage(state))
+    assert 0.3 < avg_util[R.NW_IN] < 0.7
+
+
+def test_random_cluster_dead_and_new_brokers():
+    spec = RandomClusterSpec(num_brokers=20, num_partitions=100,
+                             dead_brokers=2, new_brokers=3, seed=3)
+    state, _ = random_cluster(spec)
+    sanity_check(state)
+    assert int(np.asarray(state.broker_alive).sum()) == 21
+    assert int(np.asarray(state.broker_new).sum()) == 3
+    # new brokers hold nothing
+    counts = np.asarray(S.broker_replica_count(state))
+    assert (counts[20:] == 0).all()
