@@ -1,0 +1,121 @@
+"""Goal SPI and acceptance stacking.
+
+The reference's Goal plugin interface (reference: cruise-control/src/main/
+java/com/linkedin/kafka/cruisecontrol/analyzer/goals/Goal.java:38-148)
+exposes optimize / actionAcceptance / statsComparator; AbstractGoal
+(AbstractGoal.java:41-385) adds the template loop where every candidate
+action must be accepted by all previously-optimized goals
+(AnalyzerUtils.isProposalAcceptableForOptimizedGoals, AnalyzerUtils.java:119).
+
+Here a goal is a stateless Python object whose methods are *traceable*:
+`optimize` runs a jitted round loop; `accept_move` / `accept_leadership`
+return broadcastable boolean masks evaluated inside other goals' kernels —
+acceptance stacking without host round-trips (composed masks, SURVEY.md §7
+hard part (a)).
+"""
+from __future__ import annotations
+
+import abc
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import (OptimizationContext,
+                                                 RoundCache,
+                                                 make_round_cache)
+from cruise_control_tpu.model.state import ClusterState
+
+
+class OptimizationFailure(Exception):
+    """A hard goal could not be satisfied
+    (reference analyzer/exception/OptimizationFailureException)."""
+
+
+class Goal(abc.ABC):
+    """Pluggable optimization goal."""
+
+    #: human-readable unique name (reference Goal.name())
+    name: str = "goal"
+    #: hard goals abort optimization when unsatisfiable (Goal.isHardGoal())
+    is_hard: bool = False
+    #: default cap on optimization rounds (each round commits up to one move
+    #: per source broker, so this bounds per-broker sequential moves)
+    max_rounds: int = 64
+
+    def configure(self, props) -> None:  # pragma: no cover - plugin hook
+        """Config hook for getConfiguredInstances."""
+
+    # ---- optimization ----
+    @abc.abstractmethod
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence["Goal"]) -> ClusterState:
+        """Rebalance `state` for this goal; actions must be accepted by every
+        goal in `prev_goals` (reference AbstractGoal.optimize template)."""
+
+    # ---- acceptance (called while *other* goals optimize) ----
+    def accept_move(self, state: ClusterState, ctx: OptimizationContext,
+                    cache: RoundCache, replica: jax.Array,
+                    dest_broker: jax.Array) -> jax.Array:
+        """bool mask (broadcast of replica × dest_broker shapes): would this
+        goal still accept the cluster after moving `replica` to
+        `dest_broker`?  (reference Goal.actionAcceptance →
+        INTER_BROKER_REPLICA_MOVEMENT)."""
+        return jnp.ones(jnp.broadcast_shapes(replica.shape, dest_broker.shape),
+                        dtype=bool)
+
+    def accept_leadership(self, state: ClusterState, ctx: OptimizationContext,
+                          cache: RoundCache, src_replica: jax.Array,
+                          dest_replica: jax.Array) -> jax.Array:
+        """bool mask: acceptance of a leadership transfer src→dest replica
+        (reference Goal.actionAcceptance → LEADERSHIP_MOVEMENT)."""
+        return jnp.ones(jnp.broadcast_shapes(src_replica.shape,
+                                             dest_replica.shape), dtype=bool)
+
+    # ---- violation surface (detector + hard-goal verification) ----
+    def violated_brokers(self, state: ClusterState, ctx: OptimizationContext,
+                         cache: RoundCache) -> jax.Array:
+        """bool[B] — brokers currently violating this goal (used by the
+        goal-violation detector and by post-optimization hard-goal checks)."""
+        return jnp.zeros(state.num_brokers, dtype=bool)
+
+    # ---- stats regression check ----
+    def stats_not_worse(self, before, after) -> bool:
+        """Host-side check that optimization did not regress this goal's
+        statistic (reference AbstractGoal.optimize post-check :92-101 via
+        ClusterModelStatsComparator).  `before`/`after` are
+        ClusterModelStats on host (numpy)."""
+        return True
+
+    def __repr__(self) -> str:  # pragma: no cover - trivial
+        return f"<{type(self).__name__} {self.name}>"
+
+
+def compose_move_acceptance(goals: Sequence[Goal], state: ClusterState,
+                            ctx: OptimizationContext, cache: RoundCache
+                            ) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """AND of accept_move over `goals` — the acceptance-stacking mask.
+
+    The goal list is static at trace time, so the composition unrolls into
+    one fused boolean expression on device."""
+    def fn(replica: jax.Array, dest_broker: jax.Array) -> jax.Array:
+        ok = jnp.ones(jnp.broadcast_shapes(replica.shape, dest_broker.shape),
+                      dtype=bool)
+        for goal in goals:
+            ok &= goal.accept_move(state, ctx, cache, replica, dest_broker)
+        return ok
+    return fn
+
+
+def compose_leadership_acceptance(goals: Sequence[Goal], state: ClusterState,
+                                  ctx: OptimizationContext, cache: RoundCache
+                                  ) -> Callable[[jax.Array, jax.Array],
+                                                jax.Array]:
+    def fn(src_replica: jax.Array, dest_replica: jax.Array) -> jax.Array:
+        ok = jnp.ones(jnp.broadcast_shapes(src_replica.shape,
+                                           dest_replica.shape), dtype=bool)
+        for goal in goals:
+            ok &= goal.accept_leadership(state, ctx, cache, src_replica,
+                                         dest_replica)
+        return ok
+    return fn

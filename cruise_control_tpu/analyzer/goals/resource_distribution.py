@@ -1,0 +1,223 @@
+"""Resource utilization distribution goals (soft).
+
+TPU-native redesign of the reference's ResourceDistributionGoal family
+(reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/
+analyzer/goals/ResourceDistributionGoal.java:50-999 and its concrete
+subclasses Cpu/Disk/NetworkInbound/NetworkOutboundUsageDistributionGoal):
+keep every alive broker's utilization of one resource within
+[avg·(1−margin), avg·(1+margin)] (threshold math at :927-957).
+
+The reference walks brokers, trying leadership moves (NW_OUT/CPU), then
+replica move-out/in via priority queues over sorted replicas (:307-433).
+Here each optimization *round* scores all (replica, destination) pairs at
+once (kernels.move_round / leadership_round) and commits one move per
+source broker; the round loop is a `lax.while_loop` with early exit.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import kernels
+from cruise_control_tpu.analyzer.context import (OptimizationContext,
+                                                 RoundCache,
+                                                 make_round_cache)
+from cruise_control_tpu.analyzer.goals.base import (
+    Goal, compose_leadership_acceptance, compose_move_acceptance)
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.state import ClusterState
+
+
+class ResourceDistributionGoal(Goal):
+    """Balance one resource's utilization across alive brokers."""
+
+    resource: Resource = Resource.DISK
+    is_hard = False
+
+    def __init__(self, max_rounds: int = 64):
+        self.max_rounds = max_rounds
+        self.name = f"{self.resource.name.title().replace('_', '')}" \
+                    f"UsageDistributionGoal"
+
+    # -- bounds ------------------------------------------------------------
+    def _bounds(self, state: ClusterState, ctx: OptimizationContext):
+        """Absolute per-broker [lower, upper] load bounds for the resource."""
+        res = int(self.resource)
+        cap = state.broker_capacity[:, res]
+        upper = ctx.balance_upper_pct[res] * cap
+        lower = ctx.balance_lower_pct[res] * cap
+        return lower, upper
+
+    def _leadership_applicable(self) -> bool:
+        # only NW_OUT and CPU travel with leadership (reference
+        # ResourceDistributionGoal#rebalanceByMovingLoadOut leadership path)
+        return self.resource in (Resource.NW_OUT, Resource.CPU)
+
+    # -- optimization ------------------------------------------------------
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+        res = int(self.resource)
+
+        def round_body(st: ClusterState):
+            committed = jnp.zeros((), dtype=bool)
+
+            # ---------- phase A: leadership shed (NW_OUT / CPU) ----------
+            if self._leadership_applicable():
+                cache = make_round_cache(st)
+                lower, upper = self._bounds(st, ctx)
+                W = cache.broker_load[:, res]
+                bonus = (st.partition_leader_bonus[st.replica_partition, res]
+                         * st.replica_valid)
+                movable = (st.replica_valid & ~ctx.replica_excluded
+                           & ctx.replica_movable & ~st.replica_offline)
+                accept = compose_leadership_acceptance(prev_goals, st, ctx,
+                                                       cache)
+
+                def self_accept(src_r, dst_r):
+                    db = st.replica_broker[dst_r]
+                    return (W[db] + bonus[jnp.broadcast_to(
+                        src_r, jnp.broadcast_shapes(src_r.shape, dst_r.shape))]
+                        <= upper[db])
+
+                def accept_all(src_r, dst_r):
+                    return accept(src_r, dst_r) & self_accept(src_r, dst_r)
+
+                cand_r, cand_f, cand_v = kernels.leadership_round(
+                    st, bonus, W - upper, movable, ctx.broker_leader_ok,
+                    upper - W, accept_all,
+                    -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
+                    ctx.partition_replicas)
+                st = kernels.commit_leadership(st, cand_r, cand_f, cand_v)
+                committed |= jnp.any(cand_v)
+
+            # ---------- phase B: shed replicas off over-upper brokers ----
+            cache = make_round_cache(st)
+            lower, upper = self._bounds(st, ctx)
+            W = cache.broker_load[:, res]
+            w = cache.replica_load[:, res]
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & (w > 0.0))
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            dest_pref = -W / jnp.maximum(st.broker_capacity[:, res], 1e-9)
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, W > upper, W - upper, movable,
+                ctx.broker_dest_ok & st.broker_alive, upper - W, accept,
+                dest_pref, ctx.partition_replicas)
+            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            committed |= jnp.any(cand_v)
+
+            # ---------- phase C: fill under-lower brokers ----------------
+            cache = make_round_cache(st)
+            lower, upper = self._bounds(st, ctx)
+            W = cache.broker_load[:, res]
+            w = cache.replica_load[:, res]
+            avg_w = (ctx.balance_upper_pct[res] + ctx.balance_lower_pct[res]) \
+                / 2.0 * state.broker_capacity[:, res]
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & (w > 0.0))
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            under = (W < lower) & st.broker_alive & ctx.broker_dest_ok
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, W > avg_w, W - lower, movable, under, upper - W,
+                accept, -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
+                ctx.partition_replicas, strict_allowance=True)
+            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            committed |= jnp.any(cand_v)
+            return st, committed
+
+        def cond(carry):
+            _, rounds, progressed = carry
+            return progressed & (rounds < self.max_rounds)
+
+        def body(carry):
+            st, rounds, _ = carry
+            st, committed = round_body(st)
+            return st, rounds + 1, committed
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int32),
+                         jnp.ones((), dtype=bool)))
+        return state
+
+    # -- acceptance (as a previously-optimized goal) -----------------------
+    def accept_move(self, state, ctx, cache, replica, dest_broker):
+        """reference ResourceDistributionGoal.actionAcceptance:120-137 —
+        if source is above its lower limit and destination under its upper
+        limit, the move must keep both within limits; otherwise it must not
+        make the destination more unbalanced than the source was."""
+        res = int(self.resource)
+        w = cache.replica_load[:, res][replica]
+        src = state.replica_broker[replica]
+        W = cache.broker_load[:, res]
+        cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
+        lower = ctx.balance_lower_pct[res] * cap
+        upper = ctx.balance_upper_pct[res] * cap
+
+        src_ok_before = W[src] >= lower[src]
+        dest_ok_before = W[dest_broker] <= upper[dest_broker]
+        strict = ((W[dest_broker] + w <= upper[dest_broker])
+                  & (W[src] - w >= lower[src]))
+        # relaxed: destination must not end up above the source's pre-move
+        # level (utilization-wise) — "not more unbalanced"
+        relaxed = ((W[dest_broker] + w) / cap[dest_broker]
+                   <= W[src] / cap[src])
+        return jnp.where(src_ok_before & dest_ok_before, strict, relaxed)
+
+    def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
+        if not self._leadership_applicable():
+            return jnp.ones(jnp.broadcast_shapes(src_replica.shape,
+                                                 dest_replica.shape),
+                            dtype=bool)
+        res = int(self.resource)
+        bonus = state.partition_leader_bonus[
+            state.replica_partition[src_replica], res]
+        dest = state.replica_broker[dest_replica]
+        src = state.replica_broker[src_replica]
+        W = cache.broker_load[:, res]
+        cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
+        lower = ctx.balance_lower_pct[res] * cap
+        upper = ctx.balance_upper_pct[res] * cap
+        strict = ((W[dest] + bonus <= upper[dest])
+                  & (W[src] - bonus >= lower[src]))
+        relaxed = (W[dest] + bonus) / cap[dest] <= W[src] / cap[src]
+        ok_before = (W[src] >= lower[src]) & (W[dest] <= upper[dest])
+        return jnp.where(ok_before, strict, relaxed)
+
+    # -- violation surface -------------------------------------------------
+    def violated_brokers(self, state, ctx, cache):
+        res = int(self.resource)
+        W = cache.broker_load[:, res]
+        cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
+        lower = ctx.balance_lower_pct[res] * cap
+        upper = ctx.balance_upper_pct[res] * cap
+        return state.broker_alive & ((W > upper) | (W < lower))
+
+    def stats_not_worse(self, before, after) -> bool:
+        """Utilization spread for the resource must not regress (reference
+        ResourceDistributionGoalStatsComparator counts balanced brokers; the
+        st.dev is the continuous equivalent)."""
+        import numpy as np
+        res = int(self.resource)
+        return float(after.util_std[res]) <= float(before.util_std[res]) + 1e-6
+
+
+class CpuUsageDistributionGoal(ResourceDistributionGoal):
+    resource = Resource.CPU
+
+
+class DiskUsageDistributionGoal(ResourceDistributionGoal):
+    resource = Resource.DISK
+
+
+class NetworkInboundUsageDistributionGoal(ResourceDistributionGoal):
+    resource = Resource.NW_IN
+
+
+class NetworkOutboundUsageDistributionGoal(ResourceDistributionGoal):
+    resource = Resource.NW_OUT

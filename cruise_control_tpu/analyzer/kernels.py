@@ -77,7 +77,7 @@ def shed_score(w: jax.Array, excess_r: jax.Array) -> jax.Array:
 
 def move_round(state: ClusterState,
                w: jax.Array,
-               broker_w: jax.Array,
+               src_ok: jax.Array,
                src_excess: jax.Array,
                movable: jax.Array,
                dest_ok: jax.Array,
@@ -86,18 +86,19 @@ def move_round(state: ClusterState,
                dest_pref: jax.Array,
                partition_replicas: jax.Array,
                forced: Optional[jax.Array] = None,
+               strict_allowance: bool = False,
                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One round of batched replica-move search.
 
     Args:
       w: f32[R] per-replica weight of the balanced metric.
-      broker_w: f32[B] current per-broker totals of `w`.
-      src_excess: f32[B] how much each broker wants to shed (<=0: not a src).
+      src_ok: bool[B] brokers acting as sources this round.
+      src_excess: f32[B] how much each source wants to shed (shed-score pivot).
       movable: bool[R] replicas eligible to move this round.
       dest_ok: bool[B] broker-level destination eligibility.
       dest_headroom: f32[B] max additional `w` each destination may take
         (post-move bound already including the goal's own limit).
-      accept_matrix_fn: (cand_replicas i32[C], all-dest) -> bool[C, B]
+      accept_matrix_fn: (cand_replicas i32[C,1], dest i32[1,B]) -> bool[C, B]
         acceptance of previously-optimized goals + structural feasibility
         beyond what this kernel enforces.
       dest_pref: f32[B] destination preference (higher = better).
@@ -105,6 +106,10 @@ def move_round(state: ClusterState,
         no-two-replicas-of-a-partition-on-one-broker constraint).
       forced: optional bool[R] — replicas that MUST move (offline/self-heal):
         they bypass the shed-score and excess masking.
+      strict_allowance: if True a replica may only move when w <= its
+        broker's excess (the source must stay above its lower bound — the
+        fill-underloaded phase; reference
+        isLoadAboveBalanceLowerLimitAfterChange REMOVE check).
 
     Returns (cand_replica i32[C], cand_dest i32[C], cand_valid bool[C]) with
     C == num_brokers (one candidate per source broker).
@@ -112,8 +117,9 @@ def move_round(state: ClusterState,
     num_b = state.num_brokers
     rb = state.replica_broker
 
-    is_src = src_excess > 0.0
-    eligible = movable & is_src[rb]
+    eligible = movable & src_ok[rb]
+    if strict_allowance:
+        eligible &= w <= src_excess[rb]
     if forced is not None:
         eligible = eligible | (movable & forced)
         # forced replicas outrank everything else on their broker
@@ -139,14 +145,17 @@ def move_round(state: ClusterState,
                   & (sib_broker[:, :, None]
                      == jnp.arange(num_b)[None, None, :]), axis=1)
     feasible &= ~dup
-    feasible &= accept_matrix_fn(cand_r_safe, None)
+    feasible &= accept_matrix_fn(cand_r_safe[:, None],
+                                 jnp.arange(num_b, dtype=jnp.int32)[None, :])
 
     pref = jnp.where(feasible, dest_pref[None, :], NEG)
     cand_dest = jnp.argmax(pref, axis=1).astype(jnp.int32)
     cand_valid = cand_has & (jnp.max(pref, axis=1) > NEG / 2)
 
-    # one winner per destination
-    gain = cand_w + (forced is not None) * 0.0
+    # one winner per destination (forced/self-heal moves take precedence)
+    gain = cand_w
+    if forced is not None:
+        gain = gain + jnp.where(forced[cand_r_safe], 1e12, 0.0)
     cand_valid = resolve_dest_conflicts(cand_dest, gain, cand_valid, num_b)
     return cand_r, cand_dest, cand_valid
 

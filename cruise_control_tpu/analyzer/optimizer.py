@@ -1,0 +1,220 @@
+"""Multi-goal optimizer orchestration.
+
+The TPU-native counterpart of the reference's GoalOptimizer.optimizations
+(reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/
+analyzer/GoalOptimizer.java:409-480): goals run in priority order, each
+goal's actions must be accepted by every previously-optimized goal, hard
+goal failure aborts, per-goal statistics must not regress
+(AbstractGoal.java:92-101), and the initial→final distribution diff becomes
+the proposal set (AnalyzerUtils.getDiff).
+
+Self-healing (offline replicas on dead brokers/disks) runs as a dedicated
+batched pre-pass: the reference interleaves it into every goal's
+rebalanceForBroker; the outcome contract — no replica remains on a dead
+broker, moves land within capacity — is identical and checked by the
+verifier (testing/verifier.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer import kernels
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationContext,
+                                                 OptimizationOptions,
+                                                 make_context,
+                                                 make_round_cache)
+from cruise_control_tpu.analyzer.goals.base import (Goal, OptimizationFailure,
+                                                    compose_move_acceptance)
+from cruise_control_tpu.analyzer.proposals import (ExecutionProposal,
+                                                   diff_proposals)
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.sanity import sanity_check
+from cruise_control_tpu.model.state import ClusterState
+from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats
+
+LOG = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class OptimizerResult:
+    """reference analyzer/OptimizerResult.java:290 — proposals plus per-goal
+    before/after statistics and violation info."""
+
+    proposals: List[ExecutionProposal]
+    stats_before: ClusterModelStats
+    stats_after: ClusterModelStats
+    stats_by_goal: Dict[str, ClusterModelStats]
+    violated_goals_before: List[str]
+    violated_goals_after: List[str]
+    regressed_goals: List[str]
+    final_state: ClusterState
+    duration_s: float = 0.0
+
+    @property
+    def num_replica_movements(self) -> int:
+        return sum(len(p.replicas_to_add) for p in self.proposals)
+
+    @property
+    def num_leadership_movements(self) -> int:
+        return sum(1 for p in self.proposals
+                   if p.has_leader_action and not p.has_replica_action)
+
+    @property
+    def data_to_move(self) -> float:
+        return sum(p.inter_broker_data_to_move for p in self.proposals)
+
+    def balancedness_score(self) -> float:
+        """[0, 100] gauge (reference AnomalyDetector.java:176-178 /
+        GoalOptimizer balancedness weights): fraction of goals without
+        violations, weighted double for hard goals."""
+        if not self.violated_goals_before and not self.violated_goals_after:
+            return 100.0
+        total = len(set(self.violated_goals_before)
+                    | set(self.violated_goals_after)) or 1
+        fixed = len(set(self.violated_goals_before)
+                    - set(self.violated_goals_after))
+        return 100.0 * fixed / total
+
+
+def heal_offline_replicas(state: ClusterState, ctx: OptimizationContext,
+                          max_rounds: int = 256) -> ClusterState:
+    """Batched self-healing: every offline replica moves to an alive broker
+    with capacity headroom, preferring least-loaded destinations.  Honors
+    the no-duplicate-partition constraint and capacity thresholds.
+    """
+    def cond(carry):
+        st, rounds, progressed = carry
+        return progressed & (rounds < max_rounds)
+
+    def body(carry):
+        st, rounds, _ = carry
+        cache = make_round_cache(st)
+        offline = S.self_healing_eligible(st)
+        w = cache.replica_load[:, Resource.DISK]
+        cap = st.broker_capacity * ctx.capacity_threshold[None, :]
+        headroom_all = cap - cache.broker_load          # [B, RES]
+
+        def accept(r, d):
+            # capacity across every resource (CapacityGoal acceptance)
+            load_r = cache.replica_load[r]              # [..., RES]
+            return jnp.all(load_r <= headroom_all[d], axis=-1)
+
+        dest_ok = st.broker_alive & ctx.broker_dest_ok
+        util = cache.broker_load[:, Resource.DISK] / jnp.maximum(
+            st.broker_capacity[:, Resource.DISK], 1e-9)
+        cand_r, cand_d, cand_v = kernels.move_round(
+            st, w, jnp.zeros(st.num_brokers, bool),
+            jnp.zeros(st.num_brokers), st.replica_valid, dest_ok,
+            jnp.full(st.num_brokers, jnp.inf), accept, -util,
+            ctx.partition_replicas, forced=offline)
+        st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+        return st, rounds + 1, jnp.any(cand_v)
+
+    state, _, _ = jax.lax.while_loop(
+        cond, body, (state, jnp.zeros((), jnp.int32), jnp.ones((), bool)))
+    return state
+
+
+class GoalOptimizer:
+    """Priority-ordered multi-goal optimization with acceptance stacking."""
+
+    def __init__(self, goals: Sequence[Goal],
+                 constraint: Optional[BalancingConstraint] = None,
+                 jit_goals: bool = True):
+        self.goals = list(goals)
+        self.constraint = constraint or BalancingConstraint()
+        self._jit_goals = jit_goals
+        self._compiled: Dict[str, object] = {}
+
+    def optimizations(self, state: ClusterState, topology,
+                      options: Optional[OptimizationOptions] = None,
+                      check_sanity: bool = True) -> OptimizerResult:
+        """Run all goals in priority order and diff out proposals
+        (reference GoalOptimizer.optimizations :409-480)."""
+        t_start = time.time()
+        options = options or OptimizationOptions()
+        ctx = make_context(state, self.constraint, options, topology)
+        initial = state
+        stats_before = jax.device_get(compute_stats(state))
+
+        cache0 = make_round_cache(state)
+        violated_before = [g.name for g in self.goals
+                           if bool(np.asarray(
+                               g.violated_brokers(state, ctx, cache0)).any())]
+
+        if bool(np.asarray(S.self_healing_eligible(state)).any()):
+            heal = self._get_compiled("__heal__",
+                                      lambda s, c: heal_offline_replicas(s, c))
+            state = heal(state, ctx)
+            still_offline = int(np.asarray(
+                S.self_healing_eligible(state)).sum())
+            if still_offline:
+                raise OptimizationFailure(
+                    f"self-healing could not relocate {still_offline} "
+                    f"offline replicas (insufficient capacity or "
+                    f"eligible brokers)")
+
+        stats_by_goal: Dict[str, ClusterModelStats] = {}
+        regressed: List[str] = []
+        prev_stats = stats_before
+        for i, goal in enumerate(self.goals):
+            prev_goals = tuple(self.goals[:i])
+            fn = self._get_compiled(
+                goal.name,
+                lambda s, c, g=goal, pg=prev_goals: g.optimize(s, c, pg))
+            t0 = time.time()
+            state = fn(state, ctx)
+            jax.block_until_ready(state.replica_broker)
+            goal_stats = jax.device_get(compute_stats(state))
+            stats_by_goal[goal.name] = goal_stats
+            LOG.debug("Finished optimization for %s in %.0fms", goal.name,
+                      (time.time() - t0) * 1e3)
+            if not goal.stats_not_worse(prev_stats, goal_stats):
+                # reference AbstractGoal.optimize :92-101 treats a regressed
+                # comparator as failure unless self-healing
+                regressed.append(goal.name)
+                LOG.warning("goal %s regressed its statistic", goal.name)
+            prev_stats = goal_stats
+
+        cache1 = make_round_cache(state)
+        violated_after = [g.name for g in self.goals
+                          if bool(np.asarray(
+                              g.violated_brokers(state, ctx, cache1)).any())]
+        for goal in self.goals:
+            if goal.is_hard and goal.name in violated_after:
+                raise OptimizationFailure(
+                    f"hard goal {goal.name} still violated after optimization")
+
+        if check_sanity:
+            sanity_check(state)
+
+        partition_rows = np.asarray(ctx.partition_replicas)
+        proposals = diff_proposals(initial, state, topology, partition_rows)
+        stats_after = jax.device_get(compute_stats(state))
+        return OptimizerResult(
+            proposals=proposals,
+            stats_before=stats_before,
+            stats_after=stats_after,
+            stats_by_goal=stats_by_goal,
+            violated_goals_before=violated_before,
+            violated_goals_after=violated_after,
+            regressed_goals=regressed,
+            final_state=state,
+            duration_s=time.time() - t_start,
+        )
+
+    def _get_compiled(self, key: str, fn):
+        if not self._jit_goals:
+            return fn
+        if key not in self._compiled:
+            self._compiled[key] = jax.jit(fn)
+        return self._compiled[key]

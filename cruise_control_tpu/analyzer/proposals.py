@@ -1,0 +1,140 @@
+"""Execution proposals — the optimizer's output contract.
+
+Host-side diff of initial vs optimized tensor states into per-partition
+reassignment proposals, the equivalent of the reference's
+AnalyzerUtils.getDiff (reference: cruise-control/src/main/java/com/linkedin/
+kafka/cruisecontrol/analyzer/AnalyzerUtils.java:50-117) producing
+ExecutionProposal objects (executor/ExecutionProposal.java:1-301).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.builder import ClusterTopology, PartitionId
+from cruise_control_tpu.model.state import ClusterState
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaPlacement:
+    """(broker id, optional logdir) — reference ReplicaPlacementInfo."""
+    broker_id: int
+    logdir: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutionProposal:
+    """One partition's reassignment: old → new replica list, leader first
+    (reference ExecutionProposal.java: oldLeader, old/new replica lists)."""
+
+    partition: PartitionId
+    old_leader: int
+    old_replicas: Tuple[ReplicaPlacement, ...]
+    new_replicas: Tuple[ReplicaPlacement, ...]
+    partition_size: float = 0.0   # DISK footprint of the leader replica
+
+    @property
+    def new_leader(self) -> int:
+        return self.new_replicas[0].broker_id
+
+    @property
+    def has_replica_action(self) -> bool:
+        return ({p.broker_id for p in self.old_replicas}
+                != {p.broker_id for p in self.new_replicas})
+
+    @property
+    def has_leader_action(self) -> bool:
+        return self.old_leader != self.new_leader
+
+    @property
+    def replicas_to_add(self) -> Tuple[int, ...]:
+        old = {p.broker_id for p in self.old_replicas}
+        return tuple(p.broker_id for p in self.new_replicas
+                     if p.broker_id not in old)
+
+    @property
+    def replicas_to_remove(self) -> Tuple[int, ...]:
+        new = {p.broker_id for p in self.new_replicas}
+        return tuple(p.broker_id for p in self.old_replicas
+                     if p.broker_id not in new)
+
+    @property
+    def inter_broker_data_to_move(self) -> float:
+        return self.partition_size * len(self.replicas_to_add)
+
+    def to_json(self) -> dict:
+        return {
+            "topicPartition": {"topic": self.partition.topic,
+                               "partition": self.partition.partition},
+            "oldLeader": self.old_leader,
+            "oldReplicas": [p.broker_id for p in self.old_replicas],
+            "newReplicas": [p.broker_id for p in self.new_replicas],
+        }
+
+
+def _ordered_replicas(state_np: dict, topology: ClusterTopology,
+                      partition_rows: np.ndarray, p: int
+                      ) -> Tuple[int, List[ReplicaPlacement]]:
+    """Replica list of partition p with the leader first."""
+    rows = partition_rows[p]
+    rows = rows[rows >= 0]
+    brokers = state_np["replica_broker"][rows]
+    leaders = state_np["replica_is_leader"][rows]
+    disks = state_np["replica_disk"][rows]
+    order = np.argsort(~leaders, kind="stable")  # leader(s) first
+    placements = []
+    for i in order:
+        logdir = None
+        if disks[i] >= 0:
+            logdir = topology.disk_names[disks[i]][1]
+        placements.append(
+            ReplicaPlacement(topology.broker_ids[brokers[i]], logdir))
+    leader_rows = rows[leaders]
+    leader = (topology.broker_ids[state_np["replica_broker"][leader_rows[0]]]
+              if len(leader_rows) else -1)
+    return leader, placements
+
+
+def diff_proposals(initial: ClusterState, optimized: ClusterState,
+                   topology: ClusterTopology,
+                   partition_rows: np.ndarray) -> List[ExecutionProposal]:
+    """Diff two states sharing replica/partition indexing into proposals.
+
+    Vectorized pre-filter: only partitions whose replica brokers or leader
+    flags changed produce a proposal (AnalyzerUtils.getDiff semantics).
+    """
+    init = {k: np.asarray(getattr(initial, k)) for k in
+            ("replica_broker", "replica_is_leader", "replica_disk")}
+    opt = {k: np.asarray(getattr(optimized, k)) for k in
+           ("replica_broker", "replica_is_leader", "replica_disk")}
+    valid = np.asarray(initial.replica_valid)
+    changed_r = valid & (
+        (init["replica_broker"] != opt["replica_broker"])
+        | (init["replica_is_leader"] != opt["replica_is_leader"])
+        | (init["replica_disk"] != opt["replica_disk"]))
+    if not changed_r.any():
+        return []
+    part = np.asarray(initial.replica_partition)
+    changed_p = np.unique(part[changed_r])
+
+    # partition DISK size: leader replica's disk load
+    base = np.asarray(initial.replica_base_load)
+    proposals = []
+    for p in changed_p:
+        old_leader, old_reps = _ordered_replicas(init, topology,
+                                                 partition_rows, int(p))
+        _, new_reps = _ordered_replicas(opt, topology, partition_rows, int(p))
+        rows = partition_rows[p]
+        rows = rows[rows >= 0]
+        size = float(base[rows, Resource.DISK].max()) if len(rows) else 0.0
+        proposals.append(ExecutionProposal(
+            partition=topology.partitions[int(p)],
+            old_leader=old_leader,
+            old_replicas=tuple(old_reps),
+            new_replicas=tuple(new_reps),
+            partition_size=size,
+        ))
+    return proposals

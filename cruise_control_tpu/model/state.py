@@ -276,21 +276,24 @@ def apply_moves(state: ClusterState, replicas: jax.Array,
                 dest_brokers: jax.Array, valid: jax.Array) -> ClusterState:
     """Batched replica relocation: commit K (replica → dest) moves at once.
 
-    Invalid rows (valid=False) are redirected to a no-op by writing the
-    replica's current broker back.  This is the round-commit primitive of the
-    batched optimizer — the reference commits one move at a time inside
+    Invalid rows (valid=False) are routed to an out-of-bounds index and
+    dropped by the scatter, so they can never collide with a real update of
+    the same replica (duplicate scatter indices with conflicting values have
+    undefined order).  This is the round-commit primitive of the batched
+    optimizer — the reference commits one move at a time inside
     rebalanceForBroker (AbstractGoal.java:179-221); here a whole round of
     non-conflicting moves lands in one scatter."""
     replicas = replicas.astype(jnp.int32)
-    cur = state.replica_broker[replicas]
-    tgt = jnp.where(valid, dest_brokers.astype(jnp.int32), cur)
-    new_broker = state.replica_broker.at[replicas].set(tgt)
-    moved = valid & (tgt != cur)
-    new_disk = state.replica_disk.at[replicas].set(
-        jnp.where(moved, -1, state.replica_disk[replicas]))
-    new_offline = state.replica_offline.at[replicas].set(
-        jnp.where(moved, ~state.broker_alive[tgt],
-                  state.replica_offline[replicas]))
+    num_r = state.replica_broker.shape[0]
+    tgt = dest_brokers.astype(jnp.int32)
+    # dest == current broker is a no-op, not a "move": it must not clear the
+    # replica's disk/offline flags
+    valid = valid & (state.replica_broker[replicas] != tgt)
+    idx = jnp.where(valid, replicas, num_r)          # OOB rows are dropped
+    new_broker = state.replica_broker.at[idx].set(tgt, mode="drop")
+    new_disk = state.replica_disk.at[idx].set(-1, mode="drop")
+    new_offline = state.replica_offline.at[idx].set(
+        ~state.broker_alive[tgt], mode="drop")
     return state.replace(replica_broker=new_broker, replica_disk=new_disk,
                          replica_offline=new_offline)
 
@@ -308,12 +311,14 @@ def transfer_leadership(state: ClusterState, src_replica: jax.Array,
 def apply_leadership_transfers(state: ClusterState, src_replicas: jax.Array,
                                dest_replicas: jax.Array,
                                valid: jax.Array) -> ClusterState:
-    """Batched leadership transfer: K (leader → follower) handoffs at once."""
-    src = src_replicas.astype(jnp.int32)
-    dst = dest_replicas.astype(jnp.int32)
+    """Batched leadership transfer: K (leader → follower) handoffs at once.
+    Invalid rows are routed out-of-bounds and dropped (see apply_moves)."""
+    num_r = state.replica_is_leader.shape[0]
+    src = jnp.where(valid, src_replicas.astype(jnp.int32), num_r)
+    dst = jnp.where(valid, dest_replicas.astype(jnp.int32), num_r)
     flags = state.replica_is_leader
-    flags = flags.at[src].set(jnp.where(valid, False, flags[src]))
-    flags = flags.at[dst].set(jnp.where(valid, True, flags[dst]))
+    flags = flags.at[src].set(False, mode="drop")
+    flags = flags.at[dst].set(True, mode="drop")
     return state.replace(replica_is_leader=flags)
 
 
