@@ -222,6 +222,8 @@ class RoundCache:
     replica_count: jax.Array      # i32[B]
     leader_count: jax.Array       # i32[B]
     partition_rack_count: jax.Array  # i32[P, K]
+    broker_topic_count: jax.Array    # i32[B, T]
+    potential_nw_out: jax.Array      # f32[B]
 
 
 def make_round_cache(state: ClusterState) -> RoundCache:
@@ -234,4 +236,6 @@ def make_round_cache(state: ClusterState) -> RoundCache:
         replica_count=S.broker_replica_count(state),
         leader_count=S.broker_leader_count(state),
         partition_rack_count=S.partition_rack_count(state),
+        broker_topic_count=S.broker_topic_replica_count(state),
+        potential_nw_out=S.potential_leadership_load(state),
     )

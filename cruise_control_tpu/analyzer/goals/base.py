@@ -91,6 +91,14 @@ class Goal(abc.ABC):
         return f"<{type(self).__name__} {self.name}>"
 
 
+def new_broker_dest_mask(state: ClusterState, base: jax.Array) -> jax.Array:
+    """When new brokers exist, balancing actions target only them
+    (reference brokersToBalance: newBrokers if non-empty,
+    ResourceDistributionGoal.java:169-175)."""
+    any_new = jnp.any(state.broker_new)
+    return jnp.where(any_new, base & state.broker_new, base)
+
+
 def compose_move_acceptance(goals: Sequence[Goal], state: ClusterState,
                             ctx: OptimizationContext, cache: RoundCache
                             ) -> Callable[[jax.Array, jax.Array], jax.Array]:

@@ -1,0 +1,213 @@
+"""Capacity goals (hard).
+
+TPU-native equivalents of the reference's CapacityGoal hierarchy
+(reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/
+analyzer/goals/CapacityGoal.java:42-502 → Cpu/Disk/NetworkInbound/
+NetworkOutboundCapacityGoal) and ReplicaCapacityGoal
+(ReplicaCapacityGoal.java:41-380): no alive broker may exceed
+capacity × capacity-threshold for the resource (or the max replica count).
+
+Being hard goals, violations after optimization abort the whole run
+(reference Goal.isHardGoal + GoalOptimizer hard-goal handling).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import kernels
+from cruise_control_tpu.analyzer.context import (OptimizationContext,
+                                                 make_round_cache)
+from cruise_control_tpu.analyzer.goals.base import (
+    Goal, compose_leadership_acceptance, compose_move_acceptance)
+from cruise_control_tpu.common.resources import (RESOURCE_GOAL_NAMES,
+                                                 Resource)
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.state import ClusterState
+
+
+class CapacityGoal(Goal):
+    """Keep one resource's broker load under capacity × threshold."""
+
+    resource: Resource = Resource.DISK
+    is_hard = True
+
+    def __init__(self, max_rounds: int = 64):
+        self.max_rounds = max_rounds
+        self.name = (RESOURCE_GOAL_NAMES[int(self.resource)]
+                     + "CapacityGoal")
+
+    def _limit(self, state: ClusterState, ctx: OptimizationContext):
+        res = int(self.resource)
+        return state.broker_capacity[:, res] * ctx.capacity_threshold[res]
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+        res = int(self.resource)
+        leadership_helps = self.resource in (Resource.NW_OUT, Resource.CPU)
+
+        def round_body(st: ClusterState):
+            committed = jnp.zeros((), dtype=bool)
+            if leadership_helps:
+                cache = make_round_cache(st)
+                limit = self._limit(st, ctx)
+                W = cache.broker_load[:, res]
+                bonus = (st.partition_leader_bonus[st.replica_partition, res]
+                         * st.replica_valid)
+                movable = (st.replica_valid & ~ctx.replica_excluded
+                           & ctx.replica_movable & ~st.replica_offline)
+                accept = compose_leadership_acceptance(prev_goals, st, ctx,
+                                                       cache)
+
+                def accept_all(src_r, dst_r):
+                    db = st.replica_broker[dst_r]
+                    fits = (W[db] + bonus[jnp.broadcast_to(
+                        src_r, jnp.broadcast_shapes(src_r.shape,
+                                                    dst_r.shape))]
+                        <= limit[db])
+                    return fits & accept(src_r, dst_r)
+
+                cand_r, cand_f, cand_v = kernels.leadership_round(
+                    st, bonus, W - limit, movable, ctx.broker_leader_ok,
+                    limit - W, accept_all, -W / jnp.maximum(limit, 1e-9),
+                    ctx.partition_replicas)
+                st = kernels.commit_leadership(st, cand_r, cand_f, cand_v)
+                committed |= jnp.any(cand_v)
+
+            cache = make_round_cache(st)
+            limit = self._limit(st, ctx)
+            W = cache.broker_load[:, res]
+            w = cache.replica_load[:, res]
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & (w > 0.0))
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, W > limit, W - limit, movable,
+                ctx.broker_dest_ok & st.broker_alive, limit - W, accept,
+                -W / jnp.maximum(limit, 1e-9), ctx.partition_replicas)
+            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            committed |= jnp.any(cand_v)
+            return st, committed
+
+        def cond(carry):
+            st, rounds, progressed = carry
+            cache = make_round_cache(st)
+            still_violated = jnp.any(
+                (cache.broker_load[:, res] > self._limit(st, ctx))
+                & st.broker_alive)
+            return progressed & still_violated & (rounds < self.max_rounds)
+
+        def body(carry):
+            st, rounds, _ = carry
+            st, committed = round_body(st)
+            return st, rounds + 1, committed
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int32),
+                         jnp.ones((), dtype=bool)))
+        return state
+
+    def accept_move(self, state, ctx, cache, replica, dest_broker):
+        """Destination must stay under capacity threshold
+        (reference CapacityGoal.actionAcceptance → REPLICA_REJECT)."""
+        res = int(self.resource)
+        limit = self._limit(state, ctx)
+        w = cache.replica_load[:, res][replica]
+        return cache.broker_load[:, res][dest_broker] + w <= limit[dest_broker]
+
+    def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
+        if self.resource not in (Resource.NW_OUT, Resource.CPU):
+            return jnp.ones(jnp.broadcast_shapes(src_replica.shape,
+                                                 dest_replica.shape),
+                            dtype=bool)
+        res = int(self.resource)
+        limit = self._limit(state, ctx)
+        bonus = state.partition_leader_bonus[
+            state.replica_partition[src_replica], res]
+        dest = state.replica_broker[dest_replica]
+        return cache.broker_load[:, res][dest] + bonus <= limit[dest]
+
+    def violated_brokers(self, state, ctx, cache):
+        res = int(self.resource)
+        return state.broker_alive & (
+            cache.broker_load[:, res] > self._limit(state, ctx))
+
+    def stats_not_worse(self, before, after) -> bool:
+        res = int(self.resource)
+        # the worst broker must not get worse (it may stay put if other
+        # goals legitimately filled headroom below the threshold)
+        return (float(after.util_max[res])
+                <= max(float(before.util_max[res]), 1.0) + 1e-6)
+
+
+class CpuCapacityGoal(CapacityGoal):
+    resource = Resource.CPU
+
+
+class DiskCapacityGoal(CapacityGoal):
+    resource = Resource.DISK
+
+
+class NetworkInboundCapacityGoal(CapacityGoal):
+    resource = Resource.NW_IN
+
+
+class NetworkOutboundCapacityGoal(CapacityGoal):
+    resource = Resource.NW_OUT
+
+
+class ReplicaCapacityGoal(Goal):
+    """Max replicas per broker (reference ReplicaCapacityGoal.java:41)."""
+
+    is_hard = True
+    name = "ReplicaCapacityGoal"
+
+    def __init__(self, max_rounds: int = 64):
+        self.max_rounds = max_rounds
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+        limit = float(ctx.max_replicas_per_broker)
+
+        def round_body(st: ClusterState):
+            cache = make_round_cache(st)
+            count = cache.replica_count.astype(jnp.float32)
+            w = jnp.ones(st.num_replicas, dtype=jnp.float32)
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline)
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, count > limit, count - limit, movable,
+                ctx.broker_dest_ok & st.broker_alive, limit - count, accept,
+                -count, ctx.partition_replicas)
+            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            return st, jnp.any(cand_v)
+
+        def cond(carry):
+            st, rounds, progressed = carry
+            count = S.broker_replica_count(st).astype(jnp.float32)
+            return (progressed & (rounds < self.max_rounds)
+                    & jnp.any((count > limit) & st.broker_alive))
+
+        def body(carry):
+            st, rounds, _ = carry
+            st, committed = round_body(st)
+            return st, rounds + 1, committed
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int32),
+                         jnp.ones((), dtype=bool)))
+        return state
+
+    def accept_move(self, state, ctx, cache, replica, dest_broker):
+        limit = ctx.max_replicas_per_broker
+        ones = jnp.ones(jnp.broadcast_shapes(replica.shape,
+                                             dest_broker.shape), bool)
+        return ones & (cache.replica_count[dest_broker] + 1 <= limit)
+
+    def violated_brokers(self, state, ctx, cache):
+        return state.broker_alive & (
+            cache.replica_count > ctx.max_replicas_per_broker)

@@ -1,0 +1,298 @@
+"""Replica-count distribution goals (soft).
+
+TPU-native equivalents of the reference's count-based distribution family
+(reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/
+analyzer/goals/ReplicaDistributionAbstractGoal.java:27 →
+ReplicaDistributionGoal, LeaderReplicaDistributionGoal;
+TopicReplicaDistributionGoal.java:55-591): per-broker replica / leader /
+per-topic-replica counts within [avg·(1−margin), avg·(1+margin)], with a
+minimum gap of one replica so tiny clusters don't churn
+(reference ReplicaDistributionAbstractGoal balance-limit math).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import kernels
+from cruise_control_tpu.analyzer.context import (OptimizationContext,
+                                                 make_round_cache)
+from cruise_control_tpu.analyzer.goals.base import (
+    Goal, compose_leadership_acceptance, compose_move_acceptance,
+    new_broker_dest_mask)
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.state import ClusterState
+
+
+def _count_bounds(avg: jax.Array, pct_margin: float):
+    """Reference ReplicaDistributionAbstractGoal: limits are
+    avg*(1±margin), at least one replica away from the average."""
+    upper = jnp.ceil(jnp.maximum(avg * (1 + pct_margin), avg + 1))
+    lower = jnp.floor(jnp.minimum(avg * (1 - pct_margin), avg - 1))
+    return jnp.maximum(lower, 0.0), upper
+
+
+class ReplicaDistributionGoal(Goal):
+    """Even replica counts (reference ReplicaDistributionGoal.java)."""
+
+    name = "ReplicaDistributionGoal"
+    balance_pct_attr = "replica_balance_percentage"
+
+    def __init__(self, max_rounds: int = 64, balance_pct_margin: float = 0.09):
+        self.max_rounds = max_rounds
+        # (pct - 1) * margin with defaults 1.1 / 0.9
+        self.pct_margin = balance_pct_margin
+
+    # -- weights: which replicas count for this goal
+    def _weights(self, state: ClusterState) -> jax.Array:
+        return state.replica_valid.astype(jnp.float32)
+
+    def _counts(self, cache) -> jax.Array:
+        return cache.replica_count.astype(jnp.float32)
+
+    def _avg(self, state: ClusterState, counts: jax.Array) -> jax.Array:
+        alive = state.broker_alive
+        return jnp.sum(counts * alive) / jnp.maximum(jnp.sum(alive), 1)
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+
+        def round_body(st: ClusterState):
+            cache = make_round_cache(st)
+            counts = self._counts(cache)
+            avg = self._avg(st, counts)
+            lower, upper = _count_bounds(avg, self.pct_margin)
+            w = self._weights(st)
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & (w > 0.0))
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            dest_ok = new_broker_dest_mask(
+                st, ctx.broker_dest_ok & st.broker_alive)
+            committed = jnp.zeros((), dtype=bool)
+
+            # shed from over-upper brokers
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, counts > upper, counts - upper, movable,
+                dest_ok & (counts + 1 <= upper), upper - counts, accept,
+                -counts, ctx.partition_replicas)
+            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            committed |= jnp.any(cand_v)
+
+            # fill under-lower brokers
+            cache = make_round_cache(st)
+            counts = self._counts(cache)
+            w = self._weights(st)
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & (w > 0.0))
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, counts > avg, counts - lower, movable,
+                dest_ok & (counts < lower), upper - counts, accept,
+                -counts, ctx.partition_replicas, strict_allowance=True)
+            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            committed |= jnp.any(cand_v)
+            return st, committed
+
+        def cond(carry):
+            st, rounds, progressed = carry
+            return progressed & (rounds < self.max_rounds)
+
+        def body(carry):
+            st, rounds, _ = carry
+            st, committed = round_body(st)
+            return st, rounds + 1, committed
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int32),
+                         jnp.ones((), dtype=bool)))
+        return state
+
+    def accept_move(self, state, ctx, cache, replica, dest_broker):
+        counts = self._counts(cache)
+        avg = self._avg(state, counts)
+        lower, upper = _count_bounds(avg, self.pct_margin)
+        src = state.replica_broker[replica]
+        w = self._weights(state)[replica]
+        ones = jnp.ones(jnp.broadcast_shapes(replica.shape,
+                                             dest_broker.shape), bool)
+        strict = ((counts[dest_broker] + w <= upper)
+                  & (counts[src] - w >= lower))
+        relaxed = counts[dest_broker] + w <= counts[src]
+        ok_before = (counts[src] >= lower) & (counts[dest_broker] <= upper)
+        # a move with zero weight (e.g. a follower under the leader-count
+        # goal) cannot change this goal's counts — always acceptable
+        # (reference accepts non-leader replica moves unconditionally)
+        return ones & ((w == 0) | jnp.where(ok_before, strict, relaxed))
+
+    def violated_brokers(self, state, ctx, cache):
+        counts = self._counts(cache)
+        avg = self._avg(state, counts)
+        lower, upper = _count_bounds(avg, self.pct_margin)
+        return state.broker_alive & ((counts > upper) | (counts < lower))
+
+    def stats_not_worse(self, before, after) -> bool:
+        return (float(after.replica_count_std)
+                <= float(before.replica_count_std) + 1e-6)
+
+
+class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
+    """Even leader counts — prefers leadership transfers, falls back to
+    moving leader replicas (reference LeaderReplicaDistributionGoal.java)."""
+
+    name = "LeaderReplicaDistributionGoal"
+
+    def _weights(self, state: ClusterState) -> jax.Array:
+        return (state.replica_valid
+                & state.replica_is_leader).astype(jnp.float32)
+
+    def _counts(self, cache) -> jax.Array:
+        return cache.leader_count.astype(jnp.float32)
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+
+        def round_body(st: ClusterState):
+            cache = make_round_cache(st)
+            counts = self._counts(cache)
+            avg = self._avg(st, counts)
+            lower, upper = _count_bounds(avg, self.pct_margin)
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline)
+            accept = compose_leadership_acceptance(prev_goals, st, ctx, cache)
+
+            def accept_all(src_r, dst_r):
+                db = st.replica_broker[dst_r]
+                return (counts[db] + 1 <= upper) & accept(src_r, dst_r)
+
+            bonus = (st.replica_valid & st.replica_is_leader).astype(
+                jnp.float32)
+            cand_r, cand_f, cand_v = kernels.leadership_round(
+                st, bonus, counts - upper, movable, ctx.broker_leader_ok,
+                upper - counts, accept_all, -counts, ctx.partition_replicas)
+            st = kernels.commit_leadership(st, cand_r, cand_f, cand_v)
+            return st, jnp.any(cand_v)
+
+        def cond(carry):
+            st, rounds, progressed = carry
+            return progressed & (rounds < self.max_rounds)
+
+        def body(carry):
+            st, rounds, _ = carry
+            st, committed = round_body(st)
+            return st, rounds + 1, committed
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int32),
+                         jnp.ones((), dtype=bool)))
+        return state
+
+    def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
+        counts = self._counts(cache)
+        avg = self._avg(state, counts)
+        lower, upper = _count_bounds(avg, self.pct_margin)
+        dest = state.replica_broker[dest_replica]
+        src = state.replica_broker[src_replica]
+        strict = (counts[dest] + 1 <= upper) & (counts[src] - 1 >= lower)
+        relaxed = counts[dest] + 1 <= counts[src]
+        ok_before = (counts[src] >= lower) & (counts[dest] <= upper)
+        return jnp.where(ok_before, strict, relaxed)
+
+    def stats_not_worse(self, before, after) -> bool:
+        return (float(after.leader_count_std)
+                <= float(before.leader_count_std) + 1e-6)
+
+
+class TopicReplicaDistributionGoal(Goal):
+    """Even per-topic replica counts
+    (reference TopicReplicaDistributionGoal.java:55-591)."""
+
+    name = "TopicReplicaDistributionGoal"
+
+    def __init__(self, max_rounds: int = 64, balance_pct_margin: float = 1.8):
+        # default topic balance pct is 3.0 → (3-1)*0.9 = 1.8
+        self.max_rounds = max_rounds
+        self.pct_margin = balance_pct_margin
+
+    def _bounds(self, state: ClusterState, topic_counts: jax.Array):
+        alive = state.broker_alive
+        totals = jnp.sum(topic_counts * alive[:, None], axis=0)   # [T]
+        avg = totals / jnp.maximum(jnp.sum(alive), 1)
+        upper = jnp.ceil(jnp.maximum(avg * (1 + self.pct_margin), avg + 1))
+        lower = jnp.floor(jnp.maximum(
+            jnp.minimum(avg * (1 - self.pct_margin), avg - 1), 0.0))
+        return lower, upper                                        # [T], [T]
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+
+        def round_body(st: ClusterState):
+            cache = make_round_cache(st)
+            tc = cache.broker_topic_count.astype(jnp.float32)          # [B,T]
+            lower, upper = self._bounds(st, tc)
+            topic_of_r = st.partition_topic[st.replica_partition]
+            # per-replica excess of its (broker, topic) cell
+            excess_r = tc[st.replica_broker, topic_of_r] - upper[topic_of_r]
+            # feasible-destination guard: a mover whose topic is at its upper
+            # bound on every eligible destination would win its broker's
+            # candidacy forever and starve other over-limit topics
+            dest_ok_b = ctx.broker_dest_ok & st.broker_alive
+            topic_has_dest = jnp.any(
+                dest_ok_b[:, None] & (tc + 1 <= upper[None, :]), axis=0)  # [T]
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & (excess_r > 0) & topic_has_dest[topic_of_r])
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+
+            def accept_all(r, d):
+                t = st.partition_topic[st.replica_partition[r]]
+                fits = tc[d, t] + 1 <= upper[t]
+                return fits & accept(r, d)
+
+            w = jnp.ones(st.num_replicas, dtype=jnp.float32)
+            counts = cache.replica_count.astype(jnp.float32)
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, jnp.zeros(st.num_brokers, bool),
+                jnp.zeros(st.num_brokers), st.replica_valid,
+                ctx.broker_dest_ok & st.broker_alive,
+                jnp.full(st.num_brokers, jnp.inf), accept_all, -counts,
+                ctx.partition_replicas, forced=movable)
+            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            return st, jnp.any(cand_v)
+
+        def cond(carry):
+            st, rounds, progressed = carry
+            return progressed & (rounds < self.max_rounds)
+
+        def body(carry):
+            st, rounds, _ = carry
+            st, committed = round_body(st)
+            return st, rounds + 1, committed
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int32),
+                         jnp.ones((), dtype=bool)))
+        return state
+
+    def accept_move(self, state, ctx, cache, replica, dest_broker):
+        tc = cache.broker_topic_count.astype(jnp.float32)
+        lower, upper = self._bounds(state, tc)
+        t = state.partition_topic[state.replica_partition[replica]]
+        src = state.replica_broker[replica]
+        strict = tc[dest_broker, t] + 1 <= upper[t]
+        relaxed = tc[dest_broker, t] + 1 <= tc[src, t]
+        ok_before = tc[dest_broker, t] <= upper[t]
+        return jnp.where(ok_before, strict, relaxed)
+
+    def violated_brokers(self, state, ctx, cache):
+        tc = cache.broker_topic_count.astype(jnp.float32)
+        lower, upper = self._bounds(state, tc)
+        over = jnp.any(tc > upper[None, :], axis=1)
+        return state.broker_alive & over
+
+    def stats_not_worse(self, before, after) -> bool:
+        return (float(after.topic_replica_count_std)
+                <= float(before.topic_replica_count_std) + 0.3)

@@ -1,0 +1,220 @@
+"""Network-shaped soft goals: potential outbound capacity and leader
+bytes-in distribution.
+
+TPU-native equivalents of the reference's PotentialNwOutGoal
+(reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/
+analyzer/goals/PotentialNwOutGoal.java:42-372 — cap each broker's *potential*
+outbound rate: the NW_OUT it would serve if it became leader of every
+replica it hosts) and LeaderBytesInDistributionGoal
+(LeaderBytesInDistributionGoal.java:43-286 — balance the leader-side
+bytes-in rate, which dominates produce-path CPU).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import kernels
+from cruise_control_tpu.analyzer.context import (OptimizationContext,
+                                                 make_round_cache)
+from cruise_control_tpu.analyzer.goals.base import (
+    Goal, compose_leadership_acceptance, compose_move_acceptance)
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.state import ClusterState
+
+
+class PotentialNwOutGoal(Goal):
+    name = "PotentialNwOutGoal"
+
+    def __init__(self, max_rounds: int = 64):
+        self.max_rounds = max_rounds
+
+    def _limit(self, state: ClusterState, ctx: OptimizationContext):
+        res = int(Resource.NW_OUT)
+        return state.broker_capacity[:, res] * ctx.capacity_threshold[res]
+
+    @staticmethod
+    def _leader_role_nw_out(state: ClusterState) -> jax.Array:
+        return (S.replica_leader_role_load(state)[:, Resource.NW_OUT]
+                * state.replica_valid)
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+
+        def round_body(st: ClusterState):
+            cache = make_round_cache(st)
+            pot = cache.potential_nw_out
+            limit = self._limit(st, ctx)
+            w = self._leader_role_nw_out(st)
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & (w > 0.0))
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+
+            def accept_all(r, d):
+                return (pot[d] + w[r] <= limit[d]) & accept(r, d)
+
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, pot > limit, pot - limit, movable,
+                ctx.broker_dest_ok & st.broker_alive, limit - pot,
+                accept_all, -pot / jnp.maximum(limit, 1e-9),
+                ctx.partition_replicas)
+            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            return st, jnp.any(cand_v)
+
+        def cond(carry):
+            st, rounds, progressed = carry
+            pot = S.potential_leadership_load(st)
+            return (progressed & (rounds < self.max_rounds)
+                    & jnp.any((pot > self._limit(st, ctx)) & st.broker_alive))
+
+        def body(carry):
+            st, rounds, _ = carry
+            st, committed = round_body(st)
+            return st, rounds + 1, committed
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int32),
+                         jnp.ones((), dtype=bool)))
+        return state
+
+    def accept_move(self, state, ctx, cache, replica, dest_broker):
+        """Keep destinations under the potential-NW_OUT cap unless they are
+        already over it and the move shrinks nothing (reference
+        PotentialNwOutGoal.actionAcceptance)."""
+        w = self._leader_role_nw_out(state)[replica]
+        limit = self._limit(state, ctx)
+        pot = cache.potential_nw_out
+        under_after = pot[dest_broker] + w <= limit[dest_broker]
+        # a destination already violating only accepts load-free replicas
+        return under_after | (w <= 0.0)
+
+    def violated_brokers(self, state, ctx, cache):
+        return state.broker_alive & (
+            cache.potential_nw_out > self._limit(state, ctx))
+
+    def stats_not_worse(self, before, after) -> bool:
+        return (float(after.potential_nw_out_max)
+                <= float(before.potential_nw_out_max) * 1.0001 + 1e-3)
+
+
+class LeaderBytesInDistributionGoal(Goal):
+    """Balance per-broker leader bytes-in via leadership transfers
+    (reference LeaderBytesInDistributionGoal.java:43)."""
+
+    name = "LeaderBytesInDistributionGoal"
+
+    def __init__(self, max_rounds: int = 64, balance_pct_margin: float = 0.09):
+        self.max_rounds = max_rounds
+        self.pct_margin = balance_pct_margin
+
+    @staticmethod
+    def _leader_nw_in(state: ClusterState) -> jax.Array:
+        """f32[R] — NW_IN carried only by leaders (produce traffic)."""
+        return (state.replica_base_load[:, Resource.NW_IN]
+                * (state.replica_valid & state.replica_is_leader))
+
+    def _broker_leader_bytes_in(self, state: ClusterState) -> jax.Array:
+        return jax.ops.segment_sum(self._leader_nw_in(state),
+                                   state.replica_broker,
+                                   num_segments=state.num_brokers)
+
+    def _bounds(self, state: ClusterState, lbi: jax.Array):
+        alive = state.broker_alive
+        avg = jnp.sum(lbi * alive) / jnp.maximum(jnp.sum(alive), 1)
+        return avg * (1 + self.pct_margin)
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+
+        def round_body(st: ClusterState):
+            cache = make_round_cache(st)
+            lbi = self._broker_leader_bytes_in(st)
+            upper = self._bounds(st, lbi)
+            bonus = self._leader_nw_in(st)
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline)
+            accept = compose_leadership_acceptance(prev_goals, st, ctx, cache)
+
+            def accept_all(src_r, dst_r):
+                db = st.replica_broker[dst_r]
+                b = jnp.broadcast_to(bonus[src_r], jnp.broadcast_shapes(
+                    src_r.shape, dst_r.shape))
+                return (lbi[db] + b <= upper) & accept(src_r, dst_r)
+
+            cand_r, cand_f, cand_v = kernels.leadership_round(
+                st, bonus, lbi - upper, movable, ctx.broker_leader_ok,
+                upper - lbi, accept_all, -lbi, ctx.partition_replicas)
+            st = kernels.commit_leadership(st, cand_r, cand_f, cand_v)
+            return st, jnp.any(cand_v)
+
+        def cond(carry):
+            st, rounds, progressed = carry
+            return progressed & (rounds < self.max_rounds)
+
+        def body(carry):
+            st, rounds, _ = carry
+            st, committed = round_body(st)
+            return st, rounds + 1, committed
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int32),
+                         jnp.ones((), dtype=bool)))
+        return state
+
+    def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
+        lbi = self._broker_leader_bytes_in(state)
+        upper = self._bounds(state, lbi)
+        dest = state.replica_broker[dest_replica]
+        src = state.replica_broker[src_replica]
+        bonus = jnp.broadcast_to(
+            self._leader_nw_in(state)[src_replica],
+            jnp.broadcast_shapes(src_replica.shape, dest_replica.shape))
+        strict = lbi[dest] + bonus <= upper
+        relaxed = lbi[dest] + bonus <= lbi[src]
+        return jnp.where(lbi[dest] <= upper, strict, relaxed)
+
+    def violated_brokers(self, state, ctx, cache):
+        lbi = self._broker_leader_bytes_in(state)
+        return state.broker_alive & (lbi > self._bounds(state, lbi))
+
+
+class PreferredLeaderElectionGoal(Goal):
+    """Make the first replica in each partition's original order the leader
+    (reference PreferredLeaderElectionGoal.java:34-201, used by the
+    demote-broker flow).  One batched pass — no search loop needed."""
+
+    name = "PreferredLeaderElectionGoal"
+
+    def __init__(self, max_rounds: int = 1):
+        self.max_rounds = max_rounds
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+        preferred = ctx.partition_replicas[:, 0]            # i32[P]
+        cur_leader = S.partition_leader_replica(state)      # i32[P]
+        pref_safe = jnp.maximum(preferred, 0)
+        pref_broker = state.replica_broker[pref_safe]
+        eligible = ((preferred >= 0) & (cur_leader >= 0)
+                    & (preferred != cur_leader)
+                    & state.broker_alive[pref_broker]
+                    & ctx.broker_leader_ok[pref_broker]
+                    & ~state.replica_offline[pref_safe]
+                    & ~state.broker_demoted[pref_broker])
+        return S.apply_leadership_transfers(
+            state, jnp.maximum(cur_leader, 0), pref_safe, eligible)
+
+    def violated_brokers(self, state, ctx, cache):
+        preferred = ctx.partition_replicas[:, 0]
+        cur_leader = S.partition_leader_replica(state)
+        pref_safe = jnp.maximum(preferred, 0)
+        bad = ((preferred >= 0) & (cur_leader >= 0)
+               & (preferred != cur_leader)
+               & state.broker_alive[state.replica_broker[pref_safe]])
+        broker_of_leader = state.replica_broker[jnp.maximum(cur_leader, 0)]
+        return jax.ops.segment_sum(
+            bad.astype(jnp.int32), broker_of_leader,
+            num_segments=state.num_brokers) > 0

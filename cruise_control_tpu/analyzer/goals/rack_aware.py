@@ -1,0 +1,137 @@
+"""Rack awareness goal (hard).
+
+TPU-native equivalent of the reference's RackAwareGoal
+(reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/
+analyzer/goals/RackAwareGoal.java:43-351): at most one replica of each
+partition per rack.
+
+The constraint surface is the `partition_rack_count[P, K]` tensor
+(model/state.partition_rack_count); a replica is *rack-redundant* when its
+(partition, rack) cell exceeds 1.  Each round moves at most one redundant
+replica per partition (and one per source broker) to a rack with no replica
+of that partition, so a committed batch can never re-create a violation.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import kernels
+from cruise_control_tpu.analyzer.context import (OptimizationContext,
+                                                 make_round_cache)
+from cruise_control_tpu.analyzer.goals.base import (Goal,
+                                                    compose_move_acceptance)
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.state import ClusterState
+
+
+class RackAwareGoal(Goal):
+    is_hard = True
+    name = "RackAwareGoal"
+
+    def __init__(self, max_rounds: int = 128):
+        self.max_rounds = max_rounds
+
+    @staticmethod
+    def _redundant_mask(state: ClusterState, prc: jax.Array) -> jax.Array:
+        """bool[R] — replicas in a rack that holds >1 replica of their
+        partition.  Only the "extra" ones need to move; choosing which is
+        the extra is done per-round via the single-mover-per-partition
+        filter."""
+        rack = state.broker_rack[state.replica_broker]
+        return (state.replica_valid
+                & (prc[state.replica_partition, rack] > 1))
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+
+        def round_body(st: ClusterState):
+            cache = make_round_cache(st)
+            prc = cache.partition_rack_count
+            redundant = self._redundant_mask(st, prc)
+            # prefer moving followers; a leader only moves if it is the sole
+            # way to fix the rack (all duplicates are leaders is impossible —
+            # one leader per partition)
+            movable = (redundant & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & ~st.replica_is_leader)
+            # a mover is only a candidate if some rack with an eligible
+            # destination broker holds no replica of its partition —
+            # otherwise it would win its broker's candidacy forever and
+            # starve feasible movers behind it
+            dest_ok_b = ctx.broker_dest_ok & st.broker_alive
+            rack_has_dest = jax.ops.segment_sum(
+                dest_ok_b.astype(jnp.int32), st.broker_rack,
+                num_segments=st.num_racks) > 0                  # bool[K]
+            empty_rack = (prc == 0) & rack_has_dest[None, :]    # [P, K]
+            movable &= jnp.any(empty_rack, axis=1)[st.replica_partition]
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            rack_of_b = st.broker_rack
+
+            def accept_all(r, d):
+                # destination rack must hold no replica of the partition
+                p = st.replica_partition[r]
+                cnt = prc[p, rack_of_b[d]]
+                return (cnt == 0) & accept(r, d)
+
+            w = cache.replica_load[:, Resource.DISK]
+            util = cache.broker_util[:, Resource.DISK]
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, jnp.zeros(st.num_brokers, bool),
+                jnp.zeros(st.num_brokers), st.replica_valid, dest_ok_b,
+                jnp.full(st.num_brokers, jnp.inf), accept_all, -util,
+                ctx.partition_replicas, forced=movable)
+            # (one-mover-per-partition dedup now happens inside move_round)
+            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            return st, jnp.any(cand_v)
+
+        def cond(carry):
+            st, rounds, progressed = carry
+            prc = S.partition_rack_count(st)
+            return (progressed & (rounds < self.max_rounds)
+                    & jnp.any(self._redundant_mask(st, prc)))
+
+        def body(carry):
+            st, rounds, _ = carry
+            st, committed = round_body(st)
+            return st, rounds + 1, committed
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int32),
+                         jnp.ones((), dtype=bool)))
+        return state
+
+    def accept_move(self, state, ctx, cache, replica, dest_broker):
+        """A move may not place a second replica of the partition in the
+        destination rack (reference RackAwareGoal.actionAcceptance).  The
+        mover's own contribution is subtracted when it stays in-rack."""
+        p = state.replica_partition[replica]
+        src_rack = state.broker_rack[state.replica_broker[replica]]
+        dst_rack = state.broker_rack[dest_broker]
+        cnt = cache.partition_rack_count[p, dst_rack]
+        cnt = cnt - (src_rack == dst_rack)
+        return cnt == 0
+
+    def violated_brokers(self, state, ctx, cache):
+        rack = state.broker_rack[state.replica_broker]
+        redundant = (state.replica_valid
+                     & (cache.partition_rack_count[
+                         state.replica_partition, rack] > 1))
+        # segment_sum (not segment_max: empty segments yield INT_MIN which
+        # casts to True)
+        return (jax.ops.segment_sum(
+            redundant.astype(jnp.int32), state.replica_broker,
+            num_segments=state.num_brokers) > 0) & state.broker_alive
+
+    def is_satisfiable(self, state: ClusterState) -> bool:
+        """Host-side check: rack awareness is unsatisfiable when some
+        partition has more replicas than there are racks with alive brokers
+        (reference throws OptimizationFailureException in initGoalState)."""
+        import numpy as np
+        alive_racks = np.unique(np.asarray(state.broker_rack)[
+            np.asarray(state.broker_alive)])
+        rf = np.asarray(S.partition_replication_factor(state))
+        return bool((rf <= len(alive_racks)).all())

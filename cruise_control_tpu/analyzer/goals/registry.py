@@ -1,0 +1,97 @@
+"""Goal registry and default priority order.
+
+Mirrors the reference's pluggable goal wiring: goals are looked up by name
+and instantiated from config (reference: KafkaCruiseControlUtils goal
+instantiation + config/constants/AnalyzerConfig.java DEFAULT_GOALS_CONFIG —
+the default list order below matches the reference's `default.goals`).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+from cruise_control_tpu.analyzer.goals.base import Goal
+from cruise_control_tpu.analyzer.goals.capacity import (
+    CpuCapacityGoal, DiskCapacityGoal, NetworkInboundCapacityGoal,
+    NetworkOutboundCapacityGoal, ReplicaCapacityGoal)
+from cruise_control_tpu.analyzer.goals.count_distribution import (
+    LeaderReplicaDistributionGoal, ReplicaDistributionGoal,
+    TopicReplicaDistributionGoal)
+from cruise_control_tpu.analyzer.goals.network import (
+    LeaderBytesInDistributionGoal, PotentialNwOutGoal,
+    PreferredLeaderElectionGoal)
+from cruise_control_tpu.analyzer.goals.rack_aware import RackAwareGoal
+from cruise_control_tpu.analyzer.goals.resource_distribution import (
+    CpuUsageDistributionGoal, DiskUsageDistributionGoal,
+    NetworkInboundUsageDistributionGoal,
+    NetworkOutboundUsageDistributionGoal)
+
+GOAL_CLASSES: Dict[str, Type[Goal]] = {
+    "RackAwareGoal": RackAwareGoal,
+    "ReplicaCapacityGoal": ReplicaCapacityGoal,
+    "DiskCapacityGoal": DiskCapacityGoal,
+    "NetworkInboundCapacityGoal": NetworkInboundCapacityGoal,
+    "NetworkOutboundCapacityGoal": NetworkOutboundCapacityGoal,
+    "CpuCapacityGoal": CpuCapacityGoal,
+    "ReplicaDistributionGoal": ReplicaDistributionGoal,
+    "PotentialNwOutGoal": PotentialNwOutGoal,
+    "DiskUsageDistributionGoal": DiskUsageDistributionGoal,
+    "NetworkInboundUsageDistributionGoal": NetworkInboundUsageDistributionGoal,
+    "NetworkOutboundUsageDistributionGoal":
+        NetworkOutboundUsageDistributionGoal,
+    "CpuUsageDistributionGoal": CpuUsageDistributionGoal,
+    "TopicReplicaDistributionGoal": TopicReplicaDistributionGoal,
+    "LeaderReplicaDistributionGoal": LeaderReplicaDistributionGoal,
+    "LeaderBytesInDistributionGoal": LeaderBytesInDistributionGoal,
+    "PreferredLeaderElectionGoal": PreferredLeaderElectionGoal,
+}
+
+
+#: Priority order of the reference's `default.goals`
+#: (config/constants/AnalyzerConfig.java).
+DEFAULT_GOAL_ORDER: List[str] = [
+    "RackAwareGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+]
+
+#: Subset used as hard requirements (reference `hard.goals` default).
+DEFAULT_HARD_GOALS: List[str] = [
+    "RackAwareGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+]
+
+
+def make_goal(name: str, **kwargs) -> Goal:
+    if name not in GOAL_CLASSES:
+        raise KeyError(f"unknown goal {name!r}; known: "
+                       f"{sorted(GOAL_CLASSES)}")
+    return GOAL_CLASSES[name](**kwargs)
+
+
+def default_goals(max_rounds: Optional[int] = None,
+                  names: Optional[Sequence[str]] = None) -> List[Goal]:
+    """Instantiate the default goal stack in priority order
+    (reference getGoalsByPriority, AnalyzerUtils.java:165)."""
+    out = []
+    for name in (names or DEFAULT_GOAL_ORDER):
+        kwargs = {}
+        if max_rounds is not None:
+            kwargs["max_rounds"] = max_rounds
+        out.append(make_goal(name, **kwargs))
+    return out

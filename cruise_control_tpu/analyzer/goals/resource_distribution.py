@@ -26,8 +26,10 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  RoundCache,
                                                  make_round_cache)
 from cruise_control_tpu.analyzer.goals.base import (
-    Goal, compose_leadership_acceptance, compose_move_acceptance)
-from cruise_control_tpu.common.resources import Resource
+    Goal, compose_leadership_acceptance, compose_move_acceptance,
+    new_broker_dest_mask)
+from cruise_control_tpu.common.resources import (RESOURCE_GOAL_NAMES,
+                                                 Resource)
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
 
@@ -40,8 +42,8 @@ class ResourceDistributionGoal(Goal):
 
     def __init__(self, max_rounds: int = 64):
         self.max_rounds = max_rounds
-        self.name = f"{self.resource.name.title().replace('_', '')}" \
-                    f"UsageDistributionGoal"
+        self.name = (RESOURCE_GOAL_NAMES[int(self.resource)]
+                     + "UsageDistributionGoal")
 
     # -- bounds ------------------------------------------------------------
     def _bounds(self, state: ClusterState, ctx: OptimizationContext):
@@ -56,6 +58,10 @@ class ResourceDistributionGoal(Goal):
         # only NW_OUT and CPU travel with leadership (reference
         # ResourceDistributionGoal#rebalanceByMovingLoadOut leadership path)
         return self.resource in (Resource.NW_OUT, Resource.CPU)
+
+    @staticmethod
+    def _dest_mask(st: ClusterState, ctx: OptimizationContext) -> jax.Array:
+        return new_broker_dest_mask(st, ctx.broker_dest_ok & st.broker_alive)
 
     # -- optimization ------------------------------------------------------
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
@@ -106,7 +112,7 @@ class ResourceDistributionGoal(Goal):
             dest_pref = -W / jnp.maximum(st.broker_capacity[:, res], 1e-9)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, W > upper, W - upper, movable,
-                ctx.broker_dest_ok & st.broker_alive, upper - W, accept,
+                self._dest_mask(st, ctx), upper - W, accept,
                 dest_pref, ctx.partition_replicas)
             st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
             committed |= jnp.any(cand_v)
@@ -117,12 +123,12 @@ class ResourceDistributionGoal(Goal):
             W = cache.broker_load[:, res]
             w = cache.replica_load[:, res]
             avg_w = (ctx.balance_upper_pct[res] + ctx.balance_lower_pct[res]) \
-                / 2.0 * state.broker_capacity[:, res]
+                / 2.0 * st.broker_capacity[:, res]
             movable = (st.replica_valid & ~ctx.replica_excluded
                        & ctx.replica_movable & ~st.replica_offline
                        & (w > 0.0))
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
-            under = (W < lower) & st.broker_alive & ctx.broker_dest_ok
+            under = (W < lower) & self._dest_mask(st, ctx)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, W > avg_w, W - lower, movable, under, upper - W,
                 accept, -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),

@@ -149,15 +149,52 @@ def move_round(state: ClusterState,
                                  jnp.arange(num_b, dtype=jnp.int32)[None, :])
 
     pref = jnp.where(feasible, dest_pref[None, :], NEG)
-    cand_dest = jnp.argmax(pref, axis=1).astype(jnp.int32)
-    cand_valid = cand_has & (jnp.max(pref, axis=1) > NEG / 2)
-
-    # one winner per destination (forced/self-heal moves take precedence)
     gain = cand_w
     if forced is not None:
         gain = gain + jnp.where(forced[cand_r_safe], 1e12, 0.0)
-    cand_valid = resolve_dest_conflicts(cand_dest, gain, cand_valid, num_b)
+    cand_dest, cand_valid = assign_destinations(pref, gain, cand_has, num_b)
+    # at most one replica of a partition moves per round: acceptance checks
+    # evaluate each action in isolation, so two siblings committing together
+    # could land in one rack (or overfill one bound) and re-violate a
+    # previously-optimized goal
+    part_of_cand = state.replica_partition[cand_r_safe]
+    cand_valid = resolve_dest_conflicts(part_of_cand, gain, cand_valid,
+                                        state.num_partitions)
     return cand_r, cand_dest, cand_valid
+
+
+ASSIGN_PASSES = 8
+
+
+def assign_destinations(pref: jax.Array, gain: jax.Array, cand_has: jax.Array,
+                        num_b: int) -> Tuple[jax.Array, jax.Array]:
+    """Assign each candidate a distinct destination broker.
+
+    A single argmax-then-dedup pass throttles a round to ~1 move when all
+    candidates prefer the same least-loaded destination (the sequential
+    reference never hits this: each broker claims its destination before the
+    next looks).  This runs ASSIGN_PASSES unrolled mini-passes: every pass
+    lets unassigned candidates claim their best *unclaimed* destination and
+    resolves ties by `gain`, approximating the reference's greedy order
+    while keeping the whole round one fused device computation.
+
+    Returns (dest i32[C], valid bool[C]).
+    """
+    C = pref.shape[0]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    taken = jnp.zeros(num_b, dtype=bool)
+    assigned = jnp.zeros(C, dtype=bool)
+    dest = jnp.zeros(C, dtype=jnp.int32)
+    for _ in range(ASSIGN_PASSES):
+        open_pref = jnp.where(taken[None, :], NEG, pref)
+        open_pref = jnp.where(assigned[:, None], NEG, open_pref)
+        best = jnp.argmax(open_pref, axis=1).astype(jnp.int32)
+        has = cand_has & (jnp.max(open_pref, axis=1) > NEG / 2)
+        keep = resolve_dest_conflicts(best, gain, has, num_b)
+        dest = jnp.where(keep, best, dest)
+        assigned = assigned | keep
+        taken = taken.at[jnp.where(keep, best, num_b)].set(True, mode="drop")
+    return dest, assigned
 
 
 def leadership_round(state: ClusterState,
@@ -205,23 +242,36 @@ def leadership_round(state: ClusterState,
                           sib_safe)
 
     pref = jnp.where(feasible, dest_pref[sib_broker], NEG)
-    best_f = jnp.argmax(pref, axis=1)                          # [R]
-    best_pref = jnp.max(pref, axis=1)
-    r_has = best_pref > NEG / 2
+    r_has = jnp.max(pref, axis=1) > NEG / 2
 
     # per-source-broker argmax over its leader replicas: shed the largest
     # transferable bonus first
     score = jnp.where(r_has, shed_score(bonus_w, src_excess[rb]), NEG)
     cand_r, _, cand_has = per_segment_argmax(score, rb, num_b, r_has)
     cand_r_safe = jnp.maximum(cand_r, 0)
-    cand_dest_replica = sib_safe[cand_r_safe, best_f[cand_r_safe]]
-    cand_dest_broker = rb[cand_dest_replica]
 
-    cand_valid = cand_has
-    cand_valid = resolve_dest_conflicts(cand_dest_broker,
-                                        bonus_w[cand_r_safe], cand_valid,
-                                        num_b)
-    return cand_r, cand_dest_replica.astype(jnp.int32), cand_valid
+    # multi-pass follower assignment (see assign_destinations): candidates
+    # claim distinct destination brokers across their follower options
+    pref_c = pref[cand_r_safe]                                 # [C, RF]
+    sib_broker_c = sib_broker[cand_r_safe]                     # [C, RF]
+    sib_c = sib_safe[cand_r_safe]
+    gain = bonus_w[cand_r_safe]
+    C = cand_r_safe.shape[0]
+    taken = jnp.zeros(num_b, dtype=bool)
+    assigned = jnp.zeros(C, dtype=bool)
+    dest_replica = jnp.zeros(C, dtype=jnp.int32)
+    for _ in range(ASSIGN_PASSES):
+        open_pref = jnp.where(taken[sib_broker_c], NEG, pref_c)
+        open_pref = jnp.where(assigned[:, None], NEG, open_pref)
+        slot = jnp.argmax(open_pref, axis=1)
+        has = cand_has & (jnp.max(open_pref, axis=1) > NEG / 2)
+        db = sib_broker_c[jnp.arange(C), slot]
+        keep = resolve_dest_conflicts(db, gain, has, num_b)
+        dest_replica = jnp.where(keep, sib_c[jnp.arange(C), slot],
+                                 dest_replica)
+        assigned = assigned | keep
+        taken = taken.at[jnp.where(keep, db, num_b)].set(True, mode="drop")
+    return cand_r, dest_replica.astype(jnp.int32), assigned
 
 
 def commit_moves(state: ClusterState, cand_r: jax.Array, cand_dest: jax.Array,

@@ -72,17 +72,26 @@ class OptimizerResult:
     def data_to_move(self) -> float:
         return sum(p.inter_broker_data_to_move for p in self.proposals)
 
+    #: goal names considered hard for the balancedness weighting
+    hard_goal_names: frozenset = frozenset()
+
     def balancedness_score(self) -> float:
         """[0, 100] gauge (reference AnomalyDetector.java:176-178 /
         GoalOptimizer balancedness weights): fraction of goals without
-        violations, weighted double for hard goals."""
-        if not self.violated_goals_before and not self.violated_goals_after:
+        violations after optimization, hard goals weighted double."""
+        goal_names = list(self.stats_by_goal) or sorted(
+            set(self.violated_goals_before) | set(self.violated_goals_after))
+        if not goal_names:
             return 100.0
-        total = len(set(self.violated_goals_before)
-                    | set(self.violated_goals_after)) or 1
-        fixed = len(set(self.violated_goals_before)
-                    - set(self.violated_goals_after))
-        return 100.0 * fixed / total
+        violated = set(self.violated_goals_after)
+        total = 0.0
+        clean = 0.0
+        for name in goal_names:
+            weight = 2.0 if name in self.hard_goal_names else 1.0
+            total += weight
+            if name not in violated:
+                clean += weight
+        return 100.0 * clean / total
 
 
 def heal_offline_replicas(state: ClusterState, ctx: OptimizationContext,
@@ -168,8 +177,10 @@ class GoalOptimizer:
         prev_stats = stats_before
         for i, goal in enumerate(self.goals):
             prev_goals = tuple(self.goals[:i])
+            # key by position too: duplicate goal instances must not share a
+            # compiled closure (each closes over its own prev_goals/config)
             fn = self._get_compiled(
-                goal.name,
+                f"{i}:{goal.name}",
                 lambda s, c, g=goal, pg=prev_goals: g.optimize(s, c, pg))
             t0 = time.time()
             state = fn(state, ctx)
@@ -200,7 +211,7 @@ class GoalOptimizer:
         partition_rows = np.asarray(ctx.partition_replicas)
         proposals = diff_proposals(initial, state, topology, partition_rows)
         stats_after = jax.device_get(compute_stats(state))
-        return OptimizerResult(
+        result = OptimizerResult(
             proposals=proposals,
             stats_before=stats_before,
             stats_after=stats_after,
@@ -211,6 +222,9 @@ class GoalOptimizer:
             final_state=state,
             duration_s=time.time() - t_start,
         )
+        result.hard_goal_names = frozenset(
+            g.name for g in self.goals if g.is_hard)
+        return result
 
     def _get_compiled(self, key: str, fn):
         if not self._jit_goals:

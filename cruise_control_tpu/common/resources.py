@@ -69,3 +69,9 @@ BASE_EPSILON_ARRAY = np.asarray(_BASE_EPSILON, dtype=np.float32)
 #: Resources for which expected utilization is the *average* over windows;
 #: DISK uses the *latest* window (reference model/Load.java:25-120).
 AVG_RESOURCES = (Resource.CPU, Resource.NW_IN, Resource.NW_OUT)
+
+#: Goal-name prefixes per resource id, matching the reference's goal class
+#: names (CpuCapacityGoal, NetworkInboundUsageDistributionGoal, ...).
+RESOURCE_GOAL_NAMES = {
+    0: "Cpu", 1: "NetworkInbound", 2: "NetworkOutbound", 3: "Disk",
+}

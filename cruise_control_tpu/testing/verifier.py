@@ -63,17 +63,12 @@ def verify_result(initial: ClusterState, result: OptimizerResult,
 
 def _verify_proposals_consistent(initial: ClusterState,
                                  result: OptimizerResult) -> None:
-    init_broker = np.asarray(initial.replica_broker).copy()
+    """Each proposal's new replica set must match the final state's broker
+    set for that partition (AnalyzerUtils.getDiff output contract)."""
     final_broker = np.asarray(result.final_state.replica_broker)
     valid = np.asarray(initial.replica_valid)
-    # replay: proposals are per partition; check that for each changed
-    # partition the new broker set matches the final state
     part = np.asarray(initial.replica_partition)
     for proposal in result.proposals:
-        # topology maps proposals back to broker ids — compare sets
-        p_idx = None
-        # partitions list order == partition index
-        # (ClusterTopology.partitions is index-ordered)
         p_idx = result_partition_index(result, proposal)
         rows = valid & (part == p_idx)
         final_set = set(final_broker[rows].tolist())
