@@ -1,0 +1,83 @@
+"""Benchmark: full multi-goal rebalance proposal generation.
+
+North-star config (BASELINE.json): 2,600 brokers / 200K partitions, full
+default goal stack, target < 5 s wall-clock on TPU — ≥30× the reference's
+CPU GoalOptimizer.  Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+`vs_baseline` is target_seconds / measured_seconds (>1 beats the 5 s
+north-star target).
+
+Env knobs: BENCH_BROKERS, BENCH_PARTITIONS, BENCH_RF, BENCH_ROUNDS,
+BENCH_GOALS (comma list), BENCH_SKIP_WARMUP.
+"""
+import json
+import os
+import sys
+import time
+
+TARGET_SECONDS = 5.0
+
+
+def main() -> None:
+    t_import = time.time()
+    import jax
+    import numpy as np
+
+    from cruise_control_tpu.analyzer.context import OptimizationOptions
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
+                                                           random_cluster)
+
+    num_b = int(os.environ.get("BENCH_BROKERS", 2600))
+    num_p = int(os.environ.get("BENCH_PARTITIONS", 200_000))
+    rf = int(os.environ.get("BENCH_RF", 3))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 128))
+    goal_names = os.environ.get("BENCH_GOALS")
+    names = goal_names.split(",") if goal_names else None
+
+    backend = jax.devices()[0].platform
+    print(f"# backend={backend} devices={jax.devices()} "
+          f"(import+init {time.time()-t_import:.1f}s)", file=sys.stderr)
+
+    t0 = time.time()
+    state, topo = random_cluster(RandomClusterSpec(
+        num_brokers=num_b, num_partitions=num_p, replication_factor=rf,
+        num_racks=max(8, num_b // 100), num_topics=max(8, num_p // 2000),
+        seed=4, skew_fraction=0.2))
+    print(f"# model built: B={num_b} P={num_p} R={num_p*rf} "
+          f"({time.time()-t0:.1f}s)", file=sys.stderr)
+
+    goals = default_goals(max_rounds=rounds, names=names)
+    optimizer = GoalOptimizer(goals)
+
+    # warm-up run compiles every goal kernel for these shapes; the measured
+    # run reuses the compile cache (the JVM reference likewise amortizes
+    # JIT warmup outside its proposal-computation timer)
+    if not os.environ.get("BENCH_SKIP_WARMUP"):
+        t0 = time.time()
+        optimizer.optimizations(state, topo, OptimizationOptions(),
+                                check_sanity=False)
+        print(f"# warmup (compile) {time.time()-t0:.1f}s", file=sys.stderr)
+
+    t0 = time.time()
+    result = optimizer.optimizations(state, topo, OptimizationOptions(),
+                                     check_sanity=False)
+    elapsed = time.time() - t0
+
+    print(f"# proposals={len(result.proposals)} "
+          f"replica_moves={result.num_replica_movements} "
+          f"violated_after={len(result.violated_goals_after)} "
+          f"balancedness={result.balancedness_score():.1f}",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": (f"full-stack proposal generation "
+                   f"{num_b}b/{num_p//1000}Kp rf{rf} [{backend}]"),
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(TARGET_SECONDS / elapsed, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -254,12 +254,9 @@ class TopicReplicaDistributionGoal(Goal):
 
             w = jnp.ones(st.num_replicas, dtype=jnp.float32)
             counts = cache.replica_count.astype(jnp.float32)
-            cand_r, cand_d, cand_v = kernels.move_round(
-                st, w, jnp.zeros(st.num_brokers, bool),
-                jnp.zeros(st.num_brokers), st.replica_valid,
-                ctx.broker_dest_ok & st.broker_alive,
-                jnp.full(st.num_brokers, jnp.inf), accept_all, -counts,
-                ctx.partition_replicas, forced=movable)
+            cand_r, cand_d, cand_v = kernels.forced_move_round(
+                st, movable, w, dest_ok_b, accept_all, -counts,
+                ctx.partition_replicas)
             st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
             return st, jnp.any(cand_v)
 

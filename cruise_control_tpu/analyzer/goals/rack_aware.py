@@ -8,8 +8,9 @@ partition per rack.
 The constraint surface is the `partition_rack_count[P, K]` tensor
 (model/state.partition_rack_count); a replica is *rack-redundant* when its
 (partition, rack) cell exceeds 1.  Each round moves at most one redundant
-replica per partition (and one per source broker) to a rack with no replica
-of that partition, so a committed batch can never re-create a violation.
+replica per partition (enforced inside the move kernels) to a rack with no
+replica of that partition, and destinations are claimed at most once per
+round, so a committed batch can never re-create a violation.
 """
 from __future__ import annotations
 
@@ -79,12 +80,12 @@ class RackAwareGoal(Goal):
 
             w = cache.replica_load[:, Resource.DISK]
             util = cache.broker_util[:, Resource.DISK]
-            cand_r, cand_d, cand_v = kernels.move_round(
-                st, w, jnp.zeros(st.num_brokers, bool),
-                jnp.zeros(st.num_brokers), st.replica_valid, dest_ok_b,
-                jnp.full(st.num_brokers, jnp.inf), accept_all, -util,
-                ctx.partition_replicas, forced=movable)
-            # (one-mover-per-partition dedup now happens inside move_round)
+            # global forced-candidate search: rack violations are mandatory
+            # moves independent of broker load, and their count scales with
+            # partitions — a per-source-broker cap would throttle rounds
+            cand_r, cand_d, cand_v = kernels.forced_move_round(
+                st, movable, w, dest_ok_b, accept_all, -util,
+                ctx.partition_replicas)
             st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
             return st, jnp.any(cand_v)
 

@@ -63,6 +63,34 @@ def resolve_dest_conflicts(dest: jax.Array, gain: jax.Array, valid: jax.Array,
     return keep
 
 
+def _dest_feasibility(state: ClusterState, cand_r: jax.Array,
+                      dest_ok: jax.Array,
+                      accept_matrix_fn: Callable[[jax.Array, jax.Array],
+                                                 jax.Array],
+                      partition_replicas: Optional[jax.Array] = None
+                      ) -> jax.Array:
+    """bool[C, B] structural destination feasibility shared by the move
+    kernels: broker-level eligibility, not-the-current-broker, no second
+    replica of the partition on the destination (reference
+    GoalUtils.legitMove), and the composed acceptance stack."""
+    num_b = state.num_brokers
+    rb = state.replica_broker
+    feasible = jnp.broadcast_to(dest_ok[None, :],
+                                (cand_r.shape[0], num_b)).copy()
+    feasible &= (jnp.arange(num_b)[None, :] != rb[cand_r][:, None])
+    if partition_replicas is not None:
+        siblings = partition_replicas[state.replica_partition[cand_r]]
+        sib_valid = siblings >= 0
+        sib_broker = rb[jnp.maximum(siblings, 0)]
+        dup = jnp.any(sib_valid[:, :, None]
+                      & (sib_broker[:, :, None]
+                         == jnp.arange(num_b)[None, None, :]), axis=1)
+        feasible &= ~dup
+    feasible &= accept_matrix_fn(cand_r[:, None],
+                                 jnp.arange(num_b, dtype=jnp.int32)[None, :])
+    return feasible
+
+
 def shed_score(w: jax.Array, excess_r: jax.Array) -> jax.Array:
     """Score for choosing which replica an overloaded broker sheds.
 
@@ -133,20 +161,9 @@ def move_round(state: ClusterState,
     # --- destination matrix [C, B] ---
     cand_w = w[cand_r_safe]                                    # f32[C]
     fits = (cand_w[:, None] <= dest_headroom[None, :])
-    feasible = fits & dest_ok[None, :] & cand_has[:, None]
-    # not the broker the replica already sits on
-    feasible &= (jnp.arange(num_b)[None, :] != rb[cand_r_safe][:, None])
-    # no second replica of the same partition on the destination
-    # (reference GoalUtils.legitMove)
-    siblings = partition_replicas[state.replica_partition[cand_r_safe]]
-    sib_valid = siblings >= 0                                  # [C, RF]
-    sib_broker = rb[jnp.maximum(siblings, 0)]                  # [C, RF]
-    dup = jnp.any(sib_valid[:, :, None]
-                  & (sib_broker[:, :, None]
-                     == jnp.arange(num_b)[None, None, :]), axis=1)
-    feasible &= ~dup
-    feasible &= accept_matrix_fn(cand_r_safe[:, None],
-                                 jnp.arange(num_b, dtype=jnp.int32)[None, :])
+    feasible = (fits & cand_has[:, None]
+                & _dest_feasibility(state, cand_r_safe, dest_ok,
+                                    accept_matrix_fn, partition_replicas))
 
     pref = jnp.where(feasible, dest_pref[None, :], NEG)
     gain = cand_w
@@ -166,6 +183,18 @@ def move_round(state: ClusterState,
 ASSIGN_PASSES = 8
 
 
+def _pairwise_jitter(num_c: int, num_b: int) -> jax.Array:
+    """f32[C, B] deterministic pseudo-random values in [0, 1) — spreads
+    candidates with identical destination preferences across destinations."""
+    c = jnp.arange(num_c, dtype=jnp.uint32)[:, None]
+    d = jnp.arange(num_b, dtype=jnp.uint32)[None, :]
+    x = c * jnp.uint32(2654435761) + d * jnp.uint32(40503)
+    x ^= x >> 16
+    x *= jnp.uint32(2246822519)
+    x ^= x >> 13
+    return (x & jnp.uint32(0xFFFFFF)).astype(jnp.float32) / float(1 << 24)
+
+
 def assign_destinations(pref: jax.Array, gain: jax.Array, cand_has: jax.Array,
                         num_b: int) -> Tuple[jax.Array, jax.Array]:
     """Assign each candidate a distinct destination broker.
@@ -173,20 +202,34 @@ def assign_destinations(pref: jax.Array, gain: jax.Array, cand_has: jax.Array,
     A single argmax-then-dedup pass throttles a round to ~1 move when all
     candidates prefer the same least-loaded destination (the sequential
     reference never hits this: each broker claims its destination before the
-    next looks).  This runs ASSIGN_PASSES unrolled mini-passes: every pass
-    lets unassigned candidates claim their best *unclaimed* destination and
-    resolves ties by `gain`, approximating the reference's greedy order
-    while keeping the whole round one fused device computation.
+    next looks).  Two measures approximate the sequential greedy order while
+    keeping the round one fused device computation:
+
+    * candidate-dependent jitter (~1/3 of the preference spread) decorrelates
+      destination choices, so a pass assigns many distinct destinations
+      instead of crowning one winner for the globally best broker;
+    * ASSIGN_PASSES unrolled mini-passes let losers claim their next-best
+      *unclaimed* destination.
 
     Returns (dest i32[C], valid bool[C]).
     """
     C = pref.shape[0]
+    finite = pref > NEG / 2
+    pmax = jnp.max(jnp.where(finite, pref, -jnp.inf))
+    pmin = jnp.min(jnp.where(finite, pref, jnp.inf))
+    spread = jnp.where(jnp.isfinite(pmax - pmin), pmax - pmin, 0.0)
+    amp = 0.35 * spread + 1e-6
+    jittered = jnp.where(finite, pref + amp * _pairwise_jitter(C, num_b), NEG)
+
     idx = jnp.arange(C, dtype=jnp.int32)
     taken = jnp.zeros(num_b, dtype=bool)
     assigned = jnp.zeros(C, dtype=bool)
     dest = jnp.zeros(C, dtype=jnp.int32)
-    for _ in range(ASSIGN_PASSES):
-        open_pref = jnp.where(taken[None, :], NEG, pref)
+    for k in range(ASSIGN_PASSES):
+        # pass 0 runs un-jittered so an uncontended candidate still gets its
+        # true best destination; later passes spread the losers
+        pass_pref = pref if k == 0 else jittered
+        open_pref = jnp.where(taken[None, :], NEG, pass_pref)
         open_pref = jnp.where(assigned[:, None], NEG, open_pref)
         best = jnp.argmax(open_pref, axis=1).astype(jnp.int32)
         has = cand_has & (jnp.max(open_pref, axis=1) > NEG / 2)
@@ -272,6 +315,61 @@ def leadership_round(state: ClusterState,
         assigned = assigned | keep
         taken = taken.at[jnp.where(keep, db, num_b)].set(True, mode="drop")
     return cand_r, dest_replica.astype(jnp.int32), assigned
+
+
+def forced_move_round(state: ClusterState,
+                      forced: jax.Array,
+                      w: jax.Array,
+                      dest_ok: jax.Array,
+                      accept_matrix_fn: Callable[[jax.Array, jax.Array],
+                                                 jax.Array],
+                      dest_pref: jax.Array,
+                      partition_replicas: jax.Array,
+                      max_candidates: int = 1024,
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One round of *global* forced-move search (self-healing).
+
+    Unlike `move_round`, candidates are not limited to one per source
+    broker: a dead broker evacuating hundreds of replicas must shed many
+    per round (the reference walks each dead broker's replicas directly).
+    The top `max_candidates` forced replicas (largest load first) each claim
+    a distinct destination via the multi-pass assignment.
+
+    Returns (cand_r i32[K], cand_dest i32[K], cand_valid bool[K]).
+    """
+    num_b = state.num_brokers
+    rb = state.replica_broker
+    max_candidates = min(max_candidates, state.num_replicas)
+
+    score = jnp.where(forced, w + 1.0, -jnp.inf)
+    _, cand_r = jax.lax.top_k(score, max_candidates)
+    cand_r = cand_r.astype(jnp.int32)
+    cand_has = forced[cand_r]
+
+    fits_w = w[cand_r]
+    feasible = (cand_has[:, None]
+                & _dest_feasibility(state, cand_r, dest_ok, accept_matrix_fn,
+                                    partition_replicas))
+
+    pref = jnp.where(feasible, dest_pref[None, :], NEG)
+    cand_dest, cand_valid = assign_destinations(pref, fits_w, cand_has,
+                                                num_b)
+    part_of_cand = state.replica_partition[cand_r]
+    cand_valid = resolve_dest_conflicts(part_of_cand, fits_w, cand_valid,
+                                        state.num_partitions)
+    # Acceptance checks see a per-round snapshot, so a source-side bound
+    # (e.g. counts[src]-1 >= lower) only stays valid if at most one replica
+    # leaves an *alive* broker per round.  Dead/excluded sources carry no
+    # bounds — their evacuation stays uncapped (that throughput is the whole
+    # point of the global candidate set).
+    src = rb[cand_r]
+    alive_src = state.broker_alive[src]
+    seg = jnp.where(alive_src, src, num_b)
+    capped, _, _ = per_segment_argmax(fits_w, seg, num_b + 1,
+                                      cand_valid & alive_src)
+    c_idx = jnp.arange(max_candidates, dtype=jnp.int32)
+    cand_valid &= jnp.where(alive_src, capped[seg] == c_idx, True)
+    return cand_r, cand_dest, cand_valid
 
 
 def commit_moves(state: ClusterState, cand_r: jax.Array, cand_dest: jax.Array,

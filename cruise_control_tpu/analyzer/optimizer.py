@@ -120,11 +120,8 @@ def heal_offline_replicas(state: ClusterState, ctx: OptimizationContext,
         dest_ok = st.broker_alive & ctx.broker_dest_ok
         util = cache.broker_load[:, Resource.DISK] / jnp.maximum(
             st.broker_capacity[:, Resource.DISK], 1e-9)
-        cand_r, cand_d, cand_v = kernels.move_round(
-            st, w, jnp.zeros(st.num_brokers, bool),
-            jnp.zeros(st.num_brokers), st.replica_valid, dest_ok,
-            jnp.full(st.num_brokers, jnp.inf), accept, -util,
-            ctx.partition_replicas, forced=offline)
+        cand_r, cand_d, cand_v = kernels.forced_move_round(
+            st, offline, w, dest_ok, accept, -util, ctx.partition_replicas)
         st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
         return st, rounds + 1, jnp.any(cand_v)
 

@@ -1,0 +1,115 @@
+"""Device-mesh sharding for the cluster model.
+
+The 200K-partition replica axis is the framework's "long sequence": the
+reference handles it with per-broker incremental search and sorted-replica
+caches (SURVEY.md §5.7); here it is a sharded tensor dimension.  Replica-
+major arrays shard across a 1-D `replica` mesh axis; broker/partition-level
+arrays replicate.  Under jit, segment-sum load accounting over the sharded
+replica axis lowers to per-shard partial sums + an all-reduce over ICI —
+XLA inserts the collectives (psum pattern) from the sharding annotations
+alone, which is the whole point of the pjit design: no hand-written
+communication.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cruise_control_tpu.model.state import ClusterState
+
+REPLICA_AXIS = "replica"
+
+
+def make_mesh(devices=None) -> Mesh:
+    """1-D mesh over all (or given) devices, replica-axis parallel."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (REPLICA_AXIS,))
+
+
+def _pad_to_multiple(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def pad_state(state: ClusterState, multiple: int) -> ClusterState:
+    """Pad the replica axis so it divides the mesh size; padding rows are
+    invalid replicas parked on broker 0."""
+    num_r = state.num_replicas
+    target = _pad_to_multiple(max(num_r, 1), multiple)
+    if target == num_r:
+        return state
+    pad = target - num_r
+
+    def pad_arr(x, fill):
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, widths, constant_values=fill)
+
+    return state.replace(
+        replica_valid=pad_arr(state.replica_valid, False),
+        replica_partition=pad_arr(state.replica_partition, 0),
+        replica_broker=pad_arr(state.replica_broker, 0),
+        replica_disk=pad_arr(state.replica_disk, -1),
+        replica_is_leader=pad_arr(state.replica_is_leader, False),
+        replica_offline=pad_arr(state.replica_offline, False),
+        replica_original_offline=pad_arr(state.replica_original_offline,
+                                         False),
+        replica_base_load=pad_arr(state.replica_base_load, 0.0),
+    )
+
+
+def state_shardings(state: ClusterState, mesh: Mesh) -> ClusterState:
+    """A ClusterState-shaped pytree of NamedShardings: replica-axis arrays
+    shard over the mesh, everything else replicates."""
+    shard = NamedSharding(mesh, P(REPLICA_AXIS))
+    shard2 = NamedSharding(mesh, P(REPLICA_AXIS, None))
+    rep = NamedSharding(mesh, P())
+    rep2 = NamedSharding(mesh, P(None, None))
+    return ClusterState(
+        replica_valid=shard,
+        replica_partition=shard,
+        replica_broker=shard,
+        replica_disk=shard,
+        replica_is_leader=shard,
+        replica_offline=shard,
+        replica_original_offline=shard,
+        replica_base_load=shard2,
+        partition_topic=rep,
+        partition_leader_bonus=rep2,
+        broker_alive=rep,
+        broker_new=rep,
+        broker_demoted=rep,
+        broker_bad_disks=rep,
+        broker_capacity=rep2,
+        broker_rack=rep,
+        broker_host=rep,
+        disk_broker=rep,
+        disk_capacity=rep,
+        disk_alive=rep,
+        num_racks=state.num_racks,
+        num_hosts=state.num_hosts,
+        num_topics=state.num_topics,
+    )
+
+
+def shard_state(state: ClusterState, mesh: Optional[Mesh] = None
+                ) -> ClusterState:
+    """Place a ClusterState onto the mesh with replica-axis sharding."""
+    mesh = mesh or make_mesh()
+    state = pad_state(state, mesh.size)
+    shardings = state_shardings(state, mesh)
+
+    def place(x, s):
+        if isinstance(x, (int,)):
+            return x
+        return jax.device_put(x, s)
+
+    fields = {}
+    for f in dataclasses.fields(ClusterState):
+        val = getattr(state, f.name)
+        tgt = getattr(shardings, f.name)
+        fields[f.name] = val if f.metadata.get("static") else place(val, tgt)
+    return ClusterState(**fields)
