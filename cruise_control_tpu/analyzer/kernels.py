@@ -91,6 +91,40 @@ def _dest_feasibility(state: ClusterState, cand_r: jax.Array,
     return feasible
 
 
+def feasible_dest_exists(state: ClusterState, w: jax.Array,
+                         dest_ok: jax.Array, dest_headroom: jax.Array,
+                         partition_replicas: jax.Array) -> jax.Array:
+    """bool[R] — structural guard: does some destination broker exist for
+    each replica (eligible, enough headroom, not already hosting a replica
+    of the partition)?
+
+    Candidate selection picks one replica per source broker *before* the
+    destination matrix is evaluated; without this guard a replica whose only
+    attractive destination holds a sibling wins its broker's candidacy every
+    round (ties break by index deterministically) and the broker stalls with
+    balancing work left.  The reference never hits this because its inner
+    loop walks candidates until one is accepted
+    (AbstractGoal.maybeApplyBalancingAction:179-221).
+
+    Cost: the best non-blocked destination is found against the global top
+    (RF+2) headroom brokers — a replica's blocked set (its own broker plus
+    its siblings') has at most RF+1 members, so at least one of the top
+    RF+2 is unblocked; O(R * RF * (RF+2)) instead of an R x B matrix.
+    """
+    num_b = state.num_brokers
+    rf = partition_replicas.shape[1]
+    k = min(rf + 2, num_b)
+    ok_headroom = jnp.where(dest_ok, dest_headroom, -jnp.inf)
+    top_h, top_b = jax.lax.top_k(ok_headroom, k)               # [k]
+    sib = partition_replicas[state.replica_partition]          # [R, RF]
+    sib_broker = jnp.where(sib >= 0,
+                           state.replica_broker[jnp.maximum(sib, 0)], -1)
+    blocked = jnp.any(sib_broker[:, :, None] == top_b[None, None, :],
+                      axis=1)                                  # [R, k]
+    best = jnp.max(jnp.where(blocked, -jnp.inf, top_h[None, :]), axis=1)
+    return best >= w
+
+
 def shed_score(w: jax.Array, excess_r: jax.Array) -> jax.Array:
     """Score for choosing which replica an overloaded broker sheds.
 
@@ -145,11 +179,13 @@ def move_round(state: ClusterState,
     num_b = state.num_brokers
     rb = state.replica_broker
 
-    eligible = movable & src_ok[rb]
+    has_dest = feasible_dest_exists(state, w, dest_ok, dest_headroom,
+                                    partition_replicas)
+    eligible = movable & src_ok[rb] & has_dest
     if strict_allowance:
         eligible &= w <= src_excess[rb]
     if forced is not None:
-        eligible = eligible | (movable & forced)
+        eligible = eligible | (movable & forced & has_dest)
         # forced replicas outrank everything else on their broker
         score = jnp.where(forced, w + 1e12, shed_score(w, src_excess[rb]))
     else:
@@ -341,6 +377,11 @@ def forced_move_round(state: ClusterState,
     rb = state.replica_broker
     max_candidates = min(max_candidates, state.num_replicas)
 
+    # structural guard (dup-partition / broker eligibility only — headroom
+    # is the acceptance fn's business here): un-placeable forced replicas
+    # must not occupy candidate slots
+    forced = forced & feasible_dest_exists(
+        state, w, dest_ok, jnp.full((num_b,), jnp.inf), partition_replicas)
     score = jnp.where(forced, w + 1.0, -jnp.inf)
     _, cand_r = jax.lax.top_k(score, max_candidates)
     cand_r = cand_r.astype(jnp.int32)

@@ -1,0 +1,160 @@
+"""Anomaly SPI and the percentile-based metric-anomaly finder.
+
+Re-design of the reference's core anomaly layer (reference:
+cruise-control-core/src/main/java/com/linkedin/cruisecontrol/detector/ —
+Anomaly.java, AnomalyType.java, metricanomaly/MetricAnomaly.java,
+metricanomaly/MetricAnomalyFinder.java, and
+metricanomaly/PercentileMetricAnomalyFinder.java:1-191).
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+import enum
+from typing import Dict, Hashable, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from cruise_control_tpu.core.aggregator import ValuesAndExtrapolations
+
+
+class AnomalyType(enum.Enum):
+    """Anomaly categories with self-healing priority: lower value = handled
+    first (reference CC detector/AnomalyType ordering)."""
+
+    BROKER_FAILURE = 0
+    DISK_FAILURE = 1
+    METRIC_ANOMALY = 2
+    GOAL_VIOLATION = 3
+    TOPIC_ANOMALY = 4
+
+
+class Anomaly(abc.ABC):
+    """reference CORE/detector/Anomaly.java — something that can be fixed."""
+
+    @property
+    @abc.abstractmethod
+    def anomaly_type(self) -> AnomalyType: ...
+
+    @property
+    @abc.abstractmethod
+    def anomaly_id(self) -> str: ...
+
+    @abc.abstractmethod
+    def fix(self) -> bool:
+        """Attempt the fix; True if a fix was started."""
+
+    def reason_supported(self) -> bool:
+        return False
+
+
+@dataclasses.dataclass
+class MetricAnomaly(Anomaly):
+    """A metric out of its historical normal range
+    (reference CORE/detector/metricanomaly/MetricAnomaly.java)."""
+
+    entity: Hashable
+    metric_id: int
+    windows: List[int]
+    description: str
+    _id: str = dataclasses.field(default="")
+
+    def __post_init__(self):
+        if not self._id:
+            self._id = f"metric-anomaly-{self.entity}-{self.metric_id}"
+
+    @property
+    def anomaly_type(self) -> AnomalyType:
+        return AnomalyType.METRIC_ANOMALY
+
+    @property
+    def anomaly_id(self) -> str:
+        return self._id
+
+    def fix(self) -> bool:
+        return False  # metric anomalies have no direct fix in the reference
+
+
+class MetricAnomalyFinder(abc.ABC):
+    """Plugin interface (reference
+    CORE/detector/metricanomaly/MetricAnomalyFinder.java)."""
+
+    @abc.abstractmethod
+    def metric_anomalies(
+            self,
+            metrics_history_by_entity: Mapping[Hashable, ValuesAndExtrapolations],
+            current_metrics_by_entity: Mapping[Hashable, ValuesAndExtrapolations],
+    ) -> List[MetricAnomaly]: ...
+
+
+#: Values whose upper percentile is below this are noise, never anomalous
+#: (reference PercentileMetricAnomalyFinder.SIGNIFICANT_METRIC_VALUE_THRESHOLD)
+SIGNIFICANT_METRIC_VALUE_THRESHOLD = 1.0
+
+
+class PercentileMetricAnomalyFinder(MetricAnomalyFinder):
+    """Current value vs historical percentile band
+    (reference CORE/detector/metricanomaly/PercentileMetricAnomalyFinder.java:
+    40-140): anomalous when current > P_hi * (1 + upper_margin) or
+    current < P_lo * lower_margin, with an insignificance floor on P_hi.
+    """
+
+    def __init__(self, upper_percentile: float = 95.0,
+                 lower_percentile: float = 2.0,
+                 upper_margin: float = 0.5,
+                 lower_margin: float = 0.2,
+                 interested_metrics: Optional[Sequence[int]] = None,
+                 metric_name_fn=None) -> None:
+        self.upper_percentile = upper_percentile
+        self.lower_percentile = lower_percentile
+        self.upper_margin = upper_margin
+        self.lower_margin = lower_margin
+        self.interested_metrics = (None if interested_metrics is None
+                                   else set(interested_metrics))
+        self._metric_name_fn = metric_name_fn or str
+
+    def _anomaly_for_metric(self, entity, metric_id: int,
+                            history: ValuesAndExtrapolations,
+                            current: ValuesAndExtrapolations
+                            ) -> Optional[MetricAnomaly]:
+        hist = np.asarray(history.metric_values(metric_id), dtype=np.float64)
+        if hist.size == 0:
+            return None
+        upper_pct = float(np.percentile(hist, self.upper_percentile))
+        if upper_pct <= SIGNIFICANT_METRIC_VALUE_THRESHOLD:
+            return None
+        upper = upper_pct * (1.0 + self.upper_margin)
+        lower = float(np.percentile(hist, self.lower_percentile)) \
+            * self.lower_margin
+        cur = float(current.metric_values(metric_id)[-1])
+        if cur > upper or cur < lower:
+            name = self._metric_name_fn(metric_id)
+            description = (
+                f"Metric value {cur:.3f} of {name} for {entity} in window "
+                f"{current.window_times_ms[0] if current.window_times_ms else '?'}"
+                f" is out of [{lower:.3f}, {upper:.3f}] over "
+                f"{hist.size} history windows.")
+            return MetricAnomaly(entity=entity, metric_id=metric_id,
+                                 windows=list(current.window_times_ms),
+                                 description=description)
+        return None
+
+    def metric_anomalies(self, metrics_history_by_entity,
+                         current_metrics_by_entity) -> List[MetricAnomaly]:
+        if metrics_history_by_entity is None or current_metrics_by_entity is None:
+            raise ValueError("metrics history/current cannot be None")
+        anomalies: List[MetricAnomaly] = []
+        for entity, current in current_metrics_by_entity.items():
+            history = metrics_history_by_entity.get(entity)
+            if history is None:
+                continue
+            num_metrics = current.values.shape[1]
+            metric_ids = (range(num_metrics) if self.interested_metrics is None
+                          else [m for m in self.interested_metrics
+                                if m < num_metrics])
+            for metric_id in metric_ids:
+                anomaly = self._anomaly_for_metric(entity, metric_id,
+                                                   history, current)
+                if anomaly is not None:
+                    anomalies.append(anomaly)
+        return anomalies

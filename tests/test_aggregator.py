@@ -1,0 +1,280 @@
+"""Tests for the core windowed aggregation engine.
+
+Modeled on the reference's core test strategy (reference:
+cruise-control-core/src/test/java/.../MetricSampleAggregatorTest.java:1-484
+and RawMetricValuesTest.java:1-379) with an IntegerEntity-style fake entity.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.core.aggregator import (AggregationOptions,
+                                                Extrapolation, Granularity,
+                                                MetricSample,
+                                                MetricSampleAggregator,
+                                                NotEnoughValidWindowsError)
+from cruise_control_tpu.core.anomaly import PercentileMetricAnomalyFinder
+from cruise_control_tpu.core.metricdef import AggregationFunction, MetricDef
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegerEntity:
+    """reference CORE test IntegerEntity: entity with a named group."""
+    group: str
+    idx: int
+
+
+WINDOW_MS = 1000
+MIN_SAMPLES = 4
+
+
+def make_metric_def():
+    md = MetricDef()
+    md.define("m_avg", AggregationFunction.AVG)
+    md.define("m_max", AggregationFunction.MAX)
+    md.define("m_latest", AggregationFunction.LATEST)
+    return md.freeze()
+
+
+def make_aggregator(num_windows=8):
+    return MetricSampleAggregator(num_windows=num_windows, window_ms=WINDOW_MS,
+                                  min_samples_per_window=MIN_SAMPLES,
+                                  metric_def=make_metric_def())
+
+
+def fill_window(agg, entity, window, num_samples=MIN_SAMPLES, value=10.0):
+    """Put `num_samples` samples into the window covering
+    ((window-1)*W, window*W]."""
+    for i in range(num_samples):
+        t = (window - 1) * WINDOW_MS + (i + 1) * WINDOW_MS // (num_samples + 1)
+        agg.add_sample(MetricSample(
+            entity, t, {0: value, 1: value * 2, 2: value * 3}))
+
+
+def test_avg_max_latest_aggregation():
+    agg = make_aggregator()
+    e = IntegerEntity("g", 0)
+    # window 1: values 1..4 → avg 2.5, max 8, latest 12
+    for i, v in enumerate([1.0, 2.0, 3.0, 4.0]):
+        agg.add_sample(MetricSample(e, 100 + i * 100, {0: v, 1: v * 2, 2: v * 3}))
+    # roll to make window 1 stable
+    fill_window(agg, e, 2)
+    result = agg.aggregate(0, 10_000, AggregationOptions())
+    vae = result.entity_values[e]
+    assert vae.window_times_ms[0] == WINDOW_MS
+    np.testing.assert_allclose(vae.values[0], [2.5, 8.0, 12.0])
+    assert not vae.extrapolations.get(0)
+
+
+def test_avg_available_extrapolation():
+    agg = make_aggregator()
+    e = IntegerEntity("g", 0)
+    # half-min (2) samples in window 1 → AVG_AVAILABLE
+    for i, v in enumerate([2.0, 4.0]):
+        agg.add_sample(MetricSample(e, 100 + i * 100, {0: v, 1: v, 2: v}))
+    fill_window(agg, e, 2)
+    result = agg.aggregate(0, 10_000)
+    vae = result.entity_values[e]
+    assert vae.extrapolations[0] == Extrapolation.AVG_AVAILABLE
+    np.testing.assert_allclose(vae.values[0, 0], 3.0)
+
+
+def test_avg_adjacent_extrapolation():
+    agg = make_aggregator()
+    e = IntegerEntity("g", 0)
+    fill_window(agg, e, 1, value=10.0)
+    # window 2 left empty
+    fill_window(agg, e, 3, value=20.0)
+    fill_window(agg, e, 4)  # roll so 3 is stable and has a right neighbour
+    fill_window(agg, e, 5)
+    result = agg.aggregate(0, 100_000)
+    vae = result.entity_values[e]
+    pos = vae.window_times_ms.index(2 * WINDOW_MS)
+    assert vae.extrapolations[pos] == Extrapolation.AVG_ADJACENT
+    # AVG metric: (4*10 + 4*20) / 8 = 15
+    np.testing.assert_allclose(vae.values[pos, 0], 15.0)
+    # MAX metric: (20 + 40) / 2 = 30 (counts==0 → divide by 2)
+    np.testing.assert_allclose(vae.values[pos, 1], 30.0)
+
+
+def test_forced_insufficient_extrapolation():
+    agg = make_aggregator()
+    e = IntegerEntity("g", 0)
+    agg.add_sample(MetricSample(e, 500, {0: 7.0, 1: 7.0, 2: 7.0}))
+    fill_window(agg, e, 2)
+    result = agg.aggregate(0, 10_000)
+    vae = result.entity_values[e]
+    assert vae.extrapolations[0] == Extrapolation.FORCED_INSUFFICIENT
+    np.testing.assert_allclose(vae.values[0, 0], 7.0)
+
+
+def test_window_rolling_evicts_old_windows():
+    agg = make_aggregator(num_windows=4)
+    e = IntegerEntity("g", 0)
+    for w in range(1, 10):
+        fill_window(agg, e, w)
+    windows = agg.all_windows()
+    assert len(windows) == 4
+    assert windows[-1] == 8 * WINDOW_MS  # window 9 is current, 5..8 stable
+    assert agg.num_abandoned_samples > 0
+
+
+def test_too_old_sample_rejected():
+    agg = make_aggregator(num_windows=2)
+    e = IntegerEntity("g", 0)
+    for w in range(5, 9):
+        fill_window(agg, e, w)
+    assert not agg.add_sample(MetricSample(e, 100, {0: 1.0, 1: 1.0, 2: 1.0}))
+
+
+def test_partial_sample_rejected():
+    agg = make_aggregator()
+    e = IntegerEntity("g", 0)
+    with pytest.raises(ValueError, match="missing ids"):
+        agg.add_sample(MetricSample(e, 100, {0: 1.0}))
+
+
+def test_sparse_window_skipped_without_invalidating_entities():
+    """A window failing min_valid_entity_ratio is excluded; entities with
+    full data in the included windows stay valid (reference
+    WindowState.maybeInclude / retainAllValidEntities)."""
+    agg = make_aggregator()
+    entities = [IntegerEntity("g", i) for i in range(10)]
+    for w in [1, 5, 6]:
+        for e in entities:
+            fill_window(agg, e, w)
+    # windows 2-4: samples for only 2 of 10 entities (a 3-wide gap defeats
+    # AVG_ADJACENT, which needs both direct neighbours sufficient)
+    for e in entities[:2]:
+        for w in [2, 3, 4]:
+            fill_window(agg, e, w)
+    opts = AggregationOptions(min_valid_entity_ratio=0.5,
+                              interested_entities=set(entities))
+    result = agg.aggregate(0, 100_000, opts)
+    comp = result.completeness
+    for w in [2, 3, 4]:
+        assert w * WINDOW_MS not in comp.valid_window_indices
+    assert len(comp.valid_entities) == 10
+    assert len(result.entity_values) == 10
+    # the sparse windows must not appear in any entity's value windows
+    assert all(3 * WINDOW_MS not in vae.window_times_ms
+               for vae in result.entity_values.values())
+
+
+def test_completeness_cache_hit():
+    agg = make_aggregator()
+    e = IntegerEntity("g", 0)
+    for w in range(1, 5):
+        fill_window(agg, e, w)
+    opts = AggregationOptions()
+    c1 = agg.completeness(0, 100_000, opts)
+    c2 = agg.completeness(0, 100_000, opts)
+    assert c2 is c1  # served from cache at same generation
+    fill_window(agg, e, 5)  # generation bump invalidates
+    assert agg.completeness(0, 100_000, opts) is not c1
+
+
+def test_completeness_entity_and_group_granularity():
+    agg = make_aggregator()
+    complete = IntegerEntity("topicA", 0)
+    partial = IntegerEntity("topicA", 1)
+    other = IntegerEntity("topicB", 2)
+    for w in range(1, 6):
+        fill_window(agg, complete, w)
+        fill_window(agg, other, w)
+        if w >= 3:  # `partial` misses windows 1-2 entirely
+            fill_window(agg, partial, w)
+
+    opts = AggregationOptions(interested_entities={complete, partial, other})
+    comp = agg.completeness(0, 100_000, opts)
+    assert complete in comp.valid_entities
+    assert other in comp.valid_entities
+    assert partial not in comp.valid_entities
+    assert comp.valid_entity_ratio == pytest.approx(2 / 3)
+    # topicA has an invalid member → group invalid
+    assert comp.valid_entity_groups == {"topicB"}
+
+    group_opts = dataclasses.replace(opts, granularity=Granularity.ENTITY_GROUP)
+    comp2 = agg.completeness(0, 100_000, group_opts)
+    assert comp2.valid_entities == {other}
+
+
+def test_aggregate_raises_without_enough_windows():
+    agg = make_aggregator()
+    e = IntegerEntity("g", 0)
+    fill_window(agg, e, 1)  # only the current window exists: no stable ones
+    with pytest.raises(NotEnoughValidWindowsError):
+        agg.aggregate(0, 10_000, AggregationOptions(min_valid_windows=1))
+
+
+def test_min_valid_entity_ratio_enforced():
+    agg = make_aggregator()
+    good = IntegerEntity("g", 0)
+    bad = IntegerEntity("g", 1)
+    for w in range(1, 4):
+        fill_window(agg, good, w)
+    opts = AggregationOptions(min_valid_entity_ratio=0.9,
+                              interested_entities={good, bad})
+    with pytest.raises(NotEnoughValidWindowsError):
+        agg.aggregate(0, 100_000, opts)
+
+
+def test_peek_current_window():
+    agg = make_aggregator()
+    e = IntegerEntity("g", 0)
+    fill_window(agg, e, 1)
+    agg.add_sample(MetricSample(e, 1500, {0: 42.0, 1: 42.0, 2: 42.0}))
+    peek = agg.peek_current_window()
+    np.testing.assert_allclose(peek[e].values[0, 0], 42.0)
+
+
+def test_retain_and_remove_entities():
+    agg = make_aggregator()
+    a, b = IntegerEntity("ga", 0), IntegerEntity("gb", 1)
+    for w in range(1, 4):
+        fill_window(agg, a, w)
+        fill_window(agg, b, w)
+    gen = agg.generation
+    agg.retain_entities({a})
+    assert agg.generation > gen
+    result = agg.aggregate(0, 100_000)
+    assert a in result.entity_values and b not in result.entity_values
+
+    agg2 = make_aggregator()
+    for w in range(1, 4):
+        fill_window(agg2, a, w)
+        fill_window(agg2, b, w)
+    agg2.remove_entity_group({"gb"})
+    result = agg2.aggregate(0, 100_000)
+    assert a in result.entity_values and b not in result.entity_values
+
+
+def test_generation_bumps_on_new_window():
+    agg = make_aggregator()
+    e = IntegerEntity("g", 0)
+    fill_window(agg, e, 1)
+    g0 = agg.generation
+    fill_window(agg, e, 2)
+    assert agg.generation > g0
+
+
+def test_percentile_anomaly_finder():
+    agg = make_aggregator()
+    e = IntegerEntity("g", 0)
+    for w in range(1, 9):
+        fill_window(agg, e, w, value=10.0)
+    history = agg.aggregate(0, 1_000_000).entity_values
+    # current window has a big spike
+    agg.add_sample(MetricSample(e, agg.all_windows()[-1] + 10,
+                                {0: 500.0, 1: 500.0, 2: 500.0}))
+    current = agg.peek_current_window()
+    finder = PercentileMetricAnomalyFinder(interested_metrics=[0])
+    anomalies = finder.metric_anomalies(history, current)
+    assert len(anomalies) == 1
+    assert anomalies[0].metric_id == 0
+
+    # normal value → no anomaly
+    finder2 = PercentileMetricAnomalyFinder(interested_metrics=[0])
+    normal_current = {ent: vae for ent, vae in history.items()}
+    assert finder2.metric_anomalies(history, normal_current) == []
