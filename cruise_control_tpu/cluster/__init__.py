@@ -1,0 +1,26 @@
+"""Cluster transport plane: metadata, admin SPI, simulated cluster.
+
+The reference talks to its managed cluster over two control-plane backends —
+the Kafka protocol (metadata refresh, AdminClient operations, consumers for
+metric topics) and ZooKeeper (reassignment znodes, liveness watches,
+preferred-leader election, throttle configs); see reference
+cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/common/
+MetadataClient.java and .../executor/ExecutorUtils.scala.  This package is
+the framework's equivalent transport plane, reduced to one asynchronous
+`ClusterAdminClient` SPI (the modern AdminClient-era surface) plus a cached
+`MetadataClient` and an in-process `SimulatedCluster` that plays the role of
+the reference's embedded-Kafka integration harness
+(cruise-control-metrics-reporter/src/test/.../CCKafkaIntegrationTestHarness.java).
+"""
+from cruise_control_tpu.cluster.types import (BrokerInfo, ClusterSnapshot,
+                                              LogDirInfo, PartitionInfo,
+                                              ReassignmentState, TopicPartition)
+from cruise_control_tpu.cluster.admin import ClusterAdminClient
+from cruise_control_tpu.cluster.metadata import MetadataClient
+from cruise_control_tpu.cluster.simulated import SimulatedCluster
+
+__all__ = [
+    "BrokerInfo", "ClusterSnapshot", "LogDirInfo", "PartitionInfo",
+    "ReassignmentState", "TopicPartition", "ClusterAdminClient",
+    "MetadataClient", "SimulatedCluster",
+]

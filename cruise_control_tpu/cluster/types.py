@@ -1,0 +1,127 @@
+"""Value types describing the managed cluster.
+
+These are the framework's wire-free analogs of the Kafka metadata objects the
+reference consumes (org.apache.kafka.common.Cluster / Node / PartitionInfo as
+used in reference CC/common/MetadataClient.java and
+CC/monitor/MonitorUtils.java).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Mapping, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class TopicPartition:
+    """(topic, partition) id — reference org.apache.kafka.common.TopicPartition."""
+
+    topic: str
+    partition: int
+
+    def __str__(self) -> str:
+        return f"{self.topic}-{self.partition}"
+
+
+@dataclasses.dataclass(frozen=True)
+class LogDirInfo:
+    """One logdir on a broker (JBOD disk).
+
+    Mirrors what the reference learns from AdminClient.describeLogDirs
+    (CC/detector/DiskFailureDetector.java:1-123)."""
+
+    path: str
+    capacity_bytes: float = 0.0
+    used_bytes: float = 0.0
+    offline: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerInfo:
+    """Broker endpoint + placement (reference kafka Node + rack)."""
+
+    broker_id: int
+    host: str = "localhost"
+    rack: Optional[str] = None
+    alive: bool = True
+    logdirs: Tuple[LogDirInfo, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionInfo:
+    """Replica list (leader first position is NOT implied; `leader` is
+    explicit), in-sync set, and per-replica logdir placement."""
+
+    tp: TopicPartition
+    leader: Optional[int]
+    replicas: Tuple[int, ...]
+    in_sync: Tuple[int, ...] = ()
+    offline_replicas: Tuple[int, ...] = ()
+    # broker id -> logdir path for that broker's replica
+    logdir_by_broker: Mapping[int, str] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def size_bytes(self) -> float:  # filled by monitors when known
+        return 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ReassignmentState:
+    """An in-flight partition reassignment (reference
+    Executor.hasOngoingPartitionReassignments, CC/executor/Executor.java:687)."""
+
+    tp: TopicPartition
+    adding_replicas: Tuple[int, ...]
+    removing_replicas: Tuple[int, ...]
+    target_replicas: Tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterSnapshot:
+    """Point-in-time cluster metadata with a monotonically increasing
+    generation (reference MetadataClient keeps (metadata, generation);
+    CC/common/MetadataClient.java:1-171)."""
+
+    generation: int
+    brokers: Tuple[BrokerInfo, ...]
+    partitions: Tuple[PartitionInfo, ...]
+    controller_id: Optional[int] = None
+
+    # ---- queries used throughout the monitor/executor planes ----
+    def broker(self, broker_id: int) -> Optional[BrokerInfo]:
+        for b in self.brokers:
+            if b.broker_id == broker_id:
+                return b
+        return None
+
+    @property
+    def alive_broker_ids(self) -> FrozenSet[int]:
+        return frozenset(b.broker_id for b in self.brokers if b.alive)
+
+    @property
+    def all_broker_ids(self) -> FrozenSet[int]:
+        return frozenset(b.broker_id for b in self.brokers)
+
+    @property
+    def topics(self) -> FrozenSet[str]:
+        return frozenset(p.tp.topic for p in self.partitions)
+
+    def partition(self, tp: TopicPartition) -> Optional[PartitionInfo]:
+        for p in self.partitions:
+            if p.tp == tp:
+                return p
+        return None
+
+    def partitions_of(self, topic: str) -> List[PartitionInfo]:
+        return [p for p in self.partitions if p.tp.topic == topic]
+
+    def partitions_with_offline_replicas(self) -> List[PartitionInfo]:
+        return [p for p in self.partitions if p.offline_replicas]
+
+    def replica_count(self) -> int:
+        return sum(len(p.replicas) for p in self.partitions)
+
+
+def partitions_by_index(partitions: Sequence[PartitionInfo]
+                        ) -> Dict[TopicPartition, PartitionInfo]:
+    return {p.tp: p for p in partitions}

@@ -1,0 +1,18 @@
+"""Configuration: typed config groups + pluggable resolvers.
+
+Mirrors the reference's config package (CC/config/): the ~200-key
+`KafkaCruiseControlConfig` equivalent lives in `main_config.py` built on the
+core ConfigDef framework (cruise_control_tpu/common/config.py), capacity
+resolution in `capacity.py`, topic-config provision in `topics.py`.
+"""
+from cruise_control_tpu.config.capacity import (BrokerCapacity,
+                                                BrokerCapacityConfigResolver,
+                                                BrokerCapacityConfigFileResolver,
+                                                StaticCapacityResolver)
+from cruise_control_tpu.config.main_config import CruiseControlConfig
+
+__all__ = [
+    "BrokerCapacity", "BrokerCapacityConfigResolver",
+    "BrokerCapacityConfigFileResolver", "StaticCapacityResolver",
+    "CruiseControlConfig",
+]

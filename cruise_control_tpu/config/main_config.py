@@ -1,0 +1,381 @@
+"""The framework's main typed configuration.
+
+Reference: CC/config/KafkaCruiseControlConfig.java:1-393 plus the eight
+constant groups under CC/config/constants/ (MonitorConfig, AnalyzerConfig,
+ExecutorConfig, AnomalyDetectorConfig, WebServerConfig,
+CruiseControlRequestConfig, CruiseControlParametersConfig,
+UserTaskManagerConfig) — ~200 typed keys with defaults, validators and
+cross-field sanity checks.  The same grouping is kept here; endpoint→class
+wiring (request/parameters groups) lives with the API layer and merges in
+via `api.request_config_def()` when the webserver starts.
+"""
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from cruise_control_tpu.common.config import (AbstractConfig, ConfigDef,
+                                              ConfigException, Importance,
+                                              Type, in_range)
+
+_H = Importance.HIGH
+_M = Importance.MEDIUM
+_L = Importance.LOW
+
+
+def monitor_config_def(d: ConfigDef) -> ConfigDef:
+    """reference config/constants/MonitorConfig.java (40 keys)"""
+    d.define("partition.metrics.window.ms", Type.LONG, 3_600_000,
+             in_range(min_value=1), _H,
+             "Span of one partition-metric aggregation window.")
+    d.define("num.partition.metrics.windows", Type.INT, 5,
+             in_range(min_value=1), _H,
+             "Number of stable partition windows kept.")
+    d.define("min.samples.per.partition.metrics.window", Type.INT, 3,
+             in_range(min_value=1), _M,
+             "Samples required for a partition window to be valid.")
+    d.define("broker.metrics.window.ms", Type.LONG, 3_600_000,
+             in_range(min_value=1), _H,
+             "Span of one broker-metric aggregation window.")
+    d.define("num.broker.metrics.windows", Type.INT, 20,
+             in_range(min_value=1), _H,
+             "Number of stable broker windows kept.")
+    d.define("min.samples.per.broker.metrics.window", Type.INT, 1,
+             in_range(min_value=1), _M,
+             "Samples required for a broker window to be valid.")
+    d.define("metric.sampling.interval.ms", Type.LONG, 120_000,
+             in_range(min_value=10), _H, "Interval between sampling runs.")
+    d.define("num.metric.fetchers", Type.INT, 1, in_range(min_value=1), _M,
+             "Parallel metric-fetcher workers.")
+    d.define("metric.sampler.class", Type.CLASS,
+             "cruise_control_tpu.monitor.sampling.sampler.NoopSampler",
+             None, _H, "MetricSampler implementation.")
+    d.define("sample.store.class", Type.CLASS,
+             "cruise_control_tpu.monitor.sampling.sample_store.NoopSampleStore",
+             None, _M, "SampleStore implementation for durable samples.")
+    d.define("sample.store.directory", Type.STRING, "/tmp/cc-samples", None,
+             _L, "Directory for the file sample store.")
+    d.define("skip.loading.samples", Type.BOOLEAN, False, None, _L,
+             "Skip reloading stored samples at startup.")
+    d.define("broker.capacity.config.resolver.class", Type.CLASS,
+             "cruise_control_tpu.config.capacity.StaticCapacityResolver",
+             None, _H, "BrokerCapacityConfigResolver implementation.")
+    d.define("capacity.config.file", Type.STRING, "", None, _M,
+             "JSON capacity file for the file resolver.")
+    d.define("metadata.ttl.ms", Type.LONG, 5_000, in_range(min_value=1), _L,
+             "Cluster metadata cache TTL.")
+    d.define("monitor.state.update.interval.ms", Type.LONG, 30_000,
+             in_range(min_value=1), _L, "Sensor/state refresh interval.")
+    d.define("broker.sample.retention.ms", Type.LONG, 86_400_000 * 7,
+             in_range(min_value=1), _L, "Broker-sample retention for stores.")
+    d.define("partition.sample.retention.ms", Type.LONG, 86_400_000 * 7,
+             in_range(min_value=1), _L,
+             "Partition-sample retention for stores.")
+    d.define("sampling.allow.cpu.capacity.estimation", Type.BOOLEAN, True,
+             None, _L, "Allow estimated capacities during sampling.")
+    d.define("max.allowed.extrapolations.per.partition", Type.INT, 5,
+             in_range(min_value=0), _L,
+             "Extrapolated windows tolerated per partition entity.")
+    d.define("max.allowed.extrapolations.per.broker", Type.INT, 5,
+             in_range(min_value=0), _L,
+             "Extrapolated windows tolerated per broker entity.")
+    d.define("num.cached.recent.anomaly.states", Type.INT, 10,
+             in_range(min_value=1, max_value=100), _L,
+             "Recent anomalies kept per type for the state endpoint.")
+    return d
+
+
+def analyzer_config_def(d: ConfigDef) -> ConfigDef:
+    """reference config/constants/AnalyzerConfig.java (28 keys)"""
+    d.define("cpu.balance.threshold", Type.DOUBLE, 1.1,
+             in_range(min_value=1.0), _H,
+             "Allowed CPU utilization ratio above/below cluster average.")
+    d.define("network.inbound.balance.threshold", Type.DOUBLE, 1.1,
+             in_range(min_value=1.0), _H, "NW_IN balance ratio.")
+    d.define("network.outbound.balance.threshold", Type.DOUBLE, 1.1,
+             in_range(min_value=1.0), _H, "NW_OUT balance ratio.")
+    d.define("disk.balance.threshold", Type.DOUBLE, 1.1,
+             in_range(min_value=1.0), _H, "DISK balance ratio.")
+    d.define("cpu.capacity.threshold", Type.DOUBLE, 0.7,
+             in_range(min_value=0.0, max_value=1.0), _H,
+             "Usable fraction of CPU capacity.")
+    d.define("network.inbound.capacity.threshold", Type.DOUBLE, 0.8,
+             in_range(min_value=0.0, max_value=1.0), _H,
+             "Usable fraction of NW_IN capacity.")
+    d.define("network.outbound.capacity.threshold", Type.DOUBLE, 0.8,
+             in_range(min_value=0.0, max_value=1.0), _H,
+             "Usable fraction of NW_OUT capacity.")
+    d.define("disk.capacity.threshold", Type.DOUBLE, 0.8,
+             in_range(min_value=0.0, max_value=1.0), _H,
+             "Usable fraction of DISK capacity.")
+    d.define("cpu.low.utilization.threshold", Type.DOUBLE, 0.0,
+             in_range(min_value=0.0, max_value=1.0), _L,
+             "Below this CPU utilization, distribution goals stand down.")
+    d.define("network.inbound.low.utilization.threshold", Type.DOUBLE, 0.0,
+             in_range(min_value=0.0, max_value=1.0), _L, "NW_IN idle floor.")
+    d.define("network.outbound.low.utilization.threshold", Type.DOUBLE, 0.0,
+             in_range(min_value=0.0, max_value=1.0), _L, "NW_OUT idle floor.")
+    d.define("disk.low.utilization.threshold", Type.DOUBLE, 0.0,
+             in_range(min_value=0.0, max_value=1.0), _L, "DISK idle floor.")
+    d.define("replica.count.balance.threshold", Type.DOUBLE, 1.1,
+             in_range(min_value=1.0), _M,
+             "Allowed replica-count ratio around the cluster average.")
+    d.define("leader.replica.count.balance.threshold", Type.DOUBLE, 1.1,
+             in_range(min_value=1.0), _M, "Leader-count balance ratio.")
+    d.define("topic.replica.count.balance.threshold", Type.DOUBLE, 3.0,
+             in_range(min_value=1.0), _M,
+             "Per-topic replica-count balance ratio.")
+    d.define("max.replicas.per.broker", Type.LONG, 10_000,
+             in_range(min_value=1), _M, "Replica capacity per broker.")
+    d.define("goals", Type.LIST,
+             ("RackAwareGoal,ReplicaCapacityGoal,DiskCapacityGoal,"
+              "NetworkInboundCapacityGoal,NetworkOutboundCapacityGoal,"
+              "CpuCapacityGoal,ReplicaDistributionGoal,PotentialNwOutGoal,"
+              "DiskUsageDistributionGoal,"
+              "NetworkInboundUsageDistributionGoal,"
+              "NetworkOutboundUsageDistributionGoal,"
+              "CpuUsageDistributionGoal,TopicReplicaDistributionGoal,"
+              "LeaderReplicaDistributionGoal,"
+              "LeaderBytesInDistributionGoal"),
+             None, _H, "Default goal list by descending priority.")
+    d.define("hard.goals", Type.LIST,
+             ("RackAwareGoal,ReplicaCapacityGoal,DiskCapacityGoal,"
+              "NetworkInboundCapacityGoal,NetworkOutboundCapacityGoal,"
+              "CpuCapacityGoal"),
+             None, _H, "Goals that must always be satisfied.")
+    d.define("default.goals", Type.LIST, "", None, _M,
+             "Override of `goals` for proposal precomputation.")
+    d.define("intra.broker.goals", Type.LIST,
+             "IntraBrokerDiskCapacityGoal,IntraBrokerDiskUsageDistributionGoal",
+             None, _M, "Goals for intra-broker (JBOD) rebalancing.")
+    d.define("anomaly.detection.goals", Type.LIST,
+             ("RackAwareGoal,ReplicaCapacityGoal,DiskCapacityGoal,"
+              "NetworkInboundCapacityGoal,NetworkOutboundCapacityGoal,"
+              "CpuCapacityGoal"),
+             None, _M, "Goals the goal-violation detector checks.")
+    d.define("self.healing.goals", Type.LIST, "", None, _L,
+             "Goal override for self-healing (empty = default goals).")
+    d.define("goal.balancedness.priority.weight", Type.DOUBLE, 1.1,
+             in_range(min_value=1.0), _L,
+             "Weight multiplier per goal-priority rank in balancedness.")
+    d.define("goal.balancedness.strictness.weight", Type.DOUBLE, 1.5,
+             in_range(min_value=1.0), _L,
+             "Weight multiplier for hard goals in balancedness.")
+    d.define("goal.violation.distribution.threshold.multiplier", Type.DOUBLE,
+             1.0, in_range(min_value=1.0), _L,
+             "Relaxation of distribution thresholds during violation fix.")
+    d.define("num.proposal.precompute.threads", Type.INT, 1,
+             in_range(min_value=1), _M,
+             "Background proposal precompute workers.")
+    d.define("proposal.expiration.ms", Type.LONG, 900_000,
+             in_range(min_value=1), _M,
+             "Cached proposals older than this are recomputed.")
+    d.define("max.optimization.rounds", Type.INT, 64,
+             in_range(min_value=1), _L,
+             "Per-goal cap on batched optimization rounds (TPU solver).")
+    d.define("allow.capacity.estimation.on.proposal", Type.BOOLEAN, True,
+             None, _L, "Allow estimated capacities when computing proposals.")
+    return d
+
+
+def executor_config_def(d: ConfigDef) -> ConfigDef:
+    """reference config/constants/ExecutorConfig.java (20 keys)"""
+    d.define("num.concurrent.partition.movements.per.broker", Type.INT, 5,
+             in_range(min_value=1), _H,
+             "Cap of in-flight inter-broker moves per broker.")
+    d.define("num.concurrent.intra.broker.partition.movements", Type.INT, 2,
+             in_range(min_value=1), _M,
+             "Cap of in-flight intra-broker (logdir) moves per broker.")
+    d.define("num.concurrent.leader.movements", Type.INT, 1000,
+             in_range(min_value=1), _M,
+             "Cap of leadership changes per execution batch.")
+    d.define("execution.progress.check.interval.ms", Type.LONG, 10_000,
+             in_range(min_value=1), _H,
+             "Interval between execution progress polls.")
+    d.define("max.num.cluster.movements", Type.INT, 1250,
+             in_range(min_value=1), _M,
+             "Global cap of simultaneous movement tasks.")
+    d.define("default.replication.throttle", Type.LONG, -1, None, _M,
+             "Replication throttle in B/s applied during moves (-1 = none).")
+    d.define("replica.movement.strategies", Type.LIST,
+             "BaseReplicaMovementStrategy", None, _M,
+             "Chain of task-ordering strategies.")
+    d.define("default.replica.movement.strategies", Type.LIST,
+             "BaseReplicaMovementStrategy", None, _L,
+             "Default strategy chain when a request names none.")
+    d.define("executor.notifier.class", Type.CLASS,
+             "cruise_control_tpu.executor.notifier.LoggingExecutorNotifier",
+             None, _L, "ExecutorNotifier implementation.")
+    d.define("max.execution.task.lifetime.ms", Type.LONG, 86_400_000,
+             in_range(min_value=1), _L,
+             "Tasks alive longer than this are marked dead.")
+    d.define("task.execution.alerting.threshold.ms", Type.LONG, 90_000,
+             in_range(min_value=1), _L,
+             "Alert when a task takes longer than this.")
+    d.define("leader.movement.timeout.ms", Type.LONG, 180_000,
+             in_range(min_value=1), _L, "Timeout for a leadership movement.")
+    d.define("demotion.history.retention.time.ms", Type.LONG, 1_209_600_000,
+             in_range(min_value=1), _L, "Retention of demoted-broker records.")
+    d.define("removal.history.retention.time.ms", Type.LONG, 1_209_600_000,
+             in_range(min_value=1), _L, "Retention of removed-broker records.")
+    return d
+
+
+def anomaly_detector_config_def(d: ConfigDef) -> ConfigDef:
+    """reference config/constants/AnomalyDetectorConfig.java (24 keys)"""
+    d.define("anomaly.detection.interval.ms", Type.LONG, 300_000,
+             in_range(min_value=1), _H,
+             "Base interval for scheduled anomaly detectors.")
+    d.define("goal.violation.detection.interval.ms", Type.LONG, -1, None, _M,
+             "Goal-violation detector interval (-1 = base interval).")
+    d.define("metric.anomaly.detection.interval.ms", Type.LONG, -1, None, _M,
+             "Metric-anomaly detector interval (-1 = base interval).")
+    d.define("disk.failure.detection.interval.ms", Type.LONG, -1, None, _M,
+             "Disk-failure detector interval (-1 = base interval).")
+    d.define("topic.anomaly.detection.interval.ms", Type.LONG, -1, None, _M,
+             "Topic-anomaly detector interval (-1 = base interval).")
+    d.define("broker.failure.alert.threshold.ms", Type.LONG, 900_000,
+             in_range(min_value=0), _M,
+             "Grace before a broker failure is alerted.")
+    d.define("broker.failure.self.healing.threshold.ms", Type.LONG,
+             1_800_000, in_range(min_value=0), _M,
+             "Grace before broker-failure self-healing starts.")
+    d.define("anomaly.notifier.class", Type.CLASS,
+             "cruise_control_tpu.detector.notifier.SelfHealingNotifier",
+             None, _H, "AnomalyNotifier implementation.")
+    d.define("self.healing.enabled", Type.BOOLEAN, False, None, _H,
+             "Master switch for all self-healing.")
+    d.define("self.healing.broker.failure.enabled", Type.BOOLEAN, True, None,
+             _M, "Self-heal broker failures.")
+    d.define("self.healing.goal.violation.enabled", Type.BOOLEAN, True, None,
+             _M, "Self-heal goal violations.")
+    d.define("self.healing.disk.failure.enabled", Type.BOOLEAN, True, None,
+             _M, "Self-heal disk failures.")
+    d.define("self.healing.metric.anomaly.enabled", Type.BOOLEAN, False,
+             None, _M, "Self-heal metric anomalies.")
+    d.define("self.healing.topic.anomaly.enabled", Type.BOOLEAN, False, None,
+             _M, "Self-heal topic anomalies.")
+    d.define("self.healing.slow.broker.removal.enabled", Type.BOOLEAN, False,
+             None, _M, "Allow slow-broker escalation to removal.")
+    d.define("metric.anomaly.finder.class", Type.LIST,
+             "cruise_control_tpu.core.anomaly.PercentileMetricAnomalyFinder",
+             None, _M, "MetricAnomalyFinder implementations.")
+    d.define("metric.anomaly.percentile.upper.threshold", Type.DOUBLE, 95.0,
+             in_range(min_value=0.0, max_value=100.0), _L,
+             "Upper percentile for the percentile anomaly finder.")
+    d.define("metric.anomaly.percentile.lower.threshold", Type.DOUBLE, 2.0,
+             in_range(min_value=0.0, max_value=100.0), _L,
+             "Lower percentile for the percentile anomaly finder.")
+    d.define("slow.broker.bytes.rate.detection.threshold", Type.DOUBLE, 1024.0,
+             in_range(min_value=0.0), _L,
+             "Minimum byte rate before slow-broker scoring applies.")
+    d.define("slow.broker.log.flush.time.threshold.ms", Type.DOUBLE, 1000.0,
+             in_range(min_value=0.0), _L,
+             "Log-flush-time floor for slow-broker detection.")
+    d.define("slow.broker.demotion.score", Type.INT, 5,
+             in_range(min_value=1), _L,
+             "Slowness score at which a broker is demoted.")
+    d.define("slow.broker.decommission.score", Type.INT, 50,
+             in_range(min_value=1), _L,
+             "Slowness score at which a broker is removed.")
+    d.define("topic.anomaly.finder.class", Type.LIST, "", None, _L,
+             "TopicAnomalyFinder implementations.")
+    d.define("topic.replication.factor.margin", Type.INT, 1,
+             in_range(min_value=0), _L,
+             "Required RF margin over min.insync.replicas.")
+    return d
+
+
+def webserver_config_def(d: ConfigDef) -> ConfigDef:
+    """reference config/constants/WebServerConfig.java (36 keys)"""
+    d.define("webserver.http.port", Type.INT, 9090,
+             in_range(min_value=0, max_value=65535), _H, "REST port.")
+    d.define("webserver.http.address", Type.STRING, "127.0.0.1", None, _H,
+             "REST bind address.")
+    d.define("webserver.http.cors.enabled", Type.BOOLEAN, False, None, _L,
+             "Enable CORS headers.")
+    d.define("webserver.http.cors.origin", Type.STRING, "*", None, _L,
+             "CORS allowed origin.")
+    d.define("webserver.api.urlprefix", Type.STRING, "/kafkacruisecontrol",
+             None, _M, "URL prefix for all endpoints.")
+    d.define("webserver.session.maxExpiryPeriodMs", Type.LONG, 60_000,
+             in_range(min_value=1), _L, "Async session expiry.")
+    d.define("webserver.request.maxBlockTimeMs", Type.LONG, 10_000,
+             in_range(min_value=0), _M,
+             "How long a sync-looking request blocks before going async.")
+    d.define("webserver.security.enable", Type.BOOLEAN, False, None, _M,
+             "Enable authentication/authorization.")
+    d.define("webserver.security.provider", Type.CLASS,
+             "cruise_control_tpu.api.security.BasicSecurityProvider",
+             None, _M, "SecurityProvider implementation.")
+    d.define("webserver.auth.credentials.file", Type.STRING, "", None, _M,
+             "Credentials file for basic auth (user: password,ROLE).")
+    d.define("webserver.ssl.enable", Type.BOOLEAN, False, None, _M,
+             "Serve HTTPS (requires keystore).")
+    d.define("webserver.ssl.keystore.location", Type.STRING, "", None, _L,
+             "PEM/keystore path for TLS.")
+    d.define("webserver.ssl.key.password", Type.PASSWORD, "", None, _L,
+             "TLS key password.")
+    d.define("webserver.accesslog.enabled", Type.BOOLEAN, True, None, _L,
+             "Write NCSA-style access log lines.")
+    d.define("two.step.verification.enabled", Type.BOOLEAN, False, None, _M,
+             "Park POST requests in the purgatory for review.")
+    d.define("two.step.purgatory.retention.time.ms", Type.LONG,
+             1_209_600_000, in_range(min_value=1), _L,
+             "Purgatory retention for pending requests.")
+    d.define("two.step.purgatory.max.requests", Type.INT, 25,
+             in_range(min_value=1), _L, "Purgatory capacity.")
+    return d
+
+
+def user_task_manager_config_def(d: ConfigDef) -> ConfigDef:
+    """reference config/constants/UserTaskManagerConfig.java (10 keys)"""
+    d.define("max.active.user.tasks", Type.INT, 5, in_range(min_value=1), _M,
+             "Maximum concurrently active async user tasks.")
+    d.define("completed.user.task.retention.time.ms", Type.LONG, 86_400_000,
+             in_range(min_value=1), _M,
+             "Retention of completed user tasks.")
+    d.define("max.cached.completed.user.tasks", Type.INT, 100,
+             in_range(min_value=1), _L,
+             "Maximum completed user tasks cached.")
+    return d
+
+
+def config_def() -> ConfigDef:
+    d = ConfigDef()
+    monitor_config_def(d)
+    analyzer_config_def(d)
+    executor_config_def(d)
+    anomaly_detector_config_def(d)
+    webserver_config_def(d)
+    user_task_manager_config_def(d)
+    return d
+
+
+class CruiseControlConfig(AbstractConfig):
+    """reference CC/config/KafkaCruiseControlConfig.java — parsed config with
+    cross-field sanity checks."""
+
+    def __init__(self, props: Mapping[str, Any]):
+        super().__init__(config_def(), props)
+        self._sanity_check()
+
+    def _sanity_check(self) -> None:
+        """Cross-field checks (reference
+        KafkaCruiseControlConfig.sanityCheck*)."""
+        goals = [g for g in self.get_list("goals") if g]
+        hard = [g for g in self.get_list("hard.goals") if g]
+        missing = [g for g in hard if g not in goals]
+        if missing:
+            raise ConfigException(
+                f"hard.goals {missing} are not in the goals list")
+        detection = [g for g in self.get_list("anomaly.detection.goals")
+                     if g]
+        missing = [g for g in detection if g not in goals]
+        if missing:
+            raise ConfigException(
+                f"anomaly.detection.goals {missing} are not in goals")
+        if (self.get_long("broker.failure.self.healing.threshold.ms")
+                < self.get_long("broker.failure.alert.threshold.ms")):
+            raise ConfigException(
+                "broker.failure.self.healing.threshold.ms must be >= "
+                "broker.failure.alert.threshold.ms")

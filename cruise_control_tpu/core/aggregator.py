@@ -158,6 +158,8 @@ class MetricSampleAggregator:
         self._half_min = max(1, self._min_samples // 2)
         self._metric_def = metric_def
         self._num_metrics = metric_def.size()
+        self._agg_fn_by_id = [m.aggregation_function
+                              for m in metric_def.all_metric_infos()]
 
         self._lock = threading.RLock()
         self._entity_index: Dict[Hashable, int] = {}
@@ -171,9 +173,9 @@ class MetricSampleAggregator:
         self._current_window_index: Optional[int] = None  # absolute index
         self._oldest_window_index: Optional[int] = None
         self._generation = 0
-        self._window_generations = np.zeros(self._num_slots, dtype=np.int64)
         self._completeness_cache: Dict[Tuple, MetricSampleCompleteness] = {}
         self._completeness_cache_size = completeness_cache_size
+        self._tensor_cache: Dict[Tuple, Tuple] = {}
         self._num_abandoned_samples = 0
 
     # ------------------------------------------------------------------
@@ -221,11 +223,16 @@ class MetricSampleAggregator:
         MetricSample.close() guarantees this): the per-window sample count is
         shared across metrics, so a partial sample would silently skew AVG
         (sum over fewer addends / full count) and MAX (0-baseline)."""
-        if len(sample.values) != self._num_metrics:
-            missing = set(range(self._num_metrics)) - set(sample.values)
+        if (len(sample.values) != self._num_metrics
+                or not all(0 <= int(m) < self._num_metrics
+                           for m in sample.values)):
+            expected = set(range(self._num_metrics))
+            missing = expected - set(sample.values)
+            unknown = set(sample.values) - expected
             raise ValueError(
-                f"sample for {sample.entity} must provide all "
-                f"{self._num_metrics} metrics; missing ids {sorted(missing)}")
+                f"sample for {sample.entity} must provide exactly metric ids "
+                f"0..{self._num_metrics - 1}; missing {sorted(missing)}, "
+                f"unknown {sorted(unknown)}")
         with self._lock:
             window_index = self._window_index(sample.sample_time_ms)
             if self._current_window_index is None:
@@ -238,6 +245,7 @@ class MetricSampleAggregator:
             row = self._entity_row(sample.entity)
             slot = self._slot(window_index)
             self._record(row, slot, sample)
+            self._tensor_cache.clear()
             if rolled or window_index != self._current_window_index:
                 self._bump_generation(window_index)
             return True
@@ -248,7 +256,7 @@ class MetricSampleAggregator:
     def _record(self, row: int, slot: int, sample: MetricSample) -> None:
         is_latest = sample.sample_time_ms >= self._latest[row, slot]
         for metric_id, value in sample.values.items():
-            fn = self._metric_def.metric_info(metric_id).aggregation_function
+            fn = self._agg_fn_by_id[metric_id]
             if fn is AggregationFunction.AVG:
                 self._acc[row, slot, metric_id] += value
             elif fn is AggregationFunction.MAX:
@@ -298,14 +306,12 @@ class MetricSampleAggregator:
             self._counts[:, slot] = 0
             self._acc[:, slot, :] = 0.0
             self._latest[:, slot] = -np.inf
-            self._window_generations[slot] = 0
         self._oldest_window_index = new_oldest
         self._current_window_index = window_index
         return True
 
     def _bump_generation(self, window_index: int) -> None:
         self._generation += 1
-        self._window_generations[self._slot(window_index)] = self._generation
         self._completeness_cache.clear()
 
     # ------------------------------------------------------------------
@@ -392,7 +398,22 @@ class MetricSampleAggregator:
     def _window_tensor(self, window_indices: List[int]):
         """Vectorized per-entity-per-window value + extrapolation computation
         over the given absolute window indices (RawMetricValues.aggregate
-        re-shaped: entity loop -> tensor ops)."""
+        re-shaped: entity loop -> tensor ops).
+
+        Memoized per (windows, entity count, generation): aggregate() needs
+        the same tensor _completeness_locked just computed, so the second
+        O(E*W*M) pass becomes a cache hit."""
+        key = (tuple(window_indices), len(self._entities), self._generation)
+        cached = self._tensor_cache.get(key)
+        if cached is not None:
+            return cached
+        result = self._window_tensor_uncached(window_indices)
+        if len(self._tensor_cache) >= 4:
+            self._tensor_cache.pop(next(iter(self._tensor_cache)))
+        self._tensor_cache[key] = result
+        return result
+
+    def _window_tensor_uncached(self, window_indices: List[int]):
         e = len(self._entities)
         slots = np.array([self._slot(w) for w in window_indices], dtype=np.int64)
         counts = self._counts[:e][:, slots]                      # [E, W]

@@ -1,0 +1,17 @@
+"""Monitor plane: sampling, windowed aggregation, cluster-model building.
+
+Reference: CC/monitor/ (LoadMonitor, task runner, fetchers, samplers,
+aggregators, completeness) — see SURVEY.md §2.4.
+"""
+from cruise_control_tpu.monitor.completeness import (
+    ModelCompletenessRequirements)
+from cruise_control_tpu.monitor.load_monitor import (LoadMonitor,
+                                                     LoadMonitorState,
+                                                     ModelGeneration)
+from cruise_control_tpu.monitor.task_runner import (
+    LoadMonitorTaskRunner, LoadMonitorTaskRunnerState)
+
+__all__ = [
+    "ModelCompletenessRequirements", "LoadMonitor", "LoadMonitorState",
+    "ModelGeneration", "LoadMonitorTaskRunner", "LoadMonitorTaskRunnerState",
+]

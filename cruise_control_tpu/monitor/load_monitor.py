@@ -1,0 +1,334 @@
+"""LoadMonitor: metrics in, tensorized ClusterModel out.
+
+Reference: CC/monitor/LoadMonitor.java:78-780 — owns the metadata client,
+capacity resolver, both windowed aggregators and the sampling task runner;
+`clusterModel(...)` (:518-570) refreshes metadata, aggregates partition
+samples, creates racks/brokers with resolved capacities
+(populateClusterCapacity :465-502), populates per-replica loads
+(MonitorUtils.populatePartitionLoad) and marks dead/bad brokers
+(setBadBrokerState).  A bounded semaphore throttles concurrent model
+builds (:366-377).
+
+The output here is the solver-ready tensor state (`ClusterState` +
+`ClusterTopology`) rather than a mutable object graph — the expensive
+Java-side object walk becomes a columnar build feeding device arrays.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.cluster.admin import ClusterAdminClient
+from cruise_control_tpu.cluster.metadata import MetadataClient
+from cruise_control_tpu.cluster.types import ClusterSnapshot, TopicPartition
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.config.capacity import (BrokerCapacity,
+                                                BrokerCapacityConfigResolver,
+                                                StaticCapacityResolver)
+from cruise_control_tpu.core.aggregator import (NotEnoughValidWindowsError,
+                                                ValuesAndExtrapolations)
+from cruise_control_tpu.model.builder import (ClusterModelBuilder,
+                                              ClusterTopology,
+                                              estimate_follower_cpu)
+from cruise_control_tpu.model.state import ClusterState
+from cruise_control_tpu.monitor import metricdef as MD
+from cruise_control_tpu.monitor.aggregators import (
+    BrokerMetricSampleAggregator, PartitionMetricSampleAggregator)
+from cruise_control_tpu.monitor.completeness import (
+    ModelCompletenessRequirements)
+from cruise_control_tpu.monitor.entities import PartitionEntity
+from cruise_control_tpu.monitor.sampling.fetcher import MetricFetcherManager
+from cruise_control_tpu.monitor.sampling.sample_store import (SampleLoader,
+                                                              SampleStore)
+from cruise_control_tpu.monitor.sampling.sampler import MetricSampler, Samples
+from cruise_control_tpu.monitor.task_runner import (LoadMonitorTaskRunner,
+                                                    LoadMonitorTaskRunnerState)
+
+LOG = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class ModelGeneration:
+    """(cluster metadata generation, load/aggregator generation) — staleness
+    key for model/proposal caches (reference CC/monitor/ModelGeneration.java)."""
+
+    cluster_generation: int
+    load_generation: int
+
+    def is_stale(self, other: "ModelGeneration") -> bool:
+        return (self.cluster_generation < other.cluster_generation
+                or self.load_generation < other.load_generation)
+
+
+@dataclasses.dataclass
+class LoadMonitorState:
+    """REST-visible snapshot (reference CC/monitor/LoadMonitorState.java)."""
+
+    state: str
+    num_valid_windows: int
+    total_num_windows: int
+    monitored_partitions_percentage: float
+    num_monitored_partitions: int
+    num_total_partitions: int
+    reason_of_pause: Optional[str] = None
+    last_sampling_ms: float = 0.0
+
+
+class _LoaderShim(SampleLoader):
+    def __init__(self, monitor: "LoadMonitor"):
+        self._monitor = monitor
+
+    def load_samples(self, samples: Samples) -> None:
+        self._monitor._partition_aggregator.add_partition_samples(
+            samples.partition_samples)
+        self._monitor._broker_aggregator.add_broker_samples(
+            samples.broker_samples)
+
+
+class LoadMonitor:
+    """The monitor-plane facade."""
+
+    def __init__(self, admin: ClusterAdminClient,
+                 sampler: MetricSampler,
+                 capacity_resolver: Optional[
+                     BrokerCapacityConfigResolver] = None,
+                 sample_store: Optional[SampleStore] = None,
+                 num_windows: int = 5,
+                 window_ms: float = 3_600_000,
+                 min_samples_per_window: int = 3,
+                 broker_num_windows: int = 20,
+                 sampling_interval_ms: float = 120_000,
+                 num_fetchers: int = 1,
+                 metadata_ttl_ms: float = 5_000,
+                 max_concurrent_model_builds: int = 2,
+                 time_fn: Callable[[], float] = time.time):
+        self._admin = admin
+        self._metadata = MetadataClient(admin, metadata_ttl_ms, time_fn)
+        self._capacity_resolver = (capacity_resolver
+                                   or StaticCapacityResolver())
+        self._sample_store = sample_store
+        self._time_fn = time_fn
+        self._partition_aggregator = PartitionMetricSampleAggregator(
+            num_windows, int(window_ms), min_samples_per_window)
+        self._broker_aggregator = BrokerMetricSampleAggregator(
+            broker_num_windows, int(window_ms), 1)
+        self._fetcher = MetricFetcherManager(
+            sampler, self._partition_aggregator, self._broker_aggregator,
+            sample_store, num_fetchers)
+        self.task_runner = LoadMonitorTaskRunner(
+            self._metadata, self._fetcher, sampling_interval_ms, time_fn)
+        # reference: cluster-model-creation semaphore
+        # (LoadMonitor.java:92,366-377)
+        self._model_semaphore = threading.BoundedSemaphore(
+            max_concurrent_model_builds)
+        cdef = MD.common_metric_def()
+        self._cpu_id = cdef.metric_id(MD.CPU_USAGE)
+        self._nw_in_id = cdef.metric_id(MD.LEADER_BYTES_IN)
+        self._nw_out_id = cdef.metric_id(MD.LEADER_BYTES_OUT)
+        self._disk_id = cdef.metric_id(MD.DISK_USAGE)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start_up(self, do_sampling: bool = True,
+                 skip_loading_samples: bool = False) -> None:
+        """reference LoadMonitor.startUp: reload stored samples, then start
+        the sampling loop."""
+        if self._sample_store is not None and not skip_loading_samples:
+            self.task_runner.set_loading(True)
+            try:
+                self._sample_store.load_samples(_LoaderShim(self))
+            finally:
+                self.task_runner.set_loading(False)
+        self.task_runner.start(do_sampling)
+
+    def shutdown(self) -> None:
+        self.task_runner.shutdown()
+        self._fetcher.shutdown()
+        if self._sample_store is not None:
+            self._sample_store.close()
+
+    # ------------------------------------------------------------------
+    # accessors
+    # ------------------------------------------------------------------
+    @property
+    def metadata(self) -> MetadataClient:
+        return self._metadata
+
+    @property
+    def partition_aggregator(self) -> PartitionMetricSampleAggregator:
+        return self._partition_aggregator
+
+    @property
+    def broker_aggregator(self) -> BrokerMetricSampleAggregator:
+        return self._broker_aggregator
+
+    def model_generation(self) -> ModelGeneration:
+        return ModelGeneration(self._metadata.cluster_generation,
+                               self._partition_aggregator.generation)
+
+    def pause_metric_sampling(self, reason: str) -> None:
+        self.task_runner.pause_sampling(reason)
+
+    def resume_metric_sampling(self, reason: str) -> None:
+        self.task_runner.resume_sampling(reason)
+
+    def acquire_for_model_generation(self) -> "ModelBuildPermit":
+        """reference KafkaCruiseControl.acquireForModelGeneration — bounded
+        concurrency on expensive model builds."""
+        return ModelBuildPermit(self._model_semaphore)
+
+    # ------------------------------------------------------------------
+    # completeness
+    # ------------------------------------------------------------------
+    def meet_completeness_requirements(
+            self, req: ModelCompletenessRequirements) -> bool:
+        """reference LoadMonitor.meetCompletenessRequirements :618-631."""
+        try:
+            result = self._partition_aggregator.aggregate_with_requirements(
+                self._time_fn() * 1000.0, req)
+        except NotEnoughValidWindowsError:
+            return False
+        comp = result.completeness
+        return (len(comp.valid_window_indices) >= req.min_required_num_windows
+                and comp.valid_entity_ratio
+                >= req.min_monitored_partitions_percentage)
+
+    def get_state(self) -> LoadMonitorState:
+        snapshot = self._metadata.cluster()
+        total = len(snapshot.partitions)
+        try:
+            result = self._partition_aggregator.aggregate_with_requirements(
+                self._time_fn() * 1000.0, ModelCompletenessRequirements())
+            valid_windows = len(result.completeness.valid_window_indices)
+            ratio = result.completeness.valid_entity_ratio
+            monitored = len(result.entity_values)
+        except NotEnoughValidWindowsError:
+            valid_windows, ratio, monitored = 0, 0.0, 0
+        return LoadMonitorState(
+            state=self.task_runner.state.value,
+            num_valid_windows=valid_windows,
+            total_num_windows=self._partition_aggregator.num_windows,
+            monitored_partitions_percentage=ratio,
+            num_monitored_partitions=monitored,
+            num_total_partitions=total,
+            reason_of_pause=self.task_runner.reason_of_pause,
+            last_sampling_ms=self._fetcher.last_sampling_ms)
+
+    # ------------------------------------------------------------------
+    # model building
+    # ------------------------------------------------------------------
+    def _expected_utilization(self, vae: ValuesAndExtrapolations
+                              ) -> np.ndarray:
+        """Collapse windows → one load vector: avg for CPU/NW, latest for
+        DISK (reference model/Load.java:25-120).  Window row 0 is the most
+        recent window (reference window order)."""
+        values = vae.values
+        out = np.zeros(NUM_RESOURCES, dtype=np.float64)
+        out[Resource.CPU] = values[:, self._cpu_id].mean()
+        out[Resource.NW_IN] = values[:, self._nw_in_id].mean()
+        out[Resource.NW_OUT] = values[:, self._nw_out_id].mean()
+        out[Resource.DISK] = values[0, self._disk_id]
+        return out
+
+    def cluster_model(self,
+                      requirements: Optional[
+                          ModelCompletenessRequirements] = None,
+                      allow_capacity_estimation: bool = True,
+                      now_ms: Optional[float] = None
+                      ) -> Tuple[ClusterState, ClusterTopology]:
+        """Build the tensor cluster model
+        (reference LoadMonitor.clusterModel :518-570)."""
+        req = requirements or ModelCompletenessRequirements()
+        now_ms = now_ms if now_ms is not None else self._time_fn() * 1000.0
+        t0 = time.time()
+        snapshot = self._metadata.refresh_metadata()
+        result = self._partition_aggregator.aggregate_with_requirements(
+            now_ms, req)
+        comp = result.completeness
+        if (len(comp.valid_window_indices) < req.min_required_num_windows
+                or comp.valid_entity_ratio
+                < req.min_monitored_partitions_percentage):
+            raise NotEnoughValidWindowsError(
+                f"completeness not met: {len(comp.valid_window_indices)} "
+                f"valid windows, {comp.valid_entity_ratio:.1%} monitored "
+                f"partitions (need {req.min_required_num_windows} / "
+                f"{req.min_monitored_partitions_percentage:.1%})")
+
+        builder = ClusterModelBuilder()
+        # --- brokers with resolved capacity (populateClusterCapacity) ---
+        logdirs_by_broker = self._admin.describe_log_dirs(
+            sorted(snapshot.all_broker_ids))
+        for binfo in snapshot.brokers:
+            cap = self._capacity_resolver.capacity_for_broker(
+                binfo.rack, binfo.host, binfo.broker_id,
+                allow_capacity_estimation)
+            disks = None
+            if cap.disk_capacity_by_logdir:
+                disks = dict(cap.disk_capacity_by_logdir)
+                for ld in logdirs_by_broker.get(binfo.broker_id, []):
+                    if ld.offline and ld.path in disks:
+                        disks[ld.path] = 0.0   # dead logdir
+            builder.add_broker(
+                binfo.broker_id, rack_id=binfo.rack or binfo.host,
+                capacity=cap.capacity, host=binfo.host, alive=binfo.alive,
+                disks=disks)
+
+        # --- per-partition replica loads (populatePartitionLoad) ---
+        n_skipped = 0
+        for pinfo in snapshot.partitions:
+            entity = PartitionEntity(pinfo.tp.topic, pinfo.tp.partition)
+            vae = result.entity_values.get(entity)
+            if vae is None:
+                n_skipped += 1
+                continue
+            leader_load = self._expected_utilization(vae)
+            offline = set(pinfo.offline_replicas)
+            leader = pinfo.leader
+            for broker_id in pinfo.replicas:
+                is_leader = broker_id == leader
+                if is_leader:
+                    load = leader_load
+                else:
+                    load = leader_load.copy()
+                    load[Resource.NW_OUT] = 0.0
+                    load[Resource.CPU] = estimate_follower_cpu(
+                        leader_load[Resource.CPU],
+                        leader_load[Resource.NW_IN],
+                        leader_load[Resource.NW_OUT])
+                logdir = pinfo.logdir_by_broker.get(broker_id)
+                binfo = snapshot.broker(broker_id)
+                has_jbod = (binfo is not None and logdir is not None
+                            and any(d[0] == broker_id and d[1] == logdir
+                                    for d in builder._disk_names))
+                builder.add_replica(
+                    pinfo.tp.topic, pinfo.tp.partition, broker_id,
+                    is_leader, load,
+                    offline=broker_id in offline,
+                    logdir=logdir if has_jbod else None)
+        state, topology = builder.build()
+        LOG.debug("generated cluster model in %.0f ms (B=%d P=%d R=%d, "
+                  "%d partitions without samples)",
+                  (time.time() - t0) * 1e3, state.num_brokers,
+                  state.num_partitions,
+                  int(np.asarray(state.replica_valid).sum()), n_skipped)
+        return state, topology
+
+
+class ModelBuildPermit:
+    """Context manager wrapping the model-generation semaphore."""
+
+    def __init__(self, semaphore: threading.BoundedSemaphore):
+        self._semaphore = semaphore
+
+    def __enter__(self) -> "ModelBuildPermit":
+        self._semaphore.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._semaphore.release()

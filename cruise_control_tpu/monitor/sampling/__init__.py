@@ -1,0 +1,22 @@
+"""Sampling: sampler SPI, fetchers, sample holders/serde, sample stores.
+
+Submodules are imported lazily at use sites to avoid circular imports with
+the aggregators module (fetcher ↔ aggregators).
+"""
+from cruise_control_tpu.monitor.sampling.holder import (BrokerMetricSample,
+                                                        PartitionMetricSample)
+from cruise_control_tpu.monitor.sampling.sampler import (MetricSampler,
+                                                         NoopSampler,
+                                                         Samples,
+                                                         SamplingMode,
+                                                         SimulatedClusterSampler)
+from cruise_control_tpu.monitor.sampling.sample_store import (FileSampleStore,
+                                                              NoopSampleStore,
+                                                              SampleLoader,
+                                                              SampleStore)
+
+__all__ = [
+    "BrokerMetricSample", "PartitionMetricSample", "MetricSampler",
+    "NoopSampler", "Samples", "SamplingMode", "SimulatedClusterSampler",
+    "FileSampleStore", "NoopSampleStore", "SampleLoader", "SampleStore",
+]

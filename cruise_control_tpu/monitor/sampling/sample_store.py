@@ -1,0 +1,139 @@
+"""SampleStore SPI: durable metric samples reloaded at startup.
+
+Reference: CC/monitor/sampling/SampleStore.java:1-91 — persists partition
+and broker samples so a restarted instance recovers its load history
+without waiting num_windows × window_ms; the default stores to two Kafka
+topics (KafkaSampleStore.java:85-552).  Here the default is a pair of
+append-only local files using the binary sample serde (holder.py); the
+loading path streams records back through the same SampleLoader interface.
+"""
+from __future__ import annotations
+
+import abc
+import logging
+import os
+import struct
+import threading
+from typing import Iterable, Optional
+
+from cruise_control_tpu.monitor.sampling.holder import (BrokerMetricSample,
+                                                        PartitionMetricSample)
+from cruise_control_tpu.monitor.sampling.sampler import Samples
+
+LOG = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<I")
+
+
+class SampleLoader(abc.ABC):
+    """Callback receiving reloaded samples (reference SampleStore.SampleLoader)."""
+
+    @abc.abstractmethod
+    def load_samples(self, samples: Samples) -> None:
+        ...
+
+
+class SampleStore(abc.ABC):
+    """reference SampleStore.java:1-91"""
+
+    def configure(self, configs) -> None:  # pragma: no cover - plugin hook
+        pass
+
+    @abc.abstractmethod
+    def store_samples(self, samples: Samples) -> None:
+        ...
+
+    @abc.abstractmethod
+    def load_samples(self, loader: SampleLoader) -> None:
+        ...
+
+    def evict_samples_before(self, timestamp_ms: float) -> None:
+        """Optional retention hook."""
+
+    def close(self) -> None:  # pragma: no cover
+        pass
+
+
+class NoopSampleStore(SampleStore):
+    """reference NoopSampleStore"""
+
+    def store_samples(self, samples: Samples) -> None:
+        pass
+
+    def load_samples(self, loader: SampleLoader) -> None:
+        pass
+
+
+class FileSampleStore(SampleStore):
+    """Length-prefixed binary record log per sample kind.
+
+    Two files mirror the reference's two store topics
+    (partition.metric.sample.store.topic / broker.metric.sample.store.topic,
+    KafkaSampleStore.java:117-118).
+    """
+
+    PARTITION_FILE = "partition-samples.bin"
+    BROKER_FILE = "broker-samples.bin"
+
+    def __init__(self, directory: str):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._pf = open(os.path.join(directory, self.PARTITION_FILE), "ab")
+        self._bf = open(os.path.join(directory, self.BROKER_FILE), "ab")
+
+    def store_samples(self, samples: Samples) -> None:
+        with self._lock:
+            for s in samples.partition_samples:
+                rec = s.to_bytes()
+                self._pf.write(_LEN.pack(len(rec)) + rec)
+            for s in samples.broker_samples:
+                rec = s.to_bytes()
+                self._bf.write(_LEN.pack(len(rec)) + rec)
+            self._pf.flush()
+            self._bf.flush()
+
+    @staticmethod
+    def _read_records(path: str) -> Iterable[bytes]:
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            while True:
+                head = f.read(_LEN.size)
+                if len(head) < _LEN.size:
+                    return
+                (n,) = _LEN.unpack(head)
+                rec = f.read(n)
+                if len(rec) < n:
+                    LOG.warning("truncated sample record in %s; stopping "
+                                "load", path)
+                    return
+                yield rec
+
+    def load_samples(self, loader: SampleLoader) -> None:
+        batch = Samples()
+        n_bad = 0
+        for rec in self._read_records(
+                os.path.join(self._dir, self.PARTITION_FILE)):
+            try:
+                batch.partition_samples.append(
+                    PartitionMetricSample.from_bytes(rec))
+            except (ValueError, struct.error):
+                n_bad += 1
+        for rec in self._read_records(
+                os.path.join(self._dir, self.BROKER_FILE)):
+            try:
+                batch.broker_samples.append(BrokerMetricSample.from_bytes(rec))
+            except (ValueError, struct.error):
+                n_bad += 1
+        if n_bad:
+            LOG.warning("skipped %d unreadable stored samples", n_bad)
+        loader.load_samples(batch)
+        LOG.info("loaded %d partition + %d broker samples from %s",
+                 len(batch.partition_samples), len(batch.broker_samples),
+                 self._dir)
+
+    def close(self) -> None:
+        with self._lock:
+            self._pf.close()
+            self._bf.close()

@@ -131,7 +131,7 @@ def test_too_old_sample_rejected():
 def test_partial_sample_rejected():
     agg = make_aggregator()
     e = IntegerEntity("g", 0)
-    with pytest.raises(ValueError, match="missing ids"):
+    with pytest.raises(ValueError, match="missing"):
         agg.add_sample(MetricSample(e, 100, {0: 1.0}))
 
 
