@@ -359,6 +359,10 @@ class SimulatedCluster(ClusterAdminClient):
             for part in self._partitions.values():
                 if part.target is None:
                     continue
+                # replication to a dead destination makes no progress
+                if any(b not in self._brokers or not self._brokers[b].alive
+                       for b in part.target if b not in part.replicas):
+                    continue
                 part.moved_bytes += self._effective_rate(part) * dt
                 if part.moved_bytes >= part.move_total_bytes:
                     self._complete_move(part)

@@ -100,26 +100,35 @@ class BrokerCapacityConfigFileResolver(BrokerCapacityConfigResolver):
     @staticmethod
     def _parse(entry: Mapping, broker_id: int) -> BrokerCapacity:
         cap_doc = entry["capacity"]
+        # every resource must be present: a silent 0.0 capacity would make
+        # capacity goals perpetually violated (the reference resolver
+        # likewise rejects incomplete entries)
+        missing = [k for k in ("DISK", "CPU", "NW_IN", "NW_OUT")
+                   if k not in cap_doc]
+        if missing:
+            raise ValueError(
+                f"capacity entry for broker {broker_id} is missing "
+                f"resource(s) {missing}")
         caps = [0.0] * NUM_RESOURCES
         disk_by_logdir = None
         num_cores = 1.0
 
-        disk = cap_doc.get("DISK", 0.0)
+        disk = cap_doc["DISK"]
         if isinstance(disk, Mapping):  # JBOD per-logdir map
             disk_by_logdir = {str(k): float(v) for k, v in disk.items()}
             caps[Resource.DISK] = sum(disk_by_logdir.values())
         else:
             caps[Resource.DISK] = float(disk)
 
-        cpu = cap_doc.get("CPU", 100.0)
+        cpu = cap_doc["CPU"]
         if isinstance(cpu, Mapping):  # capacityCores.json flavor
             num_cores = float(cpu.get("num.cores", 1))
             caps[Resource.CPU] = 100.0 * num_cores
         else:
             caps[Resource.CPU] = float(cpu)
 
-        caps[Resource.NW_IN] = float(cap_doc.get("NW_IN", 0.0))
-        caps[Resource.NW_OUT] = float(cap_doc.get("NW_OUT", 0.0))
+        caps[Resource.NW_IN] = float(cap_doc["NW_IN"])
+        caps[Resource.NW_OUT] = float(cap_doc["NW_OUT"])
         return BrokerCapacity(tuple(caps), disk_by_logdir, num_cores,
                               is_estimated=False)
 

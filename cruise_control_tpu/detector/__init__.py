@@ -1,0 +1,37 @@
+"""Detector plane: anomaly detection and self-healing (SURVEY.md §2.6)."""
+from cruise_control_tpu.detector.anomalies import (BrokerFailures,
+                                                   DiskFailures,
+                                                   GoalViolations,
+                                                   SlowBrokers, TopicAnomaly)
+from cruise_control_tpu.detector.anomaly_detector import AnomalyDetector
+from cruise_control_tpu.detector.broker_failure import (BrokerFailureDetector,
+                                                        FailedBrokerStore,
+                                                        FileFailedBrokerStore)
+from cruise_control_tpu.detector.detector_state import (AnomalyDetectorState,
+                                                        AnomalyState)
+from cruise_control_tpu.detector.disk_failure import DiskFailureDetector
+from cruise_control_tpu.detector.goal_violation import (GoalViolationDetector,
+                                                        balancedness_score)
+from cruise_control_tpu.detector.metric_anomaly import MetricAnomalyDetector
+from cruise_control_tpu.detector.notifier import (AnomalyNotificationResult,
+                                                  AnomalyNotifier,
+                                                  NoopNotifier,
+                                                  NotificationAction,
+                                                  SelfHealingNotifier,
+                                                  WebhookSelfHealingNotifier)
+from cruise_control_tpu.detector.slow_broker import (SlowBrokerFinder,
+                                                     SlowBrokerFinderConfig)
+from cruise_control_tpu.detector.topic_anomaly import (
+    PartitionSizeAnomalyFinder, TopicReplicationFactorAnomalyFinder)
+
+__all__ = [
+    "AnomalyDetector", "AnomalyDetectorState", "AnomalyState",
+    "AnomalyNotifier", "AnomalyNotificationResult", "NotificationAction",
+    "NoopNotifier", "SelfHealingNotifier", "WebhookSelfHealingNotifier",
+    "BrokerFailureDetector", "FailedBrokerStore", "FileFailedBrokerStore",
+    "DiskFailureDetector", "GoalViolationDetector", "balancedness_score",
+    "MetricAnomalyDetector", "SlowBrokerFinder", "SlowBrokerFinderConfig",
+    "TopicReplicationFactorAnomalyFinder", "PartitionSizeAnomalyFinder",
+    "BrokerFailures", "DiskFailures", "GoalViolations", "SlowBrokers",
+    "TopicAnomaly",
+]

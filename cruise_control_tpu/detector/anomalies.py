@@ -1,0 +1,172 @@
+"""Concrete anomaly types.
+
+Reference CC/detector/ anomaly classes (GoalViolations.java:1-130,
+BrokerFailures.java, DiskFailures.java, SlowBrokers.java,
+TopicReplicationFactorAnomaly.java): each anomaly carries enough context to
+describe itself and a fix callable that routes through the normal
+optimize+execute path (self-healing reuses the rebalance machinery,
+SURVEY.md §3.5).  Fix callables are injected by whoever wires the detector
+(the facade), keeping the detector plane free of circular dependencies.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import uuid as _uuid
+from typing import Callable, Dict, List, Mapping, Optional, Sequence
+
+from cruise_control_tpu.core.anomaly import Anomaly, AnomalyType
+
+#: a self-healing action: returns True if a fix was started
+FixFn = Callable[[], bool]
+
+
+def _new_id(prefix: str) -> str:
+    return f"{prefix}-{_uuid.uuid4().hex[:12]}"
+
+
+@dataclasses.dataclass
+class GoalViolations(Anomaly):
+    """Detection goals found violations (reference GoalViolations.java).
+
+    `fixable_violated_goals` get self-healed by one rebalance run over the
+    full configured goal list; `unfixable` ones are only reported."""
+
+    fixable_violated_goals: List[str]
+    unfixable_violated_goals: List[str]
+    fix_fn: Optional[FixFn] = None
+    detected_ms: float = 0.0
+    _id: str = dataclasses.field(default_factory=lambda: _new_id("goal-viol"))
+
+    @property
+    def anomaly_type(self) -> AnomalyType:
+        return AnomalyType.GOAL_VIOLATION
+
+    @property
+    def anomaly_id(self) -> str:
+        return self._id
+
+    def fix(self) -> bool:
+        if self.fix_fn is None or not self.fixable_violated_goals:
+            return False
+        return self.fix_fn()
+
+    def __str__(self) -> str:
+        return (f"GoalViolations(fixable={self.fixable_violated_goals}, "
+                f"unfixable={self.unfixable_violated_goals})")
+
+
+@dataclasses.dataclass
+class BrokerFailures(Anomaly):
+    """Dead brokers with their first-observed failure times
+    (reference BrokerFailures.java)."""
+
+    failed_brokers_by_time_ms: Dict[int, float]
+    fix_fn: Optional[FixFn] = None
+    detected_ms: float = 0.0
+    _id: str = dataclasses.field(
+        default_factory=lambda: _new_id("broker-failure"))
+
+    @property
+    def anomaly_type(self) -> AnomalyType:
+        return AnomalyType.BROKER_FAILURE
+
+    @property
+    def anomaly_id(self) -> str:
+        return self._id
+
+    def fix(self) -> bool:
+        if self.fix_fn is None or not self.failed_brokers_by_time_ms:
+            return False
+        return self.fix_fn()
+
+    def __str__(self) -> str:
+        return f"BrokerFailures({sorted(self.failed_brokers_by_time_ms)})"
+
+
+@dataclasses.dataclass
+class DiskFailures(Anomaly):
+    """Offline logdirs by broker (reference DiskFailures.java)."""
+
+    failed_disks_by_broker: Dict[int, List[str]]
+    fix_fn: Optional[FixFn] = None
+    detected_ms: float = 0.0
+    _id: str = dataclasses.field(
+        default_factory=lambda: _new_id("disk-failure"))
+
+    @property
+    def anomaly_type(self) -> AnomalyType:
+        return AnomalyType.DISK_FAILURE
+
+    @property
+    def anomaly_id(self) -> str:
+        return self._id
+
+    def fix(self) -> bool:
+        if self.fix_fn is None or not self.failed_disks_by_broker:
+            return False
+        return self.fix_fn()
+
+    def __str__(self) -> str:
+        return f"DiskFailures({self.failed_disks_by_broker})"
+
+
+@dataclasses.dataclass
+class SlowBrokers(Anomaly):
+    """Brokers judged slow by the slowness score, with the recommended
+    remediation (reference SlowBrokers.java + SlowBrokerFinder escalation:
+    demote first, remove when persistent)."""
+
+    slow_brokers_by_time_ms: Dict[int, float]
+    remove_slow_brokers: bool        # False => demote
+    fix_fn: Optional[FixFn] = None
+    detected_ms: float = 0.0
+    _id: str = dataclasses.field(
+        default_factory=lambda: _new_id("slow-broker"))
+
+    @property
+    def anomaly_type(self) -> AnomalyType:
+        return AnomalyType.METRIC_ANOMALY
+
+    @property
+    def anomaly_id(self) -> str:
+        return self._id
+
+    def fix(self) -> bool:
+        if self.fix_fn is None or not self.slow_brokers_by_time_ms:
+            return False
+        return self.fix_fn()
+
+    def __str__(self) -> str:
+        verb = "remove" if self.remove_slow_brokers else "demote"
+        return f"SlowBrokers({sorted(self.slow_brokers_by_time_ms)}, {verb})"
+
+
+@dataclasses.dataclass
+class TopicAnomaly(Anomaly):
+    """Topics violating a policy — e.g. replication factor != target
+    (reference TopicReplicationFactorAnomaly.java) or oversized partitions
+    (PartitionSizeAnomalyFinder)."""
+
+    description: str
+    topics: List[str]
+    fix_fn: Optional[FixFn] = None
+    detected_ms: float = 0.0
+    _id: str = dataclasses.field(
+        default_factory=lambda: _new_id("topic-anomaly"))
+
+    @property
+    def anomaly_type(self) -> AnomalyType:
+        return AnomalyType.TOPIC_ANOMALY
+
+    @property
+    def anomaly_id(self) -> str:
+        return self._id
+
+    def fix(self) -> bool:
+        if self.fix_fn is None:
+            return False
+        return self.fix_fn()
+
+    def __str__(self) -> str:
+        return f"TopicAnomaly({self.description}, topics={self.topics})"

@@ -1,0 +1,207 @@
+"""Anomaly-detector coordinator.
+
+Reference CC/detector/AnomalyDetector.java:50-564: detectors push anomalies
+into a priority queue (priority = AnomalyType order, FIFO within type); the
+handler takes each anomaly, consults the notifier (FIX / CHECK-later /
+IGNORE), and for FIX starts the anomaly's self-healing runnable — unless the
+load monitor isn't ready or another fix is in flight.  Scheduled detectors
+(goal-violation, metric, disk, topic) run at configurable intervals with
+jitter; the broker-failure detector is event-driven.
+
+Re-design: detection sweeps and queue handling are explicit `*_once` methods
+driven either by the built-in scheduler thread (wall-clock deployments) or
+directly by tests/demos with a virtual clock — same state machine either
+way.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import random
+import threading
+import time as _time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from cruise_control_tpu.core.anomaly import Anomaly, AnomalyType
+from cruise_control_tpu.detector.detector_state import (AnomalyDetectorState,
+                                                        AnomalyState)
+from cruise_control_tpu.detector.notifier import (AnomalyNotificationResult,
+                                                  AnomalyNotifier,
+                                                  NoopNotifier)
+
+LOG = logging.getLogger(__name__)
+
+#: a detector with a `detect_now()` method
+ScheduledDetector = object
+
+
+class AnomalyDetector:
+    def __init__(self,
+                 notifier: Optional[AnomalyNotifier] = None,
+                 ready_fn: Optional[Callable[[], bool]] = None,
+                 fix_in_progress_fn: Optional[Callable[[], bool]] = None,
+                 num_cached_recent_anomaly_states: int = 10,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._notifier = notifier or NoopNotifier()
+        #: load-monitor readiness gate (reference checks LoadMonitor state)
+        self._ready = ready_fn or (lambda: True)
+        #: executor-busy gate (one self-healing fix at a time)
+        self._fix_in_progress = fix_in_progress_fn or (lambda: False)
+        self._time = time_fn or _time.time
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        #: heap of (type priority, seq, anomaly)
+        self._queue: List[Tuple[int, int, Anomaly]] = []
+        #: deferred CHECK-later anomalies: (due_ms, seq, anomaly)
+        self._deferred: List[Tuple[float, int, Anomaly]] = []
+        self.state = AnomalyDetectorState(num_cached_recent_anomaly_states)
+        self._detectors: List[Tuple[ScheduledDetector, float, float]] = []
+        self._scheduler: Optional[threading.Thread] = None
+        self._shutdown = threading.Event()
+
+    # ------------------------------------------------------------------
+    # intake (detectors call this as their report_fn)
+    # ------------------------------------------------------------------
+    def report(self, anomaly: Anomaly) -> None:
+        now_ms = self._time() * 1000.0
+        with self._lock:
+            heapq.heappush(self._queue,
+                           (anomaly.anomaly_type.value, next(self._seq),
+                            anomaly))
+        self.state.on_detected(anomaly, now_ms)
+
+    @property
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._queue) + len(self._deferred)
+
+    # ------------------------------------------------------------------
+    # scheduled detection
+    # ------------------------------------------------------------------
+    def register_detector(self, detector: ScheduledDetector,
+                          interval_s: float) -> None:
+        """Register an object with detect_now() to run every interval_s
+        (first run jittered into [0, interval) like the reference's
+        scheduleAtFixedRate initial delays :190-222)."""
+        first_due = self._time() + random.random() * interval_s
+        self._detectors.append([detector, interval_s, first_due])
+
+    def run_detection_once(self) -> None:
+        """Run every registered detector immediately (test/demo surface)."""
+        for entry in self._detectors:
+            entry[0].detect_now()
+
+    def _run_due_detections(self) -> None:
+        now = self._time()
+        for entry in self._detectors:
+            detector, interval, due = entry
+            if now >= due:
+                try:
+                    detector.detect_now()
+                except Exception:  # noqa: BLE001 - keep the schedule alive
+                    LOG.exception("detector %s failed",
+                                  type(detector).__name__)
+                entry[2] = now + interval
+
+    # ------------------------------------------------------------------
+    # handling (reference AnomalyHandlerTask :322-470)
+    # ------------------------------------------------------------------
+    def process_once(self) -> Optional[AnomalyState]:
+        """Handle the highest-priority pending anomaly; returns its final
+        handling status, or None if nothing was due."""
+        now_ms = self._time() * 1000.0
+        with self._lock:
+            while self._deferred and self._deferred[0][0] <= now_ms:
+                _, seq, anomaly = heapq.heappop(self._deferred)
+                heapq.heappush(self._queue,
+                               (anomaly.anomaly_type.value, seq, anomaly))
+            if not self._queue:
+                return None
+            _, _, anomaly = heapq.heappop(self._queue)
+        return self._handle(anomaly, now_ms)
+
+    def process_all(self) -> List[AnomalyState]:
+        out = []
+        while True:
+            st = self.process_once()
+            if st is None:
+                return out
+            out.append(st)
+
+    def _handle(self, anomaly: Anomaly, now_ms: float) -> AnomalyState:
+        action = self._notifier.on_anomaly(anomaly)
+        if action.result == AnomalyNotificationResult.IGNORE:
+            status = AnomalyState.IGNORED
+        elif action.result == AnomalyNotificationResult.CHECK:
+            with self._lock:
+                heapq.heappush(self._deferred,
+                               (now_ms + action.delay_ms, next(self._seq),
+                                anomaly))
+            status = AnomalyState.CHECK_WITH_DELAY
+        else:  # FIX
+            if not self._ready():
+                # monitor still warming up: keep the anomaly alive —
+                # event-driven detectors (broker failures) won't re-report
+                with self._lock:
+                    heapq.heappush(self._deferred,
+                                   (now_ms + 10_000.0, next(self._seq),
+                                    anomaly))
+                status = AnomalyState.LOAD_MONITOR_NOT_READY
+            elif self._fix_in_progress():
+                # re-check shortly: another fix is executing
+                with self._lock:
+                    heapq.heappush(self._deferred,
+                                   (now_ms + 10_000.0, next(self._seq),
+                                    anomaly))
+                status = AnomalyState.CHECK_WITH_DELAY
+            else:
+                try:
+                    started = anomaly.fix()
+                except Exception:  # noqa: BLE001 - fix failure is a status
+                    LOG.exception("fix for %s raised", anomaly.anomaly_id)
+                    started = False
+                status = (AnomalyState.FIX_STARTED if started
+                          else AnomalyState.FIX_FAILED_TO_START)
+        self.state.on_status(anomaly, status, now_ms)
+        return status
+
+    # ------------------------------------------------------------------
+    # background scheduler (wall-clock deployments)
+    # ------------------------------------------------------------------
+    def start(self, tick_s: float = 1.0) -> None:
+        if self._scheduler is not None:
+            return
+        self._shutdown.clear()
+
+        def loop() -> None:
+            while not self._shutdown.is_set():
+                try:
+                    self._run_due_detections()
+                    while self.process_once() is not None:
+                        pass
+                except Exception:  # noqa: BLE001
+                    LOG.exception("anomaly handler iteration failed")
+                self._shutdown.wait(tick_s)
+
+        self._scheduler = threading.Thread(target=loop,
+                                           name="anomaly-detector",
+                                           daemon=True)
+        self._scheduler.start()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._scheduler is not None:
+            self._scheduler.join(timeout=10.0)
+            self._scheduler = None
+
+    # ------------------------------------------------------------------
+    def self_healing_enabled(self) -> Dict[AnomalyType, bool]:
+        return self._notifier.self_healing_enabled()
+
+    def set_self_healing_for(self, anomaly_type: AnomalyType,
+                             enabled: bool) -> bool:
+        return self._notifier.set_self_healing_for(anomaly_type, enabled)
+
+    def to_json(self) -> dict:
+        return self.state.to_json(self._notifier.self_healing_enabled())

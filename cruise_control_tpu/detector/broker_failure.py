@@ -1,0 +1,124 @@
+"""Broker-failure detector.
+
+Reference CC/detector/BrokerFailureDetector.java:44-237: subscribes to the
+cluster's liveness watch (ZK /brokers/ids child watch there; the
+ClusterAdminClient liveness listener here), keeps the set of failed brokers
+with their first-observed failure time, persists that table so failure ages
+survive restarts (reference persisted a ZK znode; here a pluggable store,
+default file-backed JSON), and gates fixability on count/percentage
+thresholds.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time as _time
+from typing import Callable, Dict, Optional, Set
+
+from cruise_control_tpu.cluster.admin import ClusterAdminClient
+from cruise_control_tpu.detector.anomalies import BrokerFailures, FixFn
+
+LOG = logging.getLogger(__name__)
+
+
+class FailedBrokerStore:
+    """Persistence SPI for failure times (reference's ZK-path persistence)."""
+
+    def load(self) -> Dict[int, float]:
+        return {}
+
+    def save(self, failed: Dict[int, float]) -> None:
+        pass
+
+
+class FileFailedBrokerStore(FailedBrokerStore):
+    def __init__(self, path: str) -> None:
+        self._path = path
+
+    def load(self) -> Dict[int, float]:
+        try:
+            with open(self._path) as f:
+                return {int(k): float(v) for k, v in json.load(f).items()}
+        except (OSError, ValueError):
+            return {}
+
+    def save(self, failed: Dict[int, float]) -> None:
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({str(k): v for k, v in failed.items()}, f)
+        os.replace(tmp, self._path)
+
+
+class BrokerFailureDetector:
+    """Event-driven detector; reports via a queue-insert callback."""
+
+    def __init__(self, admin: ClusterAdminClient,
+                 report_fn: Callable[[BrokerFailures], None],
+                 fix_fn: Optional[FixFn] = None,
+                 store: Optional[FailedBrokerStore] = None,
+                 fixable_max_count: int = 10,
+                 fixable_max_ratio: float = 0.4,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._admin = admin
+        self._report = report_fn
+        self._fix_fn = fix_fn
+        self._store = store or FailedBrokerStore()
+        self._fixable_max_count = fixable_max_count
+        self._fixable_max_ratio = fixable_max_ratio
+        self._time = time_fn or _time.time
+        self._lock = threading.Lock()
+        self._failed: Dict[int, float] = self._store.load()
+        self._listener = self._on_liveness_change
+        self._started = False
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._admin.add_liveness_listener(self._listener)
+        self._started = True
+        self.detect_now()   # catch failures that predate the watch
+
+    def shutdown(self) -> None:
+        if self._started:
+            self._admin.remove_liveness_listener(self._listener)
+            self._started = False
+
+    def failed_brokers(self) -> Dict[int, float]:
+        with self._lock:
+            return dict(self._failed)
+
+    # ------------------------------------------------------------------
+    def detect_now(self) -> None:
+        snapshot = self._admin.describe_cluster()
+        self._update(snapshot.alive_broker_ids, snapshot.all_broker_ids)
+
+    def _on_liveness_change(self, alive: Set[int]) -> None:
+        snapshot = self._admin.describe_cluster()
+        self._update(alive, snapshot.all_broker_ids)
+
+    def _update(self, alive: Set[int], all_ids: Set[int]) -> None:
+        now_ms = self._time() * 1000.0
+        with self._lock:
+            dead = set(all_ids) - set(alive)
+            # new failures keep their first-observed time
+            for b in dead:
+                self._failed.setdefault(b, now_ms)
+            # recovered brokers drop out
+            for b in list(self._failed):
+                if b not in dead:
+                    del self._failed[b]
+            failed = dict(self._failed)
+            self._store.save(failed)
+            total = max(1, len(all_ids))
+        if failed:
+            fixable = (len(failed) <= self._fixable_max_count
+                       and len(failed) / total <= self._fixable_max_ratio)
+            if not fixable:
+                LOG.warning(
+                    "%d/%d brokers failed — beyond self-healing thresholds, "
+                    "reporting without fix", len(failed), total)
+            self._report(BrokerFailures(
+                failed_brokers_by_time_ms=failed,
+                fix_fn=self._fix_fn if fixable else None,
+                detected_ms=now_ms))

@@ -1,0 +1,91 @@
+"""Anomaly-detector state for the STATE endpoint.
+
+Reference CC/detector/AnomalyDetectorState.java:1-403 — ring buffers of
+recent anomalies per type with their handling status, plus self-healing
+enabled/disabled flags and counters.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import enum
+import threading
+from typing import Deque, Dict, List, Optional
+
+from cruise_control_tpu.core.anomaly import Anomaly, AnomalyType
+
+
+class AnomalyState(enum.Enum):
+    DETECTED = "DETECTED"
+    CHECK_WITH_DELAY = "CHECK_WITH_DELAY"
+    IGNORED = "IGNORED"
+    FIX_STARTED = "FIX_STARTED"
+    FIX_FAILED_TO_START = "FIX_FAILED_TO_START"
+    LOAD_MONITOR_NOT_READY = "LOAD_MONITOR_NOT_READY"
+    COMPLETENESS_NOT_READY = "COMPLETENESS_NOT_READY"
+
+
+@dataclasses.dataclass
+class AnomalyRecord:
+    anomaly_id: str
+    anomaly_type: AnomalyType
+    description: str
+    status: AnomalyState
+    detected_ms: float
+    status_update_ms: float
+
+
+class AnomalyDetectorState:
+    def __init__(self, num_cached_recent_anomaly_states: int = 10) -> None:
+        self._lock = threading.Lock()
+        self._recent: Dict[AnomalyType, Deque[AnomalyRecord]] = {
+            t: collections.deque(maxlen=num_cached_recent_anomaly_states)
+            for t in AnomalyType}
+        self._metrics: Dict[str, int] = collections.defaultdict(int)
+
+    def on_detected(self, anomaly: Anomaly, now_ms: float) -> None:
+        with self._lock:
+            self._recent[anomaly.anomaly_type].append(AnomalyRecord(
+                anomaly.anomaly_id, anomaly.anomaly_type, str(anomaly),
+                AnomalyState.DETECTED, now_ms, now_ms))
+            self._metrics[f"{anomaly.anomaly_type.name}-detected"] += 1
+
+    def on_status(self, anomaly: Anomaly, status: AnomalyState,
+                  now_ms: float) -> None:
+        with self._lock:
+            for rec in self._recent[anomaly.anomaly_type]:
+                if rec.anomaly_id == anomaly.anomaly_id:
+                    rec.status = status
+                    rec.status_update_ms = now_ms
+                    break
+            self._metrics[f"{anomaly.anomaly_type.name}-"
+                          f"{status.name.lower()}"] += 1
+
+    def recent_anomalies(self, anomaly_type: AnomalyType
+                         ) -> List[AnomalyRecord]:
+        with self._lock:
+            return list(self._recent[anomaly_type])
+
+    def metrics(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._metrics)
+
+    def to_json(self, self_healing_enabled: Dict[AnomalyType, bool]) -> dict:
+        with self._lock:
+            return {
+                "selfHealingEnabled": [t.name for t, on in
+                                       self_healing_enabled.items() if on],
+                "selfHealingDisabled": [t.name for t, on in
+                                        self_healing_enabled.items()
+                                        if not on],
+                "recentAnomalies": {
+                    t.name: [{
+                        "anomalyId": r.anomaly_id,
+                        "description": r.description,
+                        "status": r.status.value,
+                        "detectionMs": r.detected_ms,
+                        "statusUpdateMs": r.status_update_ms,
+                    } for r in recs]
+                    for t, recs in self._recent.items()},
+                "metrics": dict(self._metrics),
+            }

@@ -1,0 +1,41 @@
+"""Disk-failure detector (JBOD).
+
+Reference CC/detector/DiskFailureDetector.java:1-123: periodically calls
+describeLogDirs on alive brokers and raises a DiskFailures anomaly for any
+offline logdir.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Dict, List, Optional
+
+from cruise_control_tpu.cluster.admin import ClusterAdminClient
+from cruise_control_tpu.detector.anomalies import DiskFailures, FixFn
+
+
+class DiskFailureDetector:
+    def __init__(self, admin: ClusterAdminClient,
+                 report_fn: Callable[[DiskFailures], None],
+                 fix_fn: Optional[FixFn] = None,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._admin = admin
+        self._report = report_fn
+        self._fix_fn = fix_fn
+        self._time = time_fn or _time.time
+
+    def detect_now(self) -> Optional[DiskFailures]:
+        snapshot = self._admin.describe_cluster()
+        logdirs = self._admin.describe_log_dirs(
+            sorted(snapshot.alive_broker_ids))
+        failed: Dict[int, List[str]] = {}
+        for broker_id, dirs in logdirs.items():
+            offline = [d.path for d in dirs if d.offline]
+            if offline:
+                failed[broker_id] = offline
+        if not failed:
+            return None
+        anomaly = DiskFailures(
+            failed_disks_by_broker=failed, fix_fn=self._fix_fn,
+            detected_ms=self._time() * 1000.0)
+        self._report(anomaly)
+        return anomaly

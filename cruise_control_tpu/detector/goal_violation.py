@@ -1,0 +1,108 @@
+"""Goal-violation detector.
+
+Reference CC/detector/GoalViolationDetector.java:49-277: periodically builds
+a cluster model and evaluates a separate *detection* goal list against it —
+no optimization, just the per-goal violation predicate — reporting a
+GoalViolations anomaly and a balancedness score [0, 100].
+
+TPU note: violation predicates are the goals' `violated_brokers` kernels
+(vectorized reductions over broker-load tensors), so a detection sweep is a
+single fused device computation per goal rather than the reference's
+per-broker Java loops.
+"""
+from __future__ import annotations
+
+import logging
+import time as _time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions,
+                                                 make_context,
+                                                 make_round_cache)
+from cruise_control_tpu.analyzer.goals.base import Goal
+from cruise_control_tpu.detector.anomalies import FixFn, GoalViolations
+
+LOG = logging.getLogger(__name__)
+
+
+def balancedness_score(goals: Sequence[Goal], violated: Sequence[str],
+                       priority_weight: float = 1.1,
+                       strictness_weight: float = 1.5) -> float:
+    """[0, 100]: weighted fraction of satisfied goals (reference
+    GoalViolationDetector balancedness + AnomalyDetector.java:176-178 gauge;
+    weights from goal.balancedness.priority.weight /
+    goal.balancedness.strictness.weight).  Hard goals weigh
+    `strictness_weight`× more; higher-priority goals weigh more through
+    `priority_weight^rank`."""
+    if not goals:
+        return 100.0
+    weights = []
+    for rank, goal in enumerate(goals):
+        w = priority_weight ** (len(goals) - 1 - rank)
+        if goal.is_hard:
+            w *= strictness_weight
+        weights.append(w)
+    total = sum(weights)
+    violated_set = set(violated)
+    lost = sum(w for goal, w in zip(goals, weights)
+               if goal.name in violated_set)
+    return 100.0 * (1.0 - lost / total)
+
+
+class GoalViolationDetector:
+    """Scheduled detector; `detect_now` runs one sweep."""
+
+    def __init__(self, load_monitor,
+                 detection_goals: Sequence[Goal],
+                 report_fn: Callable[[GoalViolations], None],
+                 fix_fn: Optional[FixFn] = None,
+                 constraint: Optional[BalancingConstraint] = None,
+                 options: Optional[OptimizationOptions] = None,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._load_monitor = load_monitor
+        self._goals = list(detection_goals)
+        self._report = report_fn
+        self._fix_fn = fix_fn
+        self._constraint = constraint or BalancingConstraint()
+        self._options = options or OptimizationOptions(
+            is_triggered_by_goal_violation=True)
+        self._time = time_fn or _time.time
+        self._last_score: float = 100.0
+
+    @property
+    def last_balancedness_score(self) -> float:
+        return self._last_score
+
+    def detect_now(self) -> Optional[GoalViolations]:
+        try:
+            state, topology = self._load_monitor.cluster_model()
+        except Exception as exc:  # noqa: BLE001 - not enough data yet
+            LOG.debug("skipping goal-violation sweep: %s", exc)
+            return None
+        ctx = make_context(state, self._constraint, self._options, topology)
+        cache = make_round_cache(state)
+        # a violation is unfixable when no alive broker may receive
+        # replicas (nothing the optimizer may touch) — goal-independent
+        can_move = bool((np.asarray(state.broker_alive)
+                         & np.asarray(ctx.broker_dest_ok)).any())
+        fixable: List[str] = []
+        unfixable: List[str] = []
+        for goal in self._goals:
+            violated = bool(np.asarray(
+                goal.violated_brokers(state, ctx, cache)).any())
+            if violated:
+                (fixable if can_move else unfixable).append(goal.name)
+        self._last_score = balancedness_score(
+            self._goals, fixable + unfixable)
+        if not fixable and not unfixable:
+            return None
+        anomaly = GoalViolations(
+            fixable_violated_goals=fixable,
+            unfixable_violated_goals=unfixable,
+            fix_fn=self._fix_fn,
+            detected_ms=self._time() * 1000.0)
+        self._report(anomaly)
+        return anomaly

@@ -1,0 +1,38 @@
+"""Metric-anomaly detector.
+
+Reference CC/detector/MetricAnomalyDetector.java: runs the configured
+MetricAnomalyFinder plugins (default: the percentile finder from core) over
+the broker metric history and queues every anomaly found.
+"""
+from __future__ import annotations
+
+from typing import Callable, Hashable, List, Mapping, Optional, Sequence, Tuple
+
+from cruise_control_tpu.core.aggregator import ValuesAndExtrapolations
+from cruise_control_tpu.core.anomaly import (MetricAnomaly,
+                                             MetricAnomalyFinder)
+
+#: supplies (history_by_broker, current_window_by_broker)
+HistorySupplier = Callable[[], Tuple[
+    Mapping[Hashable, ValuesAndExtrapolations],
+    Mapping[Hashable, ValuesAndExtrapolations]]]
+
+
+class MetricAnomalyDetector:
+    def __init__(self, history_supplier: HistorySupplier,
+                 finders: Sequence[MetricAnomalyFinder],
+                 report_fn: Callable[[MetricAnomaly], None]) -> None:
+        self._supplier = history_supplier
+        self._finders = list(finders)
+        self._report = report_fn
+
+    def detect_now(self) -> List[MetricAnomaly]:
+        history, current = self._supplier()
+        if not history or not current:
+            return []
+        out: List[MetricAnomaly] = []
+        for finder in self._finders:
+            for anomaly in finder.metric_anomalies(history, current):
+                out.append(anomaly)
+                self._report(anomaly)
+        return out

@@ -1,0 +1,179 @@
+"""Anomaly notifier SPI.
+
+Reference CC/detector/notifier/: AnomalyNotifier decides per anomaly whether
+to FIX now, CHECK again after a delay, or IGNORE.  SelfHealingNotifier
+(SelfHealingNotifier.java:1-306) adds per-type self-healing enable flags and
+a broker-failure grace period (alert threshold, then auto-fix threshold).
+SlackSelfHealingNotifier (SlackSelfHealingNotifier.java:1-94) posts
+alerts through a webhook; here the transport is an injected callable so the
+framework stays dependency-free (zero egress in CI).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import time as _time
+from collections import OrderedDict
+from typing import Callable, Dict, Optional
+
+from cruise_control_tpu.core.anomaly import Anomaly, AnomalyType
+from cruise_control_tpu.detector.anomalies import BrokerFailures
+
+LOG = logging.getLogger(__name__)
+
+
+class AnomalyNotificationResult(enum.Enum):
+    FIX = "FIX"
+    CHECK = "CHECK"
+    IGNORE = "IGNORE"
+
+
+@dataclasses.dataclass(frozen=True)
+class NotificationAction:
+    result: AnomalyNotificationResult
+    #: for CHECK: re-examine after this many ms
+    delay_ms: float = 0.0
+
+    @staticmethod
+    def fix() -> "NotificationAction":
+        return NotificationAction(AnomalyNotificationResult.FIX)
+
+    @staticmethod
+    def check(delay_ms: float) -> "NotificationAction":
+        return NotificationAction(AnomalyNotificationResult.CHECK, delay_ms)
+
+    @staticmethod
+    def ignore() -> "NotificationAction":
+        return NotificationAction(AnomalyNotificationResult.IGNORE)
+
+
+class AnomalyNotifier:
+    """SPI — reference AnomalyNotifier.java."""
+
+    def on_anomaly(self, anomaly: Anomaly) -> NotificationAction:
+        raise NotImplementedError
+
+    def self_healing_enabled(self) -> Dict[AnomalyType, bool]:
+        return {t: False for t in AnomalyType}
+
+    def set_self_healing_for(self, anomaly_type: AnomalyType,
+                             enabled: bool) -> bool:
+        """Returns the previous value."""
+        return False
+
+
+class NoopNotifier(AnomalyNotifier):
+    """Ignore everything (reference NoopNotifier.java)."""
+
+    def on_anomaly(self, anomaly: Anomaly) -> NotificationAction:
+        return NotificationAction.ignore()
+
+
+class SelfHealingNotifier(AnomalyNotifier):
+    """Grace-period + per-type-gated self-healing
+    (reference SelfHealingNotifier.java).
+
+    Broker failures honor two thresholds from the first failure time:
+    before `alert_threshold_ms` nothing happens (transient restarts);
+    between the thresholds an alert fires and the anomaly is re-CHECKed;
+    after `auto_fix_threshold_ms` the fix starts.  Other anomaly types fix
+    immediately when their type's self-healing is enabled.
+    """
+
+    DEFAULT_ALERT_THRESHOLD_MS = 15 * 60 * 1000.0
+    DEFAULT_AUTO_FIX_THRESHOLD_MS = 30 * 60 * 1000.0
+
+    def __init__(self,
+                 self_healing_enabled: Optional[Dict[AnomalyType, bool]] = None,
+                 broker_failure_alert_threshold_ms: float =
+                 DEFAULT_ALERT_THRESHOLD_MS,
+                 broker_failure_auto_fix_threshold_ms: float =
+                 DEFAULT_AUTO_FIX_THRESHOLD_MS,
+                 alert_fn: Optional[Callable[[Anomaly, bool], None]] = None,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._enabled: Dict[AnomalyType, bool] = {
+            t: False for t in AnomalyType}
+        if self_healing_enabled:
+            self._enabled.update(self_healing_enabled)
+        self._alert_ms = broker_failure_alert_threshold_ms
+        self._fix_ms = broker_failure_auto_fix_threshold_ms
+        if self._fix_ms < self._alert_ms:
+            raise ValueError("auto-fix threshold must be >= alert threshold")
+        self._alert_fn = alert_fn
+        self._time = time_fn or _time.time
+        # anomaly ids already alerted — deduped so deferred re-checks don't
+        # alert again; bounded FIFO so long-lived processes don't leak
+        self._alerted: "OrderedDict[str, bool]" = OrderedDict()
+        self._max_alerted = 4096
+
+    def _first_alert(self, anomaly: Anomaly) -> bool:
+        """True exactly once per anomaly id."""
+        if anomaly.anomaly_id in self._alerted:
+            return False
+        self._alerted[anomaly.anomaly_id] = True
+        while len(self._alerted) > self._max_alerted:
+            self._alerted.popitem(last=False)
+        return True
+
+    # ------------------------------------------------------------------
+    def self_healing_enabled(self) -> Dict[AnomalyType, bool]:
+        return dict(self._enabled)
+
+    def set_self_healing_for(self, anomaly_type: AnomalyType,
+                             enabled: bool) -> bool:
+        old = self._enabled[anomaly_type]
+        self._enabled[anomaly_type] = enabled
+        return old
+
+    # ------------------------------------------------------------------
+    def on_anomaly(self, anomaly: Anomaly) -> NotificationAction:
+        if isinstance(anomaly, BrokerFailures):
+            return self._on_broker_failure(anomaly)
+        heal = self._enabled.get(anomaly.anomaly_type, False)
+        if self._first_alert(anomaly):
+            self._alert(anomaly, auto_fix=heal)
+        return (NotificationAction.fix() if heal
+                else NotificationAction.ignore())
+
+    def _on_broker_failure(self, anomaly: BrokerFailures
+                           ) -> NotificationAction:
+        now_ms = self._time() * 1000.0
+        if not anomaly.failed_brokers_by_time_ms:
+            return NotificationAction.ignore()
+        earliest = min(anomaly.failed_brokers_by_time_ms.values())
+        alert_at = earliest + self._alert_ms
+        fix_at = earliest + self._fix_ms
+        if now_ms < alert_at:
+            return NotificationAction.check(alert_at - now_ms)
+        heal = self._enabled.get(AnomalyType.BROKER_FAILURE, False)
+        if self._first_alert(anomaly):
+            self._alert(anomaly, auto_fix=heal)
+        if not heal:
+            return NotificationAction.ignore()
+        if now_ms < fix_at:
+            return NotificationAction.check(fix_at - now_ms)
+        return NotificationAction.fix()
+
+    def _alert(self, anomaly: Anomaly, auto_fix: bool) -> None:
+        LOG.warning("anomaly alert: %s (self-healing=%s)", anomaly, auto_fix)
+        if self._alert_fn is not None:
+            try:
+                self._alert_fn(anomaly, auto_fix)
+            except Exception:  # noqa: BLE001 - alerts must not break healing
+                LOG.exception("alert delivery failed")
+
+
+class WebhookSelfHealingNotifier(SelfHealingNotifier):
+    """Alert via an injected webhook poster
+    (reference SlackSelfHealingNotifier.java posts JSON to a Slack webhook;
+    `post_fn(payload_dict)` abstracts the HTTP call)."""
+
+    def __init__(self, post_fn: Callable[[dict], None], **kwargs) -> None:
+        def alert(anomaly: Anomaly, auto_fix: bool) -> None:
+            post_fn({
+                "text": f"{anomaly.anomaly_type.name}: {anomaly}",
+                "anomalyId": anomaly.anomaly_id,
+                "selfHealing": auto_fix,
+            })
+        super().__init__(alert_fn=alert, **kwargs)

@@ -1,0 +1,130 @@
+"""Slow-broker finder.
+
+Reference CC/detector/SlowBrokerFinder.java:39-471 — two-signal detection:
+(1) the raw log-flush-time metric against the broker's own history
+percentile, and (2) the *derived* per-byte flush cost (flush time divided by
+bytes-in rate) against both own history and the peer population percentile.
+A broker must trip BOTH signals to be suspected.  Each suspicion raises the
+broker's slowness score; scores decay when healthy.  Escalation: brokers
+over the demotion score get a demote recommendation; persistently slow
+brokers (score over the removal threshold) get a removal recommendation.
+
+Vectorized re-design: histories arrive as arrays [broker, window]; all
+percentile math is batched numpy (the monitor plane already keeps these as
+device-friendly arrays; host numpy is fine at O(brokers × windows)).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import Callable, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from cruise_control_tpu.detector.anomalies import FixFn, SlowBrokers
+
+
+@dataclasses.dataclass
+class SlowBrokerFinderConfig:
+    """Reference config keys slow.broker.* (SlowBrokerFinder.java:54-90)."""
+
+    #: own-history percentile the latest value must exceed
+    history_percentile: float = 90.0
+    #: own-history margin multiplier on that percentile
+    history_margin: float = 3.0
+    #: peer-population percentile the latest value must exceed
+    peer_percentile: float = 50.0
+    #: peer margin multiplier
+    peer_margin: float = 3.0
+    #: score added per detection; decayed by 1 per clean sweep
+    score_per_detection: float = 1.0
+    #: demote when score reaches this
+    demotion_score: float = 5.0
+    #: remove when score reaches this
+    removal_score: float = 10.0
+    #: ignore brokers whose bytes-in is below this (idle brokers flush slow)
+    min_bytes_in_rate: float = 1024.0
+
+
+class SlowBrokerFinder:
+    """Feed with per-sweep metric arrays; emits SlowBrokers anomalies."""
+
+    def __init__(self, report_fn: Callable[[SlowBrokers], None],
+                 config: Optional[SlowBrokerFinderConfig] = None,
+                 demote_fix_fn: Optional[FixFn] = None,
+                 remove_fix_fn: Optional[FixFn] = None,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._cfg = config or SlowBrokerFinderConfig()
+        self._report = report_fn
+        self._demote_fix = demote_fix_fn
+        self._remove_fix = remove_fix_fn
+        self._time = time_fn or _time.time
+        self._scores: Dict[int, float] = {}
+        self._first_detect_ms: Dict[int, float] = {}
+
+    @property
+    def slowness_scores(self) -> Dict[int, float]:
+        return dict(self._scores)
+
+    # ------------------------------------------------------------------
+    def detect_now(self, broker_ids: Sequence[int],
+                   flush_time_history: np.ndarray,
+                   bytes_in_history: np.ndarray) -> Optional[SlowBrokers]:
+        """One sweep.  `flush_time_history`/`bytes_in_history` are
+        [broker, window] with the LATEST window last; detection compares the
+        latest window against history (all earlier windows).
+        """
+        cfg = self._cfg
+        flush = np.asarray(flush_time_history, dtype=np.float64)
+        bytes_in = np.asarray(bytes_in_history, dtype=np.float64)
+        if flush.ndim != 2 or flush.shape[1] < 2:
+            return None
+        latest_flush = flush[:, -1]
+        hist_flush = flush[:, :-1]
+        per_byte = flush / np.maximum(bytes_in, 1.0)
+        latest_pb = per_byte[:, -1]
+        hist_pb = per_byte[:, :-1]
+
+        # signal 1: raw flush time vs own history
+        own_thresh = np.percentile(hist_flush, cfg.history_percentile,
+                                   axis=1) * cfg.history_margin
+        sig1 = latest_flush > own_thresh
+        # signal 2: per-byte cost vs own history AND vs current peers
+        own_pb_thresh = np.percentile(hist_pb, cfg.history_percentile,
+                                      axis=1) * cfg.history_margin
+        peer_thresh = np.percentile(latest_pb, cfg.peer_percentile) \
+            * cfg.peer_margin
+        sig2 = (latest_pb > own_pb_thresh) & (latest_pb > peer_thresh)
+        active = bytes_in[:, -1] >= cfg.min_bytes_in_rate
+        suspected = sig1 & sig2 & active
+
+        now_ms = self._time() * 1000.0
+        for i, bid in enumerate(broker_ids):
+            if suspected[i]:
+                self._scores[bid] = (self._scores.get(bid, 0.0)
+                                     + cfg.score_per_detection)
+                self._first_detect_ms.setdefault(bid, now_ms)
+            elif bid in self._scores:
+                self._scores[bid] -= cfg.score_per_detection
+                if self._scores[bid] <= 0:
+                    del self._scores[bid]
+                    self._first_detect_ms.pop(bid, None)
+
+        to_remove = {b: self._first_detect_ms[b]
+                     for b, s in self._scores.items()
+                     if s >= cfg.removal_score}
+        to_demote = {b: self._first_detect_ms[b]
+                     for b, s in self._scores.items()
+                     if cfg.demotion_score <= s < cfg.removal_score}
+        if to_remove:
+            anomaly = SlowBrokers(to_remove, remove_slow_brokers=True,
+                                  fix_fn=self._remove_fix,
+                                  detected_ms=now_ms)
+        elif to_demote:
+            anomaly = SlowBrokers(to_demote, remove_slow_brokers=False,
+                                  fix_fn=self._demote_fix,
+                                  detected_ms=now_ms)
+        else:
+            return None
+        self._report(anomaly)
+        return anomaly

@@ -1,0 +1,90 @@
+"""Topic anomaly finders.
+
+Reference CC/detector/TopicReplicationFactorAnomalyFinder.java:1-286 (topics
+whose replication factor differs from the target, with min.insync.replicas
+read from topic configs as a floor) and PartitionSizeAnomalyFinder.java:1-129
+(partitions larger than a threshold).
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Dict, List, Optional
+
+from cruise_control_tpu.cluster.admin import ClusterAdminClient
+from cruise_control_tpu.detector.anomalies import FixFn, TopicAnomaly
+
+
+class TopicReplicationFactorAnomalyFinder:
+    def __init__(self, admin: ClusterAdminClient,
+                 report_fn: Callable[[TopicAnomaly], None],
+                 target_replication_factor: int = 3,
+                 fix_fn: Optional[FixFn] = None,
+                 topic_pattern: Optional[str] = None,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._admin = admin
+        self._report = report_fn
+        self._target_rf = target_replication_factor
+        self._fix_fn = fix_fn
+        self._pattern = topic_pattern
+        self._time = time_fn or _time.time
+
+    def detect_now(self) -> Optional[TopicAnomaly]:
+        import re
+        snapshot = self._admin.describe_cluster()
+        pat = re.compile(self._pattern) if self._pattern else None
+        bad: Dict[str, int] = {}
+        for topic in sorted(snapshot.topics):
+            if pat is not None and not pat.match(topic):
+                continue
+            # min.insync.replicas floors the acceptable RF (reference reads
+            # topic configs for minISR before flagging under-replication)
+            try:
+                min_isr = int(self._admin.topic_configs(topic).get(
+                    "min.insync.replicas", 1))
+            except (TypeError, ValueError):
+                min_isr = 1
+            target = max(self._target_rf, min_isr)
+            rfs = {len(p.replicas) for p in snapshot.partitions_of(topic)}
+            if any(rf != target for rf in rfs):
+                bad[topic] = target
+        if not bad:
+            return None
+        anomaly = TopicAnomaly(
+            description=(f"topics with replication factor != target: "
+                         f"{sorted(bad)}"),
+            topics=sorted(bad), fix_fn=self._fix_fn,
+            detected_ms=self._time() * 1000.0)
+        self._report(anomaly)
+        return anomaly
+
+
+class PartitionSizeAnomalyFinder:
+    def __init__(self, admin: ClusterAdminClient,
+                 report_fn: Callable[[TopicAnomaly], None],
+                 size_threshold_bytes: float = 1 << 40,
+                 partition_size_fn: Optional[Callable[[str, int], float]]
+                 = None,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._admin = admin
+        self._report = report_fn
+        self._threshold = size_threshold_bytes
+        self._size_fn = partition_size_fn
+        self._time = time_fn or _time.time
+
+    def detect_now(self) -> Optional[TopicAnomaly]:
+        if self._size_fn is None:
+            return None
+        snapshot = self._admin.describe_cluster()
+        oversized: List[str] = []
+        for p in snapshot.partitions:
+            if self._size_fn(p.tp.topic, p.tp.partition) > self._threshold:
+                oversized.append(str(p.tp))
+        if not oversized:
+            return None
+        anomaly = TopicAnomaly(
+            description=f"partitions over {self._threshold:.0f} bytes: "
+                        f"{oversized[:20]}",
+            topics=sorted({s.rsplit('-', 1)[0] for s in oversized}),
+            detected_ms=self._time() * 1000.0)
+        self._report(anomaly)
+        return anomaly

@@ -1,0 +1,27 @@
+"""Executor plane: drives rebalance proposals against the cluster.
+
+Host-side, I/O-bound async engine (SURVEY.md §2.5); the reference's
+CC/executor/ package re-designed over the ClusterAdminClient SPI.
+"""
+from cruise_control_tpu.executor.executor import (Executor, ExecutorNotifier)
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.state import ExecutorPhase, ExecutorState
+from cruise_control_tpu.executor.strategy import (
+    BaseReplicaMovementStrategy, PostponeUrpReplicaMovementStrategy,
+    PrioritizeLargeReplicaMovementStrategy,
+    PrioritizeSmallReplicaMovementStrategy, ReplicaMovementStrategy,
+    strategy_from_names)
+from cruise_control_tpu.executor.task import (ExecutionTask, TaskState,
+                                              TaskType)
+from cruise_control_tpu.executor.task_manager import (ExecutionCounts,
+                                                      ExecutionTaskManager)
+
+__all__ = [
+    "Executor", "ExecutorNotifier", "ExecutorPhase", "ExecutorState",
+    "ExecutionTask", "ExecutionTaskManager", "ExecutionTaskPlanner",
+    "ExecutionCounts", "TaskState", "TaskType",
+    "ReplicaMovementStrategy", "BaseReplicaMovementStrategy",
+    "PrioritizeSmallReplicaMovementStrategy",
+    "PrioritizeLargeReplicaMovementStrategy",
+    "PostponeUrpReplicaMovementStrategy", "strategy_from_names",
+]

@@ -1,0 +1,496 @@
+"""The execution engine.
+
+Drives accepted proposals against the cluster in the reference's three
+phases — inter-broker replica moves, intra-broker (logdir) moves, leadership
+moves — with per-phase batching loops that poll cluster metadata on a check
+interval, mark tasks completed/dead, re-execute stuck reassignments, and
+apply replication throttles around moves (reference CC/executor/
+Executor.java:74-1477, phase dispatch at :791-873, polling at :1169-1334,
+re-execution at :1432-1470).
+
+Host-side and I/O-bound by design: actual data movement happens inside the
+managed cluster; this engine only requests and observes it.  Time and sleep
+are injectable so the loop runs identically against wall-clock demos and
+virtual-time simulated clusters.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time as _time
+import uuid as _uuid
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.cluster.admin import ClusterAdminClient
+from cruise_control_tpu.cluster.types import ClusterSnapshot, TopicPartition
+from cruise_control_tpu.executor.state import ExecutorPhase, ExecutorState
+from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy
+from cruise_control_tpu.executor.task import (ExecutionTask, TaskState,
+                                              TaskType)
+from cruise_control_tpu.executor.task_manager import ExecutionTaskManager
+
+LOG = logging.getLogger(__name__)
+
+
+class ExecutorNotifier:
+    """SPI notified when an execution finishes (reference
+    ExecutorNotifier.java)."""
+
+    def on_execution_finished(self, uuid: str, succeeded: bool,
+                              message: str) -> None:  # pragma: no cover
+        pass
+
+
+class ExecutionStoppedException(RuntimeError):
+    pass
+
+
+class Executor:
+    """Thread-safe, single-execution-at-a-time engine."""
+
+    def __init__(self, admin: ClusterAdminClient,
+                 load_monitor=None,
+                 notifier: Optional[ExecutorNotifier] = None,
+                 concurrent_inter_broker_moves_per_broker: int = 5,
+                 concurrent_intra_broker_moves_per_broker: int = 2,
+                 concurrent_leader_movements: int = 1000,
+                 progress_check_interval_s: float = 10.0,
+                 max_task_execution_idle_s: float = 190.0,
+                 leader_movement_timeout_s: float = 180.0,
+                 replication_throttle_bytes_per_s: Optional[float] = None,
+                 removal_history_retention_s: float = 12 * 3600.0,
+                 time_fn: Optional[Callable[[], float]] = None,
+                 sleep_fn: Optional[Callable[[float], None]] = None) -> None:
+        self._admin = admin
+        self._load_monitor = load_monitor
+        self._notifier = notifier
+        self._inter_cap = concurrent_inter_broker_moves_per_broker
+        self._intra_cap = concurrent_intra_broker_moves_per_broker
+        self._leader_cap = concurrent_leader_movements
+        self._check_interval = progress_check_interval_s
+        self._max_idle = max_task_execution_idle_s
+        self._leader_timeout = leader_movement_timeout_s
+        self._throttle_rate = replication_throttle_bytes_per_s
+        self._history_retention = removal_history_retention_s
+        self._time = time_fn or _time.time
+        self._sleep = sleep_fn or _time.sleep
+
+        self._lock = threading.RLock()
+        self._manager: Optional[ExecutionTaskManager] = None
+        self._phase = ExecutorPhase.NO_TASK_IN_PROGRESS
+        self._stop_requested = False
+        self._force_stop = False
+        self._uuid: Optional[str] = None
+        self._reason: Optional[str] = None
+        self._thread: Optional[threading.Thread] = None
+        #: broker id -> removal/demotion time (reference Executor.java:309-366)
+        self._removed_brokers: Dict[int, float] = {}
+        self._demoted_brokers: Dict[int, float] = {}
+
+    # ------------------------------------------------------------------
+    # public surface
+    # ------------------------------------------------------------------
+    def execute_proposals(self, proposals: Sequence[ExecutionProposal],
+                          reason: str = "",
+                          uuid: Optional[str] = None,
+                          removed_brokers: Sequence[int] = (),
+                          demoted_brokers: Sequence[int] = (),
+                          strategy: Optional[ReplicaMovementStrategy] = None,
+                          concurrent_inter_broker_moves: Optional[int] = None,
+                          concurrent_leader_movements: Optional[int] = None,
+                          replication_throttle: Optional[float] = None,
+                          wait: bool = False) -> str:
+        """Register and start executing proposals.  Returns the execution
+        uuid.  Raises if an execution is already in progress (reference
+        sanityCheckExecuteProposals)."""
+        with self._lock:
+            if self._phase != ExecutorPhase.NO_TASK_IN_PROGRESS:
+                raise RuntimeError(
+                    f"cannot start execution in state {self._phase}")
+            self._phase = ExecutorPhase.STARTING_EXECUTION
+            self._stop_requested = False
+            self._force_stop = False
+            self._uuid = uuid or str(_uuid.uuid4())
+            self._reason = reason
+            now = self._time()
+            for b in removed_brokers:
+                self._removed_brokers[b] = now
+            for b in demoted_brokers:
+                self._demoted_brokers[b] = now
+            mgr = ExecutionTaskManager(
+                concurrent_inter_broker_moves or self._inter_cap,
+                self._intra_cap,
+                concurrent_leader_movements or self._leader_cap,
+                strategy)
+            snapshot = self._admin.describe_cluster()
+            mgr.load_proposals(proposals,
+                               sorted(snapshot.all_broker_ids))
+            self._manager = mgr
+            throttle = (replication_throttle
+                        if replication_throttle is not None
+                        else self._throttle_rate)
+            run_uuid = self._uuid
+        self._thread = threading.Thread(
+            target=self._run, args=(throttle,),
+            name=f"proposal-execution-{run_uuid[:8]}", daemon=True)
+        self._thread.start()
+        if wait:
+            self._thread.join()
+        return run_uuid
+
+    def stop_execution(self, force: bool = False) -> None:
+        """Request graceful (or forced — cancel in-flight reassignments)
+        stop (reference Executor.stopExecution / force-stop znode deletion
+        :1153-1163)."""
+        with self._lock:
+            if self._phase == ExecutorPhase.NO_TASK_IN_PROGRESS:
+                return
+            self._stop_requested = True
+            self._force_stop = force
+            self._phase = ExecutorPhase.STOPPING_EXECUTION
+
+    def await_completion(self, timeout: Optional[float] = None) -> bool:
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
+
+    @property
+    def state(self) -> ExecutorState:
+        with self._lock:
+            if (self._phase == ExecutorPhase.NO_TASK_IN_PROGRESS
+                    or self._manager is None):
+                return ExecutorState.idle()
+            return ExecutorState.snapshot(self._phase, self._uuid,
+                                          self._reason, self._manager)
+
+    @property
+    def has_ongoing_execution(self) -> bool:
+        with self._lock:
+            return self._phase != ExecutorPhase.NO_TASK_IN_PROGRESS
+
+    def recently_removed_brokers(self) -> Set[int]:
+        return self._recent(self._removed_brokers)
+
+    def recently_demoted_brokers(self) -> Set[int]:
+        return self._recent(self._demoted_brokers)
+
+    def drop_recently_removed_brokers(self, brokers: Sequence[int]) -> None:
+        with self._lock:
+            for b in brokers:
+                self._removed_brokers.pop(b, None)
+
+    def drop_recently_demoted_brokers(self, brokers: Sequence[int]) -> None:
+        with self._lock:
+            for b in brokers:
+                self._demoted_brokers.pop(b, None)
+
+    def _recent(self, table: Dict[int, float]) -> Set[int]:
+        with self._lock:
+            cutoff = self._time() - self._history_retention
+            for b in [b for b, t in table.items() if t < cutoff]:
+                del table[b]
+            return set(table)
+
+    # ------------------------------------------------------------------
+    # the execution runnable (reference ProposalExecutionRunnable)
+    # ------------------------------------------------------------------
+    def _run(self, throttle: Optional[float]) -> None:
+        mgr = self._manager
+        assert mgr is not None
+        succeeded = True
+        message = "execution completed"
+        throttled_brokers: List[int] = []
+        try:
+            if self._load_monitor is not None:
+                self._load_monitor.pause_metric_sampling(
+                    "executing proposals")
+            if throttle is not None:
+                snapshot = self._admin.describe_cluster()
+                throttled_brokers = sorted(snapshot.alive_broker_ids)
+                self._admin.set_replication_throttle(throttled_brokers,
+                                                     throttle)
+            self._set_phase(
+                ExecutorPhase.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS)
+            self._inter_broker_move_replicas(mgr)
+            self._set_phase(
+                ExecutorPhase.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS)
+            self._intra_broker_move_replicas(mgr)
+            self._set_phase(ExecutorPhase.LEADER_MOVEMENT_TASK_IN_PROGRESS)
+            self._move_leaderships(mgr)
+        except ExecutionStoppedException:
+            succeeded = False
+            message = "execution stopped by user"
+        except Exception as exc:  # noqa: BLE001 - report any failure
+            LOG.exception("execution failed")
+            succeeded = False
+            message = f"execution failed: {exc}"
+        finally:
+            if throttled_brokers:
+                try:
+                    self._admin.clear_replication_throttle(throttled_brokers)
+                except Exception:  # noqa: BLE001
+                    LOG.exception("failed to clear throttles")
+            if self._load_monitor is not None:
+                self._load_monitor.resume_metric_sampling(
+                    "execution finished")
+            with self._lock:
+                uuid = self._uuid
+                self._phase = ExecutorPhase.NO_TASK_IN_PROGRESS
+            if self._notifier is not None and uuid is not None:
+                self._notifier.on_execution_finished(uuid, succeeded, message)
+
+    def _set_phase(self, phase: ExecutorPhase) -> None:
+        with self._lock:
+            if self._stop_requested:
+                raise ExecutionStoppedException()
+            self._phase = phase
+
+    def _check_stop(self, mgr: ExecutionTaskManager,
+                    in_flight: List[ExecutionTask]) -> None:
+        with self._lock:
+            if not self._stop_requested:
+                return
+            force = self._force_stop
+        now_ms = self._time() * 1000.0
+        if force:
+            # cancel in-flight reassignments outright
+            cancel = {TopicPartition(t.proposal.partition.topic,
+                                     t.proposal.partition.partition): None
+                      for t in in_flight
+                      if t.task_type == TaskType.INTER_BROKER_REPLICA_ACTION}
+            if cancel:
+                self._admin.alter_partition_reassignments(cancel)
+            for t in list(in_flight):
+                mgr.mark_aborting(t, now_ms)
+                mgr.finish_task(t, TaskState.ABORTED, now_ms)
+                in_flight.remove(t)
+        else:
+            for t in in_flight:
+                mgr.mark_aborting(t, now_ms)
+        raise ExecutionStoppedException()
+
+    # ------------------------------------------------------------------
+    # phase 1: inter-broker replica movement
+    # ------------------------------------------------------------------
+    def _inter_broker_move_replicas(self, mgr: ExecutionTaskManager) -> None:
+        in_flight: List[ExecutionTask] = []
+        while True:
+            now_ms = self._time() * 1000.0
+            new_tasks = mgr.next_inter_broker_tasks(now_ms)
+            if new_tasks:
+                alive = self._admin.describe_cluster().alive_broker_ids
+                targets = {}
+                for t in new_tasks:
+                    if any(b not in alive
+                           for b in t.proposal.replicas_to_add):
+                        # destination already dead — never submit
+                        mgr.finish_task(t, TaskState.DEAD, now_ms)
+                        continue
+                    tp = TopicPartition(t.proposal.partition.topic,
+                                        t.proposal.partition.partition)
+                    targets[tp] = [r.broker_id
+                                   for r in t.proposal.new_replicas]
+                    in_flight.append(t)
+                if targets:
+                    self._admin.alter_partition_reassignments(targets)
+            if not in_flight and not new_tasks:
+                counts = mgr.counts(TaskType.INTER_BROKER_REPLICA_ACTION)
+                if counts.pending == 0:
+                    return
+            try:
+                self._check_stop(mgr, in_flight)
+            except ExecutionStoppedException:
+                if in_flight:
+                    # graceful stop: wait for in-flight tasks to finish
+                    self._drain_inter_broker(mgr, in_flight)
+                raise
+            self._sleep(self._check_interval)
+            self._poll_inter_broker(mgr, in_flight)
+
+    def _drain_inter_broker(self, mgr: ExecutionTaskManager,
+                            in_flight: List[ExecutionTask]) -> None:
+        while in_flight:
+            self._sleep(self._check_interval)
+            self._poll_inter_broker(mgr, in_flight)
+            with self._lock:
+                if self._force_stop:
+                    now_ms = self._time() * 1000.0
+                    cancel = {
+                        TopicPartition(t.proposal.partition.topic,
+                                       t.proposal.partition.partition): None
+                        for t in in_flight}
+                    if cancel:
+                        self._admin.alter_partition_reassignments(cancel)
+                    for t in list(in_flight):
+                        mgr.finish_task(t, TaskState.ABORTED, now_ms)
+                    in_flight.clear()
+
+    def _poll_inter_broker(self, mgr: ExecutionTaskManager,
+                           in_flight: List[ExecutionTask]) -> None:
+        """One metadata poll: classify each in-flight reassignment as done,
+        dead, lost (re-execute), or still moving (reference
+        waitForExecutionTaskToFinish + maybeReexecuteTasks — re-execution
+        happens only when the cluster no longer knows about the
+        reassignment, never on a wall-clock timer, so slow transfers are
+        simply waited out)."""
+        snapshot = self._admin.describe_cluster()
+        reassigning = {r.tp for r in
+                       self._admin.list_partition_reassignments()}
+        alive = snapshot.alive_broker_ids
+        now_ms = self._time() * 1000.0
+        for task in list(in_flight):
+            p = task.proposal
+            tp = TopicPartition(p.partition.topic, p.partition.partition)
+            info = snapshot.partition(tp)
+            new_brokers = [r.broker_id for r in p.new_replicas]
+            if info is None:
+                # partition deleted out from under us
+                mgr.finish_task(task, TaskState.DEAD, now_ms)
+                in_flight.remove(task)
+                continue
+            if tp not in reassigning and set(info.replicas) == set(new_brokers):
+                state = (TaskState.ABORTED
+                         if task.state == TaskState.ABORTING
+                         else TaskState.COMPLETED)
+                mgr.finish_task(task, state, now_ms)
+                in_flight.remove(task)
+            elif any(b not in alive for b in p.replicas_to_add):
+                # a destination broker died: task cannot finish
+                self._admin.alter_partition_reassignments({tp: None})
+                mgr.finish_task(task, TaskState.DEAD, now_ms)
+                in_flight.remove(task)
+            elif tp not in reassigning:
+                # the cluster lost the reassignment (e.g. controller
+                # failover): re-submit it
+                self._admin.alter_partition_reassignments(
+                    {tp: new_brokers})
+                task.reexecution_count += 1
+
+    # ------------------------------------------------------------------
+    # phase 2: intra-broker (logdir) movement
+    # ------------------------------------------------------------------
+    def _intra_broker_move_replicas(self, mgr: ExecutionTaskManager) -> None:
+        in_flight: List[ExecutionTask] = []
+        while True:
+            now_ms = self._time() * 1000.0
+            new_tasks = mgr.next_intra_broker_tasks(now_ms)
+            if new_tasks:
+                moves: Dict[TopicPartition, Dict[int, str]] = {}
+                for t in new_tasks:
+                    tp = TopicPartition(t.proposal.partition.topic,
+                                        t.proposal.partition.partition)
+                    old_dirs = {r.broker_id: r.logdir
+                                for r in t.proposal.old_replicas}
+                    for r in t.proposal.new_replicas:
+                        if (r.logdir is not None
+                                and old_dirs.get(r.broker_id) is not None
+                                and old_dirs[r.broker_id] != r.logdir):
+                            moves.setdefault(tp, {})[r.broker_id] = r.logdir
+                if moves:
+                    self._admin.alter_replica_log_dirs(moves)
+                in_flight.extend(new_tasks)
+            if not in_flight and not new_tasks:
+                if mgr.counts(TaskType.INTRA_BROKER_REPLICA_ACTION).pending \
+                        == 0:
+                    return
+            self._check_stop(mgr, in_flight)
+            self._sleep(self._check_interval)
+            # poll: logdir placement matches the proposal
+            snapshot = self._admin.describe_cluster()
+            alive = snapshot.alive_broker_ids
+            now_ms = self._time() * 1000.0
+            for task in list(in_flight):
+                p = task.proposal
+                tp = TopicPartition(p.partition.topic, p.partition.partition)
+                info = snapshot.partition(tp)
+                want = {r.broker_id: r.logdir for r in p.new_replicas
+                        if r.logdir is not None}
+                if info is None or any(b not in alive for b in want):
+                    # partition deleted or the hosting broker died
+                    mgr.finish_task(task, TaskState.DEAD, now_ms)
+                    in_flight.remove(task)
+                    continue
+                have = dict(info.logdir_by_broker)
+                if all(have.get(b) == d for b, d in want.items()):
+                    mgr.finish_task(task, TaskState.COMPLETED, now_ms)
+                    in_flight.remove(task)
+                elif (now_ms - task.start_time_ms
+                      > self._max_idle * 1000.0):
+                    # logdir move stalled beyond the idle budget
+                    mgr.finish_task(task, TaskState.DEAD, now_ms)
+                    in_flight.remove(task)
+
+    # ------------------------------------------------------------------
+    # phase 3: leadership movement
+    # ------------------------------------------------------------------
+    def _move_leaderships(self, mgr: ExecutionTaskManager) -> None:
+        while True:
+            now_ms = self._time() * 1000.0
+            batch = mgr.next_leadership_tasks(now_ms)
+            if not batch:
+                if mgr.counts(TaskType.LEADER_ACTION).pending == 0:
+                    return
+                self._sleep(self._check_interval)
+                continue
+            self._check_stop(mgr, batch)
+            # reorder each partition's replica list so the desired leader is
+            # the preferred replica (an in-place same-set reassignment), then
+            # trigger preferred-leader election — the modern equivalent of
+            # the reference's ZK PLE path (ExecutorUtils.scala:95-101)
+            snapshot = self._admin.describe_cluster()
+            alive = snapshot.alive_broker_ids
+            tps = []
+            reorders = {}
+            for t in list(batch):
+                p = t.proposal
+                tp = TopicPartition(p.partition.topic, p.partition.partition)
+                info = snapshot.partition(tp)
+                want = [r.broker_id for r in p.new_replicas]
+                if (info is None or p.new_leader not in alive
+                        or set(info.replicas) != set(want)):
+                    # leader is dead or its replica never arrived (e.g. the
+                    # inter-broker task died): leadership cannot move
+                    mgr.finish_task(t, TaskState.DEAD, now_ms)
+                    batch.remove(t)
+                    continue
+                tps.append(tp)
+                reorders[tp] = want
+            if reorders:
+                self._admin.alter_partition_reassignments(reorders)
+                self._admin.elect_preferred_leaders(tps)
+            deadline_ms = (self._time() + self._leader_timeout) * 1000.0
+            pending = list(batch)
+            while pending:
+                with self._lock:
+                    stop = self._stop_requested
+                if stop:
+                    # leadership movements are instantaneous requests; on
+                    # stop just abandon what hasn't landed yet
+                    now_ms = self._time() * 1000.0
+                    for task in pending:
+                        mgr.mark_aborting(task, now_ms)
+                        mgr.finish_task(task, TaskState.ABORTED, now_ms)
+                    raise ExecutionStoppedException()
+                self._sleep(min(self._check_interval,
+                                self._leader_timeout / 10.0))
+                snapshot = self._admin.describe_cluster()
+                now_ms = self._time() * 1000.0
+                alive = snapshot.alive_broker_ids
+                for task in list(pending):
+                    p = task.proposal
+                    tp = TopicPartition(p.partition.topic,
+                                        p.partition.partition)
+                    info = snapshot.partition(tp)
+                    if info is None or p.new_leader not in alive:
+                        mgr.finish_task(task, TaskState.DEAD, now_ms)
+                        pending.remove(task)
+                    elif info.leader == p.new_leader:
+                        mgr.finish_task(task, TaskState.COMPLETED, now_ms)
+                        pending.remove(task)
+                if now_ms > deadline_ms:
+                    for task in pending:
+                        mgr.finish_task(task, TaskState.DEAD, now_ms)
+                    pending.clear()

@@ -1,0 +1,132 @@
+"""Execution task planner.
+
+Turns each ExecutionProposal into at most one leadership task, at most one
+inter-broker movement task, and any number of intra-broker (logdir) movement
+tasks, then serves them per broker in strategy order — the behavior of the
+reference's ExecutionTaskPlanner (reference CC/executor/
+ExecutionTaskPlanner.java:68-446).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor.strategy import (BaseReplicaMovementStrategy,
+                                                  ReplicaMovementStrategy)
+from cruise_control_tpu.executor.task import (ExecutionTask, TaskState,
+                                              TaskType)
+
+
+class ExecutionTaskPlanner:
+    """Stateful planner: load proposals once, pop executable tasks as
+    concurrency slots open."""
+
+    def __init__(self,
+                 strategy: Optional[ReplicaMovementStrategy] = None) -> None:
+        self._strategy = strategy or BaseReplicaMovementStrategy()
+        self._leadership_tasks: List[ExecutionTask] = []
+        self._inter_broker_tasks: List[ExecutionTask] = []
+        self._intra_broker_tasks: List[ExecutionTask] = []
+
+    # ------------------------------------------------------------------
+    # planning
+    # ------------------------------------------------------------------
+    def add_proposals(self, proposals: Sequence[ExecutionProposal]) -> None:
+        """Decompose proposals into typed tasks
+        (ExecutionTaskPlanner.addExecutionProposal)."""
+        for p in proposals:
+            if p.has_replica_action:
+                self._inter_broker_tasks.append(ExecutionTask(
+                    ExecutionTask.next_id(), p,
+                    TaskType.INTER_BROKER_REPLICA_ACTION))
+            if p.has_leader_action:
+                # runs in phase 3, after any replica movement has landed the
+                # new leader's replica (Executor.java execute() phase order)
+                self._leadership_tasks.append(ExecutionTask(
+                    ExecutionTask.next_id(), p, TaskType.LEADER_ACTION))
+            for intra in self._intra_broker_moves(p):
+                self._intra_broker_tasks.append(intra)
+        self._inter_broker_tasks = self._strategy.sorted_tasks(
+            self._inter_broker_tasks)
+
+    @staticmethod
+    def _intra_broker_moves(p: ExecutionProposal) -> List[ExecutionTask]:
+        """Same-broker logdir changes (reference planner's
+        maybeAddIntraBrokerReplicaMovementTasks)."""
+        old_by_broker = {r.broker_id: r.logdir for r in p.old_replicas}
+        tasks = []
+        for r in p.new_replicas:
+            old_dir = old_by_broker.get(r.broker_id)
+            if (r.broker_id in old_by_broker and r.logdir is not None
+                    and old_dir is not None and r.logdir != old_dir):
+                tasks.append(ExecutionTask(
+                    ExecutionTask.next_id(), p,
+                    TaskType.INTRA_BROKER_REPLICA_ACTION))
+        return tasks
+
+    # ------------------------------------------------------------------
+    # serving
+    # ------------------------------------------------------------------
+    @property
+    def remaining_leadership_tasks(self) -> List[ExecutionTask]:
+        return [t for t in self._leadership_tasks
+                if t.state == TaskState.PENDING]
+
+    @property
+    def remaining_inter_broker_tasks(self) -> List[ExecutionTask]:
+        return [t for t in self._inter_broker_tasks
+                if t.state == TaskState.PENDING]
+
+    @property
+    def remaining_intra_broker_tasks(self) -> List[ExecutionTask]:
+        return [t for t in self._intra_broker_tasks
+                if t.state == TaskState.PENDING]
+
+    def pop_inter_broker_tasks(
+            self, slots_by_broker: Dict[int, int]) -> List[ExecutionTask]:
+        """Next batch of inter-broker moves honoring per-broker concurrency
+        slots.  A task consumes a slot on EVERY participating broker (both
+        adding and removing sides), matching the reference's per-broker
+        in-flight accounting (ExecutionTaskPlanner.getInterBrokerReplica
+        MovementTasks)."""
+        picked: List[ExecutionTask] = []
+        slots = dict(slots_by_broker)
+        for task in self.remaining_inter_broker_tasks:
+            brokers = self._participants(task)
+            if all(slots.get(b, 0) > 0 for b in brokers):
+                for b in brokers:
+                    slots[b] = slots.get(b, 0) - 1
+                picked.append(task)
+        return picked
+
+    def pop_intra_broker_tasks(
+            self, slots_by_broker: Dict[int, int]) -> List[ExecutionTask]:
+        picked: List[ExecutionTask] = []
+        slots = dict(slots_by_broker)
+        for task in self.remaining_intra_broker_tasks:
+            brokers = {r.broker_id for r in task.proposal.new_replicas}
+            brokers &= {r.broker_id for r in task.proposal.old_replicas}
+            if all(slots.get(b, 0) > 0 for b in brokers):
+                for b in brokers:
+                    slots[b] = slots.get(b, 0) - 1
+                picked.append(task)
+        return picked
+
+    def pop_leadership_tasks(self, max_tasks: int) -> List[ExecutionTask]:
+        return self.remaining_leadership_tasks[:max_tasks]
+
+    @staticmethod
+    def _participants(task: ExecutionTask) -> Set[int]:
+        p = task.proposal
+        return ({r.broker_id for r in p.old_replicas}
+                | {r.broker_id for r in p.new_replicas})
+
+    # ------------------------------------------------------------------
+    def all_tasks(self) -> List[ExecutionTask]:
+        return (self._inter_broker_tasks + self._intra_broker_tasks
+                + self._leadership_tasks)
+
+    def clear(self) -> None:
+        self._leadership_tasks.clear()
+        self._inter_broker_tasks.clear()
+        self._intra_broker_tasks.clear()

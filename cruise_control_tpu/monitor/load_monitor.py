@@ -226,14 +226,14 @@ class LoadMonitor:
     def _expected_utilization(self, vae: ValuesAndExtrapolations
                               ) -> np.ndarray:
         """Collapse windows → one load vector: avg for CPU/NW, latest for
-        DISK (reference model/Load.java:25-120).  Window row 0 is the most
-        recent window (reference window order)."""
+        DISK (reference model/Load.java:25-120).  Aggregator rows are
+        ordered oldest→newest, so the latest window is the last row."""
         values = vae.values
         out = np.zeros(NUM_RESOURCES, dtype=np.float64)
         out[Resource.CPU] = values[:, self._cpu_id].mean()
         out[Resource.NW_IN] = values[:, self._nw_in_id].mean()
         out[Resource.NW_OUT] = values[:, self._nw_out_id].mean()
-        out[Resource.DISK] = values[0, self._disk_id]
+        out[Resource.DISK] = values[-1, self._disk_id]
         return out
 
     def cluster_model(self,
@@ -264,6 +264,7 @@ class LoadMonitor:
         # --- brokers with resolved capacity (populateClusterCapacity) ---
         logdirs_by_broker = self._admin.describe_log_dirs(
             sorted(snapshot.all_broker_ids))
+        jbod_dirs: Dict[int, frozenset] = {}
         for binfo in snapshot.brokers:
             cap = self._capacity_resolver.capacity_for_broker(
                 binfo.rack, binfo.host, binfo.broker_id,
@@ -274,6 +275,7 @@ class LoadMonitor:
                 for ld in logdirs_by_broker.get(binfo.broker_id, []):
                     if ld.offline and ld.path in disks:
                         disks[ld.path] = 0.0   # dead logdir
+                jbod_dirs[binfo.broker_id] = frozenset(disks)
             builder.add_broker(
                 binfo.broker_id, rack_id=binfo.rack or binfo.host,
                 capacity=cap.capacity, host=binfo.host, alive=binfo.alive,
@@ -302,10 +304,8 @@ class LoadMonitor:
                         leader_load[Resource.NW_IN],
                         leader_load[Resource.NW_OUT])
                 logdir = pinfo.logdir_by_broker.get(broker_id)
-                binfo = snapshot.broker(broker_id)
-                has_jbod = (binfo is not None and logdir is not None
-                            and any(d[0] == broker_id and d[1] == logdir
-                                    for d in builder._disk_names))
+                has_jbod = (logdir is not None
+                            and logdir in jbod_dirs.get(broker_id, ()))
                 builder.add_replica(
                     pinfo.tp.topic, pinfo.tp.partition, broker_id,
                     is_leader, load,

@@ -67,23 +67,29 @@ class MetricFetcherManager:
         partitions = [p.tp for p in cluster.partitions]
         buckets = [b for b in
                    assign_partitions(partitions, self._num_fetchers) if b]
+        if not buckets:
+            # no partitions yet — still collect broker metrics so
+            # broker-level detection isn't blind on an empty cluster
+            buckets = [set()]
         merged = Samples()
-        if buckets:
-            futures = []
-            for i, bucket in enumerate(buckets):
-                # only fetcher 0 reports broker metrics to avoid duplicates
-                m = mode if i == 0 else (
-                    SamplingMode.PARTITION_METRICS_ONLY
-                    if mode == SamplingMode.ALL else mode)
-                futures.append(self._pool.submit(
-                    self._sampler.get_samples, cluster, bucket, start_ms,
-                    end_ms, m))
-            for fut in futures:
-                try:
-                    merged.merge(fut.result(timeout=self._timeout_s))
-                except Exception:  # noqa: BLE001 - sampler is a plugin
-                    LOG.exception("metric sampler failed; continuing with "
-                                  "partial samples")
+        futures = []
+        for i, bucket in enumerate(buckets):
+            # only fetcher 0 reports broker metrics to avoid duplicates
+            if i == 0:
+                m = mode
+            elif mode == SamplingMode.BROKER_METRICS_ONLY:
+                continue   # fetcher 0 already covers all broker metrics
+            else:
+                m = SamplingMode.PARTITION_METRICS_ONLY
+            futures.append(self._pool.submit(
+                self._sampler.get_samples, cluster, bucket, start_ms,
+                end_ms, m))
+        for fut in futures:
+            try:
+                merged.merge(fut.result(timeout=self._timeout_s))
+            except Exception:  # noqa: BLE001 - sampler is a plugin
+                LOG.exception("metric sampler failed; continuing with "
+                              "partial samples")
         n_p = self._partition_aggregator.add_partition_samples(
             merged.partition_samples)
         n_b = self._broker_aggregator.add_broker_samples(

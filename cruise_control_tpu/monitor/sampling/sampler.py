@@ -110,40 +110,47 @@ class SimulatedClusterSampler(MetricSampler):
         broker_repl_out: dict = {}
         broker_disk: dict = {}
 
+        # snapshot per-partition loads under the sim lock: sampling runs on
+        # the load-monitor thread while tests/demos mutate the cluster
+        with sim._lock:  # test-harness internal access
+            loads = {tp: (part.leader_cpu, part.nw_in, part.nw_out,
+                          part.size_bytes)
+                     for tp, part in sim._partitions.items()}
+
         for pinfo in cluster.partitions:
             tp = pinfo.tp
-            part = sim._partitions.get(tp)  # test-harness internal access
-            if part is None or pinfo.leader is None:
+            part_load = loads.get(tp)
+            if part_load is None or pinfo.leader is None:
                 continue
             leader = pinfo.leader
+            leader_cpu, nw_in, nw_out, size_bytes = part_load
             n_followers = max(len(pinfo.replicas) - 1, 0)
-            broker_cpu[leader] = broker_cpu.get(leader, 0.0) + part.leader_cpu
+            broker_cpu[leader] = broker_cpu.get(leader, 0.0) + leader_cpu
             broker_bytes_in[leader] = (broker_bytes_in.get(leader, 0.0)
-                                       + part.nw_in)
+                                       + nw_in)
             broker_bytes_out[leader] = (broker_bytes_out.get(leader, 0.0)
-                                        + part.nw_out)
+                                        + nw_out)
             for b in pinfo.replicas:
-                broker_disk[b] = broker_disk.get(b, 0.0) + part.size_bytes
+                broker_disk[b] = broker_disk.get(b, 0.0) + size_bytes
                 if b != leader:
                     broker_repl_in[b] = (broker_repl_in.get(b, 0.0)
-                                         + part.nw_in)
-                    fcpu = estimate_follower_cpu(part.leader_cpu, part.nw_in,
-                                                 part.nw_out)
+                                         + nw_in)
+                    fcpu = estimate_follower_cpu(leader_cpu, nw_in, nw_out)
                     broker_cpu[b] = broker_cpu.get(b, 0.0) + fcpu
             broker_repl_out[leader] = (broker_repl_out.get(leader, 0.0)
-                                       + part.nw_in * n_followers)
+                                       + nw_in * n_followers)
 
             if (mode != SamplingMode.BROKER_METRICS_ONLY
                     and tp in assigned_partitions):
                 c = self._cid
                 values = complete_partition_values({
-                    c[MD.CPU_USAGE]: part.leader_cpu,
-                    c[MD.DISK_USAGE]: part.size_bytes,
-                    c[MD.LEADER_BYTES_IN]: part.nw_in,
-                    c[MD.LEADER_BYTES_OUT]: part.nw_out,
-                    c[MD.PRODUCE_RATE]: part.nw_in / 1024.0,
-                    c[MD.FETCH_RATE]: part.nw_out / 1024.0,
-                    c[MD.MESSAGE_IN_RATE]: part.nw_in / 512.0,
+                    c[MD.CPU_USAGE]: leader_cpu,
+                    c[MD.DISK_USAGE]: size_bytes,
+                    c[MD.LEADER_BYTES_IN]: nw_in,
+                    c[MD.LEADER_BYTES_OUT]: nw_out,
+                    c[MD.PRODUCE_RATE]: nw_in / 1024.0,
+                    c[MD.FETCH_RATE]: nw_out / 1024.0,
+                    c[MD.MESSAGE_IN_RATE]: nw_in / 512.0,
                 })
                 out.partition_samples.append(
                     PartitionMetricSample(leader, tp, t, values))

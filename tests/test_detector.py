@@ -1,0 +1,327 @@
+"""Detector plane tests.
+
+Models the reference's AnomalyDetectorTest.java (queue + self-healing flow,
+601 LoC, mock-based) and BrokerFailureDetectorTest.java (real ZK watch;
+here the SimulatedCluster liveness listener), plus unit tests for the
+notifier grace periods, slow-broker scoring, and balancedness score.
+"""
+import conftest  # noqa: F401
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.cluster.simulated import SimulatedCluster
+from cruise_control_tpu.core.anomaly import AnomalyType
+from cruise_control_tpu.detector import (
+    AnomalyDetector, AnomalyState, BrokerFailureDetector, BrokerFailures,
+    DiskFailureDetector, GoalViolationDetector, NoopNotifier,
+    SelfHealingNotifier, SlowBrokerFinder, SlowBrokerFinderConfig,
+    TopicReplicationFactorAnomalyFinder, balancedness_score)
+from cruise_control_tpu.detector.anomalies import GoalViolations
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _sim(brokers=4):
+    sim = SimulatedCluster()
+    for b in range(brokers):
+        sim.add_broker(b, rack=f"r{b % 2}")
+    return sim
+
+
+class TestBrokerFailureDetector:
+    def test_liveness_watch_reports_failures(self):
+        sim = _sim()
+        clock = FakeClock(100.0)
+        reports = []
+        det = BrokerFailureDetector(sim, reports.append, time_fn=clock)
+        det.start()
+        assert reports == []   # all alive at startup
+        sim.kill_broker(2)
+        assert len(reports) == 1
+        assert set(reports[0].failed_brokers_by_time_ms) == {2}
+        assert reports[0].failed_brokers_by_time_ms[2] == 100e3
+        # failure time sticks across subsequent events
+        clock.t = 200.0
+        sim.kill_broker(3)
+        assert set(reports[-1].failed_brokers_by_time_ms) == {2, 3}
+        assert reports[-1].failed_brokers_by_time_ms[2] == 100e3
+        # recovery clears the broker
+        sim.restart_broker(2)
+        assert set(reports[-1].failed_brokers_by_time_ms) == {3}
+        det.shutdown()
+
+    def test_persistence_across_restart(self, tmp_path):
+        from cruise_control_tpu.detector import FileFailedBrokerStore
+        sim = _sim()
+        clock = FakeClock(50.0)
+        store = FileFailedBrokerStore(str(tmp_path / "failed.json"))
+        det = BrokerFailureDetector(sim, lambda a: None, store=store,
+                                    time_fn=clock)
+        det.start()
+        sim.kill_broker(1)
+        det.shutdown()
+        # new detector instance sees the original failure time
+        clock.t = 500.0
+        det2 = BrokerFailureDetector(sim, lambda a: None, store=store,
+                                     time_fn=clock)
+        det2.start()
+        assert det2.failed_brokers()[1] == 50e3
+        det2.shutdown()
+
+    def test_unfixable_beyond_thresholds(self):
+        sim = _sim(4)
+        reports = []
+        det = BrokerFailureDetector(sim, reports.append,
+                                    fix_fn=lambda: True,
+                                    fixable_max_ratio=0.25)
+        det.start()
+        sim.kill_broker(0)
+        sim.kill_broker(1)   # 50% failed > 25% threshold
+        assert reports[-1].fix_fn is None
+        assert not reports[-1].fix()
+
+
+class TestDiskFailureDetector:
+    def test_offline_logdir_detected(self):
+        sim = SimulatedCluster()
+        for b in range(2):
+            sim.add_broker(b, logdirs=("/d0", "/d1"))
+        sim.create_topic("t", [[0, 1]])
+        reports = []
+        det = DiskFailureDetector(sim, reports.append)
+        assert det.detect_now() is None
+        sim.fail_disk(0, "/d1")
+        anomaly = det.detect_now()
+        assert anomaly is not None
+        assert anomaly.failed_disks_by_broker == {0: ["/d1"]}
+
+
+class TestSlowBrokerFinder:
+    def _history(self, n_brokers=4, n_windows=20, slow_broker=None,
+                 factor=10.0):
+        rng = np.random.default_rng(0)
+        flush = rng.uniform(1.0, 2.0, size=(n_brokers, n_windows))
+        bytes_in = np.full((n_brokers, n_windows), 1e6)
+        if slow_broker is not None:
+            flush[slow_broker, -1] *= factor
+        return flush, bytes_in
+
+    def test_detects_and_escalates(self):
+        reports = []
+        cfg = SlowBrokerFinderConfig(score_per_detection=1.0,
+                                     demotion_score=2.0, removal_score=4.0)
+        finder = SlowBrokerFinder(reports.append, cfg,
+                                  demote_fix_fn=lambda: True,
+                                  remove_fix_fn=lambda: True)
+        flush, bytes_in = self._history(slow_broker=1)
+        ids = [0, 1, 2, 3]
+        finder.detect_now(ids, flush, bytes_in)       # score 1: no anomaly
+        assert reports == [] and finder.slowness_scores == {1: 1.0}
+        finder.detect_now(ids, flush, bytes_in)       # score 2: demote
+        assert reports[-1].remove_slow_brokers is False
+        finder.detect_now(ids, flush, bytes_in)
+        finder.detect_now(ids, flush, bytes_in)       # score 4: remove
+        assert reports[-1].remove_slow_brokers is True
+
+    def test_score_decay_on_recovery(self):
+        reports = []
+        finder = SlowBrokerFinder(reports.append)
+        flush, bytes_in = self._history(slow_broker=2)
+        finder.detect_now([0, 1, 2, 3], flush, bytes_in)
+        assert finder.slowness_scores == {2: 1.0}
+        healthy_flush, _ = self._history(slow_broker=None)
+        finder.detect_now([0, 1, 2, 3], healthy_flush, bytes_in)
+        assert finder.slowness_scores == {}
+
+    def test_idle_broker_not_flagged(self):
+        reports = []
+        finder = SlowBrokerFinder(reports.append)
+        flush, bytes_in = self._history(slow_broker=0)
+        bytes_in[0, :] = 10.0   # idle: below min_bytes_in_rate
+        finder.detect_now([0, 1, 2, 3], flush, bytes_in)
+        assert finder.slowness_scores == {}
+
+
+class TestTopicAnomalyFinder:
+    def test_rf_mismatch(self):
+        sim = _sim(4)
+        sim.create_topic("good", [[0, 1, 2]])
+        sim.create_topic("bad", [[0, 1]])
+        reports = []
+        finder = TopicReplicationFactorAnomalyFinder(
+            sim, reports.append, target_replication_factor=3)
+        anomaly = finder.detect_now()
+        assert anomaly is not None and anomaly.topics == ["bad"]
+
+
+class TestSelfHealingNotifier:
+    def test_broker_failure_grace_periods(self):
+        clock = FakeClock(1000.0)
+        n = SelfHealingNotifier(
+            self_healing_enabled={AnomalyType.BROKER_FAILURE: True},
+            broker_failure_alert_threshold_ms=60e3,
+            broker_failure_auto_fix_threshold_ms=120e3,
+            time_fn=clock)
+        failure = BrokerFailures({1: 1000e3}, fix_fn=lambda: True)
+        # before alert threshold: CHECK with delay to the alert point
+        act = n.on_anomaly(failure)
+        assert act.result.value == "CHECK" and act.delay_ms == 60e3
+        # between thresholds: CHECK until auto-fix point
+        clock.t = 1000.0 + 90.0
+        act = n.on_anomaly(failure)
+        assert act.result.value == "CHECK"
+        # past auto-fix threshold: FIX
+        clock.t = 1000.0 + 121.0
+        assert n.on_anomaly(failure).result.value == "FIX"
+
+    def test_healing_disabled_ignores(self):
+        clock = FakeClock(0.0)
+        n = SelfHealingNotifier(time_fn=clock,
+                                broker_failure_alert_threshold_ms=0.0,
+                                broker_failure_auto_fix_threshold_ms=0.0)
+        failure = BrokerFailures({1: 0.0})
+        assert n.on_anomaly(failure).result.value == "IGNORE"
+
+    def test_other_anomaly_fixes_when_enabled(self):
+        n = SelfHealingNotifier(
+            self_healing_enabled={AnomalyType.GOAL_VIOLATION: True})
+        gv = GoalViolations(["DiskUsageDistributionGoal"], [],
+                            fix_fn=lambda: True)
+        assert n.on_anomaly(gv).result.value == "FIX"
+        assert n.set_self_healing_for(AnomalyType.GOAL_VIOLATION, False)
+        assert n.on_anomaly(gv).result.value == "IGNORE"
+
+
+class TestAnomalyDetectorQueue:
+    def test_priority_and_fix_flow(self):
+        clock = FakeClock(0.0)
+        notifier = SelfHealingNotifier(
+            self_healing_enabled={t: True for t in AnomalyType},
+            broker_failure_alert_threshold_ms=0.0,
+            broker_failure_auto_fix_threshold_ms=0.0,
+            time_fn=clock)
+        det = AnomalyDetector(notifier, time_fn=clock)
+        fixed = []
+        gv = GoalViolations(["g"], [], fix_fn=lambda: fixed.append("gv")
+                            or True)
+        bf = BrokerFailures({1: 0.0}, fix_fn=lambda: fixed.append("bf")
+                            or True)
+        det.report(gv)
+        det.report(bf)
+        statuses = det.process_all()
+        # broker failure has higher priority than goal violation
+        assert fixed == ["bf", "gv"]
+        assert statuses == [AnomalyState.FIX_STARTED] * 2
+
+    def test_check_with_delay_requeues(self):
+        clock = FakeClock(0.0)
+        notifier = SelfHealingNotifier(
+            self_healing_enabled={AnomalyType.BROKER_FAILURE: True},
+            broker_failure_alert_threshold_ms=10e3,
+            broker_failure_auto_fix_threshold_ms=10e3,
+            time_fn=clock)
+        det = AnomalyDetector(notifier, time_fn=clock)
+        fixed = []
+        det.report(BrokerFailures({1: 0.0},
+                                  fix_fn=lambda: fixed.append(1) or True))
+        assert det.process_once() == AnomalyState.CHECK_WITH_DELAY
+        assert det.process_once() is None        # not due yet
+        clock.t = 11.0
+        assert det.process_once() == AnomalyState.FIX_STARTED
+        assert fixed == [1]
+
+    def test_fix_blocked_while_execution_in_progress(self):
+        busy = [True]
+        det = AnomalyDetector(
+            SelfHealingNotifier(
+                self_healing_enabled={AnomalyType.GOAL_VIOLATION: True}),
+            fix_in_progress_fn=lambda: busy[0])
+        det.report(GoalViolations(["g"], [], fix_fn=lambda: True))
+        assert det.process_once() == AnomalyState.CHECK_WITH_DELAY
+
+    def test_not_ready_blocks_fix(self):
+        det = AnomalyDetector(
+            SelfHealingNotifier(
+                self_healing_enabled={AnomalyType.GOAL_VIOLATION: True}),
+            ready_fn=lambda: False)
+        det.report(GoalViolations(["g"], [], fix_fn=lambda: True))
+        assert det.process_once() == AnomalyState.LOAD_MONITOR_NOT_READY
+
+    def test_state_json(self):
+        det = AnomalyDetector(NoopNotifier())
+        det.report(GoalViolations(["g"], []))
+        det.process_all()
+        js = det.to_json()
+        assert js["recentAnomalies"]["GOAL_VIOLATION"][0]["status"] \
+            == "IGNORED"
+
+
+class TestBalancednessScore:
+    class _G:
+        def __init__(self, name, hard):
+            self.name, self.is_hard = name, hard
+
+    def test_score(self):
+        goals = [self._G("hard1", True), self._G("soft1", False)]
+        assert balancedness_score(goals, []) == 100.0
+        assert balancedness_score(goals, ["hard1", "soft1"]) == 0.0
+        partial = balancedness_score(goals, ["soft1"])
+        # violating only the soft goal costs less than half the score
+        assert 50.0 < partial < 100.0
+        assert balancedness_score([], []) == 100.0
+
+
+class TestGoalViolationDetectorEndToEnd:
+    def test_detects_on_unbalanced_fixture(self):
+        from cruise_control_tpu.analyzer.goals.registry import default_goals
+        from cruise_control_tpu.testing.fixtures import unbalanced_cluster
+
+        state, topo = unbalanced_cluster()
+
+        class FakeMonitor:
+            def cluster_model(self):
+                return state, topo
+
+        reports = []
+        det = GoalViolationDetector(FakeMonitor(), default_goals(),
+                                    reports.append)
+        anomaly = det.detect_now()
+        assert anomaly is not None
+        assert anomaly.fixable_violated_goals
+        assert det.last_balancedness_score < 100.0
+
+
+class TestReviewRegressions:
+    def test_not_ready_requeues_anomaly(self):
+        clock = FakeClock(0.0)
+        ready = [False]
+        det = AnomalyDetector(
+            SelfHealingNotifier(
+                self_healing_enabled={AnomalyType.GOAL_VIOLATION: True}),
+            ready_fn=lambda: ready[0], time_fn=clock)
+        fixed = []
+        det.report(GoalViolations(["g"], [],
+                                  fix_fn=lambda: fixed.append(1) or True))
+        assert det.process_once() == AnomalyState.LOAD_MONITOR_NOT_READY
+        # once the monitor is ready, the deferred anomaly must still heal
+        ready[0] = True
+        clock.t = 11.0
+        assert det.process_once() == AnomalyState.FIX_STARTED
+        assert fixed == [1]
+
+    def test_alert_fires_once_per_anomaly(self):
+        alerts = []
+        n = SelfHealingNotifier(
+            self_healing_enabled={AnomalyType.GOAL_VIOLATION: True},
+            alert_fn=lambda a, fix: alerts.append(a.anomaly_id))
+        gv = GoalViolations(["g"], [], fix_fn=lambda: True)
+        for _ in range(5):   # deferred re-checks must not re-alert
+            n.on_anomaly(gv)
+        assert alerts == [gv.anomaly_id]

@@ -1,0 +1,276 @@
+"""Executor plane tests against the simulated cluster.
+
+Models the reference's ExecutorTest (reference cruise-control/src/test/...
+/executor/ExecutorTest.java, 517 LoC, run against embedded Kafka+ZK):
+task lifecycle, phased execution, concurrency caps, dead-destination
+handling, and stop semantics — here against the in-process SimulatedCluster
+with a virtual clock driven by the executor's own sleeps.
+"""
+import conftest  # noqa: F401
+
+from cruise_control_tpu.analyzer.proposals import (ExecutionProposal,
+                                                   ReplicaPlacement)
+from cruise_control_tpu.cluster.simulated import SimulatedCluster
+from cruise_control_tpu.cluster.types import TopicPartition
+from cruise_control_tpu.executor import (
+    Executor, ExecutionTaskPlanner, ExecutorPhase,
+    PrioritizeLargeReplicaMovementStrategy,
+    PrioritizeSmallReplicaMovementStrategy, TaskState, TaskType,
+    strategy_from_names)
+from cruise_control_tpu.model.builder import PartitionId
+
+
+def _proposal(topic, part, old, new, old_leader=None, size=0.0,
+              logdirs_old=None, logdirs_new=None):
+    olds = tuple(ReplicaPlacement(b, (logdirs_old or {}).get(b))
+                 for b in old)
+    news = tuple(ReplicaPlacement(b, (logdirs_new or {}).get(b))
+                 for b in new)
+    return ExecutionProposal(
+        partition=PartitionId(topic, part),
+        old_leader=old_leader if old_leader is not None else old[0],
+        old_replicas=olds, new_replicas=news, partition_size=size)
+
+
+def _sim(num_brokers=4, logdirs=("/d0",)):
+    sim = SimulatedCluster()  # virtual clock
+    for b in range(num_brokers):
+        sim.add_broker(b, rack=f"r{b % 2}", logdirs=logdirs)
+    return sim
+
+
+def _executor(sim, **kw):
+    kw.setdefault("progress_check_interval_s", 1.0)
+    return Executor(sim, time_fn=lambda: sim.now_ms() / 1000.0,
+                    sleep_fn=sim.advance, **kw)
+
+
+class TestPlanner:
+    def test_task_decomposition(self):
+        planner = ExecutionTaskPlanner()
+        planner.add_proposals([
+            _proposal("t", 0, [0, 1], [2, 1]),            # replica move
+            _proposal("t", 1, [0, 1], [1, 0]),            # pure leader move
+            _proposal("t", 2, [0, 1], [0, 1],             # logdir move
+                      logdirs_old={0: "/d0"}, logdirs_new={0: "/d1"}),
+        ])
+        assert len(planner.remaining_inter_broker_tasks) == 1
+        assert len(planner.remaining_leadership_tasks) == 2  # t-0 and t-1
+        assert len(planner.remaining_intra_broker_tasks) == 1
+
+    def test_replica_move_with_leader_change_gets_both_tasks(self):
+        planner = ExecutionTaskPlanner()
+        planner.add_proposals([_proposal("t", 0, [0, 1], [2, 1],
+                                         old_leader=0)])
+        assert len(planner.remaining_inter_broker_tasks) == 1
+        assert len(planner.remaining_leadership_tasks) == 1
+
+    def test_concurrency_slots(self):
+        planner = ExecutionTaskPlanner()
+        planner.add_proposals([
+            _proposal("t", 0, [0], [1]),
+            _proposal("t", 1, [0], [1]),
+            _proposal("t", 2, [2], [3]),
+        ])
+        # 1 slot per broker: t-0 takes brokers {0,1}; t-1 blocked; t-2 free
+        picked = planner.pop_inter_broker_tasks({0: 1, 1: 1, 2: 1, 3: 1})
+        tps = {t.proposal.partition.partition for t in picked}
+        assert tps == {0, 2}
+
+
+class TestStrategies:
+    def test_ordering(self):
+        planner_small = ExecutionTaskPlanner(
+            PrioritizeSmallReplicaMovementStrategy())
+        planner_small.add_proposals([
+            _proposal("t", 0, [0], [1], size=100.0),
+            _proposal("t", 1, [0], [1], size=1.0),
+        ])
+        order = [t.proposal.partition.partition
+                 for t in planner_small.remaining_inter_broker_tasks]
+        assert order == [1, 0]
+
+        planner_large = ExecutionTaskPlanner(
+            PrioritizeLargeReplicaMovementStrategy())
+        planner_large.add_proposals([
+            _proposal("t", 0, [0], [1], size=100.0),
+            _proposal("t", 1, [0], [1], size=1.0),
+        ])
+        order = [t.proposal.partition.partition
+                 for t in planner_large.remaining_inter_broker_tasks]
+        assert order == [0, 1]
+
+    def test_strategy_from_names(self):
+        s = strategy_from_names(["PrioritizeSmallReplicaMovementStrategy"])
+        assert s.name() == "PrioritizeSmallReplicaMovementStrategy"
+
+
+class TestExecutionEndToEnd:
+    def test_replica_and_leader_movement(self):
+        sim = _sim()
+        sim.create_topic("t", [[0, 1], [1, 2]], size_bytes=50e6)
+        ex = _executor(sim)
+        proposals = [
+            _proposal("t", 0, [0, 1], [2, 1], old_leader=0, size=50e6),
+            _proposal("t", 1, [1, 2], [2, 1], old_leader=1, size=50e6),
+        ]
+        ex.execute_proposals(proposals, reason="test", wait=True)
+        snap = sim.describe_cluster()
+        p0 = snap.partition(TopicPartition("t", 0))
+        p1 = snap.partition(TopicPartition("t", 1))
+        assert set(p0.replicas) == {1, 2} and p0.leader == 2
+        assert set(p1.replicas) == {1, 2} and p1.leader == 2
+        assert ex.state.phase == ExecutorPhase.NO_TASK_IN_PROGRESS
+        assert not ex.has_ongoing_execution
+
+    def test_progress_counters_and_notifier(self):
+        sim = _sim()
+        sim.create_topic("t", [[0, 1]], size_bytes=10e6)
+        finished = []
+
+        class Notifier:
+            def on_execution_finished(self, uuid, ok, msg):
+                finished.append((uuid, ok, msg))
+
+        ex = _executor(sim, notifier=Notifier())
+        uuid = ex.execute_proposals(
+            [_proposal("t", 0, [0, 1], [2, 1], size=10e6)], wait=True)
+        assert finished == [(uuid, True, "execution completed")]
+
+    def test_dead_destination_broker_kills_task(self):
+        sim = _sim()
+        sim.create_topic("t", [[0, 1]], size_bytes=10e6)
+        sim.kill_broker(3)
+        ex = _executor(sim)
+        ex.execute_proposals(
+            [_proposal("t", 0, [0, 1], [3, 1], size=10e6)], wait=True)
+        snap = sim.describe_cluster()
+        # task should be DEAD, replicas unchanged
+        assert set(snap.partition(TopicPartition("t", 0)).replicas) == {0, 1}
+
+    def test_concurrent_execution_rejected(self):
+        sim = _sim()
+        sim.create_topic("t", [[0, 1]], size_bytes=1e12)  # slow move
+        ex = _executor(sim)
+        ex.execute_proposals([_proposal("t", 0, [0, 1], [2, 1], size=1e12)])
+        try:
+            import pytest
+            with pytest.raises(RuntimeError):
+                ex.execute_proposals(
+                    [_proposal("t", 0, [0, 1], [3, 1], size=1e12)])
+        finally:
+            ex.stop_execution(force=True)
+            assert ex.await_completion(timeout=30.0)
+
+    def test_force_stop_cancels_reassignment(self):
+        sim = _sim()
+        sim.create_topic("t", [[0, 1]], size_bytes=1e12)
+        ex = _executor(sim)
+        # trip the stop from inside the executor's own sleep so the test is
+        # deterministic under the virtual clock
+        calls = []
+        orig_sleep = ex._sleep
+
+        def stopping_sleep(s):
+            calls.append(s)
+            if len(calls) == 1:
+                ex.stop_execution(force=True)
+            orig_sleep(s)
+        ex._sleep = stopping_sleep
+        ex.execute_proposals([_proposal("t", 0, [0, 1], [2, 1], size=1e12)],
+                             wait=True)
+        assert sim.list_partition_reassignments() == []
+        snap = sim.describe_cluster()
+        assert set(snap.partition(TopicPartition("t", 0)).replicas) == {0, 1}
+        assert ex.state.phase == ExecutorPhase.NO_TASK_IN_PROGRESS
+
+    def test_throttle_applied_and_cleared(self):
+        sim = _sim()
+        sim.create_topic("t", [[0, 1]], size_bytes=100e6)
+        ex = _executor(sim, replication_throttle_bytes_per_s=10e6)
+        ex.execute_proposals([_proposal("t", 0, [0, 1], [2, 1], size=100e6)],
+                             wait=True)
+        # finished despite throttle; throttles cleared afterwards
+        snap = sim.describe_cluster()
+        assert set(snap.partition(TopicPartition("t", 0)).replicas) == {1, 2}
+        assert all(b.throttle is None for b in sim._brokers.values())
+
+    def test_intra_broker_logdir_move(self):
+        sim = _sim(logdirs=("/d0", "/d1"))
+        sim.create_topic("t", [[0, 1]], size_bytes=10e6)
+        ex = _executor(sim)
+        ex.execute_proposals([
+            _proposal("t", 0, [0, 1], [0, 1],
+                      logdirs_old={0: "/d0"}, logdirs_new={0: "/d1"},
+                      size=10e6)], wait=True)
+        snap = sim.describe_cluster()
+        assert snap.partition(
+            TopicPartition("t", 0)).logdir_by_broker[0] == "/d1"
+
+    def test_removal_history(self):
+        sim = _sim()
+        sim.create_topic("t", [[0, 1]], size_bytes=1e6)
+        ex = _executor(sim)
+        ex.execute_proposals([_proposal("t", 0, [0, 1], [2, 1], size=1e6)],
+                             removed_brokers=[0], demoted_brokers=[1],
+                             wait=True)
+        assert ex.recently_removed_brokers() == {0}
+        assert ex.recently_demoted_brokers() == {1}
+        ex.drop_recently_removed_brokers([0])
+        assert ex.recently_removed_brokers() == set()
+
+
+class TestTaskStateMachine:
+    def test_illegal_transition_raises(self):
+        import pytest
+        from cruise_control_tpu.executor.task import ExecutionTask
+        t = ExecutionTask(ExecutionTask.next_id(),
+                          _proposal("t", 0, [0], [1]),
+                          TaskType.INTER_BROKER_REPLICA_ACTION)
+        with pytest.raises(ValueError):
+            t.completed(0.0)  # PENDING -> COMPLETED is illegal
+        t.in_progress(0.0)
+        t.completed(1.0)
+        assert t.done and t.state == TaskState.COMPLETED
+
+
+class TestReviewRegressions:
+    def test_slow_transfer_completes_without_reexecution(self):
+        # transfer takes far longer than the idle budget: the executor must
+        # wait it out, not reset progress by re-submitting
+        sim = _sim()
+        sim.create_topic("t", [[0, 1]], size_bytes=100e6)
+        sim._move_rate = 1e6   # 100 s transfer
+        ex = _executor(sim, max_task_execution_idle_s=5.0)
+        ex.execute_proposals([_proposal("t", 0, [0, 1], [2, 1], size=100e6)],
+                             wait=True)
+        snap = sim.describe_cluster()
+        assert set(snap.partition(TopicPartition("t", 0)).replicas) == {1, 2}
+        task = [t for t in ex._manager._planner.all_tasks()
+                if t.task_type == TaskType.INTER_BROKER_REPLICA_ACTION][0]
+        assert task.reexecution_count == 0
+
+    def test_lost_reassignment_is_reexecuted(self):
+        sim = _sim()
+        sim.create_topic("t", [[0, 1]], size_bytes=100e6)
+        sim._move_rate = 10e6
+        ex = _executor(sim)
+        # cancel the reassignment out from under the executor once,
+        # from inside its own sleep (deterministic under virtual time)
+        cancelled = []
+        orig_sleep = ex._sleep
+
+        def sabotaging_sleep(s):
+            orig_sleep(s)
+            if not cancelled and sim.list_partition_reassignments():
+                sim.alter_partition_reassignments(
+                    {TopicPartition("t", 0): None})
+                cancelled.append(True)
+        ex._sleep = sabotaging_sleep
+        ex.execute_proposals([_proposal("t", 0, [0, 1], [2, 1], size=100e6)],
+                             wait=True)
+        snap = sim.describe_cluster()
+        assert set(snap.partition(TopicPartition("t", 0)).replicas) == {1, 2}
+        task = [t for t in ex._manager._planner.all_tasks()
+                if t.task_type == TaskType.INTER_BROKER_REPLICA_ACTION][0]
+        assert task.reexecution_count >= 1
