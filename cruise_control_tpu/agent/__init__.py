@@ -1,0 +1,22 @@
+"""In-node metrics reporter agent + monitor-side processing
+(SURVEY.md §2.9 / §2.4): the production-shaped metric pipeline —
+agent samples node metrics -> serialized records -> transport ->
+processor -> aggregator samples."""
+from cruise_control_tpu.agent.metrics import (AgentMetric, MetricScope,
+                                              RawMetricType, deserialize,
+                                              serialize)
+from cruise_control_tpu.agent.processor import (AgentMetricsReporterSampler,
+                                                BrokerLoad, MetricsProcessor)
+from cruise_control_tpu.agent.reporter import (MetricsReporterAgent,
+                                               NodeMetricsSource,
+                                               SimulatedNodeMetricsSource)
+from cruise_control_tpu.agent.transport import (InProcessMetricsTransport,
+                                                MetricsTransport)
+
+__all__ = [
+    "AgentMetric", "MetricScope", "RawMetricType", "serialize",
+    "deserialize", "MetricsReporterAgent", "NodeMetricsSource",
+    "SimulatedNodeMetricsSource", "MetricsTransport",
+    "InProcessMetricsTransport", "MetricsProcessor", "BrokerLoad",
+    "AgentMetricsReporterSampler",
+]

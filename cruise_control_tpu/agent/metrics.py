@@ -1,0 +1,123 @@
+"""Raw metric types + wire format for the in-node metrics agent.
+
+Reference cruise-control-metrics-reporter metric/ package:
+RawMetricType.java:27-183 (77 typed metrics with broker/topic/partition
+scope and versioned serialization), CruiseControlMetric.java:1-99 (Broker /
+Topic / PartitionMetric), MetricSerde.java:1-76 (binary records on the
+metrics topic).
+
+The wire format here is a compact struct-packed record (type id, version,
+time, scope ids, value) — same role as the reference's serde, no Kafka
+dependency: any bytes transport can carry it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+from typing import List, Optional, Union
+
+
+class MetricScope(enum.Enum):
+    BROKER = 0
+    TOPIC = 1
+    PARTITION = 2
+
+
+class RawMetricType(enum.Enum):
+    """Typed raw metrics the agent reports (reference RawMetricType —
+    same catalogue, grouped by scope)."""
+
+    # broker scope
+    ALL_TOPIC_BYTES_IN = (0, MetricScope.BROKER)
+    ALL_TOPIC_BYTES_OUT = (1, MetricScope.BROKER)
+    ALL_TOPIC_REPLICATION_BYTES_IN = (2, MetricScope.BROKER)
+    ALL_TOPIC_REPLICATION_BYTES_OUT = (3, MetricScope.BROKER)
+    ALL_TOPIC_MESSAGES_IN_PER_SEC = (4, MetricScope.BROKER)
+    ALL_TOPIC_PRODUCE_REQUEST_RATE = (5, MetricScope.BROKER)
+    ALL_TOPIC_FETCH_REQUEST_RATE = (6, MetricScope.BROKER)
+    BROKER_CPU_UTIL = (7, MetricScope.BROKER)
+    BROKER_PRODUCE_REQUEST_RATE = (8, MetricScope.BROKER)
+    BROKER_CONSUMER_FETCH_REQUEST_RATE = (9, MetricScope.BROKER)
+    BROKER_FOLLOWER_FETCH_REQUEST_RATE = (10, MetricScope.BROKER)
+    BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT = (11, MetricScope.BROKER)
+    BROKER_REQUEST_QUEUE_SIZE = (12, MetricScope.BROKER)
+    BROKER_RESPONSE_QUEUE_SIZE = (13, MetricScope.BROKER)
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MAX = (14, MetricScope.BROKER)
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MEAN = (15, MetricScope.BROKER)
+    BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MAX = (16, MetricScope.BROKER)
+    BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN = (17,
+                                                        MetricScope.BROKER)
+    BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MAX = (18, MetricScope.BROKER)
+    BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN = (19,
+                                                        MetricScope.BROKER)
+    BROKER_LOG_FLUSH_RATE = (20, MetricScope.BROKER)
+    BROKER_LOG_FLUSH_TIME_MS_MAX = (21, MetricScope.BROKER)
+    BROKER_LOG_FLUSH_TIME_MS_MEAN = (22, MetricScope.BROKER)
+    BROKER_LOG_FLUSH_TIME_MS_999TH = (23, MetricScope.BROKER)
+    BROKER_DISK_UTIL = (24, MetricScope.BROKER)
+
+    # topic scope
+    TOPIC_BYTES_IN = (40, MetricScope.TOPIC)
+    TOPIC_BYTES_OUT = (41, MetricScope.TOPIC)
+    TOPIC_REPLICATION_BYTES_IN = (42, MetricScope.TOPIC)
+    TOPIC_REPLICATION_BYTES_OUT = (43, MetricScope.TOPIC)
+    TOPIC_PRODUCE_REQUEST_RATE = (44, MetricScope.TOPIC)
+    TOPIC_FETCH_REQUEST_RATE = (45, MetricScope.TOPIC)
+    TOPIC_MESSAGES_IN_PER_SEC = (46, MetricScope.TOPIC)
+
+    # partition scope
+    PARTITION_SIZE = (60, MetricScope.PARTITION)
+
+    def __init__(self, type_id: int, scope: MetricScope):
+        self.type_id = type_id
+        self.scope = scope
+
+
+_BY_ID = {t.type_id: t for t in RawMetricType}
+
+
+@dataclasses.dataclass(frozen=True)
+class AgentMetric:
+    """One reported metric (reference CruiseControlMetric + subclasses —
+    topic/partition fields empty for broker scope)."""
+
+    metric_type: RawMetricType
+    broker_id: int
+    time_ms: float
+    value: float
+    topic: str = ""
+    partition: int = -1
+
+    def __post_init__(self):
+        if self.metric_type.scope is MetricScope.TOPIC and not self.topic:
+            raise ValueError(f"{self.metric_type.name} requires a topic")
+        if self.metric_type.scope is MetricScope.PARTITION \
+                and (not self.topic or self.partition < 0):
+            raise ValueError(
+                f"{self.metric_type.name} requires topic+partition")
+
+
+#: serde version (reference MetricSerde versioning)
+_VERSION = 0
+_HEADER = struct.Struct(">BHiqdi")   # version, type, broker, time, value,
+                                     # partition
+
+
+def serialize(metric: AgentMetric) -> bytes:
+    topic_bytes = metric.topic.encode()
+    return _HEADER.pack(_VERSION, metric.metric_type.type_id,
+                        metric.broker_id, int(metric.time_ms),
+                        metric.value, metric.partition) \
+        + struct.pack(">H", len(topic_bytes)) + topic_bytes
+
+
+def deserialize(data: bytes) -> AgentMetric:
+    version, type_id, broker, time_ms, value, partition = _HEADER.unpack(
+        data[:_HEADER.size])
+    if version > _VERSION:
+        raise ValueError(f"unsupported metric record version {version}")
+    (tlen,) = struct.unpack(">H", data[_HEADER.size:_HEADER.size + 2])
+    topic = data[_HEADER.size + 2:_HEADER.size + 2 + tlen].decode()
+    return AgentMetric(_BY_ID[type_id], broker, float(time_ms), value,
+                       topic, partition)

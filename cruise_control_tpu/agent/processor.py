@@ -1,0 +1,192 @@
+"""Monitor-side processing of agent metric records.
+
+Reference CC/monitor/sampling/CruiseControlMetricsProcessor.java:1-208 +
+holder/BrokerLoad.java:1-330 and CruiseControlMetricsReporterSampler.java:
+41-253: consume typed records from the metrics transport, accumulate them
+per broker (BrokerLoad), attribute broker CPU to leader partitions by
+byte-rate ratio (ModelUtils.estimateLeaderCpuUtil), and emit the
+Partition/BrokerMetricSamples the aggregators consume.
+
+`AgentMetricsReporterSampler` is the production-shaped MetricSampler: the
+same role the reference's default sampler plays, with the transport SPI in
+place of the Kafka consumer.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+from typing import Dict, List, Optional, Set, Tuple
+
+from cruise_control_tpu.agent.metrics import (AgentMetric, MetricScope,
+                                              RawMetricType, deserialize)
+from cruise_control_tpu.agent.transport import MetricsTransport
+from cruise_control_tpu.cluster.types import ClusterSnapshot, TopicPartition
+from cruise_control_tpu.model.builder import estimate_follower_cpu
+from cruise_control_tpu.monitor import metricdef as MD
+from cruise_control_tpu.monitor.sampling.holder import (
+    BrokerMetricSample, PartitionMetricSample, complete_broker_values,
+    complete_partition_values)
+from cruise_control_tpu.monitor.sampling.sampler import (MetricSampler,
+                                                         Samples,
+                                                         SamplingMode)
+
+LOG = logging.getLogger(__name__)
+
+T = RawMetricType
+
+
+class BrokerLoad:
+    """Accumulates one broker's raw metrics for a processing round
+    (reference holder/BrokerLoad.java)."""
+
+    def __init__(self) -> None:
+        self.broker_metrics: Dict[RawMetricType, float] = {}
+        #: (topic) -> bytes in/out
+        self.topic_bytes: Dict[str, Tuple[float, float]] = {}
+        #: (topic, partition) -> size bytes
+        self.partition_size: Dict[Tuple[str, int], float] = {}
+        self.latest_time_ms: float = 0.0
+
+    def record(self, m: AgentMetric) -> None:
+        self.latest_time_ms = max(self.latest_time_ms, m.time_ms)
+        if m.metric_type.scope is MetricScope.BROKER:
+            self.broker_metrics[m.metric_type] = m.value
+        elif m.metric_type.scope is MetricScope.TOPIC:
+            tin, tout = self.topic_bytes.get(m.topic, (0.0, 0.0))
+            if m.metric_type is T.TOPIC_BYTES_IN:
+                tin = m.value
+            elif m.metric_type is T.TOPIC_BYTES_OUT:
+                tout = m.value
+            self.topic_bytes[m.topic] = (tin, tout)
+        elif m.metric_type is T.PARTITION_SIZE:
+            self.partition_size[(m.topic, m.partition)] = m.value
+
+    def get(self, metric_type: RawMetricType, default: float = 0.0) -> float:
+        return self.broker_metrics.get(metric_type, default)
+
+
+class MetricsProcessor:
+    """Turns a batch of agent records into aggregator samples."""
+
+    def __init__(self) -> None:
+        cdef = MD.common_metric_def()
+        self._cid = {name: cdef.metric_id(name) for name in
+                     (MD.CPU_USAGE, MD.DISK_USAGE, MD.LEADER_BYTES_IN,
+                      MD.LEADER_BYTES_OUT, MD.PRODUCE_RATE, MD.FETCH_RATE,
+                      MD.MESSAGE_IN_RATE)}
+        bdef = MD.broker_metric_def()
+        self._bid = {name: bdef.metric_id(name) for name in
+                     (MD.CPU_USAGE, MD.DISK_USAGE, MD.LEADER_BYTES_IN,
+                      MD.LEADER_BYTES_OUT, MD.REPLICATION_BYTES_IN_RATE,
+                      MD.REPLICATION_BYTES_OUT_RATE,
+                      MD.BROKER_LOG_FLUSH_TIME_MS_999TH,
+                      MD.BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT)}
+
+    def process(self, records: List[AgentMetric],
+                cluster: ClusterSnapshot,
+                assigned_partitions: Optional[Set[TopicPartition]] = None,
+                mode: SamplingMode = SamplingMode.ALL) -> Samples:
+        loads: Dict[int, BrokerLoad] = collections.defaultdict(BrokerLoad)
+        for m in records:
+            loads[m.broker_id].record(m)
+
+        out = Samples()
+        if mode != SamplingMode.PARTITION_METRICS_ONLY:
+            for bid, load in loads.items():
+                b = self._bid
+                out.broker_samples.append(BrokerMetricSample(
+                    bid, load.latest_time_ms, complete_broker_values({
+                        b[MD.CPU_USAGE]: load.get(T.BROKER_CPU_UTIL),
+                        b[MD.DISK_USAGE]: load.get(T.BROKER_DISK_UTIL),
+                        b[MD.LEADER_BYTES_IN]:
+                            load.get(T.ALL_TOPIC_BYTES_IN),
+                        b[MD.LEADER_BYTES_OUT]:
+                            load.get(T.ALL_TOPIC_BYTES_OUT),
+                        b[MD.REPLICATION_BYTES_IN_RATE]:
+                            load.get(T.ALL_TOPIC_REPLICATION_BYTES_IN),
+                        b[MD.REPLICATION_BYTES_OUT_RATE]:
+                            load.get(T.ALL_TOPIC_REPLICATION_BYTES_OUT),
+                        b[MD.BROKER_LOG_FLUSH_TIME_MS_999TH]:
+                            load.get(T.BROKER_LOG_FLUSH_TIME_MS_999TH),
+                        b[MD.BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT]:
+                            load.get(
+                                T.BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT),
+                    })))
+        if mode == SamplingMode.BROKER_METRICS_ONLY:
+            return out
+
+        # partition samples: per-partition bytes shares of the topic's
+        # bytes, CPU attributed from broker CPU by byte-rate ratio
+        # (reference estimateLeaderCpuUtil, ModelUtils.java:41-70).
+        # a broker's TOPIC_BYTES_* covers only partitions it LEADS, so the
+        # per-partition share divides by its led-partition count (its
+        # PARTITION_SIZE records also cover followed partitions)
+        led_count: Dict[Tuple[int, str], int] = collections.defaultdict(int)
+        for pinfo in cluster.partitions:
+            if pinfo.leader is not None:
+                led_count[(pinfo.leader, pinfo.tp.topic)] += 1
+        for pinfo in cluster.partitions:
+            tp = pinfo.tp
+            leader = pinfo.leader
+            if leader is None or leader not in loads:
+                continue
+            if assigned_partitions is not None \
+                    and tp not in assigned_partitions:
+                continue
+            load = loads[leader]
+            size = load.partition_size.get((tp.topic, tp.partition))
+            if size is None:
+                continue   # leader reported nothing for this partition
+            topic_in, topic_out = load.topic_bytes.get(tp.topic, (0.0, 0.0))
+            share = 1.0 / max(led_count[(leader, tp.topic)], 1)
+            p_in = topic_in * share
+            p_out = topic_out * share
+            broker_in = load.get(T.ALL_TOPIC_BYTES_IN)
+            broker_out = load.get(T.ALL_TOPIC_BYTES_OUT)
+            cpu = load.get(T.BROKER_CPU_UTIL)
+            denom = broker_in + broker_out
+            p_cpu = cpu * ((p_in + p_out) / denom) if denom > 0 else 0.0
+            c = self._cid
+            out.partition_samples.append(PartitionMetricSample(
+                leader, tp, load.latest_time_ms,
+                complete_partition_values({
+                    c[MD.CPU_USAGE]: p_cpu,
+                    c[MD.DISK_USAGE]: size,
+                    c[MD.LEADER_BYTES_IN]: p_in,
+                    c[MD.LEADER_BYTES_OUT]: p_out,
+                    c[MD.PRODUCE_RATE]: p_in / 1024.0,
+                    c[MD.FETCH_RATE]: p_out / 1024.0,
+                    c[MD.MESSAGE_IN_RATE]: p_in / 512.0,
+                })))
+        return out
+
+
+class AgentMetricsReporterSampler(MetricSampler):
+    """Default production-shaped sampler: drains the metrics transport and
+    processes records into samples (reference
+    CruiseControlMetricsReporterSampler)."""
+
+    def __init__(self, transport: MetricsTransport,
+                 max_records_per_round: int = 1_000_000) -> None:
+        self._transport = transport
+        self._max_records = max_records_per_round
+        self._processor = MetricsProcessor()
+
+    def get_samples(self, cluster: ClusterSnapshot,
+                    assigned_partitions: Set[TopicPartition],
+                    start_ms: float, end_ms: float,
+                    mode: SamplingMode = SamplingMode.ALL) -> Samples:
+        raw = self._transport.poll(self._max_records)
+        records = []
+        for data in raw:
+            try:
+                # no time filtering: the aggregator buckets each sample by
+                # its own timestamp, so late records land in their window
+                records.append(deserialize(data))
+            except Exception:  # noqa: BLE001 - skip corrupt records
+                LOG.warning("dropping undeserializable metric record")
+        return self._processor.process(records, cluster,
+                                       assigned_partitions, mode)
+
+    def close(self) -> None:
+        self._transport.close()

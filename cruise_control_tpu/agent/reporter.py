@@ -1,0 +1,141 @@
+"""The in-node metrics reporter agent.
+
+Reference cruise-control-metrics-reporter/CruiseControlMetricsReporter.java:
+59-369 — a plugin running INSIDE each managed broker that samples the
+node's internal metrics on an interval and produces typed records to the
+metrics topic.  Here the node-metrics source is an SPI (the reference's
+Yammer-registry walk, MetricsUtils.java:1-469, becomes `NodeMetricsSource`)
+and the sink is the MetricsTransport.
+"""
+from __future__ import annotations
+
+import abc
+import logging
+import threading
+import time as _time
+from typing import Callable, List, Optional
+
+from cruise_control_tpu.agent.metrics import AgentMetric, serialize
+from cruise_control_tpu.agent.transport import MetricsTransport
+
+LOG = logging.getLogger(__name__)
+
+
+class NodeMetricsSource(abc.ABC):
+    """Where the agent reads its node's current metrics from (the
+    reference's YammerMetricProcessor walk over kafka.server metrics)."""
+
+    @abc.abstractmethod
+    def collect(self, now_ms: float) -> List[AgentMetric]: ...
+
+
+class MetricsReporterAgent:
+    """Periodic sampler -> transport producer."""
+
+    def __init__(self, source: NodeMetricsSource,
+                 transport: MetricsTransport,
+                 reporting_interval_s: float = 60.0,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._source = source
+        self._transport = transport
+        self._interval_s = reporting_interval_s
+        self._time = time_fn or _time.time
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def report_once(self) -> int:
+        """One reporting round; returns the number of records produced."""
+        now_ms = self._time() * 1000.0
+        try:
+            metrics = self._source.collect(now_ms)
+        except Exception:  # noqa: BLE001 - node introspection is best-effort
+            LOG.exception("metric collection failed")
+            return 0
+        if not metrics:
+            return 0
+        self._transport.produce([serialize(m) for m in metrics])
+        return len(metrics)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(self._interval_s):
+                self.report_once()
+
+        self._thread = threading.Thread(target=loop,
+                                        name="metrics-reporter-agent",
+                                        daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class SimulatedNodeMetricsSource(NodeMetricsSource):
+    """Reads one broker's metrics out of the SimulatedCluster — the
+    demo/test stand-in for the reference's Yammer registry walk."""
+
+    def __init__(self, cluster, broker_id: int,
+                 cores: float = 1.0) -> None:
+        self._cluster = cluster
+        self._broker_id = broker_id
+        self._cores = cores
+
+    def collect(self, now_ms: float) -> List[AgentMetric]:
+        from cruise_control_tpu.agent.metrics import RawMetricType as T
+        bid = self._broker_id
+        snapshot = self._cluster.describe_cluster()
+        broker = snapshot.broker(bid)
+        if broker is None or not broker.alive:
+            return []
+        bytes_in = bytes_out = repl_in = repl_out = cpu = disk = 0.0
+        out: List[AgentMetric] = []
+        per_topic = {}
+        with self._cluster._lock:   # test-harness internal access
+            parts = {tp: (p.leader, list(p.replicas), p.leader_cpu,
+                          p.nw_in, p.nw_out, p.size_bytes)
+                     for tp, p in self._cluster._partitions.items()}
+        for tp, (leader, replicas, leader_cpu, nw_in, nw_out,
+                 size) in parts.items():
+            if bid == leader:
+                bytes_in += nw_in
+                bytes_out += nw_out
+                repl_out += nw_in * max(0, len(replicas) - 1)
+                cpu += leader_cpu
+                t = per_topic.setdefault(tp.topic, [0.0, 0.0])
+                t[0] += nw_in
+                t[1] += nw_out
+            if bid in replicas:
+                disk += size
+                if bid != leader:
+                    repl_in += nw_in
+                    cpu += 0.1 * leader_cpu
+                out.append(AgentMetric(T.PARTITION_SIZE, bid, now_ms, size,
+                                       topic=tp.topic,
+                                       partition=tp.partition))
+        out.extend([
+            AgentMetric(T.ALL_TOPIC_BYTES_IN, bid, now_ms, bytes_in),
+            AgentMetric(T.ALL_TOPIC_BYTES_OUT, bid, now_ms, bytes_out),
+            AgentMetric(T.ALL_TOPIC_REPLICATION_BYTES_IN, bid, now_ms,
+                        repl_in),
+            AgentMetric(T.ALL_TOPIC_REPLICATION_BYTES_OUT, bid, now_ms,
+                        repl_out),
+            AgentMetric(T.BROKER_CPU_UTIL, bid, now_ms,
+                        min(100.0 * self._cores, cpu)),
+            AgentMetric(T.BROKER_DISK_UTIL, bid, now_ms, disk),
+            AgentMetric(T.BROKER_LOG_FLUSH_TIME_MS_999TH, bid, now_ms, 1.0),
+            AgentMetric(T.BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT, bid,
+                        now_ms, max(0.0, 1.0 - cpu / 100.0)),
+        ])
+        for topic, (tin, tout) in per_topic.items():
+            out.append(AgentMetric(T.TOPIC_BYTES_IN, bid, now_ms, tin,
+                                   topic=topic))
+            out.append(AgentMetric(T.TOPIC_BYTES_OUT, bid, now_ms, tout,
+                                   topic=topic))
+        return out

@@ -1,0 +1,50 @@
+"""Metrics transport SPI: how agent records travel to the monitor.
+
+The reference uses a Kafka topic (`__CruiseControlMetrics`) written by an
+in-broker producer and read by a consumer in the service
+(CruiseControlMetricsReporter.java:59-369 /
+CruiseControlMetricsReporterSampler.java:41-253).  Here the channel is an
+SPI: `InProcessMetricsTransport` for tests/demos, and any durable queue
+(Kafka, PubSub, a file) can implement the two methods for production.
+Records are the serialized bytes from agent.metrics — the transport never
+needs to understand them.
+"""
+from __future__ import annotations
+
+import abc
+import collections
+import threading
+from typing import Deque, List
+
+
+class MetricsTransport(abc.ABC):
+    @abc.abstractmethod
+    def produce(self, records: List[bytes]) -> None:
+        """Publish serialized metric records."""
+
+    @abc.abstractmethod
+    def poll(self, max_records: int = 10_000) -> List[bytes]:
+        """Consume up to max_records pending records (at-most-once)."""
+
+    def close(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+
+class InProcessMetricsTransport(MetricsTransport):
+    """Bounded in-memory queue (drops oldest on overflow, mirroring a
+    retention-limited topic)."""
+
+    def __init__(self, capacity: int = 1_000_000) -> None:
+        self._lock = threading.Lock()
+        self._queue: Deque[bytes] = collections.deque(maxlen=capacity)
+
+    def produce(self, records: List[bytes]) -> None:
+        with self._lock:
+            self._queue.extend(records)
+
+    def poll(self, max_records: int = 10_000) -> List[bytes]:
+        with self._lock:
+            out = []
+            while self._queue and len(out) < max_records:
+                out.append(self._queue.popleft())
+            return out

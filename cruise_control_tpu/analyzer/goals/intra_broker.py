@@ -1,0 +1,180 @@
+"""Intra-broker (JBOD) disk goals.
+
+Reference CC/analyzer/goals/IntraBrokerDiskCapacityGoal.java:41 (hard: no
+logdir above its capacity threshold) and
+IntraBrokerDiskUsageDistributionGoal.java:46 (soft: balance usage across a
+broker's logdirs).  Both act on the disk axis only — replicas move between
+logdirs of their own broker, broker-level loads are untouched, so
+inter-broker goals never need to re-accept these actions (the reference's
+actionAcceptance for INTRA_BROKER_REPLICA_MOVEMENT is broker-local too).
+
+Kernel shape: per-disk loads are one segment-sum over the replica axis;
+each round the most-overloaded logdir of every broker sheds its
+best-scoring replica to the broker's least-loaded alive logdir — all
+brokers in parallel, one scatter to commit.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import OptimizationContext
+from cruise_control_tpu.analyzer.goals.base import Goal
+from cruise_control_tpu.analyzer.kernels import (per_segment_argmax,
+                                                 shed_score)
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.state import ClusterState
+
+
+def _disk_move_round(st: ClusterState, ctx: OptimizationContext,
+                     over_amount: jax.Array,
+                     dest_bound: jax.Array
+                     ) -> Tuple[ClusterState, jax.Array]:
+    """One round: for every broker whose worst logdir is over, move one
+    replica to the broker's best logdir.
+
+    over_amount: f32[D] how much each disk wants to shed (<=0: balanced).
+    dest_bound: f32[D] max post-move load per destination disk.
+    """
+    num_b = st.num_brokers
+    num_d = st.num_disks
+    dload = S.disk_load(st)
+    w = ctx_replica_disk_load(st)
+
+    # worst over-loaded disk per broker
+    src_disk, _, src_has = per_segment_argmax(
+        over_amount, st.disk_broker, num_b,
+        st.disk_alive & (over_amount > 0))
+    # best (least-loaded, alive) destination disk per broker
+    dest_disk, _, dest_has = per_segment_argmax(
+        -dload, st.disk_broker, num_b, st.disk_alive)
+
+    src_safe = jnp.maximum(src_disk, 0)
+    dest_safe = jnp.maximum(dest_disk, 0)
+
+    # candidate replica on each broker's source disk
+    on_disk = jnp.maximum(st.replica_disk, 0)
+    movable = (st.replica_valid & (st.replica_disk >= 0)
+               & ~ctx.replica_excluded)
+    score = shed_score(w, over_amount[on_disk])
+    r_of_disk, _, r_has = per_segment_argmax(score, on_disk, num_d, movable)
+
+    cand_r = r_of_disk[src_safe]                       # i32[B]
+    cand_r_safe = jnp.maximum(cand_r, 0)
+    cand_w = w[cand_r_safe]
+    fits = dload[dest_safe] + cand_w <= dest_bound[dest_safe]
+    valid = (src_has & dest_has & r_has[src_safe] & (cand_r >= 0)
+             & (dest_safe != src_safe) & fits)
+    st = S.apply_disk_moves(st, cand_r_safe, dest_safe, valid)
+    return st, jnp.any(valid)
+
+
+def ctx_replica_disk_load(st: ClusterState) -> jax.Array:
+    return st.replica_base_load[:, Resource.DISK]
+
+
+class IntraBrokerDiskCapacityGoal(Goal):
+    """Hard: every alive logdir under capacity * threshold
+    (reference IntraBrokerDiskCapacityGoal.java)."""
+
+    name = "IntraBrokerDiskCapacityGoal"
+    is_hard = True
+
+    def __init__(self, max_rounds: int = 64,
+                 capacity_threshold: float = 0.8):
+        self.max_rounds = max_rounds
+        self.capacity_threshold = capacity_threshold
+
+    def _limits(self, st: ClusterState) -> jax.Array:
+        return st.disk_capacity * self.capacity_threshold
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+        limit = self._limits(state)
+
+        def round_body(st):
+            over = S.disk_load(st) - limit
+            return _disk_move_round(st, ctx, over, limit)
+
+        def cond(carry):
+            st, rounds, progressed = carry
+            over_any = jnp.any(st.disk_alive
+                               & (S.disk_load(st) > limit))
+            return progressed & (rounds < self.max_rounds) & over_any
+
+        def body(carry):
+            st, rounds, _ = carry
+            st, committed = round_body(st)
+            return st, rounds + 1, committed
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int32),
+                         jnp.ones((), dtype=bool)))
+        return state
+
+    def violated_brokers(self, state, ctx, cache):
+        over = state.disk_alive & (S.disk_load(state) > self._limits(state))
+        return (jax.ops.segment_sum(
+            over.astype(jnp.int32), state.disk_broker,
+            num_segments=state.num_brokers) > 0) & state.broker_alive
+
+
+class IntraBrokerDiskUsageDistributionGoal(Goal):
+    """Soft: logdir usage within ±margin of the broker's average fill
+    (reference IntraBrokerDiskUsageDistributionGoal.java)."""
+
+    name = "IntraBrokerDiskUsageDistributionGoal"
+    is_hard = False
+
+    def __init__(self, max_rounds: int = 64, balance_margin: float = 0.1):
+        self.max_rounds = max_rounds
+        self.balance_margin = balance_margin
+
+    def _bounds(self, st: ClusterState):
+        dload = S.disk_load(st)
+        alive = st.disk_alive
+        per_b_load = jax.ops.segment_sum(jnp.where(alive, dload, 0.0),
+                                         st.disk_broker,
+                                         num_segments=st.num_brokers)
+        per_b_cap = jax.ops.segment_sum(
+            jnp.where(alive, st.disk_capacity, 0.0), st.disk_broker,
+            num_segments=st.num_brokers)
+        avg_fill = per_b_load / jnp.maximum(per_b_cap, 1e-9)   # [B]
+        target = avg_fill[st.disk_broker] * st.disk_capacity   # [D]
+        upper = target * (1 + self.balance_margin) \
+            + 1e-6 * jnp.maximum(st.disk_capacity, 1.0)
+        lower = target * (1 - self.balance_margin)
+        return dload, upper, lower
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+
+        def round_body(st):
+            dload, upper, lower = self._bounds(st)
+            return _disk_move_round(st, ctx, dload - upper, upper)
+
+        def cond(carry):
+            st, rounds, progressed = carry
+            dload, upper, _ = self._bounds(st)
+            return (progressed & (rounds < self.max_rounds)
+                    & jnp.any(st.disk_alive & (dload > upper)))
+
+        def body(carry):
+            st, rounds, _ = carry
+            st, committed = round_body(st)
+            return st, rounds + 1, committed
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int32),
+                         jnp.ones((), dtype=bool)))
+        return state
+
+    def violated_brokers(self, state, ctx, cache):
+        dload, upper, _lower = self._bounds(state)
+        bad = state.disk_alive & (dload > upper)
+        return (jax.ops.segment_sum(
+            bad.astype(jnp.int32), state.disk_broker,
+            num_segments=state.num_brokers) > 0) & state.broker_alive

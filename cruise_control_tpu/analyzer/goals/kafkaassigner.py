@@ -1,0 +1,128 @@
+"""Kafka-assigner mode goals.
+
+Reference CC/analyzer/kafkaassigner/: an alternative static-assignment mode
+(the `kafka_assigner=true` request flag) that works without a full load
+model — `KafkaAssignerEvenRackAwareGoal` (KafkaAssignerEvenRackAwareGoal
+.java:41, position-round-robin rack spreading) and
+`KafkaAssignerDiskUsageDistributionGoal` (KafkaAssignerDiskUsageDistribution
+Goal.java:46, swap-based disk balancing that preserves per-broker replica
+counts).
+
+TPU re-design: rack evenness reuses the rack-aware forced-move kernel with
+replica-count destination preference (the round-robin effect); disk
+balancing is the batched `swap_round` kernel — all hot×cold pairings scored
+at once instead of the reference's per-broker nested candidate walk.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import kernels
+from cruise_control_tpu.analyzer.context import (OptimizationContext,
+                                                 make_round_cache)
+from cruise_control_tpu.analyzer.goals.base import (Goal,
+                                                    compose_move_acceptance)
+from cruise_control_tpu.analyzer.goals.rack_aware import RackAwareGoal
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.state import ClusterState
+
+
+class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
+    """Rack spreading with even replica counts.
+
+    The reference walks replica positions round-robin over racks; the
+    emergent invariants are (a) no two replicas of a partition share a rack
+    and (b) replicas spread evenly over brokers.  Phase 1 (the parent rack
+    kernel with fewest-replicas destination preference) enforces (a);
+    phase 2 runs a tight count-evening pass whose every move must keep
+    passing this goal's own rack acceptance.
+    """
+
+    name = "KafkaAssignerEvenRackAwareGoal"
+    is_hard = True
+
+    def _dest_pref(self, st: ClusterState, cache) -> jax.Array:
+        # fewest replicas first (vs the parent's lowest disk utilization)
+        counts = jax.ops.segment_sum(
+            st.replica_valid.astype(jnp.float32), st.replica_broker,
+            num_segments=st.num_brokers)
+        return -counts
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+        from cruise_control_tpu.analyzer.goals.count_distribution import (
+            ReplicaDistributionGoal)
+        state = super().optimize(state, ctx, prev_goals)
+        evener = ReplicaDistributionGoal(max_rounds=self.max_rounds,
+                                         balance_pct_margin=0.0)
+        return evener.optimize(state, ctx, (self,) + tuple(prev_goals))
+
+
+class KafkaAssignerDiskUsageDistributionGoal(Goal):
+    """Swap-based disk balancing preserving per-broker replica counts."""
+
+    name = "KafkaAssignerDiskUsageDistributionGoal"
+    is_hard = False
+
+    def __init__(self, max_rounds: int = 64,
+                 balance_margin: float = 0.1):
+        self.max_rounds = max_rounds
+        #: brokers within avg*(1 ± margin) are balanced (reference uses the
+        #: disk balance percentage with a fixed margin factor)
+        self.balance_margin = balance_margin
+
+    def _bounds(self, st: ClusterState):
+        util = S.broker_load(st)[:, Resource.DISK]
+        cap = st.broker_capacity[:, Resource.DISK]
+        pct = jnp.where(cap > 0, util / jnp.maximum(cap, 1e-9), 0.0)
+        alive = st.broker_alive
+        avg = jnp.sum(jnp.where(alive, pct, 0.0)) \
+            / jnp.maximum(jnp.sum(alive), 1)
+        return pct, avg
+
+    def optimize(self, state: ClusterState, ctx: OptimizationContext,
+                 prev_goals: Sequence[Goal]) -> ClusterState:
+
+        def round_body(st: ClusterState):
+            cache = make_round_cache(st)
+            pct, avg = self._bounds(st)
+            hot = st.broker_alive & (pct > avg * (1 + self.balance_margin))
+            cold = (st.broker_alive & ctx.broker_dest_ok
+                    & (pct < avg * (1 - self.balance_margin)))
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline)
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            w = cache.replica_load[:, Resource.DISK]
+            cap = st.broker_capacity[:, Resource.DISK]
+            util = S.broker_load(st)[:, Resource.DISK]
+            # per-broker absolute target: same relative fill everywhere
+            target = avg * cap
+            out_r, in_r, cold_idx, valid = kernels.swap_round(
+                st, w, movable, hot, cold, util, target,
+                lambda r, d: accept(r, d), ctx.partition_replicas)
+            st = kernels.commit_swaps(st, out_r, in_r, cold_idx, valid)
+            return st, jnp.any(valid)
+
+        def cond(carry):
+            st, rounds, progressed = carry
+            return progressed & (rounds < self.max_rounds)
+
+        def body(carry):
+            st, rounds, _ = carry
+            st, committed = round_body(st)
+            return st, rounds + 1, committed
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int32),
+                         jnp.ones((), dtype=bool)))
+        return state
+
+    def violated_brokers(self, state, ctx, cache):
+        pct, avg = self._bounds(state)
+        return state.broker_alive & (
+            (pct > avg * (1 + self.balance_margin))
+            | (pct < avg * (1 - self.balance_margin)))

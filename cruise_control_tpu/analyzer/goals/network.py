@@ -192,28 +192,41 @@ class PreferredLeaderElectionGoal(Goal):
     def __init__(self, max_rounds: int = 1):
         self.max_rounds = max_rounds
 
+    @staticmethod
+    def _elected_leader(state: ClusterState, ctx: OptimizationContext):
+        """(has_candidate bool[P], chosen i32[P]): per partition, the FIRST
+        replica in the original order whose broker is alive,
+        leadership-eligible and not demoted — the reference skips
+        demoted/ineligible preferred replicas and falls through to the next
+        in order (PreferredLeaderElectionGoal.java).  Shared by optimize and
+        the violation predicate so the two can never disagree."""
+        rows = ctx.partition_replicas                       # i32[P, RF]
+        rows_safe = jnp.maximum(rows, 0)
+        broker = state.replica_broker[rows_safe]            # i32[P, RF]
+        ok = ((rows >= 0)
+              & state.broker_alive[broker]
+              & ctx.broker_leader_ok[broker]
+              & ~state.replica_offline[rows_safe]
+              & ~state.broker_demoted[broker])
+        has_candidate = ok.any(axis=1)
+        first = jnp.argmax(ok, axis=1)                      # i32[P]
+        chosen = jnp.take_along_axis(rows_safe, first[:, None],
+                                     axis=1)[:, 0]
+        return has_candidate, chosen
+
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
-        preferred = ctx.partition_replicas[:, 0]            # i32[P]
+        has_candidate, chosen = self._elected_leader(state, ctx)
         cur_leader = S.partition_leader_replica(state)      # i32[P]
-        pref_safe = jnp.maximum(preferred, 0)
-        pref_broker = state.replica_broker[pref_safe]
-        eligible = ((preferred >= 0) & (cur_leader >= 0)
-                    & (preferred != cur_leader)
-                    & state.broker_alive[pref_broker]
-                    & ctx.broker_leader_ok[pref_broker]
-                    & ~state.replica_offline[pref_safe]
-                    & ~state.broker_demoted[pref_broker])
+        eligible = (has_candidate & (cur_leader >= 0)
+                    & (chosen != cur_leader))
         return S.apply_leadership_transfers(
-            state, jnp.maximum(cur_leader, 0), pref_safe, eligible)
+            state, jnp.maximum(cur_leader, 0), chosen, eligible)
 
     def violated_brokers(self, state, ctx, cache):
-        preferred = ctx.partition_replicas[:, 0]
+        has_candidate, chosen = self._elected_leader(state, ctx)
         cur_leader = S.partition_leader_replica(state)
-        pref_safe = jnp.maximum(preferred, 0)
-        bad = ((preferred >= 0) & (cur_leader >= 0)
-               & (preferred != cur_leader)
-               & state.broker_alive[state.replica_broker[pref_safe]])
+        bad = has_candidate & (cur_leader >= 0) & (chosen != cur_leader)
         broker_of_leader = state.replica_broker[jnp.maximum(cur_leader, 0)]
         return jax.ops.segment_sum(
             bad.astype(jnp.int32), broker_of_leader,

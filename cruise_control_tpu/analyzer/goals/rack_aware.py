@@ -36,6 +36,12 @@ class RackAwareGoal(Goal):
     def __init__(self, max_rounds: int = 128):
         self.max_rounds = max_rounds
 
+    def _dest_pref(self, st: ClusterState, cache) -> jax.Array:
+        """f32[B] destination preference (higher = better); default: lowest
+        disk utilization.  Subclasses override (kafka-assigner mode prefers
+        lowest replica count)."""
+        return -cache.broker_util[:, Resource.DISK]
+
     @staticmethod
     def _redundant_mask(state: ClusterState, prc: jax.Array) -> jax.Array:
         """bool[R] — replicas in a rack that holds >1 replica of their
@@ -79,13 +85,12 @@ class RackAwareGoal(Goal):
                 return (cnt == 0) & accept(r, d)
 
             w = cache.replica_load[:, Resource.DISK]
-            util = cache.broker_util[:, Resource.DISK]
             # global forced-candidate search: rack violations are mandatory
             # moves independent of broker load, and their count scales with
             # partitions — a per-source-broker cap would throttle rounds
             cand_r, cand_d, cand_v = kernels.forced_move_round(
-                st, movable, w, dest_ok_b, accept_all, -util,
-                ctx.partition_replicas)
+                st, movable, w, dest_ok_b, accept_all,
+                self._dest_pref(st, cache), ctx.partition_replicas)
             st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
             return st, jnp.any(cand_v)
 

@@ -16,6 +16,10 @@ from cruise_control_tpu.analyzer.goals.capacity import (
 from cruise_control_tpu.analyzer.goals.count_distribution import (
     LeaderReplicaDistributionGoal, ReplicaDistributionGoal,
     TopicReplicaDistributionGoal)
+from cruise_control_tpu.analyzer.goals.intra_broker import (
+    IntraBrokerDiskCapacityGoal, IntraBrokerDiskUsageDistributionGoal)
+from cruise_control_tpu.analyzer.goals.kafkaassigner import (
+    KafkaAssignerDiskUsageDistributionGoal, KafkaAssignerEvenRackAwareGoal)
 from cruise_control_tpu.analyzer.goals.network import (
     LeaderBytesInDistributionGoal, PotentialNwOutGoal,
     PreferredLeaderElectionGoal)
@@ -43,7 +47,20 @@ GOAL_CLASSES: Dict[str, Type[Goal]] = {
     "LeaderReplicaDistributionGoal": LeaderReplicaDistributionGoal,
     "LeaderBytesInDistributionGoal": LeaderBytesInDistributionGoal,
     "PreferredLeaderElectionGoal": PreferredLeaderElectionGoal,
+    "KafkaAssignerEvenRackAwareGoal": KafkaAssignerEvenRackAwareGoal,
+    "KafkaAssignerDiskUsageDistributionGoal":
+        KafkaAssignerDiskUsageDistributionGoal,
+    "IntraBrokerDiskCapacityGoal": IntraBrokerDiskCapacityGoal,
+    "IntraBrokerDiskUsageDistributionGoal":
+        IntraBrokerDiskUsageDistributionGoal,
 }
+
+#: goal list used when a request sets kafka_assigner=true (reference
+#: kafkaassigner mode, SURVEY.md §2.3)
+KAFKA_ASSIGNER_GOAL_ORDER: List[str] = [
+    "KafkaAssignerEvenRackAwareGoal",
+    "KafkaAssignerDiskUsageDistributionGoal",
+]
 
 
 #: Priority order of the reference's `default.goals`

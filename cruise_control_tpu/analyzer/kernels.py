@@ -413,6 +413,99 @@ def forced_move_round(state: ClusterState,
     return cand_r, cand_dest, cand_valid
 
 
+def swap_round(state: ClusterState,
+               w: jax.Array,
+               movable: jax.Array,
+               hot_b: jax.Array,
+               cold_b: jax.Array,
+               util: jax.Array,
+               target_util: jax.Array,
+               accept_matrix_fn: Callable[[jax.Array, jax.Array], jax.Array],
+               partition_replicas: jax.Array,
+               ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One round of batched replica-SWAP search.
+
+    The reference swaps replicas between an over- and an under-utilized
+    broker to balance a resource while preserving per-broker replica counts
+    (ResourceDistributionGoal swap phase :307-433 and the kafka-assigner
+    KafkaAssignerDiskUsageDistributionGoal.java:46).  Vectorized: each hot
+    broker nominates its largest movable replica, each cold broker its
+    smallest; all hot×cold pairings are scored on a [B, B] plane by the
+    reduction in squared deviation from `target_util` (per-broker targets
+    handle heterogeneous capacities); one swap per hot broker, each cold
+    broker claimed once, one swap per partition.
+
+    `w`, `util` and `target_util` share one absolute unit.
+
+    Returns (out_r i32[B], in_r i32[B], cold i32[B], valid bool[B]) —
+    for hot broker h: move out_r[h] -> cold[h] and in_r[cold[h]] -> h.
+    """
+    num_b = state.num_brokers
+    rb = state.replica_broker
+    arange_b = jnp.arange(num_b, dtype=jnp.int32)
+
+    out_r, _, out_has = per_segment_argmax(w, rb, num_b,
+                                           movable & hot_b[rb])
+    in_r, _, in_has = per_segment_argmax(-w, rb, num_b,
+                                         movable & cold_b[rb])
+    out_safe = jnp.maximum(out_r, 0)
+    in_safe = jnp.maximum(in_r, 0)
+    w_out = w[out_safe]                                   # f32[B] (by hot h)
+    w_in = w[in_safe]                                     # f32[B] (by cold c)
+
+    delta = w_out[:, None] - w_in[None, :]                # load h sheds
+    dev = util - target_util
+    dev_before = (dev ** 2)[:, None] + (dev ** 2)[None, :]
+    dev_after = (dev[:, None] - delta) ** 2 \
+        + (dev[None, :] + delta) ** 2
+    imp = dev_before - dev_after                          # f32[B, B]
+
+    # sibling constraints: the outgoing replica's partition may not already
+    # sit on the cold broker, and vice versa
+    def sibling_on(cand_rows: jax.Array) -> jax.Array:
+        """bool[B, B]: does cand_rows[i]'s partition have a replica on
+        broker j?"""
+        sib = partition_replicas[state.replica_partition[cand_rows]]
+        sib_b = jnp.where(sib >= 0, rb[jnp.maximum(sib, 0)], -1)
+        return jnp.any(sib_b[:, :, None] == arange_b[None, None, :], axis=1)
+
+    dup_out = sibling_on(out_safe)                        # [hot, dest c]
+    dup_in = sibling_on(in_safe)                          # [cold, dest h]
+
+    feasible = (out_has[:, None] & in_has[None, :]
+                & hot_b[:, None] & cold_b[None, :]
+                & (delta > 0) & (imp > 0)
+                & ~dup_out & ~dup_in.T
+                & accept_matrix_fn(out_safe[:, None], arange_b[None, :])
+                & accept_matrix_fn(in_safe[:, None], arange_b[None, :]).T)
+
+    score = jnp.where(feasible, imp, NEG)
+    cold = jnp.argmax(score, axis=1).astype(jnp.int32)
+    sel = jnp.take_along_axis(score, cold[:, None], axis=1)[:, 0]
+    valid = sel > NEG / 2
+    # each cold broker participates in at most one swap
+    valid = resolve_dest_conflicts(cold, sel, valid, num_b)
+    # one swap per partition (either side)
+    p_out = state.replica_partition[out_safe]
+    p_in = state.replica_partition[jnp.maximum(in_r[cold], 0)]
+    valid = resolve_dest_conflicts(p_out, sel, valid, state.num_partitions)
+    valid = resolve_dest_conflicts(p_in, sel, valid, state.num_partitions)
+    return out_r, in_r, cold, valid
+
+
+def commit_swaps(state: ClusterState, out_r: jax.Array, in_r: jax.Array,
+                 cold: jax.Array, valid: jax.Array) -> ClusterState:
+    """Apply a swap round: both directions land in one scatter batch."""
+    hot = jnp.arange(state.num_brokers, dtype=jnp.int32)
+    in_of_pair = in_r[cold]
+    replicas = jnp.concatenate([jnp.maximum(out_r, 0),
+                                jnp.maximum(in_of_pair, 0)])
+    dests = jnp.concatenate([cold, hot])
+    ok = jnp.concatenate([valid & (out_r >= 0),
+                          valid & (in_of_pair >= 0)])
+    return S.apply_moves(state, replicas, dests, ok)
+
+
 def commit_moves(state: ClusterState, cand_r: jax.Array, cand_dest: jax.Array,
                  cand_valid: jax.Array) -> ClusterState:
     return S.apply_moves(state, jnp.maximum(cand_r, 0), cand_dest,

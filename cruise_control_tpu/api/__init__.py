@@ -1,0 +1,26 @@
+"""REST API plane (SURVEY.md §2.7): endpoint dispatch, async user tasks,
+two-step verification purgatory, pluggable security."""
+from cruise_control_tpu.api.parameters import (ParameterError, QueryParams,
+                                               VALID_PARAMS)
+from cruise_control_tpu.api.purgatory import (Purgatory, ReviewRequest,
+                                              ReviewStatus)
+from cruise_control_tpu.api.security import (AuthenticationError,
+                                             AuthorizationError,
+                                             BasicSecurityProvider,
+                                             NoSecurityProvider, Principal,
+                                             Role, SecurityProvider,
+                                             TokenSecurityProvider,
+                                             TrustedProxySecurityProvider)
+from cruise_control_tpu.api.server import BASE_PATH, CruiseControlApp
+from cruise_control_tpu.api.user_tasks import (USER_TASK_ID_HEADER,
+                                               TaskStatus, UserTaskInfo,
+                                               UserTaskManager)
+
+__all__ = [
+    "CruiseControlApp", "BASE_PATH", "QueryParams", "ParameterError",
+    "VALID_PARAMS", "Purgatory", "ReviewRequest", "ReviewStatus",
+    "SecurityProvider", "NoSecurityProvider", "BasicSecurityProvider",
+    "TokenSecurityProvider", "TrustedProxySecurityProvider", "Principal",
+    "Role", "AuthenticationError", "AuthorizationError",
+    "UserTaskManager", "UserTaskInfo", "TaskStatus", "USER_TASK_ID_HEADER",
+]

@@ -1,0 +1,195 @@
+"""Pluggable REST security.
+
+Reference CC/servlet/security/ (17 files): SecurityProvider SPI with HTTP
+Basic, JWT, SPNEGO and trusted-proxy implementations over a three-role
+model ADMIN > USER > VIEWER (docs/wiki "Security").  Here: the SPI, the
+role model and endpoint→role mapping, an HTTP Basic provider (stdlib
+base64), and a signed-token provider (stdlib hmac — structurally the JWT
+flow without external JOSE dependencies).
+"""
+from __future__ import annotations
+
+import abc
+import base64
+import dataclasses
+import enum
+import hashlib
+import hmac
+import json
+import time as _time
+from typing import Callable, Dict, Mapping, Optional, Sequence, Tuple
+
+from cruise_control_tpu.api.parameters import GET_ENDPOINTS, POST_ENDPOINTS
+
+
+class Role(enum.IntEnum):
+    """VIEWER < USER < ADMIN (reference security docs)."""
+
+    VIEWER = 0
+    USER = 1
+    ADMIN = 2
+
+
+#: minimum role per endpoint: viewers see state; users may run GETs that
+#: compute; admins mutate (reference DefaultRoleSecurityProvider mapping)
+def required_role(endpoint: str) -> Role:
+    if endpoint in POST_ENDPOINTS or endpoint == "REVIEW":
+        return Role.ADMIN
+    if endpoint in ("PROPOSALS", "BOOTSTRAP", "TRAIN"):
+        return Role.USER
+    return Role.VIEWER
+
+
+@dataclasses.dataclass(frozen=True)
+class Principal:
+    name: str
+    role: Role
+
+
+class AuthenticationError(Exception):
+    """401 — missing or invalid credentials."""
+
+
+class AuthorizationError(Exception):
+    """403 — authenticated but not permitted."""
+
+
+class SecurityProvider(abc.ABC):
+    """SPI — reference servlet/security/SecurityProvider.java."""
+
+    @abc.abstractmethod
+    def authenticate(self, headers: Mapping[str, str]) -> Principal:
+        """Return the principal or raise AuthenticationError."""
+
+    def authorize(self, principal: Principal, endpoint: str) -> None:
+        if principal.role < required_role(endpoint):
+            raise AuthorizationError(
+                f"{principal.name} (role {principal.role.name}) may not "
+                f"call {endpoint}")
+
+
+class NoSecurityProvider(SecurityProvider):
+    """Everything allowed (security disabled, the reference default)."""
+
+    def authenticate(self, headers: Mapping[str, str]) -> Principal:
+        return Principal("anonymous", Role.ADMIN)
+
+
+class BasicSecurityProvider(SecurityProvider):
+    """HTTP Basic auth against a static credential table (reference
+    BasicSecurityProvider reading auth.credentials.file).
+
+    `users` maps username -> (password, Role).
+    """
+
+    def __init__(self, users: Mapping[str, Tuple[str, Role]]) -> None:
+        self._users = dict(users)
+
+    @staticmethod
+    def from_credentials_file(path: str) -> "BasicSecurityProvider":
+        """Jetty-property-file flavor: `user: password,ROLE`."""
+        users: Dict[str, Tuple[str, Role]] = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                name, rest = line.split(":", 1)
+                password, role = rest.rsplit(",", 1)
+                users[name.strip()] = (password.strip(),
+                                       Role[role.strip().upper()])
+        return BasicSecurityProvider(users)
+
+    def authenticate(self, headers: Mapping[str, str]) -> Principal:
+        auth = _header(headers, "Authorization")
+        if not auth or not auth.startswith("Basic "):
+            raise AuthenticationError("missing Basic credentials")
+        try:
+            decoded = base64.b64decode(auth[6:]).decode()
+            name, password = decoded.split(":", 1)
+        except Exception:
+            raise AuthenticationError("malformed Basic credentials")
+        entry = self._users.get(name)
+        if entry is None or not hmac.compare_digest(entry[0], password):
+            raise AuthenticationError("bad username or password")
+        return Principal(name, entry[1])
+
+
+class TokenSecurityProvider(SecurityProvider):
+    """HMAC-signed bearer tokens (the JWT flow of the reference's
+    JwtSecurityProvider/JwtLoginService.java:1-226, with stdlib crypto:
+    header.payload.signature, HS256-equivalent).
+    """
+
+    def __init__(self, secret: bytes,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._secret = secret
+        self._time = time_fn or _time.time
+
+    # -- token issue (the reference's login service) --
+    def issue(self, name: str, role: Role, ttl_s: float = 3600.0) -> str:
+        payload = {"sub": name, "role": role.name,
+                   "exp": self._time() + ttl_s}
+        body = _b64url(json.dumps(payload).encode())
+        sig = _b64url(hmac.new(self._secret, body.encode(),
+                               hashlib.sha256).digest())
+        return f"{body}.{sig}"
+
+    def authenticate(self, headers: Mapping[str, str]) -> Principal:
+        auth = _header(headers, "Authorization")
+        if not auth or not auth.startswith("Bearer "):
+            raise AuthenticationError("missing Bearer token")
+        token = auth[7:]
+        try:
+            body, sig = token.rsplit(".", 1)
+            want = _b64url(hmac.new(self._secret, body.encode(),
+                                    hashlib.sha256).digest())
+            if not hmac.compare_digest(want, sig):
+                raise AuthenticationError("bad token signature")
+            payload = json.loads(_b64url_decode(body))
+        except AuthenticationError:
+            raise
+        except Exception:
+            raise AuthenticationError("malformed token")
+        if payload.get("exp", 0) < self._time():
+            raise AuthenticationError("token expired")
+        return Principal(payload["sub"], Role[payload["role"]])
+
+
+class TrustedProxySecurityProvider(SecurityProvider):
+    """Authenticates a fronting proxy and trusts its asserted user
+    (reference TrustedProxySecurityProvider: the proxy authenticates via
+    its own provider and passes the end user in `doAs`)."""
+
+    def __init__(self, proxy_provider: SecurityProvider,
+                 trusted_proxies: Sequence[str],
+                 role_fn: Callable[[str], Role] = lambda name: Role.USER
+                 ) -> None:
+        self._proxy_provider = proxy_provider
+        self._trusted = set(trusted_proxies)
+        self._role_fn = role_fn
+
+    def authenticate(self, headers: Mapping[str, str]) -> Principal:
+        proxy = self._proxy_provider.authenticate(headers)
+        if proxy.name not in self._trusted:
+            raise AuthenticationError(
+                f"{proxy.name} is not a trusted proxy")
+        do_as = _header(headers, "doAs") or _header(headers, "X-DoAs-User")
+        if not do_as:
+            raise AuthenticationError("trusted proxy must assert doAs user")
+        return Principal(do_as, self._role_fn(do_as))
+
+
+def _header(headers: Mapping[str, str], name: str) -> Optional[str]:
+    for k, v in headers.items():
+        if k.lower() == name.lower():
+            return v
+    return None
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))

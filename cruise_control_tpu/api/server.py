@@ -1,0 +1,442 @@
+"""REST API server.
+
+Reference CC/servlet/KafkaCruiseControlServlet.java:39-232 +
+KafkaCruiseControlApp.java (Jetty wiring): 19 endpoints under
+`/kafkacruisecontrol/...`, async POSTs tracked by the UserTaskManager with
+`User-Task-ID` headers, optional two-step verification through the
+purgatory, pluggable security.
+
+The dispatch core (`handle_request`) is transport-free — the stdlib
+ThreadingHTTPServer wrapper feeds it, and tests drive it directly.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.parse
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+from cruise_control_tpu.api import responses as R
+from cruise_control_tpu.api.parameters import (GET_ENDPOINTS, POST_ENDPOINTS,
+                                               ParameterError, QueryParams)
+from cruise_control_tpu.api.purgatory import Purgatory
+from cruise_control_tpu.api.security import (AuthenticationError,
+                                             AuthorizationError,
+                                             NoSecurityProvider,
+                                             SecurityProvider)
+from cruise_control_tpu.api.user_tasks import (USER_TASK_ID_HEADER,
+                                               UserTaskManager)
+from cruise_control_tpu.analyzer.context import OptimizationOptions
+from cruise_control_tpu.core.anomaly import AnomalyType
+from cruise_control_tpu.executor.strategy import strategy_from_names
+from cruise_control_tpu.facade import CruiseControl, OngoingExecutionError
+
+LOG = logging.getLogger(__name__)
+
+BASE_PATH = "/kafkacruisecontrol"
+
+#: endpoints answered synchronously (no user task)
+SYNC_ENDPOINTS = {"STATE", "KAFKA_CLUSTER_STATE", "USER_TASKS",
+                  "REVIEW_BOARD", "REVIEW", "STOP_PROPOSAL_EXECUTION",
+                  "PAUSE_SAMPLING", "RESUME_SAMPLING", "ADMIN"}
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class CruiseControlApp:
+    """Endpoint dispatch over a CruiseControl facade."""
+
+    def __init__(self, cruise_control: CruiseControl,
+                 security: Optional[SecurityProvider] = None,
+                 two_step_verification: bool = False,
+                 async_response_timeout_s: float = 1.0,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self.cc = cruise_control
+        self.security = security or NoSecurityProvider()
+        self.purgatory = Purgatory(time_fn=time_fn) \
+            if two_step_verification else None
+        self.user_tasks = UserTaskManager(time_fn=time_fn)
+        self._async_timeout = async_response_timeout_s
+        self._http: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------------
+    # transport-free dispatch
+    # ------------------------------------------------------------------
+    def handle_request(self, method: str, path: str, query_string: str = "",
+                       headers: Optional[Mapping[str, str]] = None,
+                       client: str = "local"
+                       ) -> Tuple[int, Dict[str, str], dict]:
+        """(status, response headers, json body)."""
+        headers = headers or {}
+        try:
+            endpoint = self._endpoint_of(method, path)
+            # per-endpoint request sensors (reference servlet meters/timers,
+            # KafkaCruiseControlServlet.java:60-65)
+            registry = getattr(self.cc, "metrics", None)
+            if registry is not None:
+                registry.meter(f"{endpoint}-request-rate").mark()
+            principal = self.security.authenticate(headers)
+            self.security.authorize(principal, endpoint)
+            params = QueryParams(
+                endpoint, urllib.parse.parse_qs(query_string,
+                                                keep_blank_values=True))
+            if endpoint in SYNC_ENDPOINTS:
+                if endpoint in POST_ENDPOINTS:
+                    # sync mutating endpoints go through the purgatory too
+                    parked = self._purgatory_gate(endpoint, params,
+                                                  query_string, client)
+                    if parked is not None:
+                        return parked
+                return 200, {}, self._handle_sync(endpoint, params)
+            return self._handle_async(endpoint, params, query_string,
+                                      client, headers)
+        except (ParameterError, ValueError) as exc:
+            return self._error(400, exc)
+        except AuthenticationError as exc:
+            return self._error(401, exc)
+        except AuthorizationError as exc:
+            return self._error(403, exc)
+        except KeyError as exc:
+            return self._error(404, exc)
+        except OngoingExecutionError as exc:
+            return self._error(409, exc)
+        except HttpError as exc:
+            return self._error(exc.status, exc)
+        except Exception as exc:  # noqa: BLE001 - 500 with message
+            LOG.exception("request failed")
+            return self._error(500, exc)
+
+    @staticmethod
+    def _error(status: int, exc: Exception) -> Tuple[int, Dict[str, str],
+                                                     dict]:
+        return status, {}, {"errorMessage": f"{type(exc).__name__}: {exc}",
+                            "version": 1}
+
+    @staticmethod
+    def _endpoint_of(method: str, path: str) -> str:
+        if not path.startswith(BASE_PATH + "/"):
+            raise HttpError(404, f"unknown path {path}; expected "
+                                 f"{BASE_PATH}/<endpoint>")
+        endpoint = path[len(BASE_PATH) + 1:].strip("/").upper()
+        if endpoint not in GET_ENDPOINTS and endpoint not in POST_ENDPOINTS \
+                and endpoint != "REVIEW":
+            raise HttpError(404, f"unknown endpoint {endpoint}")
+        if method == "GET" and endpoint not in GET_ENDPOINTS:
+            raise HttpError(405, f"{endpoint} is not a GET endpoint")
+        if method == "POST" and endpoint not in POST_ENDPOINTS \
+                and endpoint != "REVIEW":
+            raise HttpError(405, f"{endpoint} is not a POST endpoint")
+        return endpoint
+
+    def _purgatory_gate(self, endpoint: str, params: QueryParams,
+                        query_string: str, client: str
+                        ) -> Optional[Tuple[int, Dict[str, str], dict]]:
+        """Two-step verification: park unreviewed POSTs, consume approvals.
+        Returns a parked-response triple, or None to proceed."""
+        if self.purgatory is None or endpoint not in POST_ENDPOINTS:
+            return None
+        review_id = params.get_int("review_id")
+        if review_id is None:
+            req = self.purgatory.submit(endpoint, query_string, client)
+            return 202, {}, {"reviewResult": req.to_json(), "version": 1}
+        self.purgatory.take_approved(review_id, endpoint, query_string)
+        return None
+
+    # ------------------------------------------------------------------
+    # async machinery (reference handler/async + UserTaskManager)
+    # ------------------------------------------------------------------
+    def _handle_async(self, endpoint: str, params: QueryParams,
+                      query_string: str, client: str,
+                      headers: Mapping[str, str]
+                      ) -> Tuple[int, Dict[str, str], dict]:
+        task_id = None
+        for k, v in headers.items():
+            if k.lower() == USER_TASK_ID_HEADER.lower():
+                task_id = v
+        # purgatory gate — skipped when re-polling an in-flight task (the
+        # review id was already consumed when the task started)
+        if task_id is None:
+            parked = self._purgatory_gate(endpoint, params, query_string,
+                                          client)
+            if parked is not None:
+                return parked
+        op = self._operation_for(endpoint, params)
+        info = self.user_tasks.get_or_create(endpoint, query_string, client,
+                                             op, task_id=task_id)
+        hdrs = {USER_TASK_ID_HEADER: info.task_id}
+        try:
+            body = info.future.result(timeout=self._async_timeout)
+            return 200, hdrs, body
+        except FutureTimeout:
+            return 202, hdrs, {"progress": [{"operation": endpoint,
+                                             "status": "InProgress"}],
+                               "version": 1}
+        except Exception as exc:  # operation failed
+            status = 409 if isinstance(exc, OngoingExecutionError) else 500
+            return status, hdrs, {"errorMessage":
+                                  f"{type(exc).__name__}: {exc}",
+                                  "version": 1}
+
+    # ------------------------------------------------------------------
+    # per-endpoint operations
+    # ------------------------------------------------------------------
+    def _operation_for(self, endpoint: str,
+                       params: QueryParams) -> Callable[[], dict]:
+        cc = self.cc
+        if endpoint == "PROPOSALS":
+            goals = params.get_csv("goals")
+            verbose = params.get_bool("verbose")
+            ignore_cache = params.get_bool("ignore_proposal_cache")
+            excluded = params.get_csv("excluded_topics")
+            options = (OptimizationOptions(
+                excluded_topics=frozenset(excluded)) if excluded else None)
+
+            def proposals_op() -> dict:
+                result = cc.optimizations(goals, options,
+                                          ignore_proposal_cache=ignore_cache)
+                return R.optimization_result(result, verbose=verbose)
+            return proposals_op
+
+        if endpoint == "LOAD":
+            def load_op() -> dict:
+                state, topo = cc.cluster_model()
+                return R.broker_stats(state, topo)
+            return load_op
+
+        if endpoint == "PARTITION_LOAD":
+            resource = params.get_resource("resource")
+            entries = params.get_int("entries")
+            topic = params.get("topic")
+
+            def partition_load_op() -> dict:
+                state, topo = cc.cluster_model()
+                return {"records": R.partition_load(
+                    state, topo, resource=resource, entries=entries,
+                    topic_pattern=topic),
+                    "version": 1}
+            return partition_load_op
+
+        if endpoint == "BOOTSTRAP":
+            def bootstrap_op() -> dict:
+                # enough synchronous rounds to fill every window
+                agg = cc.load_monitor.partition_aggregator
+                rounds = agg.num_windows + 1
+                cc.load_monitor.task_runner.bootstrap(rounds)
+                return {"message": f"bootstrapped {rounds} sampling rounds",
+                        "version": 1}
+            return bootstrap_op
+
+        if endpoint == "TRAIN":
+            def train_op() -> dict:
+                cc.load_monitor.train()
+                return {"message": "training triggered", "version": 1}
+            return train_op
+
+        if endpoint in ("REBALANCE", "ADD_BROKER", "REMOVE_BROKER",
+                        "DEMOTE_BROKER", "FIX_OFFLINE_REPLICAS",
+                        "TOPIC_CONFIGURATION"):
+            return self._mutation_operation(endpoint, params)
+
+        raise HttpError(404, f"unhandled endpoint {endpoint}")
+
+    def _mutation_operation(self, endpoint: str,
+                            params: QueryParams) -> Callable[[], dict]:
+        cc = self.cc
+        dryrun = params.get_bool("dryrun", default=True)
+        verbose = params.get_bool("verbose")
+        goals = params.get_csv("goals")
+        reason = params.get("reason", f"{endpoint} via REST")
+        throttle = params.get_float("replication_throttle")
+        exec_kwargs: dict = {}
+        if throttle is not None:
+            exec_kwargs["replication_throttle"] = throttle
+        conc = params.get_int("concurrent_partition_movements_per_broker")
+        if conc is not None:
+            exec_kwargs["concurrent_inter_broker_moves"] = conc
+        lead = params.get_int("concurrent_leader_movements")
+        if lead is not None:
+            exec_kwargs["concurrent_leader_movements"] = lead
+        strategies = params.get_csv("replica_movement_strategies")
+        strategy = strategy_from_names(strategies) if strategies else None
+
+        if endpoint in ("ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER"):
+            broker_ids = params.get_csv_ints("brokerid")
+            if not broker_ids:
+                raise ParameterError(f"{endpoint} requires brokerid")
+        else:
+            broker_ids = None
+
+        def run() -> dict:
+            if endpoint == "REBALANCE":
+                excluded = params.get_csv("excluded_topics")
+                dests = params.get_csv_ints("destination_broker_ids")
+                options = None
+                if excluded or dests:
+                    options = OptimizationOptions(
+                        excluded_topics=frozenset(excluded or ()),
+                        requested_destination_broker_ids=frozenset(
+                            dests or ()))
+                op = cc.rebalance(goals=goals, dryrun=dryrun,
+                                  options=options, reason=reason,
+                                  strategy=strategy,
+                                  ignore_proposal_cache=params.get_bool(
+                                      "ignore_proposal_cache"),
+                                  kafka_assigner=params.get_bool(
+                                      "kafka_assigner"),
+                                  **exec_kwargs)
+            elif endpoint == "ADD_BROKER":
+                op = cc.add_brokers(broker_ids, goals=goals, dryrun=dryrun,
+                                    reason=reason, **exec_kwargs)
+            elif endpoint == "REMOVE_BROKER":
+                op = cc.remove_brokers(broker_ids, goals=goals,
+                                       dryrun=dryrun, reason=reason,
+                                       **exec_kwargs)
+            elif endpoint == "DEMOTE_BROKER":
+                op = cc.demote_brokers(broker_ids, dryrun=dryrun,
+                                       reason=reason, **exec_kwargs)
+            elif endpoint == "FIX_OFFLINE_REPLICAS":
+                op = cc.fix_offline_replicas(goals=goals, dryrun=dryrun,
+                                             reason=reason, **exec_kwargs)
+            else:  # TOPIC_CONFIGURATION
+                topic = params.get("topic")
+                rf = params.get_int("replication_factor")
+                if not topic or rf is None:
+                    raise ParameterError(
+                        "TOPIC_CONFIGURATION requires topic and "
+                        "replication_factor")
+                op = cc.update_topic_replication_factor(
+                    topic, rf, goals=goals, dryrun=dryrun, reason=reason,
+                    **exec_kwargs)
+            if op.optimizer_result is not None:
+                body = R.optimization_result(op.optimizer_result,
+                                             verbose=verbose)
+            else:   # direct-proposal operations (RF change)
+                body = {"summary": {
+                    "numReplicaMovements": sum(
+                        1 for p in op.proposals if p.has_replica_action),
+                    "numProposals": len(op.proposals)}}
+                if verbose:
+                    body["proposals"] = [p.to_json() for p in op.proposals]
+            body["dryRun"] = op.dryrun
+            if op.execution_uuid:
+                body["executionId"] = op.execution_uuid
+            return body
+        return run
+
+    # ------------------------------------------------------------------
+    # sync endpoints
+    # ------------------------------------------------------------------
+    def _handle_sync(self, endpoint: str, params: QueryParams) -> dict:
+        cc = self.cc
+        if endpoint == "STATE":
+            substates = params.get_csv("substates")
+            out = cc.state(substates)
+            out["version"] = 1
+            return out
+        if endpoint == "KAFKA_CLUSTER_STATE":
+            out = R.kafka_cluster_state(
+                cc.load_monitor.metadata.refresh_metadata())
+            out["version"] = 1
+            return out
+        if endpoint == "USER_TASKS":
+            ids = params.get_csv("user_task_ids")
+            tasks = self.user_tasks.all_tasks()
+            if ids:
+                tasks = [t for t in tasks if t.task_id in set(ids)]
+            return {"userTasks": [t.to_json() for t in tasks], "version": 1}
+        if endpoint == "REVIEW_BOARD":
+            if self.purgatory is None:
+                raise HttpError(400, "two-step verification is disabled")
+            ids = params.get_csv_ints("review_ids")
+            return {"requestInfo": [r.to_json() for r
+                                    in self.purgatory.all_requests(ids)],
+                    "version": 1}
+        if endpoint == "REVIEW":
+            if self.purgatory is None:
+                raise HttpError(400, "two-step verification is disabled")
+            approve = params.get_csv_ints("approve") or []
+            discard = params.get_csv_ints("discard") or []
+            reason = params.get("reason", "")
+            changed = self.purgatory.review(approve, discard, reason)
+            return {"requestInfo": [r.to_json() for r in changed],
+                    "version": 1}
+        if endpoint == "STOP_PROPOSAL_EXECUTION":
+            cc.stop_execution(force=params.get_bool("force_stop"))
+            return {"message": "execution stop requested", "version": 1}
+        if endpoint == "PAUSE_SAMPLING":
+            cc.pause_sampling(params.get("reason", "paused via REST"))
+            return {"message": "sampling paused", "version": 1}
+        if endpoint == "RESUME_SAMPLING":
+            cc.resume_sampling(params.get("reason", "resumed via REST"))
+            return {"message": "sampling resumed", "version": 1}
+        if endpoint == "ADMIN":
+            out: dict = {"version": 1}
+            for param, enable in (("enable_self_healing_for", True),
+                                  ("disable_self_healing_for", False)):
+                names = params.get_csv(param)
+                if names:
+                    changed = {}
+                    for name in names:
+                        try:
+                            at = AnomalyType[name.upper()]
+                        except KeyError:
+                            raise ParameterError(
+                                f"unknown anomaly type {name!r}")
+                        old = cc.anomaly_detector.set_self_healing_for(
+                            at, enable)
+                        changed[at.name] = {"before": old, "after": enable}
+                    out.setdefault("selfHealing", {}).update(changed)
+            return out
+        raise HttpError(404, f"unhandled sync endpoint {endpoint}")
+
+    # ------------------------------------------------------------------
+    # HTTP transport
+    # ------------------------------------------------------------------
+    def start(self, host: str = "127.0.0.1", port: int = 9090) -> int:
+        """Start the HTTP server; returns the bound port."""
+        app = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _dispatch(self, method: str) -> None:
+                parsed = urllib.parse.urlsplit(self.path)
+                status, hdrs, body = app.handle_request(
+                    method, parsed.path, parsed.query,
+                    dict(self.headers.items()),
+                    client=self.client_address[0])
+                data = json.dumps(body, indent=2).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in hdrs.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self) -> None:  # noqa: N802 - stdlib API
+                self._dispatch("GET")
+
+            def do_POST(self) -> None:  # noqa: N802
+                self._dispatch("POST")
+
+            def log_message(self, fmt: str, *args) -> None:
+                LOG.debug("http: " + fmt, *args)
+
+        self._http = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._http.serve_forever,
+                         name="rest-server", daemon=True).start()
+        return self._http.server_address[1]
+
+    def stop(self) -> None:
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+            self._http = None
+        self.user_tasks.shutdown()

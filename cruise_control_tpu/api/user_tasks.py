@@ -1,0 +1,139 @@
+"""User-task manager: async REST operation tracking.
+
+Reference CC/servlet/UserTaskManager.java:56-834 — every async request gets
+a UUID (returned in the `User-Task-ID` response header); repeated requests
+with the same task id (or same client + URL) attach to the in-flight
+operation instead of starting a new one; completed tasks are retained for a
+configurable time and listed by the USER_TASKS endpoint.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time as _time
+import uuid as _uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+USER_TASK_ID_HEADER = "User-Task-ID"
+
+
+class TaskStatus(enum.Enum):
+    ACTIVE = "Active"
+    COMPLETED = "Completed"
+    COMPLETED_WITH_ERROR = "CompletedWithError"
+
+
+@dataclasses.dataclass
+class UserTaskInfo:
+    task_id: str
+    endpoint: str
+    query: str
+    client_id: str
+    start_ms: float
+    future: Future
+    status: TaskStatus = TaskStatus.ACTIVE
+    end_ms: float = 0.0
+
+    def to_json(self) -> dict:
+        return {
+            "UserTaskId": self.task_id,
+            "RequestURL": f"{self.endpoint}?{self.query}" if self.query
+                          else self.endpoint,
+            "ClientIdentity": self.client_id,
+            "StartMs": self.start_ms,
+            "Status": self.status.value,
+        }
+
+
+class UserTaskManager:
+    """Thread-safe registry of async operations."""
+
+    def __init__(self, max_active_tasks: int = 25,
+                 completed_retention_s: float = 24 * 3600.0,
+                 max_workers: int = 8,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._max_active = max_active_tasks
+        self._retention_s = completed_retention_s
+        self._time = time_fn or _time.time
+        self._lock = threading.Lock()
+        self._tasks: Dict[str, UserTaskInfo] = {}
+        #: (client_id, endpoint+query) -> task id, for implicit resumption
+        self._by_request: Dict[Tuple[str, str], str] = {}
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="user-task")
+
+    # ------------------------------------------------------------------
+    def get_or_create(self, endpoint: str, query: str, client_id: str,
+                      operation: Callable[[], Any],
+                      task_id: Optional[str] = None) -> UserTaskInfo:
+        """Attach to an existing task (by explicit id or same client+URL)
+        or start `operation` on the pool."""
+        now_ms = self._time() * 1000.0
+        key = (client_id, f"{endpoint}?{query}")
+        with self._lock:
+            self._expire(now_ms)
+            if task_id is not None:
+                info = self._tasks.get(task_id)
+                if info is None:
+                    raise KeyError(f"unknown user task {task_id}")
+                return info
+            existing = self._by_request.get(key)
+            if existing is not None:
+                info = self._tasks.get(existing)
+                if info is not None and info.status == TaskStatus.ACTIVE:
+                    return info
+            active = sum(1 for t in self._tasks.values()
+                         if t.status == TaskStatus.ACTIVE)
+            if active >= self._max_active:
+                raise RuntimeError(
+                    f"too many active user tasks ({active}); retry later")
+            new_id = str(_uuid.uuid4())
+
+            def run() -> Any:
+                try:
+                    result = operation()
+                    self._finish(new_id, TaskStatus.COMPLETED)
+                    return result
+                except BaseException:
+                    self._finish(new_id, TaskStatus.COMPLETED_WITH_ERROR)
+                    raise
+
+            # submit while still holding the lock: the task must never be
+            # visible with future=None (a concurrent identical request
+            # attaches to it immediately)
+            info = UserTaskInfo(new_id, endpoint, query, client_id, now_ms,
+                                future=self._pool.submit(run))
+            self._tasks[new_id] = info
+            self._by_request[key] = new_id
+        return info
+
+    def _finish(self, task_id: str, status: TaskStatus) -> None:
+        with self._lock:
+            info = self._tasks.get(task_id)
+            if info is not None:
+                info.status = status
+                info.end_ms = self._time() * 1000.0
+
+    def _expire(self, now_ms: float) -> None:
+        cutoff = now_ms - self._retention_s * 1000.0
+        dead = [tid for tid, t in self._tasks.items()
+                if t.status != TaskStatus.ACTIVE and t.end_ms < cutoff]
+        for tid in dead:
+            info = self._tasks.pop(tid)
+            self._by_request.pop(
+                (info.client_id, f"{info.endpoint}?{info.query}"), None)
+
+    # ------------------------------------------------------------------
+    def get(self, task_id: str) -> Optional[UserTaskInfo]:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def all_tasks(self) -> List[UserTaskInfo]:
+        with self._lock:
+            self._expire(self._time() * 1000.0)
+            return sorted(self._tasks.values(), key=lambda t: -t.start_ms)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)

@@ -1,0 +1,5 @@
+"""Python client + CLI for the REST API (SURVEY.md §2.10)."""
+from cruise_control_tpu.client.client import (CruiseControlClient,
+                                              CruiseControlClientError)
+
+__all__ = ["CruiseControlClient", "CruiseControlClientError"]

@@ -1,0 +1,191 @@
+"""Python client for the REST API.
+
+Reference cruise-control-client/ (~2K LoC): Endpoint classes with allowed
+parameters, a Responder that long-polls async responses via the
+`User-Task-ID` header, and the `cccli` CLI on top.  Stdlib urllib only.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, Mapping, Optional, Sequence
+
+from cruise_control_tpu.api.parameters import (GET_ENDPOINTS, POST_ENDPOINTS,
+                                               VALID_PARAMS)
+from cruise_control_tpu.api.user_tasks import USER_TASK_ID_HEADER
+
+
+class CruiseControlClientError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class CruiseControlClient:
+    """One method per endpoint; async responses are long-polled to
+    completion (reference Responder.py / ExecutionContext)."""
+
+    def __init__(self, base_url: str,
+                 auth_header: Optional[str] = None,
+                 poll_interval_s: float = 1.0,
+                 timeout_s: float = 600.0) -> None:
+        self._base = base_url.rstrip("/")
+        self._auth = auth_header
+        self._poll_s = poll_interval_s
+        self._timeout_s = timeout_s
+
+    # ------------------------------------------------------------------
+    def request(self, endpoint: str,
+                params: Optional[Mapping[str, object]] = None,
+                wait: bool = True) -> dict:
+        endpoint = endpoint.upper()
+        legal = VALID_PARAMS.get(endpoint)
+        if legal is None:
+            raise ValueError(f"unknown endpoint {endpoint}")
+        method = "GET" if endpoint in GET_ENDPOINTS else "POST"
+        query = {}
+        for k, v in (params or {}).items():
+            if v is None:
+                continue
+            if k.lower() not in legal:
+                raise ValueError(f"{endpoint} does not accept {k!r}; "
+                                 f"legal: {sorted(legal)}")
+            if isinstance(v, bool):
+                v = "true" if v else "false"
+            elif isinstance(v, (list, tuple)):
+                v = ",".join(str(x) for x in v)
+            elif isinstance(v, (set, frozenset)):
+                v = ",".join(str(x) for x in sorted(v))
+            query[k.lower()] = str(v)
+        url = (f"{self._base}/{endpoint.lower()}"
+               + (f"?{urllib.parse.urlencode(query)}" if query else ""))
+        deadline = time.time() + self._timeout_s
+        task_id: Optional[str] = None
+        while True:
+            status, headers, body = self._http(method, url, task_id)
+            task_id = headers.get(USER_TASK_ID_HEADER, task_id)
+            if status == 200:
+                return body
+            if status == 202 and "reviewResult" in body:
+                # two-step verification parked the request — re-polling
+                # would file duplicate reviews; hand the review back
+                return body
+            if status == 202 and wait:
+                if time.time() > deadline:
+                    raise CruiseControlClientError(
+                        202, f"operation did not finish within "
+                             f"{self._timeout_s}s (task {task_id})")
+                time.sleep(self._poll_s)
+                continue
+            if status == 202:
+                return body
+            raise CruiseControlClientError(
+                status, body.get("errorMessage", str(body)))
+
+    def _http(self, method: str, url: str, task_id: Optional[str]
+              ):
+        req = urllib.request.Request(url, method=method)
+        if self._auth:
+            req.add_header("Authorization", self._auth)
+        if task_id:
+            req.add_header(USER_TASK_ID_HEADER, task_id)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return (resp.status, dict(resp.headers.items()),
+                        json.loads(resp.read() or b"{}"))
+        except urllib.error.HTTPError as exc:
+            try:
+                body = json.loads(exc.read() or b"{}")
+            except json.JSONDecodeError:
+                body = {"errorMessage": str(exc)}
+            return exc.code, dict(exc.headers.items() if exc.headers
+                                  else {}), body
+
+    # ------------------------------------------------------------------
+    # endpoint convenience wrappers (reference Endpoint.py classes)
+    # ------------------------------------------------------------------
+    def state(self, substates: Optional[Sequence[str]] = None) -> dict:
+        return self.request("STATE", {"substates": substates})
+
+    def load(self) -> dict:
+        return self.request("LOAD")
+
+    def partition_load(self, resource: str = "disk",
+                       entries: Optional[int] = None,
+                       topic: Optional[str] = None) -> dict:
+        return self.request("PARTITION_LOAD", {
+            "resource": resource, "entries": entries, "topic": topic})
+
+    def proposals(self, goals: Optional[Sequence[str]] = None,
+                  verbose: bool = False,
+                  ignore_proposal_cache: bool = False) -> dict:
+        return self.request("PROPOSALS", {
+            "goals": goals, "verbose": verbose,
+            "ignore_proposal_cache": ignore_proposal_cache})
+
+    def kafka_cluster_state(self) -> dict:
+        return self.request("KAFKA_CLUSTER_STATE")
+
+    def user_tasks(self) -> dict:
+        return self.request("USER_TASKS")
+
+    def rebalance(self, dryrun: bool = True,
+                  goals: Optional[Sequence[str]] = None,
+                  verbose: bool = False, **params) -> dict:
+        return self.request("REBALANCE", {
+            "dryrun": dryrun, "goals": goals, "verbose": verbose, **params})
+
+    def add_broker(self, broker_ids: Sequence[int], dryrun: bool = True,
+                   **params) -> dict:
+        return self.request("ADD_BROKER", {
+            "brokerid": list(broker_ids), "dryrun": dryrun, **params})
+
+    def remove_broker(self, broker_ids: Sequence[int], dryrun: bool = True,
+                      **params) -> dict:
+        return self.request("REMOVE_BROKER", {
+            "brokerid": list(broker_ids), "dryrun": dryrun, **params})
+
+    def demote_broker(self, broker_ids: Sequence[int], dryrun: bool = True,
+                      **params) -> dict:
+        return self.request("DEMOTE_BROKER", {
+            "brokerid": list(broker_ids), "dryrun": dryrun, **params})
+
+    def fix_offline_replicas(self, dryrun: bool = True, **params) -> dict:
+        return self.request("FIX_OFFLINE_REPLICAS",
+                            {"dryrun": dryrun, **params})
+
+    def stop_execution(self, force: bool = False) -> dict:
+        return self.request("STOP_PROPOSAL_EXECUTION",
+                            {"force_stop": force})
+
+    def pause_sampling(self, reason: str = "") -> dict:
+        return self.request("PAUSE_SAMPLING",
+                            {"reason": reason} if reason else {})
+
+    def resume_sampling(self, reason: str = "") -> dict:
+        return self.request("RESUME_SAMPLING",
+                            {"reason": reason} if reason else {})
+
+    def admin(self, **params) -> dict:
+        return self.request("ADMIN", params)
+
+    def topic_configuration(self, topic: str, replication_factor: int,
+                            dryrun: bool = True, **params) -> dict:
+        return self.request("TOPIC_CONFIGURATION", {
+            "topic": topic, "replication_factor": replication_factor,
+            "dryrun": dryrun, **params})
+
+    def review(self, approve: Optional[Sequence[int]] = None,
+               discard: Optional[Sequence[int]] = None,
+               reason: str = "") -> dict:
+        return self.request("REVIEW", {
+            "approve": list(approve) if approve else None,
+            "discard": list(discard) if discard else None,
+            "reason": reason or None})
+
+    def review_board(self) -> dict:
+        return self.request("REVIEW_BOARD")

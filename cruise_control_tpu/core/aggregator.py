@@ -236,9 +236,11 @@ class MetricSampleAggregator:
         with self._lock:
             window_index = self._window_index(sample.sample_time_ms)
             if self._current_window_index is None:
+                # history starts at the first sample's window: inventing
+                # empty windows before it would leave permanently-invalid
+                # leading windows until a full retention period has passed
                 self._current_window_index = window_index
-                self._oldest_window_index = max(
-                    1, window_index - self._num_windows)
+                self._oldest_window_index = window_index
             if window_index < self._oldest_window_index:
                 return False
             rolled = self._maybe_roll_out_new_window(window_index)

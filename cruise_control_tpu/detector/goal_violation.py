@@ -77,10 +77,17 @@ class GoalViolationDetector:
         return self._last_score
 
     def detect_now(self) -> Optional[GoalViolations]:
+        from cruise_control_tpu.core.aggregator import (
+            NotEnoughValidWindowsError)
         try:
             state, topology = self._load_monitor.cluster_model()
-        except Exception as exc:  # noqa: BLE001 - not enough data yet
+        except NotEnoughValidWindowsError as exc:
+            # expected during warm-up: not an error
             LOG.debug("skipping goal-violation sweep: %s", exc)
+            return None
+        except Exception:  # noqa: BLE001 - keep the schedule alive
+            LOG.exception(
+                "goal-violation sweep failed to build the cluster model")
             return None
         ctx = make_context(state, self._constraint, self._options, topology)
         cache = make_round_cache(state)

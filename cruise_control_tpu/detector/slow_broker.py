@@ -46,18 +46,73 @@ class SlowBrokerFinderConfig:
     min_bytes_in_rate: float = 1024.0
 
 
+#: self-healing factory: given the slow broker ids, start a fix; True if
+#: one was started (lets the fix target exactly the brokers detected)
+FixFactory = Callable[[List[int]], bool]
+
+
+def _as_factory(fn) -> Optional[FixFactory]:
+    """Accept either a plain FixFn (legacy, ignores broker ids) or a
+    FixFactory."""
+    if fn is None:
+        return None
+    import inspect
+    try:
+        takes_arg = len(inspect.signature(fn).parameters) >= 1
+    except (TypeError, ValueError):
+        takes_arg = False
+    return fn if takes_arg else (lambda ids: fn())
+
+
+class SlowBrokerDetector:
+    """Scheduled adapter: assembles [broker, window] flush-time and
+    bytes-in histories from the broker metric aggregator and runs the
+    finder (the reference MetricAnomalyDetector feeds SlowBrokerFinder the
+    same broker metric history)."""
+
+    def __init__(self, broker_aggregator, finder: "SlowBrokerFinder") -> None:
+        self._aggregator = broker_aggregator
+        self._finder = finder
+        from cruise_control_tpu.monitor import metricdef as MD
+        bdef = MD.broker_metric_def()
+        self._flush_id = bdef.metric_id(MD.BROKER_LOG_FLUSH_TIME_MS_999TH)
+        self._lin_id = bdef.metric_id(MD.LEADER_BYTES_IN)
+        self._rin_id = bdef.metric_id(MD.REPLICATION_BYTES_IN_RATE)
+
+    def detect_now(self) -> Optional[SlowBrokers]:
+        from cruise_control_tpu.core.aggregator import (
+            NotEnoughValidWindowsError)
+        try:
+            result = self._aggregator.aggregate(-np.inf, np.inf)
+        except NotEnoughValidWindowsError:
+            return None   # warm-up: no broker history yet
+        entities = sorted(result.entity_values,
+                          key=lambda e: e.broker_id)
+        if not entities:
+            return None
+        flush = np.stack([
+            result.entity_values[e].values[:, self._flush_id]
+            for e in entities])
+        bytes_in = np.stack([
+            result.entity_values[e].values[:, self._lin_id]
+            + result.entity_values[e].values[:, self._rin_id]
+            for e in entities])
+        return self._finder.detect_now(
+            [e.broker_id for e in entities], flush, bytes_in)
+
+
 class SlowBrokerFinder:
     """Feed with per-sweep metric arrays; emits SlowBrokers anomalies."""
 
     def __init__(self, report_fn: Callable[[SlowBrokers], None],
                  config: Optional[SlowBrokerFinderConfig] = None,
-                 demote_fix_fn: Optional[FixFn] = None,
-                 remove_fix_fn: Optional[FixFn] = None,
+                 demote_fix_fn=None,
+                 remove_fix_fn=None,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self._cfg = config or SlowBrokerFinderConfig()
         self._report = report_fn
-        self._demote_fix = demote_fix_fn
-        self._remove_fix = remove_fix_fn
+        self._demote_fix = _as_factory(demote_fix_fn)
+        self._remove_fix = _as_factory(remove_fix_fn)
         self._time = time_fn or _time.time
         self._scores: Dict[int, float] = {}
         self._first_detect_ms: Dict[int, float] = {}
@@ -99,6 +154,12 @@ class SlowBrokerFinder:
         suspected = sig1 & sig2 & active
 
         now_ms = self._time() * 1000.0
+        # brokers that stopped reporting (dead/removed) drop their scores —
+        # otherwise a saturated score re-raises the anomaly forever
+        present = set(broker_ids)
+        for bid in [b for b in self._scores if b not in present]:
+            del self._scores[bid]
+            self._first_detect_ms.pop(bid, None)
         for i, bid in enumerate(broker_ids):
             if suspected[i]:
                 self._scores[bid] = (self._scores.get(bid, 0.0)
@@ -117,13 +178,17 @@ class SlowBrokerFinder:
                      for b, s in self._scores.items()
                      if cfg.demotion_score <= s < cfg.removal_score}
         if to_remove:
+            ids = sorted(to_remove)
+            fix = (None if self._remove_fix is None
+                   else (lambda f=self._remove_fix, i=ids: f(i)))
             anomaly = SlowBrokers(to_remove, remove_slow_brokers=True,
-                                  fix_fn=self._remove_fix,
-                                  detected_ms=now_ms)
+                                  fix_fn=fix, detected_ms=now_ms)
         elif to_demote:
+            ids = sorted(to_demote)
+            fix = (None if self._demote_fix is None
+                   else (lambda f=self._demote_fix, i=ids: f(i)))
             anomaly = SlowBrokers(to_demote, remove_slow_brokers=False,
-                                  fix_fn=self._demote_fix,
-                                  detected_ms=now_ms)
+                                  fix_fn=fix, detected_ms=now_ms)
         else:
             return None
         self._report(anomaly)

@@ -104,6 +104,14 @@ class Executor:
         """Register and start executing proposals.  Returns the execution
         uuid.  Raises if an execution is already in progress (reference
         sanityCheckExecuteProposals)."""
+        for name, value in (("concurrent_inter_broker_moves",
+                             concurrent_inter_broker_moves),
+                            ("concurrent_leader_movements",
+                             concurrent_leader_movements)):
+            if value is not None and value < 1:
+                raise ValueError(f"{name} must be >= 1, got {value}")
+        if replication_throttle is not None and replication_throttle <= 0:
+            raise ValueError("replication_throttle must be positive")
         with self._lock:
             if self._phase != ExecutorPhase.NO_TASK_IN_PROGRESS:
                 raise RuntimeError(
@@ -119,9 +127,13 @@ class Executor:
             for b in demoted_brokers:
                 self._demoted_brokers[b] = now
             mgr = ExecutionTaskManager(
-                concurrent_inter_broker_moves or self._inter_cap,
+                concurrent_inter_broker_moves
+                if concurrent_inter_broker_moves is not None
+                else self._inter_cap,
                 self._intra_cap,
-                concurrent_leader_movements or self._leader_cap,
+                concurrent_leader_movements
+                if concurrent_leader_movements is not None
+                else self._leader_cap,
                 strategy)
             snapshot = self._admin.describe_cluster()
             mgr.load_proposals(proposals,

@@ -92,7 +92,7 @@ class ExecutionTaskPlanner:
         picked: List[ExecutionTask] = []
         slots = dict(slots_by_broker)
         for task in self.remaining_inter_broker_tasks:
-            brokers = self._participants(task)
+            brokers = task.participants()
             if all(slots.get(b, 0) > 0 for b in brokers):
                 for b in brokers:
                     slots[b] = slots.get(b, 0) - 1
@@ -104,8 +104,7 @@ class ExecutionTaskPlanner:
         picked: List[ExecutionTask] = []
         slots = dict(slots_by_broker)
         for task in self.remaining_intra_broker_tasks:
-            brokers = {r.broker_id for r in task.proposal.new_replicas}
-            brokers &= {r.broker_id for r in task.proposal.old_replicas}
+            brokers = task.intra_brokers()
             if all(slots.get(b, 0) > 0 for b in brokers):
                 for b in brokers:
                     slots[b] = slots.get(b, 0) - 1
@@ -114,12 +113,6 @@ class ExecutionTaskPlanner:
 
     def pop_leadership_tasks(self, max_tasks: int) -> List[ExecutionTask]:
         return self.remaining_leadership_tasks[:max_tasks]
-
-    @staticmethod
-    def _participants(task: ExecutionTask) -> Set[int]:
-        p = task.proposal
-        return ({r.broker_id for r in p.old_replicas}
-                | {r.broker_id for r in p.new_replicas})
 
     # ------------------------------------------------------------------
     def all_tasks(self) -> List[ExecutionTask]:

@@ -94,6 +94,20 @@ class ExecutionTask:
         self._transition(TaskState.DEAD, now_ms)
 
     # ---- queries ----
+    def participants(self) -> set:
+        """Brokers touched by this task (old + new replica sets) — the
+        slot-accounting unit for inter-broker concurrency."""
+        p = self.proposal
+        return ({r.broker_id for r in p.old_replicas}
+                | {r.broker_id for r in p.new_replicas})
+
+    def intra_brokers(self) -> set:
+        """Brokers where this task moves a replica between logdirs (the
+        new∩old set) — the slot-accounting unit for intra-broker moves."""
+        p = self.proposal
+        return ({r.broker_id for r in p.new_replicas}
+                & {r.broker_id for r in p.old_replicas})
+
     @property
     def done(self) -> bool:
         return self.state in (TaskState.COMPLETED, TaskState.ABORTED,

@@ -77,7 +77,7 @@ class ExecutionTaskManager:
             tasks = self._planner.pop_inter_broker_tasks(slots)
             for t in tasks:
                 t.in_progress(now_ms)
-                for b in self._participants(t):
+                for b in t.participants():
                     self._in_flight_inter[b] = (
                         self._in_flight_inter.get(b, 0) + 1)
             return tasks
@@ -89,9 +89,7 @@ class ExecutionTaskManager:
             tasks = self._planner.pop_intra_broker_tasks(slots)
             for t in tasks:
                 t.in_progress(now_ms)
-                both = ({r.broker_id for r in t.proposal.new_replicas}
-                        & {r.broker_id for r in t.proposal.old_replicas})
-                for b in both:
+                for b in t.intra_brokers():
                     self._in_flight_intra[b] = (
                         self._in_flight_intra.get(b, 0) + 1)
             return tasks
@@ -120,16 +118,14 @@ class ExecutionTaskManager:
             else:
                 raise ValueError(f"not a terminal state: {state}")
             if task.task_type == TaskType.INTER_BROKER_REPLICA_ACTION:
-                for b in self._participants(task):
+                for b in task.participants():
                     self._in_flight_inter[b] = max(
                         0, self._in_flight_inter.get(b, 0) - 1)
                 if state == TaskState.COMPLETED:
                     self._inter_data_moved += (
                         task.proposal.inter_broker_data_to_move)
             elif task.task_type == TaskType.INTRA_BROKER_REPLICA_ACTION:
-                both = ({r.broker_id for r in task.proposal.new_replicas}
-                        & {r.broker_id for r in task.proposal.old_replicas})
-                for b in both:
+                for b in task.intra_brokers():
                     self._in_flight_intra[b] = max(
                         0, self._in_flight_intra.get(b, 0) - 1)
             else:
@@ -139,12 +135,6 @@ class ExecutionTaskManager:
         with self._lock:
             if task.state == TaskState.IN_PROGRESS:
                 task.aborting(now_ms)
-
-    @staticmethod
-    def _participants(task: ExecutionTask) -> Set[int]:
-        p = task.proposal
-        return ({r.broker_id for r in p.old_replicas}
-                | {r.broker_id for r in p.new_replicas})
 
     # ------------------------------------------------------------------
     # queries

@@ -1,0 +1,544 @@
+"""The framework facade: one object wiring monitor → analyzer → detector →
+executor.
+
+Re-design of the reference's KafkaCruiseControl facade (reference
+CC/KafkaCruiseControl.java:70-804: construction order :100-113, startUp
+:178-184, clusterModel :290, optimizations :523, executeProposals :576,
+executeRemoval :618, executeDemotion :657, proposal-cache invalidation
+:499-517) plus the GoalOptimizer's generation-keyed proposal cache
+(CC/analyzer/GoalOptimizer.java:210-217).
+
+All REST/CLI operations land here.  Device work (goal optimization) happens
+inside GoalOptimizer; everything in this module is host-side orchestration.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time as _time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions)
+from cruise_control_tpu.analyzer.goals.registry import (
+    DEFAULT_GOAL_ORDER, KAFKA_ASSIGNER_GOAL_ORDER, default_goals, make_goal)
+from cruise_control_tpu.analyzer.optimizer import (GoalOptimizer,
+                                                   OptimizerResult)
+from cruise_control_tpu.cluster.admin import ClusterAdminClient
+from cruise_control_tpu.config.capacity import (BrokerCapacityConfigResolver,
+                                                StaticCapacityResolver)
+from cruise_control_tpu.core.anomaly import AnomalyType
+from cruise_control_tpu.core.anomaly import PercentileMetricAnomalyFinder
+from cruise_control_tpu.detector import (AnomalyDetector,
+                                         BrokerFailureDetector,
+                                         DiskFailureDetector,
+                                         GoalViolationDetector,
+                                         MetricAnomalyDetector,
+                                         SlowBrokerFinder,
+                                         TopicReplicationFactorAnomalyFinder)
+from cruise_control_tpu.detector.slow_broker import SlowBrokerDetector
+from cruise_control_tpu.detector.notifier import (AnomalyNotifier,
+                                                  SelfHealingNotifier)
+from cruise_control_tpu.executor import Executor, ExecutorNotifier
+from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.monitor.completeness import (
+    ModelCompletenessRequirements)
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.sampling.sampler import MetricSampler
+from cruise_control_tpu.utils.metrics import MetricRegistry
+
+LOG = logging.getLogger(__name__)
+
+
+class OngoingExecutionError(RuntimeError):
+    """An execution is already in progress (reference
+    sanityCheckDryRun/ongoing-execution errors)."""
+
+
+@dataclasses.dataclass
+class OperationResult:
+    """What a POST operation returns: the optimizer result (or, for
+    operations that construct proposals directly, just the proposals) plus,
+    when not a dry run, the execution uuid driving it."""
+
+    optimizer_result: Optional[OptimizerResult]
+    execution_uuid: Optional[str] = None
+    proposals: List = dataclasses.field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if self.optimizer_result is not None and not self.proposals:
+            self.proposals = list(self.optimizer_result.proposals)
+
+    @property
+    def dryrun(self) -> bool:
+        return self.execution_uuid is None
+
+
+class CruiseControl:
+    """Facade over the four service planes."""
+
+    def __init__(self, admin: ClusterAdminClient,
+                 sampler: MetricSampler,
+                 capacity_resolver: Optional[
+                     BrokerCapacityConfigResolver] = None,
+                 anomaly_notifier: Optional[AnomalyNotifier] = None,
+                 executor_notifier: Optional[ExecutorNotifier] = None,
+                 goal_names: Optional[Sequence[str]] = None,
+                 constraint: Optional[BalancingConstraint] = None,
+                 goal_violation_interval_s: float = 300.0,
+                 disk_failure_interval_s: float = 300.0,
+                 topic_anomaly_interval_s: float = 600.0,
+                 self_healing_goals: Optional[Sequence[str]] = None,
+                 time_fn: Optional[Callable[[], float]] = None,
+                 sleep_fn: Optional[Callable[[float], None]] = None,
+                 monitor_kwargs: Optional[dict] = None,
+                 executor_kwargs: Optional[dict] = None) -> None:
+        self._admin = admin
+        self._time = time_fn or _time.time
+        self._constraint = constraint or BalancingConstraint()
+        self._goal_names = list(goal_names or DEFAULT_GOAL_ORDER)
+
+        # construction order mirrors the reference facade :100-113
+        self.load_monitor = LoadMonitor(
+            admin, sampler, capacity_resolver or StaticCapacityResolver(),
+            time_fn=self._time, **(monitor_kwargs or {}))
+        self.executor = Executor(
+            admin, load_monitor=self.load_monitor,
+            notifier=executor_notifier, time_fn=self._time,
+            sleep_fn=sleep_fn, **(executor_kwargs or {}))
+        self.goal_optimizer = GoalOptimizer(
+            default_goals(names=self._goal_names), self._constraint)
+        self._ple_optimizer = GoalOptimizer(
+            [make_goal("PreferredLeaderElectionGoal")], self._constraint)
+
+        notifier = anomaly_notifier or SelfHealingNotifier(time_fn=self._time)
+        self.anomaly_detector = AnomalyDetector(
+            notifier,
+            ready_fn=self._monitor_ready,
+            fix_in_progress_fn=lambda: self.executor.has_ongoing_execution,
+            time_fn=self._time)
+        self._wire_detectors(goal_violation_interval_s,
+                             disk_failure_interval_s,
+                             topic_anomaly_interval_s)
+
+        # proposal cache (reference GoalOptimizer.validCachedProposal)
+        self._cache_lock = threading.Lock()
+        self._cached_result: Optional[OptimizerResult] = None
+        self._cached_generation = None
+
+        # sensors (reference dropwizard registry, SURVEY.md §5.1)
+        self.metrics = MetricRegistry(self._time)
+        self.metrics.gauge(
+            "balancedness-score",
+            lambda: self.goal_violation_detector.last_balancedness_score)
+
+    # ------------------------------------------------------------------
+    # lifecycle (reference startUp order :178-184)
+    # ------------------------------------------------------------------
+    def start_up(self, do_sampling: bool = True,
+                 detection_tick_s: float = 1.0,
+                 start_detection: bool = True) -> None:
+        self.load_monitor.start_up(do_sampling=do_sampling)
+        self.broker_failure_detector.start()
+        if start_detection:
+            self.anomaly_detector.start(tick_s=detection_tick_s)
+
+    def shutdown(self) -> None:
+        self.anomaly_detector.shutdown()
+        self.broker_failure_detector.shutdown()
+        self.executor.stop_execution(force=True)
+        self.executor.await_completion(timeout=30.0)
+        self.load_monitor.shutdown()
+
+    # ------------------------------------------------------------------
+    # detector wiring (self-healing fix runnables, SURVEY.md §3.5)
+    # ------------------------------------------------------------------
+    def _wire_detectors(self, gv_interval: float, disk_interval: float,
+                        topic_interval: float) -> None:
+        report = self.anomaly_detector.report
+        self.goal_violation_detector = GoalViolationDetector(
+            self.load_monitor,
+            default_goals(names=self._goal_names),   # separate instances
+            report, fix_fn=self._heal_rebalance,
+            constraint=self._constraint, time_fn=self._time)
+        self.broker_failure_detector = BrokerFailureDetector(
+            self._admin, report, fix_fn=self._heal_broker_failure,
+            time_fn=self._time)
+        self.disk_failure_detector = DiskFailureDetector(
+            self._admin, report, fix_fn=self._heal_offline_replicas,
+            time_fn=self._time)
+        self.slow_broker_finder = SlowBrokerFinder(
+            report, time_fn=self._time,
+            demote_fix_fn=self._heal_slow_brokers_demote,
+            remove_fix_fn=self._heal_slow_brokers_remove)
+        self.slow_broker_detector = SlowBrokerDetector(
+            self.load_monitor.broker_aggregator, self.slow_broker_finder)
+        self.metric_anomaly_detector = MetricAnomalyDetector(
+            self._broker_metric_history,
+            [PercentileMetricAnomalyFinder()], report)
+        self.topic_anomaly_finder = TopicReplicationFactorAnomalyFinder(
+            self._admin, report, time_fn=self._time)
+        self.anomaly_detector.register_detector(
+            self.goal_violation_detector, gv_interval)
+        self.anomaly_detector.register_detector(
+            self.disk_failure_detector, disk_interval)
+        self.anomaly_detector.register_detector(
+            self.slow_broker_detector, disk_interval)
+        self.anomaly_detector.register_detector(
+            self.metric_anomaly_detector, disk_interval)
+        self.anomaly_detector.register_detector(
+            self.topic_anomaly_finder, topic_interval)
+
+    def _monitor_ready(self) -> bool:
+        st = self.load_monitor.get_state()
+        return st.num_valid_windows > 0
+
+    def _heal_rebalance(self) -> bool:
+        try:
+            result = self.rebalance(dryrun=False,
+                                    reason="self-healing: goal violation")
+            return result.execution_uuid is not None
+        except Exception:  # noqa: BLE001 - healing failure is handled
+            LOG.exception("self-healing rebalance failed")
+            return False
+
+    def _heal_broker_failure(self) -> bool:
+        failed = sorted(self.broker_failure_detector.failed_brokers())
+        if not failed:
+            return False
+        try:
+            result = self.remove_brokers(failed, dryrun=False,
+                                         reason="self-healing: broker failure")
+            return result.execution_uuid is not None
+        except Exception:  # noqa: BLE001
+            LOG.exception("self-healing broker removal failed")
+            return False
+
+    def _heal_offline_replicas(self) -> bool:
+        try:
+            result = self.fix_offline_replicas(
+                dryrun=False, reason="self-healing: disk failure")
+            return result.execution_uuid is not None
+        except Exception:  # noqa: BLE001
+            LOG.exception("self-healing offline-replica fix failed")
+            return False
+
+    def _heal_slow_brokers_demote(self, broker_ids: List[int]) -> bool:
+        try:
+            result = self.demote_brokers(
+                broker_ids, dryrun=False,
+                reason="self-healing: slow brokers (demote)")
+            return result.execution_uuid is not None
+        except Exception:  # noqa: BLE001
+            LOG.exception("self-healing slow-broker demotion failed")
+            return False
+
+    def _heal_slow_brokers_remove(self, broker_ids: List[int]) -> bool:
+        try:
+            result = self.remove_brokers(
+                broker_ids, dryrun=False,
+                reason="self-healing: slow brokers (remove)")
+            return result.execution_uuid is not None
+        except Exception:  # noqa: BLE001
+            LOG.exception("self-healing slow-broker removal failed")
+            return False
+
+    def _broker_metric_history(self):
+        """(history, current-window) broker metric maps for the metric
+        anomaly finders (reference MetricAnomalyDetector run())."""
+        agg = self.load_monitor.broker_aggregator
+        try:
+            history = agg.aggregate(-np.inf, np.inf).entity_values
+        except Exception:  # noqa: BLE001 - warm-up
+            return {}, {}
+        current = agg.peek_current_window()
+        return history, current
+
+    # ------------------------------------------------------------------
+    # model + proposals
+    # ------------------------------------------------------------------
+    def cluster_model(self, requirements: Optional[
+            ModelCompletenessRequirements] = None):
+        with self.load_monitor.acquire_for_model_generation(), \
+                self.metrics.timer("cluster-model-creation-timer").time():
+            return self.load_monitor.cluster_model(requirements)
+
+    def optimizations(self,
+                      goals: Optional[Sequence[str]] = None,
+                      options: Optional[OptimizationOptions] = None,
+                      ignore_proposal_cache: bool = False) -> OptimizerResult:
+        """Proposals for the current cluster model.  The cache is only used
+        for the default goal list with default options and is invalidated
+        when the model generation moves (reference
+        GoalOptimizer.validCachedProposal :210-217,
+        KafkaCruiseControl.ignoreProposalCache :499-517)."""
+        cacheable = goals is None and options is None
+        generation = self.load_monitor.model_generation()
+        if cacheable and not ignore_proposal_cache:
+            with self._cache_lock:
+                if (self._cached_result is not None
+                        and self._cached_generation == generation):
+                    return self._cached_result
+        optimizer = (self.goal_optimizer if goals is None
+                     else GoalOptimizer(default_goals(names=list(goals)),
+                                        self._constraint))
+        state, topo = self.cluster_model()
+        with self.metrics.timer("proposal-computation-timer").time():
+            result = optimizer.optimizations(state, topo, options)
+        if cacheable:
+            with self._cache_lock:
+                self._cached_result = result
+                self._cached_generation = generation
+        return result
+
+    # ------------------------------------------------------------------
+    # POST operations (reference servlet/handler/async runnables)
+    # ------------------------------------------------------------------
+    def rebalance(self, goals: Optional[Sequence[str]] = None,
+                  dryrun: bool = True,
+                  options: Optional[OptimizationOptions] = None,
+                  reason: str = "rebalance",
+                  strategy: Optional[ReplicaMovementStrategy] = None,
+                  ignore_proposal_cache: bool = False,
+                  kafka_assigner: bool = False,
+                  **execute_kwargs) -> OperationResult:
+        self._sanity_check_execution(dryrun)
+        if kafka_assigner:
+            # static-assignment mode: rack evenness + swap-based disk
+            # balancing, no load-model goals (reference kafka_assigner flag)
+            goals = list(KAFKA_ASSIGNER_GOAL_ORDER)
+        result = self.optimizations(
+            goals, options,
+            ignore_proposal_cache=ignore_proposal_cache
+            or options is not None or kafka_assigner)
+        return self._maybe_execute(result, dryrun, reason, strategy,
+                                   **execute_kwargs)
+
+    def add_brokers(self, broker_ids: Sequence[int],
+                    goals: Optional[Sequence[str]] = None,
+                    dryrun: bool = True, reason: str = "add brokers",
+                    **execute_kwargs) -> OperationResult:
+        """Move replicas ONTO the new brokers only (reference
+        AddBrokerRunnable; OptimizationVerifier forbids old→old moves)."""
+        self._sanity_check_execution(dryrun)
+        state, topo = self.cluster_model()
+        idx = topo.broker_index
+        for b in broker_ids:
+            state = S.set_broker_state(state, idx[b], new=True)
+        # restrict move destinations to the added brokers: the reference
+        # forbids old->old movement during ADD_BROKER
+        # (OptimizationVerifier rule (b), SURVEY.md §4.2)
+        options = OptimizationOptions(
+            requested_destination_broker_ids=frozenset(broker_ids))
+        optimizer = self._optimizer_for(goals)
+        result = optimizer.optimizations(state, topo, options)
+        return self._maybe_execute(result, dryrun, reason, None,
+                                   **execute_kwargs)
+
+    def remove_brokers(self, broker_ids: Sequence[int],
+                       goals: Optional[Sequence[str]] = None,
+                       dryrun: bool = True, reason: str = "remove brokers",
+                       **execute_kwargs) -> OperationResult:
+        """Drain all replicas off the given brokers (reference
+        RemoveBrokerRunnable: brokers modeled as dead so self-healing
+        relocates everything)."""
+        self._sanity_check_execution(dryrun)
+        state, topo = self.cluster_model()
+        idx = topo.broker_index
+        for b in broker_ids:
+            state = S.set_broker_state(state, idx[b], alive=False)
+        optimizer = self._optimizer_for(goals)
+        result = optimizer.optimizations(state, topo)
+        return self._maybe_execute(result, dryrun, reason, None,
+                                   removed_brokers=list(broker_ids),
+                                   **execute_kwargs)
+
+    def demote_brokers(self, broker_ids: Sequence[int],
+                       dryrun: bool = True, reason: str = "demote brokers",
+                       **execute_kwargs) -> OperationResult:
+        """Shift leadership (and preferred-leader order) off the brokers
+        (reference DemoteBrokerRunnable + PreferredLeaderElectionGoal)."""
+        self._sanity_check_execution(dryrun)
+        state, topo = self.cluster_model()
+        idx = topo.broker_index
+        for b in broker_ids:
+            state = S.set_broker_state(state, idx[b], demoted=True)
+        result = self._ple_optimizer.optimizations(state, topo)
+        return self._maybe_execute(result, dryrun, reason, None,
+                                   demoted_brokers=list(broker_ids),
+                                   **execute_kwargs)
+
+    def fix_offline_replicas(self, goals: Optional[Sequence[str]] = None,
+                             dryrun: bool = True,
+                             reason: str = "fix offline replicas",
+                             **execute_kwargs) -> OperationResult:
+        """Relocate offline replicas to healthy brokers/disks (reference
+        FixOfflineReplicasRunnable)."""
+        self._sanity_check_execution(dryrun)
+        state, topo = self.cluster_model()
+        if not bool(np.asarray(S.self_healing_eligible(state)).any()):
+            raise ValueError("no offline replicas to fix")
+        optimizer = self._optimizer_for(goals)
+        result = optimizer.optimizations(state, topo)
+        return self._maybe_execute(result, dryrun, reason, None,
+                                   **execute_kwargs)
+
+    def update_topic_replication_factor(
+            self, topic: str, target_rf: int,
+            goals: Optional[Sequence[str]] = None,
+            dryrun: bool = True,
+            reason: str = "topic configuration",
+            **execute_kwargs) -> OperationResult:
+        """Grow or shrink a topic's replication factor (reference
+        TopicConfigurationRunnable + ClusterModel.createOrDeleteReplicas,
+        ClusterModel.java:905-970).  New replicas land rack-aware on the
+        least-loaded brokers; removals drop rack-duplicate followers first
+        and never the leader."""
+        from cruise_control_tpu.analyzer.proposals import (ExecutionProposal,
+                                                           ReplicaPlacement)
+        from cruise_control_tpu.model.builder import PartitionId
+
+        if target_rf < 1:
+            raise ValueError("replication factor must be >= 1")
+        self._sanity_check_execution(dryrun)
+        snapshot = self.load_monitor.metadata.refresh_metadata()
+        parts = snapshot.partitions_of(topic)
+        if not parts:
+            raise ValueError(f"unknown topic {topic!r}")
+        rack_of = {b.broker_id: (b.rack or b.host) for b in snapshot.brokers}
+        alive = sorted(snapshot.alive_broker_ids)
+        if target_rf > len(alive):
+            raise ValueError(
+                f"replication factor {target_rf} exceeds {len(alive)} "
+                f"alive brokers")
+        counts: Dict[int, int] = {b: 0 for b in alive}
+        for p in snapshot.partitions:
+            for b in p.replicas:
+                if b in counts:
+                    counts[b] += 1
+
+        proposals = []
+        for p in sorted(parts, key=lambda x: x.tp.partition):
+            old = list(p.replicas)
+            new = list(old)
+            while len(new) < target_rf:
+                used_racks = {rack_of[b] for b in new if b in rack_of}
+                candidates = [b for b in alive if b not in new]
+                if not candidates:
+                    raise ValueError(
+                        f"not enough brokers for rf={target_rf}")
+                # unused rack first, then fewest replicas
+                candidates.sort(key=lambda b: (rack_of[b] in used_racks,
+                                               counts[b], b))
+                pick = candidates[0]
+                new.append(pick)
+                counts[pick] += 1
+            while len(new) > target_rf:
+                followers = [b for b in new if b != p.leader]
+                if not followers:
+                    break
+                rack_tally: Dict[str, int] = {}
+                for b in new:
+                    rack_tally[rack_of.get(b, "?")] = rack_tally.get(
+                        rack_of.get(b, "?"), 0) + 1
+                # duplicated rack first, then most-loaded broker
+                followers.sort(key=lambda b: (
+                    -rack_tally.get(rack_of.get(b, "?"), 0),
+                    -counts.get(b, 0), -b))
+                drop = followers[0]
+                new.remove(drop)
+                if drop in counts:
+                    counts[drop] -= 1
+            if new != old:
+                leader = p.leader if p.leader is not None else new[0]
+                ordered_old = [leader] + [b for b in old if b != leader]
+                ordered_new = [leader] + [b for b in new if b != leader]
+                proposals.append(ExecutionProposal(
+                    partition=PartitionId(topic, p.tp.partition),
+                    old_leader=leader,
+                    old_replicas=tuple(ReplicaPlacement(b)
+                                       for b in ordered_old),
+                    new_replicas=tuple(ReplicaPlacement(b)
+                                       for b in ordered_new)))
+        if dryrun or not proposals:
+            return OperationResult(None, proposals=proposals)
+        uuid = self.executor.execute_proposals(proposals, reason=reason,
+                                               **execute_kwargs)
+        return OperationResult(None, execution_uuid=uuid,
+                               proposals=proposals)
+
+    def stop_execution(self, force: bool = False) -> None:
+        self.executor.stop_execution(force=force)
+
+    def pause_sampling(self, reason: str = "paused by user") -> None:
+        self.load_monitor.pause_metric_sampling(reason)
+
+    def resume_sampling(self, reason: str = "resumed by user") -> None:
+        self.load_monitor.resume_metric_sampling(reason)
+
+    # ------------------------------------------------------------------
+    # state (reference servlet/response/CruiseControlState.java)
+    # ------------------------------------------------------------------
+    def state(self, substates: Optional[Sequence[str]] = None) -> dict:
+        want = {s.lower() for s in (substates or
+                                    ("monitor", "executor", "analyzer",
+                                     "anomaly_detector"))}
+        out: dict = {}
+        if "monitor" in want:
+            ms = self.load_monitor.get_state()
+            out["MonitorState"] = {
+                "state": ms.state,
+                "numValidWindows": ms.num_valid_windows,
+                "totalNumWindows": ms.total_num_windows,
+                "monitoredPartitionsPercentage":
+                    ms.monitored_partitions_percentage,
+                "numMonitoredPartitions": ms.num_monitored_partitions,
+                "numTotalPartitions": ms.num_total_partitions,
+                "reasonOfPause": ms.reason_of_pause,
+            }
+        if "executor" in want:
+            out["ExecutorState"] = self.executor.state.to_json()
+        if "analyzer" in want:
+            with self._cache_lock:
+                cached = self._cached_result
+            out["AnalyzerState"] = {
+                "isProposalReady": cached is not None,
+                "goals": self._goal_names,
+                "readyGoals": self._goal_names if cached is not None else [],
+            }
+        if "anomaly_detector" in want:
+            out["AnomalyDetectorState"] = self.anomaly_detector.to_json()
+        if "sensors" in want:
+            out["Sensors"] = self.metrics.to_json()
+        return out
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _optimizer_for(self, goals: Optional[Sequence[str]]) -> GoalOptimizer:
+        if goals is None:
+            return self.goal_optimizer
+        return GoalOptimizer(default_goals(names=list(goals)),
+                             self._constraint)
+
+    def _sanity_check_execution(self, dryrun: bool) -> None:
+        if not dryrun and self.executor.has_ongoing_execution:
+            raise OngoingExecutionError(
+                "cannot start execution: another execution is in progress")
+
+    def _maybe_execute(self, result: OptimizerResult, dryrun: bool,
+                       reason: str,
+                       strategy: Optional[ReplicaMovementStrategy],
+                       **execute_kwargs) -> OperationResult:
+        if dryrun or not result.proposals:
+            return OperationResult(result)
+        uuid = self.executor.execute_proposals(
+            result.proposals, reason=reason, strategy=strategy,
+            **execute_kwargs)
+        with self._cache_lock:    # executing invalidates cached proposals
+            self._cached_result = None
+        return OperationResult(result, execution_uuid=uuid)

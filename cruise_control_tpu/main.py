@@ -1,0 +1,185 @@
+"""Application entry point.
+
+Reference CC/KafkaCruiseControlMain.java:23-53 + KafkaCruiseControlApp.java:
+read a properties file, build the service stack from config, start the REST
+server, block until interrupted.  Pluggable classes (sampler, sample store,
+capacity resolver, notifiers, security provider) are instantiated from
+config exactly like the reference's getConfiguredInstance wiring.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+from typing import Mapping, Optional
+
+from cruise_control_tpu.api.security import (BasicSecurityProvider,
+                                             NoSecurityProvider)
+from cruise_control_tpu.api.server import CruiseControlApp
+from cruise_control_tpu.config.capacity import (
+    BrokerCapacityConfigFileResolver, BrokerCapacityConfigResolver)
+from cruise_control_tpu.config.main_config import CruiseControlConfig
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor.sampling.sample_store import SampleStore
+from cruise_control_tpu.monitor.sampling.sampler import MetricSampler
+
+LOG = logging.getLogger(__name__)
+
+
+def read_properties(path: str) -> dict:
+    """Java-style `key=value` properties file (reference readConfig)."""
+    props = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith(("#", "!")):
+                continue
+            if "=" in line:
+                k, v = line.split("=", 1)
+                props[k.strip()] = v.strip()
+    return props
+
+
+def build_cruise_control(config: CruiseControlConfig, admin,
+                         sampler: Optional[MetricSampler] = None
+                         ) -> CruiseControl:
+    """Assemble the facade from config (reference KafkaCruiseControl
+    constructor wiring :100-113)."""
+    if sampler is None:
+        sampler = config.get_configured_instance(
+            "metric.sampler.class", MetricSampler)
+    capacity_file = config.get("capacity.config.file")
+    if capacity_file:
+        resolver: BrokerCapacityConfigResolver = \
+            BrokerCapacityConfigFileResolver(capacity_file)
+    else:
+        resolver = config.get_configured_instance(
+            "broker.capacity.config.resolver.class",
+            BrokerCapacityConfigResolver)
+    sample_store = config.get_configured_instance(
+        "sample.store.class", SampleStore)
+    notifier = config.get_configured_instance("anomaly.notifier.class")
+    return CruiseControl(
+        admin, sampler,
+        capacity_resolver=resolver,
+        anomaly_notifier=notifier,
+        goal_names=[g for g in config.get_list("goals") if g],
+        goal_violation_interval_s=config.get_long(
+            "anomaly.detection.interval.ms") / 1e3,
+        monitor_kwargs=dict(
+            sample_store=sample_store,
+            num_windows=config.get_int("num.partition.metrics.windows"),
+            window_ms=config.get_long("partition.metrics.window.ms"),
+            min_samples_per_window=config.get_int(
+                "min.samples.per.partition.metrics.window"),
+            broker_num_windows=config.get_int("num.broker.metrics.windows"),
+            sampling_interval_ms=config.get_long(
+                "metric.sampling.interval.ms"),
+            num_fetchers=config.get_int("num.metric.fetchers"),
+            metadata_ttl_ms=config.get_long("metadata.ttl.ms")),
+        executor_kwargs=dict(
+            concurrent_inter_broker_moves_per_broker=config.get_int(
+                "num.concurrent.partition.movements.per.broker"),
+            concurrent_intra_broker_moves_per_broker=config.get_int(
+                "num.concurrent.intra.broker.partition.movements"),
+            concurrent_leader_movements=config.get_int(
+                "num.concurrent.leader.movements"),
+            progress_check_interval_s=config.get_long(
+                "execution.progress.check.interval.ms") / 1e3))
+
+
+def build_app(config: CruiseControlConfig,
+              cruise_control: CruiseControl) -> CruiseControlApp:
+    if config.get_boolean("webserver.security.enable"):
+        creds = config.get("webserver.auth.credentials.file")
+        security = (BasicSecurityProvider.from_credentials_file(creds)
+                    if creds else NoSecurityProvider())
+    else:
+        security = NoSecurityProvider()
+    return CruiseControlApp(
+        cruise_control, security=security,
+        two_step_verification=config.get_boolean(
+            "two.step.verification.enabled"),
+        async_response_timeout_s=config.get_long(
+            "webserver.request.maxBlockTimeMs") / 1e3)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cruise-control-tpu",
+        description="TPU-native cluster-rebalancing service")
+    parser.add_argument("config", help="properties file")
+    parser.add_argument("port", nargs="?", type=int,
+                        help="REST port override")
+    parser.add_argument("host", nargs="?", help="REST host override")
+    parser.add_argument("--demo-cluster", action="store_true",
+                        help="run against an in-process simulated cluster "
+                             "(no external infrastructure)")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    config = CruiseControlConfig(read_properties(args.config))
+
+    if args.demo_cluster:
+        from cruise_control_tpu.cluster.simulated import SimulatedCluster
+        from cruise_control_tpu.monitor.sampling.sampler import (
+            SimulatedClusterSampler)
+        import time as _t
+        admin = SimulatedCluster(time_fn=_t.time)
+        for b in range(6):
+            admin.add_broker(b, rack=f"rack{b % 3}")
+        from cruise_control_tpu.cluster.types import TopicPartition
+        # sizes well inside StaticCapacityResolver's default DISK capacity
+        admin.create_topic(
+            "demo", [[b % 6, (b + 1) % 6] for b in range(24)],
+            size_bytes=1e4)
+        for p in range(24):
+            admin.set_partition_load(TopicPartition("demo", p),
+                                     leader_cpu=1.0, nw_in=50.0,
+                                     nw_out=100.0)
+        sampler = SimulatedClusterSampler(admin)
+        cc = build_cruise_control(config, admin, sampler=sampler)
+    else:
+        admin_cls = config.get("cluster.admin.class") \
+            if "cluster.admin.class" in config.originals else None
+        if not admin_cls:
+            print("error: provide --demo-cluster or set "
+                  "cluster.admin.class to a ClusterAdminClient "
+                  "implementation for your infrastructure",
+                  file=sys.stderr)
+            return 2
+        from cruise_control_tpu.common.config import resolve_class
+        admin = resolve_class(admin_cls)()
+        cc = build_cruise_control(config, admin)
+
+    app = build_app(config, cc)
+    cc.start_up()
+    host = args.host or config.get("webserver.http.address")
+    port = args.port if args.port is not None \
+        else config.get_int("webserver.http.port")
+    bound = app.start(host=host, port=port)
+    LOG.info("REST API listening on http://%s:%d%s", host, bound,
+             "/kafkacruisecontrol")
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):  # noqa: ARG001
+        stop.set()
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    try:
+        while not stop.wait(1.0):
+            pass
+    finally:
+        LOG.info("shutting down")
+        app.stop()
+        cc.shutdown()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,120 @@
+"""Trainable linear CPU-estimation model.
+
+Reference CC/model/LinearRegressionModelParameters.java:27-374 +
+ModelParameters / ModelUtils.java:41-70: broker CPU utilization is modeled
+as a linear function of leader-bytes-in, leader-bytes-out and
+follower(replication)-bytes-in rates; training collects broker metric
+samples and solves for the coefficients, which then drive leader/follower
+CPU attribution in the workload model.
+
+Re-design: instead of the reference's bucketed incremental accumulation,
+training is one batched least-squares solve over the full sample matrix
+(numpy lstsq — the matrix is [samples × 3], tiny)."""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class CpuModelCoefficients:
+    """CPU% contributed per byte/s of each traffic kind."""
+
+    leader_bytes_in: float
+    leader_bytes_out: float
+    follower_bytes_in: float
+
+    def estimate_leader_cpu(self, leader_nw_in: float, leader_nw_out: float
+                            ) -> float:
+        return (self.leader_bytes_in * leader_nw_in
+                + self.leader_bytes_out * leader_nw_out)
+
+    def estimate_follower_cpu(self, follower_nw_in: float) -> float:
+        return self.follower_bytes_in * follower_nw_in
+
+
+class LinearRegressionCpuModel:
+    """Accumulates (cpu, leader_in, leader_out, replication_in) training
+    rows and fits coefficients on demand."""
+
+    MIN_SAMPLES = 8
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._rows: list = []
+        self._coefficients: Optional[CpuModelCoefficients] = None
+
+    # ------------------------------------------------------------------
+    def add_sample(self, cpu_pct: float, leader_bytes_in: float,
+                   leader_bytes_out: float,
+                   replication_bytes_in: float) -> None:
+        with self._lock:
+            self._rows.append((cpu_pct, leader_bytes_in, leader_bytes_out,
+                               replication_bytes_in))
+
+    def clear_samples(self) -> None:
+        """Drop accumulated training rows (callers that re-feed the full
+        history each training round must clear first, or rows duplicate)."""
+        with self._lock:
+            self._rows.clear()
+
+    @property
+    def num_samples(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    @property
+    def trained(self) -> bool:
+        with self._lock:
+            return self._coefficients is not None
+
+    @property
+    def coefficients(self) -> Optional[CpuModelCoefficients]:
+        with self._lock:
+            return self._coefficients
+
+    # ------------------------------------------------------------------
+    def train(self) -> CpuModelCoefficients:
+        """Non-negative least squares fit (coefficients are physical rates,
+        so negatives are clamped and refit without that feature —
+        the reference likewise guards against nonsensical coefficients)."""
+        with self._lock:
+            rows = np.asarray(self._rows, dtype=np.float64)
+        if rows.shape[0] < self.MIN_SAMPLES:
+            raise ValueError(
+                f"need >= {self.MIN_SAMPLES} training samples, "
+                f"have {rows.shape[0]}")
+        y = rows[:, 0]
+        X = rows[:, 1:4]
+        active = [0, 1, 2]
+        coef = np.zeros(3)
+        for _ in range(3):
+            sol, *_ = np.linalg.lstsq(X[:, active], y, rcond=None)
+            if (sol >= 0).all():
+                for i, a in enumerate(active):
+                    coef[a] = sol[i]
+                break
+            # drop the most negative feature and refit
+            worst = active[int(np.argmin(sol))]
+            active = [a for a in active if a != worst]
+            if not active:
+                break
+        result = CpuModelCoefficients(*coef)
+        with self._lock:
+            self._coefficients = result
+        return result
+
+    def training_error(self) -> Optional[float]:
+        """RMS error of the fit over the training rows."""
+        with self._lock:
+            coefs = self._coefficients
+            rows = np.asarray(self._rows, dtype=np.float64)
+        if coefs is None or rows.shape[0] == 0:
+            return None
+        pred = (coefs.leader_bytes_in * rows[:, 1]
+                + coefs.leader_bytes_out * rows[:, 2]
+                + coefs.follower_bytes_in * rows[:, 3])
+        return float(np.sqrt(np.mean((pred - rows[:, 0]) ** 2)))

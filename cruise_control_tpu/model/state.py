@@ -350,6 +350,27 @@ def set_broker_state(state: ClusterState, broker: int, *, alive: bool = None,
     return state.replace(**updates)
 
 
+def apply_disk_moves(state: ClusterState, replicas: jax.Array,
+                     dest_disks: jax.Array, valid: jax.Array) -> ClusterState:
+    """Batched intra-broker relocation: move K replicas between logdirs of
+    their own broker (reference ClusterModel intra-broker relocateReplica /
+    Disk.moveReplica).  Broker assignment is untouched; moving off a broken
+    logdir clears the replica's offline flag."""
+    replicas = replicas.astype(jnp.int32)
+    num_r = state.replica_broker.shape[0]
+    tgt = dest_disks.astype(jnp.int32)
+    same_broker = (state.disk_broker[jnp.maximum(tgt, 0)]
+                   == state.replica_broker[replicas])
+    valid = valid & same_broker & (state.replica_disk[replicas] != tgt)
+    idx = jnp.where(valid, replicas, num_r)
+    new_disk = state.replica_disk.at[idx].set(tgt, mode="drop")
+    tgt_dead = ~state.disk_alive[jnp.maximum(tgt, 0)]
+    broker_dead = ~state.broker_alive[state.replica_broker[replicas]]
+    new_offline = state.replica_offline.at[idx].set(
+        tgt_dead | broker_dead, mode="drop")
+    return state.replace(replica_disk=new_disk, replica_offline=new_offline)
+
+
 def mark_disk_dead(state: ClusterState, disk: int) -> ClusterState:
     """Mark one logdir broken: its replicas become offline while the broker
     stays alive with bad disks (reference Disk.State / BAD_DISKS broker

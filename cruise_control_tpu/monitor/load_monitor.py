@@ -32,6 +32,7 @@ from cruise_control_tpu.config.capacity import (BrokerCapacity,
                                                 StaticCapacityResolver)
 from cruise_control_tpu.core.aggregator import (NotEnoughValidWindowsError,
                                                 ValuesAndExtrapolations)
+from cruise_control_tpu.model.cpu_model import LinearRegressionCpuModel
 from cruise_control_tpu.model.builder import (ClusterModelBuilder,
                                               ClusterTopology,
                                               estimate_follower_cpu)
@@ -131,6 +132,9 @@ class LoadMonitor:
         self._nw_in_id = cdef.metric_id(MD.LEADER_BYTES_IN)
         self._nw_out_id = cdef.metric_id(MD.LEADER_BYTES_OUT)
         self._disk_id = cdef.metric_id(MD.DISK_USAGE)
+        #: trainable CPU attribution model (reference TRAIN endpoint +
+        #: LinearRegressionModelParameters)
+        self.cpu_model = LinearRegressionCpuModel()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -219,6 +223,29 @@ class LoadMonitor:
             num_total_partitions=total,
             reason_of_pause=self.task_runner.reason_of_pause,
             last_sampling_ms=self._fetcher.last_sampling_ms)
+
+    # ------------------------------------------------------------------
+    # CPU model training (reference TrainingTask.java + TRAIN endpoint)
+    # ------------------------------------------------------------------
+    def train(self) -> None:
+        """Fit the linear CPU model from the broker metric history: every
+        (broker, window) cell contributes one training row of
+        (cpu%, leader-bytes-in, leader-bytes-out, replication-bytes-in)."""
+        bdef = MD.broker_metric_def()
+        cpu = bdef.metric_id(MD.CPU_USAGE)
+        lin = bdef.metric_id(MD.LEADER_BYTES_IN)
+        lout = bdef.metric_id(MD.LEADER_BYTES_OUT)
+        rin = bdef.metric_id(MD.REPLICATION_BYTES_IN_RATE)
+        result = self._broker_aggregator.aggregate(-np.inf, np.inf)
+        # each training round feeds the FULL current history
+        self.cpu_model.clear_samples()
+        for vae in result.entity_values.values():
+            vals = vae.values
+            for w in range(vals.shape[0]):
+                self.cpu_model.add_sample(
+                    float(vals[w, cpu]), float(vals[w, lin]),
+                    float(vals[w, lout]), float(vals[w, rin]))
+        self.cpu_model.train()
 
     # ------------------------------------------------------------------
     # model building

@@ -1,0 +1,170 @@
+"""Lightweight metric registry: counters, gauges, meters, timers.
+
+Reference: dropwizard MetricRegistry exported over JMX domain
+`kafka.cruisecontrol` (CC/KafkaCruiseControlApp.java:39-41) with sensors
+like `proposal-computation-timer` (GoalOptimizer.java:118),
+`cluster-model-creation-timer` (LoadMonitor.java:180) and per-endpoint
+request timers/meters (KafkaCruiseControlServlet.java:60-65); sensor list
+doc docs/wiki "Sensors".  Here the registry is process-local and exported
+as JSON through the STATE endpoint's `sensors` substate.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time as _time
+from typing import Callable, Dict, List, Optional
+
+
+class Counter:
+    def __init__(self) -> None:
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def count(self) -> int:
+        return self._v
+
+    def to_json(self) -> dict:
+        return {"type": "counter", "count": self._v}
+
+
+class Meter:
+    """Event rate: count + events/s over the process lifetime and a
+    sliding recent window."""
+
+    def __init__(self, time_fn: Callable[[], float] = _time.time,
+                 window_s: float = 300.0) -> None:
+        self._time = time_fn
+        self._window_s = window_s
+        self._lock = threading.Lock()
+        self._count = 0
+        self._start = time_fn()
+        self._recent: List[float] = []
+
+    def mark(self, n: int = 1) -> None:
+        now = self._time()
+        with self._lock:
+            self._count += n
+            self._recent.extend([now] * min(n, 100))
+            cutoff = now - self._window_s
+            while self._recent and self._recent[0] < cutoff:
+                self._recent.pop(0)
+
+    def to_json(self) -> dict:
+        now = self._time()
+        with self._lock:
+            lifetime = max(now - self._start, 1e-9)
+            recent = [t for t in self._recent if t >= now - self._window_s]
+            return {"type": "meter", "count": self._count,
+                    "meanRate": self._count / lifetime,
+                    "recentRate": len(recent) / self._window_s}
+
+
+class Timer:
+    """Duration stats (count, mean, max, last, approximate p99 via a
+    bounded reservoir)."""
+
+    RESERVOIR = 256
+
+    def __init__(self, time_fn: Callable[[], float] = _time.time) -> None:
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._last = 0.0
+        self._samples: List[float] = []
+
+    def update(self, duration_s: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._sum += duration_s
+            self._max = max(self._max, duration_s)
+            self._last = duration_s
+            if len(self._samples) < self.RESERVOIR:
+                self._samples.append(duration_s)
+            else:
+                # deterministic reservoir: overwrite cyclically
+                self._samples[self._count % self.RESERVOIR] = duration_s
+
+    def time(self) -> "_TimerContext":
+        return _TimerContext(self)
+
+    def to_json(self) -> dict:
+        with self._lock:
+            if not self._count:
+                return {"type": "timer", "count": 0}
+            ordered = sorted(self._samples)
+            p99 = ordered[min(len(ordered) - 1,
+                              math.ceil(0.99 * len(ordered)) - 1)]
+            return {"type": "timer", "count": self._count,
+                    "meanMs": 1e3 * self._sum / self._count,
+                    "maxMs": 1e3 * self._max, "lastMs": 1e3 * self._last,
+                    "p99Ms": 1e3 * p99}
+
+
+class _TimerContext:
+    def __init__(self, timer: Timer) -> None:
+        self._timer = timer
+
+    def __enter__(self) -> "_TimerContext":
+        self._t0 = self._timer._time()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._timer.update(self._timer._time() - self._t0)
+
+
+class Gauge:
+    def __init__(self, fn: Callable[[], float]) -> None:
+        self._fn = fn
+
+    def to_json(self) -> dict:
+        try:
+            return {"type": "gauge", "value": self._fn()}
+        except Exception:  # noqa: BLE001 - gauges must never break export
+            return {"type": "gauge", "value": None}
+
+
+class MetricRegistry:
+    """Named sensors; one registry per CruiseControl instance."""
+
+    def __init__(self, time_fn: Callable[[], float] = _time.time) -> None:
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._sensors: Dict[str, object] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def meter(self, name: str) -> Meter:
+        return self._get(name, lambda: Meter(self._time))
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, lambda: Timer(self._time))
+
+    def gauge(self, name: str, fn: Callable[[], float]) -> Gauge:
+        with self._lock:
+            g = self._sensors.get(name)
+            if not isinstance(g, Gauge):
+                g = Gauge(fn)
+                self._sensors[name] = g
+            return g
+
+    def _get(self, name: str, factory):
+        with self._lock:
+            s = self._sensors.get(name)
+            if s is None:
+                s = factory()
+                self._sensors[name] = s
+            return s
+
+    def to_json(self) -> Dict[str, dict]:
+        with self._lock:
+            items = list(self._sensors.items())
+        return {name: s.to_json() for name, s in sorted(items)}

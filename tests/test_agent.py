@@ -1,0 +1,133 @@
+"""Metrics-agent pipeline tests.
+
+Models the reference's metrics-reporter tests
+(CruiseControlMetricsReporterTest: reporter in a real broker producing to
+the metrics topic; MetricsUtils/serde unit tests) — here the full
+production-shaped pipeline: agent -> serialized records -> transport ->
+processor -> aggregator samples -> cluster model.
+"""
+import conftest  # noqa: F401
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.agent import (AgentMetric, AgentMetricsReporterSampler,
+                                      InProcessMetricsTransport,
+                                      MetricsReporterAgent, RawMetricType,
+                                      SimulatedNodeMetricsSource,
+                                      deserialize, serialize)
+from cruise_control_tpu.cluster.simulated import SimulatedCluster
+from cruise_control_tpu.cluster.types import TopicPartition
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.config.capacity import StaticCapacityResolver
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+
+T = RawMetricType
+
+
+class TestSerde:
+    def test_roundtrip_all_scopes(self):
+        for m in (
+            AgentMetric(T.BROKER_CPU_UTIL, 3, 1234.0, 55.5),
+            AgentMetric(T.TOPIC_BYTES_IN, 1, 99.0, 1e6, topic="t"),
+            AgentMetric(T.PARTITION_SIZE, 2, 5.0, 42.0, topic="t",
+                        partition=7),
+        ):
+            assert deserialize(serialize(m)) == m
+
+    def test_scope_validation(self):
+        with pytest.raises(ValueError):
+            AgentMetric(T.TOPIC_BYTES_IN, 1, 0.0, 1.0)      # topic missing
+        with pytest.raises(ValueError):
+            AgentMetric(T.PARTITION_SIZE, 1, 0.0, 1.0, topic="t")
+
+
+def make_sim(num_brokers=4, partitions=8, rf=2):
+    sim = SimulatedCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b, rack=f"r{b % 2}")
+    assignments = [[(p + i) % num_brokers for i in range(rf)]
+                   for p in range(partitions)]
+    sim.create_topic("t0", assignments, size_bytes=1e4)
+    for p in range(partitions):
+        sim.set_partition_load(TopicPartition("t0", p), leader_cpu=2.0,
+                               nw_in=100.0, nw_out=300.0)
+    return sim
+
+
+class TestAgentPipeline:
+    def test_agents_report_and_processor_builds_samples(self):
+        sim = make_sim()
+        transport = InProcessMetricsTransport()
+        clock = {"now": 10_000.0}
+        agents = [MetricsReporterAgent(
+            SimulatedNodeMetricsSource(sim, b), transport,
+            time_fn=lambda: clock["now"]) for b in range(4)]
+        for a in agents:
+            assert a.report_once() > 0
+        sampler = AgentMetricsReporterSampler(transport)
+        snapshot = sim.describe_cluster()
+        samples = sampler.get_samples(
+            snapshot, {p.tp for p in snapshot.partitions}, 0.0, 20_000e3)
+        assert len(samples.broker_samples) == 4
+        # every partition got a sample from its leader's agent
+        assert len(samples.partition_samples) == 8
+        # per-partition bytes share: topic bytes-in split across leaders'
+        # partitions; each leader leads 2 of its topic partitions
+        from cruise_control_tpu.monitor import metricdef as MD
+        cdef = MD.common_metric_def()
+        nw_id = cdef.metric_id(MD.LEADER_BYTES_IN)
+        for s in samples.partition_samples:
+            assert s.values[nw_id] == pytest.approx(100.0)
+
+    def test_pipeline_feeds_cluster_model(self):
+        sim = make_sim()
+        transport = InProcessMetricsTransport()
+        clock = {"now": 10_000.0}
+        agents = [MetricsReporterAgent(
+            SimulatedNodeMetricsSource(sim, b), transport,
+            time_fn=lambda: clock["now"]) for b in range(4)]
+        monitor = LoadMonitor(
+            sim, AgentMetricsReporterSampler(transport),
+            StaticCapacityResolver(), num_windows=3, window_ms=10_000,
+            min_samples_per_window=1, sampling_interval_ms=5_000,
+            time_fn=lambda: clock["now"])
+        monitor.start_up(do_sampling=False)
+        for _ in range(8):
+            for a in agents:
+                a.report_once()
+            monitor.task_runner.sample_once()
+            clock["now"] += 10.0
+        state, topo = monitor.cluster_model()
+        assert state.num_brokers == 4
+        assert int(np.asarray(state.replica_valid).sum()) == 16
+        load = np.asarray(S.broker_load(state))
+        # leaders carry NW_OUT; followers add replication NW_IN
+        assert load[:, Resource.NW_OUT].sum() == pytest.approx(
+            8 * 300.0, rel=1e-3)
+        monitor.shutdown()
+
+    def test_corrupt_records_dropped(self):
+        transport = InProcessMetricsTransport()
+        transport.produce([b"garbage", serialize(
+            AgentMetric(T.BROKER_CPU_UTIL, 0, 1.0, 10.0))])
+        sampler = AgentMetricsReporterSampler(transport)
+        sim = make_sim(num_brokers=1, partitions=1, rf=1)
+        snapshot = sim.describe_cluster()
+        samples = sampler.get_samples(snapshot, set(), 0.0, 1e9)
+        assert len(samples.broker_samples) == 1
+
+    def test_background_reporting_thread(self):
+        sim = make_sim()
+        transport = InProcessMetricsTransport()
+        agent = MetricsReporterAgent(
+            SimulatedNodeMetricsSource(sim, 0), transport,
+            reporting_interval_s=0.05)
+        agent.start()
+        import time
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not transport.poll(1):
+            time.sleep(0.05)
+        agent.shutdown()
+        assert agent._thread is None

@@ -1,0 +1,351 @@
+"""REST API tests.
+
+Models the reference's servlet tests (parameter validation per
+*Parameters class, ResponseTest schema walk, security integration tests,
+purgatory/2-step flow) against the transport-free dispatch core, plus one
+real HTTP round-trip through the stdlib server.
+"""
+import json
+import time
+import urllib.request
+
+import conftest  # noqa: F401
+import pytest
+
+from cruise_control_tpu.api import (BasicSecurityProvider, ParameterError,
+                                    Purgatory, QueryParams, Role,
+                                    TokenSecurityProvider,
+                                    USER_TASK_ID_HEADER, UserTaskManager)
+from cruise_control_tpu.api.security import (AuthenticationError,
+                                             AuthorizationError)
+from cruise_control_tpu.api.server import CruiseControlApp
+from cruise_control_tpu.cluster.types import TopicPartition
+
+from test_facade import feed_samples, make_stack
+
+
+class TestQueryParams:
+    def test_unknown_param_rejected(self):
+        with pytest.raises(ParameterError):
+            QueryParams("REBALANCE", {"no_such_param": ["1"]})
+
+    def test_typed_accessors(self):
+        p = QueryParams("REBALANCE", {
+            "dryrun": ["false"], "goals": ["RackAwareGoal,DiskCapacityGoal"],
+            "concurrent_leader_movements": ["12"],
+            "replication_throttle": ["1.5e6"]})
+        assert p.get_bool("dryrun", default=True) is False
+        assert p.get_csv("goals") == ["RackAwareGoal", "DiskCapacityGoal"]
+        assert p.get_int("concurrent_leader_movements") == 12
+        assert p.get_float("replication_throttle") == 1.5e6
+
+    def test_bad_values(self):
+        with pytest.raises(ParameterError):
+            QueryParams("REBALANCE", {"dryrun": ["maybe"]}).get_bool("dryrun")
+        with pytest.raises(ParameterError):
+            QueryParams("ADD_BROKER",
+                        {"brokerid": ["x"]}).get_csv_ints("brokerid")
+
+
+class TestSecurity:
+    def test_basic_auth_roles(self):
+        import base64
+        provider = BasicSecurityProvider({
+            "admin": ("secret", Role.ADMIN),
+            "viewer": ("pw", Role.VIEWER)})
+
+        def hdr(user, pw):
+            tok = base64.b64encode(f"{user}:{pw}".encode()).decode()
+            return {"Authorization": f"Basic {tok}"}
+
+        admin = provider.authenticate(hdr("admin", "secret"))
+        assert admin.role == Role.ADMIN
+        provider.authorize(admin, "REBALANCE")   # no raise
+        viewer = provider.authenticate(hdr("viewer", "pw"))
+        with pytest.raises(AuthorizationError):
+            provider.authorize(viewer, "REBALANCE")
+        provider.authorize(viewer, "STATE")
+        with pytest.raises(AuthenticationError):
+            provider.authenticate(hdr("admin", "wrong"))
+        with pytest.raises(AuthenticationError):
+            provider.authenticate({})
+
+    def test_token_provider_roundtrip_and_expiry(self):
+        clock = {"t": 1000.0}
+        provider = TokenSecurityProvider(b"k3y", time_fn=lambda: clock["t"])
+        token = provider.issue("alice", Role.USER, ttl_s=60.0)
+        p = provider.authenticate({"Authorization": f"Bearer {token}"})
+        assert p.name == "alice" and p.role == Role.USER
+        clock["t"] += 61.0
+        with pytest.raises(AuthenticationError):
+            provider.authenticate({"Authorization": f"Bearer {token}"})
+        with pytest.raises(AuthenticationError):
+            provider.authenticate(
+                {"Authorization": f"Bearer {token[:-2]}xx"})
+
+
+class TestPurgatory:
+    def test_review_flow(self):
+        purgatory = Purgatory()
+        req = purgatory.submit("REBALANCE", "dryrun=false", "alice")
+        assert req.status.value == "PENDING_REVIEW"
+        purgatory.review([req.review_id], [], reason="lgtm")
+        taken = purgatory.take_approved(req.review_id, "REBALANCE",
+                                        "dryrun=false")
+        assert taken.status.value == "SUBMITTED"
+        # one-shot: cannot take again
+        with pytest.raises(ValueError):
+            purgatory.take_approved(req.review_id, "REBALANCE",
+                                    "dryrun=false")
+
+    def test_discard_and_wrong_endpoint(self):
+        purgatory = Purgatory()
+        req = purgatory.submit("REMOVE_BROKER", "brokerid=1", "bob")
+        purgatory.review([], [req.review_id])
+        with pytest.raises(ValueError):
+            purgatory.take_approved(req.review_id, "REMOVE_BROKER",
+                                    "brokerid=1")
+        req2 = purgatory.submit("REBALANCE", "", "bob")
+        purgatory.review([req2.review_id], [])
+        with pytest.raises(ValueError):
+            purgatory.take_approved(req2.review_id, "REMOVE_BROKER", "")
+
+    def test_approval_bound_to_parameters(self):
+        # an approval for a dry run must not authorize a live run
+        purgatory = Purgatory()
+        req = purgatory.submit("REBALANCE", "dryrun=true", "mallory")
+        purgatory.review([req.review_id], [])
+        with pytest.raises(ValueError):
+            purgatory.take_approved(req.review_id, "REBALANCE",
+                                    "dryrun=false")
+        # review_id itself is excluded from the comparison
+        taken = purgatory.take_approved(
+            req.review_id, "REBALANCE",
+            f"dryrun=true&review_id={req.review_id}")
+        assert taken.status.value == "SUBMITTED"
+
+
+class TestUserTaskManager:
+    def test_attach_by_same_request(self):
+        utm = UserTaskManager()
+        calls = []
+
+        def op():
+            calls.append(1)
+            time.sleep(0.2)
+            return {"ok": True}
+
+        a = utm.get_or_create("PROPOSALS", "q=1", "client", op)
+        b = utm.get_or_create("PROPOSALS", "q=1", "client", op)
+        assert a.task_id == b.task_id
+        assert a.future.result(timeout=5.0) == {"ok": True}
+        assert calls == [1]
+        utm.shutdown()
+
+    def test_lookup_by_task_id(self):
+        utm = UserTaskManager()
+        info = utm.get_or_create("PROPOSALS", "", "c", lambda: 42)
+        same = utm.get_or_create("PROPOSALS", "other", "c2", lambda: 0,
+                                 task_id=info.task_id)
+        assert same.task_id == info.task_id
+        with pytest.raises(KeyError):
+            utm.get_or_create("PROPOSALS", "", "c", lambda: 0,
+                              task_id="nope")
+        utm.shutdown()
+
+
+def make_app(**kwargs):
+    sim, cc, clock = make_stack(num_brokers=4, skewed=True)
+    cc.start_up(do_sampling=False, start_detection=False)
+    feed_samples(cc, clock)
+    app = CruiseControlApp(cc, async_response_timeout_s=30.0, **kwargs)
+    return sim, cc, app
+
+
+class TestDispatch:
+    def test_state_endpoint(self):
+        sim, cc, app = make_app()
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/state")
+        assert status == 200
+        assert body["MonitorState"]["numValidWindows"] > 0
+        cc.shutdown()
+
+    def test_kafka_cluster_state(self):
+        sim, cc, app = make_app()
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/kafka_cluster_state")
+        assert status == 200
+        counts = body["KafkaBrokerState"]["ReplicaCountByBrokerId"]
+        assert sum(counts.values()) == 24    # 12 partitions × rf 2
+        cc.shutdown()
+
+    def test_load_and_partition_load(self):
+        sim, cc, app = make_app()
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/load")
+        assert status == 200 and len(body["brokers"]) == 4
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/partition_load",
+            "resource=nw_in&entries=5")
+        assert status == 200 and len(body["records"]) == 5
+        cc.shutdown()
+
+    @staticmethod
+    def _poll(app, method, path, query="", deadline_s=600.0):
+        """Async client behavior: re-request with the User-Task-ID header
+        until the operation completes."""
+        headers = {}
+        end = time.time() + deadline_s
+        while True:
+            status, hdrs, body = app.handle_request(method, path, query,
+                                                    headers)
+            if status != 202:
+                return status, hdrs, body
+            assert USER_TASK_ID_HEADER in hdrs
+            headers = {USER_TASK_ID_HEADER: hdrs[USER_TASK_ID_HEADER]}
+            assert time.time() < end, "operation never completed"
+            time.sleep(0.2)
+
+    def test_proposals_and_rebalance_roundtrip(self):
+        sim, cc, app = make_app()
+        status, hdrs, body = self._poll(
+            app, "GET", "/kafkacruisecontrol/proposals", "verbose=true")
+        assert status == 200, body
+        assert body["summary"]["numProposals"] > 0
+        status, _, body = self._poll(
+            app, "POST", "/kafkacruisecontrol/rebalance", "dryrun=false")
+        assert status == 200, body
+        assert body["dryRun"] is False and body.get("executionId")
+        cc.executor.await_completion(timeout=60.0)
+        counts = {}
+        for p in sim.describe_cluster().partitions:
+            for r in p.replicas:
+                counts[r] = counts.get(r, 0) + 1
+        assert len(counts) == 4
+        cc.shutdown()
+
+    def test_unknown_endpoint_and_params(self):
+        sim, cc, app = make_app()
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/nonsense")
+        assert status == 404
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/state", "bogus=1")
+        assert status == 400 and "bogus" in body["errorMessage"]
+        # GET on a POST endpoint
+        status, _, _ = app.handle_request(
+            "GET", "/kafkacruisecontrol/rebalance")
+        assert status == 405
+        cc.shutdown()
+
+    def test_admin_self_healing_toggle(self):
+        sim, cc, app = make_app()
+        status, _, body = app.handle_request(
+            "POST", "/kafkacruisecontrol/admin",
+            "enable_self_healing_for=broker_failure")
+        assert status == 200
+        assert body["selfHealing"]["BROKER_FAILURE"]["after"] is True
+        cc.shutdown()
+
+    def test_topic_configuration_rf_change(self):
+        sim, cc, app = make_app()
+        status, _, body = app.handle_request(
+            "POST", "/kafkacruisecontrol/topic_configuration",
+            "topic=t0&replication_factor=3&dryrun=false&verbose=true")
+        assert status == 200, body
+        cc.executor.await_completion(timeout=120.0)
+        snap = sim.describe_cluster()
+        for p in snap.partitions_of("t0"):
+            assert len(p.replicas) == 3
+            racks = {sim._brokers[b].rack for b in p.replicas}
+            assert len(racks) == 2   # both racks covered
+        cc.shutdown()
+
+    def test_two_step_verification_flow(self):
+        sim, cc, app = make_app(two_step_verification=True)
+        # POST without review id parks in purgatory
+        status, _, body = app.handle_request(
+            "POST", "/kafkacruisecontrol/rebalance", "dryrun=true")
+        assert status == 202 and "reviewResult" in body
+        rid = body["reviewResult"]["Id"]
+        # approve then re-submit with the review id
+        status, _, body = app.handle_request(
+            "POST", "/kafkacruisecontrol/review", f"approve={rid}")
+        assert status == 200
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/review_board")
+        assert status == 200 and body["requestInfo"][0]["Status"] \
+            == "APPROVED"
+        status, _, body = self._poll(
+            app, "POST", "/kafkacruisecontrol/rebalance",
+            f"dryrun=true&review_id={rid}")
+        assert status == 200 and body["summary"]["numProposals"] > 0
+        cc.shutdown()
+
+    def test_two_step_gates_sync_posts_and_binds_params(self):
+        sim, cc, app = make_app(two_step_verification=True)
+        # sync mutating POST parks too
+        status, _, body = app.handle_request(
+            "POST", "/kafkacruisecontrol/pause_sampling", "reason=x")
+        assert status == 202 and "reviewResult" in body
+        rid = body["reviewResult"]["Id"]
+        app.handle_request("POST", "/kafkacruisecontrol/review",
+                           f"approve={rid}")
+        # approval is bound to the reviewed parameters
+        status, _, body = app.handle_request(
+            "POST", "/kafkacruisecontrol/pause_sampling",
+            f"reason=other&review_id={rid}")
+        assert status == 400
+        status, _, body = app.handle_request(
+            "POST", "/kafkacruisecontrol/pause_sampling",
+            f"reason=x&review_id={rid}")
+        assert status == 200
+        cc.shutdown()
+
+    def test_security_enforced_in_dispatch(self):
+        import base64
+        provider = BasicSecurityProvider({"v": ("pw", Role.VIEWER)})
+        sim, cc, app = make_app(security=provider)
+        tok = base64.b64encode(b"v:pw").decode()
+        hdrs = {"Authorization": f"Basic {tok}"}
+        status, _, _ = app.handle_request(
+            "GET", "/kafkacruisecontrol/state", headers=hdrs)
+        assert status == 200
+        status, _, _ = app.handle_request(
+            "POST", "/kafkacruisecontrol/rebalance", headers=hdrs)
+        assert status == 403
+        status, _, _ = app.handle_request(
+            "GET", "/kafkacruisecontrol/state")
+        assert status == 401
+        cc.shutdown()
+
+    def test_http_transport_roundtrip(self):
+        sim, cc, app = make_app()
+        port = app.start(port=0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/kafkacruisecontrol/state",
+                    timeout=30) as resp:
+                assert resp.status == 200
+                body = json.loads(resp.read())
+                assert "MonitorState" in body
+        finally:
+            app.stop()
+            cc.shutdown()
+
+
+class TestSensors:
+    def test_sensors_substate_exports_registry(self):
+        sim, cc, app = make_app()
+        self_poll = TestDispatch._poll
+        self_poll(app, "GET", "/kafkacruisecontrol/proposals")
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/state", "substates=sensors")
+        assert status == 200
+        sensors = body["Sensors"]
+        assert sensors["proposal-computation-timer"]["count"] >= 1
+        assert sensors["cluster-model-creation-timer"]["count"] >= 1
+        assert sensors["PROPOSALS-request-rate"]["count"] >= 1
+        assert "balancedness-score" in sensors
+        cc.shutdown()

@@ -1,0 +1,98 @@
+"""Python client + CLI tests against a live in-process REST server.
+
+Models the reference's python-client tests (cruise-control-client/tests):
+endpoint wrappers, async long-polling, error surfacing, and the cccli
+argument surface.
+"""
+import json
+
+import conftest  # noqa: F401
+import pytest
+
+from cruise_control_tpu.client.cli import build_parser, main as cli_main
+from cruise_control_tpu.client.client import (CruiseControlClient,
+                                              CruiseControlClientError)
+
+from test_facade import feed_samples, make_stack
+from cruise_control_tpu.api.server import CruiseControlApp
+
+
+@pytest.fixture(scope="module")
+def live_server():
+    sim, cc, clock = make_stack(num_brokers=4, skewed=True)
+    cc.start_up(do_sampling=False, start_detection=False)
+    feed_samples(cc, clock)
+    app = CruiseControlApp(cc, async_response_timeout_s=5.0)
+    port = app.start(port=0)
+    yield sim, cc, f"http://127.0.0.1:{port}/kafkacruisecontrol"
+    app.stop()
+    cc.shutdown()
+
+
+class TestClient:
+    def test_state_and_load(self, live_server):
+        _, _, url = live_server
+        client = CruiseControlClient(url)
+        st = client.state()
+        assert st["MonitorState"]["numValidWindows"] > 0
+        load = client.load()
+        assert len(load["brokers"]) == 4
+
+    def test_proposals_long_poll(self, live_server):
+        _, _, url = live_server
+        client = CruiseControlClient(url, poll_interval_s=0.5,
+                                     timeout_s=600.0)
+        out = client.proposals(verbose=True)
+        assert out["summary"]["numProposals"] > 0
+        assert "proposals" in out
+
+    def test_dryrun_rebalance(self, live_server):
+        _, _, url = live_server
+        client = CruiseControlClient(url, poll_interval_s=0.5,
+                                     timeout_s=600.0)
+        out = client.rebalance(dryrun=True)
+        assert out["dryRun"] is True
+
+    def test_error_surfacing(self, live_server):
+        _, _, url = live_server
+        client = CruiseControlClient(url)
+        with pytest.raises(CruiseControlClientError) as err:
+            client.remove_broker([])     # missing brokerid
+        assert err.value.status == 400
+        with pytest.raises(ValueError):
+            client.request("STATE", {"bogus": 1})
+
+    def test_user_tasks_listed(self, live_server):
+        _, _, url = live_server
+        client = CruiseControlClient(url)
+        client.state()
+        tasks = client.user_tasks()
+        assert "userTasks" in tasks
+
+
+class TestCli:
+    def test_parser_covers_endpoints(self):
+        parser = build_parser()
+        for argv in (["state"], ["load"], ["proposals", "--verbose"],
+                     ["rebalance", "--execute"],
+                     ["add_broker", "1,2"], ["remove_broker", "3"],
+                     ["demote_broker", "0"],
+                     ["topic_configuration", "t", "3"],
+                     ["stop_execution", "--force"],
+                     ["admin", "--enable-self-healing-for",
+                      "broker_failure"],
+                     ["review", "--approve", "1,2"]):
+            args = parser.parse_args(argv)
+            assert args.command == argv[0]
+
+    def test_cli_end_to_end(self, live_server, capsys):
+        _, _, url = live_server
+        rc = cli_main(["-a", url, "state"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert "MonitorState" in out
+
+    def test_cli_error_exit_code(self, live_server, capsys):
+        _, _, url = live_server
+        rc = cli_main(["-a", url, "remove_broker", ""])
+        assert rc == 1

@@ -1,0 +1,187 @@
+"""Facade tests: the wired stack end to end.
+
+Models the reference's service-level tests (KafkaCruiseControl facade usage
+in AnomalyDetectorTest/ExecutorTest) against the simulated cluster: model
+building through the monitor, cached proposals, rebalance with execution,
+add/remove/demote broker flows, and detector wiring.
+"""
+import conftest  # noqa: F401
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.cluster.simulated import SimulatedCluster
+from cruise_control_tpu.cluster.types import TopicPartition
+from cruise_control_tpu.core.anomaly import AnomalyType
+from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+from cruise_control_tpu.facade import (CruiseControl, OngoingExecutionError,
+                                       OperationResult)
+from cruise_control_tpu.monitor.sampling.sampler import (
+    SimulatedClusterSampler)
+
+
+def make_stack(num_brokers=4, partitions=12, rf=2, skewed=True,
+               notifier=None, assignment_pool=None):
+    """assignment_pool limits which brokers initially host replicas (e.g.
+    a freshly added broker starts empty)."""
+    sim = SimulatedCluster()
+    clock = {"now": 10_000.0}
+    for b in range(num_brokers):
+        sim.add_broker(b, rack=f"rack{b % 2}")
+    pool = list(assignment_pool) if assignment_pool is not None \
+        else list(range(num_brokers))
+    assignments = []
+    for p in range(partitions):
+        if skewed:
+            replicas = [pool[i % 2] for i in range(rf)]  # all on two brokers
+        else:
+            replicas = [pool[(p + i) % len(pool)] for i in range(rf)]
+        assignments.append(replicas)
+    sim.create_topic("t0", assignments, size_bytes=1e4)
+    for p in range(partitions):
+        sim.set_partition_load(TopicPartition("t0", p), leader_cpu=2.0,
+                               nw_in=100.0, nw_out=300.0)
+
+    cc = CruiseControl(
+        sim, SimulatedClusterSampler(sim),
+        anomaly_notifier=notifier,
+        time_fn=lambda: clock["now"],
+        sleep_fn=lambda s: (sim.advance(s),
+                            clock.__setitem__("now", clock["now"] + s)),
+        monitor_kwargs=dict(num_windows=3, window_ms=10_000,
+                            min_samples_per_window=1,
+                            sampling_interval_ms=5_000),
+        executor_kwargs=dict(progress_check_interval_s=1.0))
+    return sim, cc, clock
+
+
+def feed_samples(cc, clock, rounds=8):
+    for _ in range(rounds):
+        cc.load_monitor.task_runner.sample_once()
+        clock["now"] += 10.0
+
+
+class TestFacade:
+    def test_cluster_model_and_cached_proposals(self):
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        state, topo = cc.cluster_model()
+        assert state.num_brokers == 4
+        r1 = cc.optimizations()
+        r2 = cc.optimizations()          # same generation: cache hit
+        assert r1 is r2
+        feed_samples(cc, clock, rounds=1)  # new samples -> new generation
+        r3 = cc.optimizations()
+        assert r3 is not r1
+        cc.shutdown()
+
+    def test_rebalance_executes_and_balances(self):
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        result = cc.rebalance(dryrun=False, wait=True)
+        assert not result.dryrun and result.optimizer_result.proposals
+        counts = {b: 0 for b in range(4)}
+        for p in sim.describe_cluster().partitions:
+            for r in p.replicas:
+                counts[r] += 1
+        assert all(v > 0 for v in counts.values())
+        cc.shutdown()
+
+    def test_dryrun_does_not_touch_cluster(self):
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        gen_before = sim.describe_cluster().generation
+        result = cc.rebalance(dryrun=True)
+        assert result.dryrun and result.optimizer_result.proposals
+        assert sim.describe_cluster().generation == gen_before
+        cc.shutdown()
+
+    def test_add_brokers_moves_only_onto_new(self):
+        # broker 4 just joined: it hosts nothing yet
+        sim, cc, clock = make_stack(num_brokers=5, skewed=False,
+                                    assignment_pool=[0, 1, 2, 3])
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        # broker 4 treated as new: no old->old movement allowed
+        result = cc.add_brokers([4], dryrun=True)
+        assert result.optimizer_result.proposals
+        for prop in result.optimizer_result.proposals:
+            added = set(prop.replicas_to_add)
+            assert added <= {4}, f"old->old move in {prop}"
+        cc.shutdown()
+
+    def test_remove_brokers_drains_target(self):
+        sim, cc, clock = make_stack(num_brokers=4, skewed=False)
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        result = cc.remove_brokers([0], dryrun=False, wait=True)
+        assert result.execution_uuid is not None
+        snap = sim.describe_cluster()
+        on_removed = [p for p in snap.partitions if 0 in p.replicas]
+        assert not on_removed
+        assert cc.executor.recently_removed_brokers() == {0}
+        cc.shutdown()
+
+    def test_demote_brokers_sheds_leadership(self):
+        sim, cc, clock = make_stack(num_brokers=4, skewed=False)
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        result = cc.demote_brokers([0], dryrun=False, wait=True)
+        snap = sim.describe_cluster()
+        leaders = {p.leader for p in snap.partitions}
+        assert 0 not in leaders
+        # demotion only moves leadership, never replicas
+        for prop in result.optimizer_result.proposals:
+            assert not prop.replicas_to_add
+        cc.shutdown()
+
+    def test_ongoing_execution_rejected(self):
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        # make the move huge & slow so the first execution stays in flight
+        for p in range(12):
+            sim.set_partition_load(TopicPartition("t0", p),
+                                   leader_cpu=2.0, nw_in=100.0,
+                                   nw_out=300.0, size_bytes=1e4)
+        sim._move_rate = 1.0
+        cc.rebalance(dryrun=False, wait=False)
+        with pytest.raises(OngoingExecutionError):
+            cc.rebalance(dryrun=False)
+        cc.stop_execution(force=True)
+        assert cc.executor.await_completion(timeout=30.0)
+        cc.shutdown()
+
+    def test_state_aggregation(self):
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        st = cc.state()
+        assert {"MonitorState", "ExecutorState", "AnalyzerState",
+                "AnomalyDetectorState"} <= set(st)
+        assert st["MonitorState"]["numValidWindows"] > 0
+        assert st["ExecutorState"]["state"] == "NO_TASK_IN_PROGRESS"
+        cc.shutdown()
+
+    def test_self_healing_broker_failure_via_facade(self):
+        sim2, cc, clock = make_stack(num_brokers=4, skewed=False)
+        # swap in a notifier with zero grace periods on the shared clock
+        cc.anomaly_detector._notifier = SelfHealingNotifier(
+            self_healing_enabled={AnomalyType.BROKER_FAILURE: True},
+            broker_failure_alert_threshold_ms=0.0,
+            broker_failure_auto_fix_threshold_ms=0.0,
+            time_fn=lambda: clock["now"])
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        cc.optimizations()
+        sim2.kill_broker(3)
+        clock["now"] += 1.0
+        statuses = cc.anomaly_detector.process_all()
+        assert any(s.name == "FIX_STARTED" for s in statuses), statuses
+        cc.executor.await_completion(timeout=60.0)
+        snap = sim2.describe_cluster()
+        assert not [p for p in snap.partitions if 3 in p.replicas]
+        cc.shutdown()

@@ -1,0 +1,107 @@
+"""Intra-broker (JBOD) goal tests.
+
+Models the reference's IntraBrokerRebalanceTest.java (151 LoC): replicas
+move between a broker's logdirs to satisfy per-disk capacity and to balance
+disk usage, never leaving the broker.
+"""
+import conftest  # noqa: F401
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions,
+                                                 make_context,
+                                                 make_round_cache)
+from cruise_control_tpu.analyzer.goals.intra_broker import (
+    IntraBrokerDiskCapacityGoal, IntraBrokerDiskUsageDistributionGoal)
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.builder import ClusterModelBuilder
+
+CAPACITY = {Resource.CPU: 100.0, Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6,
+            Resource.DISK: 10_000.0}
+
+
+def jbod_skewed(disk_caps=(1000.0, 1000.0), sizes=(400.0, 300.0, 200.0)):
+    """One broker with two logdirs; everything piled on /d0."""
+    b = ClusterModelBuilder()
+    disks = {f"/d{i}": c for i, c in enumerate(disk_caps)}
+    b.add_broker(0, "A", CAPACITY, disks=disks)
+    b.add_broker(1, "B", CAPACITY, disks=disks)
+    for p, size in enumerate(sizes):
+        load = {Resource.CPU: 1.0, Resource.NW_IN: 10.0,
+                Resource.NW_OUT: 10.0, Resource.DISK: size}
+        b.add_replica("T", p, 0, True, load, logdir="/d0")
+        follower = dict(load)
+        follower[Resource.NW_OUT] = 0.0
+        b.add_replica("T", p, 1, False, follower, logdir="/d0")
+    return b.build()
+
+
+def _ctx(state, topo):
+    return make_context(state, BalancingConstraint(), OptimizationOptions(),
+                        topo)
+
+
+class TestIntraBrokerCapacity:
+    def test_overfull_disk_sheds_to_sibling(self):
+        state, topo = jbod_skewed(sizes=(400.0, 300.0, 200.0))
+        # /d0 on each broker holds 900 > 0.8 * 1000
+        goal = IntraBrokerDiskCapacityGoal(capacity_threshold=0.8)
+        ctx = _ctx(state, topo)
+        cache = make_round_cache(state)
+        assert np.asarray(goal.violated_brokers(state, ctx, cache)).all()
+        out = goal.optimize(state, ctx, ())
+        cache2 = make_round_cache(out)
+        assert not np.asarray(goal.violated_brokers(out, ctx, cache2)).any()
+        # brokers unchanged: intra-broker only
+        assert (np.asarray(out.replica_broker)
+                == np.asarray(state.replica_broker)).all()
+        dload = np.asarray(S.disk_load(out))
+        assert (dload <= 800.0 + 1e-3).all()
+
+    def test_respects_dead_disk(self):
+        state, topo = jbod_skewed()
+        # kill /d1 everywhere: nothing can move, goal stays violated
+        for d in range(state.num_disks):
+            if topo.disk_names[d][1] == "/d1":
+                state = S.mark_disk_dead(state, d)
+        goal = IntraBrokerDiskCapacityGoal(capacity_threshold=0.8)
+        ctx = _ctx(state, topo)
+        out = goal.optimize(state, ctx, ())
+        disk_of = np.asarray(out.replica_disk)
+        alive = np.asarray(out.disk_alive)
+        valid = np.asarray(out.replica_valid) & (disk_of >= 0)
+        # no replica may land on a dead disk
+        assert alive[disk_of[valid]].all() or not valid.any()
+
+
+class TestIntraBrokerDistribution:
+    def test_balances_between_logdirs(self):
+        state, topo = jbod_skewed(sizes=(300.0, 280.0, 260.0, 240.0))
+        goal = IntraBrokerDiskUsageDistributionGoal(balance_margin=0.2)
+        ctx = _ctx(state, topo)
+        dload0 = np.asarray(S.disk_load(state))
+        out = goal.optimize(state, ctx, ())
+        dload1 = np.asarray(S.disk_load(out))
+        # spread improved on each broker (both started one-sided)
+        d0 = dload1.reshape(2, 2)
+        assert (abs(d0[:, 0] - d0[:, 1])
+                < abs(dload0.reshape(2, 2)[:, 0]
+                      - dload0.reshape(2, 2)[:, 1])).all()
+        assert (np.asarray(out.replica_broker)
+                == np.asarray(state.replica_broker)).all()
+
+    def test_proposals_carry_logdir_moves(self):
+        from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+        state, topo = jbod_skewed(sizes=(400.0, 300.0, 200.0))
+        opt = GoalOptimizer([IntraBrokerDiskCapacityGoal(
+            capacity_threshold=0.8)])
+        result = opt.optimizations(state, topo)
+        assert result.proposals
+        intra = [p for p in result.proposals
+                 if not p.has_replica_action
+                 and any(o.logdir != n.logdir
+                         for o, n in zip(p.old_replicas, p.new_replicas))]
+        assert intra, "expected logdir-only proposals"
