@@ -21,7 +21,6 @@ from cruise_control_tpu.agent.metrics import (AgentMetric, MetricScope,
                                               RawMetricType, deserialize)
 from cruise_control_tpu.agent.transport import MetricsTransport
 from cruise_control_tpu.cluster.types import ClusterSnapshot, TopicPartition
-from cruise_control_tpu.model.builder import estimate_follower_cpu
 from cruise_control_tpu.monitor import metricdef as MD
 from cruise_control_tpu.monitor.sampling.holder import (
     BrokerMetricSample, PartitionMetricSample, complete_broker_values,

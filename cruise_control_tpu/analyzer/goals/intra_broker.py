@@ -151,16 +151,26 @@ class IntraBrokerDiskUsageDistributionGoal(Goal):
 
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
+        # shedding is driven by distance ABOVE the broker's average fill
+        # (not above the upper bound): an under-filled logdir is healed by
+        # the most-loaded sibling shedding toward it, since the move round
+        # always targets the broker's least-loaded logdir
+
+        def _target(st):
+            dload, upper, lower = self._bounds(st)
+            target = (upper + lower) / 2.0
+            return dload, target, upper, lower
 
         def round_body(st):
-            dload, upper, lower = self._bounds(st)
-            return _disk_move_round(st, ctx, dload - upper, upper)
+            dload, target, upper, _lower = _target(st)
+            return _disk_move_round(st, ctx, dload - target, upper)
 
         def cond(carry):
             st, rounds, progressed = carry
-            dload, upper, _ = self._bounds(st)
-            return (progressed & (rounds < self.max_rounds)
-                    & jnp.any(st.disk_alive & (dload > upper)))
+            dload, _target_v, upper, lower = _target(st)
+            unbalanced = jnp.any(st.disk_alive
+                                 & ((dload > upper) | (dload < lower)))
+            return progressed & (rounds < self.max_rounds) & unbalanced
 
         def body(carry):
             st, rounds, _ = carry
@@ -173,8 +183,8 @@ class IntraBrokerDiskUsageDistributionGoal(Goal):
         return state
 
     def violated_brokers(self, state, ctx, cache):
-        dload, upper, _lower = self._bounds(state)
-        bad = state.disk_alive & (dload > upper)
+        dload, upper, lower = self._bounds(state)
+        bad = state.disk_alive & ((dload > upper) | (dload < lower))
         return (jax.ops.segment_sum(
             bad.astype(jnp.int32), state.disk_broker,
             num_segments=state.num_brokers) > 0) & state.broker_alive

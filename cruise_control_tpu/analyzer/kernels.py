@@ -361,7 +361,7 @@ def forced_move_round(state: ClusterState,
                                                  jax.Array],
                       dest_pref: jax.Array,
                       partition_replicas: jax.Array,
-                      max_candidates: int = 1024,
+                      max_candidates: int = 4096,
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One round of *global* forced-move search (self-healing).
 

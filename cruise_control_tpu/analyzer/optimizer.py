@@ -135,57 +135,118 @@ class GoalOptimizer:
 
     def __init__(self, goals: Sequence[Goal],
                  constraint: Optional[BalancingConstraint] = None,
-                 jit_goals: bool = True):
+                 jit_goals: bool = True,
+                 pipeline_segment_size: int = 4):
         self.goals = list(goals)
         self.constraint = constraint or BalancingConstraint()
         self._jit_goals = jit_goals
+        #: goals per compiled program (see optimizations docstring)
+        self.pipeline_segment_size = pipeline_segment_size
         self._compiled: Dict[str, object] = {}
+
+    def _pre_fn(self):
+        """(state, ctx) -> (violated_before bool[G], healed state,
+        still_offline)."""
+        goals = tuple(self.goals)
+
+        def run(state: ClusterState, ctx: OptimizationContext):
+            cache0 = make_round_cache(state)
+            violated_before = (
+                jnp.stack([g.violated_brokers(state, ctx, cache0).any()
+                           for g in goals])
+                if goals else jnp.zeros((0,), dtype=bool))
+            needs_heal = S.self_healing_eligible(state).any()
+            state = jax.lax.cond(
+                needs_heal, lambda s: heal_offline_replicas(s, ctx),
+                lambda s: s, state)
+            still_offline = jnp.sum(S.self_healing_eligible(state))
+            return violated_before, state, still_offline
+        return run
+
+    def _segment_fn(self, start: int, stop: int):
+        """(state, ctx) -> (state, stacked per-goal stats) for
+        goals[start:stop], with acceptance stacking over ALL prior goals."""
+        goals = tuple(self.goals)
+
+        def run(state: ClusterState, ctx: OptimizationContext):
+            per_goal_stats = []
+            for i in range(start, stop):
+                state = goals[i].optimize(state, ctx, goals[:i])
+                per_goal_stats.append(compute_stats(state))
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                   *per_goal_stats)
+            return state, stacked
+        return run
+
+    def _post_fn(self):
+        """(state, ctx) -> violated_after bool[G]."""
+        goals = tuple(self.goals)
+
+        def run(state: ClusterState, ctx: OptimizationContext):
+            cache1 = make_round_cache(state)
+            return (jnp.stack([g.violated_brokers(state, ctx, cache1).any()
+                               for g in goals])
+                    if goals else jnp.zeros((0,), dtype=bool))
+        return run
 
     def optimizations(self, state: ClusterState, topology,
                       options: Optional[OptimizationOptions] = None,
                       check_sanity: bool = True) -> OptimizerResult:
         """Run all goals in priority order and diff out proposals
-        (reference GoalOptimizer.optimizations :409-480)."""
+        (reference GoalOptimizer.optimizations :409-480).
+
+        The pipeline runs as a handful of jitted segments (violation sweep +
+        self-healing, then `pipeline_segment_size` goals per program, then
+        the final sweep): everything stays on device — eager per-goal checks
+        cost seconds over a remote-device transport where every small op is
+        an RPC — while keeping each XLA program small enough to compile at
+        2K+-broker scale (one program holding every goal overwhelms the
+        compiler)."""
         t_start = time.time()
         options = options or OptimizationOptions()
         ctx = make_context(state, self.constraint, options, topology)
         initial = state
-        stats_before = jax.device_get(compute_stats(state))
+        stats_fn = self._get_compiled("__stats__", compute_stats)
+        stats_before = jax.device_get(stats_fn(state))
 
-        cache0 = make_round_cache(state)
-        violated_before = [g.name for g in self.goals
-                           if bool(np.asarray(
-                               g.violated_brokers(state, ctx, cache0)).any())]
+        t0 = time.time()
+        pre = self._get_compiled("__pre__", self._pre_fn())
+        vb_dev, state, still_dev = pre(state, ctx)
+        seg = max(1, self.pipeline_segment_size)
+        stacked_parts = []
+        for start in range(0, len(self.goals), seg):
+            stop = min(start + seg, len(self.goals))
+            fn = self._get_compiled(f"__seg_{start}_{stop}__",
+                                    self._segment_fn(start, stop))
+            state, stacked_seg = fn(state, ctx)
+            stacked_parts.append(stacked_seg)
+        post = self._get_compiled("__post__", self._post_fn())
+        va_dev = post(state, ctx)
+        jax.block_until_ready(state.replica_broker)
+        LOG.debug("goal pipeline (%d segments) ran in %.0fms",
+                  (len(self.goals) + seg - 1) // seg,
+                  (time.time() - t0) * 1e3)
+        stacked_h, vb_h, va_h, still_offline = jax.device_get(
+            (stacked_parts, vb_dev, va_dev, still_dev))
+        stacked_h = (jax.tree.map(
+            lambda *xs: np.concatenate(xs), *stacked_h)
+            if stacked_h else None)
 
-        if bool(np.asarray(S.self_healing_eligible(state)).any()):
-            heal = self._get_compiled("__heal__",
-                                      lambda s, c: heal_offline_replicas(s, c))
-            state = heal(state, ctx)
-            still_offline = int(np.asarray(
-                S.self_healing_eligible(state)).sum())
-            if still_offline:
-                raise OptimizationFailure(
-                    f"self-healing could not relocate {still_offline} "
-                    f"offline replicas (insufficient capacity or "
-                    f"eligible brokers)")
+        if int(still_offline):
+            raise OptimizationFailure(
+                f"self-healing could not relocate {int(still_offline)} "
+                f"offline replicas (insufficient capacity or "
+                f"eligible brokers)")
+
+        violated_before = [g.name for g, v in zip(self.goals, vb_h) if v]
+        violated_after = [g.name for g, v in zip(self.goals, va_h) if v]
 
         stats_by_goal: Dict[str, ClusterModelStats] = {}
         regressed: List[str] = []
         prev_stats = stats_before
         for i, goal in enumerate(self.goals):
-            prev_goals = tuple(self.goals[:i])
-            # key by position too: duplicate goal instances must not share a
-            # compiled closure (each closes over its own prev_goals/config)
-            fn = self._get_compiled(
-                f"{i}:{goal.name}",
-                lambda s, c, g=goal, pg=prev_goals: g.optimize(s, c, pg))
-            t0 = time.time()
-            state = fn(state, ctx)
-            jax.block_until_ready(state.replica_broker)
-            goal_stats = jax.device_get(compute_stats(state))
+            goal_stats = jax.tree.map(lambda x, i=i: x[i], stacked_h)
             stats_by_goal[goal.name] = goal_stats
-            LOG.debug("Finished optimization for %s in %.0fms", goal.name,
-                      (time.time() - t0) * 1e3)
             if not goal.stats_not_worse(prev_stats, goal_stats):
                 # reference AbstractGoal.optimize :92-101 treats a regressed
                 # comparator as failure unless self-healing
@@ -193,10 +254,6 @@ class GoalOptimizer:
                 LOG.warning("goal %s regressed its statistic", goal.name)
             prev_stats = goal_stats
 
-        cache1 = make_round_cache(state)
-        violated_after = [g.name for g in self.goals
-                          if bool(np.asarray(
-                              g.violated_brokers(state, ctx, cache1)).any())]
         for goal in self.goals:
             if goal.is_hard and goal.name in violated_after:
                 raise OptimizationFailure(
@@ -207,7 +264,8 @@ class GoalOptimizer:
 
         partition_rows = np.asarray(ctx.partition_replicas)
         proposals = diff_proposals(initial, state, topology, partition_rows)
-        stats_after = jax.device_get(compute_stats(state))
+        stats_after = (stats_by_goal[self.goals[-1].name] if self.goals
+                       else jax.device_get(stats_fn(state)))
         result = OptimizerResult(
             proposals=proposals,
             stats_before=stats_before,

@@ -75,27 +75,21 @@ class ExecutionProposal:
         }
 
 
-def _ordered_replicas(state_np: dict, topology: ClusterTopology,
-                      partition_rows: np.ndarray, p: int
-                      ) -> Tuple[int, List[ReplicaPlacement]]:
-    """Replica list of partition p with the leader first."""
-    rows = partition_rows[p]
-    rows = rows[rows >= 0]
-    brokers = state_np["replica_broker"][rows]
-    leaders = state_np["replica_is_leader"][rows]
-    disks = state_np["replica_disk"][rows]
-    order = np.argsort(~leaders, kind="stable")  # leader(s) first
-    placements = []
-    for i in order:
-        logdir = None
-        if disks[i] >= 0:
-            logdir = topology.disk_names[disks[i]][1]
-        placements.append(
-            ReplicaPlacement(topology.broker_ids[brokers[i]], logdir))
-    leader_rows = rows[leaders]
-    leader = (topology.broker_ids[state_np["replica_broker"][leader_rows[0]]]
-              if len(leader_rows) else -1)
-    return leader, placements
+def _ordered_placements(brokers: np.ndarray, leaders: np.ndarray,
+                        disks: np.ndarray, row_valid: np.ndarray,
+                        topology: ClusterTopology):
+    """[M, RF] arrays -> per-row leader-first reordering.
+
+    Returns (brokers, leaders, disks, validity), each [M, RF] reordered so
+    leaders come first and invalid slots last (stable within groups)."""
+    # sort key: invalid rows last, then leaders first; stable to preserve
+    # the original replica order among followers
+    key = np.where(~row_valid, 2, np.where(leaders, 0, 1))
+    order = np.argsort(key, axis=1, kind="stable")
+    return (np.take_along_axis(brokers, order, axis=1),
+            np.take_along_axis(leaders, order, axis=1),
+            np.take_along_axis(disks, order, axis=1),
+            np.take_along_axis(row_valid, order, axis=1))
 
 
 def diff_proposals(initial: ClusterState, optimized: ClusterState,
@@ -103,8 +97,9 @@ def diff_proposals(initial: ClusterState, optimized: ClusterState,
                    partition_rows: np.ndarray) -> List[ExecutionProposal]:
     """Diff two states sharing replica/partition indexing into proposals.
 
-    Vectorized pre-filter: only partitions whose replica brokers or leader
-    flags changed produce a proposal (AnalyzerUtils.getDiff semantics).
+    Fully vectorized except for the final proposal-object construction:
+    only partitions whose replica brokers or leader flags changed produce a
+    proposal (AnalyzerUtils.getDiff semantics).
     """
     init = {k: np.asarray(getattr(initial, k)) for k in
             ("replica_broker", "replica_is_leader", "replica_disk")}
@@ -120,21 +115,49 @@ def diff_proposals(initial: ClusterState, optimized: ClusterState,
     part = np.asarray(initial.replica_partition)
     changed_p = np.unique(part[changed_r])
 
-    # partition DISK size: leader replica's disk load
+    rows_mat = partition_rows[changed_p]                # [M, RF]
+    row_valid = rows_mat >= 0
+    rows_safe = np.maximum(rows_mat, 0)
+
+    def gather(table):
+        out = table[rows_safe]
+        return out
+
+    old_b, old_l, old_d, ordv = _ordered_placements(
+        gather(init["replica_broker"]), gather(init["replica_is_leader"]),
+        gather(init["replica_disk"]), row_valid, topology)
+    new_b, _new_l, new_d, _ = _ordered_placements(
+        gather(opt["replica_broker"]), gather(opt["replica_is_leader"]),
+        gather(opt["replica_disk"]), row_valid, topology)
+
     base = np.asarray(initial.replica_base_load)
+    sizes = np.where(row_valid, base[rows_safe, Resource.DISK], 0.0) \
+        .max(axis=1)
+    broker_ids = np.asarray(topology.broker_ids)
+    old_bid = broker_ids[old_b]
+    new_bid = broker_ids[new_b]
+    # leader broker id (first ordered slot is a leader when one exists)
+    old_leader = np.where(old_l[:, 0], old_bid[:, 0], -1)
+
+    disk_names = topology.disk_names
     proposals = []
-    for p in changed_p:
-        old_leader, old_reps = _ordered_replicas(init, topology,
-                                                 partition_rows, int(p))
-        _, new_reps = _ordered_replicas(opt, topology, partition_rows, int(p))
-        rows = partition_rows[p]
-        rows = rows[rows >= 0]
-        size = float(base[rows, Resource.DISK].max()) if len(rows) else 0.0
+    for m, p in enumerate(changed_p):
+        n = int(row_valid[m].sum())
+        olds = tuple(
+            ReplicaPlacement(int(old_bid[m, i]),
+                             disk_names[old_d[m, i]][1]
+                             if old_d[m, i] >= 0 else None)
+            for i in range(n))
+        news = tuple(
+            ReplicaPlacement(int(new_bid[m, i]),
+                             disk_names[new_d[m, i]][1]
+                             if new_d[m, i] >= 0 else None)
+            for i in range(n))
         proposals.append(ExecutionProposal(
             partition=topology.partitions[int(p)],
-            old_leader=old_leader,
-            old_replicas=tuple(old_reps),
-            new_replicas=tuple(new_reps),
-            partition_size=size,
+            old_leader=int(old_leader[m]),
+            old_replicas=olds,
+            new_replicas=news,
+            partition_size=float(sizes[m]),
         ))
     return proposals

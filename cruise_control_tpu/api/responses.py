@@ -88,12 +88,19 @@ def partition_load(state: ClusterState, topology: ClusterTopology,
     order = np.argsort(base[leader_rows, resource])
     if not min_load:
         order = order[::-1]
+    # group follower rows by partition once — a per-partition full-array
+    # scan would make this endpoint O(partitions x replicas)
+    f_rows = np.nonzero(valid & ~leader)[0]
+    f_sorted = f_rows[np.argsort(part_of[f_rows], kind="stable")]
+    f_parts = part_of[f_sorted]
+    starts = np.searchsorted(f_parts, np.arange(
+        int(part_of.max()) + 2 if part_of.size else 1))
     for r in leader_rows[order]:
         p = int(part_of[r])
         pid = topology.partitions[p]
         if pat is not None and not pat.match(pid.topic):
             continue
-        follower_rows = np.nonzero(valid & (part_of == p) & ~leader)[0]
+        follower_rows = f_sorted[starts[p]:starts[p + 1]]
         rows.append({
             "topic": pid.topic,
             "partition": pid.partition,

@@ -78,6 +78,22 @@ class UserTaskManager:
                 info = self._tasks.get(task_id)
                 if info is None:
                     raise KeyError(f"unknown user task {task_id}")
+                # compare PARSED params: clients may re-order or re-encode
+                # the same query between polls
+                import urllib.parse
+                same = (info.endpoint == endpoint
+                        and sorted(urllib.parse.parse_qsl(
+                            info.query, keep_blank_values=True))
+                        == sorted(urllib.parse.parse_qsl(
+                            query, keep_blank_values=True)))
+                if not same:
+                    # a stale/reused header must not attach to a different
+                    # operation (reference UserTaskManager scopes task ids
+                    # to their request)
+                    raise ValueError(
+                        f"user task {task_id} belongs to "
+                        f"{info.endpoint}?{info.query}, not "
+                        f"{endpoint}?{query}")
                 return info
             existing = self._by_request.get(key)
             if existing is not None:

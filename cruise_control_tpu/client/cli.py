@@ -105,7 +105,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     auth = None
     if args.user:
         auth = "Basic " + base64.b64encode(args.user.encode()).decode()
-    client = CruiseControlClient(args.address, auth_header=auth)
+    client = CruiseControlClient(args.address, auth_header=auth,
+                                 wait_default=not args.no_wait)
 
     cmd = args.command
     try:

@@ -32,16 +32,21 @@ class CruiseControlClient:
     def __init__(self, base_url: str,
                  auth_header: Optional[str] = None,
                  poll_interval_s: float = 1.0,
-                 timeout_s: float = 600.0) -> None:
+                 timeout_s: float = 600.0,
+                 wait_default: bool = True) -> None:
         self._base = base_url.rstrip("/")
         self._auth = auth_header
         self._poll_s = poll_interval_s
         self._timeout_s = timeout_s
+        #: long-poll async operations to completion unless overridden
+        self._wait_default = wait_default
 
     # ------------------------------------------------------------------
     def request(self, endpoint: str,
                 params: Optional[Mapping[str, object]] = None,
-                wait: bool = True) -> dict:
+                wait: Optional[bool] = None) -> dict:
+        if wait is None:
+            wait = self._wait_default
         endpoint = endpoint.upper()
         legal = VALID_PARAMS.get(endpoint)
         if legal is None:

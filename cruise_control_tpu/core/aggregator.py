@@ -569,8 +569,14 @@ class MetricSampleAggregator:
                              options: AggregationOptions):
         if self._current_window_index is None:
             raise NotEnoughValidWindowsError("no samples added yet")
-        from_w = max(self._window_index(from_ms), self._oldest_window_index)
-        to_w = min(self._window_index(to_ms), self._current_window_index - 1)
+        # ±inf means "everything retained" (callers pass -inf/inf for the
+        # full history; int(inf) would raise)
+        from_w = (self._oldest_window_index if from_ms == -np.inf
+                  else max(self._window_index(from_ms),
+                           self._oldest_window_index))
+        to_w = (self._current_window_index - 1 if to_ms == np.inf
+                else min(self._window_index(to_ms),
+                         self._current_window_index - 1))
         if to_w < from_w:
             raise NotEnoughValidWindowsError(
                 f"no stable window in [{from_ms}, {to_ms}]")

@@ -52,6 +52,9 @@ from cruise_control_tpu.monitor.sampling.sampler import MetricSampler
 from cruise_control_tpu.utils.metrics import MetricRegistry
 
 LOG = logging.getLogger(__name__)
+#: operations audit log (reference `operationLogger`,
+#: CC/executor/Executor.java:76,775): one INFO line per requested mutation
+OPERATION_LOG = logging.getLogger("operationLogger")
 
 
 class OngoingExecutionError(RuntimeError):
@@ -63,19 +66,18 @@ class OngoingExecutionError(RuntimeError):
 class OperationResult:
     """What a POST operation returns: the optimizer result (or, for
     operations that construct proposals directly, just the proposals) plus,
-    when not a dry run, the execution uuid driving it."""
+    when not a dry run, the execution uuid driving it.  `dryrun` records
+    what the CALLER requested — an execute request that found nothing to do
+    has no uuid but is still not a dry run."""
 
     optimizer_result: Optional[OptimizerResult]
     execution_uuid: Optional[str] = None
     proposals: List = dataclasses.field(default_factory=list)
+    dryrun: bool = True
 
     def __post_init__(self) -> None:
         if self.optimizer_result is not None and not self.proposals:
             self.proposals = list(self.optimizer_result.proposals)
-
-    @property
-    def dryrun(self) -> bool:
-        return self.execution_uuid is None
 
 
 class CruiseControl:
@@ -465,11 +467,11 @@ class CruiseControl:
                     new_replicas=tuple(ReplicaPlacement(b)
                                        for b in ordered_new)))
         if dryrun or not proposals:
-            return OperationResult(None, proposals=proposals)
+            return OperationResult(None, proposals=proposals, dryrun=dryrun)
         uuid = self.executor.execute_proposals(proposals, reason=reason,
                                                **execute_kwargs)
         return OperationResult(None, execution_uuid=uuid,
-                               proposals=proposals)
+                               proposals=proposals, dryrun=False)
 
     def stop_execution(self, force: bool = False) -> None:
         self.executor.stop_execution(force=force)
@@ -534,11 +536,17 @@ class CruiseControl:
                        reason: str,
                        strategy: Optional[ReplicaMovementStrategy],
                        **execute_kwargs) -> OperationResult:
+        OPERATION_LOG.info(
+            "%s: %d proposals (%d replica moves, %d leadership moves), "
+            "dryrun=%s", reason, len(result.proposals),
+            result.num_replica_movements, result.num_leadership_movements,
+            dryrun)
         if dryrun or not result.proposals:
-            return OperationResult(result)
+            return OperationResult(result, dryrun=dryrun)
         uuid = self.executor.execute_proposals(
             result.proposals, reason=reason, strategy=strategy,
             **execute_kwargs)
+        OPERATION_LOG.info("%s: execution %s started", reason, uuid)
         with self._cache_lock:    # executing invalidates cached proposals
             self._cached_result = None
-        return OperationResult(result, execution_uuid=uuid)
+        return OperationResult(result, execution_uuid=uuid, dryrun=False)

@@ -310,6 +310,8 @@ class LoadMonitor:
 
         # --- per-partition replica loads (populatePartitionLoad) ---
         n_skipped = 0
+        # one read: per-partition consistency + no per-partition locking
+        coefs = self.cpu_model.coefficients   # None until TRAINed
         for pinfo in snapshot.partitions:
             entity = PartitionEntity(pinfo.tp.topic, pinfo.tp.partition)
             vae = result.entity_values.get(entity)
@@ -326,10 +328,18 @@ class LoadMonitor:
                 else:
                     load = leader_load.copy()
                     load[Resource.NW_OUT] = 0.0
-                    load[Resource.CPU] = estimate_follower_cpu(
-                        leader_load[Resource.CPU],
-                        leader_load[Resource.NW_IN],
-                        leader_load[Resource.NW_OUT])
+                    # trained linear model takes over follower CPU
+                    # attribution once TRAIN has run (reference
+                    # ModelUtils.getFollowerCpuUtilFromLeaderLoad switches
+                    # from static coefficients to the trained regression)
+                    if coefs is not None:
+                        load[Resource.CPU] = coefs.estimate_follower_cpu(
+                            leader_load[Resource.NW_IN])
+                    else:
+                        load[Resource.CPU] = estimate_follower_cpu(
+                            leader_load[Resource.CPU],
+                            leader_load[Resource.NW_IN],
+                            leader_load[Resource.NW_OUT])
                 logdir = pinfo.logdir_by_broker.get(broker_id)
                 has_jbod = (logdir is not None
                             and logdir in jbod_dirs.get(broker_id, ()))

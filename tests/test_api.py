@@ -144,13 +144,18 @@ class TestUserTaskManager:
 
     def test_lookup_by_task_id(self):
         utm = UserTaskManager()
-        info = utm.get_or_create("PROPOSALS", "", "c", lambda: 42)
-        same = utm.get_or_create("PROPOSALS", "other", "c2", lambda: 0,
+        info = utm.get_or_create("PROPOSALS", "q=1", "c", lambda: 42)
+        same = utm.get_or_create("PROPOSALS", "q=1", "c2", lambda: 0,
                                  task_id=info.task_id)
         assert same.task_id == info.task_id
         with pytest.raises(KeyError):
-            utm.get_or_create("PROPOSALS", "", "c", lambda: 0,
+            utm.get_or_create("PROPOSALS", "q=1", "c", lambda: 0,
                               task_id="nope")
+        # a task id is scoped to its request: attaching it to a different
+        # endpoint or query must fail rather than return the wrong result
+        with pytest.raises(ValueError):
+            utm.get_or_create("REBALANCE", "dryrun=false", "c", lambda: 0,
+                              task_id=info.task_id)
         utm.shutdown()
 
 
@@ -182,11 +187,11 @@ class TestDispatch:
 
     def test_load_and_partition_load(self):
         sim, cc, app = make_app()
-        status, _, body = app.handle_request(
-            "GET", "/kafkacruisecontrol/load")
+        status, _, body = self._poll(
+            app, "GET", "/kafkacruisecontrol/load")
         assert status == 200 and len(body["brokers"]) == 4
-        status, _, body = app.handle_request(
-            "GET", "/kafkacruisecontrol/partition_load",
+        status, _, body = self._poll(
+            app, "GET", "/kafkacruisecontrol/partition_load",
             "resource=nw_in&entries=5")
         assert status == 200 and len(body["records"]) == 5
         cc.shutdown()

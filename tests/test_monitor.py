@@ -205,3 +205,26 @@ class TestCapacityResolver:
         assert cap9.resource(Resource.DISK) == pytest.approx(500000)
         with pytest.raises(KeyError):
             resolver.capacity_for_broker("r", "h", 9, allow_estimation=False)
+
+
+def test_train_endpoint_path_and_infinite_aggregate():
+    """TRAIN fits real coefficients from broker history; aggregate over
+    (-inf, inf) must cover the full retained history (regression: the
+    window arithmetic crashed on int(-inf))."""
+    import numpy as np
+    sim = make_sim_cluster()
+    monitor, clock = make_monitor(sim)
+    monitor.start_up(do_sampling=False)
+    for _ in range(8):
+        monitor.task_runner.sample_once()
+        clock["now"] += 10.0
+    res = monitor.broker_aggregator.aggregate(-np.inf, np.inf)
+    assert res.entity_values
+    monitor.train()
+    assert monitor.cpu_model.trained
+    coefs = monitor.cpu_model.coefficients
+    assert coefs.leader_bytes_in >= 0.0
+    # trained model now drives follower CPU attribution in the model build
+    state, topo = monitor.cluster_model()
+    assert state.num_brokers == 4
+    monitor.shutdown()
