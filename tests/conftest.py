@@ -21,3 +21,18 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# An environment hook (e.g. a TPU-plugin sitecustomize) may import jax at
+# interpreter startup, in which case jax has already read JAX_PLATFORMS /
+# cache env vars and the assignments above are no-ops.  Force the config
+# directly — backends are created lazily, so this still takes effect as
+# long as no jax computation ran yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update(
+    "jax_persistent_cache_min_compile_time_secs",
+    float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+assert jax.default_backend() == "cpu", jax.default_backend()
