@@ -31,6 +31,10 @@ from cruise_control_tpu.executor.task import (ExecutionTask, TaskState,
 from cruise_control_tpu.executor.task_manager import ExecutionTaskManager
 
 LOG = logging.getLogger(__name__)
+#: operations audit log — one INFO line per started execution, emitted here
+#: so every path (facade, self-healing, topic-RF change) is covered
+#: (reference Executor.java:76,775-781 operationLogger)
+OPERATION_LOG = logging.getLogger("operationLogger")
 
 
 class ExecutorNotifier:
@@ -143,6 +147,16 @@ class Executor:
                         if replication_throttle is not None
                         else self._throttle_rate)
             run_uuid = self._uuid
+        # outside the lock: counts() walks every task and a blocking log
+        # handler must not stall state queries / stop_execution
+        OPERATION_LOG.info(
+            "execution %s started: %d proposals (%d inter-broker, "
+            "%d intra-broker, %d leadership tasks), reason: %s",
+            run_uuid, len(proposals),
+            mgr.counts(TaskType.INTER_BROKER_REPLICA_ACTION).total,
+            mgr.counts(TaskType.INTRA_BROKER_REPLICA_ACTION).total,
+            mgr.counts(TaskType.LEADER_ACTION).total,
+            reason or "(unspecified)")
         self._thread = threading.Thread(
             target=self._run, args=(throttle,),
             name=f"proposal-execution-{run_uuid[:8]}", daemon=True)

@@ -546,7 +546,6 @@ class CruiseControl:
         uuid = self.executor.execute_proposals(
             result.proposals, reason=reason, strategy=strategy,
             **execute_kwargs)
-        OPERATION_LOG.info("%s: execution %s started", reason, uuid)
         with self._cache_lock:    # executing invalidates cached proposals
             self._cached_result = None
         return OperationResult(result, execution_uuid=uuid, dryrun=False)

@@ -12,7 +12,8 @@ that the tensor state deliberately does not carry.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
+from typing import (Callable, Dict, List, Mapping, Optional, Sequence, Tuple,
+                    Union)
 
 import numpy as np
 
@@ -106,9 +107,19 @@ class ClusterTopology:
 
 
 class ClusterModelBuilder:
-    """Incrementally describe a cluster, then `build()` the tensor state."""
+    """Incrementally describe a cluster, then `build()` the tensor state.
 
-    def __init__(self):
+    `follower_cpu_estimator` — `(leader_cpu, leader_nw_in, leader_nw_out) ->
+    follower_cpu` — controls the leader-load split into follower base +
+    leadership bonus.  Callers that attribute follower CPU with a trained
+    regression (LoadMonitor after TRAIN) must pass the same estimator here,
+    or the leadership-transfer deltas inside the model would disagree with
+    the follower loads it was built from (reference: ModelUtils switches
+    getFollowerCpuUtilFromLeaderLoad globally once trained)."""
+
+    def __init__(self, follower_cpu_estimator: Optional[
+            Callable[[float, float, float], float]] = None):
+        self._follower_cpu = follower_cpu_estimator or estimate_follower_cpu
         self._racks: Dict[str, int] = {}
         self._hosts: Dict[str, int] = {}
         self._brokers: Dict[int, _Broker] = {}
@@ -201,7 +212,7 @@ class ClusterModelBuilder:
             else:
                 f_vec = lead_vec.copy()
                 f_vec[Resource.NW_OUT] = 0.0
-                f_vec[Resource.CPU] = estimate_follower_cpu(
+                f_vec[Resource.CPU] = self._follower_cpu(
                     lead_vec[Resource.CPU], lead_vec[Resource.NW_IN],
                     lead_vec[Resource.NW_OUT])
             self.add_replica(topic, partition, fb, False, f_vec)
@@ -268,9 +279,13 @@ class ClusterModelBuilder:
             if rep.is_leader:
                 # Split the leader's current-role load into follower base +
                 # leadership bonus (reference Replica.makeFollower semantics).
-                cpu_f = estimate_follower_cpu(rep.load[Resource.CPU],
-                                              rep.load[Resource.NW_IN],
-                                              rep.load[Resource.NW_OUT])
+                # clamp to [0, leader CPU]: a noisy trained estimator must
+                # not produce a negative leadership bonus (a transfer would
+                # then look like it REDUCES load on the receiving broker)
+                cpu_f = min(max(self._follower_cpu(rep.load[Resource.CPU],
+                                                   rep.load[Resource.NW_IN],
+                                                   rep.load[Resource.NW_OUT]),
+                                0.0), float(rep.load[Resource.CPU]))
                 base = rep.load.copy()
                 base[Resource.CPU] = cpu_f
                 base[Resource.NW_OUT] = 0.0

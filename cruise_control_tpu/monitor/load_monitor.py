@@ -287,7 +287,16 @@ class LoadMonitor:
                 f"partitions (need {req.min_required_num_windows} / "
                 f"{req.min_monitored_partitions_percentage:.1%})")
 
-        builder = ClusterModelBuilder()
+        # one read: per-partition consistency + no per-partition locking;
+        # the builder's leader-load split must use the same follower-CPU
+        # attribution as the follower loads assigned below
+        coefs = self.cpu_model.coefficients   # None until TRAINed
+        if coefs is not None:
+            follower_cpu = (lambda cpu, nw_in, nw_out:
+                            coefs.estimate_follower_cpu(nw_in))
+        else:
+            follower_cpu = estimate_follower_cpu
+        builder = ClusterModelBuilder(follower_cpu_estimator=follower_cpu)
         # --- brokers with resolved capacity (populateClusterCapacity) ---
         logdirs_by_broker = self._admin.describe_log_dirs(
             sorted(snapshot.all_broker_ids))
@@ -310,8 +319,6 @@ class LoadMonitor:
 
         # --- per-partition replica loads (populatePartitionLoad) ---
         n_skipped = 0
-        # one read: per-partition consistency + no per-partition locking
-        coefs = self.cpu_model.coefficients   # None until TRAINed
         for pinfo in snapshot.partitions:
             entity = PartitionEntity(pinfo.tp.topic, pinfo.tp.partition)
             vae = result.entity_values.get(entity)
@@ -332,14 +339,10 @@ class LoadMonitor:
                     # attribution once TRAIN has run (reference
                     # ModelUtils.getFollowerCpuUtilFromLeaderLoad switches
                     # from static coefficients to the trained regression)
-                    if coefs is not None:
-                        load[Resource.CPU] = coefs.estimate_follower_cpu(
-                            leader_load[Resource.NW_IN])
-                    else:
-                        load[Resource.CPU] = estimate_follower_cpu(
-                            leader_load[Resource.CPU],
-                            leader_load[Resource.NW_IN],
-                            leader_load[Resource.NW_OUT])
+                    load[Resource.CPU] = follower_cpu(
+                        leader_load[Resource.CPU],
+                        leader_load[Resource.NW_IN],
+                        leader_load[Resource.NW_OUT])
                 logdir = pinfo.logdir_by_broker.get(broker_id)
                 has_jbod = (logdir is not None
                             and logdir in jbod_dirs.get(broker_id, ()))

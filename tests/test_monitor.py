@@ -224,7 +224,15 @@ def test_train_endpoint_path_and_infinite_aggregate():
     assert monitor.cpu_model.trained
     coefs = monitor.cpu_model.coefficients
     assert coefs.leader_bytes_in >= 0.0
-    # trained model now drives follower CPU attribution in the model build
+    # trained model now drives follower CPU attribution in the model build,
+    # consistently for BOTH follower loads and the leader base/bonus split
+    # (a leadership transfer must leave the demoted leader carrying exactly
+    # the trained follower estimate)
     state, topo = monitor.cluster_model()
     assert state.num_brokers == 4
+    valid = np.asarray(state.replica_valid)
+    base = np.asarray(state.replica_base_load)
+    expect = coefs.follower_bytes_in * base[valid, Resource.NW_IN]
+    np.testing.assert_allclose(base[valid, Resource.CPU], expect, rtol=1e-5,
+                               atol=1e-6)
     monitor.shutdown()
