@@ -42,6 +42,13 @@ class Goal(abc.ABC):
     #: default cap on optimization rounds (each round commits up to one move
     #: per source broker, so this bounds per-broker sequential moves)
     max_rounds: int = 64
+    #: whether accept_move depends on the replica's SOURCE broker (e.g. a
+    #: count/utilization lower bound that each departure erodes).  When every
+    #: previously-optimized goal is destination-side only, batched kernels
+    #: may commit several departures per alive source broker in one round
+    #: without invalidating the per-round acceptance snapshot.  Conservative
+    #: default: True.
+    source_side_acceptance: bool = True
 
     def configure(self, props) -> None:  # pragma: no cover - plugin hook
         """Config hook for getConfiguredInstances."""

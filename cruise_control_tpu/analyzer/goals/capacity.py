@@ -33,6 +33,7 @@ class CapacityGoal(Goal):
 
     resource: Resource = Resource.DISK
     is_hard = True
+    source_side_acceptance = False   # acceptance checks the destination only
 
     def __init__(self, max_rounds: int = 64):
         self.max_rounds = max_rounds
@@ -164,6 +165,7 @@ class ReplicaCapacityGoal(Goal):
 
     is_hard = True
     name = "ReplicaCapacityGoal"
+    source_side_acceptance = False   # acceptance checks the destination only
 
     def __init__(self, max_rounds: int = 64):
         self.max_rounds = max_rounds

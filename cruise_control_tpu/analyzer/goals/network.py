@@ -28,6 +28,7 @@ from cruise_control_tpu.model.state import ClusterState
 
 class PotentialNwOutGoal(Goal):
     name = "PotentialNwOutGoal"
+    source_side_acceptance = False   # caps the destination's potential NW_OUT
 
     def __init__(self, max_rounds: int = 64):
         self.max_rounds = max_rounds

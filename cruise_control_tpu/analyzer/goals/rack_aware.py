@@ -32,6 +32,7 @@ from cruise_control_tpu.model.state import ClusterState
 class RackAwareGoal(Goal):
     is_hard = True
     name = "RackAwareGoal"
+    source_side_acceptance = False   # acceptance checks the destination rack
 
     def __init__(self, max_rounds: int = 128):
         self.max_rounds = max_rounds
@@ -90,7 +91,9 @@ class RackAwareGoal(Goal):
             # partitions — a per-source-broker cap would throttle rounds
             cand_r, cand_d, cand_v = kernels.forced_move_round(
                 st, movable, w, dest_ok_b, accept_all,
-                self._dest_pref(st, cache), ctx.partition_replicas)
+                self._dest_pref(st, cache), ctx.partition_replicas,
+                cap_alive_sources=any(g.source_side_acceptance
+                                      for g in prev_goals))
             st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
             return st, jnp.any(cand_v)
 

@@ -110,5 +110,12 @@ def default_goals(max_rounds: Optional[int] = None,
         kwargs = {}
         if max_rounds is not None:
             kwargs["max_rounds"] = max_rounds
+        if name in GOAL_CLASSES and GOAL_CLASSES[name].is_hard:
+            # unknown names fall through to make_goal's curated error
+            # hard goals must run to convergence, not to a round budget: an
+            # unconverged hard goal aborts the whole optimization.  Rounds
+            # only execute while progress is made, so the high bound is free
+            # once converged.
+            kwargs["max_rounds"] = max(kwargs.get("max_rounds", 0), 1024)
         out.append(make_goal(name, **kwargs))
     return out

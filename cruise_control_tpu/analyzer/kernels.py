@@ -362,6 +362,7 @@ def forced_move_round(state: ClusterState,
                       dest_pref: jax.Array,
                       partition_replicas: jax.Array,
                       max_candidates: int = 4096,
+                      cap_alive_sources: bool = True,
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One round of *global* forced-move search (self-healing).
 
@@ -402,14 +403,18 @@ def forced_move_round(state: ClusterState,
     # (e.g. counts[src]-1 >= lower) only stays valid if at most one replica
     # leaves an *alive* broker per round.  Dead/excluded sources carry no
     # bounds — their evacuation stays uncapped (that throughput is the whole
-    # point of the global candidate set).
-    src = rb[cand_r]
-    alive_src = state.broker_alive[src]
-    seg = jnp.where(alive_src, src, num_b)
-    capped, _, _ = per_segment_argmax(fits_w, seg, num_b + 1,
-                                      cand_valid & alive_src)
-    c_idx = jnp.arange(max_candidates, dtype=jnp.int32)
-    cand_valid &= jnp.where(alive_src, capped[seg] == c_idx, True)
+    # point of the global candidate set).  Callers whose acceptance stack is
+    # destination-side only (Goal.source_side_acceptance False for every
+    # previously-optimized goal) pass cap_alive_sources=False to lift the
+    # throttle.
+    if cap_alive_sources:
+        src = rb[cand_r]
+        alive_src = state.broker_alive[src]
+        seg = jnp.where(alive_src, src, num_b)
+        capped, _, _ = per_segment_argmax(fits_w, seg, num_b + 1,
+                                          cand_valid & alive_src)
+        c_idx = jnp.arange(max_candidates, dtype=jnp.int32)
+        cand_valid &= jnp.where(alive_src, capped[seg] == c_idx, True)
     return cand_r, cand_dest, cand_valid
 
 

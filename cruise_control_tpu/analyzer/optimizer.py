@@ -120,8 +120,11 @@ def heal_offline_replicas(state: ClusterState, ctx: OptimizationContext,
         dest_ok = st.broker_alive & ctx.broker_dest_ok
         util = cache.broker_load[:, Resource.DISK] / jnp.maximum(
             st.broker_capacity[:, Resource.DISK], 1e-9)
+        # acceptance here is capacity-only (destination-side), so several
+        # offline replicas may evacuate one alive broker (bad disk) per round
         cand_r, cand_d, cand_v = kernels.forced_move_round(
-            st, offline, w, dest_ok, accept, -util, ctx.partition_replicas)
+            st, offline, w, dest_ok, accept, -util, ctx.partition_replicas,
+            cap_alive_sources=False)
         st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
         return st, rounds + 1, jnp.any(cand_v)
 

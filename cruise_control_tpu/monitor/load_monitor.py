@@ -292,8 +292,12 @@ class LoadMonitor:
         # attribution as the follower loads assigned below
         coefs = self.cpu_model.coefficients   # None until TRAINed
         if coefs is not None:
+            # clamped to [0, leader CPU] so a noisy fit cannot attribute a
+            # follower more CPU than its leader uses — keeps follower loads
+            # and the builder's leader base/bonus split identical
             follower_cpu = (lambda cpu, nw_in, nw_out:
-                            coefs.estimate_follower_cpu(nw_in))
+                            min(max(coefs.estimate_follower_cpu(nw_in), 0.0),
+                                float(cpu)))
         else:
             follower_cpu = estimate_follower_cpu
         builder = ClusterModelBuilder(follower_cpu_estimator=follower_cpu)
