@@ -224,6 +224,14 @@ class RoundCache:
     partition_rack_count: jax.Array  # i32[P, K]
     broker_topic_count: jax.Array    # i32[B, T]
     potential_nw_out: jax.Array      # f32[B]
+    leader_bytes_in: jax.Array       # f32[B] NW_IN carried by leaders
+
+
+def leader_nw_in(state: ClusterState) -> jax.Array:
+    """f32[R] — NW_IN carried only by leaders (produce traffic; used by
+    LeaderBytesInDistributionGoal)."""
+    return (state.replica_base_load[:, Resource.NW_IN]
+            * (state.replica_valid & state.replica_is_leader))
 
 
 def make_round_cache(state: ClusterState) -> RoundCache:
@@ -238,4 +246,148 @@ def make_round_cache(state: ClusterState) -> RoundCache:
         partition_rack_count=S.partition_rack_count(state),
         broker_topic_count=S.broker_topic_replica_count(state),
         potential_nw_out=S.potential_leadership_load(state),
+        leader_bytes_in=jax.ops.segment_sum(
+            leader_nw_in(state), state.replica_broker,
+            num_segments=state.num_brokers),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Incremental cache maintenance.
+#
+# Rebuilding the RoundCache is O(R) in scatter-based segment reductions —
+# measured ~1.3ms per reduction at R=60K on a v5e chip, which dominates a
+# round.  A round commits at most O(B) actions, so updating the cache from
+# the committed action batch is O(B) scatter-adds instead (the same idea as
+# the reference's incrementally-maintained Broker/Rack load objects,
+# reference model/ClusterModel.java relocateReplica/relocateLeadership
+# keeping Load sums consistent).
+# ---------------------------------------------------------------------------
+
+def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
+                           replicas: jax.Array, dest_brokers: jax.Array,
+                           valid: jax.Array) -> RoundCache:
+    """Cache after `apply_moves(state_before, replicas, dest_brokers, valid)`.
+
+    `state_before` MUST be the pre-commit state (source brokers are read
+    from it).  Invalid rows are dropped via out-of-bounds routing exactly
+    like apply_moves."""
+    r = replicas.astype(jnp.int32)
+    dst = dest_brokers.astype(jnp.int32)
+    src = state_before.replica_broker[r]
+    valid = valid & (src != dst)
+    num_b = state_before.num_brokers
+    oob_b = num_b
+    s = jnp.where(valid, src, oob_b)
+    d = jnp.where(valid, dst, oob_b)
+
+    load_r = cache.replica_load[r]                       # f32[K, RES]
+    broker_load = cache.broker_load.at[s].add(-load_r, mode="drop")
+    broker_load = broker_load.at[d].add(load_r, mode="drop")
+    cap = jnp.maximum(state_before.broker_capacity, 1e-9)
+
+    one = valid.astype(jnp.int32)
+    replica_count = cache.replica_count.at[s].add(-one, mode="drop")
+    replica_count = replica_count.at[d].add(one, mode="drop")
+
+    lead = (valid & state_before.replica_is_leader[r]).astype(jnp.int32)
+    leader_count = cache.leader_count.at[s].add(-lead, mode="drop")
+    leader_count = leader_count.at[d].add(lead, mode="drop")
+
+    p = state_before.replica_partition[r]
+    k = state_before.num_racks
+    rack_s = state_before.broker_rack[jnp.minimum(s, num_b - 1)]
+    rack_d = state_before.broker_rack[jnp.minimum(d, num_b - 1)]
+    prc = cache.partition_rack_count.reshape(-1)
+    oob_pk = prc.shape[0]
+    prc = prc.at[jnp.where(valid, p * k + rack_s, oob_pk)].add(
+        -1, mode="drop")
+    prc = prc.at[jnp.where(valid, p * k + rack_d, oob_pk)].add(
+        1, mode="drop")
+    prc = prc.reshape(cache.partition_rack_count.shape)
+
+    t = state_before.partition_topic[p]
+    num_t = state_before.num_topics
+    btc = cache.broker_topic_count.reshape(-1)
+    oob_bt = btc.shape[0]
+    btc = btc.at[jnp.where(valid, src * num_t + t, oob_bt)].add(
+        -1, mode="drop")
+    btc = btc.at[jnp.where(valid, dst * num_t + t, oob_bt)].add(
+        1, mode="drop")
+    btc = btc.reshape(cache.broker_topic_count.shape)
+
+    # leader-role NW_OUT travels with the replica (potential load)
+    bonus = state_before.partition_leader_bonus[p]
+    lead_nw = (cache.replica_load[r][:, Resource.NW_OUT]
+               + jnp.where(state_before.replica_is_leader[r], 0.0,
+                           bonus[:, Resource.NW_OUT]))
+    pot = cache.potential_nw_out.at[s].add(-lead_nw * valid, mode="drop")
+    pot = pot.at[d].add(lead_nw * valid, mode="drop")
+
+    lbi_w = (state_before.replica_base_load[r, Resource.NW_IN]
+             * (valid & state_before.replica_is_leader[r]))
+    lbi = cache.leader_bytes_in.at[s].add(-lbi_w, mode="drop")
+    lbi = lbi.at[d].add(lbi_w, mode="drop")
+
+    return RoundCache(
+        broker_load=broker_load,
+        broker_util=broker_load / cap,
+        replica_load=cache.replica_load,      # role unchanged by a move
+        replica_count=replica_count,
+        leader_count=leader_count,
+        partition_rack_count=prc,
+        broker_topic_count=btc,
+        potential_nw_out=pot,
+        leader_bytes_in=lbi,
+    )
+
+
+def update_cache_for_leadership(state_before: ClusterState, cache: RoundCache,
+                                src_replicas: jax.Array,
+                                dest_replicas: jax.Array,
+                                valid: jax.Array) -> RoundCache:
+    """Cache after `apply_leadership_transfers(state_before, ...)`: the
+    partition's leadership bonus moves src replica → dest replica."""
+    sr = src_replicas.astype(jnp.int32)
+    dr = dest_replicas.astype(jnp.int32)
+    num_r = state_before.num_replicas
+    num_b = state_before.num_brokers
+    p = state_before.replica_partition[sr]
+    bonus = state_before.partition_leader_bonus[p] * valid[:, None]
+
+    b_src = state_before.replica_broker[sr]
+    b_dst = state_before.replica_broker[dr]
+    s = jnp.where(valid, b_src, num_b)
+    d = jnp.where(valid, b_dst, num_b)
+    broker_load = cache.broker_load.at[s].add(-bonus, mode="drop")
+    broker_load = broker_load.at[d].add(bonus, mode="drop")
+    cap = jnp.maximum(state_before.broker_capacity, 1e-9)
+
+    replica_load = cache.replica_load.at[
+        jnp.where(valid, sr, num_r)].add(-bonus, mode="drop")
+    replica_load = replica_load.at[
+        jnp.where(valid, dr, num_r)].add(bonus, mode="drop")
+
+    one = valid.astype(jnp.int32)
+    leader_count = cache.leader_count.at[s].add(-one, mode="drop")
+    leader_count = leader_count.at[d].add(one, mode="drop")
+
+    lbi = cache.leader_bytes_in.at[s].add(
+        -state_before.replica_base_load[sr, Resource.NW_IN] * valid,
+        mode="drop")
+    lbi = lbi.at[d].add(
+        state_before.replica_base_load[dr, Resource.NW_IN] * valid,
+        mode="drop")
+
+    # counts / racks / topics / potential NW_OUT are leadership-invariant
+    return RoundCache(
+        broker_load=broker_load,
+        broker_util=broker_load / cap,
+        replica_load=replica_load,
+        replica_count=cache.replica_count,
+        leader_count=leader_count,
+        partition_rack_count=cache.partition_rack_count,
+        broker_topic_count=cache.broker_topic_count,
+        potential_nw_out=cache.potential_nw_out,
+        leader_bytes_in=lbi,
     )

@@ -49,10 +49,9 @@ class CapacityGoal(Goal):
         res = int(self.resource)
         leadership_helps = self.resource in (Resource.NW_OUT, Resource.CPU)
 
-        def round_body(st: ClusterState):
+        def round_body(st: ClusterState, cache):
             committed = jnp.zeros((), dtype=bool)
             if leadership_helps:
-                cache = make_round_cache(st)
                 limit = self._limit(st, ctx)
                 W = cache.broker_load[:, res]
                 bonus = (st.partition_leader_bonus[st.replica_partition, res]
@@ -74,10 +73,10 @@ class CapacityGoal(Goal):
                     st, bonus, W - limit, movable, ctx.broker_leader_ok,
                     limit - W, accept_all, -W / jnp.maximum(limit, 1e-9),
                     ctx.partition_replicas)
-                st = kernels.commit_leadership(st, cand_r, cand_f, cand_v)
+                st, cache = kernels.commit_leadership_cached(
+                    st, cache, cand_r, cand_f, cand_v)
                 committed |= jnp.any(cand_v)
 
-            cache = make_round_cache(st)
             limit = self._limit(st, ctx)
             W = cache.broker_load[:, res]
             w = cache.replica_load[:, res]
@@ -89,26 +88,26 @@ class CapacityGoal(Goal):
                 st, w, W > limit, W - limit, movable,
                 ctx.broker_dest_ok & st.broker_alive, limit - W, accept,
                 -W / jnp.maximum(limit, 1e-9), ctx.partition_replicas)
-            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
             committed |= jnp.any(cand_v)
-            return st, committed
+            return st, cache, committed
 
         def cond(carry):
-            st, rounds, progressed = carry
-            cache = make_round_cache(st)
+            st, cache, rounds, progressed = carry
             still_violated = jnp.any(
                 (cache.broker_load[:, res] > self._limit(st, ctx))
                 & st.broker_alive)
             return progressed & still_violated & (rounds < self.max_rounds)
 
         def body(carry):
-            st, rounds, _ = carry
-            st, committed = round_body(st)
-            return st, rounds + 1, committed
+            st, cache, rounds, _ = carry
+            st, cache, committed = round_body(st, cache)
+            return st, cache, rounds + 1, committed
 
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.zeros((), jnp.int32),
-                         jnp.ones((), dtype=bool)))
+        state, _, _, _ = jax.lax.while_loop(
+            cond, body, (state, make_round_cache(state),
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
@@ -174,8 +173,7 @@ class ReplicaCapacityGoal(Goal):
                  prev_goals: Sequence[Goal]) -> ClusterState:
         limit = float(ctx.max_replicas_per_broker)
 
-        def round_body(st: ClusterState):
-            cache = make_round_cache(st)
+        def round_body(st: ClusterState, cache):
             count = cache.replica_count.astype(jnp.float32)
             w = jnp.ones(st.num_replicas, dtype=jnp.float32)
             movable = (st.replica_valid & ~ctx.replica_excluded
@@ -185,23 +183,24 @@ class ReplicaCapacityGoal(Goal):
                 st, w, count > limit, count - limit, movable,
                 ctx.broker_dest_ok & st.broker_alive, limit - count, accept,
                 -count, ctx.partition_replicas)
-            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
-            return st, jnp.any(cand_v)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
+            return st, cache, jnp.any(cand_v)
 
         def cond(carry):
-            st, rounds, progressed = carry
-            count = S.broker_replica_count(st).astype(jnp.float32)
+            st, cache, rounds, progressed = carry
+            count = cache.replica_count.astype(jnp.float32)
             return (progressed & (rounds < self.max_rounds)
                     & jnp.any((count > limit) & st.broker_alive))
 
         def body(carry):
-            st, rounds, _ = carry
-            st, committed = round_body(st)
-            return st, rounds + 1, committed
+            st, cache, rounds, _ = carry
+            st, cache, committed = round_body(st, cache)
+            return st, cache, rounds + 1, committed
 
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.zeros((), jnp.int32),
-                         jnp.ones((), dtype=bool)))
+        state, _, _, _ = jax.lax.while_loop(
+            cond, body, (state, make_round_cache(state),
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):

@@ -59,8 +59,7 @@ class ReplicaDistributionGoal(Goal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
-        def round_body(st: ClusterState):
-            cache = make_round_cache(st)
+        def round_body(st: ClusterState, cache):
             counts = self._counts(cache)
             avg = self._avg(st, counts)
             lower, upper = _count_bounds(avg, self.pct_margin)
@@ -78,11 +77,11 @@ class ReplicaDistributionGoal(Goal):
                 st, w, counts > upper, counts - upper, movable,
                 dest_ok & (counts + 1 <= upper), upper - counts, accept,
                 -counts, ctx.partition_replicas)
-            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
             committed |= jnp.any(cand_v)
 
             # fill under-lower brokers
-            cache = make_round_cache(st)
             counts = self._counts(cache)
             w = self._weights(st)
             movable = (st.replica_valid & ~ctx.replica_excluded
@@ -93,22 +92,23 @@ class ReplicaDistributionGoal(Goal):
                 st, w, counts > avg, counts - lower, movable,
                 dest_ok & (counts < lower), upper - counts, accept,
                 -counts, ctx.partition_replicas, strict_allowance=True)
-            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
             committed |= jnp.any(cand_v)
-            return st, committed
+            return st, cache, committed
 
         def cond(carry):
-            st, rounds, progressed = carry
+            _, _, rounds, progressed = carry
             return progressed & (rounds < self.max_rounds)
 
         def body(carry):
-            st, rounds, _ = carry
-            st, committed = round_body(st)
-            return st, rounds + 1, committed
+            st, cache, rounds, _ = carry
+            st, cache, committed = round_body(st, cache)
+            return st, cache, rounds + 1, committed
 
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.zeros((), jnp.int32),
-                         jnp.ones((), dtype=bool)))
+        state, _, _, _ = jax.lax.while_loop(
+            cond, body, (state, make_round_cache(state),
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
@@ -155,8 +155,7 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
-        def round_body(st: ClusterState):
-            cache = make_round_cache(st)
+        def round_body(st: ClusterState, cache):
             counts = self._counts(cache)
             avg = self._avg(st, counts)
             lower, upper = _count_bounds(avg, self.pct_margin)
@@ -173,21 +172,22 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
             cand_r, cand_f, cand_v = kernels.leadership_round(
                 st, bonus, counts - upper, movable, ctx.broker_leader_ok,
                 upper - counts, accept_all, -counts, ctx.partition_replicas)
-            st = kernels.commit_leadership(st, cand_r, cand_f, cand_v)
-            return st, jnp.any(cand_v)
+            st, cache = kernels.commit_leadership_cached(st, cache, cand_r,
+                                                         cand_f, cand_v)
+            return st, cache, jnp.any(cand_v)
 
         def cond(carry):
-            st, rounds, progressed = carry
+            _, _, rounds, progressed = carry
             return progressed & (rounds < self.max_rounds)
 
         def body(carry):
-            st, rounds, _ = carry
-            st, committed = round_body(st)
-            return st, rounds + 1, committed
+            st, cache, rounds, _ = carry
+            st, cache, committed = round_body(st, cache)
+            return st, cache, rounds + 1, committed
 
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.zeros((), jnp.int32),
-                         jnp.ones((), dtype=bool)))
+        state, _, _, _ = jax.lax.while_loop(
+            cond, body, (state, make_round_cache(state),
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
     def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
@@ -229,8 +229,7 @@ class TopicReplicaDistributionGoal(Goal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
-        def round_body(st: ClusterState):
-            cache = make_round_cache(st)
+        def round_body(st: ClusterState, cache):
             tc = cache.broker_topic_count.astype(jnp.float32)          # [B,T]
             lower, upper = self._bounds(st, tc)
             topic_of_r = st.partition_topic[st.replica_partition]
@@ -257,21 +256,22 @@ class TopicReplicaDistributionGoal(Goal):
             cand_r, cand_d, cand_v = kernels.forced_move_round(
                 st, movable, w, dest_ok_b, accept_all, -counts,
                 ctx.partition_replicas)
-            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
-            return st, jnp.any(cand_v)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
+            return st, cache, jnp.any(cand_v)
 
         def cond(carry):
-            st, rounds, progressed = carry
+            _, _, rounds, progressed = carry
             return progressed & (rounds < self.max_rounds)
 
         def body(carry):
-            st, rounds, _ = carry
-            st, committed = round_body(st)
-            return st, rounds + 1, committed
+            st, cache, rounds, _ = carry
+            st, cache, committed = round_body(st, cache)
+            return st, cache, rounds + 1, committed
 
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.zeros((), jnp.int32),
-                         jnp.ones((), dtype=bool)))
+        state, _, _, _ = jax.lax.while_loop(
+            cond, body, (state, make_round_cache(state),
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):

@@ -75,8 +75,8 @@ class KafkaAssignerDiskUsageDistributionGoal(Goal):
         #: disk balance percentage with a fixed margin factor)
         self.balance_margin = balance_margin
 
-    def _bounds(self, st: ClusterState):
-        util = S.broker_load(st)[:, Resource.DISK]
+    def _bounds(self, st: ClusterState, util: jax.Array):
+        """(pct[B], avg) disk fill from a precomputed broker DISK load."""
         cap = st.broker_capacity[:, Resource.DISK]
         pct = jnp.where(cap > 0, util / jnp.maximum(cap, 1e-9), 0.0)
         alive = st.broker_alive
@@ -87,9 +87,10 @@ class KafkaAssignerDiskUsageDistributionGoal(Goal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
-        def round_body(st: ClusterState):
-            cache = make_round_cache(st)
-            pct, avg = self._bounds(st)
+        def round_body(st: ClusterState, cache):
+            cap = st.broker_capacity[:, Resource.DISK]
+            util = cache.broker_load[:, Resource.DISK]
+            pct, avg = self._bounds(st, util)
             hot = st.broker_alive & (pct > avg * (1 + self.balance_margin))
             cold = (st.broker_alive & ctx.broker_dest_ok
                     & (pct < avg * (1 - self.balance_margin)))
@@ -97,32 +98,32 @@ class KafkaAssignerDiskUsageDistributionGoal(Goal):
                        & ctx.replica_movable & ~st.replica_offline)
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
             w = cache.replica_load[:, Resource.DISK]
-            cap = st.broker_capacity[:, Resource.DISK]
-            util = S.broker_load(st)[:, Resource.DISK]
             # per-broker absolute target: same relative fill everywhere
             target = avg * cap
             out_r, in_r, cold_idx, valid = kernels.swap_round(
                 st, w, movable, hot, cold, util, target,
                 lambda r, d: accept(r, d), ctx.partition_replicas)
-            st = kernels.commit_swaps(st, out_r, in_r, cold_idx, valid)
-            return st, jnp.any(valid)
+            st, cache = kernels.commit_swaps_cached(st, cache, out_r, in_r,
+                                                    cold_idx, valid)
+            return st, cache, jnp.any(valid)
 
         def cond(carry):
-            st, rounds, progressed = carry
+            _, _, rounds, progressed = carry
             return progressed & (rounds < self.max_rounds)
 
         def body(carry):
-            st, rounds, _ = carry
-            st, committed = round_body(st)
-            return st, rounds + 1, committed
+            st, cache, rounds, _ = carry
+            st, cache, committed = round_body(st, cache)
+            return st, cache, rounds + 1, committed
 
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.zeros((), jnp.int32),
-                         jnp.ones((), dtype=bool)))
+        state, _, _, _ = jax.lax.while_loop(
+            cond, body, (state, make_round_cache(state),
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
     def violated_brokers(self, state, ctx, cache):
-        pct, avg = self._bounds(state)
+        pct, avg = self._bounds(state,
+                                cache.broker_load[:, Resource.DISK])
         return state.broker_alive & (
             (pct > avg * (1 + self.balance_margin))
             | (pct < avg * (1 - self.balance_margin)))

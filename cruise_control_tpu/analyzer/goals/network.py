@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
+                                                 leader_nw_in,
                                                  make_round_cache)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance)
@@ -45,8 +46,7 @@ class PotentialNwOutGoal(Goal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
-        def round_body(st: ClusterState):
-            cache = make_round_cache(st)
+        def round_body(st: ClusterState, cache):
             pot = cache.potential_nw_out
             limit = self._limit(st, ctx)
             w = self._leader_role_nw_out(st)
@@ -63,23 +63,24 @@ class PotentialNwOutGoal(Goal):
                 ctx.broker_dest_ok & st.broker_alive, limit - pot,
                 accept_all, -pot / jnp.maximum(limit, 1e-9),
                 ctx.partition_replicas)
-            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
-            return st, jnp.any(cand_v)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
+            return st, cache, jnp.any(cand_v)
 
         def cond(carry):
-            st, rounds, progressed = carry
-            pot = S.potential_leadership_load(st)
+            st, cache, rounds, progressed = carry
+            pot = cache.potential_nw_out
             return (progressed & (rounds < self.max_rounds)
                     & jnp.any((pot > self._limit(st, ctx)) & st.broker_alive))
 
         def body(carry):
-            st, rounds, _ = carry
-            st, committed = round_body(st)
-            return st, rounds + 1, committed
+            st, cache, rounds, _ = carry
+            st, cache, committed = round_body(st, cache)
+            return st, cache, rounds + 1, committed
 
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.zeros((), jnp.int32),
-                         jnp.ones((), dtype=bool)))
+        state, _, _, _ = jax.lax.while_loop(
+            cond, body, (state, make_round_cache(state),
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
@@ -112,16 +113,10 @@ class LeaderBytesInDistributionGoal(Goal):
         self.max_rounds = max_rounds
         self.pct_margin = balance_pct_margin
 
-    @staticmethod
-    def _leader_nw_in(state: ClusterState) -> jax.Array:
-        """f32[R] — NW_IN carried only by leaders (produce traffic)."""
-        return (state.replica_base_load[:, Resource.NW_IN]
-                * (state.replica_valid & state.replica_is_leader))
-
-    def _broker_leader_bytes_in(self, state: ClusterState) -> jax.Array:
-        return jax.ops.segment_sum(self._leader_nw_in(state),
-                                   state.replica_broker,
-                                   num_segments=state.num_brokers)
+    # canonical definition lives in context.leader_nw_in (the cache field
+    # leader_bytes_in is maintained from it); delegate so the goal's
+    # acceptance math can never desynchronize from the cache
+    _leader_nw_in = staticmethod(leader_nw_in)
 
     def _bounds(self, state: ClusterState, lbi: jax.Array):
         alive = state.broker_alive
@@ -131,9 +126,8 @@ class LeaderBytesInDistributionGoal(Goal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
-        def round_body(st: ClusterState):
-            cache = make_round_cache(st)
-            lbi = self._broker_leader_bytes_in(st)
+        def round_body(st: ClusterState, cache):
+            lbi = cache.leader_bytes_in
             upper = self._bounds(st, lbi)
             bonus = self._leader_nw_in(st)
             movable = (st.replica_valid & ~ctx.replica_excluded
@@ -149,25 +143,26 @@ class LeaderBytesInDistributionGoal(Goal):
             cand_r, cand_f, cand_v = kernels.leadership_round(
                 st, bonus, lbi - upper, movable, ctx.broker_leader_ok,
                 upper - lbi, accept_all, -lbi, ctx.partition_replicas)
-            st = kernels.commit_leadership(st, cand_r, cand_f, cand_v)
-            return st, jnp.any(cand_v)
+            st, cache = kernels.commit_leadership_cached(st, cache, cand_r,
+                                                         cand_f, cand_v)
+            return st, cache, jnp.any(cand_v)
 
         def cond(carry):
-            st, rounds, progressed = carry
+            _, _, rounds, progressed = carry
             return progressed & (rounds < self.max_rounds)
 
         def body(carry):
-            st, rounds, _ = carry
-            st, committed = round_body(st)
-            return st, rounds + 1, committed
+            st, cache, rounds, _ = carry
+            st, cache, committed = round_body(st, cache)
+            return st, cache, rounds + 1, committed
 
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.zeros((), jnp.int32),
-                         jnp.ones((), dtype=bool)))
+        state, _, _, _ = jax.lax.while_loop(
+            cond, body, (state, make_round_cache(state),
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
     def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
-        lbi = self._broker_leader_bytes_in(state)
+        lbi = cache.leader_bytes_in
         upper = self._bounds(state, lbi)
         dest = state.replica_broker[dest_replica]
         src = state.replica_broker[src_replica]
@@ -179,7 +174,7 @@ class LeaderBytesInDistributionGoal(Goal):
         return jnp.where(lbi[dest] <= upper, strict, relaxed)
 
     def violated_brokers(self, state, ctx, cache):
-        lbi = self._broker_leader_bytes_in(state)
+        lbi = cache.leader_bytes_in
         return state.broker_alive & (lbi > self._bounds(state, lbi))
 
 

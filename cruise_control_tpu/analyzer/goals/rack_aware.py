@@ -56,8 +56,7 @@ class RackAwareGoal(Goal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
-        def round_body(st: ClusterState):
-            cache = make_round_cache(st)
+        def round_body(st: ClusterState, cache):
             prc = cache.partition_rack_count
             redundant = self._redundant_mask(st, prc)
             # prefer moving followers; a leader only moves if it is the sole
@@ -94,23 +93,24 @@ class RackAwareGoal(Goal):
                 self._dest_pref(st, cache), ctx.partition_replicas,
                 cap_alive_sources=any(g.source_side_acceptance
                                       for g in prev_goals))
-            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
-            return st, jnp.any(cand_v)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
+            return st, cache, jnp.any(cand_v)
 
         def cond(carry):
-            st, rounds, progressed = carry
-            prc = S.partition_rack_count(st)
+            st, cache, rounds, progressed = carry
             return (progressed & (rounds < self.max_rounds)
-                    & jnp.any(self._redundant_mask(st, prc)))
+                    & jnp.any(self._redundant_mask(
+                        st, cache.partition_rack_count)))
 
         def body(carry):
-            st, rounds, _ = carry
-            st, committed = round_body(st)
-            return st, rounds + 1, committed
+            st, cache, rounds, _ = carry
+            st, cache, committed = round_body(st, cache)
+            return st, cache, rounds + 1, committed
 
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.zeros((), jnp.int32),
-                         jnp.ones((), dtype=bool)))
+        state, _, _, _ = jax.lax.while_loop(
+            cond, body, (state, make_round_cache(state),
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):

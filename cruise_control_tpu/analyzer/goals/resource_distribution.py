@@ -68,13 +68,12 @@ class ResourceDistributionGoal(Goal):
                  prev_goals: Sequence[Goal]) -> ClusterState:
         res = int(self.resource)
 
-        def round_body(st: ClusterState):
+        def round_body(st: ClusterState, cache):
             committed = jnp.zeros((), dtype=bool)
+            lower, upper = self._bounds(st, ctx)   # capacity-only: static
 
             # ---------- phase A: leadership shed (NW_OUT / CPU) ----------
             if self._leadership_applicable():
-                cache = make_round_cache(st)
-                lower, upper = self._bounds(st, ctx)
                 W = cache.broker_load[:, res]
                 bonus = (st.partition_leader_bonus[st.replica_partition, res]
                          * st.replica_valid)
@@ -97,12 +96,11 @@ class ResourceDistributionGoal(Goal):
                     upper - W, accept_all,
                     -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
                     ctx.partition_replicas)
-                st = kernels.commit_leadership(st, cand_r, cand_f, cand_v)
+                st, cache = kernels.commit_leadership_cached(
+                    st, cache, cand_r, cand_f, cand_v)
                 committed |= jnp.any(cand_v)
 
             # ---------- phase B: shed replicas off over-upper brokers ----
-            cache = make_round_cache(st)
-            lower, upper = self._bounds(st, ctx)
             W = cache.broker_load[:, res]
             w = cache.replica_load[:, res]
             movable = (st.replica_valid & ~ctx.replica_excluded
@@ -114,12 +112,11 @@ class ResourceDistributionGoal(Goal):
                 st, w, W > upper, W - upper, movable,
                 self._dest_mask(st, ctx), upper - W, accept,
                 dest_pref, ctx.partition_replicas)
-            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
             committed |= jnp.any(cand_v)
 
             # ---------- phase C: fill under-lower brokers ----------------
-            cache = make_round_cache(st)
-            lower, upper = self._bounds(st, ctx)
             W = cache.broker_load[:, res]
             w = cache.replica_load[:, res]
             avg_w = (ctx.balance_upper_pct[res] + ctx.balance_lower_pct[res]) \
@@ -133,22 +130,23 @@ class ResourceDistributionGoal(Goal):
                 st, w, W > avg_w, W - lower, movable, under, upper - W,
                 accept, -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
                 ctx.partition_replicas, strict_allowance=True)
-            st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
             committed |= jnp.any(cand_v)
-            return st, committed
+            return st, cache, committed
 
         def cond(carry):
-            _, rounds, progressed = carry
+            _, _, rounds, progressed = carry
             return progressed & (rounds < self.max_rounds)
 
         def body(carry):
-            st, rounds, _ = carry
-            st, committed = round_body(st)
-            return st, rounds + 1, committed
+            st, cache, rounds, _ = carry
+            st, cache, committed = round_body(st, cache)
+            return st, cache, rounds + 1, committed
 
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.zeros((), jnp.int32),
-                         jnp.ones((), dtype=bool)))
+        state, _, _, _ = jax.lax.while_loop(
+            cond, body, (state, make_round_cache(state),
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
     # -- acceptance (as a previously-optimized goal) -----------------------

@@ -498,9 +498,10 @@ def swap_round(state: ClusterState,
     return out_r, in_r, cold, valid
 
 
-def commit_swaps(state: ClusterState, out_r: jax.Array, in_r: jax.Array,
-                 cold: jax.Array, valid: jax.Array) -> ClusterState:
-    """Apply a swap round: both directions land in one scatter batch."""
+def _swap_moves(state: ClusterState, out_r: jax.Array, in_r: jax.Array,
+                cold: jax.Array, valid: jax.Array):
+    """Flatten a swap round into one (replicas, dests, ok) move batch —
+    shared by the plain and cache-maintaining commits."""
     hot = jnp.arange(state.num_brokers, dtype=jnp.int32)
     in_of_pair = in_r[cold]
     replicas = jnp.concatenate([jnp.maximum(out_r, 0),
@@ -508,6 +509,13 @@ def commit_swaps(state: ClusterState, out_r: jax.Array, in_r: jax.Array,
     dests = jnp.concatenate([cold, hot])
     ok = jnp.concatenate([valid & (out_r >= 0),
                           valid & (in_of_pair >= 0)])
+    return replicas, dests, ok
+
+
+def commit_swaps(state: ClusterState, out_r: jax.Array, in_r: jax.Array,
+                 cold: jax.Array, valid: jax.Array) -> ClusterState:
+    """Apply a swap round: both directions land in one scatter batch."""
+    replicas, dests, ok = _swap_moves(state, out_r, in_r, cold, valid)
     return S.apply_moves(state, replicas, dests, ok)
 
 
@@ -515,6 +523,43 @@ def commit_moves(state: ClusterState, cand_r: jax.Array, cand_dest: jax.Array,
                  cand_valid: jax.Array) -> ClusterState:
     return S.apply_moves(state, jnp.maximum(cand_r, 0), cand_dest,
                          cand_valid & (cand_r >= 0))
+
+
+# ---------------------------------------------------------------------------
+# Cache-maintaining commits.  Rebuilding the RoundCache costs O(R) in
+# scatter reductions per round; these variants apply the O(B)-sized action
+# batch to both the state and the cache (context.update_cache_for_*), so
+# round loops carry the cache instead of recomputing it.
+# ---------------------------------------------------------------------------
+
+def commit_moves_cached(state: ClusterState, cache, cand_r: jax.Array,
+                        cand_dest: jax.Array, cand_valid: jax.Array):
+    from cruise_control_tpu.analyzer.context import update_cache_for_moves
+    r = jnp.maximum(cand_r, 0)
+    v = cand_valid & (cand_r >= 0)
+    new_cache = update_cache_for_moves(state, cache, r, cand_dest, v)
+    return S.apply_moves(state, r, cand_dest, v), new_cache
+
+
+def commit_leadership_cached(state: ClusterState, cache, cand_r: jax.Array,
+                             cand_dest_replica: jax.Array,
+                             cand_valid: jax.Array):
+    from cruise_control_tpu.analyzer.context import \
+        update_cache_for_leadership
+    src = jnp.maximum(cand_r, 0)
+    v = cand_valid & (cand_r >= 0)
+    new_cache = update_cache_for_leadership(state, cache, src,
+                                            cand_dest_replica, v)
+    return S.apply_leadership_transfers(state, src, cand_dest_replica,
+                                        v), new_cache
+
+
+def commit_swaps_cached(state: ClusterState, cache, out_r: jax.Array,
+                        in_r: jax.Array, cold: jax.Array, valid: jax.Array):
+    from cruise_control_tpu.analyzer.context import update_cache_for_moves
+    replicas, dests, ok = _swap_moves(state, out_r, in_r, cold, valid)
+    new_cache = update_cache_for_moves(state, cache, replicas, dests, ok)
+    return S.apply_moves(state, replicas, dests, ok), new_cache
 
 
 def commit_leadership(state: ClusterState, cand_r: jax.Array,

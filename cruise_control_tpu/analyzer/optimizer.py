@@ -101,12 +101,11 @@ def heal_offline_replicas(state: ClusterState, ctx: OptimizationContext,
     the no-duplicate-partition constraint and capacity thresholds.
     """
     def cond(carry):
-        st, rounds, progressed = carry
+        st, cache, rounds, progressed = carry
         return progressed & (rounds < max_rounds)
 
     def body(carry):
-        st, rounds, _ = carry
-        cache = make_round_cache(st)
+        st, cache, rounds, _ = carry
         offline = S.self_healing_eligible(st)
         w = cache.replica_load[:, Resource.DISK]
         cap = st.broker_capacity * ctx.capacity_threshold[None, :]
@@ -125,11 +124,13 @@ def heal_offline_replicas(state: ClusterState, ctx: OptimizationContext,
         cand_r, cand_d, cand_v = kernels.forced_move_round(
             st, offline, w, dest_ok, accept, -util, ctx.partition_replicas,
             cap_alive_sources=False)
-        st = kernels.commit_moves(st, cand_r, cand_d, cand_v)
-        return st, rounds + 1, jnp.any(cand_v)
+        st, cache = kernels.commit_moves_cached(st, cache, cand_r, cand_d,
+                                                cand_v)
+        return st, cache, rounds + 1, jnp.any(cand_v)
 
-    state, _, _ = jax.lax.while_loop(
-        cond, body, (state, jnp.zeros((), jnp.int32), jnp.ones((), bool)))
+    state, _, _, _ = jax.lax.while_loop(
+        cond, body, (state, make_round_cache(state),
+                     jnp.zeros((), jnp.int32), jnp.ones((), bool)))
     return state
 
 

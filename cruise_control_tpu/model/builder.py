@@ -115,11 +115,15 @@ class ClusterModelBuilder:
     regression (LoadMonitor after TRAIN) must pass the same estimator here,
     or the leadership-transfer deltas inside the model would disagree with
     the follower loads it was built from (reference: ModelUtils switches
-    getFollowerCpuUtilFromLeaderLoad globally once trained)."""
+    getFollowerCpuUtilFromLeaderLoad globally once trained).  The estimate
+    is clamped to [0, leader_cpu] in every use: a noisy estimator must not
+    produce a negative leadership bonus."""
 
     def __init__(self, follower_cpu_estimator: Optional[
             Callable[[float, float, float], float]] = None):
-        self._follower_cpu = follower_cpu_estimator or estimate_follower_cpu
+        raw = follower_cpu_estimator or estimate_follower_cpu
+        self._follower_cpu = (lambda cpu, nw_in, nw_out:
+                              np.clip(raw(cpu, nw_in, nw_out), 0.0, cpu))
         self._racks: Dict[str, int] = {}
         self._hosts: Dict[str, int] = {}
         self._brokers: Dict[int, _Broker] = {}
@@ -279,13 +283,9 @@ class ClusterModelBuilder:
             if rep.is_leader:
                 # Split the leader's current-role load into follower base +
                 # leadership bonus (reference Replica.makeFollower semantics).
-                # clamp to [0, leader CPU]: a noisy trained estimator must
-                # not produce a negative leadership bonus (a transfer would
-                # then look like it REDUCES load on the receiving broker)
-                cpu_f = min(max(self._follower_cpu(rep.load[Resource.CPU],
-                                                   rep.load[Resource.NW_IN],
-                                                   rep.load[Resource.NW_OUT]),
-                                0.0), float(rep.load[Resource.CPU]))
+                cpu_f = float(self._follower_cpu(rep.load[Resource.CPU],
+                                                 rep.load[Resource.NW_IN],
+                                                 rep.load[Resource.NW_OUT]))
                 base = rep.load.copy()
                 base[Resource.CPU] = cpu_f
                 base[Resource.NW_OUT] = 0.0

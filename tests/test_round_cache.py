@@ -1,0 +1,163 @@
+"""Incremental RoundCache maintenance vs full recomputation.
+
+The optimizer round loops carry the RoundCache and update it from each
+committed action batch instead of rebuilding O(R) segment reductions per
+round; these tests assert the incremental caches stay exactly consistent
+with `make_round_cache` of the evolving state across mixed rounds of
+moves, leadership transfers, and swaps.
+"""
+import conftest  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import kernels
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions,
+                                                 make_context,
+                                                 make_round_cache)
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
+                                                       random_cluster)
+
+
+def _assert_cache_equal(cache, fresh, atol=1e-3):
+    np.testing.assert_allclose(np.asarray(cache.broker_load),
+                               np.asarray(fresh.broker_load),
+                               rtol=1e-4, atol=atol)
+    np.testing.assert_allclose(np.asarray(cache.replica_load),
+                               np.asarray(fresh.replica_load),
+                               rtol=1e-4, atol=atol)
+    np.testing.assert_array_equal(np.asarray(cache.replica_count),
+                                  np.asarray(fresh.replica_count))
+    np.testing.assert_array_equal(np.asarray(cache.leader_count),
+                                  np.asarray(fresh.leader_count))
+    np.testing.assert_array_equal(np.asarray(cache.partition_rack_count),
+                                  np.asarray(fresh.partition_rack_count))
+    np.testing.assert_array_equal(np.asarray(cache.broker_topic_count),
+                                  np.asarray(fresh.broker_topic_count))
+    np.testing.assert_allclose(np.asarray(cache.potential_nw_out),
+                               np.asarray(fresh.potential_nw_out),
+                               rtol=1e-4, atol=atol)
+    np.testing.assert_allclose(np.asarray(cache.leader_bytes_in),
+                               np.asarray(fresh.leader_bytes_in),
+                               rtol=1e-4, atol=atol)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    state, topo = random_cluster(RandomClusterSpec(
+        num_brokers=12, num_partitions=120, replication_factor=3,
+        num_racks=4, num_topics=5, seed=7, skew_fraction=0.3))
+    ctx = make_context(state, BalancingConstraint(), OptimizationOptions(),
+                       topo)
+    return state, ctx
+
+
+def test_moves_update_cache(cluster):
+    state, ctx = cluster
+    cache = make_round_cache(state)
+    key = jax.random.PRNGKey(0)
+    for step in range(5):
+        key, k1, k2 = jax.random.split(key, 3)
+        k = 8
+        replicas = jax.random.randint(k1, (k,), 0, state.num_replicas)
+        dests = jax.random.randint(k2, (k,), 0, state.num_brokers)
+        # avoid duplicate replica rows in one batch (undefined scatter order)
+        _, first = np.unique(np.asarray(replicas), return_index=True)
+        valid = np.zeros(k, dtype=bool)
+        valid[first] = True
+        # no second replica of the partition on the destination
+        pr = np.asarray(ctx.partition_replicas)
+        rb = np.asarray(state.replica_broker)
+        for i in range(k):
+            sib = pr[np.asarray(state.replica_partition)[replicas[i]]]
+            sib_b = rb[sib[sib >= 0]]
+            if np.asarray(dests)[i] in sib_b:
+                valid[i] = False
+        valid = jnp.asarray(valid) & np.asarray(state.replica_valid)[replicas]
+        state, cache = kernels.commit_moves_cached(state, cache, replicas,
+                                                   dests, valid)
+        _assert_cache_equal(cache, make_round_cache(state))
+
+
+def test_leadership_update_cache(cluster):
+    state, ctx = cluster
+    cache = make_round_cache(state)
+    pr = np.asarray(ctx.partition_replicas)
+    # transfer leadership of a handful of partitions to a follower
+    src, dst, ok = [], [], []
+    for p in range(0, 40, 7):
+        row = pr[p][pr[p] >= 0]
+        leaders = [r for r in row
+                   if np.asarray(state.replica_is_leader)[r]]
+        followers = [r for r in row
+                     if not np.asarray(state.replica_is_leader)[r]]
+        if leaders and followers:
+            src.append(leaders[0]); dst.append(followers[0]); ok.append(True)
+    state, cache = kernels.commit_leadership_cached(
+        state, cache, jnp.asarray(src, jnp.int32),
+        jnp.asarray(dst, jnp.int32), jnp.asarray(ok))
+    _assert_cache_equal(cache, make_round_cache(state))
+
+
+def test_mixed_rounds_through_kernels(cluster):
+    """Drive the real search kernels (move_round / leadership_round) and
+    commit with cache maintenance; the cache must track exactly."""
+    state, ctx = cluster
+    cache = make_round_cache(state)
+    res = int(Resource.DISK)
+    for _ in range(4):
+        W = cache.broker_load[:, res]
+        cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
+        avg = jnp.sum(W) / jnp.sum(cap)
+        upper = avg * 1.05 * cap
+        accept = lambda r, d: jnp.ones(
+            jnp.broadcast_shapes(r.shape, d.shape), bool)
+        cand_r, cand_d, cand_v = kernels.move_round(
+            state, cache.replica_load[:, res], W > upper, W - upper,
+            state.replica_valid & ~state.replica_offline,
+            state.broker_alive, upper - W, accept, -W / cap,
+            ctx.partition_replicas)
+        state, cache = kernels.commit_moves_cached(state, cache, cand_r,
+                                                   cand_d, cand_v)
+        _assert_cache_equal(cache, make_round_cache(state))
+
+    bonus = (state.partition_leader_bonus[state.replica_partition, res]
+             * state.replica_valid)
+    W = cache.broker_load[:, res]
+    cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
+    avg = jnp.sum(W) / jnp.sum(cap)
+    upper = avg * 1.02 * cap
+    accept = lambda r, d: jnp.ones(
+        jnp.broadcast_shapes(r.shape, d.shape), bool)
+    cand_r, cand_f, cand_v = kernels.leadership_round(
+        state, bonus, W - upper,
+        state.replica_valid & ~state.replica_offline,
+        state.broker_alive, upper - W, accept, -W / cap,
+        ctx.partition_replicas)
+    state, cache = kernels.commit_leadership_cached(state, cache, cand_r,
+                                                    cand_f, cand_v)
+    _assert_cache_equal(cache, make_round_cache(state))
+
+
+def test_swaps_update_cache(cluster):
+    state, ctx = cluster
+    cache = make_round_cache(state)
+    res = int(Resource.DISK)
+    w = cache.replica_load[:, res]
+    util = cache.broker_load[:, res]
+    cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
+    target = jnp.sum(util) / jnp.sum(cap) * cap
+    hot = util > target
+    accept = lambda r, d: jnp.ones(
+        jnp.broadcast_shapes(r.shape, d.shape), bool)
+    out_r, in_r, cold, valid = kernels.swap_round(
+        state, w, state.replica_valid & ~state.replica_offline, hot, ~hot,
+        util, target, accept, ctx.partition_replicas)
+    state, cache = kernels.commit_swaps_cached(state, cache, out_r, in_r,
+                                               cold, valid)
+    assert bool(np.asarray(valid).any())
+    _assert_cache_equal(cache, make_round_cache(state))
