@@ -264,6 +264,14 @@ def make_round_cache(state: ClusterState) -> RoundCache:
 # keeping Load sums consistent).
 # ---------------------------------------------------------------------------
 
+def _scatter_pm(arr: jax.Array, s: jax.Array, d: jax.Array,
+                x: jax.Array) -> jax.Array:
+    """`arr.at[[s;d]].add([-x;+x])` as ONE fused scatter (out-of-bounds
+    rows dropped) — remove `x` at `s`, add it at `d`."""
+    return arr.at[jnp.concatenate([s, d])].add(
+        jnp.concatenate([-x, x]), mode="drop")
+
+
 def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
                            replicas: jax.Array, dest_brokers: jax.Array,
                            valid: jax.Array) -> RoundCache:
@@ -282,52 +290,45 @@ def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
     d = jnp.where(valid, dst, oob_b)
 
     load_r = cache.replica_load[r]                       # f32[K, RES]
-    broker_load = cache.broker_load.at[s].add(-load_r, mode="drop")
-    broker_load = broker_load.at[d].add(load_r, mode="drop")
+    broker_load = _scatter_pm(cache.broker_load, s, d, load_r)
     cap = jnp.maximum(state_before.broker_capacity, 1e-9)
 
     one = valid.astype(jnp.int32)
-    replica_count = cache.replica_count.at[s].add(-one, mode="drop")
-    replica_count = replica_count.at[d].add(one, mode="drop")
+    replica_count = _scatter_pm(cache.replica_count, s, d, one)
 
     lead = (valid & state_before.replica_is_leader[r]).astype(jnp.int32)
-    leader_count = cache.leader_count.at[s].add(-lead, mode="drop")
-    leader_count = leader_count.at[d].add(lead, mode="drop")
+    leader_count = _scatter_pm(cache.leader_count, s, d, lead)
 
     p = state_before.replica_partition[r]
     k = state_before.num_racks
     rack_s = state_before.broker_rack[jnp.minimum(s, num_b - 1)]
     rack_d = state_before.broker_rack[jnp.minimum(d, num_b - 1)]
-    prc = cache.partition_rack_count.reshape(-1)
-    oob_pk = prc.shape[0]
-    prc = prc.at[jnp.where(valid, p * k + rack_s, oob_pk)].add(
-        -1, mode="drop")
-    prc = prc.at[jnp.where(valid, p * k + rack_d, oob_pk)].add(
-        1, mode="drop")
-    prc = prc.reshape(cache.partition_rack_count.shape)
+    prc_flat = cache.partition_rack_count.reshape(-1)
+    oob_pk = prc_flat.shape[0]
+    prc = _scatter_pm(prc_flat,
+                      jnp.where(valid, p * k + rack_s, oob_pk),
+                      jnp.where(valid, p * k + rack_d, oob_pk),
+                      one).reshape(cache.partition_rack_count.shape)
 
     t = state_before.partition_topic[p]
     num_t = state_before.num_topics
-    btc = cache.broker_topic_count.reshape(-1)
-    oob_bt = btc.shape[0]
-    btc = btc.at[jnp.where(valid, src * num_t + t, oob_bt)].add(
-        -1, mode="drop")
-    btc = btc.at[jnp.where(valid, dst * num_t + t, oob_bt)].add(
-        1, mode="drop")
-    btc = btc.reshape(cache.broker_topic_count.shape)
+    btc_flat = cache.broker_topic_count.reshape(-1)
+    oob_bt = btc_flat.shape[0]
+    btc = _scatter_pm(btc_flat,
+                      jnp.where(valid, src * num_t + t, oob_bt),
+                      jnp.where(valid, dst * num_t + t, oob_bt),
+                      one).reshape(cache.broker_topic_count.shape)
 
     # leader-role NW_OUT travels with the replica (potential load)
     bonus = state_before.partition_leader_bonus[p]
     lead_nw = (cache.replica_load[r][:, Resource.NW_OUT]
                + jnp.where(state_before.replica_is_leader[r], 0.0,
-                           bonus[:, Resource.NW_OUT]))
-    pot = cache.potential_nw_out.at[s].add(-lead_nw * valid, mode="drop")
-    pot = pot.at[d].add(lead_nw * valid, mode="drop")
+                           bonus[:, Resource.NW_OUT])) * valid
+    pot = _scatter_pm(cache.potential_nw_out, s, d, lead_nw)
 
     lbi_w = (state_before.replica_base_load[r, Resource.NW_IN]
              * (valid & state_before.replica_is_leader[r]))
-    lbi = cache.leader_bytes_in.at[s].add(-lbi_w, mode="drop")
-    lbi = lbi.at[d].add(lbi_w, mode="drop")
+    lbi = _scatter_pm(cache.leader_bytes_in, s, d, lbi_w)
 
     return RoundCache(
         broker_load=broker_load,
@@ -359,24 +360,23 @@ def update_cache_for_leadership(state_before: ClusterState, cache: RoundCache,
     b_dst = state_before.replica_broker[dr]
     s = jnp.where(valid, b_src, num_b)
     d = jnp.where(valid, b_dst, num_b)
-    broker_load = cache.broker_load.at[s].add(-bonus, mode="drop")
-    broker_load = broker_load.at[d].add(bonus, mode="drop")
+
+    broker_load = _scatter_pm(cache.broker_load, s, d, bonus)
     cap = jnp.maximum(state_before.broker_capacity, 1e-9)
 
-    replica_load = cache.replica_load.at[
-        jnp.where(valid, sr, num_r)].add(-bonus, mode="drop")
-    replica_load = replica_load.at[
-        jnp.where(valid, dr, num_r)].add(bonus, mode="drop")
+    replica_load = _scatter_pm(cache.replica_load,
+                               jnp.where(valid, sr, num_r),
+                               jnp.where(valid, dr, num_r), bonus)
 
     one = valid.astype(jnp.int32)
-    leader_count = cache.leader_count.at[s].add(-one, mode="drop")
-    leader_count = leader_count.at[d].add(one, mode="drop")
+    leader_count = _scatter_pm(cache.leader_count, s, d, one)
 
-    lbi = cache.leader_bytes_in.at[s].add(
-        -state_before.replica_base_load[sr, Resource.NW_IN] * valid,
-        mode="drop")
-    lbi = lbi.at[d].add(
-        state_before.replica_base_load[dr, Resource.NW_IN] * valid,
+    # the DEMOTED leader's base NW_IN leaves its broker; the NEW leader's
+    # (different) base NW_IN arrives — not a symmetric ±x update
+    lbi = cache.leader_bytes_in.at[jnp.concatenate([s, d])].add(
+        jnp.concatenate([
+            -state_before.replica_base_load[sr, Resource.NW_IN] * valid,
+            state_before.replica_base_load[dr, Resource.NW_IN] * valid]),
         mode="drop")
 
     # counts / racks / topics / potential NW_OUT are leadership-invariant

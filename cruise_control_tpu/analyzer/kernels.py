@@ -34,6 +34,12 @@ def per_segment_argmax(score: jax.Array, segment: jax.Array, num_segments: int,
 
     Returns (arg[Bseg] index into `score` (-1 if none), max_score[Bseg],
     has_any[Bseg]).  Deterministic: ties break toward the lowest index.
+
+    Implementation note: a Pallas one-hot-block kernel (segments × replica
+    blocks in VMEM) was benchmarked against this scatter-based form at
+    R=600K/B=2.6K on v5e and lost 3× (22.9ms vs 7.3ms) — the one-hot plane
+    is O(R·B) compute while XLA's scatter path is O(R); keep the segment
+    ops.
     """
     masked = jnp.where(valid, score, NEG)
     seg_max = jax.ops.segment_max(masked, segment, num_segments=num_segments)
@@ -67,27 +73,30 @@ def _dest_feasibility(state: ClusterState, cand_r: jax.Array,
                       dest_ok: jax.Array,
                       accept_matrix_fn: Callable[[jax.Array, jax.Array],
                                                  jax.Array],
-                      partition_replicas: Optional[jax.Array] = None
+                      partition_replicas: Optional[jax.Array] = None,
+                      dest_ids: Optional[jax.Array] = None
                       ) -> jax.Array:
-    """bool[C, B] structural destination feasibility shared by the move
-    kernels: broker-level eligibility, not-the-current-broker, no second
-    replica of the partition on the destination (reference
-    GoalUtils.legitMove), and the composed acceptance stack."""
+    """bool[C, K] structural destination feasibility shared by the move
+    kernels (K = all brokers, or a shortlist via `dest_ids`): broker-level
+    eligibility, not-the-current-broker, no second replica of the partition
+    on the destination (reference GoalUtils.legitMove), and the composed
+    acceptance stack."""
     num_b = state.num_brokers
     rb = state.replica_broker
-    feasible = jnp.broadcast_to(dest_ok[None, :],
-                                (cand_r.shape[0], num_b)).copy()
-    feasible &= (jnp.arange(num_b)[None, :] != rb[cand_r][:, None])
+    if dest_ids is None:
+        dest_ids = jnp.arange(num_b, dtype=jnp.int32)
+    feasible = jnp.broadcast_to(dest_ok[dest_ids][None, :],
+                                (cand_r.shape[0], dest_ids.shape[0])).copy()
+    feasible &= (dest_ids[None, :] != rb[cand_r][:, None])
     if partition_replicas is not None:
         siblings = partition_replicas[state.replica_partition[cand_r]]
         sib_valid = siblings >= 0
         sib_broker = rb[jnp.maximum(siblings, 0)]
         dup = jnp.any(sib_valid[:, :, None]
                       & (sib_broker[:, :, None]
-                         == jnp.arange(num_b)[None, None, :]), axis=1)
+                         == dest_ids[None, None, :]), axis=1)
         feasible &= ~dup
-    feasible &= accept_matrix_fn(cand_r[:, None],
-                                 jnp.arange(num_b, dtype=jnp.int32)[None, :])
+    feasible &= accept_matrix_fn(cand_r[:, None], dest_ids[None, :])
     return feasible
 
 
@@ -194,18 +203,23 @@ def move_round(state: ClusterState,
     cand_r, _, cand_has = per_segment_argmax(score, rb, num_b, eligible)
     cand_r_safe = jnp.maximum(cand_r, 0)
 
-    # --- destination matrix [C, B] ---
     cand_w = w[cand_r_safe]                                    # f32[C]
-    fits = (cand_w[:, None] <= dest_headroom[None, :])
-    feasible = (fits & cand_has[:, None]
-                & _dest_feasibility(state, cand_r_safe, dest_ok,
-                                    accept_matrix_fn, partition_replicas))
-
-    pref = jnp.where(feasible, dest_pref[None, :], NEG)
     gain = cand_w
     if forced is not None:
         gain = gain + jnp.where(forced[cand_r_safe], 1e12, 0.0)
-    cand_dest, cand_valid = assign_destinations(pref, gain, cand_has, num_b)
+
+    def assign_with(dest_ids):
+        # --- destination matrix [C, K] ---
+        fits = (cand_w[:, None] <= dest_headroom[dest_ids][None, :])
+        feasible = (fits & cand_has[:, None]
+                    & _dest_feasibility(state, cand_r_safe, dest_ok,
+                                        accept_matrix_fn, partition_replicas,
+                                        dest_ids))
+        pref = jnp.where(feasible, dest_pref[dest_ids][None, :], NEG)
+        return assign_destinations(pref, gain, cand_has, num_b, dest_ids)
+
+    cand_dest, cand_valid = _assign_with_escalation(
+        assign_with, dest_ok, dest_pref, cand_has, num_b)
     # at most one replica of a partition moves per round: acceptance checks
     # evaluate each action in isolation, so two siblings committing together
     # could land in one rack (or overfill one bound) and re-violate a
@@ -217,6 +231,43 @@ def move_round(state: ClusterState,
 
 
 ASSIGN_PASSES = 8
+
+#: destination-shortlist width: candidate×destination planes are evaluated
+#: against the top-K destinations by preference instead of all B brokers,
+#: bounding the [C, K] matrices at 2.6K-broker scale (10× smaller than
+#: [C, B]).  Preference orders destinations identically for every candidate,
+#: but per-candidate acceptance (multi-resource capacity, sibling blocks)
+#: can reject the whole shortlist while a feasible broker exists outside
+#: it — a round that would commit NOTHING under the shortlist therefore
+#: escalates to the full destination set (_assign_with_escalation), so the
+#: optimization can never falsely converge because of the truncation.
+DEST_SHORTLIST = 256
+
+
+def _dest_shortlist(dest_ok: jax.Array, dest_pref: jax.Array) -> jax.Array:
+    """i32[K] — indices of the top-K eligible destinations by preference."""
+    k = min(DEST_SHORTLIST, dest_ok.shape[0])
+    masked = jnp.where(dest_ok, dest_pref, -jnp.inf)
+    _, idx = jax.lax.top_k(masked, k)
+    return idx.astype(jnp.int32)
+
+
+def _assign_with_escalation(assign_with: Callable[[jax.Array], Tuple[
+        jax.Array, jax.Array]], dest_ok: jax.Array, dest_pref: jax.Array,
+        cand_has: jax.Array, num_b: int) -> Tuple[jax.Array, jax.Array]:
+    """Run `assign_with` on the destination shortlist; if candidates exist
+    but none could be assigned, rerun on the full broker set.  The full
+    branch executes only when taken (lax.cond), so the common rounds stay
+    on the [C, K] plane while starved rounds cannot stall the loop."""
+    dest_ids = _dest_shortlist(dest_ok, dest_pref)
+    cand_dest, cand_valid = assign_with(dest_ids)
+    if dest_ids.shape[0] >= num_b:
+        return cand_dest, cand_valid
+    need_full = jnp.any(cand_has) & ~jnp.any(cand_valid)
+    return jax.lax.cond(
+        need_full,
+        lambda: assign_with(jnp.arange(num_b, dtype=jnp.int32)),
+        lambda: (cand_dest, cand_valid))
 
 
 def _pairwise_jitter(num_c: int, num_b: int) -> jax.Array:
@@ -232,13 +283,17 @@ def _pairwise_jitter(num_c: int, num_b: int) -> jax.Array:
 
 
 def assign_destinations(pref: jax.Array, gain: jax.Array, cand_has: jax.Array,
-                        num_b: int) -> Tuple[jax.Array, jax.Array]:
+                        num_b: int,
+                        dest_ids: Optional[jax.Array] = None
+                        ) -> Tuple[jax.Array, jax.Array]:
     """Assign each candidate a distinct destination broker.
 
-    A single argmax-then-dedup pass throttles a round to ~1 move when all
-    candidates prefer the same least-loaded destination (the sequential
-    reference never hits this: each broker claims its destination before the
-    next looks).  Two measures approximate the sequential greedy order while
+    `pref` is [C, K] over a destination shortlist (`dest_ids` i32[K] maps
+    shortlist slots to broker ids; identity when None).  A single
+    argmax-then-dedup pass throttles a round to ~1 move when all candidates
+    prefer the same least-loaded destination (the sequential reference
+    never hits this: each broker claims its destination before the next
+    looks).  Two measures approximate the sequential greedy order while
     keeping the round one fused device computation:
 
     * candidate-dependent jitter (~1/3 of the preference spread) decorrelates
@@ -247,17 +302,18 @@ def assign_destinations(pref: jax.Array, gain: jax.Array, cand_has: jax.Array,
     * ASSIGN_PASSES unrolled mini-passes let losers claim their next-best
       *unclaimed* destination.
 
-    Returns (dest i32[C], valid bool[C]).
+    Returns (dest i32[C] broker ids, valid bool[C]).
     """
-    C = pref.shape[0]
+    C, K = pref.shape
+    if dest_ids is None:
+        dest_ids = jnp.arange(K, dtype=jnp.int32)
     finite = pref > NEG / 2
     pmax = jnp.max(jnp.where(finite, pref, -jnp.inf))
     pmin = jnp.min(jnp.where(finite, pref, jnp.inf))
     spread = jnp.where(jnp.isfinite(pmax - pmin), pmax - pmin, 0.0)
     amp = 0.35 * spread + 1e-6
-    jittered = jnp.where(finite, pref + amp * _pairwise_jitter(C, num_b), NEG)
+    jittered = jnp.where(finite, pref + amp * _pairwise_jitter(C, K), NEG)
 
-    idx = jnp.arange(C, dtype=jnp.int32)
     taken = jnp.zeros(num_b, dtype=bool)
     assigned = jnp.zeros(C, dtype=bool)
     dest = jnp.zeros(C, dtype=jnp.int32)
@@ -265,9 +321,10 @@ def assign_destinations(pref: jax.Array, gain: jax.Array, cand_has: jax.Array,
         # pass 0 runs un-jittered so an uncontended candidate still gets its
         # true best destination; later passes spread the losers
         pass_pref = pref if k == 0 else jittered
-        open_pref = jnp.where(taken[None, :], NEG, pass_pref)
+        open_pref = jnp.where(taken[dest_ids][None, :], NEG, pass_pref)
         open_pref = jnp.where(assigned[:, None], NEG, open_pref)
-        best = jnp.argmax(open_pref, axis=1).astype(jnp.int32)
+        best_slot = jnp.argmax(open_pref, axis=1)
+        best = dest_ids[best_slot]
         has = cand_has & (jnp.max(open_pref, axis=1) > NEG / 2)
         keep = resolve_dest_conflicts(best, gain, has, num_b)
         dest = jnp.where(keep, best, dest)
@@ -389,13 +446,17 @@ def forced_move_round(state: ClusterState,
     cand_has = forced[cand_r]
 
     fits_w = w[cand_r]
-    feasible = (cand_has[:, None]
-                & _dest_feasibility(state, cand_r, dest_ok, accept_matrix_fn,
-                                    partition_replicas))
 
-    pref = jnp.where(feasible, dest_pref[None, :], NEG)
-    cand_dest, cand_valid = assign_destinations(pref, fits_w, cand_has,
-                                                num_b)
+    def assign_with(dest_ids):
+        feasible = (cand_has[:, None]
+                    & _dest_feasibility(state, cand_r, dest_ok,
+                                        accept_matrix_fn,
+                                        partition_replicas, dest_ids))
+        pref = jnp.where(feasible, dest_pref[dest_ids][None, :], NEG)
+        return assign_destinations(pref, fits_w, cand_has, num_b, dest_ids)
+
+    cand_dest, cand_valid = _assign_with_escalation(
+        assign_with, dest_ok, dest_pref, cand_has, num_b)
     part_of_cand = state.replica_partition[cand_r]
     cand_valid = resolve_dest_conflicts(part_of_cand, fits_w, cand_valid,
                                         state.num_partitions)

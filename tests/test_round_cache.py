@@ -161,3 +161,23 @@ def test_swaps_update_cache(cluster):
                                                cold, valid)
     assert bool(np.asarray(valid).any())
     _assert_cache_equal(cache, make_round_cache(state))
+
+
+def test_dest_shortlist_truncation_and_escalation(monkeypatch):
+    """Exercise the K < B shortlist path: with a tiny shortlist the
+    optimizer must still converge (rounds that would commit nothing under
+    the shortlist escalate to the full broker set) and self-healing must
+    relocate every offline replica."""
+    from cruise_control_tpu.analyzer import kernels
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.testing.verifier import run_and_verify
+
+    monkeypatch.setattr(kernels, "DEST_SHORTLIST", 3)
+    state, topo = random_cluster(RandomClusterSpec(
+        num_brokers=14, num_partitions=160, replication_factor=3,
+        num_racks=4, num_topics=6, seed=11, skew_fraction=0.4,
+        dead_brokers=2))
+    opt = GoalOptimizer(default_goals(max_rounds=32))
+    result = run_and_verify(opt, state, topo)
+    assert result.proposals
