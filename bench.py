@@ -7,8 +7,17 @@ CPU GoalOptimizer.  Prints ONE JSON line:
 `vs_baseline` is target_seconds / measured_seconds (>1 beats the 5 s
 north-star target).
 
-Env knobs: BENCH_BROKERS, BENCH_PARTITIONS, BENCH_RF, BENCH_ROUNDS,
-BENCH_GOALS (comma list), BENCH_SKIP_WARMUP.
+BENCH_CONFIG selects a BASELINE.json eval config:
+  north (default)  2600b/200Kp, full default goal stack
+  1                3-broker/30-partition deterministic fixture
+  2                200b/20Kp, resource-distribution goals only
+  3                1000b/80Kp, full hard+soft stack
+  4                2600b/200Kp add-broker + remove-broker operations
+  5                2600b JBOD (4 logdirs/broker, broken disks) with
+                   DiskUsageDistributionGoal + offline-replica self-healing
+
+Other knobs: BENCH_BROKERS, BENCH_PARTITIONS, BENCH_RF, BENCH_ROUNDS,
+BENCH_GOALS (comma list), BENCH_SEGMENT, BENCH_SKIP_WARMUP.
 """
 import json
 import os
@@ -25,53 +34,97 @@ os.environ.setdefault(
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 
+def _build(config, num_b, num_p, rf, seed=4):
+    from cruise_control_tpu.testing.fixtures import small_cluster
+    from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
+                                                           random_cluster)
+    if config == "1":
+        return small_cluster()
+    kwargs = {}
+    if config == "4":
+        kwargs["new_brokers"] = max(1, num_b // 20)
+    if config == "5":
+        kwargs.update(jbod_disks=4, dead_disks=max(1, num_b // 50))
+    return random_cluster(RandomClusterSpec(
+        num_brokers=num_b, num_partitions=num_p, replication_factor=rf,
+        num_racks=max(8, num_b // 100), num_topics=max(8, num_p // 2000),
+        seed=seed, skew_fraction=0.2, **kwargs))
+
+
 def main() -> None:
     t_import = time.time()
     import jax
-    import numpy as np
 
-    from cruise_control_tpu.analyzer.context import OptimizationOptions
     from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.context import OptimizationOptions
     from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
-    from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
-                                                           random_cluster)
+    from cruise_control_tpu.model import state as S
 
-    num_b = int(os.environ.get("BENCH_BROKERS", 2600))
-    num_p = int(os.environ.get("BENCH_PARTITIONS", 200_000))
+    config = os.environ.get("BENCH_CONFIG", "north")
+    presets = {
+        "north": (2600, 200_000, None),
+        "1": (3, 30, None),
+        "2": (200, 20_000, ["DiskUsageDistributionGoal",
+                            "NetworkInboundUsageDistributionGoal",
+                            "NetworkOutboundUsageDistributionGoal",
+                            "CpuUsageDistributionGoal"]),
+        "3": (1000, 80_000, None),
+        "4": (2600, 200_000, None),
+        "5": (2600, 200_000, ["DiskCapacityGoal",
+                              "DiskUsageDistributionGoal"]),
+    }
+    d_b, d_p, d_goals = presets[config]
+    num_b = int(os.environ.get("BENCH_BROKERS", d_b))
+    num_p = int(os.environ.get("BENCH_PARTITIONS", d_p))
     rf = int(os.environ.get("BENCH_RF", 3))
     rounds = int(os.environ.get("BENCH_ROUNDS", 128))
     goal_names = os.environ.get("BENCH_GOALS")
-    names = goal_names.split(",") if goal_names else None
+    names = goal_names.split(",") if goal_names else d_goals
 
     backend = jax.devices()[0].platform
-    print(f"# backend={backend} devices={jax.devices()} "
+    print(f"# config={config} backend={backend} devices={jax.devices()} "
           f"(import+init {time.time()-t_import:.1f}s)", file=sys.stderr)
 
     t0 = time.time()
-    state, topo = random_cluster(RandomClusterSpec(
-        num_brokers=num_b, num_partitions=num_p, replication_factor=rf,
-        num_racks=max(8, num_b // 100), num_topics=max(8, num_p // 2000),
-        seed=4, skew_fraction=0.2))
-    print(f"# model built: B={num_b} P={num_p} R={num_p*rf} "
-          f"({time.time()-t0:.1f}s)", file=sys.stderr)
+    state, topo = _build(config, num_b, num_p, rf)
+    print(f"# model built: B={state.num_brokers} P={state.num_partitions} "
+          f"R={state.num_replicas} ({time.time()-t0:.1f}s)", file=sys.stderr)
 
     goals = default_goals(max_rounds=rounds, names=names)
     segment = int(os.environ.get("BENCH_SEGMENT", 2))
     optimizer = GoalOptimizer(goals, pipeline_segment_size=segment)
+
+    def run_once(st, topo, options):
+        return optimizer.optimizations(st, topo, options, check_sanity=False)
+
+    def run_config(st, topo):
+        """One measured pass; config 4 chains add-broker then
+        remove-broker (drain via self-healing) operations."""
+        results = []
+        if config == "4":
+            # add-broker: rebalance onto the empty new brokers only
+            results.append(run_once(st, topo, OptimizationOptions()))
+            # remove-broker: kill 1% of brokers, drain via self-healing
+            drained = results[-1].final_state
+            kill = list(range(0, st.num_brokers, 100))
+            for b in kill:
+                drained = S.set_broker_state(drained, b, alive=False)
+            results.append(run_once(drained, topo, OptimizationOptions()))
+        else:
+            results.append(run_once(st, topo, OptimizationOptions()))
+        return results
 
     def run_with_retry(tag):
         # the remote-compile/device transport can drop long requests;
         # compiled segments persist, so a retry resumes where it failed
         for attempt in range(4):
             try:
-                return optimizer.optimizations(
-                    state, topo, OptimizationOptions(), check_sanity=False)
+                return run_config(state, topo)
             except jax.errors.JaxRuntimeError as exc:
                 print(f"# {tag} attempt {attempt} hit transport error: "
                       f"{str(exc).splitlines()[0][:120]}", file=sys.stderr)
                 time.sleep(10.0)
-        return optimizer.optimizations(state, topo, OptimizationOptions(),
-                                       check_sanity=False)
+        return run_config(state, topo)
 
     # warm-up run compiles every goal kernel for these shapes; the measured
     # run reuses the compile cache (the JVM reference likewise amortizes
@@ -82,18 +135,24 @@ def main() -> None:
         print(f"# warmup (compile) {time.time()-t0:.1f}s", file=sys.stderr)
 
     t0 = time.time()
-    result = optimizer.optimizations(state, topo, OptimizationOptions(),
-                                     check_sanity=False)
+    results = run_config(state, topo)
     elapsed = time.time() - t0
 
-    print(f"# proposals={len(result.proposals)} "
-          f"replica_moves={result.num_replica_movements} "
-          f"violated_after={len(result.violated_goals_after)} "
-          f"balancedness={result.balancedness_score():.1f}",
+    total_props = sum(len(r.proposals) for r in results)
+    print(f"# proposals={total_props} "
+          f"replica_moves={sum(r.num_replica_movements for r in results)} "
+          f"violated_after={len(results[-1].violated_goals_after)} "
+          f"balancedness={results[-1].balancedness_score():.1f}",
           file=sys.stderr)
+    label = {"north": "full-stack proposal generation",
+             "1": "deterministic fixture",
+             "2": "resource-distribution goals",
+             "3": "full-stack proposal generation",
+             "4": "add-broker + remove-broker",
+             "5": "JBOD self-healing + disk distribution"}[config]
     print(json.dumps({
-        "metric": (f"full-stack proposal generation "
-                   f"{num_b}b/{num_p//1000}Kp rf{rf} [{backend}]"),
+        "metric": (f"{label} {state.num_brokers}b/"
+                   f"{state.num_partitions/1000:g}Kp rf{rf} [{backend}]"),
         "value": round(elapsed, 3),
         "unit": "s",
         "vs_baseline": round(TARGET_SECONDS / elapsed, 3),

@@ -60,41 +60,56 @@ class ReplicaDistributionGoal(Goal):
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
         def round_body(st: ClusterState, cache):
-            counts = self._counts(cache)
-            avg = self._avg(st, counts)
+            avg = self._avg(st, self._counts(cache))
             lower, upper = _count_bounds(avg, self.pct_margin)
-            w = self._weights(st)
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline
-                       & (w > 0.0))
-            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
             dest_ok = new_broker_dest_mask(
                 st, ctx.broker_dest_ok & st.broker_alive)
             committed = jnp.zeros((), dtype=bool)
+            no_op = lambda s, c: (s, c, jnp.zeros((), dtype=bool))
 
-            # shed from over-upper brokers
-            cand_r, cand_d, cand_v = kernels.move_round(
-                st, w, counts > upper, counts - upper, movable,
-                dest_ok & (counts + 1 <= upper), upper - counts, accept,
-                -counts, ctx.partition_replicas)
-            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
-                                                    cand_d, cand_v)
-            committed |= jnp.any(cand_v)
+            # shed from over-upper brokers (gated: skipped when converged)
+            def phase_shed(st, cache):
+                counts = self._counts(cache)
+                w = self._weights(st)
+                movable = (st.replica_valid & ~ctx.replica_excluded
+                           & ctx.replica_movable & ~st.replica_offline
+                           & (w > 0.0))
+                accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+                cand_r, cand_d, cand_v = kernels.move_round(
+                    st, w, counts > upper, counts - upper, movable,
+                    dest_ok & (counts + 1 <= upper), upper - counts, accept,
+                    -counts, ctx.partition_replicas)
+                st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                        cand_d, cand_v)
+                return st, cache, jnp.any(cand_v)
+
+            any_over = jnp.any(st.broker_alive
+                               & (self._counts(cache) > upper))
+            st, cache, cs = jax.lax.cond(any_over, phase_shed, no_op,
+                                         st, cache)
+            committed |= cs
 
             # fill under-lower brokers
-            counts = self._counts(cache)
-            w = self._weights(st)
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline
-                       & (w > 0.0))
-            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
-            cand_r, cand_d, cand_v = kernels.move_round(
-                st, w, counts > avg, counts - lower, movable,
-                dest_ok & (counts < lower), upper - counts, accept,
-                -counts, ctx.partition_replicas, strict_allowance=True)
-            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
-                                                    cand_d, cand_v)
-            committed |= jnp.any(cand_v)
+            def phase_fill(st, cache):
+                counts = self._counts(cache)
+                w = self._weights(st)
+                movable = (st.replica_valid & ~ctx.replica_excluded
+                           & ctx.replica_movable & ~st.replica_offline
+                           & (w > 0.0))
+                accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+                cand_r, cand_d, cand_v = kernels.move_round(
+                    st, w, counts > avg, counts - lower, movable,
+                    dest_ok & (counts < lower), upper - counts, accept,
+                    -counts, ctx.partition_replicas, strict_allowance=True)
+                st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                        cand_d, cand_v)
+                return st, cache, jnp.any(cand_v)
+
+            any_under = jnp.any(st.broker_alive & dest_ok
+                                & (self._counts(cache) < lower))
+            st, cache, cf = jax.lax.cond(any_under, phase_fill, no_op,
+                                         st, cache)
+            committed |= cf
             return st, cache, committed
 
         def cond(carry):

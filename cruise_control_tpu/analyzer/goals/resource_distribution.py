@@ -71,9 +71,15 @@ class ResourceDistributionGoal(Goal):
         def round_body(st: ClusterState, cache):
             committed = jnp.zeros((), dtype=bool)
             lower, upper = self._bounds(st, ctx)   # capacity-only: static
+            no_op = lambda s, c: (s, c, jnp.zeros((), dtype=bool))
+
+            # Each phase runs under lax.cond gated on whether it has any
+            # work: a typical late round has only one active phase, and a
+            # skipped phase costs one [B] reduction instead of its O(R)
+            # candidate search.
 
             # ---------- phase A: leadership shed (NW_OUT / CPU) ----------
-            if self._leadership_applicable():
+            def phase_a(st, cache):
                 W = cache.broker_load[:, res]
                 bonus = (st.partition_leader_bonus[st.replica_partition, res]
                          * st.replica_valid)
@@ -98,41 +104,64 @@ class ResourceDistributionGoal(Goal):
                     ctx.partition_replicas)
                 st, cache = kernels.commit_leadership_cached(
                     st, cache, cand_r, cand_f, cand_v)
-                committed |= jnp.any(cand_v)
+                return st, cache, jnp.any(cand_v)
+
+            if self._leadership_applicable():
+                any_over = jnp.any(st.broker_alive
+                                   & (cache.broker_load[:, res] > upper))
+                st, cache, ca = jax.lax.cond(any_over, phase_a, no_op,
+                                             st, cache)
+                committed |= ca
 
             # ---------- phase B: shed replicas off over-upper brokers ----
-            W = cache.broker_load[:, res]
-            w = cache.replica_load[:, res]
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline
-                       & (w > 0.0))
-            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
-            dest_pref = -W / jnp.maximum(st.broker_capacity[:, res], 1e-9)
-            cand_r, cand_d, cand_v = kernels.move_round(
-                st, w, W > upper, W - upper, movable,
-                self._dest_mask(st, ctx), upper - W, accept,
-                dest_pref, ctx.partition_replicas)
-            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
-                                                    cand_d, cand_v)
-            committed |= jnp.any(cand_v)
+            def phase_b(st, cache):
+                W = cache.broker_load[:, res]
+                w = cache.replica_load[:, res]
+                movable = (st.replica_valid & ~ctx.replica_excluded
+                           & ctx.replica_movable & ~st.replica_offline
+                           & (w > 0.0))
+                accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+                dest_pref = -W / jnp.maximum(st.broker_capacity[:, res],
+                                             1e-9)
+                cand_r, cand_d, cand_v = kernels.move_round(
+                    st, w, W > upper, W - upper, movable,
+                    self._dest_mask(st, ctx), upper - W, accept,
+                    dest_pref, ctx.partition_replicas)
+                st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                        cand_d, cand_v)
+                return st, cache, jnp.any(cand_v)
+
+            any_over = jnp.any(st.broker_alive
+                               & (cache.broker_load[:, res] > upper))
+            st, cache, cb = jax.lax.cond(any_over, phase_b, no_op, st, cache)
+            committed |= cb
 
             # ---------- phase C: fill under-lower brokers ----------------
-            W = cache.broker_load[:, res]
-            w = cache.replica_load[:, res]
-            avg_w = (ctx.balance_upper_pct[res] + ctx.balance_lower_pct[res]) \
-                / 2.0 * st.broker_capacity[:, res]
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline
-                       & (w > 0.0))
-            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
-            under = (W < lower) & self._dest_mask(st, ctx)
-            cand_r, cand_d, cand_v = kernels.move_round(
-                st, w, W > avg_w, W - lower, movable, under, upper - W,
-                accept, -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
-                ctx.partition_replicas, strict_allowance=True)
-            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
-                                                    cand_d, cand_v)
-            committed |= jnp.any(cand_v)
+            def phase_c(st, cache):
+                W = cache.broker_load[:, res]
+                w = cache.replica_load[:, res]
+                avg_w = (ctx.balance_upper_pct[res]
+                         + ctx.balance_lower_pct[res]) \
+                    / 2.0 * st.broker_capacity[:, res]
+                movable = (st.replica_valid & ~ctx.replica_excluded
+                           & ctx.replica_movable & ~st.replica_offline
+                           & (w > 0.0))
+                accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+                under = (W < lower) & self._dest_mask(st, ctx)
+                cand_r, cand_d, cand_v = kernels.move_round(
+                    st, w, W > avg_w, W - lower, movable, under, upper - W,
+                    accept,
+                    -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
+                    ctx.partition_replicas, strict_allowance=True)
+                st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                        cand_d, cand_v)
+                return st, cache, jnp.any(cand_v)
+
+            any_under = jnp.any(st.broker_alive & ctx.broker_dest_ok
+                                & (cache.broker_load[:, res] < lower))
+            st, cache, cc = jax.lax.cond(any_under, phase_c, no_op,
+                                         st, cache)
+            committed |= cc
             return st, cache, committed
 
         def cond(carry):

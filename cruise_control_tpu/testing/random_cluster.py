@@ -44,6 +44,13 @@ class RandomClusterSpec:
     skew_brokers: int = 0  # 0 → num_brokers // 20 + 1
     dead_brokers: int = 0
     new_brokers: int = 0   # brokers appended empty (add-broker scenario)
+    #: JBOD: logdirs per broker (0 → no disk axis); replicas land on a
+    #: random logdir, disk capacity splits the broker DISK capacity evenly
+    jbod_disks: int = 0
+    #: broken logdirs (first N disks of alive brokers): their replicas go
+    #: offline and the broker loses that logdir's capacity — the
+    #: self-healing + bad-disks scenario (BASELINE eval config 5)
+    dead_disks: int = 0
 
 
 def _distinct_brokers(rng: np.random.Generator, num_p: int, rf: int,
@@ -137,11 +144,40 @@ def random_cluster(spec: RandomClusterSpec
 
     offline = ~alive[r_broker]
 
+    # ---- JBOD disk axis ----
+    bad_disks = np.zeros(num_b, dtype=bool)
+    disk_names = []
+    if spec.jbod_disks:
+        jd = spec.jbod_disks
+        num_d = num_b * jd
+        disk_broker = np.repeat(np.arange(num_b, dtype=np.int32), jd)
+        disk_capacity = np.repeat(capacity[:, Resource.DISK] / jd, jd
+                                  ).astype(np.float32)
+        disk_alive_arr = np.ones(num_d, dtype=bool)
+        r_disk = (r_broker * jd
+                  + rng.integers(0, jd, size=num_r)).astype(np.int32)
+        if spec.dead_disks:
+            alive_broker_disks = np.nonzero(alive[disk_broker])[0]
+            broken = alive_broker_disks[:spec.dead_disks]
+            disk_alive_arr[broken] = False
+            offline = offline | ~disk_alive_arr[r_disk]
+            bad_disks[disk_broker[broken]] = True
+            # broker DISK capacity = sum of alive logdirs (builder contract)
+            capacity[disk_broker[broken], Resource.DISK] -= \
+                disk_capacity[broken]
+        disk_names = [(int(disk_broker[d]), f"/d{d % jd}")
+                      for d in range(num_d)]
+    else:
+        disk_broker = np.zeros(1, dtype=np.int32)
+        disk_capacity = np.zeros(1, dtype=np.float32)
+        disk_alive_arr = np.ones(1, dtype=bool)
+        r_disk = np.full(num_r, -1, dtype=np.int32)
+
     state = ClusterState(
         replica_valid=jnp.ones(num_r, dtype=bool),
         replica_partition=jnp.asarray(r_part),
         replica_broker=jnp.asarray(r_broker),
-        replica_disk=jnp.full(num_r, -1, dtype=jnp.int32),
+        replica_disk=jnp.asarray(r_disk),
         replica_is_leader=jnp.asarray(r_leader),
         replica_offline=jnp.asarray(offline),
         replica_original_offline=jnp.asarray(offline),
@@ -151,13 +187,13 @@ def random_cluster(spec: RandomClusterSpec
         broker_alive=jnp.asarray(alive),
         broker_new=jnp.asarray(new),
         broker_demoted=jnp.zeros(num_b, dtype=bool),
-        broker_bad_disks=jnp.zeros(num_b, dtype=bool),
+        broker_bad_disks=jnp.asarray(bad_disks),
         broker_capacity=jnp.asarray(capacity),
         broker_rack=jnp.asarray(rack_of_broker),
         broker_host=jnp.asarray(host_of_broker),
-        disk_broker=jnp.zeros(1, dtype=jnp.int32),
-        disk_capacity=jnp.zeros(1, dtype=jnp.float32),
-        disk_alive=jnp.ones(1, dtype=bool),
+        disk_broker=jnp.asarray(disk_broker),
+        disk_capacity=jnp.asarray(disk_capacity),
+        disk_alive=jnp.asarray(disk_alive_arr),
         num_racks=spec.num_racks,
         num_hosts=num_b,
         num_topics=spec.num_topics,
@@ -169,6 +205,6 @@ def random_cluster(spec: RandomClusterSpec
         topics=[f"topic-{t}" for t in range(spec.num_topics)],
         partitions=[PartitionId(f"topic-{topic_of_p[p]}", p)
                     for p in range(num_p)],
-        disk_names=[],
+        disk_names=disk_names,
     )
     return state, topology

@@ -175,3 +175,22 @@ def test_registry_completeness():
         assert goal.name == name
     with pytest.raises(KeyError):
         make_goal("NoSuchGoal")
+
+
+def test_jbod_random_cluster_self_healing():
+    """BASELINE eval config 5 shape: JBOD logdirs with broken disks; the
+    stack must bring every offline replica back online within capacity
+    (reference: capacityJBOD.json + fix-offline-replicas flow)."""
+    spec = RandomClusterSpec(num_brokers=12, num_partitions=120,
+                             replication_factor=3, num_racks=4,
+                             num_topics=5, seed=13, jbod_disks=3,
+                             dead_disks=4)
+    state, topo = random_cluster(spec)
+    import numpy as np
+    from cruise_control_tpu.model import state as S
+    assert int(np.asarray(S.self_healing_eligible(state)).sum()) > 0
+    opt = GoalOptimizer(default_goals(
+        max_rounds=32, names=["DiskCapacityGoal",
+                              "DiskUsageDistributionGoal"]))
+    result = run_and_verify(opt, state, topo)
+    assert result.proposals
