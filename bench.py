@@ -61,19 +61,24 @@ def main() -> None:
     from cruise_control_tpu.model import state as S
 
     config = os.environ.get("BENCH_CONFIG", "north")
-    presets = {
-        "north": (2600, 200_000, None),
-        "1": (3, 30, None),
+    presets = {  # (brokers, partitions, goal subset, metric label)
+        "north": (2600, 200_000, None, "full-stack proposal generation"),
+        "1": (3, 30, None, "deterministic fixture"),
         "2": (200, 20_000, ["DiskUsageDistributionGoal",
                             "NetworkInboundUsageDistributionGoal",
                             "NetworkOutboundUsageDistributionGoal",
-                            "CpuUsageDistributionGoal"]),
-        "3": (1000, 80_000, None),
-        "4": (2600, 200_000, None),
+                            "CpuUsageDistributionGoal"],
+              "resource-distribution goals"),
+        "3": (1000, 80_000, None, "full-stack proposal generation"),
+        "4": (2600, 200_000, None, "add-broker + remove-broker"),
         "5": (2600, 200_000, ["DiskCapacityGoal",
-                              "DiskUsageDistributionGoal"]),
+                              "DiskUsageDistributionGoal"],
+              "JBOD self-healing + disk distribution"),
     }
-    d_b, d_p, d_goals = presets[config]
+    if config not in presets:
+        sys.exit(f"unknown BENCH_CONFIG={config!r}; "
+                 f"valid: {sorted(presets)}")
+    d_b, d_p, d_goals, label = presets[config]
     num_b = int(os.environ.get("BENCH_BROKERS", d_b))
     num_p = int(os.environ.get("BENCH_PARTITIONS", d_p))
     rf = int(os.environ.get("BENCH_RF", 3))
@@ -144,12 +149,6 @@ def main() -> None:
           f"violated_after={len(results[-1].violated_goals_after)} "
           f"balancedness={results[-1].balancedness_score():.1f}",
           file=sys.stderr)
-    label = {"north": "full-stack proposal generation",
-             "1": "deterministic fixture",
-             "2": "resource-distribution goals",
-             "3": "full-stack proposal generation",
-             "4": "add-broker + remove-broker",
-             "5": "JBOD self-healing + disk distribution"}[config]
     print(json.dumps({
         "metric": (f"{label} {state.num_brokers}b/"
                    f"{state.num_partitions/1000:g}Kp rf{rf} [{backend}]"),

@@ -21,7 +21,7 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  make_round_cache)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
-    new_broker_dest_mask)
+    new_broker_dest_mask, run_phase_sweeps)
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
 
@@ -59,72 +59,56 @@ class ReplicaDistributionGoal(Goal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
-        def round_body(st: ClusterState, cache):
-            avg = self._avg(st, self._counts(cache))
-            lower, upper = _count_bounds(avg, self.pct_margin)
-            dest_ok = new_broker_dest_mask(
-                st, ctx.broker_dest_ok & st.broker_alive)
-            committed = jnp.zeros((), dtype=bool)
-            no_op = lambda s, c: (s, c, jnp.zeros((), dtype=bool))
+        # bounds pivot on the alive-broker average replica count, which is
+        # invariant under moves (total count and alive set are fixed), so
+        # it is computed once; shed and fill run as progress-gated
+        # sub-loops (see base.run_phase_sweeps)
+        counts0 = S.broker_replica_count(state).astype(jnp.float32)
+        avg = self._avg(state, counts0)
+        lower, upper = _count_bounds(avg, self.pct_margin)
+        dest_ok = new_broker_dest_mask(
+            state, ctx.broker_dest_ok & state.broker_alive)
 
-            # shed from over-upper brokers (gated: skipped when converged)
-            def phase_shed(st, cache):
-                counts = self._counts(cache)
-                w = self._weights(st)
-                movable = (st.replica_valid & ~ctx.replica_excluded
-                           & ctx.replica_movable & ~st.replica_offline
-                           & (w > 0.0))
-                accept = compose_move_acceptance(prev_goals, st, ctx, cache)
-                cand_r, cand_d, cand_v = kernels.move_round(
-                    st, w, counts > upper, counts - upper, movable,
-                    dest_ok & (counts + 1 <= upper), upper - counts, accept,
-                    -counts, ctx.partition_replicas)
-                st, cache = kernels.commit_moves_cached(st, cache, cand_r,
-                                                        cand_d, cand_v)
-                return st, cache, jnp.any(cand_v)
+        def phase_shed(st, cache):
+            counts = self._counts(cache)
+            w = self._weights(st)
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & (w > 0.0))
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, counts > upper, counts - upper, movable,
+                dest_ok & (counts + 1 <= upper), upper - counts, accept,
+                -counts, ctx.partition_replicas)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
+            return st, cache, jnp.any(cand_v)
 
-            any_over = jnp.any(st.broker_alive
-                               & (self._counts(cache) > upper))
-            st, cache, cs = jax.lax.cond(any_over, phase_shed, no_op,
-                                         st, cache)
-            committed |= cs
+        def phase_fill(st, cache):
+            counts = self._counts(cache)
+            w = self._weights(st)
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & (w > 0.0))
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, counts > avg, counts - lower, movable,
+                dest_ok & (counts < lower), upper - counts, accept,
+                -counts, ctx.partition_replicas, strict_allowance=True)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
+            return st, cache, jnp.any(cand_v)
 
-            # fill under-lower brokers
-            def phase_fill(st, cache):
-                counts = self._counts(cache)
-                w = self._weights(st)
-                movable = (st.replica_valid & ~ctx.replica_excluded
-                           & ctx.replica_movable & ~st.replica_offline
-                           & (w > 0.0))
-                accept = compose_move_acceptance(prev_goals, st, ctx, cache)
-                cand_r, cand_d, cand_v = kernels.move_round(
-                    st, w, counts > avg, counts - lower, movable,
-                    dest_ok & (counts < lower), upper - counts, accept,
-                    -counts, ctx.partition_replicas, strict_allowance=True)
-                st, cache = kernels.commit_moves_cached(st, cache, cand_r,
-                                                        cand_d, cand_v)
-                return st, cache, jnp.any(cand_v)
+        def over_exists(st, cache):
+            return jnp.any(st.broker_alive & (self._counts(cache) > upper))
 
-            any_under = jnp.any(st.broker_alive & dest_ok
-                                & (self._counts(cache) < lower))
-            st, cache, cf = jax.lax.cond(any_under, phase_fill, no_op,
-                                         st, cache)
-            committed |= cf
-            return st, cache, committed
+        def under_exists(st, cache):
+            return jnp.any(st.broker_alive & dest_ok
+                           & (self._counts(cache) < lower))
 
-        def cond(carry):
-            _, _, rounds, progressed = carry
-            return progressed & (rounds < self.max_rounds)
-
-        def body(carry):
-            st, cache, rounds, _ = carry
-            st, cache, committed = round_body(st, cache)
-            return st, cache, rounds + 1, committed
-
-        state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state),
-                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
-        return state
+        return run_phase_sweeps(
+            state, [(phase_shed, over_exists), (phase_fill, under_exists)],
+            self.max_rounds)
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         counts = self._counts(cache)

@@ -27,7 +27,7 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  make_round_cache)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
-    new_broker_dest_mask)
+    new_broker_dest_mask, run_phase_sweeps)
 from cruise_control_tpu.common.resources import (RESOURCE_GOAL_NAMES,
                                                  Resource)
 from cruise_control_tpu.model import state as S
@@ -66,116 +66,95 @@ class ResourceDistributionGoal(Goal):
     # -- optimization ------------------------------------------------------
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
+        """Phases run as separate progress-gated sub-loops inside an outer
+        sweep loop (shed leadership until dry, then shed replicas, then
+        fill; repeat while anything moved).  An inactive phase costs one
+        [B]-sized while-condition instead of its O(R) candidate search —
+        and unlike lax.cond gating of a combined round (measured: ~12%
+        SLOWER at 2.6K brokers), sub-loops add no branch-carry copies."""
         res = int(self.resource)
+        lower, upper = self._bounds(state, ctx)    # capacity-only: static
 
-        def round_body(st: ClusterState, cache):
-            committed = jnp.zeros((), dtype=bool)
-            lower, upper = self._bounds(st, ctx)   # capacity-only: static
-            no_op = lambda s, c: (s, c, jnp.zeros((), dtype=bool))
+        def phase_a(st, cache):
+            W = cache.broker_load[:, res]
+            bonus = (st.partition_leader_bonus[st.replica_partition, res]
+                     * st.replica_valid)
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline)
+            accept = compose_leadership_acceptance(prev_goals, st, ctx,
+                                                   cache)
 
-            # Each phase runs under lax.cond gated on whether it has any
-            # work: a typical late round has only one active phase, and a
-            # skipped phase costs one [B] reduction instead of its O(R)
-            # candidate search.
+            def self_accept(src_r, dst_r):
+                db = st.replica_broker[dst_r]
+                return (W[db] + bonus[jnp.broadcast_to(
+                    src_r, jnp.broadcast_shapes(src_r.shape, dst_r.shape))]
+                    <= upper[db])
 
-            # ---------- phase A: leadership shed (NW_OUT / CPU) ----------
-            def phase_a(st, cache):
-                W = cache.broker_load[:, res]
-                bonus = (st.partition_leader_bonus[st.replica_partition, res]
-                         * st.replica_valid)
-                movable = (st.replica_valid & ~ctx.replica_excluded
-                           & ctx.replica_movable & ~st.replica_offline)
-                accept = compose_leadership_acceptance(prev_goals, st, ctx,
-                                                       cache)
+            def accept_all(src_r, dst_r):
+                return accept(src_r, dst_r) & self_accept(src_r, dst_r)
 
-                def self_accept(src_r, dst_r):
-                    db = st.replica_broker[dst_r]
-                    return (W[db] + bonus[jnp.broadcast_to(
-                        src_r, jnp.broadcast_shapes(src_r.shape, dst_r.shape))]
-                        <= upper[db])
+            cand_r, cand_f, cand_v = kernels.leadership_round(
+                st, bonus, W - upper, movable, ctx.broker_leader_ok,
+                upper - W, accept_all,
+                -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
+                ctx.partition_replicas)
+            st, cache = kernels.commit_leadership_cached(
+                st, cache, cand_r, cand_f, cand_v)
+            return st, cache, jnp.any(cand_v)
 
-                def accept_all(src_r, dst_r):
-                    return accept(src_r, dst_r) & self_accept(src_r, dst_r)
+        def phase_b(st, cache):
+            W = cache.broker_load[:, res]
+            w = cache.replica_load[:, res]
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & (w > 0.0))
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            dest_pref = -W / jnp.maximum(st.broker_capacity[:, res], 1e-9)
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, W > upper, W - upper, movable,
+                self._dest_mask(st, ctx), upper - W, accept,
+                dest_pref, ctx.partition_replicas)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
+            return st, cache, jnp.any(cand_v)
 
-                cand_r, cand_f, cand_v = kernels.leadership_round(
-                    st, bonus, W - upper, movable, ctx.broker_leader_ok,
-                    upper - W, accept_all,
-                    -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
-                    ctx.partition_replicas)
-                st, cache = kernels.commit_leadership_cached(
-                    st, cache, cand_r, cand_f, cand_v)
-                return st, cache, jnp.any(cand_v)
+        def phase_c(st, cache):
+            W = cache.broker_load[:, res]
+            w = cache.replica_load[:, res]
+            avg_w = (ctx.balance_upper_pct[res]
+                     + ctx.balance_lower_pct[res]) \
+                / 2.0 * st.broker_capacity[:, res]
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & (w > 0.0))
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            under = (W < lower) & self._dest_mask(st, ctx)
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, W > avg_w, W - lower, movable, under, upper - W,
+                accept,
+                -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
+                ctx.partition_replicas, strict_allowance=True)
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
+            return st, cache, jnp.any(cand_v)
 
-            if self._leadership_applicable():
-                any_over = jnp.any(st.broker_alive
-                                   & (cache.broker_load[:, res] > upper))
-                st, cache, ca = jax.lax.cond(any_over, phase_a, no_op,
-                                             st, cache)
-                committed |= ca
+        def over_exists(st, cache):
+            return jnp.any(st.broker_alive
+                           & (cache.broker_load[:, res] > upper))
 
-            # ---------- phase B: shed replicas off over-upper brokers ----
-            def phase_b(st, cache):
-                W = cache.broker_load[:, res]
-                w = cache.replica_load[:, res]
-                movable = (st.replica_valid & ~ctx.replica_excluded
-                           & ctx.replica_movable & ~st.replica_offline
-                           & (w > 0.0))
-                accept = compose_move_acceptance(prev_goals, st, ctx, cache)
-                dest_pref = -W / jnp.maximum(st.broker_capacity[:, res],
-                                             1e-9)
-                cand_r, cand_d, cand_v = kernels.move_round(
-                    st, w, W > upper, W - upper, movable,
-                    self._dest_mask(st, ctx), upper - W, accept,
-                    dest_pref, ctx.partition_replicas)
-                st, cache = kernels.commit_moves_cached(st, cache, cand_r,
-                                                        cand_d, cand_v)
-                return st, cache, jnp.any(cand_v)
+        def under_exists(st, cache):
+            # must match phase_c's destination mask (new-broker-restricted)
+            # or the predicate keeps triggering full searches that cannot
+            # commit anything
+            return jnp.any(self._dest_mask(st, ctx)
+                           & (cache.broker_load[:, res] < lower))
 
-            any_over = jnp.any(st.broker_alive
-                               & (cache.broker_load[:, res] > upper))
-            st, cache, cb = jax.lax.cond(any_over, phase_b, no_op, st, cache)
-            committed |= cb
-
-            # ---------- phase C: fill under-lower brokers ----------------
-            def phase_c(st, cache):
-                W = cache.broker_load[:, res]
-                w = cache.replica_load[:, res]
-                avg_w = (ctx.balance_upper_pct[res]
-                         + ctx.balance_lower_pct[res]) \
-                    / 2.0 * st.broker_capacity[:, res]
-                movable = (st.replica_valid & ~ctx.replica_excluded
-                           & ctx.replica_movable & ~st.replica_offline
-                           & (w > 0.0))
-                accept = compose_move_acceptance(prev_goals, st, ctx, cache)
-                under = (W < lower) & self._dest_mask(st, ctx)
-                cand_r, cand_d, cand_v = kernels.move_round(
-                    st, w, W > avg_w, W - lower, movable, under, upper - W,
-                    accept,
-                    -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
-                    ctx.partition_replicas, strict_allowance=True)
-                st, cache = kernels.commit_moves_cached(st, cache, cand_r,
-                                                        cand_d, cand_v)
-                return st, cache, jnp.any(cand_v)
-
-            any_under = jnp.any(st.broker_alive & ctx.broker_dest_ok
-                                & (cache.broker_load[:, res] < lower))
-            st, cache, cc = jax.lax.cond(any_under, phase_c, no_op,
-                                         st, cache)
-            committed |= cc
-            return st, cache, committed
-
-        def cond(carry):
-            _, _, rounds, progressed = carry
-            return progressed & (rounds < self.max_rounds)
-
-        def body(carry):
-            st, cache, rounds, _ = carry
-            st, cache, committed = round_body(st, cache)
-            return st, cache, rounds + 1, committed
-
-        state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state),
-                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
+        phases = []
+        if self._leadership_applicable():
+            phases.append((phase_a, over_exists))
+        phases.append((phase_b, over_exists))
+        phases.append((phase_c, under_exists))
+        state = run_phase_sweeps(state, phases, self.max_rounds)
         return state
 
     # -- acceptance (as a previously-optimized goal) -----------------------

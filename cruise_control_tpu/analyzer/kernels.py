@@ -372,15 +372,19 @@ def leadership_round(state: ClusterState,
     sib_offline = state.replica_offline[sib_safe]
 
     fits = bonus_w[:, None] <= dest_headroom[sib_broker]
-    # structural feasibility only on the [R, RF] plane; the composed
-    # acceptance stack (which multiplies per previously-optimized goal) is
-    # evaluated on the [C, RF] candidate rows below — with a fallback pass
-    # when that mismatch would otherwise commit nothing
-    structural = (sib_ok & fits & leader_ok[sib_broker] & ~sib_offline
-                  & lead_eligible[:, None])
+    # the acceptance stack is folded into the [R, RF] selection plane on
+    # purpose: selecting candidates on structure alone and checking
+    # acceptance afterwards was measured 2-4× SLOWER end-to-end at 2.6K
+    # brokers — rejected candidates waste their broker's slot for the
+    # round, and the extra rounds cost far more than the [R, RF]
+    # acceptance evaluation saves
+    feasible = (sib_ok & fits & leader_ok[sib_broker] & ~sib_offline
+                & lead_eligible[:, None])
+    feasible &= accept_fn(jnp.arange(rb.shape[0], dtype=jnp.int32)[:, None],
+                          sib_safe)
 
-    struct_pref = jnp.where(structural, dest_pref[sib_broker], NEG)
-    r_has = jnp.max(struct_pref, axis=1) > NEG / 2
+    pref = jnp.where(feasible, dest_pref[sib_broker], NEG)
+    r_has = jnp.max(pref, axis=1) > NEG / 2
 
     # per-source-broker argmax over its leader replicas: shed the largest
     # transferable bonus first
@@ -388,76 +392,28 @@ def leadership_round(state: ClusterState,
     cand_r, _, cand_has = per_segment_argmax(score, rb, num_b, r_has)
     cand_r_safe = jnp.maximum(cand_r, 0)
 
-    def assign_followers(feasible_c):
-        """Multi-pass follower assignment (see assign_destinations):
-        candidates claim distinct destination brokers across their
-        follower options.  `feasible_c` is bool[C, RF]."""
-        pref_c = jnp.where(feasible_c, dest_pref[sib_broker[cand_r_safe]],
-                           NEG)
-        sib_broker_c = sib_broker[cand_r_safe]                 # [C, RF]
-        sib_c = sib_safe[cand_r_safe]
-        gain = bonus_w[cand_r_safe]
-        C = cand_r_safe.shape[0]
-        taken = jnp.zeros(num_b, dtype=bool)
-        assigned = jnp.zeros(C, dtype=bool)
-        dest_replica = jnp.zeros(C, dtype=jnp.int32)
-        for _ in range(ASSIGN_PASSES):
-            open_pref = jnp.where(taken[sib_broker_c], NEG, pref_c)
-            open_pref = jnp.where(assigned[:, None], NEG, open_pref)
-            slot = jnp.argmax(open_pref, axis=1)
-            has = cand_has & (jnp.max(open_pref, axis=1) > NEG / 2)
-            db = sib_broker_c[jnp.arange(C), slot]
-            keep = resolve_dest_conflicts(db, gain, has, num_b)
-            dest_replica = jnp.where(keep, sib_c[jnp.arange(C), slot],
-                                     dest_replica)
-            assigned = assigned | keep
-            taken = taken.at[jnp.where(keep, db, num_b)].set(True,
-                                                             mode="drop")
-        return dest_replica.astype(jnp.int32), assigned
-
-    feasible_c = (structural[cand_r_safe]
-                  & accept_fn(cand_r_safe[:, None], sib_safe[cand_r_safe]))
-    dest_replica, assigned = assign_followers(feasible_c)
-
-    # fallback: the per-broker candidate was chosen on structure alone; if
-    # the acceptance stack rejected every candidate while candidates exist,
-    # rerun with acceptance folded into the full [R, RF] selection so an
-    # acceptable leader on the same broker can win instead
-    def full_pass():
-        feasible_r = structural & accept_fn(
-            jnp.arange(rb.shape[0], dtype=jnp.int32)[:, None], sib_safe)
-        pref_r = jnp.where(feasible_r, dest_pref[sib_broker], NEG)
-        has_r = jnp.max(pref_r, axis=1) > NEG / 2
-        score_f = jnp.where(has_r, shed_score(bonus_w, src_excess[rb]), NEG)
-        cand_f, _, has_f = per_segment_argmax(score_f, rb, num_b, has_r)
-        cand_f_safe = jnp.maximum(cand_f, 0)
-        # reuse the follower assignment against the re-selected candidates
-        nonlocal_sib = sib_safe[cand_f_safe]
-        pref_c = jnp.where(feasible_r[cand_f_safe],
-                           dest_pref[rb[nonlocal_sib]], NEG)
-        C = cand_f_safe.shape[0]
-        taken = jnp.zeros(num_b, dtype=bool)
-        assigned_f = jnp.zeros(C, dtype=bool)
-        dest_f = jnp.zeros(C, dtype=jnp.int32)
-        gain = bonus_w[cand_f_safe]
-        for _ in range(ASSIGN_PASSES):
-            open_pref = jnp.where(taken[rb[nonlocal_sib]], NEG, pref_c)
-            open_pref = jnp.where(assigned_f[:, None], NEG, open_pref)
-            slot = jnp.argmax(open_pref, axis=1)
-            has = has_f & (jnp.max(open_pref, axis=1) > NEG / 2)
-            db = rb[nonlocal_sib[jnp.arange(C), slot]]
-            keep = resolve_dest_conflicts(db, gain, has, num_b)
-            dest_f = jnp.where(keep, nonlocal_sib[jnp.arange(C), slot],
-                               dest_f)
-            assigned_f = assigned_f | keep
-            taken = taken.at[jnp.where(keep, db, num_b)].set(True,
-                                                             mode="drop")
-        return cand_f, dest_f.astype(jnp.int32), assigned_f
-
-    need_full = jnp.any(cand_has) & ~jnp.any(assigned)
-    return jax.lax.cond(
-        need_full, full_pass,
-        lambda: (cand_r, dest_replica, assigned))
+    # multi-pass follower assignment (see assign_destinations): candidates
+    # claim distinct destination brokers across their follower options
+    pref_c = pref[cand_r_safe]                                 # [C, RF]
+    sib_broker_c = sib_broker[cand_r_safe]                     # [C, RF]
+    sib_c = sib_safe[cand_r_safe]
+    gain = bonus_w[cand_r_safe]
+    C = cand_r_safe.shape[0]
+    taken = jnp.zeros(num_b, dtype=bool)
+    assigned = jnp.zeros(C, dtype=bool)
+    dest_replica = jnp.zeros(C, dtype=jnp.int32)
+    for _ in range(ASSIGN_PASSES):
+        open_pref = jnp.where(taken[sib_broker_c], NEG, pref_c)
+        open_pref = jnp.where(assigned[:, None], NEG, open_pref)
+        slot = jnp.argmax(open_pref, axis=1)
+        has = cand_has & (jnp.max(open_pref, axis=1) > NEG / 2)
+        db = sib_broker_c[jnp.arange(C), slot]
+        keep = resolve_dest_conflicts(db, gain, has, num_b)
+        dest_replica = jnp.where(keep, sib_c[jnp.arange(C), slot],
+                                 dest_replica)
+        assigned = assigned | keep
+        taken = taken.at[jnp.where(keep, db, num_b)].set(True, mode="drop")
+    return cand_r, dest_replica.astype(jnp.int32), assigned
 
 
 def forced_move_round(state: ClusterState,

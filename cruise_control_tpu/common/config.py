@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import enum
 import importlib
+import os
+import re
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
 
@@ -292,9 +294,28 @@ class AbstractConfig:
             configure(merged)
 
 
+#: ${env:NAME} indirection in property values (reference
+#: CC/config/EnvConfigProvider.java — secrets such as passwords reference
+#: environment variables instead of living in the properties file)
+_ENV_REF = re.compile(r"\$\{env:([A-Za-z_][A-Za-z0-9_]*)\}")
+
+
+def resolve_env_references(value: str) -> str:
+    """Substitute every `${env:NAME}` in `value` from the environment;
+    unset variables raise (a silently-empty secret is worse than failing
+    at startup)."""
+    def sub(match):
+        name = match.group(1)
+        if name not in os.environ:
+            raise KeyError(
+                f"config references ${{env:{name}}} but {name} is not set")
+        return os.environ[name]
+    return _ENV_REF.sub(sub, value)
+
+
 def load_properties(path: str) -> Dict[str, str]:
     """Parse a Java-style .properties file (reference reads config via
-    KafkaCruiseControlUtils.readConfig)."""
+    KafkaCruiseControlUtils.readConfig), resolving ${env:NAME} secrets."""
     props: Dict[str, str] = {}
     with open(path, "r", encoding="utf-8") as handle:
         for raw in handle:
@@ -306,5 +327,6 @@ def load_properties(path: str) -> Dict[str, str]:
                          if sep in line]
             if positions:
                 pos, sep = min(positions)
-                props[line[:pos].strip()] = line[pos + len(sep):].strip()
+                props[line[:pos].strip()] = resolve_env_references(
+                    line[pos + len(sep):].strip())
     return props

@@ -28,18 +28,10 @@ from cruise_control_tpu.monitor.sampling.sampler import MetricSampler
 LOG = logging.getLogger(__name__)
 
 
-def read_properties(path: str) -> dict:
-    """Java-style `key=value` properties file (reference readConfig)."""
-    props = {}
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith(("#", "!")):
-                continue
-            if "=" in line:
-                k, v = line.split("=", 1)
-                props[k.strip()] = v.strip()
-    return props
+#: Java-style `key=value` properties file with ${env:NAME} secret
+#: resolution (reference readConfig + EnvConfigProvider)
+from cruise_control_tpu.common.config import \
+    load_properties as read_properties  # noqa: E402
 
 
 def build_cruise_control(config: CruiseControlConfig, admin,

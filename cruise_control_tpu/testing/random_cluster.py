@@ -162,9 +162,11 @@ def random_cluster(spec: RandomClusterSpec
             disk_alive_arr[broken] = False
             offline = offline | ~disk_alive_arr[r_disk]
             bad_disks[disk_broker[broken]] = True
-            # broker DISK capacity = sum of alive logdirs (builder contract)
-            capacity[disk_broker[broken], Resource.DISK] -= \
-                disk_capacity[broken]
+            # broker DISK capacity = sum of alive logdirs (builder
+            # contract); subtract.at accumulates when one broker loses
+            # several logdirs (fancy-index -= would drop duplicates)
+            np.subtract.at(capacity[:, Resource.DISK],
+                           disk_broker[broken], disk_capacity[broken])
         disk_names = [(int(disk_broker[d]), f"/d{d % jd}")
                       for d in range(num_d)]
     else:

@@ -84,3 +84,20 @@ def test_properties_file(tmp_path):
 def test_document_renders():
     doc = make_def().document()
     assert "num.windows" in doc and "(required)" in doc
+
+
+def test_env_reference_resolution(tmp_path, monkeypatch):
+    """${env:NAME} secret indirection in properties files (reference
+    CC/config/EnvConfigProvider.java)."""
+    import pytest
+    from cruise_control_tpu.common.config import load_properties
+    monkeypatch.setenv("CC_TEST_SECRET", "s3cr3t")
+    p = tmp_path / "cc.properties"
+    p.write_text("webserver.auth.password=${env:CC_TEST_SECRET}\n"
+                 "plain.key=value\n")
+    props = load_properties(str(p))
+    assert props["webserver.auth.password"] == "s3cr3t"
+    assert props["plain.key"] == "value"
+    p.write_text("x=${env:CC_TEST_UNSET_VAR}\n")
+    with pytest.raises(KeyError):
+        load_properties(str(p))
