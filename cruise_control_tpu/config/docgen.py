@@ -1,0 +1,54 @@
+"""Configuration-reference generator.
+
+The reference publishes a full key reference
+(docs/wiki/User Guide/Configurations.md, ~293 lines); here the reference
+document is GENERATED from the typed ConfigDef groups so it can never go
+stale — `python -m cruise_control_tpu.config.docgen > docs/CONFIGURATION.md`
+regenerates it, and a test asserts the committed file matches the live
+definitions (the same defs-are-the-source-of-truth idea as the reference's
+ResponseTest schema walk).
+"""
+from __future__ import annotations
+
+from cruise_control_tpu.common.config import ConfigDef
+from cruise_control_tpu.config import main_config as M
+
+#: (section title, def-builder) in the reference's constant-group order
+GROUPS = [
+    ("Monitor", M.monitor_config_def),
+    ("Analyzer", M.analyzer_config_def),
+    ("Executor", M.executor_config_def),
+    ("Anomaly detector", M.anomaly_detector_config_def),
+    ("Webserver", M.webserver_config_def),
+    ("User task manager", M.user_task_manager_config_def),
+]
+
+
+def render() -> str:
+    out = [
+        "# Configuration reference",
+        "",
+        "Generated from the typed config definitions "
+        "(`cruise_control_tpu/config/main_config.py`) by "
+        "`python -m cruise_control_tpu.config.docgen`; do not edit by "
+        "hand.  Counterpart of the reference's "
+        "docs/wiki/User Guide/Configurations.md, with the key groups of "
+        "CC/config/constants/.",
+        "",
+        "Values in a `.properties` file may reference environment "
+        "variables as `${env:NAME}` (secrets; reference "
+        "EnvConfigProvider).",
+    ]
+    total = 0
+    for title, builder in GROUPS:
+        d = builder(ConfigDef())
+        keys = d.keys()
+        total += len(keys)
+        out += ["", f"## {title} ({len(keys)} keys)", ""]
+        out.append(d.document())
+    out += ["", f"_{total} keys total._", ""]
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    print(render(), end="")

@@ -101,3 +101,18 @@ def test_env_reference_resolution(tmp_path, monkeypatch):
     p.write_text("x=${env:CC_TEST_UNSET_VAR}\n")
     with pytest.raises(KeyError):
         load_properties(str(p))
+
+
+def test_configuration_doc_is_current():
+    """docs/CONFIGURATION.md must match the live config definitions
+    (defs-as-source-of-truth, like the reference's ResponseTest walking
+    @JsonResponseClass against the swagger YAML)."""
+    import os
+    from cruise_control_tpu.config.docgen import render
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "CONFIGURATION.md")
+    with open(path) as f:
+        committed = f.read()
+    assert committed == render(), (
+        "docs/CONFIGURATION.md is stale — regenerate with "
+        "`python -m cruise_control_tpu.config.docgen > docs/CONFIGURATION.md`")
