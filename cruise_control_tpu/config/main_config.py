@@ -164,11 +164,16 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
              1.0, in_range(min_value=1.0), _L,
              "Relaxation of distribution thresholds during violation fix.")
     d.define("num.proposal.precompute.threads", Type.INT, 1,
-             in_range(min_value=1), _M,
-             "Background proposal precompute workers.")
+             in_range(min_value=0), _M,
+             "Background proposal precompute loops; 0 disables the "
+             "precompute (the device solver serializes on one chip, so "
+             "values above 1 behave like 1).")
     d.define("proposal.expiration.ms", Type.LONG, 900_000,
              in_range(min_value=1), _M,
              "Cached proposals older than this are recomputed.")
+    d.define("proposal.precompute.interval.ms", Type.LONG, 30_000,
+             in_range(min_value=1), _L,
+             "Pause between background proposal precompute passes.")
     d.define("max.optimization.rounds", Type.INT, 64,
              in_range(min_value=1), _L,
              "Per-goal cap on batched optimization rounds (TPU solver).")

@@ -94,6 +94,8 @@ class CruiseControl:
                  goal_violation_interval_s: float = 300.0,
                  disk_failure_interval_s: float = 300.0,
                  topic_anomaly_interval_s: float = 600.0,
+                 proposal_expiration_s: float = 900.0,
+                 proposal_precompute_interval_s: float = 30.0,
                  self_healing_goals: Optional[Sequence[str]] = None,
                  time_fn: Optional[Callable[[], float]] = None,
                  sleep_fn: Optional[Callable[[float], None]] = None,
@@ -127,10 +129,21 @@ class CruiseControl:
                              disk_failure_interval_s,
                              topic_anomaly_interval_s)
 
-        # proposal cache (reference GoalOptimizer.validCachedProposal)
+        # proposal cache (reference GoalOptimizer.validCachedProposal) +
+        # background precompute (reference GoalOptimizer.run :130-181 and
+        # proposal.expiration.ms)
         self._cache_lock = threading.Lock()
         self._cached_result: Optional[OptimizerResult] = None
         self._cached_generation = None
+        self._cached_at = 0.0
+        #: bumped by every invalidation; a solve only stores its result if
+        #: no invalidation happened while it ran (check-then-act guard for
+        #: the background precompute racing an execution start)
+        self._cache_epoch = 0
+        self._proposal_expiration_s = proposal_expiration_s
+        self._precompute_interval_s = proposal_precompute_interval_s
+        self._precompute_stop = threading.Event()
+        self._precompute_thread: Optional[threading.Thread] = None
 
         # sensors (reference dropwizard registry, SURVEY.md §5.1)
         self.metrics = MetricRegistry(self._time)
@@ -143,18 +156,57 @@ class CruiseControl:
     # ------------------------------------------------------------------
     def start_up(self, do_sampling: bool = True,
                  detection_tick_s: float = 1.0,
-                 start_detection: bool = True) -> None:
+                 start_detection: bool = True,
+                 start_proposal_precompute: bool = False) -> None:
         self.load_monitor.start_up(do_sampling=do_sampling)
         self.broker_failure_detector.start()
         if start_detection:
             self.anomaly_detector.start(tick_s=detection_tick_s)
+        if start_proposal_precompute:
+            self._precompute_stop.clear()
+            self._precompute_thread = threading.Thread(
+                target=self._precompute_loop, name="proposal-precompute",
+                daemon=True)
+            self._precompute_thread.start()
 
     def shutdown(self) -> None:
+        self._precompute_stop.set()
+        if self._precompute_thread is not None:
+            self._precompute_thread.join(timeout=5.0)
         self.anomaly_detector.shutdown()
         self.broker_failure_detector.shutdown()
         self.executor.stop_execution(force=True)
         self.executor.await_completion(timeout=30.0)
         self.load_monitor.shutdown()
+
+    # ------------------------------------------------------------------
+    # background proposal precompute (reference GoalOptimizer.run loop:
+    # keep a warm proposal cache so PROPOSALS / rebalance requests answer
+    # from cache instead of paying a full solve)
+    # ------------------------------------------------------------------
+    def precompute_proposals_once(self) -> bool:
+        """One precompute pass; returns True when a fresh result was
+        computed.  Skipped while the monitor has no valid windows, while
+        an execution is mutating the cluster, or while the cache is still
+        valid for the current model generation."""
+        if not self._monitor_ready():
+            return False
+        if self.executor.has_ongoing_execution:
+            return False
+        generation = self.load_monitor.model_generation()
+        with self._cache_lock:
+            if self._cache_valid(generation):
+                return False
+        try:
+            self.optimizations()
+            return True
+        except Exception as exc:  # noqa: BLE001 - keep the loop alive
+            LOG.warning("proposal precompute failed: %s", exc)
+            return False
+
+    def _precompute_loop(self) -> None:
+        while not self._precompute_stop.wait(self._precompute_interval_s):
+            self.precompute_proposals_once()
 
     # ------------------------------------------------------------------
     # detector wiring (self-healing fix runnables, SURVEY.md §3.5)
@@ -282,9 +334,10 @@ class CruiseControl:
         generation = self.load_monitor.model_generation()
         if cacheable and not ignore_proposal_cache:
             with self._cache_lock:
-                if (self._cached_result is not None
-                        and self._cached_generation == generation):
+                if self._cache_valid(generation):
                     return self._cached_result
+        with self._cache_lock:
+            epoch = self._cache_epoch
         optimizer = (self.goal_optimizer if goals is None
                      else GoalOptimizer(default_goals(names=list(goals)),
                                         self._constraint))
@@ -293,9 +346,28 @@ class CruiseControl:
             result = optimizer.optimizations(state, topo, options)
         if cacheable:
             with self._cache_lock:
-                self._cached_result = result
-                self._cached_generation = generation
+                # drop the result if the cache was invalidated while the
+                # solve ran (an execution started mutating the cluster) —
+                # storing it would serve pre-execution proposals
+                if self._cache_epoch == epoch:
+                    self._cached_result = result
+                    self._cached_generation = generation
+                    self._cached_at = self._time()
         return result
+
+    def _cache_valid(self, generation) -> bool:
+        """Caller holds _cache_lock."""
+        return (self._cached_result is not None
+                and self._cached_generation == generation
+                and (self._time() - self._cached_at
+                     < self._proposal_expiration_s))
+
+    def _invalidate_proposal_cache(self) -> None:
+        """Executing invalidates cached proposals; the epoch bump also
+        makes any in-flight solve drop its (pre-execution) result."""
+        with self._cache_lock:
+            self._cached_result = None
+            self._cache_epoch += 1
 
     # ------------------------------------------------------------------
     # POST operations (reference servlet/handler/async runnables)
@@ -470,6 +542,7 @@ class CruiseControl:
             return OperationResult(None, proposals=proposals, dryrun=dryrun)
         uuid = self.executor.execute_proposals(proposals, reason=reason,
                                                **execute_kwargs)
+        self._invalidate_proposal_cache()
         return OperationResult(None, execution_uuid=uuid,
                                proposals=proposals, dryrun=False)
 
@@ -546,6 +619,5 @@ class CruiseControl:
         uuid = self.executor.execute_proposals(
             result.proposals, reason=reason, strategy=strategy,
             **execute_kwargs)
-        with self._cache_lock:    # executing invalidates cached proposals
-            self._cached_result = None
+        self._invalidate_proposal_cache()
         return OperationResult(result, execution_uuid=uuid, dryrun=False)

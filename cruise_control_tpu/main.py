@@ -60,6 +60,10 @@ def build_cruise_control(config: CruiseControlConfig, admin,
         goal_names=[g for g in config.get_list("goals") if g],
         goal_violation_interval_s=config.get_long(
             "anomaly.detection.interval.ms") / 1e3,
+        proposal_expiration_s=config.get_long(
+            "proposal.expiration.ms") / 1e3,
+        proposal_precompute_interval_s=config.get_long(
+            "proposal.precompute.interval.ms") / 1e3,
         monitor_kwargs=dict(
             sample_store=sample_store,
             num_windows=config.get_int("num.partition.metrics.windows"),
@@ -149,7 +153,8 @@ def main(argv=None) -> int:
         cc = build_cruise_control(config, admin)
 
     app = build_app(config, cc)
-    cc.start_up()
+    cc.start_up(start_proposal_precompute=config.get_int(
+        "num.proposal.precompute.threads") > 0)
     host = args.host or config.get("webserver.http.address")
     port = args.port if args.port is not None \
         else config.get_int("webserver.http.port")

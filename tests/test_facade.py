@@ -185,3 +185,59 @@ class TestFacade:
         snap = sim2.describe_cluster()
         assert not [p for p in snap.partitions if 3 in p.replicas]
         cc.shutdown()
+
+
+class TestProposalPrecompute:
+    def test_precompute_warms_cache_and_expires(self):
+        """Background precompute fills the proposal cache (reference
+        GoalOptimizer.run loop); a warm cache answers without a new solve;
+        expiry (proposal.expiration.ms) forces recompute even at the same
+        model generation."""
+        sim, cc, clock = make_stack()
+        cc._proposal_expiration_s = 100.0
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+
+        assert cc.precompute_proposals_once() is True
+        with cc._cache_lock:
+            cached = cc._cached_result
+        assert cached is not None
+
+        # warm cache: second pass is a no-op, optimizations() returns it
+        assert cc.precompute_proposals_once() is False
+        assert cc.optimizations() is cached
+
+        # expiry: same generation, aged cache -> fresh solve
+        clock["now"] += 101.0
+        assert cc.precompute_proposals_once() is True
+        with cc._cache_lock:
+            assert cc._cached_result is not cached
+        cc.shutdown()
+
+    def test_precompute_skips_when_not_ready(self):
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        # no samples yet: monitor not ready
+        assert cc.precompute_proposals_once() is False
+        cc.shutdown()
+
+    def test_invalidation_during_solve_drops_result(self):
+        """An execution starting while a (background) solve is in flight
+        bumps the cache epoch; the solve must not store its pre-execution
+        result afterwards."""
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        orig = cc.goal_optimizer.optimizations
+
+        def hooked(*args, **kwargs):
+            result = orig(*args, **kwargs)
+            cc._invalidate_proposal_cache()   # execution races the solve
+            return result
+
+        cc.goal_optimizer.optimizations = hooked
+        result = cc.optimizations()
+        assert result.proposals is not None
+        with cc._cache_lock:
+            assert cc._cached_result is None   # stale result not stored
+        cc.shutdown()
