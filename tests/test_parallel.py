@@ -1,0 +1,87 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh.
+
+The replica axis shards over a 1-D mesh (`parallel/mesh.py`); jitting the
+optimizer over sharded inputs must (a) produce the same proposals as the
+single-device solve and (b) actually lay the replica arrays out across
+devices.  This is the in-suite counterpart of the driver's
+`dryrun_multichip` entry point.
+"""
+import conftest  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions,
+                                                 make_context)
+from cruise_control_tpu.analyzer.goals.registry import default_goals
+from cruise_control_tpu.analyzer.optimizer import heal_offline_replicas
+from cruise_control_tpu.model.sanity import sanity_check
+from cruise_control_tpu.parallel.mesh import (REPLICA_AXIS, make_mesh,
+                                              pad_state, shard_state,
+                                              state_shardings)
+from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
+                                                       random_cluster)
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs the 8-device CPU mesh")
+
+
+def _spec():
+    return RandomClusterSpec(num_brokers=12, num_partitions=96,
+                             replication_factor=3, num_racks=4,
+                             num_topics=4, seed=3, skew_fraction=0.3,
+                             dead_brokers=1)
+
+
+def test_sharded_full_step_matches_single_device():
+    state, topo = random_cluster(_spec())
+    goals = default_goals(max_rounds=8, names=[
+        "RackAwareGoal", "DiskCapacityGoal", "DiskUsageDistributionGoal"])
+
+    def full_step(st, c):
+        st = heal_offline_replicas(st, c, max_rounds=8)
+        for i, goal in enumerate(goals):
+            st = goal.optimize(st, c, tuple(goals[:i]))
+        return st
+
+    # single-device reference
+    ctx = make_context(state, BalancingConstraint(), OptimizationOptions(),
+                       topo)
+    ref = jax.jit(full_step)(state, ctx)
+
+    # sharded over the 8-device mesh
+    mesh = make_mesh(jax.devices()[:8])
+    sharded = shard_state(state, mesh)
+    ctx_s = make_context(sharded, BalancingConstraint(),
+                         OptimizationOptions(), topo)
+    shardings = state_shardings(sharded, mesh)
+    step = jax.jit(full_step, in_shardings=(shardings, None),
+                   out_shardings=shardings)
+    with mesh:
+        out = step(sharded, ctx_s)
+        jax.block_until_ready(out.replica_broker)
+
+    # replica arrays really live across devices
+    assert len(out.replica_broker.sharding.device_set) == 8
+
+    sanity_check(jax.device_get(out))
+    n = state.num_replicas
+    np.testing.assert_array_equal(np.asarray(ref.replica_broker),
+                                  np.asarray(out.replica_broker)[:n])
+    np.testing.assert_array_equal(np.asarray(ref.replica_is_leader),
+                                  np.asarray(out.replica_is_leader)[:n])
+    # no offline replicas survive on either path
+    assert not (np.asarray(out.replica_offline)
+                & np.asarray(out.replica_valid)).any()
+
+
+def test_pad_state_rounds_up_and_masks():
+    state, _ = random_cluster(_spec())
+    padded = pad_state(state, 7)
+    assert padded.num_replicas % 7 == 0
+    extra = padded.num_replicas - state.num_replicas
+    assert not np.asarray(padded.replica_valid)[-extra:].any() if extra \
+        else True
