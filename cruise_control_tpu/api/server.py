@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time as _time
 import urllib.parse
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -35,6 +36,11 @@ from cruise_control_tpu.executor.strategy import strategy_from_names
 from cruise_control_tpu.facade import CruiseControl, OngoingExecutionError
 
 LOG = logging.getLogger(__name__)
+#: NCSA-style access log, one line per HTTP request (reference
+#: KafkaCruiseControlApp NCSA access log)
+ACCESS_LOG = logging.getLogger("accessLogger")
+_NCSA_MONTHS = ("Jan", "Feb", "Mar", "Apr", "May", "Jun",
+                "Jul", "Aug", "Sep", "Oct", "Nov", "Dec")
 
 BASE_PATH = "/kafkacruisecontrol"
 
@@ -58,6 +64,7 @@ class CruiseControlApp:
                  security: Optional[SecurityProvider] = None,
                  two_step_verification: bool = False,
                  async_response_timeout_s: float = 1.0,
+                 access_log: bool = True,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self.cc = cruise_control
         self.security = security or NoSecurityProvider()
@@ -65,6 +72,7 @@ class CruiseControlApp:
             if two_step_verification else None
         self.user_tasks = UserTaskManager(time_fn=time_fn)
         self._async_timeout = async_response_timeout_s
+        self._access_log = access_log
         self._http: Optional[ThreadingHTTPServer] = None
 
     # ------------------------------------------------------------------
@@ -425,6 +433,24 @@ class CruiseControlApp:
 
             def do_POST(self) -> None:  # noqa: N802
                 self._dispatch("POST")
+
+            def log_request(self, code="-", size="-") -> None:
+                # NCSA common-log line per request (reference
+                # KafkaCruiseControlApp.java:133-148 NCSA access log),
+                # logger name `accessLogger` so deployments route it to
+                # its own file
+                if app._access_log:
+                    code = getattr(code, "value", code)
+                    now = _time.localtime()
+                    # fixed English month names: %b is locale-dependent
+                    # and would break NCSA parsers under non-C locales
+                    stamp = ("%02d/%s/%04d:%02d:%02d:%02d %s" % (
+                        now.tm_mday, _NCSA_MONTHS[now.tm_mon - 1],
+                        now.tm_year, now.tm_hour, now.tm_min, now.tm_sec,
+                        _time.strftime("%z", now)))
+                    ACCESS_LOG.info(
+                        '%s - - [%s] "%s" %s %s', self.client_address[0],
+                        stamp, self.requestline, code, size)
 
             def log_message(self, fmt: str, *args) -> None:
                 LOG.debug("http: " + fmt, *args)

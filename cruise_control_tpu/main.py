@@ -99,7 +99,8 @@ def build_app(config: CruiseControlConfig,
         two_step_verification=config.get_boolean(
             "two.step.verification.enabled"),
         async_response_timeout_s=config.get_long(
-            "webserver.request.maxBlockTimeMs") / 1e3)
+            "webserver.request.maxBlockTimeMs") / 1e3,
+        access_log=config.get_boolean("webserver.accesslog.enabled"))
 
 
 def main(argv=None) -> int:

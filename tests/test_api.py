@@ -326,9 +326,21 @@ class TestDispatch:
         cc.shutdown()
 
     def test_http_transport_roundtrip(self):
+        import logging
         sim, cc, app = make_app()
-        port = app.start(port=0)
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = _Capture()
+        access = logging.getLogger("accessLogger")
+        prior_level = access.level
         try:
+            access.addHandler(handler)
+            access.setLevel(logging.INFO)
+            port = app.start(port=0)
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/kafkacruisecontrol/state",
                     timeout=30) as resp:
@@ -338,6 +350,11 @@ class TestDispatch:
         finally:
             app.stop()
             cc.shutdown()
+            access.removeHandler(handler)
+            access.setLevel(prior_level)
+        # NCSA access line: host - - [time] "GET /path HTTP/1.1" 200 -
+        assert any('"GET /kafkacruisecontrol/state' in line
+                   and " 200 " in line for line in records), records
 
 
 class TestSensors:
