@@ -1,0 +1,37 @@
+"""Force a virtual n-device CPU backend for multi-device tests/dryruns.
+
+Multi-chip TPU hardware is not available in CI or the driver environment, so
+sharding code is exercised on a virtual CPU mesh instead
+(``--xla_force_host_platform_device_count``).  A platform hook
+(sitecustomize) may import jax at interpreter startup with
+``JAX_PLATFORMS=axon``; in that case env-var assignments alone are a no-op
+and ``jax.config.update`` is required — it still takes effect as long as no
+jax computation has run yet.
+"""
+import os
+import re
+
+
+def force_cpu_devices(n_devices: int) -> None:
+    """Point jax at a CPU backend exposing exactly ``n_devices`` devices.
+
+    Must be called before any jax computation runs (backends are created
+    lazily, so an already-imported jax is fine).  Replaces any pre-existing
+    ``xla_force_host_platform_device_count`` value rather than keeping it.
+    """
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.default_backend() == "cpu", jax.default_backend()
+    have = len(jax.devices())
+    assert have == n_devices, f"need {n_devices} devices, have {have}"

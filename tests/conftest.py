@@ -2,16 +2,12 @@
 
 Multi-chip TPU hardware is not available in CI; sharding tests run on a
 virtual 8-device CPU backend instead (same pattern the driver uses for the
-multi-chip dry run).  Must run before jax is imported anywhere.
+multi-chip dry run).  Must run before any jax computation.
 """
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # persistent compilation cache: the goal kernels recompile per optimizer
 # instance otherwise, dominating test wall-clock
@@ -20,19 +16,14 @@ _cache_dir = os.path.join(os.path.dirname(os.path.dirname(
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from cruise_control_tpu.testing.virtual_mesh import force_cpu_devices  # noqa: E402
 
-# An environment hook (e.g. a TPU-plugin sitecustomize) may import jax at
-# interpreter startup, in which case jax has already read JAX_PLATFORMS /
-# cache env vars and the assignments above are no-ops.  Force the config
-# directly — backends are created lazily, so this still takes effect as
-# long as no jax computation ran yet.
+force_cpu_devices(8)
+
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir",
                   os.environ["JAX_COMPILATION_CACHE_DIR"])
 jax.config.update(
     "jax_persistent_cache_min_compile_time_secs",
     float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
-assert jax.default_backend() == "cpu", jax.default_backend()
