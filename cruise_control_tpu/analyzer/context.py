@@ -101,6 +101,16 @@ class OptimizationContext:
     rf_max: int = dataclasses.field(metadata=dict(static=True), default=5)
     fix_offline_replicas_only: bool = dataclasses.field(
         metadata=dict(static=True), default=False)
+    #: width S of the per-broker replica table (RoundCache.broker_table);
+    #: 0 disables the table (kernels fall back to segment ops).  Sized
+    #: host-side from the initial per-broker counts with headroom.
+    table_slots: int = dataclasses.field(metadata=dict(static=True),
+                                         default=0)
+    #: reduced-effort mode (reference OptimizationOptions.fastMode): soft
+    #: goals run on a quartered round budget and skip the swap fallback;
+    #: hard goals are unaffected (they must converge regardless).
+    fast_mode: bool = dataclasses.field(metadata=dict(static=True),
+                                        default=False)
 
 
 def partition_replica_index(state: ClusterState,
@@ -183,6 +193,17 @@ def make_context(state: ClusterState,
 
     pr = partition_replica_index(state)
 
+    # broker-table width: max initial per-broker replica count plus headroom
+    # for arrivals and removal holes between compactions (kernels guard
+    # destinations with fill < S, so S only bounds how many replicas one
+    # broker may accumulate — generous is safe, [B, S] i32 is small)
+    counts = np.bincount(
+        np.asarray(state.replica_broker)[np.asarray(state.replica_valid)],
+        minlength=state.num_brokers)
+    max_count = int(counts.max(initial=0))
+    table_slots = min(state.num_replicas,
+                      -(-int(max_count * 1.5 + 64) // 128) * 128)
+
     avg_util = np.asarray(S.average_utilization_percentage(state))
     upper = np.zeros(NUM_RESOURCES, dtype=np.float32)
     lower = np.zeros(NUM_RESOURCES, dtype=np.float32)
@@ -207,6 +228,8 @@ def make_context(state: ClusterState,
         max_replicas_per_broker=constraint.max_replicas_per_broker,
         rf_max=pr.shape[1],
         fix_offline_replicas_only=fix_offline_replicas_only,
+        table_slots=table_slots,
+        fast_mode=options.fast_mode,
     )
 
 
@@ -225,6 +248,16 @@ class RoundCache:
     broker_topic_count: jax.Array    # i32[B, T]
     potential_nw_out: jax.Array      # f32[B]
     leader_bytes_in: jax.Array       # f32[B] NW_IN carried by leaders
+    # Per-broker replica table: row b lists the replica ids currently on
+    # broker b (pad = R).  Replaces ragged [R]-segment argmax (a TPU
+    # scatter, ~12ms at R=600K) with dense row-wise reductions (~0.1ms) for
+    # per-broker candidate selection, and makes per-broker top-k free.
+    # Width 0 disables the table.  Removals leave pad holes at the vacated
+    # slot; arrivals append at `table_fill` (an append POINTER, >= the true
+    # count while holes exist); rows are re-packed by an in-row sort when
+    # any fill pointer nears S (see _maybe_compact_table).
+    broker_table: jax.Array       # i32[B, S] replica ids, pad = R
+    table_fill: jax.Array         # i32[B] append pointer per row
 
 
 def leader_nw_in(state: ClusterState) -> jax.Array:
@@ -234,9 +267,38 @@ def leader_nw_in(state: ClusterState) -> jax.Array:
             * (state.replica_valid & state.replica_is_leader))
 
 
-def make_round_cache(state: ClusterState) -> RoundCache:
+def build_broker_table(state: ClusterState, table_slots: int
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """(broker_table i32[B, S], fill i32[B]) — compact per-broker replica
+    rows built with one stable sort (traceable; called at round-loop entry,
+    not per round)."""
+    num_r, num_b = state.num_replicas, state.num_brokers
+    s = table_slots
+    rb = jnp.where(state.replica_valid, state.replica_broker, num_b)
+    order = jnp.argsort(rb, stable=True).astype(jnp.int32)
+    rb_sorted = rb[order]
+    counts = jax.ops.segment_sum(jnp.ones_like(rb), rb,
+                                 num_segments=num_b + 1)
+    start = jnp.concatenate([jnp.zeros(1, counts.dtype),
+                             jnp.cumsum(counts)[:-1]])
+    rank = jnp.arange(num_r, dtype=jnp.int32) - start[rb_sorted].astype(
+        jnp.int32)
+    flat_idx = jnp.where((rb_sorted < num_b) & (rank < s),
+                         rb_sorted * s + rank, num_b * s)
+    table = jnp.full((num_b * s,), num_r, dtype=jnp.int32).at[flat_idx].set(
+        order, mode="drop").reshape(num_b, s)
+    fill = jnp.minimum(counts[:num_b], s).astype(jnp.int32)
+    return table, fill
+
+
+def make_round_cache(state: ClusterState, table_slots: int = 0) -> RoundCache:
     load = S.broker_load(state)
     cap = jnp.maximum(state.broker_capacity, 1e-9)
+    if table_slots:
+        table, fill = build_broker_table(state, table_slots)
+    else:
+        table = jnp.zeros((state.num_brokers, 0), dtype=jnp.int32)
+        fill = jnp.zeros((state.num_brokers,), dtype=jnp.int32)
     return RoundCache(
         broker_load=load,
         broker_util=load / cap,
@@ -249,6 +311,8 @@ def make_round_cache(state: ClusterState) -> RoundCache:
         leader_bytes_in=jax.ops.segment_sum(
             leader_nw_in(state), state.replica_broker,
             num_segments=state.num_brokers),
+        broker_table=table,
+        table_fill=fill,
     )
 
 
@@ -272,6 +336,52 @@ def _scatter_pm(arr: jax.Array, s: jax.Array, d: jax.Array,
         jnp.concatenate([-x, x]), mode="drop")
 
 
+def _update_table_for_moves(state_before: ClusterState, cache: RoundCache,
+                            r: jax.Array, dst: jax.Array, valid: jax.Array
+                            ) -> Tuple[jax.Array, jax.Array]:
+    """Maintain (broker_table, table_fill) across a committed move batch.
+
+    Invariants relied on (the search kernels guarantee them):
+      * at most ONE arrival per destination broker per batch (destinations
+        are deduplicated by assign_destinations/resolve_dest_conflicts) —
+        two arrivals would claim the same append slot;
+      * destinations were eligible only while `table_fill < S`, so the
+        append slot is in range.
+    Departures per source are unbounded (holes are fine)."""
+    num_r = state_before.num_replicas
+    num_b = state_before.num_brokers
+    s = cache.broker_table.shape[1]
+    src = state_before.replica_broker[r]
+
+    # departures: locate each mover's slot in its source row, punch a hole
+    rows = cache.broker_table[src]                       # [C, S]
+    slot = jnp.argmax(rows == r[:, None], axis=1)
+    found = jnp.take_along_axis(rows, slot[:, None], axis=1)[:, 0] == r
+    flat = cache.broker_table.reshape(-1)
+    oob = num_b * s
+    flat = flat.at[jnp.where(valid & found, src * s + slot, oob)].set(
+        num_r, mode="drop")
+
+    # arrivals: append at the destination's fill pointer (<= 1 per dest)
+    aslot = cache.table_fill[dst]
+    flat = flat.at[jnp.where(valid & (aslot < s), dst * s + aslot, oob)].set(
+        r, mode="drop")
+    table = flat.reshape(num_b, s)
+    fill = cache.table_fill.at[jnp.where(valid, dst, num_b)].add(
+        1, mode="drop")
+
+    # re-pack when any append pointer nears the edge: in-row sort pushes the
+    # pad value (num_r, larger than any replica id) to the end
+    def compact(t):
+        return jnp.sort(t, axis=1)
+
+    need = jnp.max(fill) >= s - 1
+    table = jax.lax.cond(need, compact, lambda t: t, table)
+    true_count = jnp.sum(table < num_r, axis=1).astype(jnp.int32)
+    fill = jnp.where(need, true_count, fill)
+    return table, fill
+
+
 def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
                            replicas: jax.Array, dest_brokers: jax.Array,
                            valid: jax.Array) -> RoundCache:
@@ -279,7 +389,13 @@ def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
 
     `state_before` MUST be the pre-commit state (source brokers are read
     from it).  Invalid rows are dropped via out-of-bounds routing exactly
-    like apply_moves."""
+    like apply_moves.
+
+    Preconditions (the search kernels guarantee both): the valid rows name
+    each replica at most ONCE (updates are scatter-ADDs while apply_moves
+    scatter-SETs — a duplicated replica would desynchronize the cache), and
+    each destination broker receives at most one arrival per batch (the
+    broker-table append slot is claimed once)."""
     r = replicas.astype(jnp.int32)
     dst = dest_brokers.astype(jnp.int32)
     src = state_before.replica_broker[r]
@@ -330,6 +446,12 @@ def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
              * (valid & state_before.replica_is_leader[r]))
     lbi = _scatter_pm(cache.leader_bytes_in, s, d, lbi_w)
 
+    if cache.broker_table.shape[1]:
+        table, fill = _update_table_for_moves(state_before, cache, r, dst,
+                                              valid)
+    else:
+        table, fill = cache.broker_table, cache.table_fill
+
     return RoundCache(
         broker_load=broker_load,
         broker_util=broker_load / cap,
@@ -340,6 +462,8 @@ def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
         broker_topic_count=btc,
         potential_nw_out=pot,
         leader_bytes_in=lbi,
+        broker_table=table,
+        table_fill=fill,
     )
 
 
@@ -379,7 +503,8 @@ def update_cache_for_leadership(state_before: ClusterState, cache: RoundCache,
             state_before.replica_base_load[dr, Resource.NW_IN] * valid]),
         mode="drop")
 
-    # counts / racks / topics / potential NW_OUT are leadership-invariant
+    # counts / racks / topics / potential NW_OUT / the broker table are
+    # leadership-invariant (a transfer moves no replica between brokers)
     return RoundCache(
         broker_load=broker_load,
         broker_util=broker_load / cap,
@@ -390,4 +515,6 @@ def update_cache_for_leadership(state_before: ClusterState, cache: RoundCache,
         broker_topic_count=cache.broker_topic_count,
         potential_nw_out=cache.potential_nw_out,
         leader_bytes_in=lbi,
+        broker_table=cache.broker_table,
+        table_fill=cache.table_fill,
     )

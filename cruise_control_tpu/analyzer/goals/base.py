@@ -53,6 +53,16 @@ class Goal(abc.ABC):
     def configure(self, props) -> None:  # pragma: no cover - plugin hook
         """Config hook for getConfiguredInstances."""
 
+    def rounds_for(self, ctx: OptimizationContext) -> int:
+        """Effective round budget: fast mode (reference
+        OptimizationOptions.fastMode — reduced search effort) quarters the
+        budget for soft goals; hard goals keep theirs, since an
+        unconverged hard goal aborts the optimization."""
+        if ctx.fast_mode and not self.is_hard:
+            # max_rounds stays a ceiling: fast mode must never search MORE
+            return min(self.max_rounds, max(8, self.max_rounds // 4))
+        return self.max_rounds
+
     # ---- optimization ----
     @abc.abstractmethod
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
@@ -79,6 +89,21 @@ class Goal(abc.ABC):
         return jnp.ones(jnp.broadcast_shapes(src_replica.shape,
                                              dest_replica.shape), dtype=bool)
 
+    def accept_swap(self, state: ClusterState, ctx: OptimizationContext,
+                    cache: RoundCache, out_replica: jax.Array,
+                    in_replica: jax.Array) -> jax.Array:
+        """bool mask: acceptance of EXCHANGING `out_replica` and
+        `in_replica` between their brokers (reference Goal.actionAcceptance
+        → INTER_BROKER_REPLICA_SWAP).  Unlike two isolated moves, a swap's
+        net effect on each broker is the *difference* of the two replicas —
+        goals that would veto either half in isolation (count caps, tight
+        load caps) can accept the exchange.  Conservative default: both
+        directions must pass accept_move."""
+        b_in = state.replica_broker[in_replica]
+        b_out = state.replica_broker[out_replica]
+        return (self.accept_move(state, ctx, cache, out_replica, b_in)
+                & self.accept_move(state, ctx, cache, in_replica, b_out))
+
     # ---- violation surface (detector + hard-goal verification) ----
     def violated_brokers(self, state: ClusterState, ctx: OptimizationContext,
                          cache: RoundCache) -> jax.Array:
@@ -98,38 +123,44 @@ class Goal(abc.ABC):
         return f"<{type(self).__name__} {self.name}>"
 
 
-def run_phase_sweeps(state: ClusterState, phases, max_rounds: int
-                     ) -> ClusterState:
+def run_phase_sweeps(state: ClusterState, phases, max_rounds: int,
+                     table_slots: int = 0) -> ClusterState:
     """Run a goal's phases as progress-gated sub-loops inside an outer
     sweep loop.
 
-    `phases` is a sequence of `(body, work_exists)` pairs where
+    `phases` is a sequence of `(body, work_exists)` pairs — optionally
+    `(body, work_exists, per_sweep_cap)` — where
     `body(state, cache) -> (state, cache, committed)` performs one search
     round and `work_exists(state, cache) -> bool[]` is a cheap ([B]-sized)
-    predicate.  Each phase loops until it stops committing or its work
-    predicate clears; the outer loop repeats the sweep while any phase
-    committed (phases can re-enable each other, e.g. fills pushing a
-    destination over its upper bound).  `max_rounds` caps the TOTAL rounds
-    across all phases and sweeps.
+    predicate.  Each phase loops until it stops committing, its work
+    predicate clears, or it hits its per-sweep cap (the round-budget analog
+    of the reference's PER_BROKER_SWAP_TIMEOUT_MS for expensive phases);
+    the outer loop repeats the sweep while any phase committed (phases can
+    re-enable each other, e.g. fills pushing a destination over its upper
+    bound).  `max_rounds` caps the TOTAL rounds across all phases and
+    sweeps.
 
     Compared to gating phases with lax.cond inside one combined round,
     sub-loops add no branch-carry copies of the R-sized state — measured
     ~12% faster at 2.6K brokers / 600K replicas."""
-    def run_phase(st, cache, rounds, body_fn, work_fn):
+    def run_phase(st, cache, rounds, body_fn, work_fn, cap):
         def cond(c):
-            st, cache, rounds, progressed, _ = c
-            return (progressed & (rounds < max_rounds)
-                    & work_fn(st, cache))
+            st, cache, rounds, local, progressed, _ = c
+            ok = (progressed & (rounds < max_rounds)
+                  & work_fn(st, cache))
+            if cap is not None:
+                ok &= local < cap
+            return ok
 
         def body(c):
-            st, cache, rounds, _, any_committed = c
+            st, cache, rounds, local, _, any_committed = c
             st, cache, committed = body_fn(st, cache)
-            return (st, cache, rounds + 1, committed,
+            return (st, cache, rounds + 1, local + 1, committed,
                     any_committed | committed)
 
-        st, cache, rounds, _, any_committed = jax.lax.while_loop(
-            cond, body, (st, cache, rounds, jnp.ones((), bool),
-                         jnp.zeros((), bool)))
+        st, cache, rounds, _, _, any_committed = jax.lax.while_loop(
+            cond, body, (st, cache, rounds, jnp.zeros((), jnp.int32),
+                         jnp.ones((), bool), jnp.zeros((), bool)))
         return st, cache, rounds, any_committed
 
     def outer_cond(c):
@@ -139,16 +170,18 @@ def run_phase_sweeps(state: ClusterState, phases, max_rounds: int
     def outer_body(c):
         st, cache, rounds, _ = c
         sweep_again = jnp.zeros((), bool)
-        for body_fn, work_fn in phases:
+        for entry in phases:
+            body_fn, work_fn = entry[0], entry[1]
+            cap = entry[2] if len(entry) > 2 else None
             st, cache, rounds, committed = run_phase(st, cache, rounds,
-                                                     body_fn, work_fn)
+                                                     body_fn, work_fn, cap)
             sweep_again = sweep_again | committed
         return st, cache, rounds, sweep_again
 
     state, _, _, _ = jax.lax.while_loop(
         outer_cond, outer_body,
-        (state, make_round_cache(state), jnp.zeros((), jnp.int32),
-         jnp.ones((), bool)))
+        (state, make_round_cache(state, table_slots),
+         jnp.zeros((), jnp.int32), jnp.ones((), bool)))
     return state
 
 
@@ -172,6 +205,21 @@ def compose_move_acceptance(goals: Sequence[Goal], state: ClusterState,
                       dtype=bool)
         for goal in goals:
             ok &= goal.accept_move(state, ctx, cache, replica, dest_broker)
+        return ok
+    return fn
+
+
+def compose_swap_acceptance(goals: Sequence[Goal], state: ClusterState,
+                            ctx: OptimizationContext, cache: RoundCache
+                            ) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """AND of accept_swap over `goals` (reference
+    AnalyzerUtils.isProposalAcceptableForOptimizedGoals for swap actions)."""
+    def fn(out_replica: jax.Array, in_replica: jax.Array) -> jax.Array:
+        ok = jnp.ones(jnp.broadcast_shapes(out_replica.shape,
+                                           in_replica.shape), dtype=bool)
+        for goal in goals:
+            ok &= goal.accept_swap(state, ctx, cache, out_replica,
+                                   in_replica)
         return ok
     return fn
 

@@ -72,7 +72,7 @@ class CapacityGoal(Goal):
                 cand_r, cand_f, cand_v = kernels.leadership_round(
                     st, bonus, W - limit, movable, ctx.broker_leader_ok,
                     limit - W, accept_all, -W / jnp.maximum(limit, 1e-9),
-                    ctx.partition_replicas)
+                    ctx.partition_replicas, cache=cache)
                 st, cache = kernels.commit_leadership_cached(
                     st, cache, cand_r, cand_f, cand_v)
                 committed |= jnp.any(cand_v)
@@ -87,7 +87,8 @@ class CapacityGoal(Goal):
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, W > limit, W - limit, movable,
                 ctx.broker_dest_ok & st.broker_alive, limit - W, accept,
-                -W / jnp.maximum(limit, 1e-9), ctx.partition_replicas)
+                -W / jnp.maximum(limit, 1e-9), ctx.partition_replicas,
+                cache=cache)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             committed |= jnp.any(cand_v)
@@ -98,7 +99,7 @@ class CapacityGoal(Goal):
             still_violated = jnp.any(
                 (cache.broker_load[:, res] > self._limit(st, ctx))
                 & st.broker_alive)
-            return progressed & still_violated & (rounds < self.max_rounds)
+            return progressed & still_violated & (rounds < self.rounds_for(ctx))
 
         def body(carry):
             st, cache, rounds, _ = carry
@@ -106,7 +107,7 @@ class CapacityGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state),
+            cond, body, (state, make_round_cache(state, ctx.table_slots),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
@@ -117,6 +118,21 @@ class CapacityGoal(Goal):
         limit = self._limit(state, ctx)
         w = cache.replica_load[:, res][replica]
         return cache.broker_load[:, res][dest_broker] + w <= limit[dest_broker]
+
+    def accept_swap(self, state, ctx, cache, out_replica, in_replica):
+        """Net-delta form: each side's load changes by the *difference* of
+        the exchanged replicas (reference CapacityGoal actionAcceptance for
+        INTER_BROKER_REPLICA_SWAP)."""
+        res = int(self.resource)
+        limit = self._limit(state, ctx)
+        W = cache.broker_load[:, res]
+        w_out = cache.replica_load[:, res][out_replica]
+        w_in = cache.replica_load[:, res][in_replica]
+        b_out = state.replica_broker[out_replica]
+        b_in = state.replica_broker[in_replica]
+        d = w_out - w_in
+        return ((W[b_out] - d <= limit[b_out])
+                & (W[b_in] + d <= limit[b_in]))
 
     def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
         if self.resource not in (Resource.NW_OUT, Resource.CPU):
@@ -182,7 +198,7 @@ class ReplicaCapacityGoal(Goal):
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, count > limit, count - limit, movable,
                 ctx.broker_dest_ok & st.broker_alive, limit - count, accept,
-                -count, ctx.partition_replicas)
+                -count, ctx.partition_replicas, cache=cache)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -190,7 +206,7 @@ class ReplicaCapacityGoal(Goal):
         def cond(carry):
             st, cache, rounds, progressed = carry
             count = cache.replica_count.astype(jnp.float32)
-            return (progressed & (rounds < self.max_rounds)
+            return (progressed & (rounds < self.rounds_for(ctx))
                     & jnp.any((count > limit) & st.broker_alive))
 
         def body(carry):
@@ -199,7 +215,7 @@ class ReplicaCapacityGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state),
+            cond, body, (state, make_round_cache(state, ctx.table_slots),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
@@ -208,6 +224,12 @@ class ReplicaCapacityGoal(Goal):
         ones = jnp.ones(jnp.broadcast_shapes(replica.shape,
                                              dest_broker.shape), bool)
         return ones & (cache.replica_count[dest_broker] + 1 <= limit)
+
+    def accept_swap(self, state, ctx, cache, out_replica, in_replica):
+        """A one-for-one exchange leaves both brokers' replica counts
+        unchanged — always acceptable."""
+        return jnp.ones(jnp.broadcast_shapes(out_replica.shape,
+                                             in_replica.shape), dtype=bool)
 
     def violated_brokers(self, state, ctx, cache):
         return state.broker_alive & (

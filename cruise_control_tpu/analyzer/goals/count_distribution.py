@@ -79,7 +79,7 @@ class ReplicaDistributionGoal(Goal):
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, counts > upper, counts - upper, movable,
                 dest_ok & (counts + 1 <= upper), upper - counts, accept,
-                -counts, ctx.partition_replicas)
+                -counts, ctx.partition_replicas, cache=cache)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -94,7 +94,8 @@ class ReplicaDistributionGoal(Goal):
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, counts > avg, counts - lower, movable,
                 dest_ok & (counts < lower), upper - counts, accept,
-                -counts, ctx.partition_replicas, strict_allowance=True)
+                -counts, ctx.partition_replicas, strict_allowance=True,
+                cache=cache)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -108,7 +109,7 @@ class ReplicaDistributionGoal(Goal):
 
         return run_phase_sweeps(
             state, [(phase_shed, over_exists), (phase_fill, under_exists)],
-            self.max_rounds)
+            self.rounds_for(ctx), table_slots=ctx.table_slots)
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         counts = self._counts(cache)
@@ -126,6 +127,19 @@ class ReplicaDistributionGoal(Goal):
         # goal) cannot change this goal's counts — always acceptable
         # (reference accepts non-leader replica moves unconditionally)
         return ones & ((w == 0) | jnp.where(ok_before, strict, relaxed))
+
+    def accept_swap(self, state, ctx, cache, out_replica, in_replica):
+        """A one-for-one exchange preserves each broker's count of this
+        goal's weighted replicas when both sides weigh the same (always for
+        plain replica counts; for leader counts, when both or neither lead);
+        otherwise fall back to the per-direction move checks."""
+        w = self._weights(state)
+        same = w[out_replica] == w[in_replica]
+        b_out = state.replica_broker[out_replica]
+        b_in = state.replica_broker[in_replica]
+        both = (self.accept_move(state, ctx, cache, out_replica, b_in)
+                & self.accept_move(state, ctx, cache, in_replica, b_out))
+        return same | both
 
     def violated_brokers(self, state, ctx, cache):
         counts = self._counts(cache)
@@ -170,14 +184,15 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
                 jnp.float32)
             cand_r, cand_f, cand_v = kernels.leadership_round(
                 st, bonus, counts - upper, movable, ctx.broker_leader_ok,
-                upper - counts, accept_all, -counts, ctx.partition_replicas)
+                upper - counts, accept_all, -counts, ctx.partition_replicas,
+                cache=cache)
             st, cache = kernels.commit_leadership_cached(st, cache, cand_r,
                                                          cand_f, cand_v)
             return st, cache, jnp.any(cand_v)
 
         def cond(carry):
             _, _, rounds, progressed = carry
-            return progressed & (rounds < self.max_rounds)
+            return progressed & (rounds < self.rounds_for(ctx))
 
         def body(carry):
             st, cache, rounds, _ = carry
@@ -185,7 +200,7 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state),
+            cond, body, (state, make_round_cache(state, ctx.table_slots),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
@@ -254,14 +269,14 @@ class TopicReplicaDistributionGoal(Goal):
             counts = cache.replica_count.astype(jnp.float32)
             cand_r, cand_d, cand_v = kernels.forced_move_round(
                 st, movable, w, dest_ok_b, accept_all, -counts,
-                ctx.partition_replicas)
+                ctx.partition_replicas, cache=cache)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
 
         def cond(carry):
             _, _, rounds, progressed = carry
-            return progressed & (rounds < self.max_rounds)
+            return progressed & (rounds < self.rounds_for(ctx))
 
         def body(carry):
             st, cache, rounds, _ = carry
@@ -269,7 +284,7 @@ class TopicReplicaDistributionGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state),
+            cond, body, (state, make_round_cache(state, ctx.table_slots),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
@@ -282,6 +297,17 @@ class TopicReplicaDistributionGoal(Goal):
         relaxed = tc[dest_broker, t] + 1 <= tc[src, t]
         ok_before = tc[dest_broker, t] <= upper[t]
         return jnp.where(ok_before, strict, relaxed)
+
+    def accept_swap(self, state, ctx, cache, out_replica, in_replica):
+        """Same-topic exchanges leave per-topic counts untouched; mixed
+        topics fall back to the per-direction move checks."""
+        t = state.partition_topic[state.replica_partition]
+        same = t[out_replica] == t[in_replica]
+        b_out = state.replica_broker[out_replica]
+        b_in = state.replica_broker[in_replica]
+        both = (self.accept_move(state, ctx, cache, out_replica, b_in)
+                & self.accept_move(state, ctx, cache, in_replica, b_out))
+        return same | both
 
     def violated_brokers(self, state, ctx, cache):
         tc = cache.broker_topic_count.astype(jnp.float32)

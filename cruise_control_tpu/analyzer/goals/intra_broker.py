@@ -103,7 +103,7 @@ class IntraBrokerDiskCapacityGoal(Goal):
             st, rounds, progressed = carry
             over_any = jnp.any(st.disk_alive
                                & (S.disk_load(st) > limit))
-            return progressed & (rounds < self.max_rounds) & over_any
+            return progressed & (rounds < self.rounds_for(ctx)) & over_any
 
         def body(carry):
             st, rounds, _ = carry
@@ -170,7 +170,7 @@ class IntraBrokerDiskUsageDistributionGoal(Goal):
             dload, _target_v, upper, lower = _target(st)
             unbalanced = jnp.any(st.disk_alive
                                  & ((dload > upper) | (dload < lower)))
-            return progressed & (rounds < self.max_rounds) & unbalanced
+            return progressed & (rounds < self.rounds_for(ctx)) & unbalanced
 
         def body(carry):
             st, rounds, _ = carry

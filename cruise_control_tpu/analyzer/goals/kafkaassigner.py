@@ -24,7 +24,7 @@ from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  make_round_cache)
 from cruise_control_tpu.analyzer.goals.base import (Goal,
-                                                    compose_move_acceptance)
+                                                    compose_swap_acceptance)
 from cruise_control_tpu.analyzer.goals.rack_aware import RackAwareGoal
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
@@ -47,10 +47,7 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
 
     def _dest_pref(self, st: ClusterState, cache) -> jax.Array:
         # fewest replicas first (vs the parent's lowest disk utilization)
-        counts = jax.ops.segment_sum(
-            st.replica_valid.astype(jnp.float32), st.replica_broker,
-            num_segments=st.num_brokers)
-        return -counts
+        return -cache.replica_count.astype(jnp.float32)
 
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
@@ -96,20 +93,21 @@ class KafkaAssignerDiskUsageDistributionGoal(Goal):
                     & (pct < avg * (1 - self.balance_margin)))
             movable = (st.replica_valid & ~ctx.replica_excluded
                        & ctx.replica_movable & ~st.replica_offline)
-            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            accept = compose_swap_acceptance(prev_goals, st, ctx, cache)
             w = cache.replica_load[:, Resource.DISK]
             # per-broker absolute target: same relative fill everywhere
             target = avg * cap
             out_r, in_r, cold_idx, valid = kernels.swap_round(
                 st, w, movable, hot, cold, util, target,
-                lambda r, d: accept(r, d), ctx.partition_replicas)
+                lambda r, d: accept(r, d), ctx.partition_replicas,
+                cache=cache)
             st, cache = kernels.commit_swaps_cached(st, cache, out_r, in_r,
                                                     cold_idx, valid)
             return st, cache, jnp.any(valid)
 
         def cond(carry):
             _, _, rounds, progressed = carry
-            return progressed & (rounds < self.max_rounds)
+            return progressed & (rounds < self.rounds_for(ctx))
 
         def body(carry):
             st, cache, rounds, _ = carry
@@ -117,7 +115,7 @@ class KafkaAssignerDiskUsageDistributionGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state),
+            cond, body, (state, make_round_cache(state, ctx.table_slots),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 

@@ -62,7 +62,7 @@ class PotentialNwOutGoal(Goal):
                 st, w, pot > limit, pot - limit, movable,
                 ctx.broker_dest_ok & st.broker_alive, limit - pot,
                 accept_all, -pot / jnp.maximum(limit, 1e-9),
-                ctx.partition_replicas)
+                ctx.partition_replicas, cache=cache)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -70,7 +70,7 @@ class PotentialNwOutGoal(Goal):
         def cond(carry):
             st, cache, rounds, progressed = carry
             pot = cache.potential_nw_out
-            return (progressed & (rounds < self.max_rounds)
+            return (progressed & (rounds < self.rounds_for(ctx))
                     & jnp.any((pot > self._limit(st, ctx)) & st.broker_alive))
 
         def body(carry):
@@ -79,7 +79,7 @@ class PotentialNwOutGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state),
+            cond, body, (state, make_round_cache(state, ctx.table_slots),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
@@ -93,6 +93,21 @@ class PotentialNwOutGoal(Goal):
         under_after = pot[dest_broker] + w <= limit[dest_broker]
         # a destination already violating only accepts load-free replicas
         return under_after | (w <= 0.0)
+
+    def accept_swap(self, state, ctx, cache, out_replica, in_replica):
+        """Net-delta form over the potential (leader-role) NW_OUT each
+        side trades; like accept_move's zero-load escape, a side that the
+        exchange improves (or leaves untouched) is acceptable even while
+        still over the limit."""
+        w = self._leader_role_nw_out(state)
+        limit = self._limit(state, ctx)
+        pot = cache.potential_nw_out
+        b_out = state.replica_broker[out_replica]
+        b_in = state.replica_broker[in_replica]
+        d = w[out_replica] - w[in_replica]
+        ok_out = (pot[b_out] - d <= limit[b_out]) | (d >= 0)
+        ok_in = (pot[b_in] + d <= limit[b_in]) | (d <= 0)
+        return ok_out & ok_in
 
     def violated_brokers(self, state, ctx, cache):
         return state.broker_alive & (
@@ -142,14 +157,14 @@ class LeaderBytesInDistributionGoal(Goal):
 
             cand_r, cand_f, cand_v = kernels.leadership_round(
                 st, bonus, lbi - upper, movable, ctx.broker_leader_ok,
-                upper - lbi, accept_all, -lbi, ctx.partition_replicas)
+                upper - lbi, accept_all, -lbi, ctx.partition_replicas, cache=cache)
             st, cache = kernels.commit_leadership_cached(st, cache, cand_r,
                                                          cand_f, cand_v)
             return st, cache, jnp.any(cand_v)
 
         def cond(carry):
             _, _, rounds, progressed = carry
-            return progressed & (rounds < self.max_rounds)
+            return progressed & (rounds < self.rounds_for(ctx))
 
         def body(carry):
             st, cache, rounds, _ = carry
@@ -157,7 +172,7 @@ class LeaderBytesInDistributionGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state),
+            cond, body, (state, make_round_cache(state, ctx.table_slots),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 

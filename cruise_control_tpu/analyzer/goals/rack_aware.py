@@ -92,14 +92,15 @@ class RackAwareGoal(Goal):
                 st, movable, w, dest_ok_b, accept_all,
                 self._dest_pref(st, cache), ctx.partition_replicas,
                 cap_alive_sources=any(g.source_side_acceptance
-                                      for g in prev_goals))
+                                      for g in prev_goals),
+                cache=cache)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
 
         def cond(carry):
             st, cache, rounds, progressed = carry
-            return (progressed & (rounds < self.max_rounds)
+            return (progressed & (rounds < self.rounds_for(ctx))
                     & jnp.any(self._redundant_mask(
                         st, cache.partition_rack_count)))
 
@@ -109,7 +110,7 @@ class RackAwareGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state),
+            cond, body, (state, make_round_cache(state, ctx.table_slots),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 

@@ -27,7 +27,7 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  make_round_cache)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
-    new_broker_dest_mask, run_phase_sweeps)
+    compose_swap_acceptance, new_broker_dest_mask, run_phase_sweeps)
 from cruise_control_tpu.common.resources import (RESOURCE_GOAL_NAMES,
                                                  Resource)
 from cruise_control_tpu.model import state as S
@@ -40,8 +40,12 @@ class ResourceDistributionGoal(Goal):
     resource: Resource = Resource.DISK
     is_hard = False
 
-    def __init__(self, max_rounds: int = 64):
+    def __init__(self, max_rounds: int = 64, max_swap_rounds: int = 16):
         self.max_rounds = max_rounds
+        #: per-sweep cap on swap rounds — the round-budget analog of the
+        #: reference's PER_BROKER_SWAP_TIMEOUT_MS = 1000 per-broker swap
+        #: search budget (ResourceDistributionGoal.java:53)
+        self.max_swap_rounds = max_swap_rounds
         self.name = (RESOURCE_GOAL_NAMES[int(self.resource)]
                      + "UsageDistributionGoal")
 
@@ -97,7 +101,7 @@ class ResourceDistributionGoal(Goal):
                 st, bonus, W - upper, movable, ctx.broker_leader_ok,
                 upper - W, accept_all,
                 -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
-                ctx.partition_replicas)
+                ctx.partition_replicas, cache=cache)
             st, cache = kernels.commit_leadership_cached(
                 st, cache, cand_r, cand_f, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -113,7 +117,7 @@ class ResourceDistributionGoal(Goal):
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, W > upper, W - upper, movable,
                 self._dest_mask(st, ctx), upper - W, accept,
-                dest_pref, ctx.partition_replicas)
+                dest_pref, ctx.partition_replicas, cache=cache)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -133,10 +137,32 @@ class ResourceDistributionGoal(Goal):
                 st, w, W > avg_w, W - lower, movable, under, upper - W,
                 accept,
                 -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
-                ctx.partition_replicas, strict_allowance=True)
+                ctx.partition_replicas, strict_allowance=True, cache=cache)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
+
+        def phase_swap(st, cache):
+            """Swap phase: trade a large replica on an over-limit broker
+            for a small one on a below-average broker when plain moves are
+            exhausted — e.g. both sides replica-count-constrained
+            (reference ResourceDistributionGoal.java:307-433, swap fallback
+            inside rebalanceByMovingLoadOut)."""
+            W = cache.broker_load[:, res]
+            w = cache.replica_load[:, res]
+            movable = (st.replica_valid & ~ctx.replica_excluded
+                       & ctx.replica_movable & ~st.replica_offline
+                       & (w > 0.0))
+            accept = compose_swap_acceptance(prev_goals, st, ctx, cache)
+            hot = st.broker_alive & (W > upper)
+            target = (upper + lower) / 2.0
+            cold = self._dest_mask(st, ctx) & (W < target)
+            out_r, in_r, cold_idx, valid = kernels.swap_round(
+                st, w, movable, hot, cold, W, target, accept,
+                ctx.partition_replicas, cache=cache)
+            st, cache = kernels.commit_swaps_cached(st, cache, out_r, in_r,
+                                                    cold_idx, valid)
+            return st, cache, jnp.any(valid)
 
         def over_exists(st, cache):
             return jnp.any(st.broker_alive
@@ -149,12 +175,24 @@ class ResourceDistributionGoal(Goal):
             return jnp.any(self._dest_mask(st, ctx)
                            & (cache.broker_load[:, res] < lower))
 
+        def swap_work_exists(st, cache):
+            W = cache.broker_load[:, res]
+            target = (upper + lower) / 2.0
+            return (jnp.any(st.broker_alive & (W > upper))
+                    & jnp.any(self._dest_mask(st, ctx) & (W < target)))
+
         phases = []
         if self._leadership_applicable():
             phases.append((phase_a, over_exists))
         phases.append((phase_b, over_exists))
         phases.append((phase_c, under_exists))
-        state = run_phase_sweeps(state, phases, self.max_rounds)
+        if self.max_swap_rounds and not ctx.fast_mode:
+            # fast mode (reference OptimizationOptions.fastMode) skips the
+            # expensive swap fallback entirely
+            phases.append((phase_swap, swap_work_exists,
+                           self.max_swap_rounds))
+        state = run_phase_sweeps(state, phases, self.rounds_for(ctx),
+                                 table_slots=ctx.table_slots)
         return state
 
     # -- acceptance (as a previously-optimized goal) -----------------------
@@ -180,6 +218,28 @@ class ResourceDistributionGoal(Goal):
         relaxed = ((W[dest_broker] + w) / cap[dest_broker]
                    <= W[src] / cap[src])
         return jnp.where(src_ok_before & dest_ok_before, strict, relaxed)
+
+    def accept_swap(self, state, ctx, cache, out_replica, in_replica):
+        """Net-delta form: accept when each side ends within this goal's
+        bounds or strictly closer to the band midpoint than before."""
+        res = int(self.resource)
+        W = cache.broker_load[:, res]
+        cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
+        lower = ctx.balance_lower_pct[res] * cap
+        upper = ctx.balance_upper_pct[res] * cap
+        mid = (lower + upper) / 2.0
+        w_out = cache.replica_load[:, res][out_replica]
+        w_in = cache.replica_load[:, res][in_replica]
+        b_out = state.replica_broker[out_replica]
+        b_in = state.replica_broker[in_replica]
+        d = w_out - w_in
+
+        def side_ok(b, after):
+            in_bounds = (after >= lower[b]) & (after <= upper[b])
+            closer = jnp.abs(after - mid[b]) <= jnp.abs(W[b] - mid[b])
+            return in_bounds | closer
+
+        return (side_ok(b_out, W[b_out] - d) & side_ok(b_in, W[b_in] + d))
 
     def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
         if not self._leadership_applicable():

@@ -58,6 +58,11 @@ class OptimizerResult:
     regressed_goals: List[str]
     final_state: ClusterState
     duration_s: float = 0.0
+    #: per-goal violated-broker counts {goal: (before, after)} — the
+    #: detector/bench quality instrument (reference exposes per-goal
+    #: violation detail via GoalViolations)
+    violated_broker_counts: Dict[str, Tuple[int, int]] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def num_replica_movements(self) -> int:
@@ -146,19 +151,22 @@ class GoalOptimizer:
         self._jit_goals = jit_goals
         #: goals per compiled program (see optimizations docstring)
         self.pipeline_segment_size = pipeline_segment_size
+        #: when True, block after each segment and log its wall-clock
+        #: (sync points cost transport latency — profiling only)
+        self.profile_segments = False
         self._compiled: Dict[str, object] = {}
 
     def _pre_fn(self):
-        """(state, ctx) -> (violated_before bool[G], healed state,
+        """(state, ctx) -> (violated_broker_counts i32[G], healed state,
         still_offline)."""
         goals = tuple(self.goals)
 
         def run(state: ClusterState, ctx: OptimizationContext):
             cache0 = make_round_cache(state)
             violated_before = (
-                jnp.stack([g.violated_brokers(state, ctx, cache0).any()
-                           for g in goals])
-                if goals else jnp.zeros((0,), dtype=bool))
+                jnp.stack([g.violated_brokers(state, ctx, cache0)
+                           .sum(dtype=jnp.int32) for g in goals])
+                if goals else jnp.zeros((0,), dtype=jnp.int32))
             needs_heal = S.self_healing_eligible(state).any()
             state = jax.lax.cond(
                 needs_heal, lambda s: heal_offline_replicas(s, ctx),
@@ -183,15 +191,71 @@ class GoalOptimizer:
         return run
 
     def _post_fn(self):
-        """(state, ctx) -> violated_after bool[G]."""
+        """(state, ctx) -> violated_broker_counts i32[G]."""
         goals = tuple(self.goals)
 
         def run(state: ClusterState, ctx: OptimizationContext):
             cache1 = make_round_cache(state)
-            return (jnp.stack([g.violated_brokers(state, ctx, cache1).any()
-                               for g in goals])
-                    if goals else jnp.zeros((0,), dtype=bool))
+            return (jnp.stack([g.violated_brokers(state, ctx, cache1)
+                               .sum(dtype=jnp.int32) for g in goals])
+                    if goals else jnp.zeros((0,), dtype=jnp.int32))
         return run
+
+    def warmup(self, state: ClusterState, topology,
+               options: Optional[OptimizationOptions] = None,
+               max_workers: int = 8, attempts: int = 4) -> float:
+        """AOT-compile every pipeline program for `state`'s shapes, in
+        parallel, seeding the persistent compilation cache.
+
+        A cold sequential warmup run compiles each segment one after the
+        other (the pipeline is data-dependent), paying the SUM of compile
+        times — ~27 min at 2.6K-broker scale.  Compilation itself has no
+        data dependencies, so `jax.jit(fn).lower(args).compile()` for all
+        programs concurrently costs roughly the SLOWEST program instead.
+        The compiled executables are discarded; the later real call hits
+        the persistent cache (JAX_COMPILATION_CACHE_DIR) and pays only a
+        lookup.  Compile-transport errors are retried per program.
+
+        Returns wall-clock seconds spent."""
+        import concurrent.futures
+        import time as _time
+
+        t0 = _time.time()
+        if not jax.config.jax_compilation_cache_dir:
+            # the compiled executables are discarded; without a persistent
+            # cache the real run re-compiles everything from scratch and
+            # this warmup only DOUBLES the compile work
+            LOG.warning("warmup without jax_compilation_cache_dir set: "
+                        "compiles cannot be handed off to the real run")
+        options = options or OptimizationOptions()
+        ctx = make_context(state, self.constraint, options, topology)
+        seg = max(1, self.pipeline_segment_size)
+        jobs = [("__stats__", compute_stats, (state,)),
+                ("__pre__", self._pre_fn(), (state, ctx)),
+                ("__post__", self._post_fn(), (state, ctx))]
+        for start in range(0, len(self.goals), seg):
+            stop = min(start + seg, len(self.goals))
+            jobs.append((f"__seg_{start}_{stop}__",
+                         self._segment_fn(start, stop), (state, ctx)))
+
+        def compile_one(job):
+            key, fn, args = job
+            for attempt in range(attempts):
+                try:
+                    jax.jit(fn).lower(*args).compile()
+                    return key
+                except jax.errors.JaxRuntimeError as exc:
+                    LOG.warning("warmup compile %s attempt %d failed: %s",
+                                key, attempt,
+                                str(exc).splitlines()[0][:120])
+                    _time.sleep(5.0)
+            jax.jit(fn).lower(*args).compile()
+            return key
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers) as pool:
+            for key in pool.map(compile_one, jobs):
+                LOG.debug("warmed %s", key)
+        return _time.time() - t0
 
     def optimizations(self, state: ClusterState, topology,
                       options: Optional[OptimizationOptions] = None,
@@ -214,15 +278,25 @@ class GoalOptimizer:
         stats_before = jax.device_get(stats_fn(state))
 
         t0 = time.time()
+        profile = self.profile_segments
         pre = self._get_compiled("__pre__", self._pre_fn())
         vb_dev, state, still_dev = pre(state, ctx)
+        if profile:
+            jax.block_until_ready(state.replica_broker)
+            LOG.info("segment pre+heal: %.0fms", (time.time() - t0) * 1e3)
         seg = max(1, self.pipeline_segment_size)
         stacked_parts = []
         for start in range(0, len(self.goals), seg):
             stop = min(start + seg, len(self.goals))
             fn = self._get_compiled(f"__seg_{start}_{stop}__",
                                     self._segment_fn(start, stop))
+            t_seg = time.time()
             state, stacked_seg = fn(state, ctx)
+            if profile:
+                jax.block_until_ready(state.replica_broker)
+                LOG.info("segment %s: %.0fms",
+                         "+".join(g.name for g in self.goals[start:stop]),
+                         (time.time() - t_seg) * 1e3)
             stacked_parts.append(stacked_seg)
         post = self._get_compiled("__post__", self._post_fn())
         va_dev = post(state, ctx)
@@ -244,6 +318,8 @@ class GoalOptimizer:
 
         violated_before = [g.name for g, v in zip(self.goals, vb_h) if v]
         violated_after = [g.name for g, v in zip(self.goals, va_h) if v]
+        violated_counts = {g.name: (int(b), int(a)) for g, b, a
+                           in zip(self.goals, vb_h, va_h)}
 
         stats_by_goal: Dict[str, ClusterModelStats] = {}
         regressed: List[str] = []
@@ -280,6 +356,7 @@ class GoalOptimizer:
             regressed_goals=regressed,
             final_state=state,
             duration_s=time.time() - t_start,
+            violated_broker_counts=violated_counts,
         )
         result.hard_goal_names = frozenset(
             g.name for g in self.goals if g.is_hard)

@@ -23,6 +23,29 @@ from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
                                                        random_cluster)
 
 
+def _assert_table_equal(cache, state):
+    """The broker table must hold exactly the valid replicas of each broker
+    (row order is irrelevant — holes and append order are implementation
+    detail), and every fill pointer must cover its row's live entries."""
+    s = cache.broker_table.shape[1]
+    if not s:
+        return
+    tab = np.asarray(cache.broker_table)
+    fill = np.asarray(cache.table_fill)
+    rb = np.asarray(state.replica_broker)
+    valid = np.asarray(state.replica_valid)
+    num_r = state.num_replicas
+    for b in range(state.num_brokers):
+        row = tab[b][tab[b] < num_r]
+        expect = np.nonzero(valid & (rb == b))[0]
+        np.testing.assert_array_equal(np.sort(row), np.sort(expect),
+                                      err_msg=f"broker {b} table row")
+        live_slots = np.nonzero(tab[b] < num_r)[0]
+        if live_slots.size:
+            assert fill[b] > live_slots.max(), (
+                f"broker {b} fill pointer below a live slot")
+
+
 def _assert_cache_equal(cache, fresh, atol=1e-3):
     np.testing.assert_allclose(np.asarray(cache.broker_load),
                                np.asarray(fresh.broker_load),
@@ -161,6 +184,86 @@ def test_swaps_update_cache(cluster):
                                                cold, valid)
     assert bool(np.asarray(valid).any())
     _assert_cache_equal(cache, make_round_cache(state))
+
+
+def test_table_maintenance_through_kernels(cluster):
+    """Table-carrying cache: drive real move rounds and assert the table's
+    row membership tracks the state exactly (holes + append pointers)."""
+    state, ctx = cluster
+    cache = make_round_cache(state, ctx.table_slots)
+    _assert_table_equal(cache, state)
+    res = int(Resource.DISK)
+    for _ in range(6):
+        W = cache.broker_load[:, res]
+        cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
+        avg = jnp.sum(W) / jnp.sum(cap)
+        upper = avg * 1.02 * cap
+        accept = lambda r, d: jnp.ones(
+            jnp.broadcast_shapes(r.shape, d.shape), bool)
+        cand_r, cand_d, cand_v = kernels.move_round(
+            state, cache.replica_load[:, res], W > upper, W - upper,
+            state.replica_valid & ~state.replica_offline,
+            state.broker_alive, upper - W, accept, -W / cap,
+            ctx.partition_replicas, cache=cache)
+        state, cache = kernels.commit_moves_cached(state, cache, cand_r,
+                                                   cand_d, cand_v)
+        _assert_cache_equal(cache, make_round_cache(state))
+        _assert_table_equal(cache, state)
+
+
+def test_table_compaction_small_slots(cluster):
+    """Force the in-row sort compaction: width barely above the fullest
+    broker, then out-then-in cycles on that broker — each departure leaves
+    a hole, each arrival appends, so the fill pointer outruns the count
+    until the compaction branch re-packs the rows.  Membership must
+    survive repeated compactions exactly."""
+    state, ctx = cluster
+    counts = np.asarray(make_round_cache(state).replica_count)
+    target = int(np.argmax(counts))
+    slots = int(counts.max()) + 3
+    cache = make_round_cache(state, slots)
+    _assert_table_equal(cache, state)
+    pr = np.asarray(ctx.partition_replicas)
+    part = np.asarray(state.replica_partition)
+    rng = np.random.RandomState(3)
+    compacted = False
+
+    def pick(src_mask, dst):
+        rb = np.asarray(state.replica_broker)
+        valid = np.asarray(state.replica_valid)
+        cand = np.nonzero(valid & src_mask(rb))[0]
+        rng.shuffle(cand)
+        for r in cand:
+            sib_b = rb[pr[part[r]][pr[part[r]] >= 0]]
+            if dst not in sib_b:
+                return int(r)
+        return -1
+
+    for _ in range(12):
+        other = int(rng.randint(state.num_brokers))
+        if other == target:
+            continue
+        # hole: one replica leaves the target broker
+        r_out = pick(lambda rb: rb == target, other)
+        if r_out < 0:
+            continue
+        state, cache = kernels.commit_moves_cached(
+            state, cache, jnp.asarray([r_out], jnp.int32),
+            jnp.asarray([other], jnp.int32), jnp.asarray([True]))
+        _assert_table_equal(cache, state)
+        # append: a different replica arrives — fill grows past the count
+        r_in = pick(lambda rb: rb != target, target)
+        if r_in < 0:
+            continue
+        fill_before = int(np.asarray(cache.table_fill)[target])
+        state, cache = kernels.commit_moves_cached(
+            state, cache, jnp.asarray([r_in], jnp.int32),
+            jnp.asarray([target], jnp.int32), jnp.asarray([True]))
+        fill_after = int(np.asarray(cache.table_fill)[target])
+        if fill_after != fill_before + 1:
+            compacted = True                # sort re-packed the rows
+        _assert_table_equal(cache, state)
+    assert compacted, "compaction branch never executed — raise step count"
 
 
 def test_dest_shortlist_truncation_and_escalation(monkeypatch):

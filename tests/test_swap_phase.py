@@ -1,0 +1,86 @@
+"""Swap-phase tests for ResourceDistributionGoal.
+
+Reference behavior being covered: when plain replica moves cannot balance a
+resource — e.g. every broker is replica-count-constrained so a move OUT
+would be rejected by a previously-optimized count goal — the reference
+falls back to replica SWAPS between an over- and an under-utilized broker
+(reference ResourceDistributionGoal.java:307-433, swap budget :53).
+"""
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions,
+                                                 make_context)
+from cruise_control_tpu.analyzer.goals.capacity import ReplicaCapacityGoal
+from cruise_control_tpu.analyzer.goals.resource_distribution import (
+    DiskUsageDistributionGoal)
+from cruise_control_tpu.common.resources import Resource as R
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.builder import ClusterModelBuilder
+
+CAPACITY = {R.CPU: 100.0, R.NW_IN: 1000.0, R.NW_OUT: 1000.0, R.DISK: 1000.0}
+
+
+def _tight_hot_cold():
+    """Two brokers, 4 single-replica partitions each, max 4 replicas per
+    broker.  Broker 0 holds the big-disk partitions (800 total = 80% fill),
+    broker 1 the small ones (80 total = 8%).  A move would put 5 replicas
+    on one broker — rejected by ReplicaCapacityGoal — so only swaps can
+    balance disk."""
+    b = ClusterModelBuilder()
+    b.add_broker(0, "A", CAPACITY)
+    b.add_broker(1, "B", CAPACITY)
+    for p in range(4):
+        b.add_partition("hot", p, 0, [],
+                        {R.CPU: 5.0, R.NW_IN: 10.0, R.NW_OUT: 10.0,
+                         R.DISK: 200.0})
+    for p in range(4):
+        b.add_partition("cold", p, 1, [],
+                        {R.CPU: 5.0, R.NW_IN: 10.0, R.NW_OUT: 10.0,
+                         R.DISK: 20.0})
+    return b.build()
+
+
+def _disk_spread(state):
+    load = np.asarray(S.broker_load(state))[:, R.DISK]
+    cap = np.asarray(state.broker_capacity)[:, R.DISK]
+    util = load / cap
+    return util.max() - util.min()
+
+
+def test_swaps_balance_when_moves_cannot():
+    state, topo = _tight_hot_cold()
+    constraint = BalancingConstraint(max_replicas_per_broker=4)
+    ctx = make_context(state, constraint, OptimizationOptions(), topo)
+    cap_goal = ReplicaCapacityGoal()
+    goal = DiskUsageDistributionGoal(max_rounds=32)
+
+    before = _disk_spread(state)
+    out = goal.optimize(state, ctx, (cap_goal,))
+    after = _disk_spread(out)
+
+    counts = np.asarray(S.broker_replica_count(out))
+    assert counts.tolist() == [4, 4], "swap must preserve replica counts"
+    assert after < before - 0.1, (
+        f"swaps should have balanced disk: spread {before:.3f} -> {after:.3f}")
+
+
+def test_no_swaps_when_disabled():
+    state, topo = _tight_hot_cold()
+    constraint = BalancingConstraint(max_replicas_per_broker=4)
+    ctx = make_context(state, constraint, OptimizationOptions(), topo)
+    goal = DiskUsageDistributionGoal(max_rounds=32, max_swap_rounds=0)
+    out = goal.optimize(state, ctx, (ReplicaCapacityGoal(),))
+    # with the swap phase off and moves blocked, nothing can change
+    assert _disk_spread(out) == pytest.approx(_disk_spread(state))
+
+
+def test_fast_mode_skips_swap_phase():
+    state, topo = _tight_hot_cold()
+    constraint = BalancingConstraint(max_replicas_per_broker=4)
+    ctx = make_context(state, constraint,
+                       OptimizationOptions(fast_mode=True), topo)
+    goal = DiskUsageDistributionGoal(max_rounds=32)
+    out = goal.optimize(state, ctx, (ReplicaCapacityGoal(),))
+    assert _disk_spread(out) == pytest.approx(_disk_spread(state))
