@@ -250,14 +250,29 @@ class RoundCache:
     leader_bytes_in: jax.Array       # f32[B] NW_IN carried by leaders
     # Per-broker replica table: row b lists the replica ids currently on
     # broker b (pad = R).  Replaces ragged [R]-segment argmax (a TPU
-    # scatter, ~12ms at R=600K) with dense row-wise reductions (~0.1ms) for
+    # scatter, ~12ms at R=600K) with dense row-wise reductions for
     # per-broker candidate selection, and makes per-broker top-k free.
     # Width 0 disables the table.  Removals leave pad holes at the vacated
     # slot; arrivals append at `table_fill` (an append POINTER, >= the true
-    # count while holes exist); rows are re-packed by an in-row sort when
-    # any fill pointer nears S (see _maybe_compact_table).
+    # count while holes exist); rows are re-packed by an in-row argsort
+    # when any fill pointer nears S.
+    #
+    # The aux tables mirror the hot per-replica attributes per slot so a
+    # round's candidate scoring is pure elementwise + row-wise reduction:
+    # gathers on this hardware run at ~140M elem/s (measured), so
+    # re-gathering scores over a [B, S] id table cost ~10-60ms per round —
+    # the dominant cost of round-based optimization.  Slots whose id is
+    # the pad value carry stale aux data; every consumer masks on
+    # `broker_table < R` first.
     broker_table: jax.Array       # i32[B, S] replica ids, pad = R
     table_fill: jax.Array         # i32[B] append pointer per row
+    table_load: jax.Array         # f32[B, S, RES] current-role load
+    table_bonus: jax.Array        # f32[B, S, RES] leadership bonus
+    table_leader: jax.Array       # bool[B, S] replica currently leads
+    table_ok: jax.Array           # bool[B, S] static eligibility (valid &
+    #                               not excluded & movable & not offline)
+    replica_ok: jax.Array         # bool[R] same, replica-indexed (for
+    #                               arrivals; [0] placeholder when no table)
 
 
 def leader_nw_in(state: ClusterState) -> jax.Array:
@@ -291,14 +306,51 @@ def build_broker_table(state: ClusterState, table_slots: int
     return table, fill
 
 
-def make_round_cache(state: ClusterState, table_slots: int = 0) -> RoundCache:
+def replica_static_ok(state: ClusterState,
+                      ctx: Optional["OptimizationContext"]) -> jax.Array:
+    """bool[R] — the per-replica eligibility terms that stay constant for
+    the whole optimize() call (offline only changes in the pre-goal heal
+    pass, which runs table-less)."""
+    ok = state.replica_valid & ~state.replica_offline
+    if ctx is not None:
+        ok = ok & ~ctx.replica_excluded & ctx.replica_movable
+    return ok
+
+
+def _gather_aux_tables(state: ClusterState, table: jax.Array,
+                       ctx: Optional["OptimizationContext"]):
+    """One-time gathers of the hot per-replica attributes into [B, S, .]
+    tables (amortized over every round of the goal)."""
+    num_r = state.num_replicas
+    tab_safe = jnp.minimum(table, num_r - 1)
+    pad = table >= num_r
+    load = S.replica_current_load(state)[tab_safe]           # [B, S, RES]
+    bonus = state.partition_leader_bonus[
+        state.replica_partition[tab_safe]]                   # [B, S, RES]
+    leader = state.replica_is_leader[tab_safe] & ~pad
+    ok = replica_static_ok(state, ctx)[tab_safe] & ~pad
+    return load, bonus, leader, ok
+
+
+def make_round_cache(state: ClusterState, table_slots: int = 0,
+                     ctx: Optional["OptimizationContext"] = None
+                     ) -> RoundCache:
     load = S.broker_load(state)
     cap = jnp.maximum(state.broker_capacity, 1e-9)
+    num_b = state.num_brokers
     if table_slots:
         table, fill = build_broker_table(state, table_slots)
+        t_load, t_bonus, t_leader, t_ok = _gather_aux_tables(state, table,
+                                                             ctx)
+        r_ok = replica_static_ok(state, ctx)
     else:
-        table = jnp.zeros((state.num_brokers, 0), dtype=jnp.int32)
-        fill = jnp.zeros((state.num_brokers,), dtype=jnp.int32)
+        table = jnp.zeros((num_b, 0), dtype=jnp.int32)
+        fill = jnp.zeros((num_b,), dtype=jnp.int32)
+        t_load = jnp.zeros((num_b, 0, NUM_RESOURCES), dtype=jnp.float32)
+        t_bonus = jnp.zeros((num_b, 0, NUM_RESOURCES), dtype=jnp.float32)
+        t_leader = jnp.zeros((num_b, 0), dtype=bool)
+        t_ok = jnp.zeros((num_b, 0), dtype=bool)
+        r_ok = jnp.zeros((1,), dtype=bool)
     return RoundCache(
         broker_load=load,
         broker_util=load / cap,
@@ -313,6 +365,11 @@ def make_round_cache(state: ClusterState, table_slots: int = 0) -> RoundCache:
             num_segments=state.num_brokers),
         broker_table=table,
         table_fill=fill,
+        table_load=t_load,
+        table_bonus=t_bonus,
+        table_leader=t_leader,
+        table_ok=t_ok,
+        replica_ok=r_ok,
     )
 
 
@@ -336,10 +393,22 @@ def _scatter_pm(arr: jax.Array, s: jax.Array, d: jax.Array,
         jnp.concatenate([-x, x]), mode="drop")
 
 
+def _row_slot_of(table: jax.Array, brokers: jax.Array, r: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """(slot i32[C], found bool[C]) — locate replica r[i] in row
+    brokers[i] by matching ids ([C, S] compare; avoids maintaining a
+    replica→slot index and its R-sized scatters)."""
+    rows = table[brokers]                                # [C, S]
+    slot = jnp.argmax(rows == r[:, None], axis=1)
+    found = jnp.take_along_axis(rows, slot[:, None], axis=1)[:, 0] == r
+    return slot, found
+
+
 def _update_table_for_moves(state_before: ClusterState, cache: RoundCache,
-                            r: jax.Array, dst: jax.Array, valid: jax.Array
-                            ) -> Tuple[jax.Array, jax.Array]:
-    """Maintain (broker_table, table_fill) across a committed move batch.
+                            r: jax.Array, dst: jax.Array,
+                            valid: jax.Array) -> dict:
+    """Maintain the broker table and its aux tables across a committed
+    move batch; returns the table-field updates as a dict.
 
     Invariants relied on (the search kernels guarantee them):
       * at most ONE arrival per destination broker per batch (destinations
@@ -347,39 +416,68 @@ def _update_table_for_moves(state_before: ClusterState, cache: RoundCache,
         two arrivals would claim the same append slot;
       * destinations were eligible only while `table_fill < S`, so the
         append slot is in range.
-    Departures per source are unbounded (holes are fine)."""
+    Departures per source are unbounded (holes are fine; aux values at
+    holes go stale and every consumer masks on id < R first)."""
     num_r = state_before.num_replicas
     num_b = state_before.num_brokers
     s = cache.broker_table.shape[1]
     src = state_before.replica_broker[r]
 
     # departures: locate each mover's slot in its source row, punch a hole
-    rows = cache.broker_table[src]                       # [C, S]
-    slot = jnp.argmax(rows == r[:, None], axis=1)
-    found = jnp.take_along_axis(rows, slot[:, None], axis=1)[:, 0] == r
+    # in the id table AND in table_ok — the other aux tables may go stale
+    # at holes because every consumer masks through table_ok, which must
+    # therefore be False at every non-live slot
+    slot, found = _row_slot_of(cache.broker_table, src, r)
     flat = cache.broker_table.reshape(-1)
     oob = num_b * s
-    flat = flat.at[jnp.where(valid & found, src * s + slot, oob)].set(
-        num_r, mode="drop")
+    rem_idx = jnp.where(valid & found, src * s + slot, oob)
+    flat = flat.at[rem_idx].set(num_r, mode="drop")
 
-    # arrivals: append at the destination's fill pointer (<= 1 per dest)
+    # arrivals: append at the destination's fill pointer (<= 1 per dest),
+    # carrying the mover's attributes into the aux tables
     aslot = cache.table_fill[dst]
-    flat = flat.at[jnp.where(valid & (aslot < s), dst * s + aslot, oob)].set(
-        r, mode="drop")
+    a_idx = jnp.where(valid & (aslot < s), dst * s + aslot, oob)
+    flat = flat.at[a_idx].set(r, mode="drop")
     table = flat.reshape(num_b, s)
     fill = cache.table_fill.at[jnp.where(valid, dst, num_b)].add(
         1, mode="drop")
 
-    # re-pack when any append pointer nears the edge: in-row sort pushes the
-    # pad value (num_r, larger than any replica id) to the end
-    def compact(t):
-        return jnp.sort(t, axis=1)
+    t_load = cache.table_load.reshape(-1, NUM_RESOURCES).at[a_idx].set(
+        cache.replica_load[r], mode="drop").reshape(cache.table_load.shape)
+    bonus_r = state_before.partition_leader_bonus[
+        state_before.replica_partition[r]]
+    t_bonus = cache.table_bonus.reshape(-1, NUM_RESOURCES).at[a_idx].set(
+        bonus_r, mode="drop").reshape(cache.table_bonus.shape)
+    t_leader = cache.table_leader.reshape(-1).at[a_idx].set(
+        state_before.replica_is_leader[r], mode="drop").reshape(
+        cache.table_leader.shape)
+    t_ok_flat = cache.table_ok.reshape(-1).at[rem_idx].set(
+        False, mode="drop")
+    t_ok = t_ok_flat.at[a_idx].set(
+        cache.replica_ok[jnp.minimum(r, cache.replica_ok.shape[0] - 1)],
+        mode="drop").reshape(cache.table_ok.shape)
+
+    # re-pack when any append pointer nears the edge: argsort by id pushes
+    # the pad value (num_r, larger than any replica id) to the end, and
+    # the same permutation re-packs every aux table
+    def compact(tabs):
+        table, t_load, t_bonus, t_leader, t_ok = tabs
+        order = jnp.argsort(table, axis=1)
+        return (jnp.take_along_axis(table, order, axis=1),
+                jnp.take_along_axis(t_load, order[:, :, None], axis=1),
+                jnp.take_along_axis(t_bonus, order[:, :, None], axis=1),
+                jnp.take_along_axis(t_leader, order, axis=1),
+                jnp.take_along_axis(t_ok, order, axis=1))
 
     need = jnp.max(fill) >= s - 1
-    table = jax.lax.cond(need, compact, lambda t: t, table)
+    table, t_load, t_bonus, t_leader, t_ok = jax.lax.cond(
+        need, compact, lambda t: t,
+        (table, t_load, t_bonus, t_leader, t_ok))
     true_count = jnp.sum(table < num_r, axis=1).astype(jnp.int32)
     fill = jnp.where(need, true_count, fill)
-    return table, fill
+    return dict(broker_table=table, table_fill=fill, table_load=t_load,
+                table_bonus=t_bonus, table_leader=t_leader, table_ok=t_ok,
+                replica_ok=cache.replica_ok)
 
 
 def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
@@ -447,10 +545,15 @@ def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
     lbi = _scatter_pm(cache.leader_bytes_in, s, d, lbi_w)
 
     if cache.broker_table.shape[1]:
-        table, fill = _update_table_for_moves(state_before, cache, r, dst,
-                                              valid)
+        tables = _update_table_for_moves(state_before, cache, r, dst, valid)
     else:
-        table, fill = cache.broker_table, cache.table_fill
+        tables = dict(broker_table=cache.broker_table,
+                      table_fill=cache.table_fill,
+                      table_load=cache.table_load,
+                      table_bonus=cache.table_bonus,
+                      table_leader=cache.table_leader,
+                      table_ok=cache.table_ok,
+                      replica_ok=cache.replica_ok)
 
     return RoundCache(
         broker_load=broker_load,
@@ -462,8 +565,7 @@ def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
         broker_topic_count=btc,
         potential_nw_out=pot,
         leader_bytes_in=lbi,
-        broker_table=table,
-        table_fill=fill,
+        **tables,
     )
 
 
@@ -503,8 +605,30 @@ def update_cache_for_leadership(state_before: ClusterState, cache: RoundCache,
             state_before.replica_base_load[dr, Resource.NW_IN] * valid]),
         mode="drop")
 
-    # counts / racks / topics / potential NW_OUT / the broker table are
-    # leadership-invariant (a transfer moves no replica between brokers)
+    # counts / racks / topics / potential NW_OUT / table membership are
+    # leadership-invariant (a transfer moves no replica between brokers);
+    # the aux tables track the role change: the demoted slot sheds the
+    # bonus, the promoted slot gains it, and the leader flags flip
+    t_load = cache.table_load
+    t_leader = cache.table_leader
+    if cache.broker_table.shape[1]:
+        s_dim = cache.broker_table.shape[1]
+        num_b2 = state_before.num_brokers
+        oob_t = num_b2 * s_dim
+        src_slot, src_found = _row_slot_of(cache.broker_table, b_src, sr)
+        dst_slot, dst_found = _row_slot_of(cache.broker_table, b_dst, dr)
+        src_idx = jnp.where(valid & src_found, b_src * s_dim + src_slot,
+                            oob_t)
+        dst_idx = jnp.where(valid & dst_found, b_dst * s_dim + dst_slot,
+                            oob_t)
+        flat_load = t_load.reshape(-1, NUM_RESOURCES)
+        flat_load = flat_load.at[jnp.concatenate([src_idx, dst_idx])].add(
+            jnp.concatenate([-bonus, bonus]), mode="drop")
+        t_load = flat_load.reshape(t_load.shape)
+        flat_lead = t_leader.reshape(-1)
+        flat_lead = flat_lead.at[src_idx].set(False, mode="drop")
+        flat_lead = flat_lead.at[dst_idx].set(True, mode="drop")
+        t_leader = flat_lead.reshape(t_leader.shape)
     return RoundCache(
         broker_load=broker_load,
         broker_util=broker_load / cap,
@@ -517,4 +641,9 @@ def update_cache_for_leadership(state_before: ClusterState, cache: RoundCache,
         leader_bytes_in=lbi,
         broker_table=cache.broker_table,
         table_fill=cache.table_fill,
+        table_load=t_load,
+        table_bonus=cache.table_bonus,
+        table_leader=t_leader,
+        table_ok=cache.table_ok,
+        replica_ok=cache.replica_ok,
     )

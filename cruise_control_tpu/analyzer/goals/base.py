@@ -124,7 +124,9 @@ class Goal(abc.ABC):
 
 
 def run_phase_sweeps(state: ClusterState, phases, max_rounds: int,
-                     table_slots: int = 0) -> ClusterState:
+                     table_slots: int = 0,
+                     ctx: Optional[OptimizationContext] = None
+                     ) -> ClusterState:
     """Run a goal's phases as progress-gated sub-loops inside an outer
     sweep loop.
 
@@ -180,9 +182,46 @@ def run_phase_sweeps(state: ClusterState, phases, max_rounds: int,
 
     state, _, _, _ = jax.lax.while_loop(
         outer_cond, outer_body,
-        (state, make_round_cache(state, table_slots),
+        (state, make_round_cache(state, table_slots, ctx),
          jnp.zeros((), jnp.int32), jnp.ones((), bool)))
     return state
+
+
+def shed_rows(cache: RoundCache, w_rows: jax.Array, src_ok_b: jax.Array,
+              excess_b: jax.Array, require_positive: bool = True,
+              strict: bool = False) -> jax.Array:
+    """[B, S] NEG-masked shed-score plane from the resident aux tables —
+    the row form of kernels.shed_score + the eligibility masks, built
+    without any [R]-sized gather (see kernels.move_round sc_rows)."""
+    from cruise_control_tpu.analyzer import kernels
+    ok = cache.table_ok & src_ok_b[:, None]
+    if require_positive:
+        ok = ok & (w_rows > 0.0)
+    if strict:
+        ok = ok & (w_rows <= excess_b[:, None])
+    sc = jnp.where(w_rows <= excess_b[:, None], w_rows, -w_rows)
+    return jnp.where(ok, sc, kernels.NEG)
+
+
+def leader_shed_rows(cache: RoundCache, value_rows: jax.Array,
+                     src_ok_b: jax.Array, excess_b: jax.Array
+                     ) -> jax.Array:
+    """[B, S] NEG-masked plane of leadership-transfer candidates: leaders
+    whose transferable value is positive, on source brokers, shed-scored
+    against the row's excess."""
+    from cruise_control_tpu.analyzer import kernels
+    ok = (cache.table_ok & cache.table_leader & src_ok_b[:, None]
+          & (value_rows > 0.0))
+    sc = jnp.where(value_rows <= excess_b[:, None], value_rows,
+                   -value_rows)
+    return jnp.where(ok, sc, kernels.NEG)
+
+
+def dest_side_only(prev_goals: Sequence[Goal]) -> bool:
+    """True when every previously-optimized goal's move acceptance is
+    destination-side — the precondition for multi-commit per source
+    broker (kernels.move_round per_src_k)."""
+    return all(not g.source_side_acceptance for g in prev_goals)
 
 
 def new_broker_dest_mask(state: ClusterState, base: jax.Array) -> jax.Array:

@@ -21,7 +21,8 @@ from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  make_round_cache)
 from cruise_control_tpu.analyzer.goals.base import (
-    Goal, compose_leadership_acceptance, compose_move_acceptance)
+    Goal, compose_leadership_acceptance, compose_move_acceptance,
+    dest_side_only, leader_shed_rows, shed_rows)
 from cruise_control_tpu.common.resources import (RESOURCE_GOAL_NAMES,
                                                  Resource)
 from cruise_control_tpu.model import state as S
@@ -49,15 +50,19 @@ class CapacityGoal(Goal):
         res = int(self.resource)
         leadership_helps = self.resource in (Resource.NW_OUT, Resource.CPU)
 
+        multi_k = 4 if dest_side_only(prev_goals) else 1
+        # loop-invariant [R] arrays hoisted out of the round body
+        bonus = (state.partition_leader_bonus[state.replica_partition, res]
+                 * state.replica_valid)
+        base_movable = (state.replica_valid & ~ctx.replica_excluded
+                        & ctx.replica_movable & ~state.replica_offline)
+
         def round_body(st: ClusterState, cache):
             committed = jnp.zeros((), dtype=bool)
             if leadership_helps:
                 limit = self._limit(st, ctx)
                 W = cache.broker_load[:, res]
-                bonus = (st.partition_leader_bonus[st.replica_partition, res]
-                         * st.replica_valid)
-                movable = (st.replica_valid & ~ctx.replica_excluded
-                           & ctx.replica_movable & ~st.replica_offline)
+                movable = base_movable
                 accept = compose_leadership_acceptance(prev_goals, st, ctx,
                                                        cache)
 
@@ -69,10 +74,14 @@ class CapacityGoal(Goal):
                         <= limit[db])
                     return fits & accept(src_r, dst_r)
 
+                value_rows = cache.table_bonus[:, :, res]
                 cand_r, cand_f, cand_v = kernels.leadership_round(
                     st, bonus, W - limit, movable, ctx.broker_leader_ok,
                     limit - W, accept_all, -W / jnp.maximum(limit, 1e-9),
-                    ctx.partition_replicas, cache=cache)
+                    ctx.partition_replicas, cache=cache,
+                    bonus_rows=leader_shed_rows(cache, value_rows,
+                                                W > limit, W - limit),
+                    value_rows=value_rows)
                 st, cache = kernels.commit_leadership_cached(
                     st, cache, cand_r, cand_f, cand_v)
                 committed |= jnp.any(cand_v)
@@ -80,15 +89,16 @@ class CapacityGoal(Goal):
             limit = self._limit(st, ctx)
             W = cache.broker_load[:, res]
             w = cache.replica_load[:, res]
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline
-                       & (w > 0.0))
+            movable = base_movable & (w > 0.0)
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, W > limit, W - limit, movable,
                 ctx.broker_dest_ok & st.broker_alive, limit - W, accept,
                 -W / jnp.maximum(limit, 1e-9), ctx.partition_replicas,
-                cache=cache)
+                cache=cache,
+                sc_rows=shed_rows(cache, cache.table_load[:, :, res],
+                                  W > limit, W - limit),
+                per_src_k=multi_k)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             committed |= jnp.any(cand_v)
@@ -107,7 +117,7 @@ class CapacityGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots),
+            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
@@ -189,16 +199,24 @@ class ReplicaCapacityGoal(Goal):
                  prev_goals: Sequence[Goal]) -> ClusterState:
         limit = float(ctx.max_replicas_per_broker)
 
+        multi_k = 4 if dest_side_only(prev_goals) else 1
+
+        base_movable = (state.replica_valid & ~ctx.replica_excluded
+                        & ctx.replica_movable & ~state.replica_offline)
+
         def round_body(st: ClusterState, cache):
             count = cache.replica_count.astype(jnp.float32)
             w = jnp.ones(st.num_replicas, dtype=jnp.float32)
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline)
+            ones_rows = jnp.ones_like(cache.table_ok, dtype=jnp.float32)
+            movable = base_movable
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, count > limit, count - limit, movable,
                 ctx.broker_dest_ok & st.broker_alive, limit - count, accept,
-                -count, ctx.partition_replicas, cache=cache)
+                -count, ctx.partition_replicas, cache=cache,
+                sc_rows=shed_rows(cache, ones_rows, count > limit,
+                                  count - limit),
+                per_src_k=multi_k)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -215,7 +233,7 @@ class ReplicaCapacityGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots),
+            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 

@@ -21,7 +21,8 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  make_round_cache)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
-    new_broker_dest_mask, run_phase_sweeps)
+    dest_side_only, leader_shed_rows, new_broker_dest_mask,
+    run_phase_sweeps, shed_rows)
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
 
@@ -52,6 +53,11 @@ class ReplicaDistributionGoal(Goal):
     def _counts(self, cache) -> jax.Array:
         return cache.replica_count.astype(jnp.float32)
 
+    def _weight_rows(self, state: ClusterState, cache) -> jax.Array:
+        """[B, S] per-slot weights mirroring _weights (1 per valid
+        replica for plain counts)."""
+        return jnp.ones_like(cache.table_ok, dtype=jnp.float32)
+
     def _avg(self, state: ClusterState, counts: jax.Array) -> jax.Array:
         alive = state.broker_alive
         return jnp.sum(counts * alive) / jnp.maximum(jnp.sum(alive), 1)
@@ -69,33 +75,40 @@ class ReplicaDistributionGoal(Goal):
         dest_ok = new_broker_dest_mask(
             state, ctx.broker_dest_ok & state.broker_alive)
 
+        w_static = self._weights(state)
+        base_movable = (state.replica_valid & ~ctx.replica_excluded
+                        & ctx.replica_movable & ~state.replica_offline
+                        & (w_static > 0.0))
+
         def phase_shed(st, cache):
             counts = self._counts(cache)
-            w = self._weights(st)
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline
-                       & (w > 0.0))
+            w = w_static
+            movable = base_movable
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, counts > upper, counts - upper, movable,
                 dest_ok & (counts + 1 <= upper), upper - counts, accept,
-                -counts, ctx.partition_replicas, cache=cache)
+                -counts, ctx.partition_replicas, cache=cache,
+                sc_rows=shed_rows(cache, self._weight_rows(st, cache),
+                                  counts > upper, counts - upper),
+                per_src_k=4 if dest_side_only(prev_goals) else 1)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
 
         def phase_fill(st, cache):
             counts = self._counts(cache)
-            w = self._weights(st)
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline
-                       & (w > 0.0))
+            w = w_static
+            movable = base_movable
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, counts > avg, counts - lower, movable,
                 dest_ok & (counts < lower), upper - counts, accept,
                 -counts, ctx.partition_replicas, strict_allowance=True,
-                cache=cache)
+                cache=cache,
+                sc_rows=shed_rows(cache, self._weight_rows(st, cache),
+                                  counts > avg, counts - lower,
+                                  strict=True))
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -109,7 +122,7 @@ class ReplicaDistributionGoal(Goal):
 
         return run_phase_sweeps(
             state, [(phase_shed, over_exists), (phase_fill, under_exists)],
-            self.rounds_for(ctx), table_slots=ctx.table_slots)
+            self.rounds_for(ctx), table_slots=ctx.table_slots, ctx=ctx)
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         counts = self._counts(cache)
@@ -168,12 +181,14 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
+        base_movable = (state.replica_valid & ~ctx.replica_excluded
+                        & ctx.replica_movable & ~state.replica_offline)
+
         def round_body(st: ClusterState, cache):
             counts = self._counts(cache)
             avg = self._avg(st, counts)
             lower, upper = _count_bounds(avg, self.pct_margin)
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline)
+            movable = base_movable
             accept = compose_leadership_acceptance(prev_goals, st, ctx, cache)
 
             def accept_all(src_r, dst_r):
@@ -182,10 +197,15 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
 
             bonus = (st.replica_valid & st.replica_is_leader).astype(
                 jnp.float32)
+            value_rows = cache.table_leader.astype(jnp.float32)
             cand_r, cand_f, cand_v = kernels.leadership_round(
                 st, bonus, counts - upper, movable, ctx.broker_leader_ok,
                 upper - counts, accept_all, -counts, ctx.partition_replicas,
-                cache=cache)
+                cache=cache,
+                bonus_rows=leader_shed_rows(cache, value_rows,
+                                            counts > upper,
+                                            counts - upper),
+                value_rows=value_rows)
             st, cache = kernels.commit_leadership_cached(st, cache, cand_r,
                                                          cand_f, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -200,7 +220,7 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots),
+            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
@@ -284,7 +304,7 @@ class TopicReplicaDistributionGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots),
+            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 

@@ -100,7 +100,8 @@ class KafkaAssignerDiskUsageDistributionGoal(Goal):
             out_r, in_r, cold_idx, valid = kernels.swap_round(
                 st, w, movable, hot, cold, util, target,
                 lambda r, d: accept(r, d), ctx.partition_replicas,
-                cache=cache)
+                cache=cache,
+                w_rows=cache.table_load[:, :, Resource.DISK])
             st, cache = kernels.commit_swaps_cached(st, cache, out_r, in_r,
                                                     cold_idx, valid)
             return st, cache, jnp.any(valid)
@@ -115,7 +116,7 @@ class KafkaAssignerDiskUsageDistributionGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots),
+            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 

@@ -21,7 +21,8 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  leader_nw_in,
                                                  make_round_cache)
 from cruise_control_tpu.analyzer.goals.base import (
-    Goal, compose_leadership_acceptance, compose_move_acceptance)
+    Goal, compose_leadership_acceptance, compose_move_acceptance,
+    dest_side_only, leader_shed_rows, shed_rows)
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
@@ -46,23 +47,33 @@ class PotentialNwOutGoal(Goal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
+        # loop-invariant: the leader-ROLE load is leadership-independent
+        w_static = self._leader_role_nw_out(state)
+        base_movable = (state.replica_valid & ~ctx.replica_excluded
+                        & ctx.replica_movable & ~state.replica_offline
+                        & (w_static > 0.0))
+
         def round_body(st: ClusterState, cache):
             pot = cache.potential_nw_out
             limit = self._limit(st, ctx)
-            w = self._leader_role_nw_out(st)
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline
-                       & (w > 0.0))
+            w = w_static
+            movable = base_movable
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
 
             def accept_all(r, d):
                 return (pot[d] + w[r] <= limit[d]) & accept(r, d)
 
+            nwo = int(Resource.NW_OUT)
+            w_rows = (cache.table_load[:, :, nwo]
+                      + jnp.where(cache.table_leader, 0.0,
+                                  cache.table_bonus[:, :, nwo]))
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, pot > limit, pot - limit, movable,
                 ctx.broker_dest_ok & st.broker_alive, limit - pot,
                 accept_all, -pot / jnp.maximum(limit, 1e-9),
-                ctx.partition_replicas, cache=cache)
+                ctx.partition_replicas, cache=cache,
+                sc_rows=shed_rows(cache, w_rows, pot > limit, pot - limit),
+                per_src_k=4 if dest_side_only(prev_goals) else 1)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -79,7 +90,7 @@ class PotentialNwOutGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots),
+            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 
@@ -141,12 +152,16 @@ class LeaderBytesInDistributionGoal(Goal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
+        base_movable = (state.replica_valid & ~ctx.replica_excluded
+                        & ctx.replica_movable & ~state.replica_offline)
+
         def round_body(st: ClusterState, cache):
             lbi = cache.leader_bytes_in
             upper = self._bounds(st, lbi)
+            # leader_nw_in depends on the CURRENT leader flags — it must
+            # track this goal's own transfers, so it stays in-round
             bonus = self._leader_nw_in(st)
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline)
+            movable = base_movable
             accept = compose_leadership_acceptance(prev_goals, st, ctx, cache)
 
             def accept_all(src_r, dst_r):
@@ -155,9 +170,16 @@ class LeaderBytesInDistributionGoal(Goal):
                     src_r.shape, dst_r.shape))
                 return (lbi[db] + b <= upper) & accept(src_r, dst_r)
 
+            value_rows = jnp.where(cache.table_leader,
+                                   cache.table_load[:, :, Resource.NW_IN],
+                                   0.0)
             cand_r, cand_f, cand_v = kernels.leadership_round(
                 st, bonus, lbi - upper, movable, ctx.broker_leader_ok,
-                upper - lbi, accept_all, -lbi, ctx.partition_replicas, cache=cache)
+                upper - lbi, accept_all, -lbi, ctx.partition_replicas,
+                cache=cache,
+                bonus_rows=leader_shed_rows(cache, value_rows, lbi > upper,
+                                            lbi - upper),
+                value_rows=value_rows)
             st, cache = kernels.commit_leadership_cached(st, cache, cand_r,
                                                          cand_f, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -172,7 +194,7 @@ class LeaderBytesInDistributionGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots),
+            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 

@@ -110,7 +110,7 @@ class RackAwareGoal(Goal):
             return st, cache, rounds + 1, committed
 
         state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots),
+            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         return state
 

@@ -27,7 +27,8 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  make_round_cache)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
-    compose_swap_acceptance, new_broker_dest_mask, run_phase_sweeps)
+    compose_swap_acceptance, dest_side_only, leader_shed_rows,
+    new_broker_dest_mask, run_phase_sweeps, shed_rows)
 from cruise_control_tpu.common.resources import (RESOURCE_GOAL_NAMES,
                                                  Resource)
 from cruise_control_tpu.model import state as S
@@ -78,13 +79,17 @@ class ResourceDistributionGoal(Goal):
         SLOWER at 2.6K brokers), sub-loops add no branch-carry copies."""
         res = int(self.resource)
         lower, upper = self._bounds(state, ctx)    # capacity-only: static
+        # loop-invariant [R] arrays hoisted out of the round bodies: each
+        # in-round recomputation is an [R]-sized gather (~4-10ms at north
+        # scale with gathers at ~140M elem/s)
+        bonus = (state.partition_leader_bonus[state.replica_partition, res]
+                 * state.replica_valid)
+        base_movable = (state.replica_valid & ~ctx.replica_excluded
+                        & ctx.replica_movable & ~state.replica_offline)
 
         def phase_a(st, cache):
             W = cache.broker_load[:, res]
-            bonus = (st.partition_leader_bonus[st.replica_partition, res]
-                     * st.replica_valid)
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline)
+            movable = base_movable
             accept = compose_leadership_acceptance(prev_goals, st, ctx,
                                                    cache)
 
@@ -97,11 +102,15 @@ class ResourceDistributionGoal(Goal):
             def accept_all(src_r, dst_r):
                 return accept(src_r, dst_r) & self_accept(src_r, dst_r)
 
+            value_rows = cache.table_bonus[:, :, res]
             cand_r, cand_f, cand_v = kernels.leadership_round(
                 st, bonus, W - upper, movable, ctx.broker_leader_ok,
                 upper - W, accept_all,
                 -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
-                ctx.partition_replicas, cache=cache)
+                ctx.partition_replicas, cache=cache,
+                bonus_rows=leader_shed_rows(cache, value_rows, W > upper,
+                                            W - upper),
+                value_rows=value_rows)
             st, cache = kernels.commit_leadership_cached(
                 st, cache, cand_r, cand_f, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -109,15 +118,16 @@ class ResourceDistributionGoal(Goal):
         def phase_b(st, cache):
             W = cache.broker_load[:, res]
             w = cache.replica_load[:, res]
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline
-                       & (w > 0.0))
+            movable = base_movable & (w > 0.0)
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
             dest_pref = -W / jnp.maximum(st.broker_capacity[:, res], 1e-9)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, W > upper, W - upper, movable,
                 self._dest_mask(st, ctx), upper - W, accept,
-                dest_pref, ctx.partition_replicas, cache=cache)
+                dest_pref, ctx.partition_replicas, cache=cache,
+                sc_rows=shed_rows(cache, cache.table_load[:, :, res],
+                                  W > upper, W - upper),
+                per_src_k=4 if dest_side_only(prev_goals) else 1)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -128,16 +138,16 @@ class ResourceDistributionGoal(Goal):
             avg_w = (ctx.balance_upper_pct[res]
                      + ctx.balance_lower_pct[res]) \
                 / 2.0 * st.broker_capacity[:, res]
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline
-                       & (w > 0.0))
+            movable = base_movable & (w > 0.0)
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
             under = (W < lower) & self._dest_mask(st, ctx)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, W > avg_w, W - lower, movable, under, upper - W,
                 accept,
                 -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
-                ctx.partition_replicas, strict_allowance=True, cache=cache)
+                ctx.partition_replicas, strict_allowance=True, cache=cache,
+                sc_rows=shed_rows(cache, cache.table_load[:, :, res],
+                                  W > avg_w, W - lower, strict=True))
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -150,16 +160,15 @@ class ResourceDistributionGoal(Goal):
             inside rebalanceByMovingLoadOut)."""
             W = cache.broker_load[:, res]
             w = cache.replica_load[:, res]
-            movable = (st.replica_valid & ~ctx.replica_excluded
-                       & ctx.replica_movable & ~st.replica_offline
-                       & (w > 0.0))
+            movable = base_movable & (w > 0.0)
             accept = compose_swap_acceptance(prev_goals, st, ctx, cache)
             hot = st.broker_alive & (W > upper)
             target = (upper + lower) / 2.0
             cold = self._dest_mask(st, ctx) & (W < target)
             out_r, in_r, cold_idx, valid = kernels.swap_round(
                 st, w, movable, hot, cold, W, target, accept,
-                ctx.partition_replicas, cache=cache)
+                ctx.partition_replicas, cache=cache,
+                w_rows=cache.table_load[:, :, res])
             st, cache = kernels.commit_swaps_cached(st, cache, out_r, in_r,
                                                     cold_idx, valid)
             return st, cache, jnp.any(valid)
@@ -192,7 +201,7 @@ class ResourceDistributionGoal(Goal):
             phases.append((phase_swap, swap_work_exists,
                            self.max_swap_rounds))
         state = run_phase_sweeps(state, phases, self.rounds_for(ctx),
-                                 table_slots=ctx.table_slots)
+                                 table_slots=ctx.table_slots, ctx=ctx)
         return state
 
     # -- acceptance (as a previously-optimized goal) -----------------------

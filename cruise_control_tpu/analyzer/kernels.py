@@ -59,40 +59,65 @@ def _has_table(cache) -> bool:
     return cache is not None and cache.broker_table.shape[1] > 0
 
 
+def _combine(score: jax.Array, valid: jax.Array) -> jax.Array:
+    """Fold validity into the score so the table path pays ONE gather
+    (gathers run at ~140M elem/s on this hardware — two separate [B, S]
+    gathers of score and validity cost ~2x a fused one)."""
+    return jnp.where(valid, score, NEG)
+
+
+def _table_rows(cache, score: jax.Array, valid: jax.Array) -> jax.Array:
+    """[B, S] per-slot scores gathered from per-replica arrays (single
+    combined gather; pad slots gather the appended NEG sentinel)."""
+    combined = _combine(score, valid)
+    combined_p = jnp.concatenate(
+        [combined, jnp.full((1,), NEG, combined.dtype)])
+    return combined_p[cache.broker_table]
+
+
+def rows_pick_best(cache, sc_rows: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Per-broker argmax over a [B, S] score plane (NEG = ineligible).
+    Returns (cand i32[B] replica id or -1, has bool[B])."""
+    num_b = cache.broker_table.shape[0]
+    slot = jnp.argmax(sc_rows, axis=1)
+    mx = jnp.take_along_axis(sc_rows, slot[:, None], axis=1)[:, 0]
+    has = mx > NEG / 2
+    cand = jnp.where(has, cache.broker_table[jnp.arange(num_b), slot], -1)
+    return cand.astype(jnp.int32), has
+
+
+def rows_pick_topk(cache, sc_rows: jax.Array, k: int
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-broker top-k over a [B, S] score plane, flattened row-major.
+    Returns (cand i32[B*k], has bool[B*k], top_scores f32[B, k])."""
+    k = min(k, max(cache.broker_table.shape[1], 1))
+    top, slots = jax.lax.top_k(sc_rows, k)               # [B, k]
+    cand = jnp.take_along_axis(cache.broker_table, slots, axis=1)
+    has = top > NEG / 2
+    return (jnp.where(has, cand, -1).reshape(-1).astype(jnp.int32),
+            has.reshape(-1), top)
+
+
 def table_pick_best(cache, score: jax.Array, valid: jax.Array
                     ) -> Tuple[jax.Array, jax.Array]:
-    """Per-broker argmax over the [B, S] replica table — the dense
-    replacement for `per_segment_argmax(score, replica_broker, B, valid)`.
-    ~140x cheaper than the segment-scatter form at R=600K on v5e.
+    """Per-broker argmax over the [B, S] replica table from per-REPLICA
+    score/valid arrays (one combined gather) — the dense replacement for
+    `per_segment_argmax(score, replica_broker, B, valid)`.
 
     Returns (cand i32[B] replica id or -1, has bool[B]).
     """
-    num_b, s = cache.broker_table.shape
-    score_p = jnp.concatenate([score, jnp.full((1,), NEG, score.dtype)])
-    valid_p = jnp.concatenate([valid, jnp.zeros((1,), bool)])
-    tab = cache.broker_table
-    sc = jnp.where(valid_p[tab], score_p[tab], NEG)      # [B, S]
-    slot = jnp.argmax(sc, axis=1)
-    mx = jnp.take_along_axis(sc, slot[:, None], axis=1)[:, 0]
-    has = mx > NEG / 2
-    cand = jnp.where(has, tab[jnp.arange(num_b), slot], -1)
-    return cand.astype(jnp.int32), has
+    return rows_pick_best(cache, _table_rows(cache, score, valid))
 
 
 def table_pick_topk(cache, score: jax.Array, valid: jax.Array, k: int
                     ) -> Tuple[jax.Array, jax.Array]:
-    """Per-broker top-k over the [B, S] table, flattened to a candidate
-    list.  Returns (cand i32[B*k], has bool[B*k])."""
-    score_p = jnp.concatenate([score, jnp.full((1,), NEG, score.dtype)])
-    valid_p = jnp.concatenate([valid, jnp.zeros((1,), bool)])
-    tab = cache.broker_table
-    k = min(k, tab.shape[1])
-    sc = jnp.where(valid_p[tab], score_p[tab], NEG)      # [B, S]
-    top, slots = jax.lax.top_k(sc, k)                    # [B, k]
-    cand = jnp.take_along_axis(tab, slots, axis=1)
-    has = top > NEG / 2
-    return (jnp.where(has, cand, -1).reshape(-1).astype(jnp.int32),
-            has.reshape(-1))
+    """Per-broker top-k over the [B, S] table from per-replica arrays,
+    flattened to a candidate list.  Returns (cand i32[B*k], has bool[B*k]).
+    """
+    cand, has, _ = rows_pick_topk(cache, _table_rows(cache, score, valid),
+                                  k)
+    return cand, has
 
 
 def resolve_dest_conflicts(dest: jax.Array, gain: jax.Array, valid: jax.Array,
@@ -140,6 +165,26 @@ def _dest_feasibility(state: ClusterState, cand_r: jax.Array,
         feasible &= ~dup
     feasible &= accept_matrix_fn(cand_r[:, None], dest_ids[None, :])
     return feasible
+
+
+def cand_has_dest(state: ClusterState, cand_r: jax.Array, w_c: jax.Array,
+                  dest_ok: jax.Array, dest_headroom: jax.Array,
+                  partition_replicas: jax.Array) -> jax.Array:
+    """bool[C] — candidate-level form of `feasible_dest_exists` (same top
+    RF+2 headroom argument), evaluated only on C chosen candidates instead
+    of all R replicas."""
+    num_b = state.num_brokers
+    rf = partition_replicas.shape[1]
+    k = min(rf + 2, num_b)
+    ok_headroom = jnp.where(dest_ok, dest_headroom, -jnp.inf)
+    top_h, top_b = jax.lax.top_k(ok_headroom, k)
+    sib = partition_replicas[state.replica_partition[cand_r]]   # [C, RF]
+    sib_broker = jnp.where(sib >= 0,
+                           state.replica_broker[jnp.maximum(sib, 0)], -1)
+    blocked = jnp.any(sib_broker[:, :, None] == top_b[None, None, :],
+                      axis=1)                                   # [C, k]
+    best = jnp.max(jnp.where(blocked, -jnp.inf, top_h[None, :]), axis=1)
+    return best >= w_c
 
 
 def feasible_dest_exists(state: ClusterState, w: jax.Array,
@@ -201,6 +246,8 @@ def move_round(state: ClusterState,
                forced: Optional[jax.Array] = None,
                strict_allowance: bool = False,
                cache=None,
+               sc_rows: Optional[jax.Array] = None,
+               per_src_k: int = 1,
                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One round of batched replica-move search.
 
@@ -226,9 +273,23 @@ def move_round(state: ClusterState,
         isLoadAboveBalanceLowerLimitAfterChange REMOVE check).
       cache: RoundCache; when it carries a broker table, candidate
         selection runs on the dense [B, S] plane instead of segment ops.
+      sc_rows: optional f32[B, S] — the shed-score plane computed by the
+        GOAL from the resident aux tables (NEG = ineligible, src/excess
+        masks already applied).  When given, selection is pure row-wise
+        reduction with ZERO [R]-sized gathers (gathers cost ~7ns/element
+        on this hardware — re-gathering scores per round was the dominant
+        round cost).  The [R] args remain the semantic source of truth for
+        the rare starvation-escalation rounds.
+      per_src_k: candidates per source broker per round (multi-commit).
+        ONLY safe when every previously-optimized goal's acceptance is
+        destination-side (source_side_acceptance False) — k departures
+        from one broker share the round's acceptance snapshot.  A
+        cumulative-excess gate keeps a source from overshooting its own
+        target by more than one replica, mirroring the reference's
+        while-still-over greedy loop.
 
     Returns (cand_replica i32[C], cand_dest i32[C], cand_valid bool[C]) with
-    C == num_brokers (one candidate per source broker).
+    C == num_brokers * per_src_k.
     """
     num_b = state.num_brokers
     rb = state.replica_broker
@@ -236,28 +297,78 @@ def move_round(state: ClusterState,
         # a full table row cannot take the round's single arrival
         dest_ok = dest_ok & (cache.table_fill < cache.broker_table.shape[1])
 
-    has_dest = feasible_dest_exists(state, w, dest_ok, dest_headroom,
-                                    partition_replicas)
-    eligible = movable & src_ok[rb] & has_dest
-    if strict_allowance:
-        eligible &= w <= src_excess[rb]
-    if forced is not None:
-        eligible = eligible | (movable & forced & has_dest)
-        # forced replicas outrank everything else on their broker
-        score = jnp.where(forced, w + 1e12, shed_score(w, src_excess[rb]))
-    else:
-        score = shed_score(w, src_excess[rb])
+    if sc_rows is not None and _has_table(cache) and forced is None:
+        kk = min(per_src_k, max(cache.broker_table.shape[1], 1))
+        cand_r, cand_struct, top_sc = rows_pick_topk(cache, sc_rows, kk)
+        cand_r_safe = jnp.maximum(cand_r, 0)
+        cand_w = w[cand_r_safe]
+        hd = cand_has_dest(state, cand_r_safe, cand_w, dest_ok,
+                           dest_headroom, partition_replicas)
+        cand_has = cand_struct & hd
+        if kk > 1:
+            # cumulative-excess gate: candidate j of a row may move only
+            # while the row's excess is not yet covered by candidates
+            # before it
+            w_bk = jnp.where(cand_has, cand_w, 0.0).reshape(num_b, kk)
+            cum_before = jnp.cumsum(w_bk, axis=1) - w_bk
+            cand_has &= (cum_before < src_excess[:, None]).reshape(-1)
 
-    if _has_table(cache):
-        cand_r, cand_has = table_pick_best(cache, score, eligible)
-    else:
-        cand_r, _, cand_has = per_segment_argmax(score, rb, num_b, eligible)
-    cand_r_safe = jnp.maximum(cand_r, 0)
+        # per-broker starvation escalation: a broker whose whole top-k is
+        # destination-blocked must reach its lower-ranked candidates — the
+        # full [R]-plane selection runs only in that (rare) case
+        struct_any = jnp.any(sc_rows > NEG / 2, axis=1)
+        got = jnp.any(cand_has.reshape(num_b, kk), axis=1)
 
-    cand_w = w[cand_r_safe]                                    # f32[C]
-    gain = cand_w
-    if forced is not None:
-        gain = gain + jnp.where(forced[cand_r_safe], 1e12, 0.0)
+        def full_pick():
+            has_dest = feasible_dest_exists(state, w, dest_ok,
+                                            dest_headroom,
+                                            partition_replicas)
+            eligible = movable & src_ok[rb] & has_dest
+            if strict_allowance:
+                eligible_f = eligible & (w <= src_excess[rb])
+            else:
+                eligible_f = eligible
+            score = shed_score(w, src_excess[rb])
+            f_cand, f_has = table_pick_best(cache, score, eligible_f)
+            # starved rows take the full pick in their first slot
+            cr = cand_r.reshape(num_b, kk)
+            ch = cand_has.reshape(num_b, kk)
+            take = struct_any & ~got & f_has
+            cr = cr.at[:, 0].set(jnp.where(take, f_cand, cr[:, 0]))
+            ch = ch.at[:, 0].set(jnp.where(take, True, ch[:, 0]))
+            return cr.reshape(-1), ch.reshape(-1)
+
+        cand_r, cand_has = jax.lax.cond(
+            jnp.any(struct_any & ~got), full_pick,
+            lambda: (cand_r, cand_has))
+        cand_r_safe = jnp.maximum(cand_r, 0)
+        cand_w = w[cand_r_safe]
+        gain = cand_w
+    else:
+        has_dest = feasible_dest_exists(state, w, dest_ok, dest_headroom,
+                                        partition_replicas)
+        eligible = movable & src_ok[rb] & has_dest
+        if strict_allowance:
+            eligible &= w <= src_excess[rb]
+        if forced is not None:
+            eligible = eligible | (movable & forced & has_dest)
+            # forced replicas outrank everything else on their broker
+            score = jnp.where(forced, w + 1e12,
+                              shed_score(w, src_excess[rb]))
+        else:
+            score = shed_score(w, src_excess[rb])
+
+        if _has_table(cache):
+            cand_r, cand_has = table_pick_best(cache, score, eligible)
+        else:
+            cand_r, _, cand_has = per_segment_argmax(score, rb, num_b,
+                                                     eligible)
+        cand_r_safe = jnp.maximum(cand_r, 0)
+
+        cand_w = w[cand_r_safe]                                # f32[C]
+        gain = cand_w
+        if forced is not None:
+            gain = gain + jnp.where(forced[cand_r_safe], 1e12, 0.0)
 
     def assign_with(dest_ids):
         # --- destination matrix [C, K] ---
@@ -394,6 +505,8 @@ def leadership_round(state: ClusterState,
                      dest_pref: jax.Array,
                      partition_replicas: jax.Array,
                      cache=None,
+                     bonus_rows: Optional[jax.Array] = None,
+                     value_rows: Optional[jax.Array] = None,
                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One round of batched leadership-transfer search.
 
@@ -404,55 +517,105 @@ def leadership_round(state: ClusterState,
 
     Args mirror `move_round`; `bonus_w` is f32[R] — the metric weight that
     travels with leadership of the replica's partition.
+
+    Resident-row mode (`bonus_rows` + `value_rows`, both [B, S] from the
+    cache aux tables; bonus_rows NEG-masked by the goal): candidate
+    leaders come from a per-broker top-k over `bonus_rows`, and the
+    follower/acceptance planes are evaluated ONLY on those B*k candidates
+    — the full [R, RF] plane costs ~9M gathers per round at north scale
+    (~40ms at the measured ~140M gathered elem/s), the dominant cost of
+    leadership-heavy goals.  A per-broker starvation escalation falls back
+    to the full plane so shortlist truncation can never stall a broker.
+
     Returns (src_replica i32[C], dest_replica i32[C], valid bool[C]).
     """
     num_b = state.num_brokers
     rb = state.replica_broker
     rf = partition_replicas.shape[1]
+    r_idx = jnp.arange(rb.shape[0], dtype=jnp.int32)
+
+    def sib_of(rows: jax.Array):
+        """Follower options of `rows` ([n] replica ids) -> per-option
+        (follower replica [n, RF], follower broker, structurally-usable)."""
+        sib = partition_replicas[state.replica_partition[rows]]
+        sib_safe = jnp.maximum(sib, 0)
+        ok = (sib >= 0) & (sib != rows[:, None])
+        sib_b = rb[sib_safe]
+        ok &= leader_ok[sib_b] & ~state.replica_offline[sib_safe]
+        return sib_safe, sib_b, ok
+
+    def options_feasible(rows: jax.Array, row_bonus: jax.Array):
+        """[n, RF] — structural + acceptance feasibility of handing
+        leadership from rows[i] to each follower option."""
+        sib_safe, sib_b, ok = sib_of(rows)
+        ok &= row_bonus[:, None] <= dest_headroom[sib_b]
+        ok &= accept_fn(rows[:, None], sib_safe)
+        return sib_safe, sib_b, ok
 
     is_src = src_excess > 0.0
-    lead_eligible = (movable & state.replica_is_leader & is_src[rb]
-                     & (bonus_w > 0.0))
+    if bonus_rows is not None and value_rows is not None             and _has_table(cache):
+        kk = min(8, max(cache.broker_table.shape[1], 1))
+        top_sc, slots = jax.lax.top_k(bonus_rows, kk)          # [B, kk]
+        has_struct = top_sc > NEG / 2
+        cand = jnp.take_along_axis(cache.broker_table, slots, axis=1)
+        cand_flat = jnp.maximum(cand.reshape(-1), 0)
+        cand_bonus = jnp.take_along_axis(value_rows, slots,
+                                         axis=1).reshape(-1)
+        _, _, ok_opts = options_feasible(cand_flat, cand_bonus)
+        ok_c = (jnp.any(ok_opts, axis=1).reshape(num_b, kk)
+                & has_struct)                                  # [B, kk]
+        # first (highest-scored) accepted candidate per broker
+        first = jnp.argmax(ok_c, axis=1)
+        cand_has = jnp.any(ok_c, axis=1)
+        cand_r = jnp.where(
+            cand_has,
+            jnp.take_along_axis(cand, first[:, None], axis=1)[:, 0], -1)
 
-    # follower matrix per replica: [R', RF] — evaluate only for leaders is
-    # shape-dynamic, so compute for all R rows (masked); RF is tiny.
-    sib = partition_replicas[state.replica_partition]          # [R, RF]
-    sib_safe = jnp.maximum(sib, 0)
-    sib_is_self = sib == jnp.arange(rb.shape[0])[:, None]
-    sib_ok = (sib >= 0) & ~sib_is_self
-    sib_broker = rb[sib_safe]                                  # [R, RF]
-    sib_offline = state.replica_offline[sib_safe]
+        # per-broker starvation: structural candidates exist but the whole
+        # top-k was rejected -> evaluate the full plane, merge those rows
+        struct_any = jnp.any(bonus_rows > NEG / 2, axis=1)
+        starved = struct_any & ~cand_has
 
-    fits = bonus_w[:, None] <= dest_headroom[sib_broker]
-    # the acceptance stack is folded into the [R, RF] selection plane on
-    # purpose: selecting candidates on structure alone and checking
-    # acceptance afterwards was measured 2-4× SLOWER end-to-end at 2.6K
-    # brokers — rejected candidates waste their broker's slot for the
-    # round, and the extra rounds cost far more than the [R, RF]
-    # acceptance evaluation saves
-    feasible = (sib_ok & fits & leader_ok[sib_broker] & ~sib_offline
-                & lead_eligible[:, None])
-    feasible &= accept_fn(jnp.arange(rb.shape[0], dtype=jnp.int32)[:, None],
-                          sib_safe)
+        def full_plane():
+            lead_eligible = (movable & state.replica_is_leader
+                             & is_src[rb] & (bonus_w > 0.0))
+            _, _, ok_full = options_feasible(r_idx, bonus_w)
+            r_has = jnp.any(ok_full, axis=1) & lead_eligible
+            score = jnp.where(r_has,
+                              shed_score(bonus_w, src_excess[rb]), NEG)
+            f_cand, f_has = table_pick_best(cache, score, r_has)
+            take = starved & f_has
+            return (jnp.where(take, f_cand, cand_r), cand_has | take)
 
-    pref = jnp.where(feasible, dest_pref[sib_broker], NEG)
-    r_has = jnp.max(pref, axis=1) > NEG / 2
-
-    # per-source-broker argmax over its leader replicas: shed the largest
-    # transferable bonus first
-    score = jnp.where(r_has, shed_score(bonus_w, src_excess[rb]), NEG)
-    if _has_table(cache):
-        cand_r, cand_has = table_pick_best(cache, score, r_has)
+        cand_r, cand_has = jax.lax.cond(
+            jnp.any(starved), full_plane, lambda: (cand_r, cand_has))
+        cand_r_safe = jnp.maximum(cand_r, 0)
+        cand_bonus_b = bonus_w[cand_r_safe]
     else:
-        cand_r, _, cand_has = per_segment_argmax(score, rb, num_b, r_has)
-    cand_r_safe = jnp.maximum(cand_r, 0)
+        lead_eligible = (movable & state.replica_is_leader & is_src[rb]
+                         & (bonus_w > 0.0))
+        sib_safe_all, sib_b_all, ok_all = options_feasible(r_idx, bonus_w)
+        feasible = ok_all & lead_eligible[:, None]
+        pref_full = jnp.where(feasible, dest_pref[sib_b_all], NEG)
+        r_has = jnp.max(pref_full, axis=1) > NEG / 2
+        score = jnp.where(r_has, shed_score(bonus_w, src_excess[rb]), NEG)
+        if _has_table(cache):
+            cand_r, cand_has = table_pick_best(cache, score, r_has)
+        else:
+            cand_r, _, cand_has = per_segment_argmax(score, rb, num_b,
+                                                     r_has)
+        cand_r_safe = jnp.maximum(cand_r, 0)
+        cand_bonus_b = bonus_w[cand_r_safe]
+
+    # assignment tail on the ONE chosen row per broker ([B, RF], tiny):
+    # acceptance+structural re-evaluated for every path identically
+    sib_c, sib_broker_c, acc_c = options_feasible(cand_r_safe, cand_bonus_b)
+    acc_c &= cand_has[:, None]
+    pref_c = jnp.where(acc_c, dest_pref[sib_broker_c], NEG)
 
     # multi-pass follower assignment (see assign_destinations): candidates
     # claim distinct destination brokers across their follower options
-    pref_c = pref[cand_r_safe]                                 # [C, RF]
-    sib_broker_c = sib_broker[cand_r_safe]                     # [C, RF]
-    sib_c = sib_safe[cand_r_safe]
-    gain = bonus_w[cand_r_safe]
+    gain = cand_bonus_b
     C = cand_r_safe.shape[0]
     taken = jnp.zeros(num_b, dtype=bool)
     assigned = jnp.zeros(C, dtype=bool)
@@ -507,15 +670,36 @@ def forced_move_round(state: ClusterState,
     # must not occupy candidate slots
     if _has_table(cache):
         dest_ok = dest_ok & (cache.table_fill < cache.broker_table.shape[1])
-    forced = forced & feasible_dest_exists(
-        state, w, dest_ok, jnp.full((num_b,), jnp.inf), partition_replicas)
-    if _has_table(cache):
         k = 1 if cap_alive_sources else 4
+        # candidates first, dest-existence second: the [R]-wide existence
+        # guard costs [R, RF] gathers per round, while the candidate-level
+        # check is [B*k, RF].  If every candidate of a round turns out
+        # blocked while forced replicas remain, escalate once to the
+        # guarded full selection (the pick is deterministic, so a blocked
+        # top-k would otherwise stall the loop with work left).
         score = jnp.where(forced, w + 1.0, NEG)
-        cand_r, cand_has = table_pick_topk(cache, score, forced, k)
+        cand_r, cand_struct = table_pick_topk(cache, score, forced, k)
         cand_r = jnp.maximum(cand_r, 0)
+        inf_room = jnp.full((num_b,), jnp.inf)
+        cand_has = cand_struct & cand_has_dest(
+            state, cand_r, w[cand_r], dest_ok, inf_room,
+            partition_replicas)
+
+        def guarded_pick():
+            forced_ok = forced & feasible_dest_exists(
+                state, w, dest_ok, inf_room, partition_replicas)
+            score_f = jnp.where(forced_ok, w + 1.0, NEG)
+            f_cand, f_has = table_pick_topk(cache, score_f, forced_ok, k)
+            return jnp.maximum(f_cand, 0), f_has
+
+        need = jnp.any(cand_struct) & ~jnp.any(cand_has)
+        cand_r, cand_has = jax.lax.cond(need, guarded_pick,
+                                        lambda: (cand_r, cand_has))
         max_candidates = cand_r.shape[0]
     else:
+        forced = forced & feasible_dest_exists(
+            state, w, dest_ok, jnp.full((num_b,), jnp.inf),
+            partition_replicas)
         score = jnp.where(forced, w + 1.0, -jnp.inf)
         _, cand_r = jax.lax.top_k(score, max_candidates)
         cand_r = cand_r.astype(jnp.int32)
@@ -565,6 +749,7 @@ def swap_round(state: ClusterState,
                accept_pair_fn: Callable[[jax.Array, jax.Array], jax.Array],
                partition_replicas: jax.Array,
                cache=None,
+               w_rows: Optional[jax.Array] = None,
                ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """One round of batched replica-SWAP search.
 
@@ -592,7 +777,20 @@ def swap_round(state: ClusterState,
     rb = state.replica_broker
     arange_b = jnp.arange(num_b, dtype=jnp.int32)
 
-    if _has_table(cache):
+    if _has_table(cache) and w_rows is not None:
+        # resident-row selection: no [R]-sized gathers (see move_round)
+        room = cache.table_fill < cache.broker_table.shape[1]
+        hot_b = hot_b & room
+        cold_b = cold_b & room
+        # table_ok carries the static movable terms; the dynamic w > 0
+        # filter matches the callers' movable mask (otherwise the cold-side
+        # argmin systematically nominates zero-load replicas)
+        ok = cache.table_ok & (w_rows > 0.0)
+        out_r, out_has = rows_pick_best(
+            cache, jnp.where(ok & hot_b[:, None], w_rows, NEG))
+        in_r, in_has = rows_pick_best(
+            cache, jnp.where(ok & cold_b[:, None], -w_rows, NEG))
+    elif _has_table(cache):
         # each side of a swap gains one replica; its append slot must exist
         room = cache.table_fill < cache.broker_table.shape[1]
         hot_b = hot_b & room

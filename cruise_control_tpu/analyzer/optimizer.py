@@ -58,11 +58,13 @@ class OptimizerResult:
     regressed_goals: List[str]
     final_state: ClusterState
     duration_s: float = 0.0
-    #: per-goal violated-broker counts {goal: (before, after)} — the
+    #: per-goal violated-broker counts
+    #: {goal: (before, after-own-run, after-all-goals)} — the
     #: detector/bench quality instrument (reference exposes per-goal
-    #: violation detail via GoalViolations)
-    violated_broker_counts: Dict[str, Tuple[int, int]] = dataclasses.field(
-        default_factory=dict)
+    #: violation detail via GoalViolations).  after-own vs after-all
+    #: separates non-convergence from later-goal interference.
+    violated_broker_counts: Dict[str, Tuple[int, int, int]] = \
+        dataclasses.field(default_factory=dict)
 
     @property
     def num_replica_movements(self) -> int:
@@ -79,20 +81,25 @@ class OptimizerResult:
 
     #: goal names considered hard for the balancedness weighting
     hard_goal_names: frozenset = frozenset()
+    #: (soft, hard) goal weights (reference goal.balancedness.priority.weight
+    #: and goal.balancedness.strictness.weight,
+    #: CC/analyzer/GoalOptimizer.java:121-122)
+    balancedness_weights: Tuple[float, float] = (1.0, 2.0)
 
     def balancedness_score(self) -> float:
         """[0, 100] gauge (reference AnomalyDetector.java:176-178 /
-        GoalOptimizer balancedness weights): fraction of goals without
-        violations after optimization, hard goals weighted double."""
+        GoalOptimizer balancedness weights): weighted fraction of goals
+        without violations after optimization."""
         goal_names = list(self.stats_by_goal) or sorted(
             set(self.violated_goals_before) | set(self.violated_goals_after))
         if not goal_names:
             return 100.0
+        soft_w, hard_w = self.balancedness_weights
         violated = set(self.violated_goals_after)
         total = 0.0
         clean = 0.0
         for name in goal_names:
-            weight = 2.0 if name in self.hard_goal_names else 1.0
+            weight = hard_w if name in self.hard_goal_names else soft_w
             total += weight
             if name not in violated:
                 clean += weight
@@ -145,9 +152,11 @@ class GoalOptimizer:
     def __init__(self, goals: Sequence[Goal],
                  constraint: Optional[BalancingConstraint] = None,
                  jit_goals: bool = True,
-                 pipeline_segment_size: int = 4):
+                 pipeline_segment_size: int = 4,
+                 balancedness_weights: Tuple[float, float] = (1.0, 2.0)):
         self.goals = list(goals)
         self.constraint = constraint or BalancingConstraint()
+        self.balancedness_weights = balancedness_weights
         self._jit_goals = jit_goals
         #: goals per compiled program (see optimizations docstring)
         self.pipeline_segment_size = pipeline_segment_size
@@ -155,6 +164,13 @@ class GoalOptimizer:
         #: (sync points cost transport latency — profiling only)
         self.profile_segments = False
         self._compiled: Dict[str, object] = {}
+        #: AOT executables retained by warmup(), keyed like _compiled.
+        #: Measured on the remote-TPU path: the persistent-cache handoff
+        #: from lower().compile() to a later jit dispatch MISSES (each
+        #: segment re-compiled ~2 min on first call), so warmup keeps the
+        #: executables and optimizations() calls them directly when the
+        #: argument shapes match.
+        self._aot: Dict[str, object] = {}
 
     def _pre_fn(self):
         """(state, ctx) -> (violated_broker_counts i32[G], healed state,
@@ -176,18 +192,26 @@ class GoalOptimizer:
         return run
 
     def _segment_fn(self, start: int, stop: int):
-        """(state, ctx) -> (state, stacked per-goal stats) for
-        goals[start:stop], with acceptance stacking over ALL prior goals."""
+        """(state, ctx) -> (state, (stacked per-goal stats, own-violated
+        counts)) for goals[start:stop], with acceptance stacking over ALL
+        prior goals.  own-violated = the goal's violated-broker count right
+        after its own run — comparing it against the post-pipeline count
+        separates "this goal could not converge" from "a later goal
+        re-violated it"."""
         goals = tuple(self.goals)
 
         def run(state: ClusterState, ctx: OptimizationContext):
             per_goal_stats = []
+            own_violated = []
             for i in range(start, stop):
                 state = goals[i].optimize(state, ctx, goals[:i])
                 per_goal_stats.append(compute_stats(state))
+                own_violated.append(goals[i].violated_brokers(
+                    state, ctx, make_round_cache(state))
+                    .sum(dtype=jnp.int32))
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
                                    *per_goal_stats)
-            return state, stacked
+            return state, (stacked, jnp.stack(own_violated))
         return run
 
     def _post_fn(self):
@@ -242,18 +266,17 @@ class GoalOptimizer:
             key, fn, args = job
             for attempt in range(attempts):
                 try:
-                    jax.jit(fn).lower(*args).compile()
-                    return key
+                    return key, jax.jit(fn).lower(*args).compile()
                 except jax.errors.JaxRuntimeError as exc:
                     LOG.warning("warmup compile %s attempt %d failed: %s",
                                 key, attempt,
                                 str(exc).splitlines()[0][:120])
                     _time.sleep(5.0)
-            jax.jit(fn).lower(*args).compile()
-            return key
+            return key, jax.jit(fn).lower(*args).compile()
 
         with concurrent.futures.ThreadPoolExecutor(max_workers) as pool:
-            for key in pool.map(compile_one, jobs):
+            for key, compiled in pool.map(compile_one, jobs):
+                self._aot[key] = compiled
                 LOG.debug("warmed %s", key)
         return _time.time() - t0
 
@@ -274,41 +297,43 @@ class GoalOptimizer:
         options = options or OptimizationOptions()
         ctx = make_context(state, self.constraint, options, topology)
         initial = state
-        stats_fn = self._get_compiled("__stats__", compute_stats)
-        stats_before = jax.device_get(stats_fn(state))
+        stats_before = jax.device_get(
+            self._run("__stats__", compute_stats, state))
 
         t0 = time.time()
         profile = self.profile_segments
-        pre = self._get_compiled("__pre__", self._pre_fn())
-        vb_dev, state, still_dev = pre(state, ctx)
+        vb_dev, state, still_dev = self._run("__pre__", self._pre_fn(),
+                                             state, ctx)
         if profile:
             jax.block_until_ready(state.replica_broker)
             LOG.info("segment pre+heal: %.0fms", (time.time() - t0) * 1e3)
         seg = max(1, self.pipeline_segment_size)
         stacked_parts = []
+        own_parts = []
         for start in range(0, len(self.goals), seg):
             stop = min(start + seg, len(self.goals))
-            fn = self._get_compiled(f"__seg_{start}_{stop}__",
-                                    self._segment_fn(start, stop))
             t_seg = time.time()
-            state, stacked_seg = fn(state, ctx)
+            state, (stacked_seg, own_seg) = self._run(
+                f"__seg_{start}_{stop}__",
+                self._segment_fn(start, stop), state, ctx)
             if profile:
                 jax.block_until_ready(state.replica_broker)
                 LOG.info("segment %s: %.0fms",
                          "+".join(g.name for g in self.goals[start:stop]),
                          (time.time() - t_seg) * 1e3)
             stacked_parts.append(stacked_seg)
-        post = self._get_compiled("__post__", self._post_fn())
-        va_dev = post(state, ctx)
+            own_parts.append(own_seg)
+        va_dev = self._run("__post__", self._post_fn(), state, ctx)
         jax.block_until_ready(state.replica_broker)
         LOG.debug("goal pipeline (%d segments) ran in %.0fms",
                   (len(self.goals) + seg - 1) // seg,
                   (time.time() - t0) * 1e3)
-        stacked_h, vb_h, va_h, still_offline = jax.device_get(
-            (stacked_parts, vb_dev, va_dev, still_dev))
+        stacked_h, own_h, vb_h, va_h, still_offline = jax.device_get(
+            (stacked_parts, own_parts, vb_dev, va_dev, still_dev))
         stacked_h = (jax.tree.map(
             lambda *xs: np.concatenate(xs), *stacked_h)
             if stacked_h else None)
+        own_h = np.concatenate(own_h) if own_h else np.zeros(0, np.int32)
 
         if int(still_offline):
             raise OptimizationFailure(
@@ -318,8 +343,8 @@ class GoalOptimizer:
 
         violated_before = [g.name for g, v in zip(self.goals, vb_h) if v]
         violated_after = [g.name for g, v in zip(self.goals, va_h) if v]
-        violated_counts = {g.name: (int(b), int(a)) for g, b, a
-                           in zip(self.goals, vb_h, va_h)}
+        violated_counts = {g.name: (int(b), int(o), int(a)) for g, b, o, a
+                           in zip(self.goals, vb_h, own_h, va_h)}
 
         stats_by_goal: Dict[str, ClusterModelStats] = {}
         regressed: List[str] = []
@@ -360,6 +385,7 @@ class GoalOptimizer:
         )
         result.hard_goal_names = frozenset(
             g.name for g in self.goals if g.is_hard)
+        result.balancedness_weights = self.balancedness_weights
         return result
 
     def _get_compiled(self, key: str, fn):
@@ -368,3 +394,16 @@ class GoalOptimizer:
         if key not in self._compiled:
             self._compiled[key] = jax.jit(fn)
         return self._compiled[key]
+
+    def _run(self, key: str, fn, *args):
+        """Prefer a warmup-retained AOT executable; fall back to jit when
+        none exists or the argument shapes changed (an AOT executable is
+        pinned to the avals it was lowered for)."""
+        aot = self._aot.get(key)
+        if aot is not None:
+            try:
+                return aot(*args)
+            except (TypeError, ValueError) as exc:
+                LOG.debug("AOT %s rejected args (%s); falling back to jit",
+                          key, exc)
+        return self._get_compiled(key, fn)(*args)

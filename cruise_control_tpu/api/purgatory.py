@@ -48,8 +48,11 @@ class ReviewRequest:
 
 class Purgatory:
     def __init__(self, retention_s: float = 7 * 24 * 3600.0,
+                 max_requests: Optional[int] = None,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self._retention_s = retention_s
+        #: cap on parked requests (reference two.step.purgatory.max.requests)
+        self._max_requests = max_requests
         self._time = time_fn or _time.time
         self._lock = threading.Lock()
         self._ids = itertools.count()
@@ -60,6 +63,14 @@ class Purgatory:
         now_ms = self._time() * 1000.0
         with self._lock:
             self._expire(now_ms)
+            if self._max_requests is not None:
+                pending = sum(1 for r in self._requests.values()
+                              if r.status == ReviewStatus.PENDING_REVIEW)
+                if pending >= self._max_requests:
+                    raise ValueError(
+                        f"purgatory full: {pending} pending requests "
+                        f"(two.step.purgatory.max.requests="
+                        f"{self._max_requests})")
             rid = next(self._ids)
             req = ReviewRequest(rid, endpoint, query, submitter,
                                 ReviewStatus.PENDING_REVIEW, now_ms)

@@ -1,0 +1,200 @@
+"""Machine-readable JSON Schemas for every REST response body.
+
+The reference publishes OpenAPI YAML per endpoint and walks its
+@JsonResponseClass annotations against it in a conformance test
+(reference: cruise-control/src/test/java/.../ResponseTest.java:1-227,
+cruise-control/src/yaml/endpoints/*.yaml).  Here the schemas are the
+source of truth in code: `ENDPOINT_SCHEMAS` maps endpoint → JSON Schema
+(draft 2020-12) for the 200 body, plus shared schemas for the 202
+async-progress body, the purgatory 202 review body, and the error body.
+`python -m cruise_control_tpu.api.schema` emits the whole set as one JSON
+document (docs/RESPONSE_SCHEMAS.json); tests/test_response_schema.py
+validates live server output against these.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+_NUM = {"type": "number"}
+_INT = {"type": "integer"}
+_STR = {"type": "string"}
+_BOOL = {"type": "boolean"}
+
+
+def _obj(properties: dict, required=None, extra=True) -> dict:
+    out = {"type": "object", "properties": properties,
+           "additionalProperties": extra}
+    if required:
+        out["required"] = sorted(required)
+    return out
+
+
+def _arr(items: dict) -> dict:
+    return {"type": "array", "items": items}
+
+
+_BROKER_ROW = _obj({
+    "Broker": _INT, "Host": _STR, "Rack": _STR,
+    "BrokerState": {"enum": ["ALIVE", "DEAD"]},
+    "Replicas": _INT, "Leaders": _INT,
+    "CpuPct": _NUM, "NwInRate": _NUM, "NwOutRate": _NUM,
+    "DiskMB": _NUM, "DiskPct": _NUM,
+}, required=["Broker", "BrokerState", "Replicas", "Leaders"])
+
+_HOST_ROW = _obj({
+    "Host": _STR, "Replicas": _INT, "Leaders": _INT,
+    "CpuPct": _NUM, "NwInRate": _NUM, "NwOutRate": _NUM, "DiskMB": _NUM,
+}, required=["Host", "Replicas", "Leaders"])
+
+BROKER_STATS = _obj({
+    "brokers": _arr(_BROKER_ROW),
+    "hosts": _arr(_HOST_ROW),
+}, required=["brokers", "hosts"])
+
+_PARTITION_ROW = _obj({
+    "topic": _STR, "partition": _INT, "leader": _INT,
+    "followers": _arr(_INT),
+    "cpu": _NUM, "networkInbound": _NUM, "networkOutbound": _NUM,
+    "disk": _NUM,
+}, required=["topic", "partition", "leader", "followers"])
+
+PARTITION_LOAD = _obj({
+    "records": _arr(_PARTITION_ROW), "version": _INT,
+}, required=["records", "version"])
+
+_PROPOSAL = _obj({
+    "topicPartition": _obj({"topic": _STR, "partition": _INT}),
+    "oldLeader": _INT,
+    "oldReplicas": _arr(_INT),
+    "newReplicas": _arr(_INT),
+}, required=["topicPartition", "newReplicas"])
+
+OPTIMIZATION_RESULT = _obj({
+    "summary": _obj({
+        "numReplicaMovements": _INT,
+        "numLeaderMovements": _INT,
+        "dataToMoveMB": _NUM,
+        "numProposals": _INT,
+        "excludedTopics": _arr(_STR),
+        "onDemandBalancednessScoreBefore": {"type": ["number", "null"]},
+        "onDemandBalancednessScoreAfter": _NUM,
+        "provisionStatus": _STR,
+    }, required=["numReplicaMovements", "numProposals"]),
+    "goalSummary": _arr(_obj({
+        "goal": _STR,
+        "status": {"enum": ["FIXED", "VIOLATED", "NO-ACTION"]},
+    }, required=["goal", "status"])),
+    "violatedGoalsBefore": _arr(_STR),
+    "violatedGoalsAfter": _arr(_STR),
+    "proposals": _arr(_PROPOSAL),
+}, required=["summary", "goalSummary"])
+
+KAFKA_CLUSTER_STATE = _obj({
+    "KafkaBrokerState": _obj({
+        "LeaderCountByBrokerId": _obj({}, extra=True),
+        "ReplicaCountByBrokerId": _obj({}, extra=True),
+        "OutOfSyncCountByBrokerId": _obj({}, extra=True),
+        "OfflineReplicaCountByBrokerId": _obj({}, extra=True),
+        "IsController": _obj({}, extra=True),
+    }, required=["LeaderCountByBrokerId", "ReplicaCountByBrokerId"]),
+    "KafkaPartitionState": _obj({}, extra=True),
+    "version": _INT,
+}, required=["KafkaBrokerState", "KafkaPartitionState", "version"])
+
+STATE = _obj({
+    "MonitorState": _obj({}, extra=True),
+    "ExecutorState": _obj({}, extra=True),
+    "AnalyzerState": _obj({}, extra=True),
+    "AnomalyDetectorState": _obj({}, extra=True),
+    "version": _INT,
+}, required=["version"])
+
+_USER_TASK = _obj({
+    "UserTaskId": _STR,
+    "Status": {"enum": ["Active", "Completed", "CompletedWithError"]},
+    "RequestURL": _STR,
+    "ClientIdentity": _STR,
+    "StartMs": _NUM,
+}, required=["UserTaskId", "Status"])
+
+USER_TASKS = _obj({
+    "userTasks": _arr(_USER_TASK), "version": _INT,
+}, required=["userTasks", "version"])
+
+_REVIEW_REQUEST = _obj({
+    "Id": _INT, "Status": _STR, "EndPoint": _STR, "Reason": _STR,
+    "SubmitterAddress": _STR,
+}, required=["Id", "Status", "EndPoint"])
+
+REVIEW_BOARD = _obj({
+    "requestInfo": _arr(_REVIEW_REQUEST), "version": _INT,
+}, required=["requestInfo", "version"])
+
+MESSAGE = _obj({"message": _STR, "version": _INT},
+               required=["message", "version"])
+
+ADMIN = _obj({
+    "selfHealing": _obj({}, extra=True), "version": _INT,
+}, required=["version"])
+
+#: 202 body while an async operation is still running
+ASYNC_PROGRESS = _obj({
+    "progress": _arr(_obj({
+        "operation": _STR, "status": _STR,
+    }, required=["operation"])),
+    "version": _INT,
+}, required=["progress", "version"])
+
+#: 202 body when two-step verification parks a POST
+REVIEW_PARKED = _obj({
+    "reviewResult": _REVIEW_REQUEST, "version": _INT,
+}, required=["reviewResult", "version"])
+
+ERROR = _obj({"errorMessage": _STR, "version": _INT},
+             required=["errorMessage", "version"])
+
+#: endpoint → JSON Schema of the 200 response body
+ENDPOINT_SCHEMAS: Dict[str, dict] = {
+    "STATE": STATE,
+    "KAFKA_CLUSTER_STATE": KAFKA_CLUSTER_STATE,
+    "LOAD": BROKER_STATS,
+    "PARTITION_LOAD": PARTITION_LOAD,
+    "PROPOSALS": OPTIMIZATION_RESULT,
+    "USER_TASKS": USER_TASKS,
+    "REVIEW_BOARD": REVIEW_BOARD,
+    "REVIEW": REVIEW_BOARD,
+    "BOOTSTRAP": MESSAGE,
+    "TRAIN": MESSAGE,
+    "STOP_PROPOSAL_EXECUTION": MESSAGE,
+    "PAUSE_SAMPLING": MESSAGE,
+    "RESUME_SAMPLING": MESSAGE,
+    "ADMIN": ADMIN,
+    "REBALANCE": OPTIMIZATION_RESULT,
+    "ADD_BROKER": OPTIMIZATION_RESULT,
+    "REMOVE_BROKER": OPTIMIZATION_RESULT,
+    "DEMOTE_BROKER": OPTIMIZATION_RESULT,
+    "FIX_OFFLINE_REPLICAS": OPTIMIZATION_RESULT,
+    "TOPIC_CONFIGURATION": OPTIMIZATION_RESULT,
+}
+
+#: non-200 body schemas by meaning
+AUX_SCHEMAS: Dict[str, dict] = {
+    "async_progress_202": ASYNC_PROGRESS,
+    "review_parked_202": REVIEW_PARKED,
+    "error": ERROR,
+}
+
+
+def document() -> dict:
+    """The full schema artifact as one JSON document."""
+    return {
+        "$schema": "https://json-schema.org/draft/2020-12/schema",
+        "title": "cruise_control_tpu REST response schemas",
+        "endpoints": ENDPOINT_SCHEMAS,
+        "aux": AUX_SCHEMAS,
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(document(), indent=2, sort_keys=True))

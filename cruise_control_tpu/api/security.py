@@ -4,8 +4,17 @@ Reference CC/servlet/security/ (17 files): SecurityProvider SPI with HTTP
 Basic, JWT, SPNEGO and trusted-proxy implementations over a three-role
 model ADMIN > USER > VIEWER (docs/wiki "Security").  Here: the SPI, the
 role model and endpoint→role mapping, an HTTP Basic provider (stdlib
-base64), and a signed-token provider (stdlib hmac — structurally the JWT
-flow without external JOSE dependencies).
+base64), a standards-based `JwtSecurityProvider` (RFC 7515/7519 compact
+JWS: HS256 via stdlib hmac, RS256 via the `cryptography` package when
+present — reference servlet/security/jwt/JwtLoginService.java:1-226), a
+lightweight HMAC signed-token provider (`TokenSecurityProvider`, the
+non-JOSE flavor), and a trusted-proxy provider.
+
+**SPNEGO/Kerberos is an explicit non-goal** of this framework: it needs a
+live KDC and a Kerberos client stack that this runtime does not carry.
+Deployments that require Kerberos should terminate it at a fronting proxy
+and use `TrustedProxySecurityProvider` (the reference's own trusted-proxy
+flow exists for exactly this topology).
 """
 from __future__ import annotations
 
@@ -116,9 +125,9 @@ class BasicSecurityProvider(SecurityProvider):
 
 
 class TokenSecurityProvider(SecurityProvider):
-    """HMAC-signed bearer tokens (the JWT flow of the reference's
-    JwtSecurityProvider/JwtLoginService.java:1-226, with stdlib crypto:
-    header.payload.signature, HS256-equivalent).
+    """Lightweight HMAC-signed bearer tokens (payload.signature — NOT
+    JWT; for standards-based JWT use `JwtSecurityProvider`).  Useful for
+    service-to-service auth where both ends are this framework.
     """
 
     def __init__(self, secret: bytes,
@@ -154,6 +163,130 @@ class TokenSecurityProvider(SecurityProvider):
         if payload.get("exp", 0) < self._time():
             raise AuthenticationError("token expired")
         return Principal(payload["sub"], Role[payload["role"]])
+
+
+class JwtSecurityProvider(SecurityProvider):
+    """Standards-based JWT bearer authentication (RFC 7519 claims over an
+    RFC 7515 compact JWS; reference servlet/security/jwt/
+    JwtLoginService.java:1-226 + JwtAuthenticator).
+
+    Supported algorithms: HS256 (shared secret, stdlib hmac) and RS256
+    (RSA public key, PKCS#1 v1.5 over SHA-256 via the `cryptography`
+    package).  The accepted algorithm set is pinned at construction —
+    `alg: none` and algorithm-confusion tokens are rejected outright.
+
+    Claims honored: `exp`/`nbf` (with `leeway_s`), optional expected
+    `iss` and `aud`, `sub` as the principal name, and a role claim
+    (default `"role"`, values VIEWER/USER/ADMIN; absent → `default_role`).
+    """
+
+    def __init__(self, *, hs256_secret: Optional[bytes] = None,
+                 rs256_public_key_pem: Optional[bytes] = None,
+                 issuer: Optional[str] = None,
+                 audience: Optional[str] = None,
+                 role_claim: str = "role",
+                 default_role: Role = Role.USER,
+                 leeway_s: float = 30.0,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        if hs256_secret is None and rs256_public_key_pem is None:
+            raise ValueError("JwtSecurityProvider needs an HS256 secret "
+                             "and/or an RS256 public key")
+        self._hs256_secret = hs256_secret
+        self._rs256_key = None
+        if rs256_public_key_pem is not None:
+            from cryptography.hazmat.primitives.serialization import (
+                load_pem_public_key)
+            self._rs256_key = load_pem_public_key(rs256_public_key_pem)
+        self._issuer = issuer
+        self._audience = audience
+        self._role_claim = role_claim
+        self._default_role = default_role
+        self._leeway = leeway_s
+        self._time = time_fn or _time.time
+
+    # -- token issue (test/tooling convenience; the reference's login
+    # service issues its tokens out-of-band) --
+    def issue_hs256(self, claims: Mapping[str, object]) -> str:
+        if self._hs256_secret is None:
+            raise ValueError("no HS256 secret configured")
+        header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        body = _b64url(json.dumps(dict(claims)).encode())
+        signing_input = f"{header}.{body}".encode()
+        sig = _b64url(hmac.new(self._hs256_secret, signing_input,
+                               hashlib.sha256).digest())
+        return f"{header}.{body}.{sig}"
+
+    def _verify_signature(self, alg: str, signing_input: bytes,
+                          sig: bytes) -> None:
+        if alg == "HS256" and self._hs256_secret is not None:
+            want = hmac.new(self._hs256_secret, signing_input,
+                            hashlib.sha256).digest()
+            if not hmac.compare_digest(want, sig):
+                raise AuthenticationError("bad JWT signature")
+            return
+        if alg == "RS256" and self._rs256_key is not None:
+            from cryptography.exceptions import InvalidSignature
+            from cryptography.hazmat.primitives import hashes
+            from cryptography.hazmat.primitives.asymmetric import padding
+            try:
+                self._rs256_key.verify(sig, signing_input,
+                                       padding.PKCS1v15(), hashes.SHA256())
+            except InvalidSignature:
+                raise AuthenticationError("bad JWT signature")
+            return
+        raise AuthenticationError(f"JWT algorithm {alg!r} not accepted")
+
+    def authenticate(self, headers: Mapping[str, str]) -> Principal:
+        auth = _header(headers, "Authorization")
+        if not auth or not auth.startswith("Bearer "):
+            raise AuthenticationError("missing Bearer token")
+        token = auth[7:].strip()
+        parts = token.split(".")
+        if len(parts) != 3:
+            raise AuthenticationError("malformed JWT")
+        try:
+            header = json.loads(_b64url_decode(parts[0]))
+            claims = json.loads(_b64url_decode(parts[1]))
+            sig = _b64url_decode(parts[2])
+        except Exception:
+            raise AuthenticationError("malformed JWT")
+        if not isinstance(header, dict) or not isinstance(claims, dict):
+            raise AuthenticationError("malformed JWT")
+        alg = header.get("alg")
+        signing_input = f"{parts[0]}.{parts[1]}".encode()
+        self._verify_signature(alg, signing_input, sig)
+
+        now = self._time()
+
+        def _numeric(name):
+            try:
+                return float(claims[name])
+            except (TypeError, ValueError):
+                # must surface as 401, not a generic ValueError (the
+                # server maps ValueError to 400 bad-parameter)
+                raise AuthenticationError(f"malformed {name} claim")
+
+        if "exp" in claims and now > _numeric("exp") + self._leeway:
+            raise AuthenticationError("JWT expired")
+        if "nbf" in claims and now < _numeric("nbf") - self._leeway:
+            raise AuthenticationError("JWT not yet valid")
+        if self._issuer is not None and claims.get("iss") != self._issuer:
+            raise AuthenticationError("JWT issuer mismatch")
+        if self._audience is not None:
+            aud = claims.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if self._audience not in auds:
+                raise AuthenticationError("JWT audience mismatch")
+        sub = claims.get("sub")
+        if not sub:
+            raise AuthenticationError("JWT missing sub claim")
+        role_name = claims.get(self._role_claim)
+        try:
+            role = (Role[str(role_name).upper()] if role_name
+                    else self._default_role)
+        except KeyError:
+            raise AuthenticationError(f"unknown role {role_name!r}")
+        return Principal(str(sub), role)
 
 
 class TrustedProxySecurityProvider(SecurityProvider):

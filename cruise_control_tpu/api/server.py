@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import logging
+import ssl
 import threading
 import time as _time
 import urllib.parse
@@ -57,6 +58,18 @@ class HttpError(Exception):
         self.message = message
 
 
+def make_server_ssl_context(certfile: str, keyfile: Optional[str] = None,
+                            key_password: Optional[str] = None
+                            ) -> ssl.SSLContext:
+    """TLS context from PEM files (config keys `webserver.ssl.*`;
+    reference KafkaCruiseControlApp SSL connector).  `certfile` may hold
+    both certificate and key; pass `keyfile` when they are separate."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile, keyfile=keyfile or None,
+                        password=key_password or None)
+    return ctx
+
+
 class CruiseControlApp:
     """Endpoint dispatch over a CruiseControl facade."""
 
@@ -65,14 +78,30 @@ class CruiseControlApp:
                  two_step_verification: bool = False,
                  async_response_timeout_s: float = 1.0,
                  access_log: bool = True,
+                 purgatory_kwargs: Optional[dict] = None,
+                 user_task_kwargs: Optional[dict] = None,
+                 cors_enabled: bool = False,
+                 cors_origin: str = "*",
+                 url_prefix: Optional[str] = None,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self.cc = cruise_control
         self.security = security or NoSecurityProvider()
-        self.purgatory = Purgatory(time_fn=time_fn) \
+        self.purgatory = Purgatory(time_fn=time_fn,
+                                   **(purgatory_kwargs or {})) \
             if two_step_verification else None
-        self.user_tasks = UserTaskManager(time_fn=time_fn)
+        self.user_tasks = UserTaskManager(time_fn=time_fn,
+                                          **(user_task_kwargs or {}))
         self._async_timeout = async_response_timeout_s
         self._access_log = access_log
+        #: CORS (reference webserver.http.cors.*): when enabled, every
+        #: response carries the allow-origin header
+        self._cors_headers = ({"Access-Control-Allow-Origin": cors_origin,
+                               "Access-Control-Allow-Headers":
+                               "Content-Type, Authorization, User-Task-ID"}
+                              if cors_enabled else {})
+        #: mount point (reference webserver.api.urlprefix)
+        self.base_path = (url_prefix.rstrip("/") if url_prefix
+                          else BASE_PATH)
         self._http: Optional[ThreadingHTTPServer] = None
 
     # ------------------------------------------------------------------
@@ -128,12 +157,12 @@ class CruiseControlApp:
         return status, {}, {"errorMessage": f"{type(exc).__name__}: {exc}",
                             "version": 1}
 
-    @staticmethod
-    def _endpoint_of(method: str, path: str) -> str:
-        if not path.startswith(BASE_PATH + "/"):
+    def _endpoint_of(self, method: str, path: str) -> str:
+        base = self.base_path
+        if not path.startswith(base + "/"):
             raise HttpError(404, f"unknown path {path}; expected "
-                                 f"{BASE_PATH}/<endpoint>")
-        endpoint = path[len(BASE_PATH) + 1:].strip("/").upper()
+                                 f"{base}/<endpoint>")
+        endpoint = path[len(base) + 1:].strip("/").upper()
         if endpoint not in GET_ENDPOINTS and endpoint not in POST_ENDPOINTS \
                 and endpoint != "REVIEW":
             raise HttpError(404, f"unknown endpoint {endpoint}")
@@ -408,8 +437,13 @@ class CruiseControlApp:
     # ------------------------------------------------------------------
     # HTTP transport
     # ------------------------------------------------------------------
-    def start(self, host: str = "127.0.0.1", port: int = 9090) -> int:
-        """Start the HTTP server; returns the bound port."""
+    def start(self, host: str = "127.0.0.1", port: int = 9090,
+              ssl_context: Optional["ssl.SSLContext"] = None) -> int:
+        """Start the HTTP(S) server; returns the bound port.
+
+        `ssl_context` wraps the listening socket for TLS (reference
+        KafkaCruiseControlApp.java:100-173 optional SSL connector); build
+        one from config with `make_server_ssl_context`."""
         app = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -419,6 +453,7 @@ class CruiseControlApp:
                     method, parsed.path, parsed.query,
                     dict(self.headers.items()),
                     client=self.client_address[0])
+                hdrs = {**hdrs, **app._cors_headers}
                 data = json.dumps(body, indent=2).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -433,6 +468,18 @@ class CruiseControlApp:
 
             def do_POST(self) -> None:  # noqa: N802
                 self._dispatch("POST")
+
+            def do_OPTIONS(self) -> None:  # noqa: N802
+                # CORS preflight: browsers send OPTIONS before any
+                # cross-origin request carrying Authorization/User-Task-ID
+                self.send_response(204)
+                for k, v in app._cors_headers.items():
+                    self.send_header(k, v)
+                if app._cors_headers:
+                    self.send_header("Access-Control-Allow-Methods",
+                                     "GET, POST, OPTIONS")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
 
             def log_request(self, code="-", size="-") -> None:
                 # NCSA common-log line per request (reference
@@ -456,6 +503,9 @@ class CruiseControlApp:
                 LOG.debug("http: " + fmt, *args)
 
         self._http = ThreadingHTTPServer((host, port), Handler)
+        if ssl_context is not None:
+            self._http.socket = ssl_context.wrap_socket(
+                self._http.socket, server_side=True)
         threading.Thread(target=self._http.serve_forever,
                          name="rest-server", daemon=True).start()
         return self._http.server_address[1]

@@ -52,10 +52,18 @@ class UserTaskManager:
 
     def __init__(self, max_active_tasks: int = 25,
                  completed_retention_s: float = 24 * 3600.0,
+                 max_cached_completed_tasks: Optional[int] = None,
+                 attach_max_age_s: Optional[float] = None,
                  max_workers: int = 8,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self._max_active = max_active_tasks
         self._retention_s = completed_retention_s
+        #: completed-task cache cap (reference
+        #: max.cached.completed.user.tasks): oldest evicted beyond this
+        self._max_cached_completed = max_cached_completed_tasks
+        #: implicit same-client+URL resumption window (reference
+        #: webserver.session.maxExpiryPeriodMs session binding expiry)
+        self._attach_max_age_s = attach_max_age_s
         self._time = time_fn or _time.time
         self._lock = threading.Lock()
         self._tasks: Dict[str, UserTaskInfo] = {}
@@ -140,6 +148,26 @@ class UserTaskManager:
             info = self._tasks.pop(tid)
             self._by_request.pop(
                 (info.client_id, f"{info.endpoint}?{info.query}"), None)
+        if self._max_cached_completed is not None:
+            done = sorted((t for t in self._tasks.values()
+                           if t.status != TaskStatus.ACTIVE),
+                          key=lambda t: t.end_ms)
+            for info in done[:max(0, len(done)
+                                  - self._max_cached_completed)]:
+                self._tasks.pop(info.task_id, None)
+                self._by_request.pop(
+                    (info.client_id, f"{info.endpoint}?{info.query}"),
+                    None)
+        if self._attach_max_age_s is not None:
+            attach_cutoff = now_ms - self._attach_max_age_s * 1000.0
+            for key, tid in list(self._by_request.items()):
+                info = self._tasks.get(tid)
+                # ACTIVE tasks keep their binding — the implicit
+                # same-client+URL resume flow must survive solves longer
+                # than the session expiry
+                if info is None or (info.status != TaskStatus.ACTIVE
+                                    and info.start_ms < attach_cutoff):
+                    self._by_request.pop(key, None)
 
     # ------------------------------------------------------------------
     def get(self, task_id: str) -> Optional[UserTaskInfo]:

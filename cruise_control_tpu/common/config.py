@@ -296,16 +296,23 @@ class AbstractConfig:
 
 #: ${env:NAME} indirection in property values (reference
 #: CC/config/EnvConfigProvider.java — secrets such as passwords reference
-#: environment variables instead of living in the properties file)
-_ENV_REF = re.compile(r"\$\{env:([A-Za-z_][A-Za-z0-9_]*)\}")
+#: environment variables instead of living in the properties file).
+#: `$${env:NAME}` escapes the indirection, yielding a literal ${env:NAME}.
+_ENV_REF = re.compile(r"(\$?)\$\{env:([A-Za-z_][A-Za-z0-9_]*)\}")
 
 
 def resolve_env_references(value: str) -> str:
-    """Substitute every `${env:NAME}` in `value` from the environment;
-    unset variables raise (a silently-empty secret is worse than failing
-    at startup)."""
+    """Substitute every `${env:NAME}` in `value` from the environment.
+
+    Unset variables raise (a silently-empty secret is worse than failing
+    at startup).  A value that needs the literal text writes `$${env:...}`
+    — the reference only substitutes via its explicitly-configured
+    ConfigProvider, so an escape hatch is required here where resolution
+    happens at load time."""
     def sub(match):
-        name = match.group(1)
+        if match.group(1):                   # $${env:X} -> literal ${env:X}
+            return match.group(0)[1:]
+        name = match.group(2)
         if name not in os.environ:
             raise KeyError(
                 f"config references ${{env:{name}}} but {name} is not set")

@@ -176,7 +176,11 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
              "Pause between background proposal precompute passes.")
     d.define("max.optimization.rounds", Type.INT, 64,
              in_range(min_value=1), _L,
-             "Per-goal cap on batched optimization rounds (TPU solver).")
+             "Per-goal cap on batched optimization rounds (TPU solver). "
+             "Hard goals are floored at 1024 rounds regardless: an "
+             "unconverged hard goal aborts the whole optimization, and "
+             "rounds only run while progress is made, so the higher bound "
+             "is free once converged.")
     d.define("allow.capacity.estimation.on.proposal", Type.BOOLEAN, True,
              None, _L, "Allow estimated capacities when computing proposals.")
     return d
@@ -208,8 +212,9 @@ def executor_config_def(d: ConfigDef) -> ConfigDef:
              "BaseReplicaMovementStrategy", None, _L,
              "Default strategy chain when a request names none.")
     d.define("executor.notifier.class", Type.CLASS,
-             "cruise_control_tpu.executor.notifier.LoggingExecutorNotifier",
-             None, _L, "ExecutorNotifier implementation.")
+             "cruise_control_tpu.executor.executor.ExecutorNotifier",
+             None, _L, "ExecutorNotifier implementation (the default logs "
+             "execution completion).")
     d.define("max.execution.task.lifetime.ms", Type.LONG, 86_400_000,
              in_range(min_value=1), _L,
              "Tasks alive longer than this are marked dead.")
@@ -317,9 +322,21 @@ def webserver_config_def(d: ConfigDef) -> ConfigDef:
     d.define("webserver.ssl.enable", Type.BOOLEAN, False, None, _M,
              "Serve HTTPS (requires keystore).")
     d.define("webserver.ssl.keystore.location", Type.STRING, "", None, _L,
-             "PEM/keystore path for TLS.")
+             "PEM certificate (optionally with key) path for TLS.")
+    d.define("webserver.ssl.keyfile.location", Type.STRING, "", None, _L,
+             "PEM private-key path when separate from the certificate.")
     d.define("webserver.ssl.key.password", Type.PASSWORD, "", None, _L,
              "TLS key password.")
+    d.define("webserver.security.jwt.secret", Type.PASSWORD, "", None, _M,
+             "HS256 shared secret for JwtSecurityProvider (use "
+             "${env:NAME} indirection for the value).")
+    d.define("webserver.security.jwt.public.key.location", Type.STRING, "",
+             None, _M,
+             "PEM RSA public key for RS256 JWT verification.")
+    d.define("webserver.security.jwt.issuer", Type.STRING, "", None, _L,
+             "Expected JWT iss claim (empty disables the check).")
+    d.define("webserver.security.jwt.audience", Type.STRING, "", None, _L,
+             "Expected JWT aud claim (empty disables the check).")
     d.define("webserver.accesslog.enabled", Type.BOOLEAN, True, None, _L,
              "Write NCSA-style access log lines.")
     d.define("two.step.verification.enabled", Type.BOOLEAN, False, None, _M,

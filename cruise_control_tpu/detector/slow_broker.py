@@ -44,6 +44,13 @@ class SlowBrokerFinderConfig:
     removal_score: float = 10.0
     #: ignore brokers whose bytes-in is below this (idle brokers flush slow)
     min_bytes_in_rate: float = 1024.0
+    #: absolute log-flush-time floor: percentile detections only count
+    #: when the latest flush time also exceeds this (reference
+    #: slow.broker.log.flush.time.threshold.ms, ANDed via retainAll)
+    log_flush_time_threshold_ms: float = 1000.0
+    #: whether removal-level escalation may run its fix (reference
+    #: self.healing.slow.broker.removal.enabled — demotion still applies)
+    allow_removal: bool = True
 
 
 #: self-healing factory: given the slow broker ids, start a fix; True if
@@ -150,8 +157,12 @@ class SlowBrokerFinder:
         peer_thresh = np.percentile(latest_pb, cfg.peer_percentile) \
             * cfg.peer_margin
         sig2 = (latest_pb > own_pb_thresh) & (latest_pb > peer_thresh)
+        # signal 3: the absolute flush-time floor is a NECESSARY condition
+        # ANDed with the percentile detections (reference SlowBrokerFinder
+        # retainAll over slow.broker.log.flush.time.threshold.ms)
+        sig3 = latest_flush > cfg.log_flush_time_threshold_ms
         active = bytes_in[:, -1] >= cfg.min_bytes_in_rate
-        suspected = sig1 & sig2 & active
+        suspected = sig1 & sig2 & sig3 & active
 
         now_ms = self._time() * 1000.0
         # brokers that stopped reporting (dead/removed) drop their scores —
@@ -180,6 +191,7 @@ class SlowBrokerFinder:
         if to_remove:
             ids = sorted(to_remove)
             fix = (None if self._remove_fix is None
+                   or not cfg.allow_removal
                    else (lambda f=self._remove_fix, i=ids: f(i)))
             anomaly = SlowBrokers(to_remove, remove_slow_brokers=True,
                                   fix_fn=fix, detected_ms=now_ms)

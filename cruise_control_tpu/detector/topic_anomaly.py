@@ -18,12 +18,16 @@ class TopicReplicationFactorAnomalyFinder:
     def __init__(self, admin: ClusterAdminClient,
                  report_fn: Callable[[TopicAnomaly], None],
                  target_replication_factor: int = 3,
+                 min_isr_margin: int = 1,
                  fix_fn: Optional[FixFn] = None,
                  topic_pattern: Optional[str] = None,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self._admin = admin
         self._report = report_fn
         self._target_rf = target_replication_factor
+        #: required headroom above min.insync.replicas (reference
+        #: topic.replication.factor.margin)
+        self._min_isr_margin = min_isr_margin
         self._fix_fn = fix_fn
         self._pattern = topic_pattern
         self._time = time_fn or _time.time
@@ -43,7 +47,7 @@ class TopicReplicationFactorAnomalyFinder:
                     "min.insync.replicas", 1))
             except (TypeError, ValueError):
                 min_isr = 1
-            target = max(self._target_rf, min_isr)
+            target = max(self._target_rf, min_isr + self._min_isr_margin)
             rfs = {len(p.replicas) for p in snapshot.partitions_of(topic)}
             if any(rf != target for rf in rfs):
                 bad[topic] = target

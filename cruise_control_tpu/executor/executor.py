@@ -39,11 +39,13 @@ OPERATION_LOG = logging.getLogger("operationLogger")
 
 class ExecutorNotifier:
     """SPI notified when an execution finishes (reference
-    ExecutorNotifier.java)."""
+    ExecutorNotifier.java).  The default implementation logs the
+    completion (the executor.notifier.class default)."""
 
     def on_execution_finished(self, uuid: str, succeeded: bool,
-                              message: str) -> None:  # pragma: no cover
-        pass
+                              message: str) -> None:
+        LOG.info("execution %s finished (succeeded=%s): %s", uuid,
+                 succeeded, message)
 
 
 class ExecutionStoppedException(RuntimeError):
@@ -61,9 +63,14 @@ class Executor:
                  concurrent_leader_movements: int = 1000,
                  progress_check_interval_s: float = 10.0,
                  max_task_execution_idle_s: float = 190.0,
+                 max_task_lifetime_s: float = 6 * 3600.0,
+                 task_alerting_threshold_s: float = 90.0,
                  leader_movement_timeout_s: float = 180.0,
                  replication_throttle_bytes_per_s: Optional[float] = None,
                  removal_history_retention_s: float = 12 * 3600.0,
+                 demotion_history_retention_s: Optional[float] = None,
+                 max_cluster_movements: Optional[int] = None,
+                 default_strategy: Optional[ReplicaMovementStrategy] = None,
                  time_fn: Optional[Callable[[], float]] = None,
                  sleep_fn: Optional[Callable[[float], None]] = None) -> None:
         self._admin = admin
@@ -74,9 +81,24 @@ class Executor:
         self._leader_cap = concurrent_leader_movements
         self._check_interval = progress_check_interval_s
         self._max_idle = max_task_execution_idle_s
+        #: absolute kill switch: any task alive longer than this is DEAD
+        #: (reference max.execution.task.lifetime.ms)
+        self._max_lifetime = max_task_lifetime_s
+        #: warn (and notify) once a task runs longer than this (reference
+        #: task.execution.alerting.threshold.ms)
+        self._alert_threshold = task_alerting_threshold_s
+        self._alerted_tasks: set = set()
+        #: refuse executions whose task count exceeds this (reference
+        #: max.num.cluster.movements guards memory/controller pressure)
+        self._max_cluster_movements = max_cluster_movements
+        self._default_strategy = default_strategy
         self._leader_timeout = leader_movement_timeout_s
         self._throttle_rate = replication_throttle_bytes_per_s
         self._history_retention = removal_history_retention_s
+        self._demotion_retention = (demotion_history_retention_s
+                                    if demotion_history_retention_s
+                                    is not None
+                                    else removal_history_retention_s)
         self._time = time_fn or _time.time
         self._sleep = sleep_fn or _time.sleep
 
@@ -125,6 +147,7 @@ class Executor:
             self._force_stop = False
             self._uuid = uuid or str(_uuid.uuid4())
             self._reason = reason
+            self._alerted_tasks.clear()
             now = self._time()
             for b in removed_brokers:
                 self._removed_brokers[b] = now
@@ -138,10 +161,17 @@ class Executor:
                 concurrent_leader_movements
                 if concurrent_leader_movements is not None
                 else self._leader_cap,
-                strategy)
+                strategy or self._default_strategy)
             snapshot = self._admin.describe_cluster()
             mgr.load_proposals(proposals,
                                sorted(snapshot.all_broker_ids))
+            if (self._max_cluster_movements is not None
+                    and mgr.counts().total > self._max_cluster_movements):
+                self._phase = ExecutorPhase.NO_TASK_IN_PROGRESS
+                raise ValueError(
+                    f"execution of {mgr.counts().total} tasks exceeds "
+                    f"max.num.cluster.movements="
+                    f"{self._max_cluster_movements}")
             self._manager = mgr
             throttle = (replication_throttle
                         if replication_throttle is not None
@@ -201,7 +231,8 @@ class Executor:
         return self._recent(self._removed_brokers)
 
     def recently_demoted_brokers(self) -> Set[int]:
-        return self._recent(self._demoted_brokers)
+        return self._recent(self._demoted_brokers,
+                            self._demotion_retention)
 
     def drop_recently_removed_brokers(self, brokers: Sequence[int]) -> None:
         with self._lock:
@@ -213,9 +244,12 @@ class Executor:
             for b in brokers:
                 self._demoted_brokers.pop(b, None)
 
-    def _recent(self, table: Dict[int, float]) -> Set[int]:
+    def _recent(self, table: Dict[int, float],
+                retention_s: Optional[float] = None) -> Set[int]:
         with self._lock:
-            cutoff = self._time() - self._history_retention
+            cutoff = self._time() - (retention_s
+                                     if retention_s is not None
+                                     else self._history_retention)
             for b in [b for b, t in table.items() if t < cutoff]:
                 del table[b]
             return set(table)
@@ -394,6 +428,21 @@ class Executor:
                 self._admin.alter_partition_reassignments(
                     {tp: new_brokers})
                 task.reexecution_count += 1
+            else:
+                age_s = (now_ms - task.start_time_ms) / 1e3
+                if age_s > self._max_lifetime:
+                    # absolute lifetime exceeded (reference
+                    # max.execution.task.lifetime.ms): cancel + mark dead
+                    self._admin.alter_partition_reassignments({tp: None})
+                    mgr.finish_task(task, TaskState.DEAD, now_ms)
+                    in_flight.remove(task)
+                elif (age_s > self._alert_threshold
+                        and task.task_id not in self._alerted_tasks):
+                    self._alerted_tasks.add(task.task_id)
+                    LOG.warning(
+                        "task %s (%s) running for %.0fs, beyond the "
+                        "alerting threshold %.0fs", task.task_id, tp,
+                        age_s, self._alert_threshold)
 
     # ------------------------------------------------------------------
     # phase 2: intra-broker (logdir) movement

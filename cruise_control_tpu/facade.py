@@ -94,9 +94,22 @@ class CruiseControl:
                  goal_violation_interval_s: float = 300.0,
                  disk_failure_interval_s: float = 300.0,
                  topic_anomaly_interval_s: float = 600.0,
+                 metric_anomaly_interval_s: Optional[float] = None,
                  proposal_expiration_s: float = 900.0,
                  proposal_precompute_interval_s: float = 30.0,
                  self_healing_goals: Optional[Sequence[str]] = None,
+                 detection_goal_names: Optional[Sequence[str]] = None,
+                 intra_broker_goal_names: Optional[Sequence[str]] = None,
+                 metric_anomaly_finders: Optional[Sequence] = None,
+                 slow_broker_config=None,
+                 topic_target_rf: int = 3,
+                 topic_min_isr_margin: int = 1,
+                 topic_anomaly_finder_classes: Optional[Sequence[type]]
+                 = None,
+                 num_cached_recent_anomaly_states: int = 10,
+                 max_optimization_rounds: Optional[int] = None,
+                 balancedness_weights: Tuple[float, float] = (1.0, 2.0),
+                 allow_capacity_estimation: bool = True,
                  time_fn: Optional[Callable[[], float]] = None,
                  sleep_fn: Optional[Callable[[float], None]] = None,
                  monitor_kwargs: Optional[dict] = None,
@@ -105,6 +118,19 @@ class CruiseControl:
         self._time = time_fn or _time.time
         self._constraint = constraint or BalancingConstraint()
         self._goal_names = list(goal_names or DEFAULT_GOAL_ORDER)
+        self._detection_goal_names = list(detection_goal_names
+                                          or self._goal_names)
+        #: goal list for intra-broker (JBOD disk) rebalancing requests
+        #: (reference intra.broker.goals)
+        self.intra_broker_goal_names = list(
+            intra_broker_goal_names
+            or ["IntraBrokerDiskCapacityGoal",
+                "IntraBrokerDiskUsageDistributionGoal"])
+        self._max_rounds = max_optimization_rounds
+        #: (soft, hard) goal weights for the balancedness gauge (reference
+        #: goal.balancedness.priority.weight / strictness.weight)
+        self._balancedness_weights = balancedness_weights
+        self._allow_capacity_estimation = allow_capacity_estimation
 
         # construction order mirrors the reference facade :100-113
         self.load_monitor = LoadMonitor(
@@ -115,19 +141,28 @@ class CruiseControl:
             notifier=executor_notifier, time_fn=self._time,
             sleep_fn=sleep_fn, **(executor_kwargs or {}))
         self.goal_optimizer = GoalOptimizer(
-            default_goals(names=self._goal_names), self._constraint)
+            default_goals(names=self._goal_names,
+                          max_rounds=max_optimization_rounds),
+            self._constraint, balancedness_weights=balancedness_weights)
         self._ple_optimizer = GoalOptimizer(
             [make_goal("PreferredLeaderElectionGoal")], self._constraint)
 
         notifier = anomaly_notifier or SelfHealingNotifier(time_fn=self._time)
+        self._metric_anomaly_finders = list(metric_anomaly_finders or [])
+        self._slow_broker_config = slow_broker_config
+        self._topic_target_rf = topic_target_rf
+        self._topic_min_isr_margin = topic_min_isr_margin
+        self._topic_finder_classes = list(topic_anomaly_finder_classes or [])
         self.anomaly_detector = AnomalyDetector(
             notifier,
+            num_cached_recent_anomaly_states=num_cached_recent_anomaly_states,
             ready_fn=self._monitor_ready,
             fix_in_progress_fn=lambda: self.executor.has_ongoing_execution,
             time_fn=self._time)
         self._wire_detectors(goal_violation_interval_s,
                              disk_failure_interval_s,
-                             topic_anomaly_interval_s)
+                             topic_anomaly_interval_s,
+                             metric_anomaly_interval_s)
 
         # proposal cache (reference GoalOptimizer.validCachedProposal) +
         # background precompute (reference GoalOptimizer.run :130-181 and
@@ -157,8 +192,10 @@ class CruiseControl:
     def start_up(self, do_sampling: bool = True,
                  detection_tick_s: float = 1.0,
                  start_detection: bool = True,
+                 skip_loading_samples: bool = False,
                  start_proposal_precompute: bool = False) -> None:
-        self.load_monitor.start_up(do_sampling=do_sampling)
+        self.load_monitor.start_up(do_sampling=do_sampling,
+                                   skip_loading_samples=skip_loading_samples)
         self.broker_failure_detector.start()
         if start_detection:
             self.anomaly_detector.start(tick_s=detection_tick_s)
@@ -173,6 +210,13 @@ class CruiseControl:
         self._precompute_stop.set()
         if self._precompute_thread is not None:
             self._precompute_thread.join(timeout=5.0)
+            if self._precompute_thread.is_alive():
+                # a full proposal solve can run for minutes; it races the
+                # monitor/executor teardown below (its exceptions are
+                # swallowed by precompute_proposals_once) — make the race
+                # visible instead of silent
+                LOG.warning("proposal-precompute still running after 5s "
+                            "join timeout; shutting down around it")
         self.anomaly_detector.shutdown()
         self.broker_failure_detector.shutdown()
         self.executor.stop_execution(force=True)
@@ -205,6 +249,13 @@ class CruiseControl:
             return False
 
     def _precompute_loop(self) -> None:
+        # first pass immediately: waiting a full interval before the first
+        # solve would leave the cache cold for precompute.interval after
+        # startup (the reference's GoalOptimizer.run computes on entry).
+        # The stop check matters: shutdown right after start_up must not
+        # launch a minutes-long solve it then races.
+        if not self._precompute_stop.is_set():
+            self.precompute_proposals_once()
         while not self._precompute_stop.wait(self._precompute_interval_s):
             self.precompute_proposals_once()
 
@@ -212,11 +263,15 @@ class CruiseControl:
     # detector wiring (self-healing fix runnables, SURVEY.md §3.5)
     # ------------------------------------------------------------------
     def _wire_detectors(self, gv_interval: float, disk_interval: float,
-                        topic_interval: float) -> None:
+                        topic_interval: float,
+                        metric_interval: Optional[float] = None) -> None:
         report = self.anomaly_detector.report
+        metric_interval = (metric_interval if metric_interval is not None
+                           else disk_interval)
         self.goal_violation_detector = GoalViolationDetector(
             self.load_monitor,
-            default_goals(names=self._goal_names),   # separate instances
+            default_goals(names=self._detection_goal_names,
+                          max_rounds=self._max_rounds),  # separate instances
             report, fix_fn=self._heal_rebalance,
             constraint=self._constraint, time_fn=self._time)
         self.broker_failure_detector = BrokerFailureDetector(
@@ -226,16 +281,20 @@ class CruiseControl:
             self._admin, report, fix_fn=self._heal_offline_replicas,
             time_fn=self._time)
         self.slow_broker_finder = SlowBrokerFinder(
-            report, time_fn=self._time,
+            report, config=self._slow_broker_config, time_fn=self._time,
             demote_fix_fn=self._heal_slow_brokers_demote,
             remove_fix_fn=self._heal_slow_brokers_remove)
         self.slow_broker_detector = SlowBrokerDetector(
             self.load_monitor.broker_aggregator, self.slow_broker_finder)
         self.metric_anomaly_detector = MetricAnomalyDetector(
             self._broker_metric_history,
-            [PercentileMetricAnomalyFinder()], report)
+            self._metric_anomaly_finders or [PercentileMetricAnomalyFinder()],
+            report)
         self.topic_anomaly_finder = TopicReplicationFactorAnomalyFinder(
-            self._admin, report, time_fn=self._time)
+            self._admin, report,
+            target_replication_factor=self._topic_target_rf,
+            min_isr_margin=self._topic_min_isr_margin,
+            time_fn=self._time)
         self.anomaly_detector.register_detector(
             self.goal_violation_detector, gv_interval)
         self.anomaly_detector.register_detector(
@@ -243,9 +302,15 @@ class CruiseControl:
         self.anomaly_detector.register_detector(
             self.slow_broker_detector, disk_interval)
         self.anomaly_detector.register_detector(
-            self.metric_anomaly_detector, disk_interval)
+            self.metric_anomaly_detector, metric_interval)
         self.anomaly_detector.register_detector(
             self.topic_anomaly_finder, topic_interval)
+        #: extra pluggable topic-anomaly finders (reference
+        #: topic.anomaly.finder.class) constructed as cls(admin, report)
+        for cls in self._topic_finder_classes:
+            self.anomaly_detector.register_detector(
+                cls(self._admin, report, time_fn=self._time),
+                topic_interval)
 
     def _monitor_ready(self) -> bool:
         st = self.load_monitor.get_state()
@@ -319,7 +384,9 @@ class CruiseControl:
             ModelCompletenessRequirements] = None):
         with self.load_monitor.acquire_for_model_generation(), \
                 self.metrics.timer("cluster-model-creation-timer").time():
-            return self.load_monitor.cluster_model(requirements)
+            return self.load_monitor.cluster_model(
+                requirements,
+                allow_capacity_estimation=self._allow_capacity_estimation)
 
     def optimizations(self,
                       goals: Optional[Sequence[str]] = None,

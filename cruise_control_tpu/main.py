@@ -34,6 +34,138 @@ from cruise_control_tpu.common.config import \
     load_properties as read_properties  # noqa: E402
 
 
+def build_constraint(config: CruiseControlConfig):
+    """BalancingConstraint from the analyzer threshold keys (reference
+    BalancingConstraint(KafkaCruiseControlConfig)).  Tuple order follows
+    the Resource enum: CPU, NW_IN, NW_OUT, DISK."""
+    from cruise_control_tpu.analyzer.context import BalancingConstraint
+
+    def per_resource(fmt_cpu, fmt_nw_in, fmt_nw_out, fmt_disk):
+        return (config.get_double(fmt_cpu), config.get_double(fmt_nw_in),
+                config.get_double(fmt_nw_out), config.get_double(fmt_disk))
+
+    return BalancingConstraint(
+        resource_balance_percentage=per_resource(
+            "cpu.balance.threshold", "network.inbound.balance.threshold",
+            "network.outbound.balance.threshold", "disk.balance.threshold"),
+        capacity_threshold=per_resource(
+            "cpu.capacity.threshold", "network.inbound.capacity.threshold",
+            "network.outbound.capacity.threshold",
+            "disk.capacity.threshold"),
+        low_utilization_threshold=per_resource(
+            "cpu.low.utilization.threshold",
+            "network.inbound.low.utilization.threshold",
+            "network.outbound.low.utilization.threshold",
+            "disk.low.utilization.threshold"),
+        replica_balance_percentage=config.get_double(
+            "replica.count.balance.threshold"),
+        leader_replica_balance_percentage=config.get_double(
+            "leader.replica.count.balance.threshold"),
+        topic_replica_balance_percentage=config.get_double(
+            "topic.replica.count.balance.threshold"),
+        max_replicas_per_broker=int(
+            config.get_long("max.replicas.per.broker")),
+        goal_violation_distribution_threshold_multiplier=config.get_double(
+            "goal.violation.distribution.threshold.multiplier"),
+    )
+
+
+def _goal_lists(config: CruiseControlConfig):
+    """(goals, default, hard, detection, self-healing, intra-broker) from
+    config with the reference's sanity rules: default.goals and hard.goals
+    must be subsets of goals (KafkaCruiseControlConfig.sanityCheckGoalNames).
+    """
+    allowed = [g for g in config.get_list("goals") if g]
+    default = [g for g in config.get_list("default.goals") if g] or allowed
+    hard = [g for g in config.get_list("hard.goals") if g]
+    for name, lst in (("default.goals", default), ("hard.goals", hard)):
+        bad = [g for g in lst if allowed and g not in allowed]
+        if bad:
+            raise ValueError(f"{name} entries {bad} are not in `goals`")
+    detection = ([g for g in config.get_list("anomaly.detection.goals")
+                  if g] or None)
+    self_healing = ([g for g in config.get_list("self.healing.goals")
+                     if g] or None)
+    intra = [g for g in config.get_list("intra.broker.goals") if g] or None
+    return default, detection, self_healing, intra
+
+
+def _detector_interval(config: CruiseControlConfig, key: str) -> float:
+    """Per-type detector interval with the -1 → anomaly.detection.interval
+    fallback (reference AnomalyDetectorConfig)."""
+    v = config.get_long(key)
+    if v < 0:
+        v = config.get_long("anomaly.detection.interval.ms")
+    return v / 1e3
+
+
+def build_notifier(config: CruiseControlConfig):
+    """AnomalyNotifier from config: the default SelfHealingNotifier gets
+    the self.healing.* switches and broker-failure thresholds; any other
+    class comes from the standard configured-instance hook."""
+    from cruise_control_tpu.common.config import resolve_class
+    from cruise_control_tpu.core.anomaly import AnomalyType
+    from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+    cls = resolve_class(config.get("anomaly.notifier.class"))
+    if not issubclass(cls, SelfHealingNotifier):
+        return config.get_configured_instance("anomaly.notifier.class")
+    master = config.get_boolean("self.healing.enabled")
+    per_type = {
+        AnomalyType.BROKER_FAILURE:
+            config.get_boolean("self.healing.broker.failure.enabled"),
+        AnomalyType.GOAL_VIOLATION:
+            config.get_boolean("self.healing.goal.violation.enabled"),
+        AnomalyType.DISK_FAILURE:
+            config.get_boolean("self.healing.disk.failure.enabled"),
+        AnomalyType.METRIC_ANOMALY:
+            config.get_boolean("self.healing.metric.anomaly.enabled"),
+        AnomalyType.TOPIC_ANOMALY:
+            config.get_boolean("self.healing.topic.anomaly.enabled"),
+    }
+    enabled = {t: master and v for t, v in per_type.items()}
+    return cls(
+        self_healing_enabled=enabled,
+        broker_failure_alert_threshold_ms=config.get_long(
+            "broker.failure.alert.threshold.ms"),
+        broker_failure_auto_fix_threshold_ms=config.get_long(
+            "broker.failure.self.healing.threshold.ms"))
+
+
+def _metric_anomaly_finders(config: CruiseControlConfig):
+    """Metric-anomaly finder instances; the default percentile finder gets
+    its two threshold keys (reference PercentileMetricAnomalyFinderConfig).
+    """
+    from cruise_control_tpu.common.config import resolve_class
+    from cruise_control_tpu.core.anomaly import PercentileMetricAnomalyFinder
+    finders = []
+    for spec in config.get_list("metric.anomaly.finder.class"):
+        if not spec:
+            continue
+        cls = resolve_class(spec)
+        if issubclass(cls, PercentileMetricAnomalyFinder):
+            finders.append(cls(
+                upper_percentile=config.get_double(
+                    "metric.anomaly.percentile.upper.threshold"),
+                lower_percentile=config.get_double(
+                    "metric.anomaly.percentile.lower.threshold")))
+        else:
+            finders.append(cls())
+    return finders
+
+
+def _slow_broker_config(config: CruiseControlConfig):
+    from cruise_control_tpu.detector.slow_broker import SlowBrokerFinderConfig
+    return SlowBrokerFinderConfig(
+        min_bytes_in_rate=config.get_double(
+            "slow.broker.bytes.rate.detection.threshold"),
+        log_flush_time_threshold_ms=config.get_double(
+            "slow.broker.log.flush.time.threshold.ms"),
+        demotion_score=config.get_double("slow.broker.demotion.score"),
+        removal_score=config.get_double("slow.broker.decommission.score"),
+        allow_removal=config.get_boolean(
+            "self.healing.slow.broker.removal.enabled"))
+
+
 def build_cruise_control(config: CruiseControlConfig, admin,
                          sampler: Optional[MetricSampler] = None
                          ) -> CruiseControl:
@@ -52,14 +184,53 @@ def build_cruise_control(config: CruiseControlConfig, admin,
             BrokerCapacityConfigResolver)
     sample_store = config.get_configured_instance(
         "sample.store.class", SampleStore)
-    notifier = config.get_configured_instance("anomaly.notifier.class")
+    notifier = build_notifier(config)
+    executor_notifier = None
+    if config.get("executor.notifier.class"):
+        executor_notifier = config.get_configured_instance(
+            "executor.notifier.class")
+    from cruise_control_tpu.common.config import resolve_class
+    from cruise_control_tpu.executor.strategy import strategy_from_names
+    strategy_names = [n for n in config.get_list(
+        "default.replica.movement.strategies") if n]
+    default_strategy = (strategy_from_names(strategy_names)
+                        if strategy_names else None)
+    default_goal_names, detection_goals, self_healing_goals, intra_goals = \
+        _goal_lists(config)
+    max_movements = config.get_long("max.num.cluster.movements")
     return CruiseControl(
         admin, sampler,
         capacity_resolver=resolver,
         anomaly_notifier=notifier,
-        goal_names=[g for g in config.get_list("goals") if g],
-        goal_violation_interval_s=config.get_long(
-            "anomaly.detection.interval.ms") / 1e3,
+        executor_notifier=executor_notifier,
+        constraint=build_constraint(config),
+        goal_names=default_goal_names,
+        detection_goal_names=detection_goals,
+        self_healing_goals=self_healing_goals,
+        intra_broker_goal_names=intra_goals,
+        goal_violation_interval_s=_detector_interval(
+            config, "goal.violation.detection.interval.ms"),
+        disk_failure_interval_s=_detector_interval(
+            config, "disk.failure.detection.interval.ms"),
+        topic_anomaly_interval_s=_detector_interval(
+            config, "topic.anomaly.detection.interval.ms"),
+        metric_anomaly_interval_s=_detector_interval(
+            config, "metric.anomaly.detection.interval.ms"),
+        metric_anomaly_finders=_metric_anomaly_finders(config),
+        slow_broker_config=_slow_broker_config(config),
+        topic_min_isr_margin=config.get_int(
+            "topic.replication.factor.margin"),
+        topic_anomaly_finder_classes=[
+            resolve_class(spec) for spec
+            in config.get_list("topic.anomaly.finder.class") if spec],
+        num_cached_recent_anomaly_states=config.get_int(
+            "num.cached.recent.anomaly.states"),
+        max_optimization_rounds=config.get_int("max.optimization.rounds"),
+        balancedness_weights=(
+            config.get_double("goal.balancedness.priority.weight"),
+            config.get_double("goal.balancedness.strictness.weight")),
+        allow_capacity_estimation=config.get_boolean(
+            "allow.capacity.estimation.on.proposal"),
         proposal_expiration_s=config.get_long(
             "proposal.expiration.ms") / 1e3,
         proposal_precompute_interval_s=config.get_long(
@@ -71,10 +242,21 @@ def build_cruise_control(config: CruiseControlConfig, admin,
             min_samples_per_window=config.get_int(
                 "min.samples.per.partition.metrics.window"),
             broker_num_windows=config.get_int("num.broker.metrics.windows"),
+            broker_window_ms=config.get_long("broker.metrics.window.ms"),
+            broker_min_samples_per_window=config.get_int(
+                "min.samples.per.broker.metrics.window"),
             sampling_interval_ms=config.get_long(
                 "metric.sampling.interval.ms"),
             num_fetchers=config.get_int("num.metric.fetchers"),
-            metadata_ttl_ms=config.get_long("metadata.ttl.ms")),
+            metadata_ttl_ms=config.get_long("metadata.ttl.ms"),
+            max_allowed_extrapolations_per_partition=config.get_int(
+                "max.allowed.extrapolations.per.partition"),
+            max_allowed_extrapolations_per_broker=config.get_int(
+                "max.allowed.extrapolations.per.broker"),
+            allow_cpu_capacity_estimation=config.get_boolean(
+                "sampling.allow.cpu.capacity.estimation"),
+            state_update_interval_ms=config.get_long(
+                "monitor.state.update.interval.ms")),
         executor_kwargs=dict(
             concurrent_inter_broker_moves_per_broker=config.get_int(
                 "num.concurrent.partition.movements.per.broker"),
@@ -83,24 +265,114 @@ def build_cruise_control(config: CruiseControlConfig, admin,
             concurrent_leader_movements=config.get_int(
                 "num.concurrent.leader.movements"),
             progress_check_interval_s=config.get_long(
-                "execution.progress.check.interval.ms") / 1e3))
+                "execution.progress.check.interval.ms") / 1e3,
+            max_task_lifetime_s=config.get_long(
+                "max.execution.task.lifetime.ms") / 1e3,
+            task_alerting_threshold_s=config.get_long(
+                "task.execution.alerting.threshold.ms") / 1e3,
+            leader_movement_timeout_s=config.get_long(
+                "leader.movement.timeout.ms") / 1e3,
+            removal_history_retention_s=config.get_long(
+                "removal.history.retention.time.ms") / 1e3,
+            demotion_history_retention_s=config.get_long(
+                "demotion.history.retention.time.ms") / 1e3,
+            max_cluster_movements=(max_movements
+                                   if max_movements > 0 else None),
+            default_strategy=default_strategy,
+            replication_throttle_bytes_per_s=(
+                config.get_long("default.replication.throttle")
+                if config.get_long("default.replication.throttle") > 0
+                else None)))
+
+
+def build_security(config: CruiseControlConfig):
+    """SecurityProvider from config.
+
+    `webserver.security.provider` names the provider class (the reference
+    SPI); the two built-ins with constructor state get their wiring from
+    their dedicated keys (Basic: credentials file; JWT: secret / public
+    key / iss / aud).  Any other class is instantiated via the standard
+    configured-instance hook (no-arg constructor + optional
+    `configure(props)`)."""
+    from cruise_control_tpu.api.security import (JwtSecurityProvider,
+                                                 SecurityProvider)
+    from cruise_control_tpu.common.config import resolve_class
+
+    if not config.get_boolean("webserver.security.enable"):
+        return NoSecurityProvider()
+    cls = resolve_class(config.get("webserver.security.provider"))
+    # convenience: JWT keys present with the provider key left at its
+    # default select the JWT provider (an EXPLICIT provider choice wins)
+    explicit = "webserver.security.provider" in config.originals
+    jwt_configured = (
+        getattr(config.get("webserver.security.jwt.secret"), "value",
+                config.get("webserver.security.jwt.secret"))
+        or config.get("webserver.security.jwt.public.key.location"))
+    if not explicit and jwt_configured:
+        cls = JwtSecurityProvider
+    if cls is JwtSecurityProvider:
+        jwt_secret = config.get("webserver.security.jwt.secret")
+        jwt_secret = getattr(jwt_secret, "value", jwt_secret) or ""
+        jwt_pub = config.get("webserver.security.jwt.public.key.location")
+        pem = None
+        if jwt_pub:
+            with open(jwt_pub, "rb") as f:
+                pem = f.read()
+        return JwtSecurityProvider(
+            hs256_secret=jwt_secret.encode() if jwt_secret else None,
+            rs256_public_key_pem=pem,
+            issuer=config.get("webserver.security.jwt.issuer") or None,
+            audience=config.get("webserver.security.jwt.audience") or None)
+    if cls is BasicSecurityProvider:
+        creds = config.get("webserver.auth.credentials.file")
+        return (BasicSecurityProvider.from_credentials_file(creds)
+                if creds else NoSecurityProvider())
+    return config.get_configured_instance("webserver.security.provider",
+                                          SecurityProvider)
+
+
+def build_ssl_context(config: CruiseControlConfig):
+    """ssl.SSLContext from the webserver.ssl.* keys, or None when TLS is
+    disabled (reference KafkaCruiseControlApp.java:100-173)."""
+    if not config.get_boolean("webserver.ssl.enable"):
+        return None
+    from cruise_control_tpu.api.server import make_server_ssl_context
+    cert = config.get("webserver.ssl.keystore.location")
+    if not cert:
+        raise ValueError("webserver.ssl.enable requires "
+                         "webserver.ssl.keystore.location")
+    password = config.get("webserver.ssl.key.password")
+    password = getattr(password, "value", password) or None
+    return make_server_ssl_context(
+        cert, keyfile=config.get("webserver.ssl.keyfile.location") or None,
+        key_password=password)
 
 
 def build_app(config: CruiseControlConfig,
               cruise_control: CruiseControl) -> CruiseControlApp:
-    if config.get_boolean("webserver.security.enable"):
-        creds = config.get("webserver.auth.credentials.file")
-        security = (BasicSecurityProvider.from_credentials_file(creds)
-                    if creds else NoSecurityProvider())
-    else:
-        security = NoSecurityProvider()
+    security = build_security(config)
     return CruiseControlApp(
         cruise_control, security=security,
         two_step_verification=config.get_boolean(
             "two.step.verification.enabled"),
         async_response_timeout_s=config.get_long(
             "webserver.request.maxBlockTimeMs") / 1e3,
-        access_log=config.get_boolean("webserver.accesslog.enabled"))
+        access_log=config.get_boolean("webserver.accesslog.enabled"),
+        purgatory_kwargs=dict(
+            retention_s=config.get_long(
+                "two.step.purgatory.retention.time.ms") / 1e3,
+            max_requests=config.get_int("two.step.purgatory.max.requests")),
+        user_task_kwargs=dict(
+            max_active_tasks=config.get_int("max.active.user.tasks"),
+            completed_retention_s=config.get_long(
+                "completed.user.task.retention.time.ms") / 1e3,
+            max_cached_completed_tasks=config.get_int(
+                "max.cached.completed.user.tasks"),
+            attach_max_age_s=config.get_long(
+                "webserver.session.maxExpiryPeriodMs") / 1e3),
+        cors_enabled=config.get_boolean("webserver.http.cors.enabled"),
+        cors_origin=config.get("webserver.http.cors.origin") or "*",
+        url_prefix=config.get("webserver.api.urlprefix") or None)
 
 
 def main(argv=None) -> int:
@@ -154,13 +426,17 @@ def main(argv=None) -> int:
         cc = build_cruise_control(config, admin)
 
     app = build_app(config, cc)
-    cc.start_up(start_proposal_precompute=config.get_int(
-        "num.proposal.precompute.threads") > 0)
+    cc.start_up(
+        skip_loading_samples=config.get_boolean("skip.loading.samples"),
+        start_proposal_precompute=config.get_int(
+            "num.proposal.precompute.threads") > 0)
     host = args.host or config.get("webserver.http.address")
     port = args.port if args.port is not None \
         else config.get_int("webserver.http.port")
-    bound = app.start(host=host, port=port)
-    LOG.info("REST API listening on http://%s:%d%s", host, bound,
+    ssl_ctx = build_ssl_context(config)
+    bound = app.start(host=host, port=port, ssl_context=ssl_ctx)
+    LOG.info("REST API listening on %s://%s:%d%s",
+             "https" if ssl_ctx else "http", host, bound,
              "/kafkacruisecontrol")
 
     stop = threading.Event()

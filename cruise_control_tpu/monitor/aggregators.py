@@ -42,13 +42,16 @@ class PartitionMetricSampleAggregator(MetricSampleAggregator):
 
     def aggregate_with_requirements(
             self, now_ms: float, req: ModelCompletenessRequirements,
-            interested_entities=None) -> MetricSampleAggregationResult:
+            interested_entities=None,
+            max_allowed_extrapolations: int = 5
+            ) -> MetricSampleAggregationResult:
         """Aggregate [oldest, now] under a completeness requirement
         (reference KafkaPartitionMetricSampleAggregator.aggregate)."""
         options = AggregationOptions(
             min_valid_entity_ratio=req.min_monitored_partitions_percentage,
             min_valid_entity_group_ratio=0.0,
             min_valid_windows=req.min_required_num_windows,
+            max_allowed_extrapolations_per_entity=max_allowed_extrapolations,
             granularity=(Granularity.ENTITY_GROUP
                          if req.include_all_topics else Granularity.ENTITY),
             include_invalid_entities=req.include_all_topics,

@@ -103,10 +103,16 @@ class LoadMonitor:
                  window_ms: float = 3_600_000,
                  min_samples_per_window: int = 3,
                  broker_num_windows: int = 20,
+                 broker_window_ms: Optional[float] = None,
+                 broker_min_samples_per_window: int = 1,
                  sampling_interval_ms: float = 120_000,
                  num_fetchers: int = 1,
                  metadata_ttl_ms: float = 5_000,
                  max_concurrent_model_builds: int = 2,
+                 max_allowed_extrapolations_per_partition: int = 5,
+                 max_allowed_extrapolations_per_broker: int = 5,
+                 allow_cpu_capacity_estimation: bool = True,
+                 state_update_interval_ms: float = 0.0,
                  time_fn: Callable[[], float] = time.time):
         self._admin = admin
         self._metadata = MetadataClient(admin, metadata_ttl_ms, time_fn)
@@ -117,7 +123,23 @@ class LoadMonitor:
         self._partition_aggregator = PartitionMetricSampleAggregator(
             num_windows, int(window_ms), min_samples_per_window)
         self._broker_aggregator = BrokerMetricSampleAggregator(
-            broker_num_windows, int(window_ms), 1)
+            broker_num_windows, int(broker_window_ms or window_ms),
+            broker_min_samples_per_window)
+        #: aggregation extrapolation caps (reference
+        #: max.allowed.extrapolations.per.{partition,broker})
+        self._max_extrapolations_partition = \
+            max_allowed_extrapolations_per_partition
+        self._max_extrapolations_broker = \
+            max_allowed_extrapolations_per_broker
+        #: whether CPU capacity may be estimated during sampling-side
+        #: capacity resolution (reference
+        #: sampling.allow.cpu.capacity.estimation)
+        self._allow_cpu_capacity_estimation = allow_cpu_capacity_estimation
+        #: get_state() result cache TTL (reference
+        #: monitor.state.update.interval.ms sensor-update period)
+        self._state_ttl_s = state_update_interval_ms / 1e3
+        self._state_cache = None
+        self._state_cache_at = -1e18
         self._fetcher = MetricFetcherManager(
             sampler, self._partition_aggregator, self._broker_aggregator,
             sample_store, num_fetchers)
@@ -204,6 +226,10 @@ class LoadMonitor:
                 >= req.min_monitored_partitions_percentage)
 
     def get_state(self) -> LoadMonitorState:
+        if (self._state_cache is not None
+                and self._time_fn() - self._state_cache_at
+                < self._state_ttl_s):
+            return self._state_cache
         snapshot = self._metadata.cluster()
         total = len(snapshot.partitions)
         try:
@@ -214,7 +240,7 @@ class LoadMonitor:
             monitored = len(result.entity_values)
         except NotEnoughValidWindowsError:
             valid_windows, ratio, monitored = 0, 0.0, 0
-        return LoadMonitorState(
+        state_out = LoadMonitorState(
             state=self.task_runner.state.value,
             num_valid_windows=valid_windows,
             total_num_windows=self._partition_aggregator.num_windows,
@@ -223,6 +249,9 @@ class LoadMonitor:
             num_total_partitions=total,
             reason_of_pause=self.task_runner.reason_of_pause,
             last_sampling_ms=self._fetcher.last_sampling_ms)
+        self._state_cache = state_out
+        self._state_cache_at = self._time_fn()
+        return state_out
 
     # ------------------------------------------------------------------
     # CPU model training (reference TrainingTask.java + TRAIN endpoint)
@@ -276,7 +305,8 @@ class LoadMonitor:
         t0 = time.time()
         snapshot = self._metadata.refresh_metadata()
         result = self._partition_aggregator.aggregate_with_requirements(
-            now_ms, req)
+            now_ms, req,
+            max_allowed_extrapolations=self._max_extrapolations_partition)
         comp = result.completeness
         if (len(comp.valid_window_indices) < req.min_required_num_windows
                 or comp.valid_entity_ratio
@@ -308,7 +338,8 @@ class LoadMonitor:
         for binfo in snapshot.brokers:
             cap = self._capacity_resolver.capacity_for_broker(
                 binfo.rack, binfo.host, binfo.broker_id,
-                allow_capacity_estimation)
+                allow_capacity_estimation
+                and self._allow_cpu_capacity_estimation)
             disks = None
             if cap.disk_capacity_by_logdir:
                 disks = dict(cap.disk_capacity_by_logdir)

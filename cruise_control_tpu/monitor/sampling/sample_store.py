@@ -14,6 +14,7 @@ import logging
 import os
 import struct
 import threading
+import time as _time
 from typing import Iterable, Optional
 
 from cruise_control_tpu.monitor.sampling.holder import (BrokerMetricSample,
@@ -75,12 +76,40 @@ class FileSampleStore(SampleStore):
     PARTITION_FILE = "partition-samples.bin"
     BROKER_FILE = "broker-samples.bin"
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: Optional[str] = None,
+                 partition_retention_ms: Optional[float] = None,
+                 broker_retention_ms: Optional[float] = None,
+                 time_fn=None):
+        #: directory may instead come from config via configure()
+        #: (reference sample.store.* keys); files open lazily
         self._dir = directory
-        os.makedirs(directory, exist_ok=True)
+        self._partition_retention_ms = partition_retention_ms
+        self._broker_retention_ms = broker_retention_ms
+        self._time = time_fn or _time.time
         self._lock = threading.Lock()
-        self._pf = open(os.path.join(directory, self.PARTITION_FILE), "ab")
-        self._bf = open(os.path.join(directory, self.BROKER_FILE), "ab")
+        self._pf = self._bf = None
+        if directory:
+            self._open()
+
+    def configure(self, configs) -> None:
+        """Plugin-style config hook (reference KafkaSampleStore.configure):
+        reads sample.store.directory and the two *.sample.retention.ms
+        keys when the store was instantiated via config."""
+        if self._dir is None:
+            self._dir = configs.get("sample.store.directory") or "cc-samples"
+        for attr, key in (("_partition_retention_ms",
+                           "partition.sample.retention.ms"),
+                          ("_broker_retention_ms",
+                           "broker.sample.retention.ms")):
+            if getattr(self, attr) is None and configs.get(key):
+                setattr(self, attr, float(configs[key]))
+        if self._pf is None:
+            self._open()
+
+    def _open(self) -> None:
+        os.makedirs(self._dir, exist_ok=True)
+        self._pf = open(os.path.join(self._dir, self.PARTITION_FILE), "ab")
+        self._bf = open(os.path.join(self._dir, self.BROKER_FILE), "ab")
 
     def store_samples(self, samples: Samples) -> None:
         with self._lock:
@@ -113,21 +142,38 @@ class FileSampleStore(SampleStore):
     def load_samples(self, loader: SampleLoader) -> None:
         batch = Samples()
         n_bad = 0
+        n_expired = 0
+        now_ms = self._time() * 1000.0
+        p_cut = (now_ms - self._partition_retention_ms
+                 if self._partition_retention_ms else None)
+        b_cut = (now_ms - self._broker_retention_ms
+                 if self._broker_retention_ms else None)
         for rec in self._read_records(
                 os.path.join(self._dir, self.PARTITION_FILE)):
             try:
-                batch.partition_samples.append(
-                    PartitionMetricSample.from_bytes(rec))
+                sample = PartitionMetricSample.from_bytes(rec)
             except (ValueError, struct.error):
                 n_bad += 1
+                continue
+            if p_cut is not None and sample.sample_time_ms < p_cut:
+                n_expired += 1
+                continue
+            batch.partition_samples.append(sample)
         for rec in self._read_records(
                 os.path.join(self._dir, self.BROKER_FILE)):
             try:
-                batch.broker_samples.append(BrokerMetricSample.from_bytes(rec))
+                sample = BrokerMetricSample.from_bytes(rec)
             except (ValueError, struct.error):
                 n_bad += 1
+                continue
+            if b_cut is not None and sample.sample_time_ms < b_cut:
+                n_expired += 1
+                continue
+            batch.broker_samples.append(sample)
         if n_bad:
             LOG.warning("skipped %d unreadable stored samples", n_bad)
+        if n_expired:
+            LOG.info("dropped %d stored samples past retention", n_expired)
         loader.load_samples(batch)
         LOG.info("loaded %d partition + %d broker samples from %s",
                  len(batch.partition_samples), len(batch.broker_samples),
@@ -135,5 +181,6 @@ class FileSampleStore(SampleStore):
 
     def close(self) -> None:
         with self._lock:
-            self._pf.close()
-            self._bf.close()
+            if self._pf is not None:
+                self._pf.close()
+                self._bf.close()

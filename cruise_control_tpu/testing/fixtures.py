@@ -120,3 +120,34 @@ def _follower(load):
     f[R.CPU] = estimate_follower_cpu(load[R.CPU], load[R.NW_IN], load[R.NW_OUT])
     f[R.NW_OUT] = 0.0
     return f
+
+
+def reference_small_cluster() -> Tuple[ClusterState, ClusterTopology]:
+    """EXACT port of the reference's DeterministicCluster.smallClusterModel
+    (reference: cruise-control/src/test/java/com/linkedin/kafka/
+    cruisecontrol/common/DeterministicCluster.java:307-344 with
+    TestConstants.BROKER_CAPACITY): brokers 0,1 in rack 0, broker 2 in
+    rack 1; topics T1 (2 partitions) and T2 (3), RF=2, per-replica loads
+    as (CPU, NW_IN, NW_OUT, DISK) below.  Used by the differential test
+    pinning reference behavior on this fixture."""
+    cap = {R.CPU: 100.0, R.NW_IN: 300_000.0, R.NW_OUT: 200_000.0,
+           R.DISK: 300_000.0}
+    b = ClusterModelBuilder()
+    b.add_broker(0, "0", cap)
+    b.add_broker(1, "0", cap)
+    b.add_broker(2, "1", cap)
+
+    def load(cpu, nw_in, nw_out, disk):
+        return {R.CPU: cpu, R.NW_IN: nw_in, R.NW_OUT: nw_out, R.DISK: disk}
+
+    b.add_partition("T1", 0, 0, [2], load(20.0, 100.0, 130.0, 75.0),
+                    follower_loads=[load(5.0, 100.0, 0.0, 75.0)])
+    b.add_partition("T1", 1, 1, [0], load(15.0, 90.0, 110.0, 55.0),
+                    follower_loads=[load(4.5, 90.0, 0.0, 55.0)])
+    b.add_partition("T2", 0, 1, [2], load(5.0, 5.0, 6.0, 5.0),
+                    follower_loads=[load(4.0, 5.0, 0.0, 5.0)])
+    b.add_partition("T2", 1, 0, [2], load(25.0, 25.0, 45.0, 55.0),
+                    follower_loads=[load(10.5, 25.0, 0.0, 55.0)])
+    b.add_partition("T2", 2, 0, [1], load(20.0, 45.0, 120.0, 95.0),
+                    follower_loads=[load(8.0, 45.0, 0.0, 95.0)])
+    return b.build()

@@ -129,7 +129,13 @@ def random_cluster(spec: RandomClusterSpec
     per_broker_load[Resource.CPU] = (lead_cpu.sum()
                                      + follower_cpu.sum() * (rf - 1)) / spec.num_brokers
     per_broker_load[Resource.NW_IN] = lead_nw_in.sum() * rf / spec.num_brokers
-    per_broker_load[Resource.NW_OUT] = lead_nw_out.sum() / spec.num_brokers
+    # NW_OUT capacity is provisioned against the POTENTIAL outbound load
+    # (every hosted replica becoming leader, the failover case) — real
+    # clusters size egress for leader failover, and PotentialNwOutGoal is
+    # otherwise structurally unsatisfiable for every broker at once: the
+    # cluster-total potential load is invariant under replica moves
+    per_broker_load[Resource.NW_OUT] = (lead_nw_out.sum() * rf
+                                        / spec.num_brokers)
     per_broker_load[Resource.DISK] = lead_disk.sum() * rf / spec.num_brokers
     capacity = np.tile((per_broker_load * spec.capacity_margin
                         ).astype(np.float32), (num_b, 1))

@@ -116,7 +116,8 @@ class TestSlowBrokerFinder:
     def test_detects_and_escalates(self):
         reports = []
         cfg = SlowBrokerFinderConfig(score_per_detection=1.0,
-                                     demotion_score=2.0, removal_score=4.0)
+                                     demotion_score=2.0, removal_score=4.0,
+                                     log_flush_time_threshold_ms=5.0)
         finder = SlowBrokerFinder(reports.append, cfg,
                                   demote_fix_fn=lambda: True,
                                   remove_fix_fn=lambda: True)
@@ -132,7 +133,8 @@ class TestSlowBrokerFinder:
 
     def test_score_decay_on_recovery(self):
         reports = []
-        finder = SlowBrokerFinder(reports.append)
+        finder = SlowBrokerFinder(reports.append, SlowBrokerFinderConfig(
+            log_flush_time_threshold_ms=5.0))
         flush, bytes_in = self._history(slow_broker=2)
         finder.detect_now([0, 1, 2, 3], flush, bytes_in)
         assert finder.slowness_scores == {2: 1.0}

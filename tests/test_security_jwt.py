@@ -1,0 +1,190 @@
+"""JwtSecurityProvider (RFC 7515/7519) and TLS serving tests.
+
+Reference behavior being covered: servlet/security/jwt/JwtLoginService
+.java:1-226 (JWT bearer authentication) and the optional SSL connector in
+KafkaCruiseControlApp.java:100-173 (HTTPS round trip).
+"""
+import datetime
+import json
+import ssl
+import urllib.request
+
+import conftest  # noqa: F401
+import pytest
+
+from cruise_control_tpu.api.security import (AuthenticationError,
+                                             JwtSecurityProvider, Role)
+
+SECRET = b"test-hs256-secret"
+
+
+def _provider(**kw):
+    kw.setdefault("hs256_secret", SECRET)
+    return JwtSecurityProvider(**kw)
+
+
+def _headers(token):
+    return {"Authorization": f"Bearer {token}"}
+
+
+class TestHs256:
+    def test_roundtrip_and_role(self):
+        p = _provider(time_fn=lambda: 1000.0)
+        tok = p.issue_hs256({"sub": "alice", "role": "ADMIN", "exp": 2000})
+        principal = p.authenticate(_headers(tok))
+        assert principal.name == "alice"
+        assert principal.role == Role.ADMIN
+
+    def test_default_role_when_claim_absent(self):
+        p = _provider(default_role=Role.VIEWER, time_fn=lambda: 0.0)
+        tok = p.issue_hs256({"sub": "bob"})
+        assert p.authenticate(_headers(tok)).role == Role.VIEWER
+
+    def test_expired_and_leeway(self):
+        p = _provider(leeway_s=10.0, time_fn=lambda: 1000.0)
+        tok = p.issue_hs256({"sub": "a", "exp": 995})
+        p.authenticate(_headers(tok))          # inside leeway
+        tok = p.issue_hs256({"sub": "a", "exp": 900})
+        with pytest.raises(AuthenticationError, match="expired"):
+            p.authenticate(_headers(tok))
+
+    def test_nbf(self):
+        p = _provider(leeway_s=0.0, time_fn=lambda: 1000.0)
+        tok = p.issue_hs256({"sub": "a", "nbf": 2000})
+        with pytest.raises(AuthenticationError, match="not yet valid"):
+            p.authenticate(_headers(tok))
+
+    def test_bad_signature(self):
+        p = _provider(time_fn=lambda: 0.0)
+        other = JwtSecurityProvider(hs256_secret=b"other",
+                                    time_fn=lambda: 0.0)
+        tok = other.issue_hs256({"sub": "a"})
+        with pytest.raises(AuthenticationError, match="signature"):
+            p.authenticate(_headers(tok))
+
+    def test_alg_none_rejected(self):
+        from cruise_control_tpu.api.security import _b64url
+        p = _provider(time_fn=lambda: 0.0)
+        header = _b64url(json.dumps({"alg": "none"}).encode())
+        body = _b64url(json.dumps({"sub": "evil"}).encode())
+        with pytest.raises(AuthenticationError, match="not accepted"):
+            p.authenticate(_headers(f"{header}.{body}."))
+
+    def test_issuer_audience(self):
+        p = _provider(issuer="cc", audience="ops", time_fn=lambda: 0.0)
+        good = p.issue_hs256({"sub": "a", "iss": "cc", "aud": ["ops", "x"]})
+        p.authenticate(_headers(good))
+        bad = p.issue_hs256({"sub": "a", "iss": "cc", "aud": "other"})
+        with pytest.raises(AuthenticationError, match="audience"):
+            p.authenticate(_headers(bad))
+        bad = p.issue_hs256({"sub": "a", "iss": "zz", "aud": "ops"})
+        with pytest.raises(AuthenticationError, match="issuer"):
+            p.authenticate(_headers(bad))
+
+    def test_unknown_role_rejected(self):
+        p = _provider(time_fn=lambda: 0.0)
+        tok = p.issue_hs256({"sub": "a", "role": "SUPERUSER"})
+        with pytest.raises(AuthenticationError, match="unknown role"):
+            p.authenticate(_headers(tok))
+
+
+def _rsa_keypair():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    return key, pub
+
+
+def _sign_rs256(private_key, claims):
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    from cruise_control_tpu.api.security import _b64url
+    header = _b64url(json.dumps({"alg": "RS256", "typ": "JWT"}).encode())
+    body = _b64url(json.dumps(claims).encode())
+    signing_input = f"{header}.{body}".encode()
+    sig = private_key.sign(signing_input, padding.PKCS1v15(),
+                           hashes.SHA256())
+    return f"{header}.{body}.{_b64url(sig)}"
+
+
+class TestRs256:
+    def test_roundtrip(self):
+        key, pub = _rsa_keypair()
+        p = JwtSecurityProvider(rs256_public_key_pem=pub,
+                                time_fn=lambda: 0.0)
+        tok = _sign_rs256(key, {"sub": "carol", "role": "USER"})
+        principal = p.authenticate(_headers(tok))
+        assert principal.name == "carol"
+        assert principal.role == Role.USER
+
+    def test_wrong_key_rejected(self):
+        key, _ = _rsa_keypair()
+        _, other_pub = _rsa_keypair()
+        p = JwtSecurityProvider(rs256_public_key_pem=other_pub,
+                                time_fn=lambda: 0.0)
+        tok = _sign_rs256(key, {"sub": "carol"})
+        with pytest.raises(AuthenticationError, match="signature"):
+            p.authenticate(_headers(tok))
+
+    def test_hs256_token_against_rs256_only_provider(self):
+        """Algorithm confusion: an HS256 token signed with the PEM bytes
+        must not pass an RS256-only provider."""
+        _, pub = _rsa_keypair()
+        p = JwtSecurityProvider(rs256_public_key_pem=pub,
+                                time_fn=lambda: 0.0)
+        forger = JwtSecurityProvider(hs256_secret=pub, time_fn=lambda: 0.0)
+        tok = forger.issue_hs256({"sub": "evil", "role": "ADMIN"})
+        with pytest.raises(AuthenticationError, match="not accepted"):
+            p.authenticate(_headers(tok))
+
+
+def _self_signed_cert(tmp_path):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now)
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .sign(key, hashes.SHA256()))
+    pem = tmp_path / "server.pem"
+    pem.write_bytes(
+        key.private_bytes(serialization.Encoding.PEM,
+                          serialization.PrivateFormat.TraditionalOpenSSL,
+                          serialization.NoEncryption())
+        + cert.public_bytes(serialization.Encoding.PEM))
+    return str(pem)
+
+
+def test_https_round_trip(tmp_path):
+    """Boot the real server with TLS and hit STATE over https."""
+    from cruise_control_tpu.api.server import make_server_ssl_context
+    from test_api import make_app
+
+    pem = _self_signed_cert(tmp_path)
+    sim, cc, app = make_app()
+    try:
+        port = app.start(host="127.0.0.1", port=0,
+                         ssl_context=make_server_ssl_context(pem))
+        client_ctx = ssl.create_default_context()
+        client_ctx.check_hostname = False
+        client_ctx.verify_mode = ssl.CERT_NONE
+        with urllib.request.urlopen(
+                f"https://127.0.0.1:{port}/kafkacruisecontrol/state",
+                context=client_ctx, timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert resp.status == 200
+        assert "MonitorState" in body or "monitorState" in body or body
+    finally:
+        app.stop()
+        cc.shutdown()
