@@ -92,7 +92,7 @@ def main() -> None:
     num_b = int(os.environ.get("BENCH_BROKERS", d_b))
     num_p = int(os.environ.get("BENCH_PARTITIONS", d_p))
     rf = int(os.environ.get("BENCH_RF", 3))
-    rounds = int(os.environ.get("BENCH_ROUNDS", 128))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 192))
     goal_names = os.environ.get("BENCH_GOALS")
     names = goal_names.split(",") if goal_names else d_goals
 

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
-                                                 make_round_cache)
+                                                 make_round_cache,
+                                                 replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
     dest_side_only, leader_shed_rows, shed_rows)
@@ -54,8 +55,7 @@ class CapacityGoal(Goal):
         # loop-invariant [R] arrays hoisted out of the round body
         bonus = (state.partition_leader_bonus[state.replica_partition, res]
                  * state.replica_valid)
-        base_movable = (state.replica_valid & ~ctx.replica_excluded
-                        & ctx.replica_movable & ~state.replica_offline)
+        base_movable = replica_static_ok(state, ctx)
 
         def round_body(st: ClusterState, cache):
             committed = jnp.zeros((), dtype=bool)
@@ -201,8 +201,7 @@ class ReplicaCapacityGoal(Goal):
 
         multi_k = 4 if dest_side_only(prev_goals) else 1
 
-        base_movable = (state.replica_valid & ~ctx.replica_excluded
-                        & ctx.replica_movable & ~state.replica_offline)
+        base_movable = replica_static_ok(state, ctx)
 
         def round_body(st: ClusterState, cache):
             count = cache.replica_count.astype(jnp.float32)

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
-                                                 make_round_cache)
+                                                 make_round_cache,
+                                                 replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
     dest_side_only, leader_shed_rows, new_broker_dest_mask,
@@ -76,9 +77,7 @@ class ReplicaDistributionGoal(Goal):
             state, ctx.broker_dest_ok & state.broker_alive)
 
         w_static = self._weights(state)
-        base_movable = (state.replica_valid & ~ctx.replica_excluded
-                        & ctx.replica_movable & ~state.replica_offline
-                        & (w_static > 0.0))
+        base_movable = replica_static_ok(state, ctx) & (w_static > 0.0)
 
         def phase_shed(st, cache):
             counts = self._counts(cache)
@@ -180,16 +179,24 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
 
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
+        """Leadership transfers first; when transfers alone cannot balance
+        (e.g. an over-count broker leads partitions whose followers all sit
+        on other over-count brokers), fall back to MOVING leader replicas
+        to under-count brokers (reference LeaderReplicaDistributionGoal
+        rebalanceForBroker: maybeApplyBalancingAction with
+        LEADERSHIP_MOVEMENT then INTER_BROKER_REPLICA_MOVEMENT)."""
+        counts0 = S.broker_leader_count(state).astype(jnp.float32)
+        avg = self._avg(state, counts0)
+        lower, upper = _count_bounds(avg, self.pct_margin)
+        base_movable = replica_static_ok(state, ctx)
+        dest_ok = new_broker_dest_mask(
+            state, ctx.broker_dest_ok & state.broker_alive)
 
-        base_movable = (state.replica_valid & ~ctx.replica_excluded
-                        & ctx.replica_movable & ~state.replica_offline)
-
-        def round_body(st: ClusterState, cache):
+        def phase_transfer(st, cache):
             counts = self._counts(cache)
-            avg = self._avg(st, counts)
-            lower, upper = _count_bounds(avg, self.pct_margin)
             movable = base_movable
-            accept = compose_leadership_acceptance(prev_goals, st, ctx, cache)
+            accept = compose_leadership_acceptance(prev_goals, st, ctx,
+                                                   cache)
 
             def accept_all(src_r, dst_r):
                 db = st.replica_broker[dst_r]
@@ -210,19 +217,31 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
                                                          cand_f, cand_v)
             return st, cache, jnp.any(cand_v)
 
-        def cond(carry):
-            _, _, rounds, progressed = carry
-            return progressed & (rounds < self.rounds_for(ctx))
+        def phase_move(st, cache):
+            counts = self._counts(cache)
+            w = (st.replica_valid & st.replica_is_leader).astype(jnp.float32)
+            movable = base_movable & (w > 0.0)
+            accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            move_dest = (dest_ok & ctx.broker_leader_ok
+                         & (counts + 1 <= upper))
+            w_rows = cache.table_leader.astype(jnp.float32)
+            cand_r, cand_d, cand_v = kernels.move_round(
+                st, w, counts > upper, counts - upper, movable, move_dest,
+                upper - counts, accept, -counts, ctx.partition_replicas,
+                cache=cache,
+                sc_rows=shed_rows(cache, w_rows, counts > upper,
+                                  counts - upper))
+            st, cache = kernels.commit_moves_cached(st, cache, cand_r,
+                                                    cand_d, cand_v)
+            return st, cache, jnp.any(cand_v)
 
-        def body(carry):
-            st, cache, rounds, _ = carry
-            st, cache, committed = round_body(st, cache)
-            return st, cache, rounds + 1, committed
+        def over_exists(st, cache):
+            return jnp.any(st.broker_alive & (self._counts(cache) > upper))
 
-        state, _, _, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
-                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
-        return state
+        return run_phase_sweeps(
+            state, [(phase_transfer, over_exists),
+                    (phase_move, over_exists)],
+            self.rounds_for(ctx), table_slots=ctx.table_slots, ctx=ctx)
 
     def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
         counts = self._counts(cache)

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  leader_nw_in,
-                                                 make_round_cache)
+                                                 make_round_cache,
+                                                 replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
     dest_side_only, leader_shed_rows, shed_rows)
@@ -49,9 +50,7 @@ class PotentialNwOutGoal(Goal):
 
         # loop-invariant: the leader-ROLE load is leadership-independent
         w_static = self._leader_role_nw_out(state)
-        base_movable = (state.replica_valid & ~ctx.replica_excluded
-                        & ctx.replica_movable & ~state.replica_offline
-                        & (w_static > 0.0))
+        base_movable = replica_static_ok(state, ctx) & (w_static > 0.0)
 
         def round_body(st: ClusterState, cache):
             pot = cache.potential_nw_out
@@ -152,8 +151,7 @@ class LeaderBytesInDistributionGoal(Goal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
-        base_movable = (state.replica_valid & ~ctx.replica_excluded
-                        & ctx.replica_movable & ~state.replica_offline)
+        base_movable = replica_static_ok(state, ctx)
 
         def round_body(st: ClusterState, cache):
             lbi = cache.leader_bytes_in

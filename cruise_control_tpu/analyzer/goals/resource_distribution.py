@@ -24,7 +24,8 @@ import jax.numpy as jnp
 from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  RoundCache,
-                                                 make_round_cache)
+                                                 make_round_cache,
+                                                 replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
     compose_swap_acceptance, dest_side_only, leader_shed_rows,
@@ -84,8 +85,7 @@ class ResourceDistributionGoal(Goal):
         # scale with gathers at ~140M elem/s)
         bonus = (state.partition_leader_bonus[state.replica_partition, res]
                  * state.replica_valid)
-        base_movable = (state.replica_valid & ~ctx.replica_excluded
-                        & ctx.replica_movable & ~state.replica_offline)
+        base_movable = replica_static_ok(state, ctx)
 
         def phase_a(st, cache):
             W = cache.broker_load[:, res]

@@ -313,9 +313,15 @@ def move_round(state: ClusterState,
             cum_before = jnp.cumsum(w_bk, axis=1) - w_bk
             cand_has &= (cum_before < src_excess[:, None]).reshape(-1)
 
-        # per-broker starvation escalation: a broker whose whole top-k is
-        # destination-blocked must reach its lower-ranked candidates — the
-        # full [R]-plane selection runs only in that (rare) case
+        # starvation escalation, THIN-PROGRESS form: the expensive full
+        # [R]-plane selection runs when shortlist commits are scarce
+        # relative to brokers with pending work (<1/8, incl. zero).  While
+        # progress is broad, blocked brokers wait cheaply; once progress
+        # thins, the full plane serves them, so no broker is starved
+        # permanently.  (Per-broker escalation fired the full plane nearly
+        # every round while stubborn brokers existed — measured ~5s/goal;
+        # the empty-only form under-served starved brokers within the
+        # round budget — NwOutUsage violated 72 -> 477.)
         struct_any = jnp.any(sc_rows > NEG / 2, axis=1)
         got = jnp.any(cand_has.reshape(num_b, kk), axis=1)
 
@@ -338,8 +344,9 @@ def move_round(state: ClusterState,
             ch = ch.at[:, 0].set(jnp.where(take, True, ch[:, 0]))
             return cr.reshape(-1), ch.reshape(-1)
 
+        thin = (jnp.sum(got) * 8 < jnp.sum(struct_any))
         cand_r, cand_has = jax.lax.cond(
-            jnp.any(struct_any & ~got), full_pick,
+            jnp.any(struct_any & ~got) & thin, full_pick,
             lambda: (cand_r, cand_has))
         cand_r_safe = jnp.maximum(cand_r, 0)
         cand_w = w[cand_r_safe]
@@ -553,7 +560,8 @@ def leadership_round(state: ClusterState,
         return sib_safe, sib_b, ok
 
     is_src = src_excess > 0.0
-    if bonus_rows is not None and value_rows is not None             and _has_table(cache):
+    if (bonus_rows is not None and value_rows is not None
+            and _has_table(cache)):
         kk = min(8, max(cache.broker_table.shape[1], 1))
         top_sc, slots = jax.lax.top_k(bonus_rows, kk)          # [B, kk]
         has_struct = top_sc > NEG / 2
@@ -571,8 +579,7 @@ def leadership_round(state: ClusterState,
             cand_has,
             jnp.take_along_axis(cand, first[:, None], axis=1)[:, 0], -1)
 
-        # per-broker starvation: structural candidates exist but the whole
-        # top-k was rejected -> evaluate the full plane, merge those rows
+        # starvation escalation, THIN-PROGRESS form (see move_round)
         struct_any = jnp.any(bonus_rows > NEG / 2, axis=1)
         starved = struct_any & ~cand_has
 
@@ -587,8 +594,10 @@ def leadership_round(state: ClusterState,
             take = starved & f_has
             return (jnp.where(take, f_cand, cand_r), cand_has | take)
 
+        thin = (jnp.sum(cand_has) * 8 < jnp.sum(struct_any))
         cand_r, cand_has = jax.lax.cond(
-            jnp.any(starved), full_plane, lambda: (cand_r, cand_has))
+            jnp.any(starved) & thin, full_plane,
+            lambda: (cand_r, cand_has))
         cand_r_safe = jnp.maximum(cand_r, 0)
         cand_bonus_b = bonus_w[cand_r_safe]
     else:

@@ -155,9 +155,11 @@ class UserTaskManager:
             for info in done[:max(0, len(done)
                                   - self._max_cached_completed)]:
                 self._tasks.pop(info.task_id, None)
-                self._by_request.pop(
-                    (info.client_id, f"{info.endpoint}?{info.query}"),
-                    None)
+                key = (info.client_id, f"{info.endpoint}?{info.query}")
+                # only sever the binding if it still points at THIS task —
+                # a newer ACTIVE task may have re-bound the same key
+                if self._by_request.get(key) == info.task_id:
+                    self._by_request.pop(key, None)
         if self._attach_max_age_s is not None:
             attach_cutoff = now_ms - self._attach_max_age_s * 1000.0
             for key, tid in list(self._by_request.items()):

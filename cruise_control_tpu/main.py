@@ -436,8 +436,7 @@ def main(argv=None) -> int:
     ssl_ctx = build_ssl_context(config)
     bound = app.start(host=host, port=port, ssl_context=ssl_ctx)
     LOG.info("REST API listening on %s://%s:%d%s",
-             "https" if ssl_ctx else "http", host, bound,
-             "/kafkacruisecontrol")
+             "https" if ssl_ctx else "http", host, bound, app.base_path)
 
     stop = threading.Event()
 
