@@ -151,3 +151,16 @@ def reference_small_cluster() -> Tuple[ClusterState, ClusterTopology]:
     b.add_partition("T2", 2, 0, [1], load(20.0, 45.0, 120.0, 95.0),
                     follower_loads=[load(8.0, 45.0, 0.0, 95.0)])
     return b.build()
+
+
+def util_spread(state: ClusterState, resource: int) -> float:
+    """Max-min utilization spread over alive brokers — the shared balance
+    metric used by the distribution-goal tests."""
+    import numpy as np
+
+    from cruise_control_tpu.model import state as S
+    load = np.asarray(S.broker_load(state))[:, resource]
+    cap = np.asarray(state.broker_capacity)[:, resource]
+    alive = np.asarray(state.broker_alive)
+    util = load[alive] / cap[alive]
+    return float(util.max() - util.min())

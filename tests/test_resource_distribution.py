@@ -19,12 +19,7 @@ from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
 from cruise_control_tpu.testing.verifier import run_and_verify
 
 
-def _util_spread(state, res):
-    load = np.asarray(S.broker_load(state))
-    cap = np.asarray(state.broker_capacity)
-    alive = np.asarray(state.broker_alive)
-    util = load[alive, res] / cap[alive, res]
-    return util.max() - util.min()
+from cruise_control_tpu.testing.fixtures import util_spread as _util_spread
 
 
 def test_disk_distribution_on_unbalanced():

@@ -43,10 +43,8 @@ def _tight_hot_cold():
 
 
 def _disk_spread(state):
-    load = np.asarray(S.broker_load(state))[:, R.DISK]
-    cap = np.asarray(state.broker_capacity)[:, R.DISK]
-    util = load / cap
-    return util.max() - util.min()
+    from cruise_control_tpu.testing.fixtures import util_spread
+    return util_spread(state, R.DISK)
 
 
 def test_swaps_balance_when_moves_cannot():
