@@ -562,26 +562,51 @@ def leadership_round(state: ClusterState,
     is_src = src_excess > 0.0
     if (bonus_rows is not None and value_rows is not None
             and _has_table(cache)):
-        kk = min(8, max(cache.broker_table.shape[1], 1))
-        top_sc, slots = jax.lax.top_k(bonus_rows, kk)          # [B, kk]
-        has_struct = top_sc > NEG / 2
-        cand = jnp.take_along_axis(cache.broker_table, slots, axis=1)
-        cand_flat = jnp.maximum(cand.reshape(-1), 0)
-        cand_bonus = jnp.take_along_axis(value_rows, slots,
-                                         axis=1).reshape(-1)
-        _, _, ok_opts = options_feasible(cand_flat, cand_bonus)
-        ok_c = (jnp.any(ok_opts, axis=1).reshape(num_b, kk)
-                & has_struct)                                  # [B, kk]
-        # first (highest-scored) accepted candidate per broker
-        first = jnp.argmax(ok_c, axis=1)
-        cand_has = jnp.any(ok_c, axis=1)
-        cand_r = jnp.where(
-            cand_has,
-            jnp.take_along_axis(cand, first[:, None], axis=1)[:, 0], -1)
+        def pick_from_shortlist(k, merge_into=None):
+            """Per-broker first ACCEPTED candidate among the top-k
+            structural candidates of each row; with `merge_into`
+            (prev_cand, prev_has), only rows the previous tier left
+            unserved take the new pick."""
+            k = min(k, max(cache.broker_table.shape[1], 1))
+            top_sc, slots = jax.lax.top_k(bonus_rows, k)       # [B, k]
+            has_struct_k = top_sc > NEG / 2
+            cand_k = jnp.take_along_axis(cache.broker_table, slots, axis=1)
+            flat = jnp.maximum(cand_k.reshape(-1), 0)
+            flat_bonus = jnp.take_along_axis(value_rows, slots,
+                                             axis=1).reshape(-1)
+            _, _, ok_opts = options_feasible(flat, flat_bonus)
+            ok_rows = (jnp.any(ok_opts, axis=1).reshape(num_b, k)
+                       & has_struct_k)                         # [B, k]
+            first = jnp.argmax(ok_rows, axis=1)
+            has = jnp.any(ok_rows, axis=1)
+            pick = jnp.where(
+                has,
+                jnp.take_along_axis(cand_k, first[:, None], axis=1)[:, 0],
+                -1)
+            if merge_into is None:
+                return pick, has
+            prev_cand, prev_has = merge_into
+            take = ~prev_has & has
+            return (jnp.where(take, pick, prev_cand), prev_has | take)
 
-        # starvation escalation, THIN-PROGRESS form (see move_round)
+        cand_r, cand_has = pick_from_shortlist(8)
+
+        # starvation escalation, TWO TIERS (see move_round for the
+        # thin-progress rationale).  The convergence tail triggers thin
+        # rounds repeatedly, so tier 1 stays candidate-level: re-pick from
+        # a DEEP per-broker shortlist (top-64 structural candidates, ~8x
+        # cheaper than the [R, RF] plane).  Tier 2 — the true full plane —
+        # runs only on thin rounds the deep tier could not help at all,
+        # so no broker with a feasible handoff deeper than its top-64 can
+        # stall for a whole phase.
         struct_any = jnp.any(bonus_rows > NEG / 2, axis=1)
-        starved = struct_any & ~cand_has
+        thin = (jnp.sum(cand_has) * 8 < jnp.sum(struct_any))
+
+        served_before_deep = jnp.sum(cand_has)
+        cand_r, cand_has = jax.lax.cond(
+            jnp.any(struct_any & ~cand_has) & thin,
+            lambda: pick_from_shortlist(64, (cand_r, cand_has)),
+            lambda: (cand_r, cand_has))
 
         def full_plane():
             lead_eligible = (movable & state.replica_is_leader
@@ -591,13 +616,13 @@ def leadership_round(state: ClusterState,
             score = jnp.where(r_has,
                               shed_score(bonus_w, src_excess[rb]), NEG)
             f_cand, f_has = table_pick_best(cache, score, r_has)
-            take = starved & f_has
+            take = struct_any & ~cand_has & f_has
             return (jnp.where(take, f_cand, cand_r), cand_has | take)
 
-        thin = (jnp.sum(cand_has) * 8 < jnp.sum(struct_any))
+        deep_helped = jnp.sum(cand_has) > served_before_deep
         cand_r, cand_has = jax.lax.cond(
-            jnp.any(starved) & thin, full_plane,
-            lambda: (cand_r, cand_has))
+            jnp.any(struct_any & ~cand_has) & thin & ~deep_helped,
+            full_plane, lambda: (cand_r, cand_has))
         cand_r_safe = jnp.maximum(cand_r, 0)
         cand_bonus_b = bonus_w[cand_r_safe]
     else:
