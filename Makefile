@@ -1,0 +1,14 @@
+# One-command hygiene check (the reference's `analyze` + `build` CI steps,
+# .circleci/config.yml:18-35): `make check` = lint + full test suite.
+.PHONY: check lint test bench
+
+check: lint test
+
+lint:
+	python tools/lint.py
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py

@@ -180,6 +180,10 @@ def main() -> None:
           + (", ".join(f"{g}={b}->{o}->{a}"
                        for g, (b, o, a) in nonzero.items())
              or "none"), file=sys.stderr)
+    print("# rounds by goal: "
+          + (", ".join(f"{g}={r}" for g, r in
+                       results[-1].rounds_by_goal.items()) or "n/a"),
+          file=sys.stderr)
     # vs_baseline is a TARGET ratio (5 s north star / measured), not a
     # measured-reference comparison: no JVM exists in this environment to
     # run the reference GoalOptimizer (see BASELINE.md "measurement

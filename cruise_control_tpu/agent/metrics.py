@@ -15,7 +15,6 @@ from __future__ import annotations
 import dataclasses
 import enum
 import struct
-from typing import List, Optional, Union
 
 
 class MetricScope(enum.Enum):

@@ -10,7 +10,7 @@ needs at trace time lives here as a static Python value or a device array.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -106,9 +106,11 @@ class OptimizationContext:
     #: host-side from the initial per-broker counts with headroom.
     table_slots: int = dataclasses.field(metadata=dict(static=True),
                                          default=0)
-    #: reduced-effort mode (reference OptimizationOptions.fastMode): soft
-    #: goals run on a quartered round budget and skip the swap fallback;
-    #: hard goals are unaffected (they must converge regardless).
+    #: reduced-effort mode — a FRAMEWORK EXTENSION (this reference snapshot
+    #: has no fast-mode member; the knob models the round-budget/search
+    #: trade-off its swap timeouts express): soft goals run on a quartered
+    #: round budget and skip the swap fallback; hard goals are unaffected
+    #: (they must converge regardless).
     fast_mode: bool = dataclasses.field(metadata=dict(static=True),
                                         default=False)
 

@@ -16,6 +16,7 @@ hard part (a)).
 from __future__ import annotations
 
 import abc
+import threading
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -54,10 +55,10 @@ class Goal(abc.ABC):
         """Config hook for getConfiguredInstances."""
 
     def rounds_for(self, ctx: OptimizationContext) -> int:
-        """Effective round budget: fast mode (reference
-        OptimizationOptions.fastMode — reduced search effort) quarters the
-        budget for soft goals; hard goals keep theirs, since an
-        unconverged hard goal aborts the optimization."""
+        """Effective round budget: fast mode (a framework extension — see
+        OptimizationContext.fast_mode) quarters the budget for soft goals;
+        hard goals keep theirs, since an unconverged hard goal aborts the
+        optimization."""
         if ctx.fast_mode and not self.is_hard:
             # max_rounds stays a ceiling: fast mode must never search MORE
             return min(self.max_rounds, max(8, self.max_rounds // 4))
@@ -123,6 +124,32 @@ class Goal(abc.ABC):
         return f"<{type(self).__name__} {self.name}>"
 
 
+# ---------------------------------------------------------------------------
+# Round-count instrumentation: goals report how many search rounds their
+# loops consumed (the per-goal analog of the reference's "Finished
+# optimization for {} in {}ms" timing, AbstractGoal.java:87-89 — rounds are
+# the unit of wall-clock here).  The sink is trace-time state: a goal's
+# optimize() appends its round-counter TRACER, and the optimizer's segment
+# function (which set the sink up before calling optimize) stacks the
+# tracers into a jitted output.  Thread-local because warmup lowers
+# segment programs from a thread pool.
+# ---------------------------------------------------------------------------
+
+_ROUND_SINK = threading.local()
+
+
+def set_round_sink(sink) -> None:
+    """Install `sink` (a list) to collect round counters; None removes."""
+    _ROUND_SINK.value = sink
+
+
+def note_rounds(rounds) -> None:
+    """Report a goal loop's final round counter (i32 scalar tracer)."""
+    sink = getattr(_ROUND_SINK, "value", None)
+    if sink is not None:
+        sink.append(rounds)
+
+
 def run_phase_sweeps(state: ClusterState, phases, max_rounds: int,
                      table_slots: int = 0,
                      ctx: Optional[OptimizationContext] = None
@@ -180,10 +207,11 @@ def run_phase_sweeps(state: ClusterState, phases, max_rounds: int,
             sweep_again = sweep_again | committed
         return st, cache, rounds, sweep_again
 
-    state, _, _, _ = jax.lax.while_loop(
+    state, _, rounds, _ = jax.lax.while_loop(
         outer_cond, outer_body,
         (state, make_round_cache(state, table_slots, ctx),
          jnp.zeros((), jnp.int32), jnp.ones((), bool)))
+    note_rounds(rounds)
     return state
 
 
@@ -215,6 +243,31 @@ def leader_shed_rows(cache: RoundCache, value_rows: jax.Array,
     sc = jnp.where(value_rows <= excess_b[:, None], value_rows,
                    -value_rows)
     return jnp.where(ok, sc, kernels.NEG)
+
+
+def balancedness_cost_by_goal(ordered_names: Sequence[str],
+                              hard_names,
+                              priority_weight: float = 1.1,
+                              strictness_weight: float = 1.5) -> dict:
+    """{goal name: cost} summing to 100 — the reference's rank-weighted
+    balancedness cost (KafkaCruiseControlUtils.balancednessCostByGoal,
+    KafkaCruiseControlUtils.java:526-552): walking goals from lowest to
+    highest priority, each level multiplies the weight by
+    `priority_weight`, and hard goals additionally weigh
+    `strictness_weight`×.  `ordered_names` is highest-priority first."""
+    if not ordered_names:
+        return {}
+    if priority_weight <= 0 or strictness_weight <= 0:
+        raise ValueError("balancedness weights must be positive")
+    hard = set(hard_names)
+    costs = {}
+    prev = 1.0 / priority_weight
+    for name in reversed(list(ordered_names)):
+        cur = priority_weight * prev
+        costs[name] = cur * (strictness_weight if name in hard else 1.0)
+        prev = cur
+    total = sum(costs.values())
+    return {n: 100.0 * c / total for n, c in costs.items()}
 
 
 def dest_side_only(prev_goals: Sequence[Goal]) -> bool:

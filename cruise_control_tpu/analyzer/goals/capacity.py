@@ -23,10 +23,9 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
-    dest_side_only, leader_shed_rows, shed_rows)
+    dest_side_only, leader_shed_rows, note_rounds, shed_rows)
 from cruise_control_tpu.common.resources import (RESOURCE_GOAL_NAMES,
                                                  Resource)
-from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
 
 
@@ -116,9 +115,10 @@ class CapacityGoal(Goal):
             st, cache, committed = round_body(st, cache)
             return st, cache, rounds + 1, committed
 
-        state, _, _, _ = jax.lax.while_loop(
+        state, _, rounds, _ = jax.lax.while_loop(
             cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
+        note_rounds(rounds)
         return state
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
@@ -231,9 +231,10 @@ class ReplicaCapacityGoal(Goal):
             st, cache, committed = round_body(st, cache)
             return st, cache, rounds + 1, committed
 
-        state, _, _, _ = jax.lax.while_loop(
+        state, _, rounds, _ = jax.lax.while_loop(
             cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
+        note_rounds(rounds)
         return state
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):

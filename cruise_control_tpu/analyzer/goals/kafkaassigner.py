@@ -23,11 +23,10 @@ import jax.numpy as jnp
 from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  make_round_cache)
-from cruise_control_tpu.analyzer.goals.base import (Goal,
-                                                    compose_swap_acceptance)
+from cruise_control_tpu.analyzer.goals.base import (
+    Goal, compose_swap_acceptance, note_rounds)
 from cruise_control_tpu.analyzer.goals.rack_aware import RackAwareGoal
 from cruise_control_tpu.common.resources import Resource
-from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
 
 
@@ -115,9 +114,10 @@ class KafkaAssignerDiskUsageDistributionGoal(Goal):
             st, cache, committed = round_body(st, cache)
             return st, cache, rounds + 1, committed
 
-        state, _, _, _ = jax.lax.while_loop(
+        state, _, rounds, _ = jax.lax.while_loop(
             cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
+        note_rounds(rounds)
         return state
 
     def violated_brokers(self, state, ctx, cache):

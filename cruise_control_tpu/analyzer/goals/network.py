@@ -23,7 +23,7 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
-    dest_side_only, leader_shed_rows, shed_rows)
+    dest_side_only, leader_shed_rows, note_rounds, shed_rows)
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
@@ -88,9 +88,10 @@ class PotentialNwOutGoal(Goal):
             st, cache, committed = round_body(st, cache)
             return st, cache, rounds + 1, committed
 
-        state, _, _, _ = jax.lax.while_loop(
+        state, _, rounds, _ = jax.lax.while_loop(
             cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
+        note_rounds(rounds)
         return state
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
@@ -191,9 +192,10 @@ class LeaderBytesInDistributionGoal(Goal):
             st, cache, committed = round_body(st, cache)
             return st, cache, rounds + 1, committed
 
-        state, _, _, _ = jax.lax.while_loop(
+        state, _, rounds, _ = jax.lax.while_loop(
             cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
+        note_rounds(rounds)
         return state
 
     def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):

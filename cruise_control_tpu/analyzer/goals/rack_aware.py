@@ -22,8 +22,8 @@ import jax.numpy as jnp
 from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  make_round_cache)
-from cruise_control_tpu.analyzer.goals.base import (Goal,
-                                                    compose_move_acceptance)
+from cruise_control_tpu.analyzer.goals.base import (
+    Goal, compose_move_acceptance, note_rounds)
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
@@ -109,9 +109,10 @@ class RackAwareGoal(Goal):
             st, cache, committed = round_body(st, cache)
             return st, cache, rounds + 1, committed
 
-        state, _, _, _ = jax.lax.while_loop(
+        state, _, rounds, _ = jax.lax.while_loop(
             cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
+        note_rounds(rounds)
         return state
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):

@@ -15,24 +15,20 @@ source broker; the round loop is a `lax.while_loop` with early exit.
 """
 from __future__ import annotations
 
-import functools
 from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer import kernels
-from cruise_control_tpu.analyzer.context import (OptimizationContext,
-                                                 RoundCache,
-                                                 make_round_cache,
-                                                 replica_static_ok)
+from cruise_control_tpu.analyzer.context import (
+    OptimizationContext, replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
     compose_swap_acceptance, dest_side_only, leader_shed_rows,
     new_broker_dest_mask, run_phase_sweeps, shed_rows)
 from cruise_control_tpu.common.resources import (RESOURCE_GOAL_NAMES,
                                                  Resource)
-from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
 
 
@@ -196,8 +192,8 @@ class ResourceDistributionGoal(Goal):
         phases.append((phase_b, over_exists))
         phases.append((phase_c, under_exists))
         if self.max_swap_rounds and not ctx.fast_mode:
-            # fast mode (reference OptimizationOptions.fastMode) skips the
-            # expensive swap fallback entirely
+            # fast mode (framework extension, OptimizationContext.fast_mode)
+            # skips the expensive swap fallback entirely
             phases.append((phase_swap, swap_work_exists,
                            self.max_swap_rounds))
         state = run_phase_sweeps(state, phases, self.rounds_for(ctx),

@@ -31,11 +31,10 @@ from cruise_control_tpu.analyzer.context import (BalancingConstraint,
                                                  OptimizationOptions,
                                                  make_context,
                                                  make_round_cache)
-from cruise_control_tpu.analyzer.goals.base import (Goal, OptimizationFailure,
-                                                    compose_move_acceptance)
+from cruise_control_tpu.analyzer.goals.base import Goal, OptimizationFailure
 from cruise_control_tpu.analyzer.proposals import (ExecutionProposal,
                                                    diff_proposals)
-from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.sanity import sanity_check
 from cruise_control_tpu.model.state import ClusterState
@@ -65,6 +64,9 @@ class OptimizerResult:
     #: separates non-convergence from later-goal interference.
     violated_broker_counts: Dict[str, Tuple[int, int, int]] = \
         dataclasses.field(default_factory=dict)
+    #: per-goal search rounds consumed (wall-clock is round-count × round
+    #: cost, so this is the profiling instrument for the round budget)
+    rounds_by_goal: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     @property
     def num_replica_movements(self) -> int:
@@ -81,29 +83,30 @@ class OptimizerResult:
 
     #: goal names considered hard for the balancedness weighting
     hard_goal_names: frozenset = frozenset()
-    #: (soft, hard) goal weights (reference goal.balancedness.priority.weight
-    #: and goal.balancedness.strictness.weight,
-    #: CC/analyzer/GoalOptimizer.java:121-122)
-    balancedness_weights: Tuple[float, float] = (1.0, 2.0)
+    #: (priority, strictness) weights (reference
+    #: goal.balancedness.priority.weight and
+    #: goal.balancedness.strictness.weight, GoalOptimizer.java:121-122;
+    #: defaults match AnalyzerConfig 1.1 / 1.5)
+    balancedness_weights: Tuple[float, float] = (1.1, 1.5)
 
     def balancedness_score(self) -> float:
-        """[0, 100] gauge (reference AnomalyDetector.java:176-178 /
-        GoalOptimizer balancedness weights): weighted fraction of goals
-        without violations after optimization."""
+        """[0, 100] gauge: 100 minus the summed rank-weighted cost of the
+        goals still violated after optimization (reference
+        KafkaCruiseControlUtils.balancednessCostByGoal :526-552 via
+        AnomalyDetector.java:176-178)."""
+        from cruise_control_tpu.analyzer.goals.base import \
+            balancedness_cost_by_goal
         goal_names = list(self.stats_by_goal) or sorted(
             set(self.violated_goals_before) | set(self.violated_goals_after))
         if not goal_names:
             return 100.0
-        soft_w, hard_w = self.balancedness_weights
+        pw, sw = self.balancedness_weights
+        costs = balancedness_cost_by_goal(goal_names, self.hard_goal_names,
+                                          pw, sw)
         violated = set(self.violated_goals_after)
-        total = 0.0
-        clean = 0.0
-        for name in goal_names:
-            weight = hard_w if name in self.hard_goal_names else soft_w
-            total += weight
-            if name not in violated:
-                clean += weight
-        return 100.0 * clean / total
+        kept = sum(c for n, c in costs.items() if n not in violated)
+        total = sum(costs.values())
+        return 100.0 * kept / total if total else 100.0
 
 
 def heal_offline_replicas(state: ClusterState, ctx: OptimizationContext,
@@ -153,7 +156,7 @@ class GoalOptimizer:
                  constraint: Optional[BalancingConstraint] = None,
                  jit_goals: bool = True,
                  pipeline_segment_size: int = 4,
-                 balancedness_weights: Tuple[float, float] = (1.0, 2.0)):
+                 balancedness_weights: Tuple[float, float] = (1.1, 1.5)):
         self.goals = list(goals)
         self.constraint = constraint or BalancingConstraint()
         self.balancedness_weights = balancedness_weights
@@ -174,7 +177,16 @@ class GoalOptimizer:
 
     def _pre_fn(self):
         """(state, ctx) -> (violated_broker_counts i32[G], healed state,
-        still_offline)."""
+        still_offline, max_broker_count, broken).
+
+        `broken` reports whether the cluster entered with dead brokers /
+        disks / offline replicas (waives the stats-regression abort).
+        `max_broker_count` is the post-heal max per-broker replica count:
+        self-healing runs table-less, so it is the one pass that can push
+        a broker past the static broker-table width sized by make_context
+        (every later arrival is fill-gated below the width); the caller
+        re-sizes the context when it overflows, so build_broker_table can
+        never silently truncate a row."""
         goals = tuple(self.goals)
 
         def run(state: ClusterState, ctx: OptimizationContext):
@@ -184,34 +196,52 @@ class GoalOptimizer:
                            .sum(dtype=jnp.int32) for g in goals])
                 if goals else jnp.zeros((0,), dtype=jnp.int32))
             needs_heal = S.self_healing_eligible(state).any()
+            # broken cluster (reference ClusterModel.brokenBrokers():
+            # dead brokers OR brokers with bad disks,
+            # ClusterModel.java:424-426) — the stats-regression abort is
+            # waived while the cluster is broken, AbstractGoal.java:92-93
+            broken = (needs_heal | ~jnp.all(state.broker_alive)
+                      | ~jnp.all(state.disk_alive))
             state = jax.lax.cond(
                 needs_heal, lambda s: heal_offline_replicas(s, ctx),
                 lambda s: s, state)
             still_offline = jnp.sum(S.self_healing_eligible(state))
-            return violated_before, state, still_offline
+            max_count = jnp.max(S.broker_replica_count(state))
+            return violated_before, state, still_offline, max_count, broken
         return run
 
     def _segment_fn(self, start: int, stop: int):
         """(state, ctx) -> (state, (stacked per-goal stats, own-violated
-        counts)) for goals[start:stop], with acceptance stacking over ALL
-        prior goals.  own-violated = the goal's violated-broker count right
+        counts, per-goal rounds)) for goals[start:stop], with acceptance
+        stacking over ALL prior goals.
+        own-violated = the goal's violated-broker count right
         after its own run — comparing it against the post-pipeline count
         separates "this goal could not converge" from "a later goal
         re-violated it"."""
         goals = tuple(self.goals)
 
         def run(state: ClusterState, ctx: OptimizationContext):
+            from cruise_control_tpu.analyzer.goals import base as goals_base
             per_goal_stats = []
             own_violated = []
+            rounds_used = []
             for i in range(start, stop):
-                state = goals[i].optimize(state, ctx, goals[:i])
+                sink: List = []
+                goals_base.set_round_sink(sink)
+                try:
+                    state = goals[i].optimize(state, ctx, goals[:i])
+                finally:
+                    goals_base.set_round_sink(None)
+                rounds_used.append(sum(sink)
+                                   if sink else jnp.zeros((), jnp.int32))
                 per_goal_stats.append(compute_stats(state))
                 own_violated.append(goals[i].violated_brokers(
                     state, ctx, make_round_cache(state))
                     .sum(dtype=jnp.int32))
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
                                    *per_goal_stats)
-            return state, (stacked, jnp.stack(own_violated))
+            return state, (stacked, jnp.stack(own_violated),
+                           jnp.stack(rounds_used))
         return run
 
     def _post_fn(self):
@@ -236,9 +266,14 @@ class GoalOptimizer:
         times — ~27 min at 2.6K-broker scale.  Compilation itself has no
         data dependencies, so `jax.jit(fn).lower(args).compile()` for all
         programs concurrently costs roughly the SLOWEST program instead.
-        The compiled executables are discarded; the later real call hits
-        the persistent cache (JAX_COMPILATION_CACHE_DIR) and pays only a
-        lookup.  Compile-transport errors are retried per program.
+        The compiled executables are RETAINED in `self._aot` and
+        `optimizations()` dispatches through them directly while argument
+        shapes match (`_run`): measured on the remote-TPU path, the
+        handoff from lower().compile() to a later jit dispatch misses the
+        persistent cache (JAX_COMPILATION_CACHE_DIR), so the retained
+        executables are the reliable fast path and the disk cache serves
+        process restarts.  Compile-transport errors are retried per
+        program.
 
         Returns wall-clock seconds spent."""
         import concurrent.futures
@@ -246,11 +281,11 @@ class GoalOptimizer:
 
         t0 = _time.time()
         if not jax.config.jax_compilation_cache_dir:
-            # the compiled executables are discarded; without a persistent
-            # cache the real run re-compiles everything from scratch and
-            # this warmup only DOUBLES the compile work
+            # the retained executables still serve THIS process; without a
+            # persistent cache nothing survives a restart
             LOG.warning("warmup without jax_compilation_cache_dir set: "
-                        "compiles cannot be handed off to the real run")
+                        "compiles serve this process only and a restart "
+                        "re-pays them")
         options = options or OptimizationOptions()
         ctx = make_context(state, self.constraint, options, topology)
         seg = max(1, self.pipeline_segment_size)
@@ -282,7 +317,9 @@ class GoalOptimizer:
 
     def optimizations(self, state: ClusterState, topology,
                       options: Optional[OptimizationOptions] = None,
-                      check_sanity: bool = True) -> OptimizerResult:
+                      check_sanity: bool = True,
+                      _table_slots_override: Optional[int] = None
+                      ) -> OptimizerResult:
         """Run all goals in priority order and diff out proposals
         (reference GoalOptimizer.optimizations :409-480).
 
@@ -296,24 +333,28 @@ class GoalOptimizer:
         t_start = time.time()
         options = options or OptimizationOptions()
         ctx = make_context(state, self.constraint, options, topology)
+        if _table_slots_override is not None:
+            ctx = dataclasses.replace(ctx,
+                                      table_slots=_table_slots_override)
         initial = state
         stats_before = jax.device_get(
             self._run("__stats__", compute_stats, state))
 
         t0 = time.time()
         profile = self.profile_segments
-        vb_dev, state, still_dev = self._run("__pre__", self._pre_fn(),
-                                             state, ctx)
+        vb_dev, state, still_dev, maxc_dev, broken_dev = self._run(
+            "__pre__", self._pre_fn(), state, ctx)
         if profile:
             jax.block_until_ready(state.replica_broker)
             LOG.info("segment pre+heal: %.0fms", (time.time() - t0) * 1e3)
         seg = max(1, self.pipeline_segment_size)
         stacked_parts = []
         own_parts = []
+        rounds_parts = []
         for start in range(0, len(self.goals), seg):
             stop = min(start + seg, len(self.goals))
             t_seg = time.time()
-            state, (stacked_seg, own_seg) = self._run(
+            state, (stacked_seg, own_seg, rounds_seg) = self._run(
                 f"__seg_{start}_{stop}__",
                 self._segment_fn(start, stop), state, ctx)
             if profile:
@@ -323,17 +364,41 @@ class GoalOptimizer:
                          (time.time() - t_seg) * 1e3)
             stacked_parts.append(stacked_seg)
             own_parts.append(own_seg)
+            rounds_parts.append(rounds_seg)
         va_dev = self._run("__post__", self._post_fn(), state, ctx)
         jax.block_until_ready(state.replica_broker)
         LOG.debug("goal pipeline (%d segments) ran in %.0fms",
                   (len(self.goals) + seg - 1) // seg,
                   (time.time() - t0) * 1e3)
-        stacked_h, own_h, vb_h, va_h, still_offline = jax.device_get(
-            (stacked_parts, own_parts, vb_dev, va_dev, still_dev))
+        (stacked_h, own_h, rounds_h, vb_h, va_h, still_offline, broken,
+         max_count) = jax.device_get(
+            (stacked_parts, own_parts, rounds_parts, vb_dev, va_dev,
+             still_dev, broken_dev, maxc_dev))
+        if ctx.table_slots and int(max_count) > ctx.table_slots:
+            # self-healing runs table-less and may concentrate replicas
+            # past the broker-table width sized from the PRE-heal counts;
+            # goals that rebuilt their table then silently dropped the
+            # overflow rows (rank >= S), hiding replicas from selection.
+            # Rare (healing + extreme concentration), so the pipeline runs
+            # optimistically and only an actual overflow pays a re-run
+            # with a wider static width (recompile, logged) instead of
+            # every call paying a mid-pipeline device sync.
+            new_slots = min(state.num_replicas,
+                            -(-int(max_count * 1.5 + 64) // 128) * 128)
+            LOG.warning(
+                "post-heal per-broker replica count %d overflowed the "
+                "broker table width %d; re-running with width %d "
+                "(programs recompile for the new static width)",
+                int(max_count), ctx.table_slots, new_slots)
+            return self.optimizations(initial, topology, options,
+                                      check_sanity=check_sanity,
+                                      _table_slots_override=new_slots)
         stacked_h = (jax.tree.map(
             lambda *xs: np.concatenate(xs), *stacked_h)
             if stacked_h else None)
         own_h = np.concatenate(own_h) if own_h else np.zeros(0, np.int32)
+        rounds_h = (np.concatenate(rounds_h) if rounds_h
+                    else np.zeros(0, np.int32))
 
         if int(still_offline):
             raise OptimizationFailure(
@@ -345,6 +410,8 @@ class GoalOptimizer:
         violated_after = [g.name for g, v in zip(self.goals, va_h) if v]
         violated_counts = {g.name: (int(b), int(o), int(a)) for g, b, o, a
                            in zip(self.goals, vb_h, own_h, va_h)}
+        rounds_by_goal = {g.name: int(r)
+                          for g, r in zip(self.goals, rounds_h)}
 
         stats_by_goal: Dict[str, ClusterModelStats] = {}
         regressed: List[str] = []
@@ -353,11 +420,21 @@ class GoalOptimizer:
             goal_stats = jax.tree.map(lambda x, i=i: x[i], stacked_h)
             stats_by_goal[goal.name] = goal_stats
             if not goal.stats_not_worse(prev_stats, goal_stats):
-                # reference AbstractGoal.optimize :92-101 treats a regressed
-                # comparator as failure unless self-healing
                 regressed.append(goal.name)
                 LOG.warning("goal %s regressed its statistic", goal.name)
             prev_stats = goal_stats
+
+        if regressed and not bool(broken):
+            # reference AbstractGoal.optimize :92-101: a goal whose stats
+            # comparator prefers the BEFORE state is an optimization
+            # failure — waived only while the cluster is broken (dead
+            # brokers/disks), where ANY valid self-healing move beats
+            # balance.  The reference aborts at the offending goal; the
+            # pipelined device run detects it post-hoc, failing the same
+            # request with the same exception type.
+            raise OptimizationFailure(
+                "optimization made goal statistics worse than before for: "
+                + ", ".join(regressed))
 
         for goal in self.goals:
             if goal.is_hard and goal.name in violated_after:
@@ -370,7 +447,8 @@ class GoalOptimizer:
         partition_rows = np.asarray(ctx.partition_replicas)
         proposals = diff_proposals(initial, state, topology, partition_rows)
         stats_after = (stats_by_goal[self.goals[-1].name] if self.goals
-                       else jax.device_get(stats_fn(state)))
+                       else jax.device_get(
+                           self._run("__stats__", compute_stats, state)))
         result = OptimizerResult(
             proposals=proposals,
             stats_before=stats_before,
@@ -382,6 +460,7 @@ class GoalOptimizer:
             final_state=state,
             duration_s=time.time() - t_start,
             violated_broker_counts=violated_counts,
+            rounds_by_goal=rounds_by_goal,
         )
         result.hard_goal_names = frozenset(
             g.name for g in self.goals if g.is_hard)

@@ -9,7 +9,7 @@ ExecutionProposal objects (executor/ExecutionProposal.java:1-301).
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 

@@ -7,7 +7,7 @@ lists, regex patterns, doubles).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Set
 
 from cruise_control_tpu.common.resources import Resource
 

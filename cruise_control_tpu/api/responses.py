@@ -9,7 +9,7 @@ of the reference's per-broker object walks.
 from __future__ import annotations
 
 import re
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional
 
 import numpy as np
 

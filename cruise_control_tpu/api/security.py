@@ -28,7 +28,7 @@ import json
 import time as _time
 from typing import Callable, Dict, Mapping, Optional, Sequence, Tuple
 
-from cruise_control_tpu.api.parameters import GET_ENDPOINTS, POST_ENDPOINTS
+from cruise_control_tpu.api.parameters import POST_ENDPOINTS
 
 
 class Role(enum.IntEnum):

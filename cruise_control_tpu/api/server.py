@@ -19,7 +19,7 @@ import time as _time
 import urllib.parse
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, List, Mapping, Optional, Tuple
+from typing import Callable, Dict, Mapping, Optional, Tuple
 
 from cruise_control_tpu.api import responses as R
 from cruise_control_tpu.api.parameters import (GET_ENDPOINTS, POST_ENDPOINTS,

@@ -11,10 +11,9 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Dict, Mapping, Optional, Sequence
+from typing import Mapping, Optional, Sequence
 
-from cruise_control_tpu.api.parameters import (GET_ENDPOINTS, POST_ENDPOINTS,
-                                               VALID_PARAMS)
+from cruise_control_tpu.api.parameters import GET_ENDPOINTS, VALID_PARAMS
 from cruise_control_tpu.api.user_tasks import USER_TASK_ID_HEADER
 
 

@@ -17,8 +17,7 @@ from __future__ import annotations
 
 import itertools
 import threading
-import time as _time
-from typing import Callable, Dict, List, Mapping, Optional, Sequence, Set, Tuple
+from typing import Callable, Dict, List, Mapping, Optional, Sequence, Set
 
 from cruise_control_tpu.cluster.admin import (ClusterAdminClient,
                                               LivenessListener)

@@ -14,8 +14,8 @@ import enum
 import importlib
 import os
 import re
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Mapping, Optional
 
 
 class ConfigException(Exception):

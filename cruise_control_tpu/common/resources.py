@@ -1,7 +1,8 @@
 """Resource definitions for the TPU-native cruise-control framework.
 
 Mirrors the semantics of the reference's Resource enum
-(reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/common/Resource.java:18-26):
+(reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/
+common/Resource.java:18-26):
 four balanced resources with per-resource comparison epsilons and
 host/broker-level distinctions.  Here resources are plain integer ids so they
 can index tensor axes directly (broker_load[B, NUM_RESOURCES]).

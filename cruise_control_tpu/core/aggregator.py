@@ -37,12 +37,12 @@ from __future__ import annotations
 import dataclasses
 import enum
 import threading
-from typing import Any, Dict, Hashable, List, Mapping, Optional, Sequence, Set, Tuple
+from typing import (
+    Dict, Hashable, List, Mapping, Optional, Sequence, Set, Tuple)
 
 import numpy as np
 
-from cruise_control_tpu.core.metricdef import (AggregationFunction, MetricDef,
-                                               MetricInfo)
+from cruise_control_tpu.core.metricdef import AggregationFunction, MetricDef
 
 
 class Extrapolation(enum.Enum):

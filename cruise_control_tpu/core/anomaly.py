@@ -11,7 +11,7 @@ from __future__ import annotations
 import abc
 import dataclasses
 import enum
-from typing import Dict, Hashable, List, Mapping, Optional, Sequence
+from typing import Hashable, List, Mapping, Optional, Sequence
 
 import numpy as np
 

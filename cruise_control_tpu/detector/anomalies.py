@@ -11,9 +11,8 @@ SURVEY.md §3.5).  Fix callables are injected by whoever wires the detector
 from __future__ import annotations
 
 import dataclasses
-import itertools
 import uuid as _uuid
-from typing import Callable, Dict, List, Mapping, Optional, Sequence
+from typing import Callable, Dict, List, Optional
 
 from cruise_control_tpu.core.anomaly import Anomaly, AnomalyType
 

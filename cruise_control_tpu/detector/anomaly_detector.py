@@ -21,7 +21,7 @@ import logging
 import random
 import threading
 import time as _time
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from cruise_control_tpu.core.anomaly import Anomaly, AnomalyType
 from cruise_control_tpu.detector.detector_state import (AnomalyDetectorState,

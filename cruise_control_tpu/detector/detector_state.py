@@ -10,7 +10,7 @@ import collections
 import dataclasses
 import enum
 import threading
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List
 
 from cruise_control_tpu.core.anomaly import Anomaly, AnomalyType
 

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import logging
 import time as _time
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -37,19 +37,19 @@ def balancedness_score(goals: Sequence[Goal], violated: Sequence[str],
     goal.balancedness.strictness.weight).  Hard goals weigh
     `strictness_weight`× more; higher-priority goals weigh more through
     `priority_weight^rank`."""
+    from cruise_control_tpu.analyzer.goals.base import \
+        balancedness_cost_by_goal
     if not goals:
         return 100.0
-    weights = []
-    for rank, goal in enumerate(goals):
-        w = priority_weight ** (len(goals) - 1 - rank)
-        if goal.is_hard:
-            w *= strictness_weight
-        weights.append(w)
-    total = sum(weights)
+    costs = balancedness_cost_by_goal(
+        [g.name for g in goals], {g.name for g in goals if g.is_hard},
+        priority_weight, strictness_weight)
+    # sum the SATISFIED goals' costs (not 100 - violated sum) so the
+    # all-violated score is exactly 0.0
     violated_set = set(violated)
-    lost = sum(w for goal, w in zip(goals, weights)
-               if goal.name in violated_set)
-    return 100.0 * (1.0 - lost / total)
+    kept = sum(c for n, c in costs.items() if n not in violated_set)
+    total = sum(costs.values())
+    return 100.0 * kept / total if total else 100.0
 
 
 class GoalViolationDetector:

@@ -6,7 +6,7 @@ the broker metric history and queues every anomaly found.
 """
 from __future__ import annotations
 
-from typing import Callable, Hashable, List, Mapping, Optional, Sequence, Tuple
+from typing import Callable, Hashable, List, Mapping, Sequence, Tuple
 
 from cruise_control_tpu.core.aggregator import ValuesAndExtrapolations
 from cruise_control_tpu.core.anomaly import (MetricAnomaly,

@@ -17,11 +17,11 @@ from __future__ import annotations
 
 import dataclasses
 import time as _time
-from typing import Callable, Dict, List, Mapping, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from cruise_control_tpu.detector.anomalies import FixFn, SlowBrokers
+from cruise_control_tpu.detector.anomalies import SlowBrokers
 
 
 @dataclasses.dataclass

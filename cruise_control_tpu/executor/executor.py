@@ -23,7 +23,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
 from cruise_control_tpu.cluster.admin import ClusterAdminClient
-from cruise_control_tpu.cluster.types import ClusterSnapshot, TopicPartition
+from cruise_control_tpu.cluster.types import TopicPartition
 from cruise_control_tpu.executor.state import ExecutorPhase, ExecutorState
 from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy
 from cruise_control_tpu.executor.task import (ExecutionTask, TaskState,

@@ -8,7 +8,7 @@ ExecutionTaskPlanner.java:68-446).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
 from cruise_control_tpu.executor.strategy import (BaseReplicaMovementStrategy,

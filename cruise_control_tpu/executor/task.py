@@ -11,7 +11,6 @@ from __future__ import annotations
 import dataclasses
 import enum
 import itertools
-from typing import Optional
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
 

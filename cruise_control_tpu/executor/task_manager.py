@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
 from cruise_control_tpu.executor.planner import ExecutionTaskPlanner

@@ -30,7 +30,6 @@ from cruise_control_tpu.analyzer.optimizer import (GoalOptimizer,
 from cruise_control_tpu.cluster.admin import ClusterAdminClient
 from cruise_control_tpu.config.capacity import (BrokerCapacityConfigResolver,
                                                 StaticCapacityResolver)
-from cruise_control_tpu.core.anomaly import AnomalyType
 from cruise_control_tpu.core.anomaly import PercentileMetricAnomalyFinder
 from cruise_control_tpu.detector import (AnomalyDetector,
                                          BrokerFailureDetector,
@@ -108,7 +107,7 @@ class CruiseControl:
                  = None,
                  num_cached_recent_anomaly_states: int = 10,
                  max_optimization_rounds: Optional[int] = None,
-                 balancedness_weights: Tuple[float, float] = (1.0, 2.0),
+                 balancedness_weights: Tuple[float, float] = (1.1, 1.5),
                  allow_capacity_estimation: bool = True,
                  time_fn: Optional[Callable[[], float]] = None,
                  sleep_fn: Optional[Callable[[float], None]] = None,
@@ -127,8 +126,9 @@ class CruiseControl:
             or ["IntraBrokerDiskCapacityGoal",
                 "IntraBrokerDiskUsageDistributionGoal"])
         self._max_rounds = max_optimization_rounds
-        #: (soft, hard) goal weights for the balancedness gauge (reference
-        #: goal.balancedness.priority.weight / strictness.weight)
+        #: (priority, strictness) weights for the balancedness gauge
+        #: (reference goal.balancedness.priority.weight /
+        #: strictness.weight; defaults match AnalyzerConfig 1.1 / 1.5)
         self._balancedness_weights = balancedness_weights
         self._allow_capacity_estimation = allow_capacity_estimation
 

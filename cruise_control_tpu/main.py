@@ -13,7 +13,7 @@ import logging
 import signal
 import sys
 import threading
-from typing import Mapping, Optional
+from typing import Optional
 
 from cruise_control_tpu.api.security import (BasicSecurityProvider,
                                              NoSecurityProvider)

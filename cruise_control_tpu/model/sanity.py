@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from cruise_control_tpu.common.resources import EPSILON_PERCENT, Resource
+from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
 

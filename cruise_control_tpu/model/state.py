@@ -42,13 +42,12 @@ a minor, intentional divergence that keeps the kernel branch-free).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.common.resources import Resource
 
 # CPU-attribution weights for follower load estimated from leader load
 # (reference model/ModelParameters.java:22-30, ModelUtils.java:54-71).

@@ -14,7 +14,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.common.resources import NUM_RESOURCES
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
 

@@ -8,13 +8,11 @@ config wiring and completeness-requirement translation.
 """
 from __future__ import annotations
 
-from typing import Iterable, Optional
+from typing import Iterable
 
-from cruise_control_tpu.core.aggregator import (AggregationOptions,
-                                                Granularity,
-                                                MetricSampleAggregationResult,
-                                                MetricSampleAggregator,
-                                                NotEnoughValidWindowsError)
+from cruise_control_tpu.core.aggregator import (
+    AggregationOptions, Granularity, MetricSampleAggregationResult,
+    MetricSampleAggregator)
 from cruise_control_tpu.monitor.completeness import (
     ModelCompletenessRequirements)
 from cruise_control_tpu.monitor.metricdef import (broker_metric_def,

@@ -19,17 +19,15 @@ import dataclasses
 import logging
 import threading
 import time
-from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 from cruise_control_tpu.cluster.admin import ClusterAdminClient
 from cruise_control_tpu.cluster.metadata import MetadataClient
-from cruise_control_tpu.cluster.types import ClusterSnapshot, TopicPartition
 from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
-from cruise_control_tpu.config.capacity import (BrokerCapacity,
-                                                BrokerCapacityConfigResolver,
-                                                StaticCapacityResolver)
+from cruise_control_tpu.config.capacity import (
+    BrokerCapacityConfigResolver, StaticCapacityResolver)
 from cruise_control_tpu.core.aggregator import (NotEnoughValidWindowsError,
                                                 ValuesAndExtrapolations)
 from cruise_control_tpu.model.cpu_model import LinearRegressionCpuModel
@@ -47,8 +45,7 @@ from cruise_control_tpu.monitor.sampling.fetcher import MetricFetcherManager
 from cruise_control_tpu.monitor.sampling.sample_store import (SampleLoader,
                                                               SampleStore)
 from cruise_control_tpu.monitor.sampling.sampler import MetricSampler, Samples
-from cruise_control_tpu.monitor.task_runner import (LoadMonitorTaskRunner,
-                                                    LoadMonitorTaskRunnerState)
+from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner
 
 LOG = logging.getLogger(__name__)
 

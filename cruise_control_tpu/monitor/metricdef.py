@@ -13,7 +13,7 @@ emits; `MetricScope` says which entity a raw type describes; the two
 from __future__ import annotations
 
 import enum
-from typing import Dict, List
+from typing import Dict
 
 from cruise_control_tpu.core.metricdef import AggregationFunction, MetricDef
 

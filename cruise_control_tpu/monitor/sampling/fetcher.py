@@ -9,7 +9,6 @@ the partition assignor hashes partitions across fetchers
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Set

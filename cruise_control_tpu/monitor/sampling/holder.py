@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import struct
-from typing import Dict, Mapping, Tuple
+from typing import Dict, Mapping
 
 from cruise_control_tpu.cluster.types import TopicPartition
 from cruise_control_tpu.core.aggregator import MetricSample

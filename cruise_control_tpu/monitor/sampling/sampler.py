@@ -14,7 +14,7 @@ from __future__ import annotations
 import abc
 import dataclasses
 import enum
-from typing import Iterable, List, Optional, Sequence, Set
+from typing import List, Set
 
 from cruise_control_tpu.cluster.simulated import SimulatedCluster
 from cruise_control_tpu.cluster.types import ClusterSnapshot, TopicPartition

@@ -11,7 +11,6 @@ plus the tensor-model sanity invariants and proposal/state consistency.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
 
 import numpy as np
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 import math
 import threading
 import time as _time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List
 
 
 class Counter:

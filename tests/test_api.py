@@ -19,7 +19,6 @@ from cruise_control_tpu.api import (BasicSecurityProvider, ParameterError,
 from cruise_control_tpu.api.security import (AuthenticationError,
                                              AuthorizationError)
 from cruise_control_tpu.api.server import CruiseControlApp
-from cruise_control_tpu.cluster.types import TopicPartition
 
 from test_facade import feed_samples, make_stack
 

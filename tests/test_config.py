@@ -3,10 +3,9 @@ CORE ConfigDef parsing/validation/defaults and AbstractConfig
 getConfiguredInstance)."""
 import pytest
 
-from cruise_control_tpu.common.config import (AbstractConfig, ConfigDef,
-                                              ConfigException, Importance,
-                                              Password, Type, in_range,
-                                              in_values, load_properties)
+from cruise_control_tpu.common.config import (
+    AbstractConfig, ConfigDef, ConfigException, Password, Type, in_range,
+    in_values, load_properties)
 
 
 def make_def():

@@ -8,7 +8,6 @@ notifier grace periods, slow-broker scoring, and balancedness score.
 import conftest  # noqa: F401
 
 import numpy as np
-import pytest
 
 from cruise_control_tpu.cluster.simulated import SimulatedCluster
 from cruise_control_tpu.core.anomaly import AnomalyType

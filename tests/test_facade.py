@@ -7,15 +7,13 @@ add/remove/demote broker flows, and detector wiring.
 """
 import conftest  # noqa: F401
 
-import numpy as np
 import pytest
 
 from cruise_control_tpu.cluster.simulated import SimulatedCluster
 from cruise_control_tpu.cluster.types import TopicPartition
 from cruise_control_tpu.core.anomaly import AnomalyType
 from cruise_control_tpu.detector.notifier import SelfHealingNotifier
-from cruise_control_tpu.facade import (CruiseControl, OngoingExecutionError,
-                                       OperationResult)
+from cruise_control_tpu.facade import CruiseControl, OngoingExecutionError
 from cruise_control_tpu.monitor.sampling.sampler import (
     SimulatedClusterSampler)
 

@@ -11,10 +11,8 @@ from cruise_control_tpu.analyzer.context import (BalancingConstraint,
 from cruise_control_tpu.analyzer.goals.capacity import (DiskCapacityGoal,
                                                         ReplicaCapacityGoal)
 from cruise_control_tpu.analyzer.goals.count_distribution import (
-    LeaderReplicaDistributionGoal, ReplicaDistributionGoal,
-    TopicReplicaDistributionGoal)
+    LeaderReplicaDistributionGoal, ReplicaDistributionGoal)
 from cruise_control_tpu.analyzer.goals.network import (
-    LeaderBytesInDistributionGoal, PotentialNwOutGoal,
     PreferredLeaderElectionGoal)
 from cruise_control_tpu.analyzer.goals.rack_aware import RackAwareGoal
 from cruise_control_tpu.analyzer.goals.registry import (DEFAULT_GOAL_ORDER,
@@ -194,3 +192,33 @@ def test_jbod_random_cluster_self_healing():
                               "DiskUsageDistributionGoal"]))
     result = run_and_verify(opt, state, topo)
     assert result.proposals
+
+
+class _RegressingGoal(ReplicaDistributionGoal):
+    """Test double: optimizes normally but reports its statistic regressed
+    (reference AbstractGoal.optimize :92-101 comparator preferring the
+    BEFORE state)."""
+
+    name = "RegressingGoal"
+
+    def stats_not_worse(self, before, after) -> bool:
+        return False
+
+
+def test_stats_regression_aborts_optimization():
+    state, topo = fixtures.small_cluster()
+    opt = GoalOptimizer([_RegressingGoal()])
+    with pytest.raises(OptimizationFailure, match="worse than before"):
+        opt.optimizations(state, topo)
+
+
+def test_stats_regression_waived_during_self_healing():
+    # reference AbstractGoal.java:92-93: the regression abort applies only
+    # when the cluster has no broken brokers
+    state, topo = fixtures.dead_broker_cluster()
+    opt = GoalOptimizer([_RegressingGoal()])
+    result = opt.optimizations(state, topo)
+    assert result.regressed_goals == ["RegressingGoal"]
+    assert not np.asarray(
+        S.broker_replica_count(result.final_state))[
+        ~np.asarray(state.broker_alive)].any()

@@ -7,7 +7,6 @@ disk usage, never leaving the broker.
 import conftest  # noqa: F401
 
 import numpy as np
-import pytest
 
 from cruise_control_tpu.analyzer.context import (BalancingConstraint,
                                                  OptimizationOptions,

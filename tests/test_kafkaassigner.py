@@ -8,7 +8,6 @@ that preserves per-broker replica counts.
 import conftest  # noqa: F401
 
 import numpy as np
-import pytest
 
 from cruise_control_tpu.analyzer.context import (BalancingConstraint,
                                                  OptimizationOptions,

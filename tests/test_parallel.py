@@ -9,7 +9,6 @@ devices.  This is the in-suite counterpart of the driver's
 import conftest  # noqa: F401
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -19,9 +18,8 @@ from cruise_control_tpu.analyzer.context import (BalancingConstraint,
 from cruise_control_tpu.analyzer.goals.registry import default_goals
 from cruise_control_tpu.analyzer.optimizer import heal_offline_replicas
 from cruise_control_tpu.model.sanity import sanity_check
-from cruise_control_tpu.parallel.mesh import (REPLICA_AXIS, make_mesh,
-                                              pad_state, shard_state,
-                                              state_shardings)
+from cruise_control_tpu.parallel.mesh import (
+    make_mesh, pad_state, shard_state, state_shardings)
 from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
                                                        random_cluster)
 

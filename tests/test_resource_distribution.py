@@ -2,7 +2,6 @@
 (analog of the reference's DeterministicClusterTest over distribution goals
 plus self-healing fixtures)."""
 import numpy as np
-import pytest
 
 from cruise_control_tpu.analyzer.context import (BalancingConstraint,
                                                  OptimizationOptions,

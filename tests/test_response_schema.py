@@ -7,8 +7,6 @@ cruise_control_tpu.api.schema.ENDPOINT_SCHEMAS, and the artifact itself
 must be valid JSON Schema.
 """
 import json
-import subprocess
-import sys
 
 import conftest  # noqa: F401
 import jsonschema

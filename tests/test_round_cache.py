@@ -284,3 +284,30 @@ def test_dest_shortlist_truncation_and_escalation(monkeypatch):
     opt = GoalOptimizer(default_goals(max_rounds=32))
     result = run_and_verify(opt, state, topo)
     assert result.proposals
+
+
+def test_table_overflow_triggers_rerun_with_wider_table(caplog):
+    """A broker-table width too small for the actual per-broker counts must
+    not silently truncate rows: optimizations() detects the overflow from
+    the post-heal max count and re-runs with a re-sized static width."""
+    import logging
+
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
+                                                           random_cluster)
+
+    state, topo = random_cluster(RandomClusterSpec(
+        num_brokers=8, num_partitions=80, replication_factor=2,
+        num_racks=4, num_topics=4, seed=3))
+    opt = GoalOptimizer(default_goals(
+        names=["ReplicaDistributionGoal"], max_rounds=16))
+    with caplog.at_level(logging.WARNING,
+                         logger="cruise_control_tpu.analyzer.optimizer"):
+        result = opt.optimizations(state, topo, _table_slots_override=2)
+    assert any("overflowed the broker table width" in r.message
+               for r in caplog.records)
+    # the re-run used an adequate width and produced a normal result
+    assert result.final_state is not None
+    counts = result.violated_broker_counts["ReplicaDistributionGoal"]
+    assert counts[2] <= counts[0]

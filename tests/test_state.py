@@ -6,7 +6,6 @@ import pytest
 
 from cruise_control_tpu.common.resources import Resource as R
 from cruise_control_tpu.model import state as S
-from cruise_control_tpu.model.builder import ClusterModelBuilder
 from cruise_control_tpu.model.sanity import sanity_check
 from cruise_control_tpu.model.stats import compute_stats
 from cruise_control_tpu.testing import fixtures

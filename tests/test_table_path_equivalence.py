@@ -24,7 +24,6 @@ from cruise_control_tpu.analyzer.goals.count_distribution import (
 from cruise_control_tpu.analyzer.goals.resource_distribution import (
     DiskUsageDistributionGoal, NetworkOutboundUsageDistributionGoal)
 from cruise_control_tpu.common.resources import Resource
-from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.sanity import sanity_check
 from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
                                                        random_cluster)

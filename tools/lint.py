@@ -1,0 +1,122 @@
+"""Dependency-free lint for CI (the reference runs checkstyle+findbugs in
+its `analyze` CI step, .circleci/config.yml:18-20; this environment ships
+no Python linter and installs are forbidden, so the equivalent hygiene
+checks are implemented on `ast`).
+
+Checks:
+  * files parse (syntax);
+  * unused imports (module scope, honoring __all__ and re-export files);
+  * tabs in indentation, trailing whitespace, missing final newline;
+  * lines longer than 100 columns.
+
+Usage: python tools/lint.py [paths...]   (default: the package + tests)
+Exit code 1 when any finding is reported.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+MAX_LINE = 100
+DEFAULT_PATHS = ["cruise_control_tpu", "tests", "tools", "bench.py",
+                 "__graft_entry__.py"]
+
+
+def _imported_names(tree: ast.AST):
+    """{local binding name: node} for every module-scope import."""
+    out = {}
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                out[name] = node
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                out[alias.asname or alias.name] = node
+    return out
+
+
+def _used_names(tree: ast.AST):
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+    return used
+
+
+def _exported(tree: ast.AST):
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    try:
+                        return set(ast.literal_eval(node.value))
+                    except ValueError:
+                        return set()
+    return None
+
+
+def lint_file(path: Path) -> list:
+    findings = []
+    text = path.read_text()
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError as exc:
+        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
+
+    lines = text.splitlines()
+    for i, line in enumerate(lines, 1):
+        if line != line.rstrip():
+            findings.append(f"{path}:{i}: trailing whitespace")
+        if line[:len(line) - len(line.lstrip())].count("\t"):
+            findings.append(f"{path}:{i}: tab in indentation")
+        if len(line) > MAX_LINE:
+            findings.append(f"{path}:{i}: line longer than {MAX_LINE} cols")
+    if text and not text.endswith("\n"):
+        findings.append(f"{path}:{len(lines)}: missing final newline")
+
+    # unused imports: __init__.py files are re-export surfaces; a module
+    # __all__ also marks intentional re-exports; `annotations` is the
+    # future import; `conftest` imports in tests exist for their side
+    # effect (forcing the CPU platform before jax initializes)
+    if path.name != "__init__.py":
+        exported = _exported(tree) or set()
+        used = _used_names(tree) | {"annotations", "conftest"}
+        for name, node in _imported_names(tree).items():
+            if name not in used and name not in exported:
+                findings.append(
+                    f"{path}:{node.lineno}: unused import '{name}'")
+    return findings
+
+
+def main(argv) -> int:
+    roots = [Path(p) for p in (argv or DEFAULT_PATHS)]
+    files = []
+    for root in roots:
+        if root.is_dir():
+            files.extend(sorted(root.rglob("*.py")))
+        elif root.exists():
+            files.append(root)
+    findings = []
+    for f in files:
+        if "__pycache__" in f.parts:
+            continue
+        findings.extend(lint_file(f))
+    for line in findings:
+        print(line)
+    print(f"lint: {len(files)} files, {len(findings)} findings",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
