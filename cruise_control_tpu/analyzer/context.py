@@ -353,7 +353,7 @@ def make_round_cache(state: ClusterState, table_slots: int = 0,
         t_leader = jnp.zeros((num_b, 0), dtype=bool)
         t_ok = jnp.zeros((num_b, 0), dtype=bool)
         r_ok = jnp.zeros((1,), dtype=bool)
-    return RoundCache(
+    cache = RoundCache(
         broker_load=load,
         broker_util=load / cap,
         replica_load=S.replica_current_load(state),
@@ -373,6 +373,10 @@ def make_round_cache(state: ClusterState, table_slots: int = 0,
         table_ok=t_ok,
         replica_ok=r_ok,
     )
+    # under an active solver mesh the resident tables shard on the broker
+    # axis (parallel/mesh.py) — a no-op otherwise
+    from cruise_control_tpu.parallel.mesh import constrain_cache
+    return constrain_cache(cache)
 
 
 # ---------------------------------------------------------------------------
@@ -412,14 +416,13 @@ def _update_table_for_moves(state_before: ClusterState, cache: RoundCache,
     """Maintain the broker table and its aux tables across a committed
     move batch; returns the table-field updates as a dict.
 
-    Invariants relied on (the search kernels guarantee them):
-      * at most ONE arrival per destination broker per batch (destinations
-        are deduplicated by assign_destinations/resolve_dest_conflicts) —
-        two arrivals would claim the same append slot;
-      * destinations were eligible only while `table_fill < S`, so the
-        append slot is in range.
-    Departures per source are unbounded (holes are fine; aux values at
-    holes go stale and every consumer masks on id < R first)."""
+    Several arrivals may land on one destination broker per batch
+    (multi-commit rounds): each claims the append slot `fill[dst] + rank`
+    where rank is its position among the batch's valid arrivals at that
+    destination (computed here by a stable sort — the search kernels'
+    dest_cap gating guarantees fill + arrivals <= S).  Departures per
+    source are unbounded (holes are fine; aux values at holes go stale
+    and every consumer masks on id < R first)."""
     num_r = state_before.num_replicas
     num_b = state_before.num_brokers
     s = cache.broker_table.shape[1]
@@ -435,9 +438,17 @@ def _update_table_for_moves(state_before: ClusterState, cache: RoundCache,
     rem_idx = jnp.where(valid & found, src * s + slot, oob)
     flat = flat.at[rem_idx].set(num_r, mode="drop")
 
-    # arrivals: append at the destination's fill pointer (<= 1 per dest),
-    # carrying the mover's attributes into the aux tables
-    aslot = cache.table_fill[dst]
+    # arrivals: rank each valid arrival among its destination's batch
+    # (stable by candidate index) so multiple arrivals claim distinct
+    # append slots fill[dst] + 0..k-1 (same primitive as the acceptance
+    # gating — kernels.segment_rank — so slot ranks and accepted ranks
+    # can never diverge)
+    from cruise_control_tpu.analyzer.kernels import segment_rank
+    c = dst.shape[0]
+    dst_or_oob = jnp.where(valid, dst, num_b)
+    order, _, _, rank_sorted = segment_rank(dst_or_oob, num_b + 1)
+    rank = jnp.zeros((c,), jnp.int32).at[order].set(rank_sorted)
+    aslot = cache.table_fill[dst] + rank
     a_idx = jnp.where(valid & (aslot < s), dst * s + aslot, oob)
     flat = flat.at[a_idx].set(r, mode="drop")
     table = flat.reshape(num_b, s)
@@ -491,11 +502,11 @@ def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
     from it).  Invalid rows are dropped via out-of-bounds routing exactly
     like apply_moves.
 
-    Preconditions (the search kernels guarantee both): the valid rows name
+    Precondition (the search kernels guarantee it): the valid rows name
     each replica at most ONCE (updates are scatter-ADDs while apply_moves
-    scatter-SETs — a duplicated replica would desynchronize the cache), and
-    each destination broker receives at most one arrival per batch (the
-    broker-table append slot is claimed once)."""
+    scatter-SETs — a duplicated replica would desynchronize the cache).
+    Destinations may receive several arrivals per batch; the broker-table
+    update rank-assigns their append slots."""
     r = replicas.astype(jnp.int32)
     dst = dest_brokers.astype(jnp.int32)
     src = state_before.replica_broker[r]
@@ -557,7 +568,8 @@ def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
                       table_ok=cache.table_ok,
                       replica_ok=cache.replica_ok)
 
-    return RoundCache(
+    from cruise_control_tpu.parallel.mesh import constrain_cache
+    return constrain_cache(RoundCache(
         broker_load=broker_load,
         broker_util=broker_load / cap,
         replica_load=cache.replica_load,      # role unchanged by a move
@@ -568,7 +580,7 @@ def update_cache_for_moves(state_before: ClusterState, cache: RoundCache,
         potential_nw_out=pot,
         leader_bytes_in=lbi,
         **tables,
-    )
+    ))
 
 
 def update_cache_for_leadership(state_before: ClusterState, cache: RoundCache,
@@ -631,7 +643,8 @@ def update_cache_for_leadership(state_before: ClusterState, cache: RoundCache,
         flat_lead = flat_lead.at[src_idx].set(False, mode="drop")
         flat_lead = flat_lead.at[dst_idx].set(True, mode="drop")
         t_leader = flat_lead.reshape(t_leader.shape)
-    return RoundCache(
+    from cruise_control_tpu.parallel.mesh import constrain_cache
+    return constrain_cache(RoundCache(
         broker_load=broker_load,
         broker_util=broker_load / cap,
         replica_load=replica_load,
@@ -648,4 +661,4 @@ def update_cache_for_leadership(state_before: ClusterState, cache: RoundCache,
         table_leader=t_leader,
         table_ok=cache.table_ok,
         replica_ok=cache.replica_ok,
-    )
+    ))

@@ -105,6 +105,45 @@ class Goal(abc.ABC):
         return (self.accept_move(state, ctx, cache, out_replica, b_in)
                 & self.accept_move(state, ctx, cache, in_replica, b_out))
 
+    # ---- quantitative acceptance (cumulative multi-commit gating) ----
+    def move_headroom_terms(self, state: ClusterState,
+                            ctx: OptimizationContext, cache: RoundCache):
+        """Quantitative form of accept_move's STRICT branch, for gating
+        several commits against one broker within a single round.
+
+        Returns a list of `(key str, w f32[R], dest_headroom f32[B],
+        src_headroom f32[B] | None)` terms meaning: this goal accepts a
+        batch of moves when, per destination broker d, the cumulative
+        Σ w[r_i] of its arrivals stays ≤ dest_headroom[d], and (when
+        src_headroom is given) per source broker b the cumulative weight
+        of its departures stays ≤ src_headroom[b].  `key` names the
+        weighted quantity (e.g. "load3", "count") — terms sharing a key
+        across goals MUST weigh by the same vector; the composer merges
+        them by min-headroom so the kernels pay one gating plane per
+        distinct quantity.  Headrooms are
+        evaluated against the round-start cache, so cumulative-gated
+        commits are exactly the moves a sequential evaluator taking the
+        strict acceptance branch would also have accepted (the reference
+        evaluates actions one at a time against the live model,
+        AbstractGoal.maybeApplyBalancingAction:179-221 — this is the
+        batched analog).
+
+        `[]` declares the goal's move acceptance free of cross-action
+        accumulation (e.g. rack awareness: different partitions never
+        interact, and the kernels already cap each partition at one move
+        per round).  `None` (the default) declares it inexpressible —
+        the kernels then fall back to one arrival per destination and
+        one departure per alive source, which is always safe."""
+        return None
+
+    def leadership_headroom_terms(self, state: ClusterState,
+                                  ctx: OptimizationContext,
+                                  cache: RoundCache):
+        """Like move_headroom_terms, for leadership transfers: `w` is
+        indexed by the SOURCE (current leader) replica and is the load
+        that travels with leadership of its partition."""
+        return None
+
     # ---- violation surface (detector + hard-goal verification) ----
     def violated_brokers(self, state: ClusterState, ctx: OptimizationContext,
                          cache: RoundCache) -> jax.Array:
@@ -314,6 +353,70 @@ def compose_swap_acceptance(goals: Sequence[Goal], state: ClusterState,
                                    in_replica)
         return ok
     return fn
+
+
+def _merge_terms(term_lists):
+    """Merge `(key, w, dest_hr, src_hr)` terms across goals: terms
+    sharing a key carry the SAME weight vector by construction (e.g.
+    every DISK-load bound weighs a move by its DISK load), so their
+    cumulative gates collapse to one term with the elementwise-min
+    headroom — the assignment pass loop then pays one [C, K] plane per
+    DISTINCT quantity instead of one per goal.  Returns None if any goal
+    opted out (a None list)."""
+    merged = {}
+    order = []
+    for terms in term_lists:
+        if terms is None:
+            return None
+        for key, w, d_hr, s_hr in terms:
+            if key not in merged:
+                merged[key] = [w, d_hr, s_hr]
+                order.append(key)
+            else:
+                ent = merged[key]
+                ent[1] = jnp.minimum(ent[1], d_hr)
+                if s_hr is not None:
+                    ent[2] = (s_hr if ent[2] is None
+                              else jnp.minimum(ent[2], s_hr))
+    return [(merged[k][0], merged[k][1], merged[k][2]) for k in order]
+
+
+def compose_move_headrooms(goals: Sequence[Goal], state: ClusterState,
+                           ctx: OptimizationContext, cache: RoundCache):
+    """Merged move_headroom_terms over `goals`; None when ANY goal opts
+    out — the kernels then stay single-commit per broker, which is
+    correct for arbitrary acceptance functions."""
+    return _merge_terms([g.move_headroom_terms(state, ctx, cache)
+                         for g in goals])
+
+
+def compose_leadership_headrooms(goals: Sequence[Goal], state: ClusterState,
+                                 ctx: OptimizationContext, cache: RoundCache):
+    """Leadership-transfer counterpart of compose_move_headrooms."""
+    return _merge_terms([g.leadership_headroom_terms(state, ctx, cache)
+                         for g in goals])
+
+
+def _split_terms(terms):
+    if terms is None:
+        return None, None
+    return ([(w, d) for (w, d, s) in terms],
+            [(w, s) for (w, d, s) in terms if s is not None])
+
+
+def move_commit_terms(goals: Sequence[Goal], state: ClusterState,
+                      ctx: OptimizationContext, cache: RoundCache):
+    """(dest_terms, src_terms) for kernels.move_round's multi-commit mode
+    — (None, None) when any prior goal's move acceptance is not
+    quantitative (the kernels then stay single-commit per broker)."""
+    return _split_terms(compose_move_headrooms(goals, state, ctx, cache))
+
+
+def leadership_commit_terms(goals: Sequence[Goal], state: ClusterState,
+                            ctx: OptimizationContext, cache: RoundCache):
+    """(dest_terms, src_terms) for kernels.leadership_round multi-commit."""
+    return _split_terms(
+        compose_leadership_headrooms(goals, state, ctx, cache))
 
 
 def compose_leadership_acceptance(goals: Sequence[Goal], state: ClusterState,

@@ -23,7 +23,8 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
-    dest_side_only, leader_shed_rows, note_rounds, shed_rows)
+    dest_side_only, leader_shed_rows, leadership_commit_terms,
+    move_commit_terms, note_rounds, shed_rows)
 from cruise_control_tpu.common.resources import (RESOURCE_GOAL_NAMES,
                                                  Resource)
 from cruise_control_tpu.model.state import ClusterState
@@ -51,6 +52,10 @@ class CapacityGoal(Goal):
         leadership_helps = self.resource in (Resource.NW_OUT, Resource.CPU)
 
         multi_k = 4 if dest_side_only(prev_goals) else 1
+        # per-round stacking bound: fill a destination at most to the
+        # balance-band midpoint per round (kernels dest_stack_headroom)
+        mid_w = ((ctx.balance_upper_pct[res] + ctx.balance_lower_pct[res])
+                 / 2.0 * state.broker_capacity[:, res])
         # loop-invariant [R] arrays hoisted out of the round body
         bonus = (state.partition_leader_bonus[state.replica_partition, res]
                  * state.replica_valid)
@@ -74,13 +79,17 @@ class CapacityGoal(Goal):
                     return fits & accept(src_r, dst_r)
 
                 value_rows = cache.table_bonus[:, :, res]
+                lt_d, lt_s = leadership_commit_terms(prev_goals, st, ctx,
+                                                     cache)
                 cand_r, cand_f, cand_v = kernels.leadership_round(
                     st, bonus, W - limit, movable, ctx.broker_leader_ok,
                     limit - W, accept_all, -W / jnp.maximum(limit, 1e-9),
                     ctx.partition_replicas, cache=cache,
                     bonus_rows=leader_shed_rows(cache, value_rows,
                                                 W > limit, W - limit),
-                    value_rows=value_rows)
+                    value_rows=value_rows,
+                    dest_terms=lt_d, src_terms=lt_s,
+                    dest_stack_headroom=mid_w - W)
                 st, cache = kernels.commit_leadership_cached(
                     st, cache, cand_r, cand_f, cand_v)
                 committed |= jnp.any(cand_v)
@@ -90,6 +99,7 @@ class CapacityGoal(Goal):
             w = cache.replica_load[:, res]
             movable = base_movable & (w > 0.0)
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            mt_d, mt_s = move_commit_terms(prev_goals, st, ctx, cache)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, W > limit, W - limit, movable,
                 ctx.broker_dest_ok & st.broker_alive, limit - W, accept,
@@ -97,7 +107,9 @@ class CapacityGoal(Goal):
                 cache=cache,
                 sc_rows=shed_rows(cache, cache.table_load[:, :, res],
                                   W > limit, W - limit),
-                per_src_k=multi_k)
+                per_src_k=4 if mt_d is not None else multi_k,
+                dest_terms=mt_d, src_terms=mt_s,
+                dest_stack_headroom=mid_w - W)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             committed |= jnp.any(cand_v)
@@ -156,6 +168,24 @@ class CapacityGoal(Goal):
         dest = state.replica_broker[dest_replica]
         return cache.broker_load[:, res][dest] + bonus <= limit[dest]
 
+    def move_headroom_terms(self, state, ctx, cache):
+        """Strict-branch quantity of accept_move: arrivals at d may add up
+        to limit[d] − load[d] of this resource."""
+        res = int(self.resource)
+        return [(f"load{res}", cache.replica_load[:, res],
+                 self._limit(state, ctx) - cache.broker_load[:, res],
+                 None)]
+
+    def leadership_headroom_terms(self, state, ctx, cache):
+        if self.resource not in (Resource.NW_OUT, Resource.CPU):
+            return []            # leadership-invariant resources
+        res = int(self.resource)
+        bonus = (state.partition_leader_bonus[state.replica_partition, res]
+                 * state.replica_valid)
+        return [(f"bonus{res}", bonus,
+                 self._limit(state, ctx) - cache.broker_load[:, res],
+                 None)]
+
     def violated_brokers(self, state, ctx, cache):
         res = int(self.resource)
         return state.broker_alive & (
@@ -209,13 +239,18 @@ class ReplicaCapacityGoal(Goal):
             ones_rows = jnp.ones_like(cache.table_ok, dtype=jnp.float32)
             movable = base_movable
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            mt_d, mt_s = move_commit_terms(prev_goals, st, ctx, cache)
+            avg_count = (jnp.sum(count * st.broker_alive)
+                         / jnp.maximum(jnp.sum(st.broker_alive), 1))
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, count > limit, count - limit, movable,
                 ctx.broker_dest_ok & st.broker_alive, limit - count, accept,
                 -count, ctx.partition_replicas, cache=cache,
                 sc_rows=shed_rows(cache, ones_rows, count > limit,
                                   count - limit),
-                per_src_k=multi_k)
+                per_src_k=4 if mt_d is not None else multi_k,
+                dest_terms=mt_d, src_terms=mt_s,
+                dest_stack_headroom=avg_count - count)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -248,6 +283,15 @@ class ReplicaCapacityGoal(Goal):
         unchanged — always acceptable."""
         return jnp.ones(jnp.broadcast_shapes(out_replica.shape,
                                              in_replica.shape), dtype=bool)
+
+    def move_headroom_terms(self, state, ctx, cache):
+        ones = jnp.ones(state.num_replicas, dtype=jnp.float32)
+        hr = (jnp.float32(ctx.max_replicas_per_broker)
+              - cache.replica_count.astype(jnp.float32))
+        return [("count", ones, hr, None)]
+
+    def leadership_headroom_terms(self, state, ctx, cache):
+        return []                # transfers move no replicas
 
     def violated_brokers(self, state, ctx, cache):
         return state.broker_alive & (

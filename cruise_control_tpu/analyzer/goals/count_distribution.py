@@ -22,7 +22,8 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
-    dest_side_only, leader_shed_rows, new_broker_dest_mask, note_rounds,
+    dest_side_only, leader_shed_rows, leadership_commit_terms,
+    move_commit_terms, new_broker_dest_mask, note_rounds,
     run_phase_sweeps, shed_rows)
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
@@ -41,6 +42,9 @@ class ReplicaDistributionGoal(Goal):
 
     name = "ReplicaDistributionGoal"
     balance_pct_attr = "replica_balance_percentage"
+    #: headroom-term quantity key (the leader subclass weighs by the
+    #: leader flag, a different quantity)
+    count_key = "count"
 
     def __init__(self, max_rounds: int = 64, balance_pct_margin: float = 0.09):
         self.max_rounds = max_rounds
@@ -84,13 +88,17 @@ class ReplicaDistributionGoal(Goal):
             w = w_static
             movable = base_movable
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            mt_d, mt_s = move_commit_terms(prev_goals, st, ctx, cache)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, counts > upper, counts - upper, movable,
                 dest_ok & (counts + 1 <= upper), upper - counts, accept,
                 -counts, ctx.partition_replicas, cache=cache,
                 sc_rows=shed_rows(cache, self._weight_rows(st, cache),
                                   counts > upper, counts - upper),
-                per_src_k=4 if dest_side_only(prev_goals) else 1)
+                per_src_k=8 if (mt_d is not None
+                                or dest_side_only(prev_goals)) else 1,
+                dest_terms=mt_d, src_terms=mt_s,
+                dest_stack_headroom=avg - counts)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -100,6 +108,7 @@ class ReplicaDistributionGoal(Goal):
             w = w_static
             movable = base_movable
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
+            mt_d, mt_s = move_commit_terms(prev_goals, st, ctx, cache)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, counts > avg, counts - lower, movable,
                 dest_ok & (counts < lower), upper - counts, accept,
@@ -107,7 +116,10 @@ class ReplicaDistributionGoal(Goal):
                 cache=cache,
                 sc_rows=shed_rows(cache, self._weight_rows(st, cache),
                                   counts > avg, counts - lower,
-                                  strict=True))
+                                  strict=True),
+                per_src_k=8 if mt_d is not None else 1,
+                dest_terms=mt_d, src_terms=mt_s,
+                dest_stack_headroom=avg - counts)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -153,6 +165,20 @@ class ReplicaDistributionGoal(Goal):
                 & self.accept_move(state, ctx, cache, in_replica, b_out))
         return same | both
 
+    def move_headroom_terms(self, state, ctx, cache):
+        """Strict-branch form of accept_move: each arrival adds its weight
+        (1 for plain counts; the leader flag for the leader subclass) to
+        the destination's count, bounded by upper − count, and each
+        departure erodes count − lower."""
+        counts = self._counts(cache)
+        avg = self._avg(state, counts)
+        lower, upper = _count_bounds(avg, self.pct_margin)
+        return [(self.count_key, self._weights(state), upper - counts,
+                 counts - lower)]
+
+    def leadership_headroom_terms(self, state, ctx, cache):
+        return []                # plain replica counts ignore leadership
+
     def violated_brokers(self, state, ctx, cache):
         counts = self._counts(cache)
         avg = self._avg(state, counts)
@@ -169,6 +195,7 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
     moving leader replicas (reference LeaderReplicaDistributionGoal.java)."""
 
     name = "LeaderReplicaDistributionGoal"
+    count_key = "leadcount"
 
     def _weights(self, state: ClusterState) -> jax.Array:
         return (state.replica_valid
@@ -205,6 +232,8 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
             bonus = (st.replica_valid & st.replica_is_leader).astype(
                 jnp.float32)
             value_rows = cache.table_leader.astype(jnp.float32)
+            lt_d, lt_s = leadership_commit_terms(prev_goals, st, ctx,
+                                                 cache)
             cand_r, cand_f, cand_v = kernels.leadership_round(
                 st, bonus, counts - upper, movable, ctx.broker_leader_ok,
                 upper - counts, accept_all, -counts, ctx.partition_replicas,
@@ -212,7 +241,9 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
                 bonus_rows=leader_shed_rows(cache, value_rows,
                                             counts > upper,
                                             counts - upper),
-                value_rows=value_rows)
+                value_rows=value_rows,
+                dest_terms=lt_d, src_terms=lt_s,
+                dest_stack_headroom=avg - counts)
             st, cache = kernels.commit_leadership_cached(st, cache, cand_r,
                                                          cand_f, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -225,12 +256,16 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
             move_dest = (dest_ok & ctx.broker_leader_ok
                          & (counts + 1 <= upper))
             w_rows = cache.table_leader.astype(jnp.float32)
+            mt_d, mt_s = move_commit_terms(prev_goals, st, ctx, cache)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, counts > upper, counts - upper, movable, move_dest,
                 upper - counts, accept, -counts, ctx.partition_replicas,
                 cache=cache,
                 sc_rows=shed_rows(cache, w_rows, counts > upper,
-                                  counts - upper))
+                                  counts - upper),
+                per_src_k=8 if mt_d is not None else 1,
+                dest_terms=mt_d, src_terms=mt_s,
+                dest_stack_headroom=avg - counts)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -253,6 +288,15 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
         relaxed = counts[dest] + 1 <= counts[src]
         ok_before = (counts[src] >= lower) & (counts[dest] <= upper)
         return jnp.where(ok_before, strict, relaxed)
+
+    def leadership_headroom_terms(self, state, ctx, cache):
+        """Each transfer adds one leader at the destination broker and
+        removes one at the source."""
+        counts = self._counts(cache)
+        avg = self._avg(state, counts)
+        lower, upper = _count_bounds(avg, self.pct_margin)
+        ones = jnp.ones(state.num_replicas, dtype=jnp.float32)
+        return [("leadcount", ones, upper - counts, counts - lower)]
 
     def stats_not_worse(self, before, after) -> bool:
         return (float(after.leader_count_std)
@@ -348,6 +392,14 @@ class TopicReplicaDistributionGoal(Goal):
         both = (self.accept_move(state, ctx, cache, out_replica, b_in)
                 & self.accept_move(state, ctx, cache, in_replica, b_out))
         return same | both
+
+    def leadership_headroom_terms(self, state, ctx, cache):
+        return []                # per-topic replica counts ignore leadership
+
+    # move_headroom_terms stays None (inherited): the bound is per
+    # (broker, topic) cell, which the scalar per-destination term cannot
+    # express — rounds with this goal in the prefix stay single-commit
+    # per destination for MOVES (transfers are unaffected).
 
     def violated_brokers(self, state, ctx, cache):
         tc = cache.broker_topic_count.astype(jnp.float32)

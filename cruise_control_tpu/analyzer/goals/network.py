@@ -23,7 +23,8 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
-    dest_side_only, leader_shed_rows, note_rounds, shed_rows)
+    dest_side_only, leader_shed_rows, leadership_commit_terms,
+    move_commit_terms, note_rounds, shed_rows)
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
@@ -66,13 +67,19 @@ class PotentialNwOutGoal(Goal):
             w_rows = (cache.table_load[:, :, nwo]
                       + jnp.where(cache.table_leader, 0.0,
                                   cache.table_bonus[:, :, nwo]))
+            mt_d, mt_s = move_commit_terms(prev_goals, st, ctx, cache)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, pot > limit, pot - limit, movable,
                 ctx.broker_dest_ok & st.broker_alive, limit - pot,
                 accept_all, -pot / jnp.maximum(limit, 1e-9),
                 ctx.partition_replicas, cache=cache,
                 sc_rows=shed_rows(cache, w_rows, pot > limit, pot - limit),
-                per_src_k=4 if dest_side_only(prev_goals) else 1)
+                per_src_k=4 if (mt_d is not None
+                                or dest_side_only(prev_goals)) else 1,
+                dest_terms=mt_d, src_terms=mt_s,
+                dest_stack_headroom=(
+                    jnp.sum(pot * st.broker_alive)
+                    / jnp.maximum(jnp.sum(st.broker_alive), 1) - pot))
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -119,6 +126,16 @@ class PotentialNwOutGoal(Goal):
         ok_out = (pot[b_out] - d <= limit[b_out]) | (d >= 0)
         ok_in = (pot[b_in] + d <= limit[b_in]) | (d <= 0)
         return ok_out & ok_in
+
+    def move_headroom_terms(self, state, ctx, cache):
+        """Arrivals add their leader-ROLE NW_OUT to the destination's
+        potential, bounded by limit − potential."""
+        return [("potential", self._leader_role_nw_out(state),
+                 self._limit(state, ctx) - cache.potential_nw_out,
+                 None)]
+
+    def leadership_headroom_terms(self, state, ctx, cache):
+        return []        # potential load is leadership-invariant
 
     def violated_brokers(self, state, ctx, cache):
         return state.broker_alive & (
@@ -172,13 +189,19 @@ class LeaderBytesInDistributionGoal(Goal):
             value_rows = jnp.where(cache.table_leader,
                                    cache.table_load[:, :, Resource.NW_IN],
                                    0.0)
+            lt_d, lt_s = leadership_commit_terms(prev_goals, st, ctx,
+                                                 cache)
             cand_r, cand_f, cand_v = kernels.leadership_round(
                 st, bonus, lbi - upper, movable, ctx.broker_leader_ok,
                 upper - lbi, accept_all, -lbi, ctx.partition_replicas,
                 cache=cache,
                 bonus_rows=leader_shed_rows(cache, value_rows, lbi > upper,
                                             lbi - upper),
-                value_rows=value_rows)
+                value_rows=value_rows,
+                dest_terms=lt_d, src_terms=lt_s,
+                dest_stack_headroom=(
+                    jnp.sum(lbi * st.broker_alive)
+                    / jnp.maximum(jnp.sum(st.broker_alive), 1) - lbi))
             st, cache = kernels.commit_leadership_cached(st, cache, cand_r,
                                                          cand_f, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -209,6 +232,33 @@ class LeaderBytesInDistributionGoal(Goal):
         strict = lbi[dest] + bonus <= upper
         relaxed = lbi[dest] + bonus <= lbi[src]
         return jnp.where(lbi[dest] <= upper, strict, relaxed)
+
+    def accept_move(self, state, ctx, cache, replica, dest_broker):
+        """Follower moves carry no leader bytes (always accepted); a
+        LEADER move lands its NW_IN at the destination, which must stay
+        under the balance threshold (reference
+        LeaderBytesInDistributionGoal.actionAcceptance:72-117)."""
+        lbi = cache.leader_bytes_in
+        upper = self._bounds(state, lbi)
+        w = jnp.broadcast_to(
+            self._leader_nw_in(state)[replica],
+            jnp.broadcast_shapes(replica.shape, dest_broker.shape))
+        return (w <= 0.0) | (lbi[dest_broker] + w <= upper)
+
+    def leadership_headroom_terms(self, state, ctx, cache):
+        """Each transfer lands the new leader's base NW_IN at its broker
+        (replicas of one partition share base NW_IN, so indexing by the
+        demoted leader is exact)."""
+        lbi = cache.leader_bytes_in
+        return [("lbi", self._leader_nw_in(state),
+                 self._bounds(state, lbi) - lbi, None)]
+
+    def move_headroom_terms(self, state, ctx, cache):
+        """Moving a replica keeps its leadership flag, so a LEADER move
+        lands its NW_IN at the destination broker."""
+        lbi = cache.leader_bytes_in
+        return [("lbi", self._leader_nw_in(state),
+                 self._bounds(state, lbi) - lbi, None)]
 
     def violated_brokers(self, state, ctx, cache):
         lbi = cache.leader_bytes_in

@@ -23,7 +23,7 @@ from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  make_round_cache)
 from cruise_control_tpu.analyzer.goals.base import (
-    Goal, compose_move_acceptance, note_rounds)
+    Goal, compose_move_acceptance, move_commit_terms, note_rounds)
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
@@ -88,12 +88,19 @@ class RackAwareGoal(Goal):
             # global forced-candidate search: rack violations are mandatory
             # moves independent of broker load, and their count scales with
             # partitions — a per-source-broker cap would throttle rounds
+            mt_d, _ = move_commit_terms(prev_goals, st, ctx, cache)
+            disk = int(Resource.DISK)
+            mid_disk = ((ctx.balance_upper_pct[disk]
+                         + ctx.balance_lower_pct[disk]) / 2.0
+                        * st.broker_capacity[:, disk])
             cand_r, cand_d, cand_v = kernels.forced_move_round(
                 st, movable, w, dest_ok_b, accept_all,
                 self._dest_pref(st, cache), ctx.partition_replicas,
                 cap_alive_sources=any(g.source_side_acceptance
                                       for g in prev_goals),
-                cache=cache)
+                cache=cache, dest_terms=mt_d,
+                dest_stack_headroom=(
+                    mid_disk - cache.broker_load[:, disk]))
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -125,6 +132,15 @@ class RackAwareGoal(Goal):
         cnt = cache.partition_rack_count[p, dst_rack]
         cnt = cnt - (src_rack == dst_rack)
         return cnt == 0
+
+    def move_headroom_terms(self, state, ctx, cache):
+        """Rack acceptance never accumulates across DIFFERENT partitions,
+        and the kernels cap each partition at one move per round — so
+        multi-commit rounds need no extra gating from this goal."""
+        return []
+
+    def leadership_headroom_terms(self, state, ctx, cache):
+        return []                # leadership-invariant
 
     def violated_brokers(self, state, ctx, cache):
         rack = state.broker_rack[state.replica_broker]

@@ -26,7 +26,8 @@ from cruise_control_tpu.analyzer.context import (
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
     compose_swap_acceptance, dest_side_only, leader_shed_rows,
-    new_broker_dest_mask, run_phase_sweeps, shed_rows)
+    leadership_commit_terms, move_commit_terms, new_broker_dest_mask,
+    run_phase_sweeps, shed_rows)
 from cruise_control_tpu.common.resources import (RESOURCE_GOAL_NAMES,
                                                  Resource)
 from cruise_control_tpu.model.state import ClusterState
@@ -99,6 +100,8 @@ class ResourceDistributionGoal(Goal):
                 return accept(src_r, dst_r) & self_accept(src_r, dst_r)
 
             value_rows = cache.table_bonus[:, :, res]
+            lt_d, lt_s = leadership_commit_terms(prev_goals, st, ctx,
+                                                 cache)
             cand_r, cand_f, cand_v = kernels.leadership_round(
                 st, bonus, W - upper, movable, ctx.broker_leader_ok,
                 upper - W, accept_all,
@@ -106,7 +109,9 @@ class ResourceDistributionGoal(Goal):
                 ctx.partition_replicas, cache=cache,
                 bonus_rows=leader_shed_rows(cache, value_rows, W > upper,
                                             W - upper),
-                value_rows=value_rows)
+                value_rows=value_rows,
+                dest_terms=lt_d, src_terms=lt_s,
+                dest_stack_headroom=(upper + lower) / 2.0 - W)
             st, cache = kernels.commit_leadership_cached(
                 st, cache, cand_r, cand_f, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -117,13 +122,17 @@ class ResourceDistributionGoal(Goal):
             movable = base_movable & (w > 0.0)
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
             dest_pref = -W / jnp.maximum(st.broker_capacity[:, res], 1e-9)
+            mt_d, mt_s = move_commit_terms(prev_goals, st, ctx, cache)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, W > upper, W - upper, movable,
                 self._dest_mask(st, ctx), upper - W, accept,
                 dest_pref, ctx.partition_replicas, cache=cache,
                 sc_rows=shed_rows(cache, cache.table_load[:, :, res],
                                   W > upper, W - upper),
-                per_src_k=4 if dest_side_only(prev_goals) else 1)
+                per_src_k=4 if (mt_d is not None
+                                or dest_side_only(prev_goals)) else 1,
+                dest_terms=mt_d, src_terms=mt_s,
+                dest_stack_headroom=(upper + lower) / 2.0 - W)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -137,13 +146,17 @@ class ResourceDistributionGoal(Goal):
             movable = base_movable & (w > 0.0)
             accept = compose_move_acceptance(prev_goals, st, ctx, cache)
             under = (W < lower) & self._dest_mask(st, ctx)
+            mt_d, mt_s = move_commit_terms(prev_goals, st, ctx, cache)
             cand_r, cand_d, cand_v = kernels.move_round(
                 st, w, W > avg_w, W - lower, movable, under, upper - W,
                 accept,
                 -W / jnp.maximum(st.broker_capacity[:, res], 1e-9),
                 ctx.partition_replicas, strict_allowance=True, cache=cache,
                 sc_rows=shed_rows(cache, cache.table_load[:, :, res],
-                                  W > avg_w, W - lower, strict=True))
+                                  W > avg_w, W - lower, strict=True),
+                per_src_k=4 if mt_d is not None else 1,
+                dest_terms=mt_d, src_terms=mt_s,
+                dest_stack_headroom=(upper + lower) / 2.0 - W)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -169,6 +182,35 @@ class ResourceDistributionGoal(Goal):
                                                     cold_idx, valid)
             return st, cache, jnp.any(valid)
 
+        def phase_swap_under(st, cache):
+            """Under-fill swap phase: a broker stuck BELOW the lower limit
+            whose plain fills are all vetoed (typically replica-count
+            saturation: it holds many small replicas, so count goals
+            reject every arrival) trades a small replica for a larger one
+            from ANY broker above the band midpoint — the reference's
+            rebalanceByMovingLoadIn sources from any richer broker, not
+            only over-limit ones (ResourceDistributionGoal.java:307-360).
+            Count-preserving, so count goals accept; without this phase a
+            below-lower broker can become permanently unservable and then
+            (via the relaxed acceptance branch, which compares against the
+            LEAST loaded broker) veto every later goal's leadership and
+            replica sheds — the measured cause of leader-goal stalls at
+            2.6K-broker scale."""
+            W = cache.broker_load[:, res]
+            w = cache.replica_load[:, res]
+            movable = base_movable & (w > 0.0)
+            accept = compose_swap_acceptance(prev_goals, st, ctx, cache)
+            target = (upper + lower) / 2.0
+            hot = st.broker_alive & (W > target)
+            cold = self._dest_mask(st, ctx) & (W < lower)
+            out_r, in_r, cold_idx, valid = kernels.swap_round(
+                st, w, movable, hot, cold, W, target, accept,
+                ctx.partition_replicas, cache=cache,
+                w_rows=cache.table_load[:, :, res])
+            st, cache = kernels.commit_swaps_cached(st, cache, out_r, in_r,
+                                                    cold_idx, valid)
+            return st, cache, jnp.any(valid)
+
         def over_exists(st, cache):
             return jnp.any(st.broker_alive
                            & (cache.broker_load[:, res] > upper))
@@ -186,6 +228,12 @@ class ResourceDistributionGoal(Goal):
             return (jnp.any(st.broker_alive & (W > upper))
                     & jnp.any(self._dest_mask(st, ctx) & (W < target)))
 
+        def swap_under_work_exists(st, cache):
+            W = cache.broker_load[:, res]
+            target = (upper + lower) / 2.0
+            return (jnp.any(self._dest_mask(st, ctx) & (W < lower))
+                    & jnp.any(st.broker_alive & (W > target)))
+
         phases = []
         if self._leadership_applicable():
             phases.append((phase_a, over_exists))
@@ -195,6 +243,8 @@ class ResourceDistributionGoal(Goal):
             # fast mode (framework extension, OptimizationContext.fast_mode)
             # skips the expensive swap fallback entirely
             phases.append((phase_swap, swap_work_exists,
+                           self.max_swap_rounds))
+            phases.append((phase_swap_under, swap_under_work_exists,
                            self.max_swap_rounds))
         state = run_phase_sweeps(state, phases, self.rounds_for(ctx),
                                  table_slots=ctx.table_slots, ctx=ctx)
@@ -265,6 +315,28 @@ class ResourceDistributionGoal(Goal):
         relaxed = (W[dest] + bonus) / cap[dest] <= W[src] / cap[src]
         ok_before = (W[src] >= lower[src]) & (W[dest] <= upper[dest])
         return jnp.where(ok_before, strict, relaxed)
+
+    def move_headroom_terms(self, state, ctx, cache):
+        """Strict-branch quantities of accept_move: arrivals bounded by
+        upper[d] − load[d], departures by load[b] − lower[b]."""
+        res = int(self.resource)
+        cap = state.broker_capacity[:, res]
+        W = cache.broker_load[:, res]
+        return [(f"load{res}", cache.replica_load[:, res],
+                 ctx.balance_upper_pct[res] * cap - W,
+                 W - ctx.balance_lower_pct[res] * cap)]
+
+    def leadership_headroom_terms(self, state, ctx, cache):
+        if not self._leadership_applicable():
+            return []
+        res = int(self.resource)
+        cap = state.broker_capacity[:, res]
+        W = cache.broker_load[:, res]
+        bonus = (state.partition_leader_bonus[state.replica_partition, res]
+                 * state.replica_valid)
+        return [(f"bonus{res}", bonus,
+                 ctx.balance_upper_pct[res] * cap - W,
+                 W - ctx.balance_lower_pct[res] * cap)]
 
     # -- violation surface -------------------------------------------------
     def violated_brokers(self, state, ctx, cache):

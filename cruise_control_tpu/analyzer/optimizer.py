@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -156,11 +157,24 @@ class GoalOptimizer:
                  constraint: Optional[BalancingConstraint] = None,
                  jit_goals: bool = True,
                  pipeline_segment_size: int = 4,
-                 balancedness_weights: Tuple[float, float] = (1.1, 1.5)):
+                 balancedness_weights: Tuple[float, float] = (1.1, 1.5),
+                 auto_warmup: bool = False):
         self.goals = list(goals)
         self.constraint = constraint or BalancingConstraint()
         self.balancedness_weights = balancedness_weights
         self._jit_goals = jit_goals
+        #: compile every pipeline program in PARALLEL before the first
+        #: solve (warmup()) instead of paying sequential per-segment
+        #: compiles inside it — the facade enables this so the
+        #: time-to-first-proposal after process start is one parallel-AOT
+        #: window cold and a persistent-cache load warm, never the serial
+        #: sum (measured at 2.6K-broker scale: ~27 min serial vs ~2.7 min
+        #: parallel cold, seconds when .jax_cache is warm)
+        self._auto_warmup = auto_warmup
+        #: serializes the one-time auto-warmup: concurrent first solves
+        #: must neither double-pay the parallel compile nor skip past a
+        #: half-finished warmup onto the serial-compile path
+        self._warmup_lock = threading.Lock()
         #: goals per compiled program (see optimizations docstring)
         self.pipeline_segment_size = pipeline_segment_size
         #: when True, block after each segment and log its wall-clock
@@ -332,6 +346,12 @@ class GoalOptimizer:
         compiler)."""
         t_start = time.time()
         options = options or OptimizationOptions()
+        if self._auto_warmup:
+            with self._warmup_lock:
+                if not self._aot:
+                    warm_s = self.warmup(state, topology, options)
+                    LOG.info("auto-warmup compiled the pipeline in %.1fs",
+                             warm_s)
         ctx = make_context(state, self.constraint, options, topology)
         if _table_slots_override is not None:
             ctx = dataclasses.replace(ctx,

@@ -1,0 +1,49 @@
+"""OptimizationOptions generator SPI.
+
+Reference analyzer/OptimizationOptionsGenerator +
+DefaultOptimizationOptionsGenerator (wired by
+`optimization.options.generator.class`): every request's options pass
+through the configured generator before reaching the optimizer, which is
+where deployment-wide policies — like the
+`topics.excluded.from.partition.movement` pattern — are applied.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+from cruise_control_tpu.analyzer.context import OptimizationOptions
+
+
+class OptimizationOptionsGenerator:
+    """SPI: transform per-request options before optimization."""
+
+    def configure(self, props) -> None:  # pragma: no cover - plugin hook
+        """Config hook for get_configured_instance."""
+
+    def generate(self, options: OptimizationOptions,
+                 topology=None) -> OptimizationOptions:
+        return options
+
+
+class DefaultOptimizationOptionsGenerator(OptimizationOptionsGenerator):
+    """Merges the deployment-wide excluded-topics pattern
+    (`topics.excluded.from.partition.movement`) into every request."""
+
+    def __init__(self, excluded_topics_pattern: str = "") -> None:
+        self._pattern: Optional[re.Pattern] = (
+            re.compile(excluded_topics_pattern)
+            if excluded_topics_pattern else None)
+
+    def generate(self, options: OptimizationOptions,
+                 topology=None) -> OptimizationOptions:
+        if self._pattern is None or topology is None:
+            return options
+        matched = {t for t in topology.topics
+                   if self._pattern.fullmatch(t)}
+        if not matched:
+            return options
+        return dataclasses.replace(
+            options,
+            excluded_topics=frozenset(options.excluded_topics) | matched)

@@ -65,6 +65,16 @@ class ExecutionProposal:
     def inter_broker_data_to_move(self) -> float:
         return self.partition_size * len(self.replicas_to_add)
 
+    @property
+    def intra_broker_data_to_move(self) -> float:
+        """Bytes moved between logdirs of one broker (reference
+        ExecutionProposal.dataToMoveInMB for intra-broker tasks)."""
+        old_dirs = {r.broker_id: r.logdir for r in self.old_replicas}
+        return self.partition_size * sum(
+            1 for r in self.new_replicas
+            if r.logdir is not None
+            and old_dirs.get(r.broker_id) not in (None, r.logdir))
+
     def to_json(self) -> dict:
         return {
             "topicPartition": {"topic": self.partition.topic,

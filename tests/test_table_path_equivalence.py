@@ -62,12 +62,12 @@ def test_goal_outcomes_comparable(goal_cls, res, seed):
     before = _spread(state, res)
     s_table = _spread(out_table, res)
     s_plain = _spread(out_plain, res)
-    # both paths must improve, and neither may be drastically worse than
-    # the other (tie-breaking differences are expected; semantic drift in
-    # the masks shows up as one path stalling)
+    # both paths must improve, and the production (table) path may not be
+    # drastically worse than the fallback — it MAY be much better: the
+    # table path runs multi-commit rounds (rank_accept) while the
+    # fallback stays single-commit, so a symmetric bound no longer holds
     assert s_table < before and s_plain < before
     assert s_table <= s_plain * 1.5 + 0.05
-    assert s_plain <= s_table * 1.5 + 0.05
 
 
 @pytest.mark.parametrize("seed", [5])
@@ -91,4 +91,6 @@ def test_count_goals_comparable(seed):
         v_0 = int(np.asarray(goal.violated_brokers(
             state, ctx, make_round_cache(state))).sum())
         assert v_t <= v_0 and v_p <= v_0
-        assert abs(v_t - v_p) <= max(2, v_0 // 4), (goal.name, v_0, v_t, v_p)
+        # the multi-commit table path converges at least as well as the
+        # single-commit fallback (one-sided: see test above)
+        assert v_t <= v_p + max(2, v_0 // 4), (goal.name, v_0, v_t, v_p)
