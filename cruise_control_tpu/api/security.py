@@ -76,6 +76,11 @@ class SecurityProvider(abc.ABC):
                 f"{principal.name} (role {principal.role.name}) may not "
                 f"call {endpoint}")
 
+    def auth_challenge_headers(self) -> Mapping[str, str]:
+        """Headers attached to 401 responses (e.g. a WWW-Authenticate
+        challenge advertising the login provider)."""
+        return {}
+
 
 class NoSecurityProvider(SecurityProvider):
     """Everything allowed (security disabled, the reference default)."""
@@ -184,6 +189,9 @@ class JwtSecurityProvider(SecurityProvider):
                  rs256_public_key_pem: Optional[bytes] = None,
                  issuer: Optional[str] = None,
                  audience: Optional[str] = None,
+                 audiences: Optional[Sequence[str]] = None,
+                 cookie_name: Optional[str] = None,
+                 login_url: Optional[str] = None,
                  role_claim: str = "role",
                  default_role: Role = Role.USER,
                  leeway_s: float = 30.0,
@@ -198,11 +206,25 @@ class JwtSecurityProvider(SecurityProvider):
                 load_pem_public_key)
             self._rs256_key = load_pem_public_key(rs256_public_key_pem)
         self._issuer = issuer
-        self._audience = audience
+        #: accepted aud claims (reference jwt.expected.audiences; the
+        #: scalar `audience` form merges in)
+        self._audiences = ([audience] if audience else []) \
+            + list(audiences or [])
+        #: cookie carrying the token (reference jwt.cookie.name)
+        self._cookie_name = cookie_name
+        #: login provider advertised on 401 (reference
+        #: jwt.authentication.provider.url)
+        self._login_url = login_url
         self._role_claim = role_claim
         self._default_role = default_role
         self._leeway = leeway_s
         self._time = time_fn or _time.time
+
+    def auth_challenge_headers(self) -> Mapping[str, str]:
+        if self._login_url:
+            return {"WWW-Authenticate":
+                    f'Bearer realm="{self._login_url}"'}
+        return {"WWW-Authenticate": "Bearer"}
 
     # -- token issue (test/tooling convenience; the reference's login
     # service issues its tokens out-of-band) --
@@ -236,11 +258,25 @@ class JwtSecurityProvider(SecurityProvider):
             return
         raise AuthenticationError(f"JWT algorithm {alg!r} not accepted")
 
+    def _token_from_cookie(self, headers: Mapping[str, str]
+                           ) -> Optional[str]:
+        if not self._cookie_name:
+            return None
+        raw = _header(headers, "Cookie") or ""
+        for part in raw.split(";"):
+            name, _, value = part.strip().partition("=")
+            if name == self._cookie_name and value:
+                return value
+        return None
+
     def authenticate(self, headers: Mapping[str, str]) -> Principal:
         auth = _header(headers, "Authorization")
-        if not auth or not auth.startswith("Bearer "):
-            raise AuthenticationError("missing Bearer token")
-        token = auth[7:].strip()
+        if auth and auth.startswith("Bearer "):
+            token = auth[7:].strip()
+        else:
+            token = self._token_from_cookie(headers)
+            if not token:
+                raise AuthenticationError("missing Bearer token")
         parts = token.split(".")
         if len(parts) != 3:
             raise AuthenticationError("malformed JWT")
@@ -272,10 +308,10 @@ class JwtSecurityProvider(SecurityProvider):
             raise AuthenticationError("JWT not yet valid")
         if self._issuer is not None and claims.get("iss") != self._issuer:
             raise AuthenticationError("JWT issuer mismatch")
-        if self._audience is not None:
+        if self._audiences:
             aud = claims.get("aud")
             auds = aud if isinstance(aud, list) else [aud]
-            if self._audience not in auds:
+            if not any(a in auds for a in self._audiences):
                 raise AuthenticationError("JWT audience mismatch")
         sub = claims.get("sub")
         if not sub:
@@ -296,17 +332,29 @@ class TrustedProxySecurityProvider(SecurityProvider):
 
     def __init__(self, proxy_provider: SecurityProvider,
                  trusted_proxies: Sequence[str],
-                 role_fn: Callable[[str], Role] = lambda name: Role.USER
+                 role_fn: Callable[[str], Role] = lambda name: Role.USER,
+                 ip_regex: Optional[str] = None
                  ) -> None:
+        import re
         self._proxy_provider = proxy_provider
         self._trusted = set(trusted_proxies)
         self._role_fn = role_fn
+        #: source-address filter (reference
+        #: trusted.proxy.services.ip.regex): the asserting proxy must
+        #: connect from a matching address; the server passes the peer
+        #: address as the X-Remote-Addr pseudo-header
+        self._ip_re = re.compile(ip_regex) if ip_regex else None
 
     def authenticate(self, headers: Mapping[str, str]) -> Principal:
         proxy = self._proxy_provider.authenticate(headers)
         if proxy.name not in self._trusted:
             raise AuthenticationError(
                 f"{proxy.name} is not a trusted proxy")
+        if self._ip_re is not None:
+            addr = _header(headers, "X-Remote-Addr") or ""
+            if not self._ip_re.fullmatch(addr):
+                raise AuthenticationError(
+                    f"proxy address {addr!r} not allowed")
         do_as = _header(headers, "doAs") or _header(headers, "X-DoAs-User")
         if not do_as:
             raise AuthenticationError("trusted proxy must assert doAs user")

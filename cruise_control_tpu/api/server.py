@@ -23,7 +23,8 @@ from typing import Callable, Dict, Mapping, Optional, Tuple
 
 from cruise_control_tpu.api import responses as R
 from cruise_control_tpu.api.parameters import (GET_ENDPOINTS, POST_ENDPOINTS,
-                                               ParameterError, QueryParams)
+                                               VALID_PARAMS, ParameterError,
+                                               QueryParams)
 from cruise_control_tpu.api.purgatory import Purgatory
 from cruise_control_tpu.api.security import (AuthenticationError,
                                              AuthorizationError,
@@ -59,12 +60,22 @@ class HttpError(Exception):
 
 
 def make_server_ssl_context(certfile: str, keyfile: Optional[str] = None,
-                            key_password: Optional[str] = None
-                            ) -> ssl.SSLContext:
+                            key_password: Optional[str] = None,
+                            protocol: str = "TLS") -> ssl.SSLContext:
     """TLS context from PEM files (config keys `webserver.ssl.*`;
     reference KafkaCruiseControlApp SSL connector).  `certfile` may hold
-    both certificate and key; pass `keyfile` when they are separate."""
+    both certificate and key; pass `keyfile` when they are separate.
+    `protocol` (webserver.ssl.protocol) floors the negotiated version:
+    "TLS" (library default), "TLSv1.2" or "TLSv1.3"."""
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    floor = {"TLS": None, "TLSV1.2": ssl.TLSVersion.TLSv1_2,
+             "TLSV1.3": ssl.TLSVersion.TLSv1_3}.get((protocol or
+                                                     "TLS").upper())
+    if floor is None and (protocol or "TLS").upper() != "TLS":
+        raise ValueError(f"unsupported webserver.ssl.protocol "
+                         f"{protocol!r}; use TLS, TLSv1.2 or TLSv1.3")
+    if floor is not None:
+        ctx.minimum_version = floor
     ctx.load_cert_chain(certfile, keyfile=keyfile or None,
                         password=key_password or None)
     return ctx
@@ -82,10 +93,31 @@ class CruiseControlApp:
                  user_task_kwargs: Optional[dict] = None,
                  cors_enabled: bool = False,
                  cors_origin: str = "*",
+                 cors_allow_methods: str = "OPTIONS, GET, POST",
+                 cors_expose_headers: str = USER_TASK_ID_HEADER,
                  url_prefix: Optional[str] = None,
+                 endpoint_classes: Optional[dict] = None,
+                 request_reason_required: bool = False,
+                 session_path: str = "/",
+                 ui_diskpath: str = "",
+                 ui_urlprefix: str = "/ui",
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self.cc = cruise_control
         self.security = security or NoSecurityProvider()
+        #: per-endpoint (request class, parameters class) overrides
+        #: (reference CruiseControlRequestConfig /
+        #: CruiseControlParametersConfig; see api.request_registry)
+        self._endpoint_classes = endpoint_classes or {}
+        #: POSTs must carry a non-empty `reason` parameter (reference
+        #: WebServerConfig `request.reason.required`)
+        self._reason_required = request_reason_required
+        #: cookie path for async-session tracking (reference
+        #: `webserver.session.path`)
+        self.session_path = session_path or "/"
+        #: static UI serving (reference `webserver.ui.diskpath` /
+        #: `webserver.ui.urlprefix`)
+        self._ui_diskpath = ui_diskpath
+        self._ui_urlprefix = (ui_urlprefix or "/ui").rstrip("/") or "/ui"
         self.purgatory = Purgatory(time_fn=time_fn,
                                    **(purgatory_kwargs or {})) \
             if two_step_verification else None
@@ -96,6 +128,10 @@ class CruiseControlApp:
         #: CORS (reference webserver.http.cors.*): when enabled, every
         #: response carries the allow-origin header
         self._cors_headers = ({"Access-Control-Allow-Origin": cors_origin,
+                               "Access-Control-Allow-Methods":
+                               cors_allow_methods,
+                               "Access-Control-Expose-Headers":
+                               cors_expose_headers,
                                "Access-Control-Allow-Headers":
                                "Content-Type, Authorization, User-Task-ID"}
                               if cors_enabled else {})
@@ -112,7 +148,24 @@ class CruiseControlApp:
                        client: str = "local"
                        ) -> Tuple[int, Dict[str, str], dict]:
         """(status, response headers, json body)."""
-        headers = headers or {}
+        headers = dict(headers or {})
+        # peer address as a pseudo-header for providers that filter on it
+        # (trusted.proxy.services.ip.regex) — OVERWRITE unconditionally: a
+        # client-supplied value must never reach the address filter
+        headers["X-Remote-Addr"] = client
+        if (method == "GET" and self._ui_diskpath
+                and (path == self._ui_urlprefix
+                     or path.startswith(self._ui_urlprefix + "/"))):
+            # static UI sits behind authentication like every endpoint
+            # (reference: Jetty's security handler fronts the whole server)
+            try:
+                self.security.authenticate(headers)
+            except AuthenticationError as exc:
+                status, hdrs, body = self._error(401, exc)
+                return status, {**hdrs,
+                                **self.security.auth_challenge_headers()}, \
+                    body
+            return self._serve_ui(path)
         try:
             endpoint = self._endpoint_of(method, path)
             # per-endpoint request sensors (reference servlet meters/timers,
@@ -122,9 +175,18 @@ class CruiseControlApp:
                 registry.meter(f"{endpoint}-request-rate").mark()
             principal = self.security.authenticate(headers)
             self.security.authorize(principal, endpoint)
-            params = QueryParams(
+            req_cls, par_cls = self._endpoint_classes.get(
+                endpoint, (None, QueryParams))
+            params = par_cls(
                 endpoint, urllib.parse.parse_qs(query_string,
                                                 keep_blank_values=True))
+            if (self._reason_required and endpoint in POST_ENDPOINTS
+                    and "reason" in VALID_PARAMS[endpoint]
+                    and not params.get("reason")):
+                raise ParameterError(
+                    f"{endpoint} requires a reason parameter "
+                    f"(request.reason.required=true)")
+            request = req_cls(endpoint) if req_cls is not None else None
             if endpoint in SYNC_ENDPOINTS:
                 if endpoint in POST_ENDPOINTS:
                     # sync mutating endpoints go through the purgatory too
@@ -132,13 +194,18 @@ class CruiseControlApp:
                                                   query_string, client)
                     if parked is not None:
                         return parked
-                return 200, {}, self._handle_sync(endpoint, params)
+                body = (request.handle_sync(self, params) if request
+                        else self._handle_sync(endpoint, params))
+                return 200, {}, body
             return self._handle_async(endpoint, params, query_string,
-                                      client, headers)
+                                      client, headers, request=request)
         except (ParameterError, ValueError) as exc:
             return self._error(400, exc)
         except AuthenticationError as exc:
-            return self._error(401, exc)
+            status, hdrs, body = self._error(401, exc)
+            # advertise the login provider (jwt.authentication.provider.url)
+            return status, {**hdrs,
+                            **self.security.auth_challenge_headers()}, body
         except AuthorizationError as exc:
             return self._error(403, exc)
         except KeyError as exc:
@@ -156,6 +223,34 @@ class CruiseControlApp:
                                                      dict]:
         return status, {}, {"errorMessage": f"{type(exc).__name__}: {exc}",
                             "version": 1}
+
+    def _serve_ui(self, path: str) -> Tuple[int, Dict[str, str], dict]:
+        """Serve the bundled UI from disk (reference
+        `webserver.ui.diskpath` / `webserver.ui.urlprefix`; Jetty static
+        resource handler).  Bodies carry raw bytes via the `__raw__`
+        sentinel the HTTP layer streams verbatim."""
+        import mimetypes
+        import os
+        rel = path[len(self._ui_urlprefix):].lstrip("/") or "index.html"
+        root = os.path.abspath(self._ui_diskpath)
+        full = os.path.abspath(os.path.join(root, rel))
+        if not full.startswith(root + os.sep) and full != root:
+            return 403, {}, {"errorMessage": "forbidden", "version": 1}
+        if not os.path.isfile(full):
+            return 404, {}, {"errorMessage": f"no such UI file {rel}",
+                             "version": 1}
+        ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+        with open(full, "rb") as fh:
+            return 200, {}, {"__raw__": fh.read(),
+                             "__content_type__": ctype}
+
+    # public delegates for configured Request classes
+    # (api.request_registry.Request defaults call back into these)
+    def default_sync_handler(self, endpoint: str, params) -> dict:
+        return self._handle_sync(endpoint, params)
+
+    def default_operation(self, endpoint: str, params):
+        return self._operation_for(endpoint, params)
 
     def _endpoint_of(self, method: str, path: str) -> str:
         base = self.base_path
@@ -192,7 +287,8 @@ class CruiseControlApp:
     # ------------------------------------------------------------------
     def _handle_async(self, endpoint: str, params: QueryParams,
                       query_string: str, client: str,
-                      headers: Mapping[str, str]
+                      headers: Mapping[str, str],
+                      request=None
                       ) -> Tuple[int, Dict[str, str], dict]:
         task_id = None
         for k, v in headers.items():
@@ -205,10 +301,16 @@ class CruiseControlApp:
                                           client)
             if parked is not None:
                 return parked
-        op = self._operation_for(endpoint, params)
+        op = (request.operation(self, params) if request is not None
+              else self._operation_for(endpoint, params))
         info = self.user_tasks.get_or_create(endpoint, query_string, client,
                                              op, task_id=task_id)
-        hdrs = {USER_TASK_ID_HEADER: info.task_id}
+        hdrs = {USER_TASK_ID_HEADER: info.task_id,
+                # async session cookie scoped to the configured path
+                # (reference webserver.session.path; the reference tracks
+                # async requests per servlet session)
+                "Set-Cookie": (f"CCSESSION={info.task_id}; "
+                               f"Path={self.session_path}")}
         try:
             body = info.future.result(timeout=self._async_timeout)
             return 200, hdrs, body
@@ -454,9 +556,15 @@ class CruiseControlApp:
                     dict(self.headers.items()),
                     client=self.client_address[0])
                 hdrs = {**hdrs, **app._cors_headers}
-                data = json.dumps(body, indent=2).encode()
+                if isinstance(body, dict) and "__raw__" in body:
+                    data = body["__raw__"]
+                    ctype = body.get("__content_type__",
+                                     "application/octet-stream")
+                else:
+                    data = json.dumps(body, indent=2).encode()
+                    ctype = "application/json"
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 for k, v in hdrs.items():
                     self.send_header(k, v)

@@ -18,6 +18,32 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 USER_TASK_ID_HEADER = "User-Task-ID"
 
+#: endpoint -> task category (reference CruiseControlEndPoint.java:17-36
+#: EndpointType: {KAFKA, CRUISE_CONTROL} x {ADMIN, MONITOR}); drives the
+#: per-category completed-task retention/caps of UserTaskManagerConfig
+ENDPOINT_CATEGORY: Dict[str, str] = {
+    "BOOTSTRAP": "cruise.control.admin",
+    "TRAIN": "cruise.control.admin",
+    "LOAD": "kafka.monitor",
+    "PARTITION_LOAD": "kafka.monitor",
+    "PROPOSALS": "kafka.monitor",
+    "STATE": "cruise.control.monitor",
+    "ADD_BROKER": "kafka.admin",
+    "REMOVE_BROKER": "kafka.admin",
+    "FIX_OFFLINE_REPLICAS": "kafka.admin",
+    "REBALANCE": "kafka.admin",
+    "STOP_PROPOSAL_EXECUTION": "kafka.admin",
+    "PAUSE_SAMPLING": "cruise.control.admin",
+    "RESUME_SAMPLING": "cruise.control.admin",
+    "KAFKA_CLUSTER_STATE": "kafka.monitor",
+    "DEMOTE_BROKER": "kafka.admin",
+    "USER_TASKS": "cruise.control.monitor",
+    "REVIEW_BOARD": "cruise.control.monitor",
+    "ADMIN": "cruise.control.admin",
+    "REVIEW": "cruise.control.admin",
+    "TOPIC_CONFIGURATION": "kafka.admin",
+}
+
 
 class TaskStatus(enum.Enum):
     ACTIVE = "Active"
@@ -55,14 +81,21 @@ class UserTaskManager:
                  max_cached_completed_tasks: Optional[int] = None,
                  attach_max_age_s: Optional[float] = None,
                  max_workers: int = 8,
+                 category_retention_s: Optional[Dict[str, float]] = None,
+                 category_max_cached: Optional[Dict[str, int]] = None,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self._max_active = max_active_tasks
         self._retention_s = completed_retention_s
         #: completed-task cache cap (reference
         #: max.cached.completed.user.tasks): oldest evicted beyond this
         self._max_cached_completed = max_cached_completed_tasks
+        #: per-category overrides (reference UserTaskManagerConfig
+        #: completed.{kafka,cruise.control}.{admin,monitor}.* keys; the
+        #: category of a task comes from ENDPOINT_CATEGORY)
+        self._category_retention_s = category_retention_s or {}
+        self._category_max_cached = category_max_cached or {}
         #: implicit same-client+URL resumption window (reference
-        #: webserver.session.maxExpiryPeriodMs session binding expiry)
+        #: webserver.session.maxExpiryTimeMs session binding expiry)
         self._attach_max_age_s = attach_max_age_s
         self._time = time_fn or _time.time
         self._lock = threading.Lock()
@@ -140,26 +173,39 @@ class UserTaskManager:
                 info.status = status
                 info.end_ms = self._time() * 1000.0
 
+    def _retention_for(self, endpoint: str) -> float:
+        cat = ENDPOINT_CATEGORY.get(endpoint)
+        return self._category_retention_s.get(cat, self._retention_s)
+
     def _expire(self, now_ms: float) -> None:
-        cutoff = now_ms - self._retention_s * 1000.0
         dead = [tid for tid, t in self._tasks.items()
-                if t.status != TaskStatus.ACTIVE and t.end_ms < cutoff]
+                if t.status != TaskStatus.ACTIVE
+                and t.end_ms < now_ms
+                - self._retention_for(t.endpoint) * 1000.0]
         for tid in dead:
             info = self._tasks.pop(tid)
             self._by_request.pop(
                 (info.client_id, f"{info.endpoint}?{info.query}"), None)
-        if self._max_cached_completed is not None:
-            done = sorted((t for t in self._tasks.values()
-                           if t.status != TaskStatus.ACTIVE),
-                          key=lambda t: t.end_ms)
-            for info in done[:max(0, len(done)
-                                  - self._max_cached_completed)]:
+
+        def evict_oldest_beyond(tasks, cap):
+            done = sorted(tasks, key=lambda t: t.end_ms)
+            for info in done[:max(0, len(done) - cap)]:
                 self._tasks.pop(info.task_id, None)
                 key = (info.client_id, f"{info.endpoint}?{info.query}")
                 # only sever the binding if it still points at THIS task —
                 # a newer ACTIVE task may have re-bound the same key
                 if self._by_request.get(key) == info.task_id:
                     self._by_request.pop(key, None)
+
+        for cat, cap in self._category_max_cached.items():
+            evict_oldest_beyond(
+                [t for t in self._tasks.values()
+                 if t.status != TaskStatus.ACTIVE
+                 and ENDPOINT_CATEGORY.get(t.endpoint) == cat], cap)
+        if self._max_cached_completed is not None:
+            evict_oldest_beyond([t for t in self._tasks.values()
+                                 if t.status != TaskStatus.ACTIVE],
+                                self._max_cached_completed)
         if self._attach_max_age_s is not None:
             attach_cutoff = now_ms - self._attach_max_age_s * 1000.0
             for key, tid in list(self._by_request.items()):

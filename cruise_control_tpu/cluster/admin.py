@@ -89,3 +89,37 @@ class ClusterAdminClient(abc.ABC):
 
     def close(self) -> None:  # pragma: no cover - default no-op
         """Release resources."""
+
+
+class TopicConfigProvider(abc.ABC):
+    """SPI over per-topic config lookup (reference
+    config/TopicConfigProvider.java, wired by
+    `topic.config.provider.class`; the reference default reads configs
+    from ZooKeeper — modernized here to the admin client)."""
+
+    def configure(self, props) -> None:  # pragma: no cover - plugin hook
+        """Config hook for get_configured_instance."""
+
+    @abc.abstractmethod
+    def topic_configs(self, topic: str) -> Mapping[str, str]:
+        """Per-topic config map (e.g. min.insync.replicas)."""
+
+
+class AdminTopicConfigProvider(TopicConfigProvider):
+    """Default provider: delegates to the cluster admin client
+    (reference KafkaTopicConfigProvider.java:1-105 behavioral
+    equivalent)."""
+
+    def __init__(self, admin: Optional[ClusterAdminClient] = None) -> None:
+        self._admin = admin
+
+    def bind(self, admin: ClusterAdminClient) -> None:
+        """Late-bind the admin client (config-instantiated providers are
+        constructed before the cluster connection exists)."""
+        self._admin = admin
+
+    def topic_configs(self, topic: str) -> Mapping[str, str]:
+        if self._admin is None:
+            raise RuntimeError("AdminTopicConfigProvider not bound to a "
+                               "cluster admin client")
+        return self._admin.topic_configs(topic)

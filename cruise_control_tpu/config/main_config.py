@@ -78,6 +78,46 @@ def monitor_config_def(d: ConfigDef) -> ConfigDef:
     d.define("max.allowed.extrapolations.per.broker", Type.INT, 5,
              in_range(min_value=0), _L,
              "Extrapolated windows tolerated per broker entity.")
+    d.define("partition.metric.sample.aggregator.completeness.cache.size",
+             Type.INT, 5, in_range(min_value=0), _L,
+             "Cached completeness evaluations (partition aggregator).")
+    d.define("broker.metric.sample.aggregator.completeness.cache.size",
+             Type.INT, 5, in_range(min_value=0), _L,
+             "Cached completeness evaluations (broker aggregator).")
+    d.define("min.valid.partition.ratio", Type.DOUBLE, 0.995,
+             in_range(min_value=0.0, max_value=1.0), _M,
+             "Default monitored-partition completeness required for model "
+             "generation when a request names none.")
+    d.define("metric.sampler.partition.assignor.class", Type.CLASS,
+             "cruise_control_tpu.monitor.sampling.fetcher"
+             ".DefaultPartitionAssignor",
+             None, _L, "Partition-to-fetcher assignment strategy.")
+    d.define("use.linear.regression.model", Type.BOOLEAN, False, None, _L,
+             "Estimate CPU from the trained linear regression model "
+             "instead of static coefficients.")
+    d.define("linear.regression.model.cpu.util.bucket.size", Type.INT, 5,
+             in_range(min_value=1, max_value=100), _L,
+             "CPU-utilization bucket width (percent) for regression "
+             "training.")
+    d.define("linear.regression.model.min.num.cpu.util.buckets", Type.INT,
+             5, in_range(min_value=1), _L,
+             "Distinct CPU buckets required before the regression trains.")
+    d.define("linear.regression.model.required.samples.per.bucket",
+             Type.INT, 10, in_range(min_value=1), _L,
+             "Samples required per CPU bucket before the regression "
+             "trains.")
+    d.define("leader.network.inbound.weight.for.cpu.util", Type.DOUBLE,
+             0.6, in_range(min_value=0.0), _L,
+             "Static CPU attribution weight of leader NW_IN.")
+    d.define("leader.network.outbound.weight.for.cpu.util", Type.DOUBLE,
+             0.1, in_range(min_value=0.0), _L,
+             "Static CPU attribution weight of leader NW_OUT.")
+    d.define("follower.network.inbound.weight.for.cpu.util", Type.DOUBLE,
+             0.3, in_range(min_value=0.0), _L,
+             "Static CPU attribution weight of follower NW_IN.")
+    d.define("topic.config.provider.class", Type.CLASS,
+             "cruise_control_tpu.cluster.admin.AdminTopicConfigProvider",
+             None, _L, "TopicConfigProvider implementation.")
     d.define("num.cached.recent.anomaly.states", Type.INT, 10,
              in_range(min_value=1, max_value=100), _L,
              "Recent anomalies kept per type for the state endpoint.")
@@ -183,6 +223,19 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
              "is free once converged.")
     d.define("allow.capacity.estimation.on.proposal", Type.BOOLEAN, True,
              None, _L, "Allow estimated capacities when computing proposals.")
+    d.define("allow.capacity.estimation.on.proposal.precompute",
+             Type.BOOLEAN, True, None, _L,
+             "Allow estimated capacities in the background proposal "
+             "precompute loop.")
+    d.define("topics.excluded.from.partition.movement", Type.STRING, "",
+             None, _M,
+             "Regex of topics never moved by any optimization "
+             "(merged into every request's excluded set).")
+    d.define("optimization.options.generator.class", Type.CLASS,
+             "cruise_control_tpu.analyzer.options_generator"
+             ".DefaultOptimizationOptionsGenerator",
+             None, _L,
+             "OptimizationOptions generator applied to every request.")
     return d
 
 
@@ -227,6 +280,26 @@ def executor_config_def(d: ConfigDef) -> ConfigDef:
              in_range(min_value=1), _L, "Retention of demoted-broker records.")
     d.define("removal.history.retention.time.ms", Type.LONG, 1_209_600_000,
              in_range(min_value=1), _L, "Retention of removed-broker records.")
+    d.define("inter.broker.replica.movement.rate.alerting.threshold",
+             Type.DOUBLE, 0.1, in_range(min_value=0.0), _L,
+             "Alert when inter-broker movement throughput (MB/s) drops "
+             "below this while tasks are in flight.")
+    d.define("intra.broker.replica.movement.rate.alerting.threshold",
+             Type.DOUBLE, 0.2, in_range(min_value=0.0), _L,
+             "Alert threshold for intra-broker (logdir) movement "
+             "throughput in MB/s.")
+    d.define("logdir.response.timeout.ms", Type.LONG, 10_000,
+             in_range(min_value=1), _L,
+             "Timeout for logdir describe/alter calls to the cluster.")
+    d.define("zookeeper.security.enabled", Type.BOOLEAN, False, None, _L,
+             "Reference-compat flag: the reference secures its ZooKeeper "
+             "sessions with this; this framework has no ZooKeeper — when "
+             "set, startup logs that security is the cluster admin "
+             "client's responsibility (see docs/DECISIONS.md).")
+    d.define("network.client.provider.class", Type.CLASS, "", None, _L,
+             "Reference-compat alias for the cluster client factory: "
+             "when `cluster.admin.class` is unset, this class (a "
+             "ClusterAdminClient) provides the cluster connection.")
     return d
 
 
@@ -292,6 +365,42 @@ def anomaly_detector_config_def(d: ConfigDef) -> ConfigDef:
     d.define("topic.replication.factor.margin", Type.INT, 1,
              in_range(min_value=0), _L,
              "Required RF margin over min.insync.replicas.")
+    d.define("broker.failure.detection.backoff.ms", Type.LONG, 300_000,
+             in_range(min_value=1), _L,
+             "Backoff before re-checking liveness of a suspect broker.")
+    d.define("fixable.failed.broker.count.threshold", Type.INT, 10,
+             in_range(min_value=1), _L,
+             "Self-healing declines broker failures above this count.")
+    d.define("fixable.failed.broker.percentage.threshold", Type.DOUBLE,
+             0.4, in_range(min_value=0.0, max_value=1.0), _L,
+             "Self-healing declines failures above this broker fraction.")
+    d.define("broker.failures.class", Type.CLASS,
+             "cruise_control_tpu.detector.anomalies.BrokerFailures", None,
+             _L, "Anomaly class instantiated for broker failures.")
+    d.define("goal.violations.class", Type.CLASS,
+             "cruise_control_tpu.detector.anomalies.GoalViolations", None,
+             _L, "Anomaly class instantiated for goal violations.")
+    d.define("disk.failures.class", Type.CLASS,
+             "cruise_control_tpu.detector.anomalies.DiskFailures", None,
+             _L, "Anomaly class instantiated for disk failures.")
+    d.define("metric.anomaly.class", Type.CLASS,
+             "cruise_control_tpu.core.anomaly.MetricAnomaly", None,
+             _L, "Anomaly class instantiated for metric anomalies.")
+    d.define("anomaly.detection.allow.capacity.estimation", Type.BOOLEAN,
+             True, None, _L,
+             "Allow estimated capacities in detector model builds.")
+    d.define("self.healing.exclude.recently.demoted.brokers", Type.BOOLEAN,
+             True, None, _L,
+             "Exclude recently demoted brokers from self-healing "
+             "leadership moves.")
+    d.define("self.healing.exclude.recently.removed.brokers", Type.BOOLEAN,
+             True, None, _L,
+             "Exclude recently removed brokers from self-healing replica "
+             "moves.")
+    d.define("failed.brokers.zk.path", Type.STRING, "", None, _L,
+             "Reference-compat name for the durable failed-broker store "
+             "location (modernized: a filesystem path for the file store "
+             "instead of a ZooKeeper znode path).")
     return d
 
 
@@ -307,8 +416,28 @@ def webserver_config_def(d: ConfigDef) -> ConfigDef:
              "CORS allowed origin.")
     d.define("webserver.api.urlprefix", Type.STRING, "/kafkacruisecontrol",
              None, _M, "URL prefix for all endpoints.")
-    d.define("webserver.session.maxExpiryPeriodMs", Type.LONG, 60_000,
+    d.define("webserver.session.maxExpiryTimeMs", Type.LONG, 60_000,
              in_range(min_value=1), _L, "Async session expiry.")
+    d.define("webserver.session.path", Type.STRING, "/", None, _L,
+             "Cookie path for the async-session cookie.")
+    d.define("webserver.http.cors.allowmethods", Type.STRING,
+             "OPTIONS, GET, POST", None, _L,
+             "CORS Access-Control-Allow-Methods header value.")
+    d.define("webserver.http.cors.exposeheaders", Type.STRING,
+             "User-Task-ID", None, _L,
+             "CORS Access-Control-Expose-Headers header value.")
+    d.define("webserver.accesslog.path", Type.STRING, "", None, _L,
+             "Access-log file path (empty = route the accessLogger "
+             "logger yourself).")
+    d.define("webserver.accesslog.retention.days", Type.INT, 14,
+             in_range(min_value=1), _L,
+             "Rotated access-log files kept (daily rotation).")
+    d.define("webserver.ui.diskpath", Type.STRING, "", None, _L,
+             "Directory of UI static files to serve (empty disables).")
+    d.define("webserver.ui.urlprefix", Type.STRING, "/ui", None, _L,
+             "URL prefix the UI is served under.")
+    d.define("request.reason.required", Type.BOOLEAN, False, None, _L,
+             "Reject POSTs without a reason parameter.")
     d.define("webserver.request.maxBlockTimeMs", Type.LONG, 10_000,
              in_range(min_value=0), _M,
              "How long a sync-looking request blocks before going async.")
@@ -327,6 +456,15 @@ def webserver_config_def(d: ConfigDef) -> ConfigDef:
              "PEM private-key path when separate from the certificate.")
     d.define("webserver.ssl.key.password", Type.PASSWORD, "", None, _L,
              "TLS key password.")
+    d.define("webserver.ssl.keystore.password", Type.PASSWORD, "", None, _L,
+             "Keystore password (used when webserver.ssl.key.password is "
+             "unset).")
+    d.define("webserver.ssl.keystore.type", Type.STRING, "PEM", None, _L,
+             "Keystore format; this framework supports PEM (convert "
+             "JKS/PKCS12 via openssl).")
+    d.define("webserver.ssl.protocol", Type.STRING, "TLS", None, _L,
+             "Minimum TLS version: TLS (library default), TLSv1.2 or "
+             "TLSv1.3.")
     d.define("webserver.security.jwt.secret", Type.PASSWORD, "", None, _M,
              "HS256 shared secret for JwtSecurityProvider (use "
              "${env:NAME} indirection for the value).")
@@ -337,6 +475,31 @@ def webserver_config_def(d: ConfigDef) -> ConfigDef:
              "Expected JWT iss claim (empty disables the check).")
     d.define("webserver.security.jwt.audience", Type.STRING, "", None, _L,
              "Expected JWT aud claim (empty disables the check).")
+    d.define("jwt.auth.certificate.location", Type.STRING, "", None, _L,
+             "Reference-compat alias of "
+             "webserver.security.jwt.public.key.location (PEM "
+             "certificate/public key for RS256 verification).")
+    d.define("jwt.authentication.provider.url", Type.STRING, "", None, _L,
+             "Login URL advertised in 401 challenges (browsers redirect "
+             "here to obtain a token).")
+    d.define("jwt.cookie.name", Type.STRING, "", None, _L,
+             "Cookie name carrying the JWT (empty = Authorization header "
+             "only).")
+    d.define("jwt.expected.audiences", Type.LIST, "", None, _L,
+             "Accepted JWT aud claims (superset form of "
+             "webserver.security.jwt.audience).")
+    d.define("spnego.keytab.file", Type.STRING, "", None, _L,
+             "Reference-compat: SPNEGO keytab.  Kerberos termination is a "
+             "documented non-goal (docs/DECISIONS.md) — setting this "
+             "fails startup with the proxy-termination guidance.")
+    d.define("spnego.principal", Type.STRING, "", None, _L,
+             "Reference-compat: SPNEGO service principal (see "
+             "spnego.keytab.file).")
+    d.define("trusted.proxy.services", Type.LIST, "", None, _L,
+             "Service principals accepted by the trusted-proxy provider.")
+    d.define("trusted.proxy.services.ip.regex", Type.STRING, "", None, _L,
+             "Regex of proxy source addresses allowed to assert "
+             "doAs identities.")
     d.define("webserver.accesslog.enabled", Type.BOOLEAN, True, None, _L,
              "Write NCSA-style access log lines.")
     d.define("two.step.verification.enabled", Type.BOOLEAN, False, None, _M,
@@ -359,6 +522,47 @@ def user_task_manager_config_def(d: ConfigDef) -> ConfigDef:
     d.define("max.cached.completed.user.tasks", Type.INT, 100,
              in_range(min_value=1), _L,
              "Maximum completed user tasks cached.")
+    # per-category retention/caps (reference UserTaskManagerConfig splits
+    # completed tasks into {kafka, cruise control} x {admin, monitor})
+    d.define("completed.kafka.admin.user.task.retention.time.ms",
+             Type.LONG, -1, None, _L,
+             "Retention of completed Kafka-admin tasks (-1 = the general "
+             "completed.user.task.retention.time.ms).")
+    d.define("completed.kafka.monitor.user.task.retention.time.ms",
+             Type.LONG, -1, None, _L,
+             "Retention of completed Kafka-monitor tasks (-1 = general).")
+    d.define("completed.cruise.control.admin.user.task.retention.time.ms",
+             Type.LONG, -1, None, _L,
+             "Retention of completed Cruise-Control-admin tasks "
+             "(-1 = general).")
+    d.define("completed.cruise.control.monitor.user.task.retention.time.ms",
+             Type.LONG, -1, None, _L,
+             "Retention of completed Cruise-Control-monitor tasks "
+             "(-1 = general).")
+    d.define("max.cached.completed.kafka.admin.user.tasks", Type.INT, -1,
+             None, _L,
+             "Cap of cached completed Kafka-admin tasks (-1 = the "
+             "general max.cached.completed.user.tasks).")
+    d.define("max.cached.completed.kafka.monitor.user.tasks", Type.INT, -1,
+             None, _L,
+             "Cap of cached completed Kafka-monitor tasks (-1 = general).")
+    d.define("max.cached.completed.cruise.control.admin.user.tasks",
+             Type.INT, -1, None, _L,
+             "Cap of cached completed Cruise-Control-admin tasks "
+             "(-1 = general).")
+    d.define("max.cached.completed.cruise.control.monitor.user.tasks",
+             Type.INT, -1, None, _L,
+             "Cap of cached completed Cruise-Control-monitor tasks "
+             "(-1 = general).")
+    return d
+
+
+def request_parameters_config_def(d: ConfigDef) -> ConfigDef:
+    """reference config/constants/CruiseControlRequestConfig.java +
+    CruiseControlParametersConfig.java (20 + 20 keys): per-endpoint
+    request-handler and parameter-validation classes."""
+    from cruise_control_tpu.api.request_registry import request_config_def
+    request_config_def(d)
     return d
 
 
@@ -370,6 +574,7 @@ def config_def() -> ConfigDef:
     anomaly_detector_config_def(d)
     webserver_config_def(d)
     user_task_manager_config_def(d)
+    request_parameters_config_def(d)
     return d
 
 

@@ -60,6 +60,8 @@ class BrokerFailureDetector:
                  store: Optional[FailedBrokerStore] = None,
                  fixable_max_count: int = 10,
                  fixable_max_ratio: float = 0.4,
+                 detection_backoff_s: float = 300.0,
+                 anomaly_cls=None,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self._admin = admin
         self._report = report_fn
@@ -67,6 +69,12 @@ class BrokerFailureDetector:
         self._store = store or FailedBrokerStore()
         self._fixable_max_count = fixable_max_count
         self._fixable_max_ratio = fixable_max_ratio
+        #: min delay between full re-detections for the SAME failure set
+        #: (reference broker.failure.detection.backoff.ms)
+        self._detection_backoff_s = detection_backoff_s
+        self._last_detect_s = -float("inf")
+        #: reference broker.failures.class
+        self._anomaly_cls = anomaly_cls or BrokerFailures
         self._time = time_fn or _time.time
         self._lock = threading.Lock()
         self._failed: Dict[int, float] = self._store.load()
@@ -77,7 +85,7 @@ class BrokerFailureDetector:
     def start(self) -> None:
         self._admin.add_liveness_listener(self._listener)
         self._started = True
-        self.detect_now()   # catch failures that predate the watch
+        self.detect_now(force=True)  # catch pre-watch failures
 
     def shutdown(self) -> None:
         if self._started:
@@ -89,7 +97,15 @@ class BrokerFailureDetector:
             return dict(self._failed)
 
     # ------------------------------------------------------------------
-    def detect_now(self) -> None:
+    def detect_now(self, force: bool = False) -> None:
+        # scheduled sweeps back off between full re-detections; the
+        # event-driven liveness listener is never throttled (reference
+        # broker.failure.detection.backoff.ms)
+        now = self._time()
+        if not force and now - self._last_detect_s \
+                < self._detection_backoff_s:
+            return
+        self._last_detect_s = now
         snapshot = self._admin.describe_cluster()
         self._update(snapshot.alive_broker_ids, snapshot.all_broker_ids)
 
@@ -118,7 +134,7 @@ class BrokerFailureDetector:
                 LOG.warning(
                     "%d/%d brokers failed — beyond self-healing thresholds, "
                     "reporting without fix", len(failed), total)
-            self._report(BrokerFailures(
+            self._report(self._anomaly_cls(
                 failed_brokers_by_time_ms=failed,
                 fix_fn=self._fix_fn if fixable else None,
                 detected_ms=now_ms))

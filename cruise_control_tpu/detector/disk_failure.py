@@ -17,10 +17,13 @@ class DiskFailureDetector:
     def __init__(self, admin: ClusterAdminClient,
                  report_fn: Callable[[DiskFailures], None],
                  fix_fn: Optional[FixFn] = None,
+                 anomaly_cls=None,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self._admin = admin
         self._report = report_fn
         self._fix_fn = fix_fn
+        #: reference disk.failures.class
+        self._anomaly_cls = anomaly_cls or DiskFailures
         self._time = time_fn or _time.time
 
     def detect_now(self) -> Optional[DiskFailures]:
@@ -34,7 +37,7 @@ class DiskFailureDetector:
                 failed[broker_id] = offline
         if not failed:
             return None
-        anomaly = DiskFailures(
+        anomaly = self._anomaly_cls(
             failed_disks_by_broker=failed, fix_fn=self._fix_fn,
             detected_ms=self._time() * 1000.0)
         self._report(anomaly)

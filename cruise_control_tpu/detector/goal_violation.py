@@ -61,8 +61,14 @@ class GoalViolationDetector:
                  fix_fn: Optional[FixFn] = None,
                  constraint: Optional[BalancingConstraint] = None,
                  options: Optional[OptimizationOptions] = None,
+                 allow_capacity_estimation: bool = True,
+                 anomaly_cls=None,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self._load_monitor = load_monitor
+        #: reference anomaly.detection.allow.capacity.estimation
+        self._allow_capacity_estimation = allow_capacity_estimation
+        #: reference goal.violations.class
+        self._anomaly_cls = anomaly_cls or GoalViolations
         self._goals = list(detection_goals)
         self._report = report_fn
         self._fix_fn = fix_fn
@@ -80,7 +86,8 @@ class GoalViolationDetector:
         from cruise_control_tpu.core.aggregator import (
             NotEnoughValidWindowsError)
         try:
-            state, topology = self._load_monitor.cluster_model()
+            state, topology = self._load_monitor.cluster_model(
+                allow_capacity_estimation=self._allow_capacity_estimation)
         except NotEnoughValidWindowsError as exc:
             # expected during warm-up: not an error
             LOG.debug("skipping goal-violation sweep: %s", exc)
@@ -106,7 +113,7 @@ class GoalViolationDetector:
             self._goals, fixable + unfixable)
         if not fixable and not unfixable:
             return None
-        anomaly = GoalViolations(
+        anomaly = self._anomaly_cls(
             fixable_violated_goals=fixable,
             unfixable_violated_goals=unfixable,
             fix_fn=self._fix_fn,

@@ -21,10 +21,14 @@ HistorySupplier = Callable[[], Tuple[
 class MetricAnomalyDetector:
     def __init__(self, history_supplier: HistorySupplier,
                  finders: Sequence[MetricAnomalyFinder],
-                 report_fn: Callable[[MetricAnomaly], None]) -> None:
+                 report_fn: Callable[[MetricAnomaly], None],
+                 anomaly_cls=None) -> None:
         self._supplier = history_supplier
         self._finders = list(finders)
         self._report = report_fn
+        #: reference metric.anomaly.class — anomalies a finder returns
+        #: are re-wrapped when an override is configured
+        self._anomaly_cls = anomaly_cls
 
     def detect_now(self) -> List[MetricAnomaly]:
         history, current = self._supplier()

@@ -21,8 +21,14 @@ class TopicReplicationFactorAnomalyFinder:
                  min_isr_margin: int = 1,
                  fix_fn: Optional[FixFn] = None,
                  topic_pattern: Optional[str] = None,
+                 topic_config_provider=None,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self._admin = admin
+        #: reference topic.config.provider.class (min.insync.replicas
+        #: lookups go through the provider SPI)
+        self._topic_configs = (topic_config_provider.topic_configs
+                               if topic_config_provider is not None
+                               else admin.topic_configs)
         self._report = report_fn
         self._target_rf = target_replication_factor
         #: required headroom above min.insync.replicas (reference
@@ -43,7 +49,7 @@ class TopicReplicationFactorAnomalyFinder:
             # min.insync.replicas floors the acceptable RF (reference reads
             # topic configs for minISR before flagging under-replication)
             try:
-                min_isr = int(self._admin.topic_configs(topic).get(
+                min_isr = int(self._topic_configs(topic).get(
                     "min.insync.replicas", 1))
             except (TypeError, ValueError):
                 min_isr = 1

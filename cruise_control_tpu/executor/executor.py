@@ -65,6 +65,9 @@ class Executor:
                  max_task_execution_idle_s: float = 190.0,
                  max_task_lifetime_s: float = 6 * 3600.0,
                  task_alerting_threshold_s: float = 90.0,
+                 inter_rate_alert_threshold_mb_s: float = 0.1,
+                 intra_rate_alert_threshold_mb_s: float = 0.2,
+                 logdir_response_timeout_s: float = 10.0,
                  leader_movement_timeout_s: float = 180.0,
                  replication_throttle_bytes_per_s: Optional[float] = None,
                  removal_history_retention_s: float = 12 * 3600.0,
@@ -88,6 +91,17 @@ class Executor:
         #: task.execution.alerting.threshold.ms)
         self._alert_threshold = task_alerting_threshold_s
         self._alerted_tasks: set = set()
+        #: movement-rate alerting floors in MB/s (reference
+        #: {inter,intra}.broker.replica.movement.rate.alerting.threshold):
+        #: a task slower than its phase's floor alerts even before the
+        #: age-based threshold
+        self._inter_rate_alert_mb_s = inter_rate_alert_threshold_mb_s
+        self._intra_rate_alert_mb_s = intra_rate_alert_threshold_mb_s
+        #: timeout for logdir describe/alter calls (reference
+        #: logdir.response.timeout.ms); honest-signaling: the stdlib admin
+        #: SPI is synchronous, so this caps the WARNING we raise when a
+        #: call overruns, it cannot abort the call
+        self._logdir_timeout_s = logdir_response_timeout_s
         #: refuse executions whose task count exceeds this (reference
         #: max.num.cluster.movements guards memory/controller pressure)
         self._max_cluster_movements = max_cluster_movements
@@ -436,13 +450,21 @@ class Executor:
                     self._admin.alter_partition_reassignments({tp: None})
                     mgr.finish_task(task, TaskState.DEAD, now_ms)
                     in_flight.remove(task)
-                elif (age_s > self._alert_threshold
-                        and task.task_id not in self._alerted_tasks):
-                    self._alerted_tasks.add(task.task_id)
-                    LOG.warning(
-                        "task %s (%s) running for %.0fs, beyond the "
-                        "alerting threshold %.0fs", task.task_id, tp,
-                        age_s, self._alert_threshold)
+                else:
+                    mb = task.proposal.inter_broker_data_to_move / 1e6
+                    rate = mb / max(age_s, 1e-9)
+                    slow = (age_s > self._alert_threshold
+                            or (age_s > self._check_interval
+                                and rate < self._inter_rate_alert_mb_s
+                                and mb > 0.0))
+                    if slow and task.task_id not in self._alerted_tasks:
+                        self._alerted_tasks.add(task.task_id)
+                        LOG.warning(
+                            "task %s (%s) running for %.0fs at %.2f MB/s "
+                            "(alert thresholds: %.0fs / %.2f MB/s)",
+                            task.task_id, tp, age_s, rate,
+                            self._alert_threshold,
+                            self._inter_rate_alert_mb_s)
 
     # ------------------------------------------------------------------
     # phase 2: intra-broker (logdir) movement
@@ -465,7 +487,13 @@ class Executor:
                                 and old_dirs[r.broker_id] != r.logdir):
                             moves.setdefault(tp, {})[r.broker_id] = r.logdir
                 if moves:
+                    _t0 = self._time()
                     self._admin.alter_replica_log_dirs(moves)
+                    if self._time() - _t0 > self._logdir_timeout_s:
+                        LOG.warning(
+                            "alter_replica_log_dirs took %.1fs (> "
+                            "logdir.response.timeout.ms)",
+                            self._time() - _t0)
                 in_flight.extend(new_tasks)
             if not in_flight and not new_tasks:
                 if mgr.counts(TaskType.INTRA_BROKER_REPLICA_ACTION).pending \
@@ -497,6 +525,18 @@ class Executor:
                     # logdir move stalled beyond the idle budget
                     mgr.finish_task(task, TaskState.DEAD, now_ms)
                     in_flight.remove(task)
+                else:
+                    age_s = (now_ms - task.start_time_ms) / 1e3
+                    mb = p.intra_broker_data_to_move / 1e6
+                    if (age_s > self._check_interval and mb > 0.0
+                            and mb / age_s < self._intra_rate_alert_mb_s
+                            and task.task_id not in self._alerted_tasks):
+                        self._alerted_tasks.add(task.task_id)
+                        LOG.warning(
+                            "intra-broker task %s (%s) at %.2f MB/s, "
+                            "below the %.2f MB/s alerting floor",
+                            task.task_id, tp, mb / age_s,
+                            self._intra_rate_alert_mb_s)
 
     # ------------------------------------------------------------------
     # phase 3: leadership movement

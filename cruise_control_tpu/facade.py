@@ -109,6 +109,17 @@ class CruiseControl:
                  max_optimization_rounds: Optional[int] = None,
                  balancedness_weights: Tuple[float, float] = (1.1, 1.5),
                  allow_capacity_estimation: bool = True,
+                 allow_capacity_estimation_on_precompute: bool = True,
+                 options_generator=None,
+                 exclude_recently_demoted_brokers: bool = True,
+                 exclude_recently_removed_brokers: bool = True,
+                 detection_allow_capacity_estimation: bool = True,
+                 broker_failure_backoff_s: float = 300.0,
+                 broker_failure_fixable_max_count: int = 10,
+                 broker_failure_fixable_max_ratio: float = 0.4,
+                 failed_broker_store_path: Optional[str] = None,
+                 anomaly_classes: Optional[dict] = None,
+                 topic_config_provider=None,
                  time_fn: Optional[Callable[[], float]] = None,
                  sleep_fn: Optional[Callable[[float], None]] = None,
                  monitor_kwargs: Optional[dict] = None,
@@ -131,6 +142,35 @@ class CruiseControl:
         #: strictness.weight; defaults match AnalyzerConfig 1.1 / 1.5)
         self._balancedness_weights = balancedness_weights
         self._allow_capacity_estimation = allow_capacity_estimation
+        #: reference allow.capacity.estimation.on.proposal.precompute
+        self._allow_capacity_estimation_precompute = \
+            allow_capacity_estimation_on_precompute
+        #: per-request options post-processing (reference
+        #: optimization.options.generator.class + the
+        #: topics.excluded.from.partition.movement pattern it applies)
+        from cruise_control_tpu.analyzer.options_generator import (
+            DefaultOptimizationOptionsGenerator)
+        self._options_generator = (options_generator
+                                   or DefaultOptimizationOptionsGenerator())
+        #: self-healing exclusions (reference
+        #: self.healing.exclude.recently.{demoted,removed}.brokers)
+        self._exclude_recently_demoted = exclude_recently_demoted_brokers
+        self._exclude_recently_removed = exclude_recently_removed_brokers
+        self._detection_allow_capacity_estimation = \
+            detection_allow_capacity_estimation
+        self._broker_failure_backoff_s = broker_failure_backoff_s
+        self._broker_failure_fixable_max_count = \
+            broker_failure_fixable_max_count
+        self._broker_failure_fixable_max_ratio = \
+            broker_failure_fixable_max_ratio
+        self._failed_broker_store_path = failed_broker_store_path
+        #: anomaly class overrides (reference AnomalyDetectorConfig
+        #: {goal.violations,broker.failures,disk.failures,metric.anomaly}
+        #: .class keys)
+        self._anomaly_classes = dict(anomaly_classes or {})
+        from cruise_control_tpu.cluster.admin import AdminTopicConfigProvider
+        self.topic_config_provider = (topic_config_provider
+                                      or AdminTopicConfigProvider(admin))
 
         # construction order mirrors the reference facade :100-113
         self.load_monitor = LoadMonitor(
@@ -143,7 +183,8 @@ class CruiseControl:
         self.goal_optimizer = GoalOptimizer(
             default_goals(names=self._goal_names,
                           max_rounds=max_optimization_rounds),
-            self._constraint, balancedness_weights=balancedness_weights)
+            self._constraint, balancedness_weights=balancedness_weights,
+            auto_warmup=True)
         self._ple_optimizer = GoalOptimizer(
             [make_goal("PreferredLeaderElectionGoal")], self._constraint)
 
@@ -242,7 +283,8 @@ class CruiseControl:
             if self._cache_valid(generation):
                 return False
         try:
-            self.optimizations()
+            self.optimizations(_allow_capacity_estimation=(
+                self._allow_capacity_estimation_precompute))
             return True
         except Exception as exc:  # noqa: BLE001 - keep the loop alive
             LOG.warning("proposal precompute failed: %s", exc)
@@ -268,18 +310,30 @@ class CruiseControl:
         report = self.anomaly_detector.report
         metric_interval = (metric_interval if metric_interval is not None
                            else disk_interval)
+        from cruise_control_tpu.detector.broker_failure import (
+            FileFailedBrokerStore)
+        cls_of = self._anomaly_classes.get
         self.goal_violation_detector = GoalViolationDetector(
             self.load_monitor,
             default_goals(names=self._detection_goal_names,
                           max_rounds=self._max_rounds),  # separate instances
             report, fix_fn=self._heal_rebalance,
-            constraint=self._constraint, time_fn=self._time)
+            constraint=self._constraint, time_fn=self._time,
+            allow_capacity_estimation=(
+                self._detection_allow_capacity_estimation),
+            anomaly_cls=cls_of("goal.violations"))
         self.broker_failure_detector = BrokerFailureDetector(
             self._admin, report, fix_fn=self._heal_broker_failure,
-            time_fn=self._time)
+            time_fn=self._time,
+            store=(FileFailedBrokerStore(self._failed_broker_store_path)
+                   if self._failed_broker_store_path else None),
+            fixable_max_count=self._broker_failure_fixable_max_count,
+            fixable_max_ratio=self._broker_failure_fixable_max_ratio,
+            detection_backoff_s=self._broker_failure_backoff_s,
+            anomaly_cls=cls_of("broker.failures"))
         self.disk_failure_detector = DiskFailureDetector(
             self._admin, report, fix_fn=self._heal_offline_replicas,
-            time_fn=self._time)
+            time_fn=self._time, anomaly_cls=cls_of("disk.failures"))
         self.slow_broker_finder = SlowBrokerFinder(
             report, config=self._slow_broker_config, time_fn=self._time,
             demote_fix_fn=self._heal_slow_brokers_demote,
@@ -289,12 +343,13 @@ class CruiseControl:
         self.metric_anomaly_detector = MetricAnomalyDetector(
             self._broker_metric_history,
             self._metric_anomaly_finders or [PercentileMetricAnomalyFinder()],
-            report)
+            report, anomaly_cls=cls_of("metric.anomaly"))
         self.topic_anomaly_finder = TopicReplicationFactorAnomalyFinder(
             self._admin, report,
             target_replication_factor=self._topic_target_rf,
             min_isr_margin=self._topic_min_isr_margin,
-            time_fn=self._time)
+            time_fn=self._time,
+            topic_config_provider=self.topic_config_provider)
         self.anomaly_detector.register_detector(
             self.goal_violation_detector, gv_interval)
         self.anomaly_detector.register_detector(
@@ -316,9 +371,26 @@ class CruiseControl:
         st = self.load_monitor.get_state()
         return st.num_valid_windows > 0
 
+    def _self_healing_options(self) -> Optional[OptimizationOptions]:
+        """Exclusions for self-healing fixes (reference
+        self.healing.exclude.recently.{demoted,removed}.brokers via
+        AnomalyDetectorUtils): recently demoted brokers take no
+        leadership, recently removed brokers take no replicas."""
+        excl_lead = (frozenset(self.executor.recently_demoted_brokers())
+                     if self._exclude_recently_demoted else frozenset())
+        excl_move = (frozenset(self.executor.recently_removed_brokers())
+                     if self._exclude_recently_removed else frozenset())
+        if not excl_lead and not excl_move:
+            return None
+        return OptimizationOptions(
+            excluded_brokers_for_leadership=excl_lead,
+            excluded_brokers_for_replica_move=excl_move,
+            is_triggered_by_goal_violation=True)
+
     def _heal_rebalance(self) -> bool:
         try:
             result = self.rebalance(dryrun=False,
+                                    options=self._self_healing_options(),
                                     reason="self-healing: goal violation")
             return result.execution_uuid is not None
         except Exception:  # noqa: BLE001 - healing failure is handled
@@ -381,17 +453,22 @@ class CruiseControl:
     # model + proposals
     # ------------------------------------------------------------------
     def cluster_model(self, requirements: Optional[
-            ModelCompletenessRequirements] = None):
+            ModelCompletenessRequirements] = None,
+            allow_capacity_estimation: Optional[bool] = None):
+        if allow_capacity_estimation is None:
+            allow_capacity_estimation = self._allow_capacity_estimation
         with self.load_monitor.acquire_for_model_generation(), \
                 self.metrics.timer("cluster-model-creation-timer").time():
             return self.load_monitor.cluster_model(
                 requirements,
-                allow_capacity_estimation=self._allow_capacity_estimation)
+                allow_capacity_estimation=allow_capacity_estimation)
 
     def optimizations(self,
                       goals: Optional[Sequence[str]] = None,
                       options: Optional[OptimizationOptions] = None,
-                      ignore_proposal_cache: bool = False) -> OptimizerResult:
+                      ignore_proposal_cache: bool = False,
+                      _allow_capacity_estimation: Optional[bool] = None
+                      ) -> OptimizerResult:
         """Proposals for the current cluster model.  The cache is only used
         for the default goal list with default options and is invalidated
         when the model generation moves (reference
@@ -408,9 +485,12 @@ class CruiseControl:
         optimizer = (self.goal_optimizer if goals is None
                      else GoalOptimizer(default_goals(names=list(goals)),
                                         self._constraint))
-        state, topo = self.cluster_model()
+        state, topo = self.cluster_model(
+            allow_capacity_estimation=_allow_capacity_estimation)
         with self.metrics.timer("proposal-computation-timer").time():
-            result = optimizer.optimizations(state, topo, options)
+            result = optimizer.optimizations(
+                state, topo, self._options_generator.generate(
+                    options or OptimizationOptions(), topo))
         if cacheable:
             with self._cache_lock:
                 # drop the result if the cache was invalidated while the

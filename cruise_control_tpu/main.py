@@ -30,8 +30,8 @@ LOG = logging.getLogger(__name__)
 
 #: Java-style `key=value` properties file with ${env:NAME} secret
 #: resolution (reference readConfig + EnvConfigProvider)
-from cruise_control_tpu.common.config import \
-    load_properties as read_properties  # noqa: E402
+from cruise_control_tpu.common.config import (  # noqa: E402
+    ConfigException, load_properties as read_properties)
 
 
 def build_constraint(config: CruiseControlConfig):
@@ -198,6 +198,34 @@ def build_cruise_control(config: CruiseControlConfig, admin,
     default_goal_names, detection_goals, self_healing_goals, intra_goals = \
         _goal_lists(config)
     max_movements = config.get_long("max.num.cluster.movements")
+    from cruise_control_tpu.analyzer.options_generator import (
+        DefaultOptimizationOptionsGenerator, OptimizationOptionsGenerator)
+    excluded_pattern = config.get(
+        "topics.excluded.from.partition.movement") or ""
+    gen_cls = resolve_class(config.get(
+        "optimization.options.generator.class"))
+    if gen_cls is DefaultOptimizationOptionsGenerator:
+        options_generator = DefaultOptimizationOptionsGenerator(
+            excluded_pattern)
+    else:
+        options_generator = config.get_configured_instance(
+            "optimization.options.generator.class",
+            OptimizationOptionsGenerator)
+    anomaly_classes = {
+        "goal.violations": resolve_class(config.get("goal.violations.class")),
+        "broker.failures": resolve_class(config.get("broker.failures.class")),
+        "disk.failures": resolve_class(config.get("disk.failures.class")),
+        "metric.anomaly": resolve_class(config.get("metric.anomaly.class"))}
+    from cruise_control_tpu.cluster.admin import (AdminTopicConfigProvider,
+                                                  TopicConfigProvider)
+    topic_config_provider = config.get_configured_instance(
+        "topic.config.provider.class", TopicConfigProvider)
+    if isinstance(topic_config_provider, AdminTopicConfigProvider):
+        topic_config_provider.bind(admin)
+    cpu_weights = (
+        config.get_double("leader.network.inbound.weight.for.cpu.util"),
+        config.get_double("leader.network.outbound.weight.for.cpu.util"),
+        config.get_double("follower.network.inbound.weight.for.cpu.util"))
     return CruiseControl(
         admin, sampler,
         capacity_resolver=resolver,
@@ -231,6 +259,25 @@ def build_cruise_control(config: CruiseControlConfig, admin,
             config.get_double("goal.balancedness.strictness.weight")),
         allow_capacity_estimation=config.get_boolean(
             "allow.capacity.estimation.on.proposal"),
+        allow_capacity_estimation_on_precompute=config.get_boolean(
+            "allow.capacity.estimation.on.proposal.precompute"),
+        options_generator=options_generator,
+        exclude_recently_demoted_brokers=config.get_boolean(
+            "self.healing.exclude.recently.demoted.brokers"),
+        exclude_recently_removed_brokers=config.get_boolean(
+            "self.healing.exclude.recently.removed.brokers"),
+        detection_allow_capacity_estimation=config.get_boolean(
+            "anomaly.detection.allow.capacity.estimation"),
+        broker_failure_backoff_s=config.get_long(
+            "broker.failure.detection.backoff.ms") / 1e3,
+        broker_failure_fixable_max_count=config.get_int(
+            "fixable.failed.broker.count.threshold"),
+        broker_failure_fixable_max_ratio=config.get_double(
+            "fixable.failed.broker.percentage.threshold"),
+        failed_broker_store_path=(
+            config.get("failed.brokers.zk.path") or None),
+        anomaly_classes=anomaly_classes,
+        topic_config_provider=topic_config_provider,
         proposal_expiration_s=config.get_long(
             "proposal.expiration.ms") / 1e3,
         proposal_precompute_interval_s=config.get_long(
@@ -256,7 +303,26 @@ def build_cruise_control(config: CruiseControlConfig, admin,
             allow_cpu_capacity_estimation=config.get_boolean(
                 "sampling.allow.cpu.capacity.estimation"),
             state_update_interval_ms=config.get_long(
-                "monitor.state.update.interval.ms")),
+                "monitor.state.update.interval.ms"),
+            completeness_cache_size=config.get_int(
+                "partition.metric.sample.aggregator.completeness.cache.size"
+            ),
+            broker_completeness_cache_size=config.get_int(
+                "broker.metric.sample.aggregator.completeness.cache.size"),
+            min_valid_partition_ratio=config.get_double(
+                "min.valid.partition.ratio"),
+            partition_assignor=config.get_configured_instance(
+                "metric.sampler.partition.assignor.class"),
+            use_linear_regression_model=config.get_boolean(
+                "use.linear.regression.model"),
+            linear_regression_kwargs=dict(
+                cpu_util_bucket_size_pct=config.get_int(
+                    "linear.regression.model.cpu.util.bucket.size"),
+                min_num_cpu_util_buckets=config.get_int(
+                    "linear.regression.model.min.num.cpu.util.buckets"),
+                required_samples_per_bucket=config.get_int(
+                    "linear.regression.model.required.samples.per.bucket")),
+            cpu_util_weights=cpu_weights),
         executor_kwargs=dict(
             concurrent_inter_broker_moves_per_broker=config.get_int(
                 "num.concurrent.partition.movements.per.broker"),
@@ -272,6 +338,12 @@ def build_cruise_control(config: CruiseControlConfig, admin,
                 "task.execution.alerting.threshold.ms") / 1e3,
             leader_movement_timeout_s=config.get_long(
                 "leader.movement.timeout.ms") / 1e3,
+            inter_rate_alert_threshold_mb_s=config.get_double(
+                "inter.broker.replica.movement.rate.alerting.threshold"),
+            intra_rate_alert_threshold_mb_s=config.get_double(
+                "intra.broker.replica.movement.rate.alerting.threshold"),
+            logdir_response_timeout_s=config.get_long(
+                "logdir.response.timeout.ms") / 1e3,
             removal_history_retention_s=config.get_long(
                 "removal.history.retention.time.ms") / 1e3,
             demotion_history_retention_s=config.get_long(
@@ -295,12 +367,34 @@ def build_security(config: CruiseControlConfig):
     configured-instance hook (no-arg constructor + optional
     `configure(props)`)."""
     from cruise_control_tpu.api.security import (JwtSecurityProvider,
-                                                 SecurityProvider)
+                                                 SecurityProvider,
+                                                 TrustedProxySecurityProvider)
     from cruise_control_tpu.common.config import resolve_class
 
+    if (config.get("spnego.keytab.file")
+            or config.get("spnego.principal")
+            or "spnego" in (config.get("webserver.security.provider")
+                            or "").lower()):
+        # SPNEGO/Kerberos termination is a documented non-goal: terminate
+        # Kerberos at a fronting proxy and use the TrustedProxy provider
+        # (docs/DECISIONS.md §SPNEGO)
+        raise ConfigException(
+            "SPNEGO is not terminated in-process: terminate Kerberos at a "
+            "proxy and configure TrustedProxySecurityProvider with "
+            "trusted.proxy.services / trusted.proxy.services.ip.regex "
+            "(decision record: docs/DECISIONS.md)")
     if not config.get_boolean("webserver.security.enable"):
         return NoSecurityProvider()
     cls = resolve_class(config.get("webserver.security.provider"))
+    if cls is TrustedProxySecurityProvider:
+        creds = config.get("webserver.auth.credentials.file")
+        inner = (BasicSecurityProvider.from_credentials_file(creds)
+                 if creds else NoSecurityProvider())
+        return TrustedProxySecurityProvider(
+            inner,
+            trusted_proxies=[s for s in config.get_list(
+                "trusted.proxy.services") if s],
+            ip_regex=config.get("trusted.proxy.services.ip.regex") or None)
     # convenience: JWT keys present with the provider key left at its
     # default select the JWT provider (an EXPLICIT provider choice wins)
     explicit = "webserver.security.provider" in config.originals
@@ -313,7 +407,10 @@ def build_security(config: CruiseControlConfig):
     if cls is JwtSecurityProvider:
         jwt_secret = config.get("webserver.security.jwt.secret")
         jwt_secret = getattr(jwt_secret, "value", jwt_secret) or ""
-        jwt_pub = config.get("webserver.security.jwt.public.key.location")
+        # jwt.auth.certificate.location is the reference-compat alias of
+        # the public-key location
+        jwt_pub = (config.get("webserver.security.jwt.public.key.location")
+                   or config.get("jwt.auth.certificate.location"))
         pem = None
         if jwt_pub:
             with open(jwt_pub, "rb") as f:
@@ -322,7 +419,11 @@ def build_security(config: CruiseControlConfig):
             hs256_secret=jwt_secret.encode() if jwt_secret else None,
             rs256_public_key_pem=pem,
             issuer=config.get("webserver.security.jwt.issuer") or None,
-            audience=config.get("webserver.security.jwt.audience") or None)
+            audience=config.get("webserver.security.jwt.audience") or None,
+            audiences=[a for a in config.get_list("jwt.expected.audiences")
+                       if a],
+            cookie_name=config.get("jwt.cookie.name") or None,
+            login_url=config.get("jwt.authentication.provider.url") or None)
     if cls is BasicSecurityProvider:
         creds = config.get("webserver.auth.credentials.file")
         return (BasicSecurityProvider.from_credentials_file(creds)
@@ -341,16 +442,52 @@ def build_ssl_context(config: CruiseControlConfig):
     if not cert:
         raise ValueError("webserver.ssl.enable requires "
                          "webserver.ssl.keystore.location")
+    ks_type = (config.get("webserver.ssl.keystore.type") or "PEM").upper()
+    if ks_type not in ("PEM", ""):
+        raise ValueError(
+            f"webserver.ssl.keystore.type={ks_type!r}: only PEM keystores "
+            f"are supported (convert JKS/PKCS12 with `openssl pkcs12`)")
     password = config.get("webserver.ssl.key.password")
     password = getattr(password, "value", password) or None
+    if not password:
+        ks_password = config.get("webserver.ssl.keystore.password")
+        password = getattr(ks_password, "value", ks_password) or None
     return make_server_ssl_context(
         cert, keyfile=config.get("webserver.ssl.keyfile.location") or None,
-        key_password=password)
+        key_password=password,
+        protocol=config.get("webserver.ssl.protocol") or "TLS")
 
 
 def build_app(config: CruiseControlConfig,
               cruise_control: CruiseControl) -> CruiseControlApp:
+    from cruise_control_tpu.api.request_registry import (
+        resolve_endpoint_classes)
     security = build_security(config)
+
+    retention_keys = {
+        "kafka.admin": "completed.kafka.admin.user.task.retention.time.ms",
+        "kafka.monitor":
+            "completed.kafka.monitor.user.task.retention.time.ms",
+        "cruise.control.admin":
+            "completed.cruise.control.admin.user.task.retention.time.ms",
+        "cruise.control.monitor":
+            "completed.cruise.control.monitor.user.task.retention.time.ms"}
+    cached_keys = {
+        "kafka.admin": "max.cached.completed.kafka.admin.user.tasks",
+        "kafka.monitor": "max.cached.completed.kafka.monitor.user.tasks",
+        "cruise.control.admin":
+            "max.cached.completed.cruise.control.admin.user.tasks",
+        "cruise.control.monitor":
+            "max.cached.completed.cruise.control.monitor.user.tasks"}
+
+    def _cat_map(keys: dict, getter, scale: float = 1.0) -> dict:
+        out = {}
+        for cat, key in keys.items():
+            v = getter(key)
+            if v is not None and v >= 0:
+                out[cat] = v * scale
+        return out
+
     return CruiseControlApp(
         cruise_control, security=security,
         two_step_verification=config.get_boolean(
@@ -369,10 +506,23 @@ def build_app(config: CruiseControlConfig,
             max_cached_completed_tasks=config.get_int(
                 "max.cached.completed.user.tasks"),
             attach_max_age_s=config.get_long(
-                "webserver.session.maxExpiryPeriodMs") / 1e3),
+                "webserver.session.maxExpiryTimeMs") / 1e3,
+            category_retention_s=_cat_map(retention_keys,
+                                          config.get_long, 1e-3),
+            category_max_cached=_cat_map(cached_keys, config.get_int)),
         cors_enabled=config.get_boolean("webserver.http.cors.enabled"),
         cors_origin=config.get("webserver.http.cors.origin") or "*",
-        url_prefix=config.get("webserver.api.urlprefix") or None)
+        cors_allow_methods=config.get(
+            "webserver.http.cors.allowmethods") or "OPTIONS, GET, POST",
+        cors_expose_headers=config.get(
+            "webserver.http.cors.exposeheaders") or "User-Task-ID",
+        url_prefix=config.get("webserver.api.urlprefix") or None,
+        endpoint_classes=resolve_endpoint_classes(config),
+        request_reason_required=config.get_boolean(
+            "request.reason.required"),
+        session_path=config.get("webserver.session.path") or "/",
+        ui_diskpath=config.get("webserver.ui.diskpath") or "",
+        ui_urlprefix=config.get("webserver.ui.urlprefix") or "/ui")
 
 
 def main(argv=None) -> int:
@@ -392,6 +542,24 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     config = CruiseControlConfig(read_properties(args.config))
+
+    # route the NCSA access log to its own rotated file
+    # (reference webserver.accesslog.{path,retention.days})
+    accesslog_path = config.get("webserver.accesslog.path")
+    if accesslog_path and config.get_boolean("webserver.accesslog.enabled"):
+        import logging.handlers
+        handler = logging.handlers.TimedRotatingFileHandler(
+            accesslog_path, when="D",
+            backupCount=config.get_int("webserver.accesslog.retention.days"))
+        handler.setFormatter(logging.Formatter("%(message)s"))
+        access = logging.getLogger("accessLogger")
+        access.addHandler(handler)
+        access.propagate = False
+    if config.get_boolean("zookeeper.security.enabled"):
+        LOG.info("zookeeper.security.enabled is a reference-compat flag: "
+                 "this framework has no ZooKeeper; cluster authentication "
+                 "is the ClusterAdminClient implementation's "
+                 "responsibility (docs/DECISIONS.md)")
 
     if args.demo_cluster:
         from cruise_control_tpu.cluster.simulated import SimulatedCluster
@@ -416,8 +584,12 @@ def main(argv=None) -> int:
         admin_cls = config.get("cluster.admin.class") \
             if "cluster.admin.class" in config.originals else None
         if not admin_cls:
+            # reference-compat alias (network.client.provider.class)
+            admin_cls = config.get("network.client.provider.class") or None
+        if not admin_cls:
             print("error: provide --demo-cluster or set "
-                  "cluster.admin.class to a ClusterAdminClient "
+                  "cluster.admin.class (or its reference-compat alias "
+                  "network.client.provider.class) to a ClusterAdminClient "
                   "implementation for your infrastructure",
                   file=sys.stderr)
             return 2

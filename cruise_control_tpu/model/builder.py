@@ -40,15 +40,26 @@ def _load_vector(load: LoadLike) -> np.ndarray:
     return vec.copy()
 
 
-def estimate_follower_cpu(leader_cpu, leader_nw_in, leader_nw_out):
+def estimate_follower_cpu(leader_cpu, leader_nw_in, leader_nw_out,
+                          leader_in_weight: float = None,
+                          leader_out_weight: float = None,
+                          follower_in_weight: float = None):
     """Follower CPU estimated from the leader's load; scalar- and
     array-compatible (reference model/ModelUtils.java:54-71 with the static
-    coefficients of ModelParameters.java:22-30)."""
-    denom = (CPU_WEIGHT_LEADER_BYTES_IN * np.asarray(leader_nw_in, np.float64)
-             + CPU_WEIGHT_LEADER_BYTES_OUT * np.asarray(leader_nw_out, np.float64))
+    coefficients of ModelParameters.java:22-30).  The weights default to
+    the module constants and are overridable from config
+    ({leader,follower}.network.{in,out}bound.weight.for.cpu.util)."""
+    lw_in = (CPU_WEIGHT_LEADER_BYTES_IN if leader_in_weight is None
+             else leader_in_weight)
+    lw_out = (CPU_WEIGHT_LEADER_BYTES_OUT if leader_out_weight is None
+              else leader_out_weight)
+    fw_in = (CPU_WEIGHT_FOLLOWER_BYTES_IN if follower_in_weight is None
+             else follower_in_weight)
+    denom = (lw_in * np.asarray(leader_nw_in, np.float64)
+             + lw_out * np.asarray(leader_nw_out, np.float64))
     est = np.where(denom > 0.0,
                    np.asarray(leader_cpu, np.float64)
-                   * CPU_WEIGHT_FOLLOWER_BYTES_IN
+                   * fw_in
                    * np.asarray(leader_nw_in, np.float64)
                    / np.maximum(denom, 1e-300),
                    0.0)

@@ -42,10 +42,36 @@ class LinearRegressionCpuModel:
 
     MIN_SAMPLES = 8
 
-    def __init__(self) -> None:
+    def __init__(self, cpu_util_bucket_size_pct: int = 5,
+                 min_num_cpu_util_buckets: int = 5,
+                 required_samples_per_bucket: int = 10) -> None:
         self._lock = threading.Lock()
         self._rows: list = []
         self._coefficients: Optional[CpuModelCoefficients] = None
+        #: training-readiness knobs (reference
+        #: linear.regression.model.cpu.util.bucket.size /
+        #: .min.num.cpu.util.buckets / .required.samples.per.bucket:
+        #: samples are bucketed by CPU utilization and the fit waits for
+        #: coverage, so one load level cannot dominate the coefficients)
+        self._bucket_size_pct = max(1, cpu_util_bucket_size_pct)
+        self._min_buckets = max(1, min_num_cpu_util_buckets)
+        self._required_per_bucket = max(1, required_samples_per_bucket)
+
+    def training_coverage(self) -> tuple:
+        """(filled buckets, required buckets) — a bucket counts once it
+        holds required_samples_per_bucket samples."""
+        from collections import Counter
+        with self._lock:
+            counts = Counter(int(r[0] // self._bucket_size_pct)
+                             for r in self._rows)
+        filled = sum(1 for c in counts.values()
+                     if c >= self._required_per_bucket)
+        return filled, self._min_buckets
+
+    @property
+    def ready_to_train(self) -> bool:
+        filled, need = self.training_coverage()
+        return filled >= need
 
     # ------------------------------------------------------------------
     def add_sample(self, cpu_pct: float, leader_bytes_in: float,

@@ -110,6 +110,13 @@ class LoadMonitor:
                  max_allowed_extrapolations_per_broker: int = 5,
                  allow_cpu_capacity_estimation: bool = True,
                  state_update_interval_ms: float = 0.0,
+                 completeness_cache_size: int = 5,
+                 broker_completeness_cache_size: int = 5,
+                 min_valid_partition_ratio: float = 0.0,
+                 partition_assignor=None,
+                 use_linear_regression_model: bool = True,
+                 linear_regression_kwargs: Optional[dict] = None,
+                 cpu_util_weights: Optional[tuple] = None,
                  time_fn: Callable[[], float] = time.time):
         self._admin = admin
         self._metadata = MetadataClient(admin, metadata_ttl_ms, time_fn)
@@ -118,10 +125,15 @@ class LoadMonitor:
         self._sample_store = sample_store
         self._time_fn = time_fn
         self._partition_aggregator = PartitionMetricSampleAggregator(
-            num_windows, int(window_ms), min_samples_per_window)
+            num_windows, int(window_ms), min_samples_per_window,
+            completeness_cache_size=completeness_cache_size)
         self._broker_aggregator = BrokerMetricSampleAggregator(
             broker_num_windows, int(broker_window_ms or window_ms),
-            broker_min_samples_per_window)
+            broker_min_samples_per_window,
+            completeness_cache_size=broker_completeness_cache_size)
+        #: default monitored-partition completeness when a request names
+        #: none (reference min.valid.partition.ratio)
+        self._min_valid_partition_ratio = min_valid_partition_ratio
         #: aggregation extrapolation caps (reference
         #: max.allowed.extrapolations.per.{partition,broker})
         self._max_extrapolations_partition = \
@@ -139,7 +151,8 @@ class LoadMonitor:
         self._state_cache_at = -1e18
         self._fetcher = MetricFetcherManager(
             sampler, self._partition_aggregator, self._broker_aggregator,
-            sample_store, num_fetchers)
+            sample_store, num_fetchers,
+            partition_assignor=partition_assignor)
         self.task_runner = LoadMonitorTaskRunner(
             self._metadata, self._fetcher, sampling_interval_ms, time_fn)
         # reference: cluster-model-creation semaphore
@@ -153,7 +166,18 @@ class LoadMonitor:
         self._disk_id = cdef.metric_id(MD.DISK_USAGE)
         #: trainable CPU attribution model (reference TRAIN endpoint +
         #: LinearRegressionModelParameters)
-        self.cpu_model = LinearRegressionCpuModel()
+        self.cpu_model = LinearRegressionCpuModel(
+            **(linear_regression_kwargs or {}))
+        #: reference use.linear.regression.model (config default False,
+        #: per the reference): when False the trained model is kept (TRAIN
+        #: still works) but model building sticks to the static
+        #: coefficients.  The CONSTRUCTOR default stays True so direct
+        #: embedders keep the train-then-use behavior
+        self._use_linear_regression = use_linear_regression_model
+        #: static CPU attribution weights (reference
+        #: {leader,follower}.network.{in,out}bound.weight.for.cpu.util,
+        #: ModelParameters.java:22-30); None = module defaults
+        self._cpu_util_weights = cpu_util_weights
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -297,7 +321,9 @@ class LoadMonitor:
                       ) -> Tuple[ClusterState, ClusterTopology]:
         """Build the tensor cluster model
         (reference LoadMonitor.clusterModel :518-570)."""
-        req = requirements or ModelCompletenessRequirements()
+        req = requirements or ModelCompletenessRequirements(
+            min_monitored_partitions_percentage=(
+                self._min_valid_partition_ratio))
         now_ms = now_ms if now_ms is not None else self._time_fn() * 1000.0
         t0 = time.time()
         snapshot = self._metadata.refresh_metadata()
@@ -317,7 +343,8 @@ class LoadMonitor:
         # one read: per-partition consistency + no per-partition locking;
         # the builder's leader-load split must use the same follower-CPU
         # attribution as the follower loads assigned below
-        coefs = self.cpu_model.coefficients   # None until TRAINed
+        coefs = (self.cpu_model.coefficients
+                 if self._use_linear_regression else None)
         if coefs is not None:
             # clamped to [0, leader CPU] so a noisy fit cannot attribute a
             # follower more CPU than its leader uses — keeps follower loads
@@ -325,6 +352,14 @@ class LoadMonitor:
             follower_cpu = (lambda cpu, nw_in, nw_out:
                             min(max(coefs.estimate_follower_cpu(nw_in), 0.0),
                                 float(cpu)))
+        elif self._cpu_util_weights is not None:
+            lw_in, lw_out, fw_in = self._cpu_util_weights
+            follower_cpu = (lambda cpu, nw_in, nw_out:
+                            estimate_follower_cpu(
+                                cpu, nw_in, nw_out,
+                                leader_in_weight=lw_in,
+                                leader_out_weight=lw_out,
+                                follower_in_weight=fw_in))
         else:
             follower_cpu = estimate_follower_cpu
         builder = ClusterModelBuilder(follower_cpu_estimator=follower_cpu)

@@ -34,6 +34,27 @@ def assign_partitions(partitions: Sequence[TopicPartition],
     return buckets
 
 
+class PartitionAssignor:
+    """SPI: distribute partitions across fetchers (reference
+    MetricSamplerPartitionAssignor, wired by
+    `metric.sampler.partition.assignor.class`)."""
+
+    def configure(self, props) -> None:  # pragma: no cover - plugin hook
+        """Config hook for get_configured_instance."""
+
+    def assign(self, partitions: Sequence[TopicPartition],
+               num_fetchers: int) -> List[Set[TopicPartition]]:
+        raise NotImplementedError
+
+
+class DefaultPartitionAssignor(PartitionAssignor):
+    """Hash-bucket assignment (the module-level assign_partitions)."""
+
+    def assign(self, partitions: Sequence[TopicPartition],
+               num_fetchers: int) -> List[Set[TopicPartition]]:
+        return assign_partitions(partitions, num_fetchers)
+
+
 class MetricFetcherManager:
     """Drives sampling rounds (reference MetricFetcherManager.java:1-224)."""
 
@@ -42,12 +63,14 @@ class MetricFetcherManager:
                  broker_aggregator: BrokerMetricSampleAggregator,
                  sample_store: Optional[SampleStore] = None,
                  num_fetchers: int = 1,
-                 sampling_timeout_s: float = 60.0):
+                 sampling_timeout_s: float = 60.0,
+                 partition_assignor: "PartitionAssignor" = None):
         self._sampler = sampler
         self._partition_aggregator = partition_aggregator
         self._broker_aggregator = broker_aggregator
         self._sample_store = sample_store
         self._num_fetchers = max(1, num_fetchers)
+        self._assignor = partition_assignor or DefaultPartitionAssignor()
         self._timeout_s = sampling_timeout_s
         self._pool = ThreadPoolExecutor(
             max_workers=self._num_fetchers,
@@ -65,7 +88,8 @@ class MetricFetcherManager:
         t0 = time.time()
         partitions = [p.tp for p in cluster.partitions]
         buckets = [b for b in
-                   assign_partitions(partitions, self._num_fetchers) if b]
+                   self._assignor.assign(partitions,
+                                         self._num_fetchers) if b]
         if not buckets:
             # no partitions yet — still collect broker metrics so
             # broker-level detection isn't blind on an empty cluster

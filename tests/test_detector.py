@@ -287,7 +287,7 @@ class TestGoalViolationDetectorEndToEnd:
         state, topo = unbalanced_cluster()
 
         class FakeMonitor:
-            def cluster_model(self):
+            def cluster_model(self, **kwargs):
                 return state, topo
 
         reports = []
