@@ -9,10 +9,22 @@ replica axis lowers to per-shard partial sums + an all-reduce over ICI —
 XLA inserts the collectives (psum pattern) from the sharding annotations
 alone, which is the whole point of the pjit design: no hand-written
 communication.
+
+The HOT steady-state path, however, is not the [R] arrays but the
+resident per-broker tables (RoundCache.broker_table [B, S] and its aux
+planes — see context.py): those shard along the BROKER axis over the
+same 1-D mesh (different arrays, same devices), so per-round candidate
+selection (row reductions, top-k) and the [C, K] assignment planes are
+broker-parallel while the small [B] accounting vectors all-reduce over
+ICI.  `solver_mesh(mesh)` activates these constraints inside the round
+kernels (they are no-ops off-mesh); the constraint surface is
+`constrain(...)` below.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import threading
 from typing import Optional
 
 import jax
@@ -23,6 +35,58 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from cruise_control_tpu.model.state import ClusterState
 
 REPLICA_AXIS = "replica"
+
+_ACTIVE = threading.local()
+
+
+@contextlib.contextmanager
+def solver_mesh(mesh: Mesh):
+    """Activate broker/replica-axis sharding constraints inside the round
+    kernels traced under this context (thread-local; trace-time only)."""
+    prev = getattr(_ACTIVE, "mesh", None)
+    _ACTIVE.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _ACTIVE.mesh = prev
+
+
+def active_mesh() -> Optional[Mesh]:
+    return getattr(_ACTIVE, "mesh", None)
+
+
+def constrain(x: jax.Array, *spec) -> jax.Array:
+    """with_sharding_constraint(x, P(*spec)) against the active solver
+    mesh; identity when no mesh is active.  Use axis position 0 =
+    REPLICA_AXIS for both replica-major [R, ...] arrays and broker-major
+    [B, S, ...] table planes — they shard over the same 1-D device axis."""
+    mesh = active_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec)))
+
+
+def constrain_cache(cache):
+    """Apply the table-plane sharding constraints to a RoundCache: the
+    [B, S, ...] resident tables shard on the broker axis (the hot-path
+    layout — round-2's gather-resident redesign moved steady-state work
+    onto these planes, so replicating them would serialize every round);
+    [R]-sized arrays shard on the replica axis; the small [B]-sized
+    accounting vectors replicate (they are all-reduced each round)."""
+    if active_mesh() is None:
+        return cache
+    ax = REPLICA_AXIS
+    return dataclasses.replace(
+        cache,
+        replica_load=constrain(cache.replica_load, ax, None),
+        broker_table=constrain(cache.broker_table, ax, None),
+        table_fill=constrain(cache.table_fill, ax),
+        table_load=constrain(cache.table_load, ax, None, None),
+        table_bonus=constrain(cache.table_bonus, ax, None, None),
+        table_leader=constrain(cache.table_leader, ax, None),
+        table_ok=constrain(cache.table_ok, ax, None),
+    )
 
 
 def make_mesh(devices=None) -> Mesh:

@@ -1,0 +1,84 @@
+"""Virtual-mesh scaling of the hot round path.
+
+Measures steady-state round wall-clock of the flagship goal kernels at a
+fixed model size while the device count grows (1 → N virtual CPU
+devices), with the broker-table planes sharded via
+parallel.mesh.solver_mesh.  CPU collectives are memcpys, so the numbers
+are a LAYOUT check (does the sharded program partition the work and
+execute, and does per-round time not explode with device count), not an
+ICI-bandwidth projection — real multi-chip hardware is unavailable here
+(see PARITY.md §multi-chip scaling for the recorded table).
+
+Usage: python tools/bench_mesh_scaling.py [replicas] [devices...]
+"""
+import os
+import sys
+import time
+
+DEVICES = [int(d) for d in sys.argv[2:]] or [1, 2, 4, 8]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={max(DEVICES)}")
+
+import jax  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from cruise_control_tpu.analyzer.context import (  # noqa: E402
+    BalancingConstraint, OptimizationOptions, make_context)
+from cruise_control_tpu.analyzer.goals.registry import (  # noqa: E402
+    default_goals)
+from cruise_control_tpu.parallel.mesh import (  # noqa: E402
+    make_mesh, shard_state, solver_mesh, state_shardings)
+from cruise_control_tpu.testing.random_cluster import (  # noqa: E402
+    RandomClusterSpec, random_cluster)
+
+
+def main() -> None:
+    num_r = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
+    num_p = num_r // 3
+    num_b = max(16, num_r // 230)
+    state0, topo = random_cluster(RandomClusterSpec(
+        num_brokers=num_b, num_partitions=num_p, replication_factor=3,
+        num_racks=8, num_topics=12, seed=7, skew_fraction=0.2))
+    rounds = int(os.environ.get("SCALING_ROUNDS", "24"))
+    goals = default_goals(max_rounds=rounds, names=[
+        "DiskUsageDistributionGoal", "CpuUsageDistributionGoal",
+        "LeaderReplicaDistributionGoal"])
+
+    def step(st, c):
+        for i, goal in enumerate(goals):
+            st = goal.optimize(st, c, tuple(goals[:i]))
+        return st
+
+    print(f"# model: B={num_b} P={num_p} R={state0.num_replicas} "
+          f"goals={[g.name for g in goals]} rounds<={rounds}")
+    base_s = None
+    for n in DEVICES:
+        mesh = make_mesh(jax.devices()[:n])
+        sharded = shard_state(state0, mesh)
+        ctx = make_context(sharded, BalancingConstraint(),
+                           OptimizationOptions(), topo)
+        with solver_mesh(mesh):
+            fn = jax.jit(step, in_shardings=(
+                state_shardings(sharded, mesh), None))
+            with mesh:
+                t0 = time.time()
+                out = fn(sharded, ctx)
+                jax.block_until_ready(out.replica_broker)
+                compile_s = time.time() - t0
+                best = float("inf")
+                for _ in range(2):
+                    t0 = time.time()
+                    out = fn(sharded, ctx)
+                    jax.block_until_ready(out.replica_broker)
+                    best = min(best, time.time() - t0)
+        base_s = base_s or best
+        print(f"devices={n}: run={best:.2f}s (compile+first {compile_s:.1f}s)"
+              f" speedup_vs_1dev={base_s / best:.2f}x")
+
+
+if __name__ == "__main__":
+    main()
