@@ -216,8 +216,21 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
         avg = self._avg(state, counts0)
         lower, upper = _count_bounds(avg, self.pct_margin)
         base_movable = replica_static_ok(state, ctx)
+        movable_all = base_movable
         dest_ok = new_broker_dest_mask(
             state, ctx.broker_dest_ok & state.broker_alive)
+
+        def _bonus_util_rows(st, cache):
+            """[B, S] combined CPU+NW_OUT leadership bonus per slot in
+            utilization units — the cost a transfer imposes on the
+            prior goals' band floors."""
+            from cruise_control_tpu.common.resources import Resource
+            cap = jnp.maximum(st.broker_capacity, 1e-9)
+            cpu = int(Resource.CPU)
+            nwo = int(Resource.NW_OUT)
+            per_b = (cache.table_bonus[:, :, cpu] / cap[:, None, cpu]
+                     + cache.table_bonus[:, :, nwo] / cap[:, None, nwo])
+            return per_b
 
         def phase_transfer(st, cache):
             counts = self._counts(cache)
@@ -234,16 +247,70 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
             value_rows = cache.table_leader.astype(jnp.float32)
             lt_d, lt_s = leadership_commit_terms(prev_goals, st, ctx,
                                                  cache)
+            # rank sheds by SMALLEST resource bonus: every transfer counts
+            # 1 toward this goal, but cheap-bonus handoffs are the ones
+            # the prior goals' band floors (src load - bonus >= lower)
+            # still accept — shedding expensive leaderships first runs
+            # into the floor and stalls the phase
+            src_ok_b = counts > upper
+            rank_rows = jnp.where(
+                cache.table_ok & cache.table_leader & src_ok_b[:, None],
+                -_bonus_util_rows(st, cache), kernels.NEG)
             cand_r, cand_f, cand_v = kernels.leadership_round(
                 st, bonus, counts - upper, movable, ctx.broker_leader_ok,
                 upper - counts, accept_all, -counts, ctx.partition_replicas,
                 cache=cache,
-                bonus_rows=leader_shed_rows(cache, value_rows,
-                                            counts > upper,
-                                            counts - upper),
+                bonus_rows=rank_rows,
                 value_rows=value_rows,
                 dest_terms=lt_d, src_terms=lt_s,
                 dest_stack_headroom=avg - counts)
+            st, cache = kernels.commit_leadership_cached(st, cache, cand_r,
+                                                         cand_f, cand_v)
+            return st, cache, jnp.any(cand_v)
+
+        def phase_refuel(st, cache):
+            """Escape hatch for floor-blocked over-count brokers: pull
+            HIGH-bonus leaderships from in-band donors INTO them.  An
+            over-count broker whose load sits at a prior goal's band
+            floor cannot shed any leadership (src - bonus < lower is
+            vetoed); importing a large-bonus leadership raises its load
+            off the floor so the next sweep's sheds unlock, and raising
+            the average bonus per leader lets the broker carry its load
+            with FEWER leaderships — the only way leader counts and load
+            bands can both converge when per-partition load varies.
+            Every individual transfer stays within all prior goals'
+            bands (acceptance stack + terms), so the sequence is one a
+            sequential evaluator could also take."""
+            counts = self._counts(cache)
+            blocked = st.broker_alive & (counts > upper)
+            accept = compose_leadership_acceptance(prev_goals, st, ctx,
+                                                   cache)
+
+            def accept_all(src_r, dst_r):
+                db = st.replica_broker[dst_r]
+                return blocked[db] & accept(src_r, dst_r)
+
+            bonus = (st.replica_valid & st.replica_is_leader).astype(
+                jnp.float32)
+            value_rows = cache.table_leader.astype(jnp.float32)
+            lt_d, lt_s = leadership_commit_terms(prev_goals, st, ctx,
+                                                 cache)
+            # donors: brokers that stay at/above the count lower bound
+            # after giving one leadership away
+            donor = st.broker_alive & (counts - 1 >= lower) & ~blocked
+            rank_rows = jnp.where(
+                cache.table_ok & cache.table_leader & donor[:, None],
+                _bonus_util_rows(st, cache), kernels.NEG)
+            leader_ok = ctx.broker_leader_ok & blocked
+            cand_r, cand_f, cand_v = kernels.leadership_round(
+                st, bonus, counts - lower, movable_all, leader_ok,
+                jnp.full((st.num_brokers,), jnp.inf), accept_all,
+                jnp.where(blocked, 1.0, 0.0), ctx.partition_replicas,
+                cache=cache,
+                bonus_rows=rank_rows,
+                value_rows=value_rows,
+                dest_terms=lt_d, src_terms=lt_s,
+                escalate=False)
             st, cache = kernels.commit_leadership_cached(st, cache, cand_r,
                                                          cand_f, cand_v)
             return st, cache, jnp.any(cand_v)
@@ -273,9 +340,13 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
         def over_exists(st, cache):
             return jnp.any(st.broker_alive & (self._counts(cache) > upper))
 
+        # refuel runs AFTER shed+move dried up (phase order within the
+        # sweep) and is capped per sweep — each sweep trades a few
+        # high-bonus imports for the low-bonus sheds they unlock
         return run_phase_sweeps(
             state, [(phase_transfer, over_exists),
-                    (phase_move, over_exists)],
+                    (phase_move, over_exists),
+                    (phase_refuel, over_exists, 2)],
             self.rounds_for(ctx), table_slots=ctx.table_slots, ctx=ctx)
 
     def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):

@@ -715,8 +715,15 @@ def leadership_round(state: ClusterState,
                      dest_terms=None,
                      src_terms=None,
                      dest_stack_headroom: Optional[jax.Array] = None,
+                     escalate: bool = True,
                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One round of batched leadership-transfer search.
+
+    `escalate=False` skips the starvation-escalation tiers (the deep
+    shortlist and the full [R, RF] plane): correct only for
+    OPPORTUNISTIC phases that need no no-stall guarantee — e.g. the
+    leader-count refuel phase, which is capped per sweep anyway; the
+    tiers were its dominant cost.
 
     For every leader replica on an overloaded broker, consider handing
     leadership to each of its followers (reference ResourceDistributionGoal
@@ -828,14 +835,16 @@ def leadership_round(state: ClusterState,
         # so no broker with a feasible handoff deeper than its top-64 can
         # stall for a whole phase.
         struct_any = jnp.any(bonus_rows > NEG / 2, axis=1)
-        thin = (jnp.sum(row_served) * 8 < jnp.sum(struct_any))
+        thin = (jnp.sum(row_served) * 8 < jnp.sum(struct_any)) \
+            if escalate else jnp.zeros((), bool)
 
         served_before_deep = jnp.sum(row_served)
         cand_r, cand_has, row_served = jax.lax.cond(
             jnp.any(struct_any & ~row_served) & thin,
             lambda: tier_merge(*pick_first_ok(64), cand_r, cand_has,
                                row_served),
-            lambda: (cand_r, cand_has, row_served))
+            lambda: (cand_r, cand_has, row_served)) \
+            if escalate else (cand_r, cand_has, row_served)
 
         def full_plane():
             lead_eligible = (movable & state.replica_is_leader
@@ -850,7 +859,8 @@ def leadership_round(state: ClusterState,
         deep_helped = jnp.sum(row_served) > served_before_deep
         cand_r, cand_has, row_served = jax.lax.cond(
             jnp.any(struct_any & ~row_served) & thin & ~deep_helped,
-            full_plane, lambda: (cand_r, cand_has, row_served))
+            full_plane, lambda: (cand_r, cand_has, row_served)) \
+            if escalate else (cand_r, cand_has, row_served)
         cand_r_safe = jnp.maximum(cand_r, 0)
         cand_bonus_b = bonus_w[cand_r_safe]
     else:

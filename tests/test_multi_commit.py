@@ -1,0 +1,96 @@
+"""Multi-commit round semantics: cumulative gating and the refuel escape.
+
+The round kernels commit several actions against one broker per round
+(rank_accept + headroom terms).  These tests pin the two contracts that
+make that safe: (a) a committed batch never exceeds any prior goal's
+strict headroom at a destination beyond the single boolean-validated
+first arrival, and (b) the leader-count goal's refuel phase escapes the
+band-floor deadlock that single-direction shedding cannot.
+"""
+import conftest  # noqa: F401
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import kernels
+
+
+def test_rank_accept_respects_cumulative_headroom():
+    num_b = 10
+    C = 12
+    # all candidates target broker 3, weights 2.0 each, headroom 5.0:
+    # the first arrival is boolean-validated (exempt), then the
+    # cumulative gate admits ranks while cum <= hr: 2, 4 -> next would
+    # be 6 > 5, so exactly 2 term-gated arrivals + nothing more
+    dest = jnp.full((C,), 3, jnp.int32)
+    gain = jnp.arange(C, 0, -1).astype(jnp.float32)
+    has = jnp.ones((C,), bool)
+    keep = kernels.rank_accept(
+        dest, gain, has, num_b,
+        taken_cnt=jnp.zeros((num_b,), jnp.int32),
+        cap=jnp.full((num_b,), 64, jnp.int32),
+        cum_d=[jnp.zeros((num_b,))],
+        d_w=[jnp.full((C,), 2.0)],
+        hr_d=[jnp.full((num_b,), 5.0)])
+    assert int(np.asarray(keep).sum()) == 2
+    # the accepted ones are the highest-gain candidates
+    assert np.asarray(keep)[:2].all()
+
+
+def test_rank_accept_first_arrival_exempt_only_when_virgin():
+    num_b = 4
+    dest = jnp.zeros((3,), jnp.int32)
+    gain = jnp.asarray([3.0, 2.0, 1.0])
+    has = jnp.ones((3,), bool)
+    # headroom 0: only the virgin-destination exemption admits anyone
+    keep = kernels.rank_accept(
+        dest, gain, has, num_b,
+        taken_cnt=jnp.zeros((num_b,), jnp.int32),
+        cap=jnp.full((num_b,), 64, jnp.int32),
+        cum_d=[jnp.zeros((num_b,))],
+        d_w=[jnp.ones((3,))], hr_d=[jnp.zeros((num_b,))])
+    assert int(np.asarray(keep).sum()) == 1
+    # already-taken destination: no exemption, headroom 0 blocks all
+    keep2 = kernels.rank_accept(
+        dest, gain, has, num_b,
+        taken_cnt=jnp.asarray([1, 0, 0, 0], jnp.int32),
+        cap=jnp.full((num_b,), 64, jnp.int32),
+        cum_d=[jnp.zeros((num_b,))],
+        d_w=[jnp.ones((3,))], hr_d=[jnp.zeros((num_b,))])
+    assert int(np.asarray(keep2).sum()) == 0
+
+
+def test_segment_rank_matches_table_append_contract():
+    seg = jnp.asarray([2, 0, 2, 2, 1, 0], jnp.int32)
+    order, seg_s, start, pos = kernels.segment_rank(seg, 4)
+    # ranks within each segment are 0..k-1 and stable by index
+    got = {}
+    o = np.asarray(order)
+    p = np.asarray(pos)
+    for i in range(len(o)):
+        got.setdefault(int(np.asarray(seg_s)[i]), []).append(int(p[i]))
+    assert got[0] == [0, 1] and got[1] == [0] and got[2] == [0, 1, 2]
+
+
+@pytest.mark.parametrize("seed", [4, 9])
+def test_leader_goal_escapes_band_floor(seed):
+    """End-to-end: after the full stack, leader-count violations shrink
+    to a small residual — the refuel phase must break the measured
+    deadlock where every shed off an over-count broker is vetoed by a
+    prior goal's band floor (see PARITY.md round 3)."""
+    from cruise_control_tpu.analyzer.context import OptimizationOptions
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.testing.random_cluster import (
+        RandomClusterSpec, random_cluster)
+
+    state, topo = random_cluster(RandomClusterSpec(
+        num_brokers=64, num_partitions=4000, replication_factor=3,
+        num_racks=8, num_topics=10, seed=seed, skew_fraction=0.2))
+    res = GoalOptimizer(default_goals(max_rounds=96),
+                        pipeline_segment_size=5).optimizations(
+        state, topo, OptimizationOptions())
+    before, _, after = res.violated_broker_counts[
+        "LeaderReplicaDistributionGoal"]
+    assert after <= max(3, before // 5), (before, after)

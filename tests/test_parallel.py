@@ -76,6 +76,51 @@ def test_sharded_full_step_matches_single_device():
                 & np.asarray(out.replica_valid)).any()
 
 
+def test_sharded_full_goal_stack_runs_and_matches_quality():
+    """The FULL default goal stack (15 goals) jitted over the 8-device
+    mesh with the solver-mesh table constraints active must execute and
+    land within the single-device run's violation counts (exact state
+    equality is not required: sharded reductions reorder float sums)."""
+    from cruise_control_tpu.analyzer.context import make_round_cache
+    from cruise_control_tpu.parallel.mesh import solver_mesh
+
+    state, topo = random_cluster(_spec())
+    goals = default_goals(max_rounds=12)
+
+    def full_step(st, c):
+        st = heal_offline_replicas(st, c, max_rounds=12)
+        for i, goal in enumerate(goals):
+            st = goal.optimize(st, c, tuple(goals[:i]))
+        return st
+
+    ctx = make_context(state, BalancingConstraint(), OptimizationOptions(),
+                       topo)
+    ref = jax.jit(full_step)(state, ctx)
+
+    mesh = make_mesh(jax.devices()[:8])
+    sharded = shard_state(state, mesh)
+    ctx_s = make_context(sharded, BalancingConstraint(),
+                         OptimizationOptions(), topo)
+    shardings = state_shardings(sharded, mesh)
+    with solver_mesh(mesh):
+        step = jax.jit(full_step, in_shardings=(shardings, None),
+                       out_shardings=shardings)
+        with mesh:
+            out = step(sharded, ctx_s)
+            jax.block_until_ready(out.replica_broker)
+    assert len(out.replica_broker.sharding.device_set) == 8
+    sanity_check(jax.device_get(out))
+    # quality within reach of the single-device solve for every goal
+    cache_r = make_round_cache(ref)
+    cache_o = make_round_cache(jax.device_get(out))
+    for i, g in enumerate(goals):
+        v_ref = int(np.asarray(g.violated_brokers(
+            ref, ctx, cache_r)).sum())
+        v_out = int(np.asarray(g.violated_brokers(
+            jax.device_get(out), ctx_s, cache_o)).sum())
+        assert v_out <= v_ref + 2, (g.name, v_ref, v_out)
+
+
 def test_pad_state_rounds_up_and_masks():
     state, _ = random_cluster(_spec())
     padded = pad_state(state, 7)
