@@ -67,6 +67,10 @@ class OptimizationOptions:
     is_triggered_by_goal_violation: bool = False
     only_move_immigrant_replicas: bool = False
     fast_mode: bool = False
+    #: joint multi-resource pre-balance before the first goal (a framework
+    #: perf extension, analyzer/prebalance.py; the optimizer additionally
+    #: activates only the dimensions whose goals are in its list)
+    prebalance: bool = True
 
 
 @jax.tree_util.register_dataclass
@@ -113,6 +117,10 @@ class OptimizationContext:
     #: (they must converge regardless).
     fast_mode: bool = dataclasses.field(metadata=dict(static=True),
                                         default=False)
+    #: run the joint pre-balance pass (analyzer/prebalance.py) before the
+    #: first goal — static so disabled requests trace no pre-balance code
+    prebalance: bool = dataclasses.field(metadata=dict(static=True),
+                                         default=True)
 
 
 def partition_replica_index(state: ClusterState,
@@ -232,6 +240,7 @@ def make_context(state: ClusterState,
         fix_offline_replicas_only=fix_offline_replicas_only,
         table_slots=table_slots,
         fast_mode=options.fast_mode,
+        prebalance=options.prebalance,
     )
 
 

@@ -408,7 +408,18 @@ def move_commit_terms(goals: Sequence[Goal], state: ClusterState,
                       ctx: OptimizationContext, cache: RoundCache):
     """(dest_terms, src_terms) for kernels.move_round's multi-commit mode
     — (None, None) when any prior goal's move acceptance is not
-    quantitative (the kernels then stay single-commit per broker)."""
+    quantitative (the kernels then stay single-commit per broker).
+
+    NEGATIVE RESULT (round 4, recorded so it is not retried): merging
+    self-imposed "do-no-harm" band terms here (capping every goal's
+    arrivals at every resource band / the count band even when no prior
+    goal demands it) DEADLOCKS cross-dimension traffic the reference's
+    relaxed acceptance branch deliberately allows — measured at the
+    north config: ReplicaDistribution exhausted its budget at 104
+    violated brokers, RackAware tripled its wall-clock (midpoint
+    variant: 371 vs 32 rounds), full stack 98.9 s vs 64.3 s without.
+    Goal-priority damage control belongs to the acceptance stack, not
+    blanket gating."""
     return _split_terms(compose_move_headrooms(goals, state, ctx, cache))
 
 

@@ -61,6 +61,21 @@ class CapacityGoal(Goal):
                  * state.replica_valid)
         base_movable = replica_static_ok(state, ctx)
 
+        if leadership_helps:
+            # whole-cluster [P, RF] re-election first: sheds the
+            # leadership-carried share of over-limit load at a fraction
+            # of a table round's cost (analyzer/leadership.py); the
+            # table rounds below then handle replica moves and residuals
+            from cruise_control_tpu.analyzer.leadership import (
+                global_leadership_sweep, limit_bounds)
+            state, sweep_rounds = global_leadership_sweep(
+                state, ctx, prev_goals,
+                measure=lambda cache: cache.broker_load[:, res],
+                value_r=bonus,
+                bounds=limit_bounds(self._limit(state, ctx), mid_w),
+                improve_gate=False)
+            note_rounds(sweep_rounds)
+
         def round_body(st: ClusterState, cache):
             committed = jnp.zeros((), dtype=bool)
             if leadership_helps:
@@ -109,7 +124,8 @@ class CapacityGoal(Goal):
                                   W > limit, W - limit),
                 per_src_k=4 if mt_d is not None else multi_k,
                 dest_terms=mt_d, src_terms=mt_s,
-                dest_stack_headroom=mid_w - W)
+                dest_stack_headroom=mid_w - W,
+                assign_fallback=True)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             committed |= jnp.any(cand_v)
@@ -250,7 +266,8 @@ class ReplicaCapacityGoal(Goal):
                                   count - limit),
                 per_src_k=4 if mt_d is not None else multi_k,
                 dest_terms=mt_d, src_terms=mt_s,
-                dest_stack_headroom=avg_count - count)
+                dest_stack_headroom=avg_count - count,
+                assign_fallback=True)
             st, cache = kernels.commit_moves_cached(st, cache, cand_r,
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)

@@ -22,7 +22,7 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
-    dest_side_only, leader_shed_rows, leadership_commit_terms,
+    dest_side_only, leadership_commit_terms,
     move_commit_terms, new_broker_dest_mask, note_rounds,
     run_phase_sweeps, shed_rows)
 from cruise_control_tpu.model import state as S
@@ -212,6 +212,36 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
         to under-count brokers (reference LeaderReplicaDistributionGoal
         rebalanceForBroker: maybeApplyBalancingAction with
         LEADERSHIP_MOVEMENT then INTER_BROKER_REPLICA_MOVEMENT)."""
+        from cruise_control_tpu.analyzer.leadership import (
+            global_leadership_sweep, mean_bounds)
+
+        def _upper_of(st, W):
+            alive = st.broker_alive
+            avg_w = jnp.sum(W * alive) / jnp.maximum(jnp.sum(alive), 1)
+            _, up = _count_bounds(avg_w, self.pct_margin)
+            return jnp.full((st.num_brokers,), up)
+
+        # whole-cluster re-election toward the mean first: the [P, RF]
+        # sweep commits hundreds of acceptance-checked transfers per
+        # round at a fraction of a table round's cost, and mean-targeting
+        # frees receiver headroom that the band-edge rounds cannot (the
+        # round-3 residual: over-count brokers pinned at prior goals'
+        # band floors).  The per-broker phases below then handle only
+        # what re-election cannot: replica MOVES and floor-blocked
+        # refuels.
+        state, sweep_rounds = global_leadership_sweep(
+            state, ctx, prev_goals,
+            measure=lambda cache: cache.leader_count.astype(jnp.float32),
+            value_r=jnp.ones(state.num_replicas, jnp.float32),
+            bounds=mean_bounds(_upper_of), improve_gate=True,
+            max_rounds=48,
+            # same-deficit receivers tie-break toward LOW bytes-in so the
+            # bulk count transfers also even out the later
+            # LeaderBytesInDistributionGoal's surface instead of
+            # scrambling it
+            dest_tiebreak=lambda cache: -cache.leader_bytes_in)
+        note_rounds(sweep_rounds)
+
         counts0 = S.broker_leader_count(state).astype(jnp.float32)
         avg = self._avg(state, counts0)
         lower, upper = _count_bounds(avg, self.pct_margin)
@@ -397,7 +427,7 @@ class TopicReplicaDistributionGoal(Goal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
 
-        def round_body(st: ClusterState, cache):
+        def round_body(st: ClusterState, cache, salt):
             tc = cache.broker_topic_count.astype(jnp.float32)          # [B,T]
             lower, upper = self._bounds(st, tc)
             topic_of_r = st.partition_topic[st.replica_partition]
@@ -419,7 +449,14 @@ class TopicReplicaDistributionGoal(Goal):
                 fits = tc[d, t] + 1 <= upper[t]
                 return fits & accept(r, d)
 
-            w = jnp.ones(st.num_replicas, dtype=jnp.float32)
+            # per-round salted jitter on the (otherwise all-equal) mover
+            # weights: the topic-level feasibility guard above cannot see
+            # per-candidate vetoes (siblings on every open destination,
+            # prior-goal band bounds), and a deterministic pick lets one
+            # vetoed mover win its broker's slot every round — the
+            # measured cause of the round-3 early stall at 64 violated
+            # brokers with 7/8 of the round budget unused
+            w = 1.0 + 0.25 * kernels.salted_jitter(st.num_replicas, salt)
             counts = cache.replica_count.astype(jnp.float32)
             cand_r, cand_d, cand_v = kernels.forced_move_round(
                 st, movable, w, dest_ok_b, accept_all, -counts,
@@ -434,7 +471,7 @@ class TopicReplicaDistributionGoal(Goal):
 
         def body(carry):
             st, cache, rounds, _ = carry
-            st, cache, committed = round_body(st, cache)
+            st, cache, committed = round_body(st, cache, rounds)
             return st, cache, rounds + 1, committed
 
         state, _, rounds, _ = jax.lax.while_loop(

@@ -168,6 +168,28 @@ class LeaderBytesInDistributionGoal(Goal):
 
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
+        from cruise_control_tpu.analyzer.leadership import (
+            global_leadership_sweep, mean_bounds)
+
+        def _upper_of(st, W):
+            alive = st.broker_alive
+            avg_w = jnp.sum(W * alive) / jnp.maximum(jnp.sum(alive), 1)
+            return jnp.full((st.num_brokers,),
+                            avg_w * (1 + self.pct_margin))
+
+        # whole-cluster re-election toward the mean bytes-in first (see
+        # count_distribution.LeaderReplicaDistributionGoal — same
+        # rationale); per-REPLICA value = the replica's own base NW_IN
+        # (the model stores base loads per replica, builder.py)
+        value_r = (state.replica_base_load[:, Resource.NW_IN]
+                   * state.replica_valid)
+        state, sweep_rounds = global_leadership_sweep(
+            state, ctx, prev_goals,
+            measure=lambda cache: cache.leader_bytes_in,
+            value_r=value_r,
+            bounds=mean_bounds(_upper_of), improve_gate=True,
+            max_rounds=48)
+        note_rounds(sweep_rounds)
 
         base_movable = replica_static_ok(state, ctx)
 

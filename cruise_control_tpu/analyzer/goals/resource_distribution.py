@@ -27,7 +27,7 @@ from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
     compose_swap_acceptance, dest_side_only, leader_shed_rows,
     leadership_commit_terms, move_commit_terms, new_broker_dest_mask,
-    run_phase_sweeps, shed_rows)
+    note_rounds, run_phase_sweeps, shed_rows)
 from cruise_control_tpu.common.resources import (RESOURCE_GOAL_NAMES,
                                                  Resource)
 from cruise_control_tpu.model.state import ClusterState
@@ -83,6 +83,22 @@ class ResourceDistributionGoal(Goal):
         bonus = (state.partition_leader_bonus[state.replica_partition, res]
                  * state.replica_valid)
         base_movable = replica_static_ok(state, ctx)
+
+        if self._leadership_applicable():
+            # whole-cluster [P, RF] re-election toward the band first
+            # (analyzer/leadership.py): commits thousands of
+            # acceptance-checked transfers per round at a fraction of
+            # phase_a's table-round cost; phase_a remains as the
+            # residual backstop
+            from cruise_control_tpu.analyzer.leadership import (
+                global_leadership_sweep, limit_bounds)
+            state, sweep_rounds = global_leadership_sweep(
+                state, ctx, prev_goals,
+                measure=lambda cache: cache.broker_load[:, res],
+                value_r=bonus,
+                bounds=limit_bounds(upper, (upper + lower) / 2.0),
+                improve_gate=False)
+            note_rounds(sweep_rounds)
 
         def phase_a(st, cache):
             W = cache.broker_load[:, res]

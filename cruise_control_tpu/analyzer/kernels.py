@@ -319,6 +319,7 @@ def move_round(state: ClusterState,
                dest_terms=None,
                src_terms=None,
                dest_stack_headroom: Optional[jax.Array] = None,
+               assign_fallback: bool = False,
                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One round of batched replica-move search.
 
@@ -378,7 +379,18 @@ def move_round(state: ClusterState,
         within every term's strict headroom (see assign_destinations).
 
     Returns (cand_replica i32[C], cand_dest i32[C], cand_valid bool[C]) with
-    C == num_brokers * per_src_k.
+    C == num_brokers * per_src_k, broker-major (rows b*k..b*k+k-1 belong
+    to source broker b).  Internally the [C, K] destination planes run on
+    the top-CAND_COMPACT candidates by gain (compact_candidates); results
+    are scattered back to the full-width layout before returning.
+
+    `assign_fallback=True` re-runs the assignment on the FULL candidate
+    set when every compacted candidate was vetoed while feasible ones
+    were dropped — pass it for HARD goals, where a falsely-converged
+    round aborts the whole optimization.  Soft goals leave it off: their
+    convergence tails are DOMINATED by legitimately-stalled rounds, and
+    re-proving the stall on full-width planes every round measured +6 s
+    at the north config (44.7 s vs 37.9 s) for marginal quality.
     """
     num_b = state.num_brokers
     rb = state.replica_broker
@@ -487,39 +499,77 @@ def move_round(state: ClusterState,
         if forced is not None:
             gain = gain + jnp.where(forced[cand_r_safe], 1e12, 0.0)
 
-    if multi:
-        # candidate-sliced quantitative terms; the OWN goal's bound leads
-        # (dest_headroom is already its strict quantity), tightened by
-        # the caller's spreading bound.  Source-side terms were
-        # prefix-gated at selection, so the assignment passes carry only
-        # destination cumulants.
-        own_hr = (jnp.minimum(dest_headroom, dest_stack_headroom)
-                  if dest_stack_headroom is not None else dest_headroom)
-        d_terms = ([(cand_w, own_hr)]
-                   + [(t_w[cand_r_safe], t_hr) for t_w, t_hr in dest_terms])
-    else:
-        d_terms = None
+    # compact to the top candidates by gain before any [C, K] plane is
+    # built — C = num_brokers x per_src_k counts every broker whether or
+    # not it is an active source, and the destination planes (and every
+    # prior goal's acceptance evaluation on them) scale with C
+    full = (gain, cand_has, cand_r, cand_r_safe, cand_w)
+    sel, gain, cand_has, cand_r, cand_r_safe, cand_w = compact_candidates(
+        CAND_COMPACT, gain, cand_has, cand_r, cand_r_safe, cand_w)
 
-    def assign_with(dest_ids):
-        # --- destination matrix [C, K] ---
-        fits = (cand_w[:, None] <= dest_headroom[dest_ids][None, :])
-        feasible = (fits & cand_has[:, None]
-                    & _dest_feasibility(state, cand_r_safe, dest_ok,
-                                        accept_matrix_fn, partition_replicas,
-                                        dest_ids))
-        pref = jnp.where(feasible, dest_pref[dest_ids][None, :], NEG)
-        return assign_destinations(pref, gain, cand_has, num_b, dest_ids,
-                                   dest_terms=d_terms, dest_cap=dest_cap)
+    def run_assign(gn, ch, crs, cw):
+        """Destination assignment + per-partition dedup for one candidate
+        set — instantiated on the compacted set always, and on the FULL
+        set only inside the rarely-taken starvation fallback below."""
+        if multi:
+            # candidate-sliced quantitative terms; the OWN goal's bound
+            # leads (dest_headroom is already its strict quantity),
+            # tightened by the caller's spreading bound.  Source-side
+            # terms were prefix-gated at selection, so the assignment
+            # passes carry only destination cumulants.
+            own_hr = (jnp.minimum(dest_headroom, dest_stack_headroom)
+                      if dest_stack_headroom is not None else dest_headroom)
+            dt = ([(cw, own_hr)]
+                  + [(t_w[crs], t_hr) for t_w, t_hr in dest_terms])
+        else:
+            dt = None
 
-    cand_dest, cand_valid = _assign_with_escalation(
-        assign_with, dest_ok, dest_pref, cand_has, num_b)
-    # at most one replica of a partition moves per round: acceptance checks
-    # evaluate each action in isolation, so two siblings committing together
-    # could land in one rack (or overfill one bound) and re-violate a
-    # previously-optimized goal
-    part_of_cand = state.replica_partition[cand_r_safe]
-    cand_valid = resolve_dest_conflicts(part_of_cand, gain, cand_valid,
-                                        state.num_partitions)
+        def assign_with(dest_ids):
+            # --- destination matrix [C, K] ---
+            fits = (cw[:, None] <= dest_headroom[dest_ids][None, :])
+            feasible = (fits & ch[:, None]
+                        & _dest_feasibility(state, crs, dest_ok,
+                                            accept_matrix_fn,
+                                            partition_replicas, dest_ids))
+            pref = jnp.where(feasible, dest_pref[dest_ids][None, :], NEG)
+            return assign_destinations(pref, gn, ch, num_b, dest_ids,
+                                       dest_terms=dt, dest_cap=dest_cap)
+
+        dest, valid = _assign_with_escalation(
+            assign_with, dest_ok, dest_pref, ch, num_b)
+        # at most one replica of a partition moves per round: acceptance
+        # checks evaluate each action in isolation, so two siblings
+        # committing together could land in one rack (or overfill one
+        # bound) and re-violate a previously-optimized goal
+        valid = resolve_dest_conflicts(state.replica_partition[crs], gn,
+                                       valid, state.num_partitions)
+        return dest, valid
+
+    cand_dest, cand_valid = run_assign(gain, cand_has, cand_r_safe, cand_w)
+    if sel is not None and not assign_fallback:
+        # scatter the compacted results back to the full-width layout
+        g_f, h_f, r_f, rs_f, w_f = full
+        c_pre = r_f.shape[0]
+        cand_dest = jnp.zeros((c_pre,), jnp.int32).at[sel].set(cand_dest)
+        cand_valid = jnp.zeros((c_pre,), bool).at[sel].set(cand_valid)
+        cand_r = r_f
+    elif sel is not None:
+        # starvation fallback: if every kept candidate was vetoed while
+        # feasible candidates were compacted away, a round would commit
+        # nothing and the goal's progress-gated loop would falsely
+        # converge (fatal for hard goals: residual violations abort the
+        # run).  Re-running the assignment on the full candidate set only
+        # in that case keeps the common rounds on the small planes.
+        g_f, h_f, r_f, rs_f, w_f = full
+        c_pre = r_f.shape[0]
+        dest_full = jnp.zeros((c_pre,), jnp.int32).at[sel].set(cand_dest)
+        valid_full = jnp.zeros((c_pre,), bool).at[sel].set(cand_valid)
+        need_full = jnp.any(h_f) & ~jnp.any(cand_valid)
+        cand_dest, cand_valid = jax.lax.cond(
+            need_full,
+            lambda: run_assign(g_f, h_f, rs_f, w_f),
+            lambda: (dest_full, valid_full))
+        cand_r = r_f
     return cand_r, cand_dest, cand_valid
 
 
@@ -529,6 +579,16 @@ ASSIGN_PASSES = 8
 #: config, 4 passes saved no wall-clock (the pass loop is not the round
 #: bottleneck) and cost a little convergence per round
 MULTI_ASSIGN_PASSES = 8
+
+#: candidate-compaction width: the [C, K] assignment/acceptance planes
+#: are sized C = num_brokers x per_src_k even when only a fraction of
+#: brokers are active sources — compacting to the top CAND_COMPACT
+#: candidates by gain (kernels.compact_candidates) cuts every plane and
+#: per-goal acceptance evaluation 5-10x while committing up to 2048
+#: actions per round (measured commits per round are in the hundreds).
+#: Non-selected candidates simply wait; as winners commit and leave the
+#: candidate set, waiting sources surface in later rounds.
+CAND_COMPACT = 2048
 
 #: swap search evaluates the worst SWAP_SHORTLIST brokers per side
 #: instead of the full [B, B] pair plane (6.76M pairs x the pairwise
@@ -543,14 +603,40 @@ MAX_ARRIVALS_PER_ROUND = 64
 
 #: destination-shortlist width: candidate×destination planes are evaluated
 #: against the top-K destinations by preference instead of all B brokers,
-#: bounding the [C, K] matrices at 2.6K-broker scale (10× smaller than
+#: bounding the [C, K] matrices at 2.6K-broker scale (40× smaller than
 #: [C, B]).  Preference orders destinations identically for every candidate,
 #: but per-candidate acceptance (multi-resource capacity, sibling blocks)
 #: can reject the whole shortlist while a feasible broker exists outside
 #: it — a round that would commit NOTHING under the shortlist therefore
 #: escalates to the full destination set (_assign_with_escalation), so the
 #: optimization can never falsely converge because of the truncation.
+#: Round-4 negative result (recorded so it is not retried): narrowing
+#: this to 64 (with 4 assign passes) cut per-round cost but collapsed
+#: per-round convergence throughput — total rounds exploded 470 -> 617
+#: and the full stack went 58.2 s -> 67.3 s.  The cheap-plane lever that
+#: DOES work is candidate compaction (CAND_COMPACT), which shrinks C
+#: while keeping the destination fan-out wide.
 DEST_SHORTLIST = 256
+
+
+def compact_candidates(width: int, gain: jax.Array, cand_has: jax.Array,
+                       *arrays):
+    """Keep the top `width` candidates by gain (invalid rows sort last).
+
+    Returns (sel, gain, cand_has, *arrays) with the arrays sliced to
+    min(width, C); `sel` is the i32[width] index map back into the full
+    candidate axis (None when no compaction happened).  Callers run this
+    AFTER per-source prefix gating (which needs the [B, k] row
+    structure) and BEFORE the [C, K] destination planes; move_round
+    keeps a full-width fallback for the compaction-starvation case (all
+    kept candidates vetoed while feasible ones were dropped)."""
+    c = gain.shape[0]
+    if c <= width:
+        return (None, gain, cand_has) + tuple(arrays)
+    _, sel = jax.lax.top_k(jnp.where(cand_has, gain, -jnp.inf), width)
+    sel = sel.astype(jnp.int32)
+    return ((sel, gain[sel], cand_has[sel])
+            + tuple(a[sel] for a in arrays))
 
 
 def _dest_shortlist(dest_ok: jax.Array, dest_pref: jax.Array) -> jax.Array:
@@ -577,6 +663,21 @@ def _assign_with_escalation(assign_with: Callable[[jax.Array], Tuple[
         need_full,
         lambda: assign_with(jnp.arange(num_b, dtype=jnp.int32)),
         lambda: (cand_dest, cand_valid))
+
+
+def salted_jitter(n: int, salt: jax.Array) -> jax.Array:
+    """f32[n] deterministic pseudo-random values in [0, 1) keyed by a
+    TRACED scalar salt (e.g. the round counter) — the in-loop counterpart
+    of `_pairwise_jitter`, whose salt must be a Python static.  Used to
+    rotate otherwise-deterministic candidate picks across rounds so a
+    vetoed candidate cannot starve its broker's slot forever."""
+    i = jnp.arange(n, dtype=jnp.uint32)
+    x = (i * jnp.uint32(2654435761)
+         + (salt.astype(jnp.uint32) + jnp.uint32(1)) * jnp.uint32(97919))
+    x ^= x >> 16
+    x *= jnp.uint32(2246822519)
+    x ^= x >> 13
+    return (x & jnp.uint32(0xFFFFFF)).astype(jnp.float32) / float(1 << 24)
 
 
 def _pairwise_jitter(num_c: int, num_b: int, salt: int = 0) -> jax.Array:

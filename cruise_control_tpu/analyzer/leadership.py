@@ -1,0 +1,237 @@
+"""Global leadership re-election sweep.
+
+Leadership balancing differs structurally from replica balancing: a
+partition's leadership can only move between that partition's OWN
+replicas, so the whole cluster's transfer candidates form a [P, RF]
+plane — small enough to evaluate for EVERY partition at once.  The
+per-broker-table rounds the goals otherwise run
+(kernels.leadership_round) cost ~150-190 ms each at 2.6K-broker scale
+(the [C, RF] follower planes plus [C, K] acceptance dominate — round-3
+segment profile); a sweep round here costs a handful of [P, RF] gathers
+plus two ranked prefix-acceptance passes (~tens of ms) and commits up to
+thousands of transfers, PLE-style (compare
+goals/network.py PreferredLeaderElectionGoal — one batched assignment
+over all partitions).
+
+Every round: each partition whose leader sits on an over-`shed_to`
+broker proposes its best under-`fill_to` sibling broker; proposals are
+gain-ranked per source and per destination broker and accepted as
+prefixes under cumulative headrooms (kernels.rank_accept) — the sweep's
+own measure plus every previously-optimized goal's quantitative bounds
+— then committed in one batch.  Each transfer also passes the composed
+boolean acceptance stack, so the batch is a sequence a sequential
+evaluator could also have taken (reference semantics:
+AbstractGoal.maybeApplyBalancingAction LEADERSHIP_MOVEMENT +
+AnalyzerUtils.isProposalAcceptableForOptimizedGoals,
+AnalyzerUtils.java:119).
+
+Two modes:
+  * mean mode (`improve_gate=True`, used by the leader-distribution
+    goals): both ends pull toward the cluster average, with a
+    strict-improvement gate so every transfer shrinks the total
+    imbalance — this unlocks the receiver-headroom chains the band-edge
+    rounds could not express (round-3 residual: over-count brokers
+    pinned at prior goals' band floors).
+  * limit mode (`improve_gate=False`, used by the CPU/NW_OUT capacity
+    and usage goals before their table rounds): sources shed to the
+    goal's bound, destinations fill toward `fill_to` (band midpoint)
+    with the first arrival per round exempt, mirroring
+    kernels.leadership_round's stacking bound.
+
+The sweep runs TABLE-LESS: transfers move no replicas, the [B, S]
+broker-table maintenance (a [C, S] slot lookup per committed action)
+would dominate its cost, and the goals' remaining phases rebuild their
+table afterwards anyway.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import kernels
+from cruise_control_tpu.analyzer.context import (OptimizationContext,
+                                                 RoundCache,
+                                                 make_round_cache,
+                                                 replica_static_ok,
+                                                 update_cache_for_leadership)
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.state import ClusterState
+
+
+def global_leadership_sweep(
+        state: ClusterState, ctx: OptimizationContext,
+        prev_goals: Sequence,
+        measure: Callable[[RoundCache], jax.Array],
+        value_r: jax.Array,
+        bounds: Callable[[ClusterState, jax.Array],
+                         Tuple[jax.Array, jax.Array, jax.Array]],
+        improve_gate: bool,
+        max_rounds: int = 24,
+        dest_tiebreak: Optional[Callable[[RoundCache], jax.Array]] = None,
+) -> Tuple[ClusterState, jax.Array]:
+    """Run whole-cluster leadership re-election rounds.
+
+    Args:
+      measure: cache -> f32[B], the balanced per-broker quantity
+        (leader count, leader bytes-in, CPU load, NW_OUT load).
+      value_r: f32[R] — how much of the measure a REPLICA's leadership
+        carries: what the destination broker gains when that replica is
+        promoted, and what the source loses when its replica is demoted
+        (1.0 everywhere for counts; the partition's leadership bonus for
+        CPU/NW_OUT — partition-level by construction; the replica's own
+        base NW_IN for leader bytes-in, which the model stores PER
+        REPLICA — builder.py r_base[i] = rep.load — so promoted and
+        demoted values can differ within one partition).
+      bounds: (state, W) -> (shed_to, fill_to, hard_cap), each f32[B]:
+        sources shed while above `shed_to`; destination cumulative
+        arrivals are bounded by `fill_to - W` (first arrival per round
+        exempt, kernels.rank_accept contract); no arrival may push a
+        destination past `hard_cap` (boolean backstop covering the
+        exemption).
+      improve_gate: additionally require each transfer to strictly
+        shrink both ends' distance to `shed_to` (mean mode — prevents
+        oscillation when value_p is large relative to the imbalance;
+        measured on a 16-broker fixture: without it leader-bytes-in
+        violations went 4 -> 9).
+      dest_tiebreak: optional cache -> f32[B] secondary preference
+        (higher = better) separating same-deficit candidate brokers —
+        e.g. the leader-count sweep prefers low-bytes-in receivers so
+        its thousands of transfers do not scramble the later
+        LeaderBytesInDistributionGoal's surface (measured round 4:
+        without it LBI's violated count rose 157 -> 181 at north).
+
+    Returns (state, rounds_used); traceable.
+    """
+    from cruise_control_tpu.analyzer.goals.base import (
+        compose_leadership_acceptance, leadership_commit_terms)
+
+    num_b = state.num_brokers
+    rows = ctx.partition_replicas                       # i32[P, RF]
+    rows_safe = jnp.maximum(rows, 0)
+    # static per-replica eligibility (valid, not excluded topic, movable,
+    # not offline) — loop-invariant, shared by source and candidate sides
+    static_ok = replica_static_ok(state, ctx)
+    big_cap = jnp.full((num_b,), jnp.iinfo(jnp.int32).max // 2, jnp.int32)
+    no_taken = jnp.zeros((num_b,), jnp.int32)
+
+    def round_body(st: ClusterState, cache: RoundCache, salt):
+        W = measure(cache)                              # f32[B]
+        alive = st.broker_alive
+        shed_to, fill_to, hard_cap = bounds(st, W)
+        cur = S.partition_leader_replica(st)            # i32[P]
+        cur_safe = jnp.maximum(cur, 0)
+        src_b = st.replica_broker[cur_safe]
+        value_leave = value_r[cur_safe]                 # f32[P]
+        live = ((cur >= 0) & static_ok[cur_safe]
+                & (W[src_b] > shed_to[src_b]) & (value_leave > 0.0))
+
+        cand_b = st.replica_broker[rows_safe]           # i32[P, RF]
+        value_arrive = value_r[rows_safe]               # f32[P, RF]
+        ok = ((rows >= 0) & (rows != cur[:, None])
+              & static_ok[rows_safe]
+              & alive[cand_b] & ctx.broker_leader_ok[cand_b]
+              & (W[cand_b] + value_arrive <= hard_cap[cand_b]))
+        deficit = (fill_to - W)[cand_b]                 # f32[P, RF]
+        if improve_gate:
+            # STRICT inequalities: an exact-mirror transfer (value equal
+            # to twice the imbalance on both ends) passes <= gates in
+            # both directions and ping-pongs between two brokers until
+            # max_rounds is exhausted whenever the alive-broker average
+            # lands on a half-integer (review finding, round 4)
+            ok &= ((value_leave[:, None]
+                    < 2.0 * (W[src_b] - shed_to[src_b])[:, None])
+                   & (value_arrive < 2.0 * deficit))
+        # per-round salted jitter so a partition whose best pick keeps
+        # failing the acceptance stack tries a different sibling next
+        # round (same rationale as kernels._pairwise_jitter)
+        jit = kernels._pairwise_jitter(rows.shape[0], rows.shape[1],
+                                       salt=0)          # static plane
+        spread = jnp.maximum(jnp.max(jnp.abs(deficit)), 1e-6)
+        score = deficit + 0.1 * spread * ((jit + salt) % 1.0)
+        if dest_tiebreak is not None:
+            tb = dest_tiebreak(cache)                   # f32[B]
+            tb_lo = jnp.min(tb)
+            tb_norm = (tb - tb_lo) / jnp.maximum(jnp.max(tb) - tb_lo, 1e-9)
+            score = score + 0.2 * spread * tb_norm[cand_b]
+        score = jnp.where(ok, score, -jnp.inf)
+        best = jnp.argmax(score, axis=1)                # i32[P]
+        dst_r = jnp.take_along_axis(rows_safe, best[:, None], axis=1)[:, 0]
+        has = live & jnp.any(ok, axis=1)
+        dst_b = st.replica_broker[dst_r]
+
+        # previously-optimized goals' boolean acceptance on the chosen
+        # transfer (single-action snapshot)
+        accept = compose_leadership_acceptance(prev_goals, st, ctx, cache)
+        has &= accept(cur_safe, dst_r)
+
+        lt_d, lt_s = leadership_commit_terms(prev_goals, st, ctx, cache)
+        gain = value_leave                               # bigger sheds first
+
+        # a prior goal whose leadership acceptance is NOT quantitative
+        # (leadership_headroom_terms None — the documented-safe default)
+        # caps the sweep at ONE transfer per broker per round on that
+        # side: the boolean snapshot validates single actions only (same
+        # contract as the kernels' single-commit fallback)
+        one_cap = jnp.ones((num_b,), jnp.int32)
+        src_cap = big_cap if lt_s is not None else one_cap
+        dst_cap = big_cap if lt_d is not None else one_cap
+
+        # --- source side: shed down to shed_to, prefix-gated ---
+        zero = jnp.zeros((num_b,), jnp.float32)
+        src_w = [value_leave] + [t_w[cur_safe] for t_w, _ in (lt_s or ())]
+        src_hr = [W - shed_to] + [hr for _, hr in (lt_s or ())]
+        has = kernels.rank_accept(
+            jnp.where(has, src_b, num_b), gain, has, num_b, no_taken,
+            src_cap, [zero] * len(src_w), src_w, src_hr)
+
+        # --- destination side: fill toward fill_to ---
+        dst_w = [value_r[dst_r]] + [t_w[cur_safe] for t_w, _ in (lt_d or ())]
+        dst_hr = [fill_to - W] + [hr for _, hr in (lt_d or ())]
+        valid = kernels.rank_accept(
+            jnp.where(has, dst_b, num_b), gain, has, num_b, no_taken,
+            dst_cap, [zero] * len(dst_w), dst_w, dst_hr)
+
+        new_st = S.apply_leadership_transfers(st, cur_safe, dst_r, valid)
+        cache = update_cache_for_leadership(st, cache, cur_safe, dst_r,
+                                            valid)
+        return new_st, cache, jnp.any(valid)
+
+    def cond(carry):
+        st, cache, rounds, progressed = carry
+        W = measure(cache)
+        shed_to, _, _ = bounds(st, W)
+        work = jnp.any(st.broker_alive & (W > shed_to))
+        return progressed & work & (rounds < max_rounds)
+
+    def body(carry):
+        st, cache, rounds, _ = carry
+        st, cache, committed = round_body(st, cache,
+                                          rounds.astype(jnp.float32) * 0.37)
+        return st, cache, rounds + 1, committed
+
+    state, _, rounds, _ = jax.lax.while_loop(
+        cond, body, (state, make_round_cache(state, 0, ctx),
+                     jnp.zeros((), jnp.int32), jnp.ones((), bool)))
+    return state, rounds
+
+
+def mean_bounds(upper_of: Callable[[ClusterState, jax.Array], jax.Array]):
+    """bounds() for mean mode: both ends target the alive-broker average;
+    `upper_of(state, W)` supplies the goal's own hard ceiling."""
+    def fn(st: ClusterState, W: jax.Array):
+        alive = st.broker_alive
+        avg = jnp.sum(W * alive) / jnp.maximum(jnp.sum(alive), 1)
+        avg_b = jnp.full((st.num_brokers,), avg)
+        up = upper_of(st, W)
+        return avg_b, jnp.minimum(avg_b, up), up
+    return fn
+
+
+def limit_bounds(limit: jax.Array, fill_to: jax.Array):
+    """bounds() for limit mode: shed while over `limit`, stack arrivals
+    toward `fill_to` (band midpoint), never cross `limit`."""
+    def fn(st: ClusterState, W: jax.Array):
+        return limit, fill_to, limit
+    return fn

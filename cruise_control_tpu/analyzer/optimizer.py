@@ -189,9 +189,28 @@ class GoalOptimizer:
         #: argument shapes match.
         self._aot: Dict[str, object] = {}
 
+    def _prebalance_dims(self):
+        """(active_resources tuple[bool x RES], balance_counts,
+        count_margin) — which dimensions the joint pre-balance may SHED,
+        derived from the goals actually in this optimizer's list so a
+        subset solve never receives moves its goals would not have made.
+        The count margin comes from the ReplicaDistributionGoal INSTANCE
+        (not the constraint) so the pre-pass sheds to exactly the band
+        that goal enforces."""
+        from cruise_control_tpu.common.resources import RESOURCE_GOAL_NAMES
+        names = {g.name for g in self.goals}
+        active = tuple(
+            (RESOURCE_GOAL_NAMES[r] + "UsageDistributionGoal") in names
+            for r in range(len(RESOURCE_GOAL_NAMES)))
+        margin = 0.09
+        for g in self.goals:
+            if g.name == "ReplicaDistributionGoal":
+                margin = getattr(g, "pct_margin", margin)
+        return active, "ReplicaDistributionGoal" in names, margin
+
     def _pre_fn(self):
         """(state, ctx) -> (violated_broker_counts i32[G], healed state,
-        still_offline, max_broker_count, broken).
+        still_offline, max_broker_count, broken, prebalance_rounds).
 
         `broken` reports whether the cluster entered with dead brokers /
         disks / offline replicas (waives the stats-regression abort).
@@ -202,6 +221,7 @@ class GoalOptimizer:
         re-sizes the context when it overflows, so build_broker_table can
         never silently truncate a row."""
         goals = tuple(self.goals)
+        active_res, balance_counts, count_margin = self._prebalance_dims()
 
         def run(state: ClusterState, ctx: OptimizationContext):
             cache0 = make_round_cache(state)
@@ -219,9 +239,18 @@ class GoalOptimizer:
             state = jax.lax.cond(
                 needs_heal, lambda s: heal_offline_replicas(s, ctx),
                 lambda s: s, state)
+            pre_rounds = jnp.zeros((), jnp.int32)
+            if (ctx.prebalance and not ctx.fix_offline_replicas_only
+                    and (any(active_res) or balance_counts)):
+                from cruise_control_tpu.analyzer.prebalance import prebalance
+                state, pre_rounds = prebalance(
+                    state, ctx, count_margin=count_margin,
+                    active_resources=active_res,
+                    balance_counts=balance_counts)
             still_offline = jnp.sum(S.self_healing_eligible(state))
             max_count = jnp.max(S.broker_replica_count(state))
-            return violated_before, state, still_offline, max_count, broken
+            return (violated_before, state, still_offline, max_count,
+                    broken, pre_rounds)
         return run
 
     def _segment_fn(self, start: int, stop: int):
@@ -362,11 +391,12 @@ class GoalOptimizer:
 
         t0 = time.time()
         profile = self.profile_segments
-        vb_dev, state, still_dev, maxc_dev, broken_dev = self._run(
-            "__pre__", self._pre_fn(), state, ctx)
+        (vb_dev, state, still_dev, maxc_dev, broken_dev,
+         pre_rounds_dev) = self._run("__pre__", self._pre_fn(), state, ctx)
         if profile:
             jax.block_until_ready(state.replica_broker)
-            LOG.info("segment pre+heal: %.0fms", (time.time() - t0) * 1e3)
+            LOG.info("segment pre+heal+prebalance: %.0fms",
+                     (time.time() - t0) * 1e3)
         seg = max(1, self.pipeline_segment_size)
         stacked_parts = []
         own_parts = []
@@ -391,9 +421,9 @@ class GoalOptimizer:
                   (len(self.goals) + seg - 1) // seg,
                   (time.time() - t0) * 1e3)
         (stacked_h, own_h, rounds_h, vb_h, va_h, still_offline, broken,
-         max_count) = jax.device_get(
+         max_count, pre_rounds) = jax.device_get(
             (stacked_parts, own_parts, rounds_parts, vb_dev, va_dev,
-             still_dev, broken_dev, maxc_dev))
+             still_dev, broken_dev, maxc_dev, pre_rounds_dev))
         if ctx.table_slots and int(max_count) > ctx.table_slots:
             # self-healing runs table-less and may concentrate replicas
             # past the broker-table width sized from the PRE-heal counts;
@@ -432,6 +462,8 @@ class GoalOptimizer:
                            in zip(self.goals, vb_h, own_h, va_h)}
         rounds_by_goal = {g.name: int(r)
                           for g, r in zip(self.goals, rounds_h)}
+        if int(pre_rounds):
+            rounds_by_goal["__prebalance__"] = int(pre_rounds)
 
         stats_by_goal: Dict[str, ClusterModelStats] = {}
         regressed: List[str] = []

@@ -123,7 +123,8 @@ class CruiseControl:
                  time_fn: Optional[Callable[[], float]] = None,
                  sleep_fn: Optional[Callable[[float], None]] = None,
                  monitor_kwargs: Optional[dict] = None,
-                 executor_kwargs: Optional[dict] = None) -> None:
+                 executor_kwargs: Optional[dict] = None,
+                 auto_warmup: bool = True) -> None:
         self._admin = admin
         self._time = time_fn or _time.time
         self._constraint = constraint or BalancingConstraint()
@@ -184,7 +185,7 @@ class CruiseControl:
             default_goals(names=self._goal_names,
                           max_rounds=max_optimization_rounds),
             self._constraint, balancedness_weights=balancedness_weights,
-            auto_warmup=True)
+            auto_warmup=auto_warmup)
         self._ple_optimizer = GoalOptimizer(
             [make_goal("PreferredLeaderElectionGoal")], self._constraint)
 

@@ -222,3 +222,25 @@ def test_stats_regression_waived_during_self_healing():
     assert not np.asarray(
         S.broker_replica_count(result.final_state))[
         ~np.asarray(state.broker_alive)].any()
+
+
+def test_warmup_aot_path_serves_optimizations():
+    """GoalOptimizer.warmup retains AOT executables and optimizations()
+    dispatches through them (the facade's auto_warmup path — its
+    production default; tests construct facades with auto_warmup=False
+    for wall-clock, so this is the dedicated coverage)."""
+    state, topo = fixtures.small_cluster()
+    goals = default_goals(max_rounds=16, names=[
+        "RackAwareGoal", "DiskCapacityGoal", "ReplicaDistributionGoal"])
+    opt = GoalOptimizer(goals, auto_warmup=True)
+    assert not opt._aot
+    result = opt.optimizations(state, topo)   # triggers the auto-warmup
+    assert opt._aot, "auto-warmup retained no AOT executables"
+    # every pipeline program was compiled, not only the executed ones
+    keys = set(opt._aot)
+    assert {"__stats__", "__pre__", "__post__"} <= keys
+    assert any(k.startswith("__seg_") for k in keys)
+    # the AOT dispatch returns the same result as a fresh jit path
+    ref = GoalOptimizer(goals).optimizations(state, topo)
+    assert np.array_equal(np.asarray(result.final_state.replica_broker),
+                          np.asarray(ref.final_state.replica_broker))

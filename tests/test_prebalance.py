@@ -1,0 +1,185 @@
+"""Contracts of the joint pre-balance pass (analyzer/prebalance.py) and
+the global leadership sweep (analyzer/leadership.py).
+
+These are the round-4 performance passes; their safety contracts (never
+create violations, honor add-broker semantics, respect single-commit
+fallbacks) are what lets them run before / inside the goal pipeline
+without weakening the verifier invariants."""
+import conftest  # noqa: F401
+
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions,
+                                                 make_context,
+                                                 make_round_cache)
+from cruise_control_tpu.analyzer.goals.base import Goal
+from cruise_control_tpu.analyzer.leadership import (global_leadership_sweep,
+                                                    limit_bounds,
+                                                    mean_bounds)
+from cruise_control_tpu.analyzer.prebalance import prebalance
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.sanity import sanity_check
+from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
+                                                       random_cluster)
+
+
+def _mk(seed=21, **kw):
+    spec = RandomClusterSpec(num_brokers=16, num_partitions=200,
+                             replication_factor=3, num_racks=4,
+                             num_topics=6, seed=seed, skew_fraction=0.5,
+                             **kw)
+    state, topo = random_cluster(spec)
+    ctx = make_context(state, BalancingConstraint(), OptimizationOptions(),
+                       topo)
+    return state, topo, ctx
+
+
+def _upper(state, ctx):
+    cap = np.asarray(state.broker_capacity)
+    up = np.minimum(np.asarray(ctx.balance_upper_pct),
+                    np.asarray(ctx.capacity_threshold))
+    return up[None, :] * cap
+
+
+def test_prebalance_reduces_over_band_and_keeps_invariants():
+    state, topo, ctx = _mk()
+    before_load = np.asarray(S.broker_load(state))
+    upper = _upper(state, ctx)
+    over_before = ((before_load > upper)
+                   & np.asarray(state.broker_alive)[:, None]).sum()
+    assert over_before > 0, "fixture must start unbalanced"
+    prc_before = np.asarray(S.partition_rack_count(state))
+
+    out, rounds = prebalance(state, ctx)
+    sanity_check(out)
+    assert int(rounds) > 0
+    after_load = np.asarray(S.broker_load(out))
+    over_after = ((after_load > upper)
+                  & np.asarray(out.broker_alive)[:, None]).sum()
+    assert over_after < over_before
+    # rack awareness can only improve: arrivals require a rack with no
+    # copy of the partition
+    prc_after = np.asarray(S.partition_rack_count(out))
+    assert (prc_after > 1).sum() <= (prc_before > 1).sum()
+
+
+def test_prebalance_never_creates_new_over_band_brokers():
+    state, topo, ctx = _mk(seed=7)
+    upper = _upper(state, ctx)
+    before = np.asarray(S.broker_load(state))
+    out, _ = prebalance(state, ctx)
+    after = np.asarray(S.broker_load(out))
+    newly_over = (after > upper) & ~(before > upper)
+    assert not newly_over.any(), np.argwhere(newly_over)
+
+
+def test_prebalance_inactive_dimensions_do_nothing():
+    state, topo, ctx = _mk()
+    out, rounds = prebalance(state, ctx,
+                             active_resources=(False,) * 4,
+                             balance_counts=False)
+    assert int(rounds) == 0
+    np.testing.assert_array_equal(np.asarray(out.replica_broker),
+                                  np.asarray(state.replica_broker))
+
+
+def test_prebalance_add_broker_targets_only_new_brokers():
+    spec = RandomClusterSpec(num_brokers=16, num_partitions=200,
+                             replication_factor=3, num_racks=4,
+                             num_topics=6, seed=3, skew_fraction=0.5,
+                             new_brokers=2)
+    state, topo = random_cluster(spec)
+    ctx = make_context(state, BalancingConstraint(), OptimizationOptions(),
+                       topo)
+    out, _ = prebalance(state, ctx)
+    moved = (np.asarray(out.replica_broker)
+             != np.asarray(state.replica_broker))
+    dest_new = np.asarray(state.broker_new)[np.asarray(out.replica_broker)]
+    assert not (moved & ~dest_new & np.asarray(out.replica_valid)).any(), \
+        "pre-balance moved a replica onto a pre-existing broker while " \
+        "new brokers exist"
+
+
+def _leader_counts(state):
+    return np.asarray(S.broker_leader_count(state)).astype(float)
+
+
+def test_sweep_mean_mode_contracts_leader_imbalance():
+    state, topo, ctx = _mk(seed=11)
+    counts0 = _leader_counts(state)
+    avg = counts0[np.asarray(state.broker_alive)].mean()
+
+    def upper_of(st, W):
+        alive = st.broker_alive
+        a = jnp.sum(W * alive) / jnp.maximum(jnp.sum(alive), 1)
+        return jnp.full((st.num_brokers,), jnp.ceil(a * 1.09) + 1)
+
+    out, rounds = global_leadership_sweep(
+        state, ctx, [],
+        measure=lambda c: c.leader_count.astype(jnp.float32),
+        value_r=jnp.ones(state.num_replicas, jnp.float32),
+        bounds=mean_bounds(upper_of), improve_gate=True)
+    counts1 = _leader_counts(out)
+    assert int(rounds) > 0
+    # total imbalance strictly shrinks, and no broker crosses the bound
+    assert np.abs(counts1 - avg).sum() < np.abs(counts0 - avg).sum()
+    upper = np.ceil(avg * 1.09) + 1
+    assert not ((counts1 > upper) & ~(counts0 > upper)).any()
+    sanity_check(out)
+
+
+def test_sweep_limit_mode_respects_hard_cap():
+    state, topo, ctx = _mk(seed=13)
+    from cruise_control_tpu.common.resources import Resource
+    res = int(Resource.CPU)
+    cache = make_round_cache(state)
+    W0 = np.asarray(cache.broker_load)[:, res]
+    limit = jnp.asarray(np.quantile(W0, 0.7) * np.ones(state.num_brokers,
+                                                       np.float32))
+    mid = limit * 0.8
+    out, rounds = global_leadership_sweep(
+        state, ctx, [],
+        measure=lambda c: c.broker_load[:, res],
+        value_r=(state.partition_leader_bonus[
+            state.replica_partition, res]
+            * state.replica_valid),
+        bounds=limit_bounds(limit, mid), improve_gate=False)
+    W1 = np.asarray(make_round_cache(out).broker_load)[:, res]
+    lim = np.asarray(limit)
+    assert (W0 > lim).sum() >= (W1 > lim).sum()
+    # no under-limit broker got pushed over the hard cap
+    assert not ((W1 > lim) & ~(W0 > lim)).any()
+
+
+class _OpaqueLeadershipGoal(Goal):
+    """Prior goal whose leadership acceptance is boolean-only
+    (leadership_headroom_terms None — the documented-safe default)."""
+
+    name = "OpaqueLeadershipGoal"
+
+    def optimize(self, state, ctx, prev_goals):  # pragma: no cover
+        return state
+
+    def leadership_headroom_terms(self, state, ctx, cache):
+        return None
+
+
+def test_sweep_single_commit_fallback_for_opaque_prior_goal():
+    state, topo, ctx = _mk(seed=11)
+    counts0 = _leader_counts(state)
+
+    def upper_of(st, W):
+        return jnp.full((st.num_brokers,), jnp.inf)
+
+    out, rounds = global_leadership_sweep(
+        state, ctx, [_OpaqueLeadershipGoal()],
+        measure=lambda c: c.leader_count.astype(jnp.float32),
+        value_r=jnp.ones(state.num_replicas, jnp.float32),
+        bounds=mean_bounds(upper_of), improve_gate=True, max_rounds=1)
+    counts1 = _leader_counts(out)
+    delta = counts1 - counts0
+    # one round, opaque prior goal: at most ONE transfer in and out per
+    # broker (the boolean snapshot validates single actions only)
+    assert delta.max() <= 1.0 and delta.min() >= -1.0

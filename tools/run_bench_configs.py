@@ -1,0 +1,63 @@
+"""Run bench.py across the five BASELINE.json eval configs and collect
+one JSON line each into BENCH_CONFIGS_r{N}.json (round-3 VERDICT missing
+item 2: per-round eval-config results must be published every round).
+
+Usage: python tools/run_bench_configs.py <round-number> [configs...]
+Writes BENCH_CONFIGS_r{N}.json at the repo root with one object per
+config: the bench metric line plus the violated-broker stderr summary.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_config(cfg: str) -> dict:
+    env = dict(os.environ, BENCH_CONFIG=cfg)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=ROOT)
+    out = proc.stdout.strip().splitlines()
+    try:
+        metric = json.loads(out[-1]) if out else {}
+        if not isinstance(metric, dict):
+            metric = {"raw": metric}
+    except ValueError:
+        metric = {"error": "unparseable stdout", "last_line": out[-1][:200]}
+    summary = {}
+    for line in proc.stderr.splitlines():
+        m = re.match(r"# (proposals|violated broker counts|rounds by goal)"
+                     r"[ :](.*)", line)
+        if m:
+            summary[m.group(1)] = m.group(2).strip()
+    metric["config"] = cfg
+    metric["summary"] = summary
+    metric["rc"] = proc.returncode
+    if proc.returncode:
+        metric["stderr_tail"] = proc.stderr.strip().splitlines()[-5:]
+    return metric
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        sys.exit("usage: run_bench_configs.py <round-number> [configs...]")
+    rnd = int(sys.argv[1])
+    configs = sys.argv[2:] or ["1", "2", "3", "4", "5"]
+    results = []
+    for cfg in configs:
+        print(f"# running BENCH_CONFIG={cfg} ...", file=sys.stderr,
+              flush=True)
+        results.append(run_config(cfg))
+        print(json.dumps(results[-1])[:300], file=sys.stderr, flush=True)
+    path = os.path.join(ROOT, f"BENCH_CONFIGS_r{rnd:02d}.json")
+    with open(path, "w") as f:
+        for r in results:
+            f.write(json.dumps(r) + "\n")
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
