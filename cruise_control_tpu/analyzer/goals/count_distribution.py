@@ -229,12 +229,19 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
         # band floors).  The per-broker phases below then handle only
         # what re-election cannot: replica MOVES and floor-blocked
         # refuels.
+        # NEGATIVE RESULT (round 4, measured at north): enabling the
+        # sweep's refuel sub-round here (refuel_floor_of/_value_r) kept
+        # the loop alive +39 rounds (51 -> 90, segment 11.1 -> 15.6 s)
+        # and the violated residual did NOT improve (194 -> 205): the
+        # floor-pinned brokers' imports are themselves vetoed or do not
+        # unlock enough sheds — the residual is strict-priority
+        # semantics, pinned by tests/test_leader_semantics.py.
         state, sweep_rounds = global_leadership_sweep(
             state, ctx, prev_goals,
             measure=lambda cache: cache.leader_count.astype(jnp.float32),
             value_r=jnp.ones(state.num_replicas, jnp.float32),
             bounds=mean_bounds(_upper_of), improve_gate=True,
-            max_rounds=48,
+            max_rounds=72,
             # same-deficit receivers tie-break toward LOW bytes-in so the
             # bulk count transfers also even out the later
             # LeaderBytesInDistributionGoal's surface instead of

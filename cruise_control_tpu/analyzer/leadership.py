@@ -59,6 +59,12 @@ from cruise_control_tpu.analyzer.context import (OptimizationContext,
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
 
+#: per-round cap on sweep transfers — the [P]-wide proposal planes
+#: compact to this many live candidates before the acceptance stack and
+#: rank_accept sorts run (see round_body; commits per round measure in
+#: the hundreds-to-low-thousands)
+SWEEP_COMPACT = 4096
+
 
 def global_leadership_sweep(
         state: ClusterState, ctx: OptimizationContext,
@@ -101,8 +107,15 @@ def global_leadership_sweep(
         its thousands of transfers do not scramble the later
         LeaderBytesInDistributionGoal's surface (measured round 4:
         without it LBI's violated count rose 157 -> 181 at north).
-
     Returns (state, rounds_used); traceable.
+
+    A floor-unblocking "refuel" sub-round (importing high-bonus
+    leaderships into brokers pinned at a prior goal's band floor, fired
+    on stalled rounds) was built and MEASURED NEGATIVE here in round 4:
+    +39 rounds at north with no residual improvement (194 -> 205) — the
+    pinned brokers' imports are themselves vetoed.  The residual is
+    strict-priority semantics, pinned by tests/test_leader_semantics.py;
+    do not rebuild the sub-round without new evidence.
     """
     from cruise_control_tpu.analyzer.goals.base import (
         compose_leadership_acceptance, leadership_commit_terms)
@@ -160,6 +173,25 @@ def global_leadership_sweep(
         dst_r = jnp.take_along_axis(rows_safe, best[:, None], axis=1)[:, 0]
         has = live & jnp.any(ok, axis=1)
         dst_b = st.replica_broker[dst_r]
+        gain = value_leave                               # bigger sheds first
+
+        # compact the [P]-wide proposal set to the top live candidates
+        # before the acceptance stack and the ranked-prefix sorts: a
+        # round commits at most a few thousand transfers, while the
+        # rank_accept lexsorts and every prior goal's acceptance
+        # evaluated over all 200K partitions measured ~200 ms/round at
+        # north scale.  STRONG salted jitter rotates candidates through
+        # the window across rounds: the acceptance stack runs after
+        # compaction, so without rotation vetoed candidates can occupy
+        # the window while acceptable ones wait outside (measured: weak
+        # 0.1 jitter left 233 violated vs 194 with full-width
+        # acceptance).
+        gain_sel = (gain * (1.0 + 0.75 * kernels.salted_jitter(
+            gain.shape[0], (salt * 100.0).astype(jnp.int32))))
+        (sel, gain, has, cur_safe, src_b, dst_r, dst_b,
+         value_leave) = kernels.compact_candidates(
+            SWEEP_COMPACT, gain_sel, has, cur_safe, src_b, dst_r, dst_b,
+            value_leave)
 
         # previously-optimized goals' boolean acceptance on the chosen
         # transfer (single-action snapshot)
@@ -167,7 +199,6 @@ def global_leadership_sweep(
         has &= accept(cur_safe, dst_r)
 
         lt_d, lt_s = leadership_commit_terms(prev_goals, st, ctx, cache)
-        gain = value_leave                               # bigger sheds first
 
         # a prior goal whose leadership acceptance is NOT quantitative
         # (leadership_headroom_terms None — the documented-safe default)
@@ -199,21 +230,28 @@ def global_leadership_sweep(
         return new_st, cache, jnp.any(valid)
 
     def cond(carry):
-        st, cache, rounds, progressed = carry
+        st, cache, rounds, dry = carry
         W = measure(cache)
         shed_to, _, _ = bounds(st, W)
         work = jnp.any(st.broker_alive & (W > shed_to))
-        return progressed & work & (rounds < max_rounds)
+        # a zero-commit round does NOT end the sweep immediately: the
+        # compaction window holds only SWEEP_COMPACT of the [P] proposals
+        # and the acceptance stack runs after compaction, so a starved
+        # window needs the salted-jitter rotation of the NEXT rounds to
+        # reach the feasible candidates outside it (review finding,
+        # round 4); three consecutive dry rounds end it.
+        return (dry < 3) & work & (rounds < max_rounds)
 
     def body(carry):
-        st, cache, rounds, _ = carry
+        st, cache, rounds, dry = carry
         st, cache, committed = round_body(st, cache,
                                           rounds.astype(jnp.float32) * 0.37)
-        return st, cache, rounds + 1, committed
+        dry = jnp.where(committed, 0, dry + 1)
+        return st, cache, rounds + 1, dry
 
     state, _, rounds, _ = jax.lax.while_loop(
         cond, body, (state, make_round_cache(state, 0, ctx),
-                     jnp.zeros((), jnp.int32), jnp.ones((), bool)))
+                     jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)))
     return state, rounds
 
 

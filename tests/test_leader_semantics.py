@@ -1,0 +1,158 @@
+"""Hand-derived differential fixture: the leader-goal residual is
+strict-priority SEMANTICS, not a search failure.
+
+Round-3/4 VERDICT ask: LeaderReplicaDistribution leaves a violated
+residual at 2.6K-broker scale whose transfers are vetoed by the
+higher-priority CPU/NW_OUT usage goals' acceptance.  This fixture pins
+the mechanism at hand-checkable size against the reference's acceptance
+rules (reference ResourceDistributionGoal.actionAcceptance,
+cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/analyzer/
+goals/ResourceDistributionGoal.java:93-140):
+
+  * LEADERSHIP_MOVEMENT src->dst is ACCEPTed only if, when both ends
+    start inside the balance band, the destination stays under the upper
+    bound AND the source stays over the lower bound after the bonus
+    moves (the strict branch); when an end starts outside the band, the
+    destination must not end up more loaded than the source (the
+    relaxed branch).
+
+Fixture: broker 0 leads six tiny-CPU partitions (leader-count 6 vs a
+count band upper of 4 — violated); brokers 1-3 each lead one 40-CPU
+partition, so broker 0 sits far BELOW the CPU balance band while every
+possible receiver sits at/above its upper edge.  Every action that could
+fix broker 0's leader count is then vetoed by the reference's own rules:
+
+  * shedding leadership 0->k: broker 0 is under the CPU band, so the
+    relaxed branch applies, and every receiver is already MORE
+    CPU-loaded than broker 0 — rejected;
+  * moving a leader replica 0->k: the receiver is above the CPU band
+    upper, so the relaxed branch applies and fails the same way;
+  * refueling broker 0 with a big-CPU leadership (to lift it toward the
+    band): the 40-CPU bonus overshoots the band upper at broker 0 and
+    drops the donor below its lower bound — the strict branch rejects.
+
+The TPU pipeline must therefore leave broker 0 over the leader-count
+band — matching what the reference's greedy would do — and that is
+asserted here, together with the per-action vetoes."""
+import conftest  # noqa: F401
+
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions,
+                                                 make_context,
+                                                 make_round_cache)
+from cruise_control_tpu.analyzer.goals.count_distribution import (
+    LeaderReplicaDistributionGoal, _count_bounds)
+from cruise_control_tpu.analyzer.goals.resource_distribution import (
+    CpuUsageDistributionGoal)
+from cruise_control_tpu.common.resources import Resource as R
+from cruise_control_tpu.model import state as S
+from cruise_control_tpu.model.builder import ClusterModelBuilder
+
+CAPACITY = {R.CPU: 100.0, R.NW_IN: 1000.0, R.NW_OUT: 1000.0,
+            R.DISK: 2000.0}
+
+
+def _fixture():
+    b = ClusterModelBuilder()
+    for broker, rack in ((0, "A"), (1, "A"), (2, "B"), (3, "B")):
+        b.add_broker(broker, rack, CAPACITY)
+    # six tiny-CPU partitions led by broker 0, followers spread on 1-3
+    for p in range(6):
+        b.add_partition("small", p, 0, [1 + p % 3],
+                        {R.CPU: 3.0, R.NW_IN: 10.0, R.NW_OUT: 10.0,
+                         R.DISK: 10.0})
+    # one heavy-CPU partition led by each of brokers 1-3; the first one
+    # keeps its follower on broker 0 (the refuel candidate whose veto is
+    # asserted below — its follower base CPU is small, so broker 0 stays
+    # far below the band), the rest chain among 1-3
+    for i, leader in enumerate((1, 2, 3)):
+        chain = 1 + (i + 1) % 3
+        followers = [0, chain] if i == 0 else [chain]
+        b.add_partition("big", i, leader, followers,
+                        {R.CPU: 40.0, R.NW_IN: 10.0, R.NW_OUT: 10.0,
+                         R.DISK: 10.0})
+    return b.build()
+
+
+def test_fixture_shape_matches_derivation():
+    state, topo = _fixture()
+    ctx = make_context(state, BalancingConstraint(), OptimizationOptions(),
+                       topo)
+    cache = make_round_cache(state)
+    counts = np.asarray(cache.leader_count, dtype=float)
+    avg = counts.mean()
+    lo, up = _count_bounds(jnp.asarray(avg), 0.09)
+    assert counts[0] > float(up), (counts, float(up))
+
+    cpu = np.asarray(cache.broker_load)[:, R.CPU]
+    lower = float(np.asarray(ctx.balance_lower_pct)[R.CPU]) * 100.0
+    upper = float(np.asarray(ctx.balance_upper_pct)[R.CPU]) * 100.0
+    # broker 0 far below the CPU band; every receiver at/above its upper
+    assert cpu[0] < lower, (cpu, lower)
+    assert (cpu[1:] > upper).all(), (cpu, upper)
+
+
+def test_every_fixing_action_is_vetoed_by_cpu_goal():
+    state, topo = _fixture()
+    ctx = make_context(state, BalancingConstraint(), OptimizationOptions(),
+                       topo)
+    cache = make_round_cache(state)
+    cpu_goal = CpuUsageDistributionGoal()
+    rows = np.asarray(ctx.partition_replicas)
+    cur = np.asarray(S.partition_leader_replica(state))
+    broker_of = np.asarray(state.replica_broker)
+
+    shed_vetoed = refuel_vetoed = 0
+    for p in range(state.num_partitions):
+        leader = cur[p]
+        for r in rows[p]:
+            if r < 0 or r == leader:
+                continue
+            ok = bool(np.asarray(cpu_goal.accept_leadership(
+                state, ctx, cache, jnp.asarray(leader), jnp.asarray(r))))
+            if broker_of[leader] == 0:
+                # shedding broker 0's leadership: relaxed branch (source
+                # below band) requires the receiver to end up no more
+                # loaded than broker 0 — impossible here
+                assert not ok, (p, leader, r)
+                shed_vetoed += 1
+            elif broker_of[r] == 0:
+                # refueling broker 0 with a 40-CPU leadership: strict
+                # branch fails both ends
+                assert not ok, (p, leader, r)
+                refuel_vetoed += 1
+    assert shed_vetoed >= 6 and refuel_vetoed >= 1
+
+    # the replica-move fallback is vetoed the same way: receivers are
+    # above the CPU band upper, so the relaxed branch compares loads
+    for r_id in np.nonzero(broker_of == 0)[0]:
+        for dest in (1, 2, 3):
+            ok = bool(np.asarray(cpu_goal.accept_move(
+                state, ctx, cache, jnp.asarray(int(r_id)),
+                jnp.asarray(dest))))
+            assert not ok, (int(r_id), dest)
+
+
+def test_pipeline_leaves_the_semantic_residual():
+    state, topo = _fixture()
+    ctx = make_context(state, BalancingConstraint(), OptimizationOptions(),
+                       topo)
+    cpu_goal = CpuUsageDistributionGoal()
+    leader_goal = LeaderReplicaDistributionGoal(max_rounds=32)
+    out = leader_goal.optimize(state, ctx, (cpu_goal,))
+    counts = np.asarray(S.broker_leader_count(out), dtype=float)
+    # broker 0 remains over the count band — the same residual the
+    # reference's greedy leaves, because every fixing action fails its
+    # acceptance rules (asserted action-by-action above)
+    avg = counts.mean()
+    _, up = _count_bounds(jnp.asarray(avg), 0.09)
+    assert counts[0] > float(up), counts
+    # and leadership never left broker 0's partitions' original owners
+    # in a way that violates the CPU goal's band
+    cache = make_round_cache(out)
+    cpu = np.asarray(cache.broker_load)[:, R.CPU]
+    upper = float(np.asarray(ctx.balance_upper_pct)[R.CPU]) * 100.0
+    assert (cpu[1:] <= upper * 1.5).all()
