@@ -76,6 +76,7 @@ def global_leadership_sweep(
         improve_gate: bool,
         max_rounds: int = 24,
         dest_tiebreak: Optional[Callable[[RoundCache], jax.Array]] = None,
+        select_jitter: float = 1.0,
 ) -> Tuple[ClusterState, jax.Array]:
     """Run whole-cluster leadership re-election rounds.
 
@@ -180,18 +181,31 @@ def global_leadership_sweep(
         # round commits at most a few thousand transfers, while the
         # rank_accept lexsorts and every prior goal's acceptance
         # evaluated over all 200K partitions measured ~200 ms/round at
-        # north scale.  STRONG salted jitter rotates candidates through
-        # the window across rounds: the acceptance stack runs after
-        # compaction, so without rotation vetoed candidates can occupy
-        # the window while acceptable ones wait outside (measured: weak
-        # 0.1 jitter left 233 violated vs 194 with full-width
-        # acceptance).
-        gain_sel = (gain * (1.0 + 0.75 * kernels.salted_jitter(
-            gain.shape[0], (salt * 100.0).astype(jnp.int32))))
-        (sel, gain, has, cur_safe, src_b, dst_r, dst_b,
-         value_leave) = kernels.compact_candidates(
+        # north scale.  WINDOW SELECTION and COMMIT RANKING are split:
+        # selection adds full-spread salted jitter so rotation reaches
+        # every candidate across rounds (the acceptance stack runs after
+        # compaction — without full-range rotation, vetoed occupants
+        # whose gain exceeds the feasible tail's would hold the window
+        # until the dry-round exit; measured: weak 0.1 jitter left 233
+        # violated vs 194 with full-width acceptance), while rank_accept
+        # still orders the window by the TRUE gain (bigger sheds first).
+        # select_jitter scales the rotation: 1.0 (full spread) for
+        # uniform-gain sweeps (leader counts — any window member is as
+        # good as any other, rotation coverage is everything); smaller
+        # for value-weighted sweeps (bytes-in), where a mostly-greedy
+        # window preserves progress-per-round (measured at north: full
+        # rotation on the bytes-in sweep left its residual at 266 —
+        # barely below the 269 start — while the count sweep improved
+        # 201 -> 116)
+        g_lo = jnp.min(jnp.where(has, gain, jnp.inf))
+        g_hi = jnp.max(jnp.where(has, gain, -jnp.inf))
+        amp = jnp.where(g_hi > g_lo, g_hi - g_lo, 1.0) * select_jitter
+        gain_sel = gain + amp * kernels.salted_jitter(
+            gain.shape[0], (salt * 100.0).astype(jnp.int32))
+        (sel, _, has, cur_safe, src_b, dst_r, dst_b,
+         value_leave, gain) = kernels.compact_candidates(
             SWEEP_COMPACT, gain_sel, has, cur_safe, src_b, dst_r, dst_b,
-            value_leave)
+            value_leave, gain)
 
         # previously-optimized goals' boolean acceptance on the chosen
         # transfer (single-action snapshot)

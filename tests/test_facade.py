@@ -18,8 +18,19 @@ from cruise_control_tpu.monitor.sampling.sampler import (
     SimulatedClusterSampler)
 
 
+#: facade tests exercise the facade FLOW (model building, caching,
+#: execution, detection wiring), not goal breadth — a four-goal stack
+#: cuts the ~55 s/test pipeline tracing cost on the 1-core CI host ~4x
+#: while test_goal_stack/test_random_goal_order keep the full default
+#: stack covered
+FACADE_TEST_GOALS = ["RackAwareGoal", "DiskCapacityGoal",
+                     "ReplicaDistributionGoal",
+                     "DiskUsageDistributionGoal"]
+
+
 def make_stack(num_brokers=4, partitions=12, rf=2, skewed=True,
-               notifier=None, assignment_pool=None, auto_warmup=False):
+               notifier=None, assignment_pool=None, auto_warmup=False,
+               goal_names=None):
     """assignment_pool limits which brokers initially host replicas (e.g.
     a freshly added broker starts empty).
 
@@ -58,7 +69,8 @@ def make_stack(num_brokers=4, partitions=12, rf=2, skewed=True,
                             min_samples_per_window=1,
                             sampling_interval_ms=5_000),
         executor_kwargs=dict(progress_check_interval_s=1.0),
-        auto_warmup=auto_warmup)
+        auto_warmup=auto_warmup,
+        goal_names=list(goal_names or FACADE_TEST_GOALS))
     return sim, cc, clock
 
 
