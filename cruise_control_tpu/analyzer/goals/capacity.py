@@ -67,13 +67,20 @@ class CapacityGoal(Goal):
             # of a table round's cost (analyzer/leadership.py); the
             # table rounds below then handle replica moves and residuals
             from cruise_control_tpu.analyzer.leadership import (
-                global_leadership_sweep, limit_bounds)
+                VALUE_WEIGHTED_SELECT_JITTER, global_leadership_sweep,
+                limit_bounds)
             state, sweep_rounds = global_leadership_sweep(
                 state, ctx, prev_goals,
                 measure=lambda cache: cache.broker_load[:, res],
                 value_r=bonus,
                 bounds=limit_bounds(self._limit(state, ctx), mid_w),
-                improve_gate=False)
+                improve_gate=False,
+                # value-weighted sweep: greedy-biased window selection
+                # (full-spread rotation measured harmful for
+                # value-weighted sweeps — see select_jitter; a
+                # remove-broker run aborted on an unconverged
+                # CpuCapacityGoal with full rotation here)
+                select_jitter=VALUE_WEIGHTED_SELECT_JITTER)
             note_rounds(sweep_rounds)
 
         def round_body(st: ClusterState, cache):

@@ -169,7 +169,8 @@ class LeaderBytesInDistributionGoal(Goal):
     def optimize(self, state: ClusterState, ctx: OptimizationContext,
                  prev_goals: Sequence[Goal]) -> ClusterState:
         from cruise_control_tpu.analyzer.leadership import (
-            global_leadership_sweep, mean_bounds)
+            VALUE_WEIGHTED_SELECT_JITTER, global_leadership_sweep,
+            mean_bounds)
 
         def _upper_of(st, W):
             alive = st.broker_alive
@@ -188,7 +189,7 @@ class LeaderBytesInDistributionGoal(Goal):
             measure=lambda cache: cache.leader_bytes_in,
             value_r=value_r,
             bounds=mean_bounds(_upper_of), improve_gate=True,
-            max_rounds=72, select_jitter=0.35)
+            max_rounds=72, select_jitter=VALUE_WEIGHTED_SELECT_JITTER)
         note_rounds(sweep_rounds)
 
         base_movable = replica_static_ok(state, ctx)

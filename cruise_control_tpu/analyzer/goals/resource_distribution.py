@@ -91,13 +91,15 @@ class ResourceDistributionGoal(Goal):
             # phase_a's table-round cost; phase_a remains as the
             # residual backstop
             from cruise_control_tpu.analyzer.leadership import (
-                global_leadership_sweep, limit_bounds)
+                VALUE_WEIGHTED_SELECT_JITTER, global_leadership_sweep,
+                limit_bounds)
             state, sweep_rounds = global_leadership_sweep(
                 state, ctx, prev_goals,
                 measure=lambda cache: cache.broker_load[:, res],
                 value_r=bonus,
                 bounds=limit_bounds(upper, (upper + lower) / 2.0),
-                improve_gate=False)
+                improve_gate=False,
+                select_jitter=VALUE_WEIGHTED_SELECT_JITTER)
             note_rounds(sweep_rounds)
 
         def phase_a(st, cache):

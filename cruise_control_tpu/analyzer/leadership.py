@@ -65,6 +65,14 @@ from cruise_control_tpu.model.state import ClusterState
 #: the hundreds-to-low-thousands)
 SWEEP_COMPACT = 4096
 
+#: greedy-bias factor for VALUE-WEIGHTED sweeps' window selection
+#: (bytes-in, CPU/NW_OUT limit mode): full-spread rotation there
+#: measured harmful — bytes-in residual 266 vs 220, and one
+#: remove-broker run aborted on an unconverged CpuCapacityGoal —
+#: while uniform-gain count sweeps keep select_jitter=1.0 (rotation
+#: coverage is everything when every candidate's gain is equal)
+VALUE_WEIGHTED_SELECT_JITTER = 0.35
+
 
 def global_leadership_sweep(
         state: ClusterState, ctx: OptimizationContext,
