@@ -389,6 +389,99 @@ def make_round_cache(state: ClusterState, table_slots: int = 0,
 
 
 # ---------------------------------------------------------------------------
+# Cache threading across goals.
+#
+# Rebuilding the RoundCache at every goal's entry measured 327 ms at
+# 2.6K-broker/600K-replica scale (the [R] argsort of build_broker_table
+# plus the [B, S, ·] aux gathers), and the table-less form 138 ms — with
+# ~15 goal entries plus per-goal violation counts that was ~6-9 s of the
+# 37 s north solve spent recomputing state the previous goal already
+# held.  A goal's incremental maintenance (update_cache_for_*) ends with
+# a cache that exactly describes its final state, so the optimizer
+# threads it into the next goal (Goal.optimize_cached) and rebuilds only
+# what a phase invalidated (the reference's analog: ClusterModel's
+# incrementally-maintained Load/Broker aggregates live across ALL goals
+# of one optimization, GoalOptimizer.java:409-480).
+# ---------------------------------------------------------------------------
+
+
+def ensure_full_cache(state: ClusterState, ctx: "OptimizationContext",
+                      cache: Optional[RoundCache]) -> RoundCache:
+    """A cache WITH a broker table when ctx.table_slots demands one:
+    None → full build; a table-less carried cache → attach a table while
+    reusing its float aggregates; a full cache → unchanged."""
+    if cache is None:
+        return make_round_cache(state, ctx.table_slots, ctx)
+    if ctx.table_slots and cache.broker_table.shape[1] != ctx.table_slots:
+        table, fill = build_broker_table(state, ctx.table_slots)
+        t_load, t_bonus, t_leader, t_ok = _gather_aux_tables(state, table,
+                                                             ctx)
+        from cruise_control_tpu.parallel.mesh import constrain_cache
+        return constrain_cache(dataclasses.replace(
+            cache, broker_table=table, table_fill=fill, table_load=t_load,
+            table_bonus=t_bonus, table_leader=t_leader, table_ok=t_ok,
+            replica_ok=replica_static_ok(state, ctx)))
+    return cache
+
+
+def strip_table(cache: RoundCache) -> RoundCache:
+    """Detach the broker table (0-width planes): the leadership sweep
+    runs table-less because per-commit slot lookups would dominate its
+    round cost (see analyzer/leadership.py module docstring)."""
+    num_b = cache.broker_load.shape[0]
+    return dataclasses.replace(
+        cache,
+        broker_table=jnp.zeros((num_b, 0), dtype=jnp.int32),
+        table_fill=jnp.zeros((num_b,), dtype=jnp.int32),
+        table_load=jnp.zeros((num_b, 0, NUM_RESOURCES), dtype=jnp.float32),
+        table_bonus=jnp.zeros((num_b, 0, NUM_RESOURCES), dtype=jnp.float32),
+        table_leader=jnp.zeros((num_b, 0), dtype=bool),
+        table_ok=jnp.zeros((num_b, 0), dtype=bool))
+
+
+def reattach_table(state: ClusterState, cache: RoundCache,
+                   table: jax.Array, fill: jax.Array, t_bonus: jax.Array,
+                   t_ok: jax.Array, replica_ok: jax.Array) -> RoundCache:
+    """Reattach a detached broker table after leadership-only commits:
+    membership (ids/fill) and the static planes (bonus, ok) are
+    transfer-invariant, so only the role-dependent planes (current-role
+    load, leader flags) re-gather from the post-transfer state — ~3×
+    cheaper than a full rebuild (no [R] argsort, two gathers instead of
+    four)."""
+    num_r = state.num_replicas
+    tab_safe = jnp.minimum(table, num_r - 1)
+    pad = table >= num_r
+    t_load = S.replica_current_load(state)[tab_safe]
+    t_leader = state.replica_is_leader[tab_safe] & ~pad
+    from cruise_control_tpu.parallel.mesh import constrain_cache
+    return constrain_cache(dataclasses.replace(
+        cache, broker_table=table, table_fill=fill, table_load=t_load,
+        table_bonus=t_bonus, table_leader=t_leader, table_ok=t_ok,
+        replica_ok=replica_ok))
+
+
+def refresh_float_aggregates(state: ClusterState,
+                             cache: RoundCache) -> RoundCache:
+    """Recompute the drift-prone FLOAT aggregates from state.
+
+    Integer counts and table membership stay exact under scatter
+    maintenance, but float scatter-adds accumulate f32 rounding across
+    the hundreds of rounds a threaded cache now lives through; the
+    optimizer refreshes at segment boundaries so drift stays bounded by
+    one segment's commits (table_load is deliberately NOT refreshed —
+    it only ranks candidates, and its refresh is a [B, S, RES] gather)."""
+    load = S.broker_load(state)
+    cap = jnp.maximum(state.broker_capacity, 1e-9)
+    return dataclasses.replace(
+        cache, broker_load=load, broker_util=load / cap,
+        replica_load=S.replica_current_load(state),
+        potential_nw_out=S.potential_leadership_load(state),
+        leader_bytes_in=jax.ops.segment_sum(
+            leader_nw_in(state), state.replica_broker,
+            num_segments=state.num_brokers))
+
+
+# ---------------------------------------------------------------------------
 # Incremental cache maintenance.
 #
 # Rebuilding the RoundCache is O(R) in scatter-based segment reductions —

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
-                                                 make_round_cache,
+                                                 ensure_full_cache,
                                                  replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
@@ -46,8 +46,8 @@ class CapacityGoal(Goal):
         res = int(self.resource)
         return state.broker_capacity[:, res] * ctx.capacity_threshold[res]
 
-    def optimize(self, state: ClusterState, ctx: OptimizationContext,
-                 prev_goals: Sequence[Goal]) -> ClusterState:
+    def optimize_cached(self, state: ClusterState, ctx: OptimizationContext,
+                        prev_goals: Sequence[Goal], cache=None):
         res = int(self.resource)
         leadership_helps = self.resource in (Resource.NW_OUT, Resource.CPU)
 
@@ -67,10 +67,10 @@ class CapacityGoal(Goal):
             # of a table round's cost (analyzer/leadership.py); the
             # table rounds below then handle replica moves and residuals
             from cruise_control_tpu.analyzer.leadership import (
-                VALUE_WEIGHTED_SELECT_JITTER, global_leadership_sweep,
-                limit_bounds)
-            state, sweep_rounds = global_leadership_sweep(
-                state, ctx, prev_goals,
+                VALUE_WEIGHTED_SELECT_JITTER, limit_bounds,
+                run_sweep_threaded)
+            state, sweep_rounds, cache = run_sweep_threaded(
+                state, ctx, prev_goals, cache,
                 measure=lambda cache: cache.broker_load[:, res],
                 value_r=bonus,
                 bounds=limit_bounds(self._limit(state, ctx), mid_w),
@@ -150,11 +150,11 @@ class CapacityGoal(Goal):
             st, cache, committed = round_body(st, cache)
             return st, cache, rounds + 1, committed
 
-        state, _, rounds, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
+        state, cache, rounds, _ = jax.lax.while_loop(
+            cond, body, (state, ensure_full_cache(state, ctx, cache),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         note_rounds(rounds)
-        return state
+        return state, cache
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         """Destination must stay under capacity threshold
@@ -248,8 +248,8 @@ class ReplicaCapacityGoal(Goal):
     def __init__(self, max_rounds: int = 64):
         self.max_rounds = max_rounds
 
-    def optimize(self, state: ClusterState, ctx: OptimizationContext,
-                 prev_goals: Sequence[Goal]) -> ClusterState:
+    def optimize_cached(self, state: ClusterState, ctx: OptimizationContext,
+                        prev_goals: Sequence[Goal], cache=None):
         limit = float(ctx.max_replicas_per_broker)
 
         multi_k = 4 if dest_side_only(prev_goals) else 1
@@ -290,11 +290,11 @@ class ReplicaCapacityGoal(Goal):
             st, cache, committed = round_body(st, cache)
             return st, cache, rounds + 1, committed
 
-        state, _, rounds, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
+        state, cache, rounds, _ = jax.lax.while_loop(
+            cond, body, (state, ensure_full_cache(state, ctx, cache),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         note_rounds(rounds)
-        return state
+        return state, cache
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         limit = ctx.max_replicas_per_broker

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
-                                                 make_round_cache,
+                                                 ensure_full_cache,
                                                  replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
@@ -67,8 +67,8 @@ class ReplicaDistributionGoal(Goal):
         alive = state.broker_alive
         return jnp.sum(counts * alive) / jnp.maximum(jnp.sum(alive), 1)
 
-    def optimize(self, state: ClusterState, ctx: OptimizationContext,
-                 prev_goals: Sequence[Goal]) -> ClusterState:
+    def optimize_cached(self, state: ClusterState, ctx: OptimizationContext,
+                        prev_goals: Sequence[Goal], cache=None):
 
         # bounds pivot on the alive-broker average replica count, which is
         # invariant under moves (total count and alive set are fixed), so
@@ -133,7 +133,8 @@ class ReplicaDistributionGoal(Goal):
 
         return run_phase_sweeps(
             state, [(phase_shed, over_exists), (phase_fill, under_exists)],
-            self.rounds_for(ctx), table_slots=ctx.table_slots, ctx=ctx)
+            self.rounds_for(ctx), table_slots=ctx.table_slots, ctx=ctx,
+            cache=ensure_full_cache(state, ctx, cache))
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         counts = self._counts(cache)
@@ -204,8 +205,8 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
     def _counts(self, cache) -> jax.Array:
         return cache.leader_count.astype(jnp.float32)
 
-    def optimize(self, state: ClusterState, ctx: OptimizationContext,
-                 prev_goals: Sequence[Goal]) -> ClusterState:
+    def optimize_cached(self, state: ClusterState, ctx: OptimizationContext,
+                        prev_goals: Sequence[Goal], cache=None):
         """Leadership transfers first; when transfers alone cannot balance
         (e.g. an over-count broker leads partitions whose followers all sit
         on other over-count brokers), fall back to MOVING leader replicas
@@ -213,7 +214,7 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
         rebalanceForBroker: maybeApplyBalancingAction with
         LEADERSHIP_MOVEMENT then INTER_BROKER_REPLICA_MOVEMENT)."""
         from cruise_control_tpu.analyzer.leadership import (
-            global_leadership_sweep, mean_bounds)
+            mean_bounds, run_sweep_threaded)
 
         def _upper_of(st, W):
             alive = st.broker_alive
@@ -236,8 +237,8 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
         # floor-pinned brokers' imports are themselves vetoed or do not
         # unlock enough sheds — the residual is strict-priority
         # semantics, pinned by tests/test_leader_semantics.py.
-        state, sweep_rounds = global_leadership_sweep(
-            state, ctx, prev_goals,
+        state, sweep_rounds, cache = run_sweep_threaded(
+            state, ctx, prev_goals, cache,
             measure=lambda cache: cache.leader_count.astype(jnp.float32),
             value_r=jnp.ones(state.num_replicas, jnp.float32),
             bounds=mean_bounds(_upper_of), improve_gate=True,
@@ -384,7 +385,8 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
             state, [(phase_transfer, over_exists),
                     (phase_move, over_exists),
                     (phase_refuel, over_exists, 2)],
-            self.rounds_for(ctx), table_slots=ctx.table_slots, ctx=ctx)
+            self.rounds_for(ctx), table_slots=ctx.table_slots, ctx=ctx,
+            cache=ensure_full_cache(state, ctx, cache))
 
     def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
         counts = self._counts(cache)
@@ -431,8 +433,8 @@ class TopicReplicaDistributionGoal(Goal):
             jnp.minimum(avg * (1 - self.pct_margin), avg - 1), 0.0))
         return lower, upper                                        # [T], [T]
 
-    def optimize(self, state: ClusterState, ctx: OptimizationContext,
-                 prev_goals: Sequence[Goal]) -> ClusterState:
+    def optimize_cached(self, state: ClusterState, ctx: OptimizationContext,
+                        prev_goals: Sequence[Goal], cache=None):
 
         def round_body(st: ClusterState, cache, salt):
             tc = cache.broker_topic_count.astype(jnp.float32)          # [B,T]
@@ -481,11 +483,11 @@ class TopicReplicaDistributionGoal(Goal):
             st, cache, committed = round_body(st, cache, rounds)
             return st, cache, rounds + 1, committed
 
-        state, _, rounds, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
+        state, cache, rounds, _ = jax.lax.while_loop(
+            cond, body, (state, ensure_full_cache(state, ctx, cache),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         note_rounds(rounds)
-        return state
+        return state, cache
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         tc = cache.broker_topic_count.astype(jnp.float32)

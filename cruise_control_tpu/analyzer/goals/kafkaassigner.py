@@ -48,14 +48,16 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         # fewest replicas first (vs the parent's lowest disk utilization)
         return -cache.replica_count.astype(jnp.float32)
 
-    def optimize(self, state: ClusterState, ctx: OptimizationContext,
-                 prev_goals: Sequence[Goal]) -> ClusterState:
+    def optimize_cached(self, state: ClusterState, ctx: OptimizationContext,
+                        prev_goals: Sequence[Goal], cache=None):
         from cruise_control_tpu.analyzer.goals.count_distribution import (
             ReplicaDistributionGoal)
-        state = super().optimize(state, ctx, prev_goals)
+        state, cache = super().optimize_cached(state, ctx, prev_goals,
+                                               cache)
         evener = ReplicaDistributionGoal(max_rounds=self.max_rounds,
                                          balance_pct_margin=0.0)
-        return evener.optimize(state, ctx, (self,) + tuple(prev_goals))
+        return evener.optimize_cached(state, ctx,
+                                      (self,) + tuple(prev_goals), cache)
 
 
 class KafkaAssignerDiskUsageDistributionGoal(Goal):

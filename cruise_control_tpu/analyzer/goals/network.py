@@ -18,8 +18,8 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
+                                                 ensure_full_cache,
                                                  leader_nw_in,
-                                                 make_round_cache,
                                                  replica_static_ok)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_leadership_acceptance, compose_move_acceptance,
@@ -46,8 +46,8 @@ class PotentialNwOutGoal(Goal):
         return (S.replica_leader_role_load(state)[:, Resource.NW_OUT]
                 * state.replica_valid)
 
-    def optimize(self, state: ClusterState, ctx: OptimizationContext,
-                 prev_goals: Sequence[Goal]) -> ClusterState:
+    def optimize_cached(self, state: ClusterState, ctx: OptimizationContext,
+                        prev_goals: Sequence[Goal], cache=None):
 
         # loop-invariant: the leader-ROLE load is leadership-independent
         w_static = self._leader_role_nw_out(state)
@@ -95,11 +95,11 @@ class PotentialNwOutGoal(Goal):
             st, cache, committed = round_body(st, cache)
             return st, cache, rounds + 1, committed
 
-        state, _, rounds, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
+        state, cache, rounds, _ = jax.lax.while_loop(
+            cond, body, (state, ensure_full_cache(state, ctx, cache),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         note_rounds(rounds)
-        return state
+        return state, cache
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         """Keep destinations under the potential-NW_OUT cap unless they are
@@ -166,11 +166,10 @@ class LeaderBytesInDistributionGoal(Goal):
         avg = jnp.sum(lbi * alive) / jnp.maximum(jnp.sum(alive), 1)
         return avg * (1 + self.pct_margin)
 
-    def optimize(self, state: ClusterState, ctx: OptimizationContext,
-                 prev_goals: Sequence[Goal]) -> ClusterState:
+    def optimize_cached(self, state: ClusterState, ctx: OptimizationContext,
+                        prev_goals: Sequence[Goal], cache=None):
         from cruise_control_tpu.analyzer.leadership import (
-            VALUE_WEIGHTED_SELECT_JITTER, global_leadership_sweep,
-            mean_bounds)
+            VALUE_WEIGHTED_SELECT_JITTER, mean_bounds, run_sweep_threaded)
 
         def _upper_of(st, W):
             alive = st.broker_alive
@@ -184,8 +183,8 @@ class LeaderBytesInDistributionGoal(Goal):
         # (the model stores base loads per replica, builder.py)
         value_r = (state.replica_base_load[:, Resource.NW_IN]
                    * state.replica_valid)
-        state, sweep_rounds = global_leadership_sweep(
-            state, ctx, prev_goals,
+        state, sweep_rounds, cache = run_sweep_threaded(
+            state, ctx, prev_goals, cache,
             measure=lambda cache: cache.leader_bytes_in,
             value_r=value_r,
             bounds=mean_bounds(_upper_of), improve_gate=True,
@@ -238,11 +237,11 @@ class LeaderBytesInDistributionGoal(Goal):
             st, cache, committed = round_body(st, cache)
             return st, cache, rounds + 1, committed
 
-        state, _, rounds, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
+        state, cache, rounds, _ = jax.lax.while_loop(
+            cond, body, (state, ensure_full_cache(state, ctx, cache),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         note_rounds(rounds)
-        return state
+        return state, cache
 
     def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
         lbi = cache.leader_bytes_in

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
-                                                 make_round_cache)
+                                                 ensure_full_cache)
 from cruise_control_tpu.analyzer.goals.base import (
     Goal, compose_move_acceptance, move_commit_terms, note_rounds)
 from cruise_control_tpu.common.resources import Resource
@@ -53,8 +53,8 @@ class RackAwareGoal(Goal):
         return (state.replica_valid
                 & (prc[state.replica_partition, rack] > 1))
 
-    def optimize(self, state: ClusterState, ctx: OptimizationContext,
-                 prev_goals: Sequence[Goal]) -> ClusterState:
+    def optimize_cached(self, state: ClusterState, ctx: OptimizationContext,
+                        prev_goals: Sequence[Goal], cache=None):
 
         def round_body(st: ClusterState, cache):
             prc = cache.partition_rack_count
@@ -116,11 +116,11 @@ class RackAwareGoal(Goal):
             st, cache, committed = round_body(st, cache)
             return st, cache, rounds + 1, committed
 
-        state, _, rounds, _ = jax.lax.while_loop(
-            cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
+        state, cache, rounds, _ = jax.lax.while_loop(
+            cond, body, (state, ensure_full_cache(state, ctx, cache),
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         note_rounds(rounds)
-        return state
+        return state, cache
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         """A move may not place a second replica of the partition in the

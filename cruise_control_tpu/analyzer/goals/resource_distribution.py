@@ -67,8 +67,8 @@ class ResourceDistributionGoal(Goal):
         return new_broker_dest_mask(st, ctx.broker_dest_ok & st.broker_alive)
 
     # -- optimization ------------------------------------------------------
-    def optimize(self, state: ClusterState, ctx: OptimizationContext,
-                 prev_goals: Sequence[Goal]) -> ClusterState:
+    def optimize_cached(self, state: ClusterState, ctx: OptimizationContext,
+                        prev_goals: Sequence[Goal], cache=None):
         """Phases run as separate progress-gated sub-loops inside an outer
         sweep loop (shed leadership until dry, then shed replicas, then
         fill; repeat while anything moved).  An inactive phase costs one
@@ -91,10 +91,10 @@ class ResourceDistributionGoal(Goal):
             # phase_a's table-round cost; phase_a remains as the
             # residual backstop
             from cruise_control_tpu.analyzer.leadership import (
-                VALUE_WEIGHTED_SELECT_JITTER, global_leadership_sweep,
-                limit_bounds)
-            state, sweep_rounds = global_leadership_sweep(
-                state, ctx, prev_goals,
+                VALUE_WEIGHTED_SELECT_JITTER, limit_bounds,
+                run_sweep_threaded)
+            state, sweep_rounds, cache = run_sweep_threaded(
+                state, ctx, prev_goals, cache,
                 measure=lambda cache: cache.broker_load[:, res],
                 value_r=bonus,
                 bounds=limit_bounds(upper, (upper + lower) / 2.0),
@@ -264,9 +264,10 @@ class ResourceDistributionGoal(Goal):
                            self.max_swap_rounds))
             phases.append((phase_swap_under, swap_under_work_exists,
                            self.max_swap_rounds))
-        state = run_phase_sweeps(state, phases, self.rounds_for(ctx),
-                                 table_slots=ctx.table_slots, ctx=ctx)
-        return state
+        from cruise_control_tpu.analyzer.context import ensure_full_cache
+        return run_phase_sweeps(state, phases, self.rounds_for(ctx),
+                                table_slots=ctx.table_slots, ctx=ctx,
+                                cache=ensure_full_cache(state, ctx, cache))
 
     # -- acceptance (as a previously-optimized goal) -----------------------
     def accept_move(self, state, ctx, cache, replica, dest_broker):

@@ -85,7 +85,8 @@ def global_leadership_sweep(
         max_rounds: int = 24,
         dest_tiebreak: Optional[Callable[[RoundCache], jax.Array]] = None,
         select_jitter: float = 1.0,
-) -> Tuple[ClusterState, jax.Array]:
+        cache0: Optional[RoundCache] = None,
+) -> Tuple[ClusterState, jax.Array, RoundCache]:
     """Run whole-cluster leadership re-election rounds.
 
     Args:
@@ -116,7 +117,10 @@ def global_leadership_sweep(
         its thousands of transfers do not scramble the later
         LeaderBytesInDistributionGoal's surface (measured round 4:
         without it LBI's violated count rose 157 -> 181 at north).
-    Returns (state, rounds_used); traceable.
+      cache0: optional TABLE-LESS RoundCache describing `state` (threaded
+        from the caller; see run_sweep_threaded) — seeds the loop instead
+        of a fresh make_round_cache.
+    Returns (state, rounds_used, final cache); traceable.
 
     A floor-unblocking "refuel" sub-round (importing high-bonus
     leaderships into brokers pinned at a prior goal's band floor, fired
@@ -271,10 +275,38 @@ def global_leadership_sweep(
         dry = jnp.where(committed, 0, dry + 1)
         return st, cache, rounds + 1, dry
 
-    state, _, rounds, _ = jax.lax.while_loop(
-        cond, body, (state, make_round_cache(state, 0, ctx),
+    if cache0 is None:
+        cache0 = make_round_cache(state, 0, ctx)
+    state, cache0, rounds, _ = jax.lax.while_loop(
+        cond, body, (state, cache0,
                      jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)))
-    return state, rounds
+    return state, rounds, cache0
+
+
+def run_sweep_threaded(state: ClusterState, ctx: OptimizationContext,
+                       prev_goals: Sequence, cache: Optional[RoundCache],
+                       **sweep_kwargs):
+    """(state, rounds, cache') — global_leadership_sweep with RoundCache
+    threading.  The sweep itself runs table-less (per-commit slot lookups
+    would dominate its round cost); a carried FULL cache's table —
+    membership is transfer-invariant — is detached for the sweep and
+    reattached afterwards with the role-dependent planes re-gathered
+    (context.reattach_table), so the caller's table rounds skip the full
+    rebuild."""
+    from cruise_control_tpu.analyzer.context import (reattach_table,
+                                                     strip_table)
+    if cache is not None and cache.broker_table.shape[1]:
+        tbl, fill = cache.broker_table, cache.table_fill
+        t_bonus, t_ok = cache.table_bonus, cache.table_ok
+        r_ok = cache.replica_ok
+        state, rounds, nt = global_leadership_sweep(
+            state, ctx, prev_goals, cache0=strip_table(cache),
+            **sweep_kwargs)
+        return state, rounds, reattach_table(state, nt, tbl, fill,
+                                             t_bonus, t_ok, r_ok)
+    state, rounds, nt = global_leadership_sweep(
+        state, ctx, prev_goals, cache0=cache, **sweep_kwargs)
+    return state, rounds, nt
 
 
 def mean_bounds(upper_of: Callable[[ClusterState, jax.Array], jax.Array]):

@@ -209,8 +209,21 @@ class GoalOptimizer:
         return active, "ReplicaDistributionGoal" in names, margin
 
     def _pre_fn(self):
-        """(state, ctx) -> (violated_broker_counts i32[G], healed state,
-        still_offline, max_broker_count, broken, prebalance_rounds).
+        """(state_initial, state, ctx) -> (violated_broker_counts i32[G],
+        healed state, RoundCache, still_offline, max_broker_count, broken,
+        prebalance_rounds).
+
+        `state_initial` is the TRUE initial model and is only read for the
+        violated-before sweep; `state` is what the pipeline optimizes.
+        They differ exactly when a warm start transplanted a seed
+        placement (optimizations(warm_start=...)) — the before-counts and
+        violated_goals_before must describe the live cluster, not the
+        seed.
+
+        The returned RoundCache describes the returned state and seeds
+        the goal segments (cache threading: every goal maintains it
+        incrementally instead of paying a ~327 ms rebuild per entry at
+        2.6K-broker scale — see context.ensure_full_cache).
 
         `broken` reports whether the cluster entered with dead brokers /
         disks / offline replicas (waives the stats-regression abort).
@@ -223,10 +236,11 @@ class GoalOptimizer:
         goals = tuple(self.goals)
         active_res, balance_counts, count_margin = self._prebalance_dims()
 
-        def run(state: ClusterState, ctx: OptimizationContext):
-            cache0 = make_round_cache(state)
+        def run(state_initial: ClusterState, state: ClusterState,
+                ctx: OptimizationContext):
+            cache0 = make_round_cache(state_initial)
             violated_before = (
-                jnp.stack([g.violated_brokers(state, ctx, cache0)
+                jnp.stack([g.violated_brokers(state_initial, ctx, cache0)
                            .sum(dtype=jnp.int32) for g in goals])
                 if goals else jnp.zeros((0,), dtype=jnp.int32))
             needs_heal = S.self_healing_eligible(state).any()
@@ -240,31 +254,45 @@ class GoalOptimizer:
                 needs_heal, lambda s: heal_offline_replicas(s, ctx),
                 lambda s: s, state)
             pre_rounds = jnp.zeros((), jnp.int32)
+            from cruise_control_tpu.analyzer.context import ensure_full_cache
             if (ctx.prebalance and not ctx.fix_offline_replicas_only
                     and (any(active_res) or balance_counts)):
                 from cruise_control_tpu.analyzer.prebalance import prebalance
-                state, pre_rounds = prebalance(
+                state, pre_rounds, cache = prebalance(
                     state, ctx, count_margin=count_margin,
                     active_resources=active_res,
                     balance_counts=balance_counts)
+            else:
+                cache = ensure_full_cache(state, ctx, None)
             still_offline = jnp.sum(S.self_healing_eligible(state))
             max_count = jnp.max(S.broker_replica_count(state))
-            return (violated_before, state, still_offline, max_count,
-                    broken, pre_rounds)
+            return (violated_before, state, cache, still_offline,
+                    max_count, broken, pre_rounds)
         return run
 
     def _segment_fn(self, start: int, stop: int):
-        """(state, ctx) -> (state, (stacked per-goal stats, own-violated
-        counts, per-goal rounds)) for goals[start:stop], with acceptance
-        stacking over ALL prior goals.
-        own-violated = the goal's violated-broker count right
-        after its own run — comparing it against the post-pipeline count
-        separates "this goal could not converge" from "a later goal
+        """(state, cache, ctx) -> (state, cache, (stacked per-goal stats,
+        own-violated counts, per-goal rounds)) for goals[start:stop], with
+        acceptance stacking over ALL prior goals.
+
+        `cache` is the threaded RoundCache: refreshed float aggregates at
+        segment entry (drift control — float scatter-adds accumulate f32
+        rounding over the hundreds of rounds the cache now lives), passed
+        through every goal's optimize_cached, and reused for the per-goal
+        stats + own-violated counts (which previously each paid an [R]
+        cache rebuild).  own-violated = the goal's violated-broker count
+        right after its own run — comparing it against the post-pipeline
+        count separates "this goal could not converge" from "a later goal
         re-violated it"."""
         goals = tuple(self.goals)
 
-        def run(state: ClusterState, ctx: OptimizationContext):
+        def run(state: ClusterState, cache, ctx: OptimizationContext):
+            from cruise_control_tpu.analyzer.context import (
+                ensure_full_cache, refresh_float_aggregates)
             from cruise_control_tpu.analyzer.goals import base as goals_base
+            from cruise_control_tpu.model.stats import \
+                compute_stats_fresh_loads
+            cache = refresh_float_aggregates(state, cache)
             per_goal_stats = []
             own_violated = []
             rounds_used = []
@@ -272,27 +300,34 @@ class GoalOptimizer:
                 sink: List = []
                 goals_base.set_round_sink(sink)
                 try:
-                    state = goals[i].optimize(state, ctx, goals[:i])
+                    state, cache = goals[i].optimize_cached(
+                        state, ctx, goals[:i], cache)
                 finally:
                     goals_base.set_round_sink(None)
                 rounds_used.append(sum(sink)
                                    if sink else jnp.zeros((), jnp.int32))
-                per_goal_stats.append(compute_stats(state))
+                c = (cache if cache is not None
+                     else make_round_cache(state))
+                per_goal_stats.append(compute_stats_fresh_loads(state, c))
                 own_violated.append(goals[i].violated_brokers(
-                    state, ctx, make_round_cache(state))
-                    .sum(dtype=jnp.int32))
+                    state, ctx, c).sum(dtype=jnp.int32))
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
                                    *per_goal_stats)
-            return state, (stacked, jnp.stack(own_violated),
-                           jnp.stack(rounds_used))
+            # a goal that fell back to the cache-less SPI returns None —
+            # rebuild so the segment's output structure stays fixed
+            cache = ensure_full_cache(state, ctx, cache)
+            return state, cache, (stacked, jnp.stack(own_violated),
+                                  jnp.stack(rounds_used))
         return run
 
     def _post_fn(self):
-        """(state, ctx) -> violated_broker_counts i32[G]."""
+        """(state, cache, ctx) -> violated_broker_counts i32[G]."""
         goals = tuple(self.goals)
 
-        def run(state: ClusterState, ctx: OptimizationContext):
-            cache1 = make_round_cache(state)
+        def run(state: ClusterState, cache, ctx: OptimizationContext):
+            from cruise_control_tpu.analyzer.context import \
+                refresh_float_aggregates
+            cache1 = refresh_float_aggregates(state, cache)
             return (jnp.stack([g.violated_brokers(state, ctx, cache1)
                                .sum(dtype=jnp.int32) for g in goals])
                     if goals else jnp.zeros((0,), dtype=jnp.int32))
@@ -332,13 +367,18 @@ class GoalOptimizer:
         options = options or OptimizationOptions()
         ctx = make_context(state, self.constraint, options, topology)
         seg = max(1, self.pipeline_segment_size)
+        # segments take the threaded RoundCache as an input — lower
+        # against its abstract shape (no device work)
+        cache_aval = jax.eval_shape(
+            lambda s: make_round_cache(s, ctx.table_slots, ctx), state)
         jobs = [("__stats__", compute_stats, (state,)),
-                ("__pre__", self._pre_fn(), (state, ctx)),
-                ("__post__", self._post_fn(), (state, ctx))]
+                ("__pre__", self._pre_fn(), (state, state, ctx)),
+                ("__post__", self._post_fn(), (state, cache_aval, ctx))]
         for start in range(0, len(self.goals), seg):
             stop = min(start + seg, len(self.goals))
             jobs.append((f"__seg_{start}_{stop}__",
-                         self._segment_fn(start, stop), (state, ctx)))
+                         self._segment_fn(start, stop),
+                         (state, cache_aval, ctx)))
 
         def compile_one(job):
             key, fn, args = job
@@ -361,10 +401,25 @@ class GoalOptimizer:
     def optimizations(self, state: ClusterState, topology,
                       options: Optional[OptimizationOptions] = None,
                       check_sanity: bool = True,
-                      _table_slots_override: Optional[int] = None
+                      _table_slots_override: Optional[int] = None,
+                      warm_start: Optional[ClusterState] = None
                       ) -> OptimizerResult:
         """Run all goals in priority order and diff out proposals
         (reference GoalOptimizer.optimizations :409-480).
+
+        `warm_start` (optional) is a PREVIOUS solve's final state over the
+        SAME topology (caller validates — facade._warm_start_compatible):
+        its placement (replica→broker/disk assignment + leader flags) is
+        transplanted onto `state` before the pipeline, so goals whose
+        bands still hold open at near-zero rounds.  Proposals still diff
+        against the ORIGINAL `state`, and the full pipeline (acceptance
+        stacking, hard-goal verification, stats guard) runs regardless,
+        so the result is exactly as valid as a cold solve — the warm seed
+        only changes where the search starts.  This extends the
+        reference's generation-keyed cached-proposal reuse
+        (GoalOptimizer.java:210-217, 275-330): the reference serves the
+        cache only while the generation is UNCHANGED; here a moved
+        generation still reuses the converged placement as a seed.
 
         The pipeline runs as a handful of jitted segments (violation sweep +
         self-healing, then `pipeline_segment_size` goals per program, then
@@ -388,11 +443,19 @@ class GoalOptimizer:
         initial = state
         stats_before = jax.device_get(
             self._run("__stats__", compute_stats, state))
+        if warm_start is not None:
+            # placement transplant: same shapes, so every compiled
+            # program is reused verbatim
+            state = state.replace(
+                replica_broker=warm_start.replica_broker,
+                replica_is_leader=warm_start.replica_is_leader,
+                replica_disk=warm_start.replica_disk)
 
         t0 = time.time()
         profile = self.profile_segments
-        (vb_dev, state, still_dev, maxc_dev, broken_dev,
-         pre_rounds_dev) = self._run("__pre__", self._pre_fn(), state, ctx)
+        (vb_dev, state, cache, still_dev, maxc_dev, broken_dev,
+         pre_rounds_dev) = self._run("__pre__", self._pre_fn(), initial,
+                                     state, ctx)
         if profile:
             jax.block_until_ready(state.replica_broker)
             LOG.info("segment pre+heal+prebalance: %.0fms",
@@ -404,9 +467,9 @@ class GoalOptimizer:
         for start in range(0, len(self.goals), seg):
             stop = min(start + seg, len(self.goals))
             t_seg = time.time()
-            state, (stacked_seg, own_seg, rounds_seg) = self._run(
+            state, cache, (stacked_seg, own_seg, rounds_seg) = self._run(
                 f"__seg_{start}_{stop}__",
-                self._segment_fn(start, stop), state, ctx)
+                self._segment_fn(start, stop), state, cache, ctx)
             if profile:
                 jax.block_until_ready(state.replica_broker)
                 LOG.info("segment %s: %.0fms",
@@ -415,7 +478,7 @@ class GoalOptimizer:
             stacked_parts.append(stacked_seg)
             own_parts.append(own_seg)
             rounds_parts.append(rounds_seg)
-        va_dev = self._run("__post__", self._post_fn(), state, ctx)
+        va_dev = self._run("__post__", self._post_fn(), state, cache, ctx)
         jax.block_until_ready(state.replica_broker)
         LOG.debug("goal pipeline (%d segments) ran in %.0fms",
                   (len(self.goals) + seg - 1) // seg,
@@ -442,7 +505,8 @@ class GoalOptimizer:
                 int(max_count), ctx.table_slots, new_slots)
             return self.optimizations(initial, topology, options,
                                       check_sanity=check_sanity,
-                                      _table_slots_override=new_slots)
+                                      _table_slots_override=new_slots,
+                                      warm_start=warm_start)
         stacked_h = (jax.tree.map(
             lambda *xs: np.concatenate(xs), *stacked_h)
             if stacked_h else None)

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from cruise_control_tpu.analyzer import kernels
 from cruise_control_tpu.analyzer.context import (OptimizationContext,
                                                  RoundCache,
-                                                 make_round_cache)
+                                                 ensure_full_cache)
 from cruise_control_tpu.common.resources import NUM_RESOURCES
 from cruise_control_tpu.model.state import ClusterState
 
@@ -84,9 +84,11 @@ def prebalance(state: ClusterState, ctx: OptimizationContext,
                count_margin: float = 0.09,
                max_rounds: int = 48,
                active_resources: Tuple[bool, ...] = (True,) * NUM_RESOURCES,
-               balance_counts: bool = True
-               ) -> Tuple[ClusterState, jax.Array]:
-    """Run the joint pre-balance rounds; returns (state, rounds_used).
+               balance_counts: bool = True,
+               cache: RoundCache | None = None):
+    """Run the joint pre-balance rounds; returns (state, rounds_used,
+    final RoundCache) — the cache seeds the first goal of the pipeline
+    (context cache threading).
 
     Traceable (lax.while_loop); call inside the optimizer's pre-segment
     program after self-healing.
@@ -99,6 +101,15 @@ def prebalance(state: ClusterState, ctx: OptimizationContext,
     """
     from cruise_control_tpu.analyzer.goals.base import (new_broker_dest_mask,
                                                         shed_rows)
+
+    cache = ensure_full_cache(state, ctx, cache)
+    if ctx.table_slots == 0:
+        # a table-less context (e.g. an empty cluster, where make_context
+        # yields 0 slots) cannot run the row-table candidate selection —
+        # rows_pick_topk would trace lax.top_k over a [B, 0] plane and
+        # fail at trace time even when cond is False (lax.while_loop
+        # always traces its body).  Nothing to pre-balance there anyway.
+        return state, jnp.zeros((), jnp.int32), cache
 
     num_b = state.num_brokers
     res_ax = NUM_RESOURCES
@@ -257,7 +268,7 @@ def prebalance(state: ClusterState, ctx: OptimizationContext,
         st, cache, committed = round_body(st, cache)
         return st, cache, rounds + 1, committed
 
-    state, _, rounds, _ = jax.lax.while_loop(
-        cond, body, (state, make_round_cache(state, ctx.table_slots, ctx),
+    state, cache, rounds, _ = jax.lax.while_loop(
+        cond, body, (state, cache,
                      jnp.zeros((), jnp.int32), jnp.ones((), bool)))
-    return state, rounds
+    return state, rounds, cache

@@ -214,6 +214,13 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
     d.define("proposal.precompute.interval.ms", Type.LONG, 30_000,
              in_range(min_value=1), _L,
              "Pause between background proposal precompute passes.")
+    d.define("proposal.warm.start.enabled", Type.BOOLEAN, True, None, _L,
+             "Seed default-stack solves from the previous solve's final "
+             "placement when the model generation moved but the topology "
+             "is unchanged (framework extension of the reference's "
+             "generation-keyed proposal cache): converged goals then open "
+             "at near-zero search rounds.  Results are identical in "
+             "validity to a cold solve — only the search start changes.")
     d.define("max.optimization.rounds", Type.INT, 64,
              in_range(min_value=1), _L,
              "Per-goal cap on batched optimization rounds (TPU solver). "

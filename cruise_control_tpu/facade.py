@@ -51,6 +51,37 @@ from cruise_control_tpu.monitor.sampling.sampler import MetricSampler
 from cruise_control_tpu.utils.metrics import MetricRegistry
 
 LOG = logging.getLogger(__name__)
+
+
+def _warm_start_compatible(seed, state) -> bool:
+    """True when `seed` (a previous solve's final state) can warm-start a
+    solve over `state`: identical replica/partition membership and an
+    unbroken cluster (dead brokers/disks or offline replicas make a
+    transplanted placement inconsistent with the model's offline flags —
+    those solves run cold and heal first)."""
+    if (seed.num_replicas != state.num_replicas
+            or seed.num_partitions != state.num_partitions
+            or seed.num_brokers != state.num_brokers
+            or seed.num_disks != state.num_disks):
+        return False
+    alive = bool(np.all(np.asarray(state.broker_alive))
+                 and np.all(np.asarray(state.disk_alive))
+                 and not np.any(np.asarray(state.replica_offline)))
+    return alive and bool(
+        np.array_equal(np.asarray(seed.replica_partition),
+                       np.asarray(state.replica_partition))
+        and np.array_equal(np.asarray(seed.replica_valid),
+                           np.asarray(state.replica_valid))
+        and np.array_equal(np.asarray(seed.partition_topic),
+                           np.asarray(state.partition_topic))
+        # broker/disk IDENTITY must match too: a rebuilt model that
+        # enumerates brokers, racks, or JBOD logdirs differently would
+        # make the transplanted replica_broker/replica_disk pairing
+        # violate the disk-on-broker invariant (model/sanity.py)
+        and np.array_equal(np.asarray(seed.disk_broker),
+                           np.asarray(state.disk_broker))
+        and np.array_equal(np.asarray(seed.broker_rack),
+                           np.asarray(state.broker_rack)))
 #: operations audit log (reference `operationLogger`,
 #: CC/executor/Executor.java:76,775): one INFO line per requested mutation
 OPERATION_LOG = logging.getLogger("operationLogger")
@@ -124,7 +155,8 @@ class CruiseControl:
                  sleep_fn: Optional[Callable[[float], None]] = None,
                  monitor_kwargs: Optional[dict] = None,
                  executor_kwargs: Optional[dict] = None,
-                 auto_warmup: bool = True) -> None:
+                 auto_warmup: bool = True,
+                 warm_start_proposals: bool = True) -> None:
         self._admin = admin
         self._time = time_fn or _time.time
         self._constraint = constraint or BalancingConstraint()
@@ -219,6 +251,12 @@ class CruiseControl:
         self._cache_epoch = 0
         self._proposal_expiration_s = proposal_expiration_s
         self._precompute_interval_s = proposal_precompute_interval_s
+        #: last DEFAULT-stack final state, kept as a warm-start seed for
+        #: the next solve (survives proposal-cache invalidation: a seed
+        #: only changes where the search starts, never what it returns —
+        #: see GoalOptimizer.optimizations warm_start)
+        self._warm_start_enabled = warm_start_proposals
+        self._warm_seed_state = None
         self._precompute_stop = threading.Event()
         self._precompute_thread: Optional[threading.Thread] = None
 
@@ -488,12 +526,20 @@ class CruiseControl:
                                         self._constraint))
         state, topo = self.cluster_model(
             allow_capacity_estimation=_allow_capacity_estimation)
+        warm = None
+        if cacheable and self._warm_start_enabled:
+            with self._cache_lock:
+                seed = self._warm_seed_state
+            if seed is not None and _warm_start_compatible(seed, state):
+                warm = seed
         with self.metrics.timer("proposal-computation-timer").time():
             result = optimizer.optimizations(
                 state, topo, self._options_generator.generate(
-                    options or OptimizationOptions(), topo))
+                    options or OptimizationOptions(), topo),
+                warm_start=warm)
         if cacheable:
             with self._cache_lock:
+                self._warm_seed_state = result.final_state
                 # drop the result if the cache was invalidated while the
                 # solve ran (an execution started mutating the cluster) —
                 # storing it would serve pre-execution proposals

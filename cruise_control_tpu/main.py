@@ -282,6 +282,8 @@ def build_cruise_control(config: CruiseControlConfig, admin,
             "proposal.expiration.ms") / 1e3,
         proposal_precompute_interval_s=config.get_long(
             "proposal.precompute.interval.ms") / 1e3,
+        warm_start_proposals=config.get_boolean(
+            "proposal.warm.start.enabled"),
         monitor_kwargs=dict(
             sample_store=sample_store,
             num_windows=config.get_int("num.partition.metrics.windows"),

@@ -52,7 +52,7 @@ def test_prebalance_reduces_over_band_and_keeps_invariants():
     assert over_before > 0, "fixture must start unbalanced"
     prc_before = np.asarray(S.partition_rack_count(state))
 
-    out, rounds = prebalance(state, ctx)
+    out, rounds, _ = prebalance(state, ctx)
     sanity_check(out)
     assert int(rounds) > 0
     after_load = np.asarray(S.broker_load(out))
@@ -69,7 +69,7 @@ def test_prebalance_never_creates_new_over_band_brokers():
     state, topo, ctx = _mk(seed=7)
     upper = _upper(state, ctx)
     before = np.asarray(S.broker_load(state))
-    out, _ = prebalance(state, ctx)
+    out, _, _ = prebalance(state, ctx)
     after = np.asarray(S.broker_load(out))
     newly_over = (after > upper) & ~(before > upper)
     assert not newly_over.any(), np.argwhere(newly_over)
@@ -77,7 +77,7 @@ def test_prebalance_never_creates_new_over_band_brokers():
 
 def test_prebalance_inactive_dimensions_do_nothing():
     state, topo, ctx = _mk()
-    out, rounds = prebalance(state, ctx,
+    out, rounds, _ = prebalance(state, ctx,
                              active_resources=(False,) * 4,
                              balance_counts=False)
     assert int(rounds) == 0
@@ -93,7 +93,7 @@ def test_prebalance_add_broker_targets_only_new_brokers():
     state, topo = random_cluster(spec)
     ctx = make_context(state, BalancingConstraint(), OptimizationOptions(),
                        topo)
-    out, _ = prebalance(state, ctx)
+    out, _, _ = prebalance(state, ctx)
     moved = (np.asarray(out.replica_broker)
              != np.asarray(state.replica_broker))
     dest_new = np.asarray(state.broker_new)[np.asarray(out.replica_broker)]
@@ -116,7 +116,7 @@ def test_sweep_mean_mode_contracts_leader_imbalance():
         a = jnp.sum(W * alive) / jnp.maximum(jnp.sum(alive), 1)
         return jnp.full((st.num_brokers,), jnp.ceil(a * 1.09) + 1)
 
-    out, rounds = global_leadership_sweep(
+    out, rounds, _ = global_leadership_sweep(
         state, ctx, [],
         measure=lambda c: c.leader_count.astype(jnp.float32),
         value_r=jnp.ones(state.num_replicas, jnp.float32),
@@ -139,7 +139,7 @@ def test_sweep_limit_mode_respects_hard_cap():
     limit = jnp.asarray(np.quantile(W0, 0.7) * np.ones(state.num_brokers,
                                                        np.float32))
     mid = limit * 0.8
-    out, rounds = global_leadership_sweep(
+    out, rounds, _ = global_leadership_sweep(
         state, ctx, [],
         measure=lambda c: c.broker_load[:, res],
         value_r=(state.partition_leader_bonus[
@@ -173,7 +173,7 @@ def test_sweep_single_commit_fallback_for_opaque_prior_goal():
     def upper_of(st, W):
         return jnp.full((st.num_brokers,), jnp.inf)
 
-    out, rounds = global_leadership_sweep(
+    out, rounds, _ = global_leadership_sweep(
         state, ctx, [_OpaqueLeadershipGoal()],
         measure=lambda c: c.leader_count.astype(jnp.float32),
         value_r=jnp.ones(state.num_replicas, jnp.float32),

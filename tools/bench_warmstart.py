@@ -1,0 +1,114 @@
+"""Warm-start measurement: cold solve vs a solve seeded from the previous
+final placement after a load perturbation.
+
+The production shape this measures (facade.optimizations warm path): the
+precompute loop solved generation N; new samples arrive (loads change a
+few percent, placement unchanged), the generation moves, and the next
+request's solve warm-starts from generation N's final placement
+(GoalOptimizer.optimizations(warm_start=...)).  The reference serves its
+proposal cache only while the generation is UNCHANGED
+(reference GoalOptimizer.java:210-217, 275-330); the warm start extends
+the same cached artifact across generation moves.
+
+Usage:  python tools/bench_warmstart.py          (north scale by default)
+Env:    WARM_BROKERS / WARM_PARTITIONS / WARM_RF / WARM_NOISE (default
+        0.03 = ±3% multiplicative load jitter, the "new samples" model).
+
+Prints per-phase wall-clock on stderr and ONE JSON line on stdout:
+  {"metric": "warm-start solve ...", "value": <warm seconds>,
+   "cold_s": ..., "speedup": ...}
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+
+def main() -> None:
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    import numpy as np
+
+    from cruise_control_tpu.analyzer.context import OptimizationOptions
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
+                                                           random_cluster)
+
+    num_b = int(os.environ.get("WARM_BROKERS", 2600))
+    num_p = int(os.environ.get("WARM_PARTITIONS", 200_000))
+    rf = int(os.environ.get("WARM_RF", 3))
+    noise = float(os.environ.get("WARM_NOISE", 0.03))
+
+    state, topo = random_cluster(RandomClusterSpec(
+        num_brokers=num_b, num_partitions=num_p, replication_factor=rf,
+        num_racks=max(8, num_b // 100), num_topics=max(8, num_p // 2000),
+        seed=4, skew_fraction=0.2))
+    optimizer = GoalOptimizer(default_goals(max_rounds=192),
+                              pipeline_segment_size=2)
+
+    t0 = time.time()
+    optimizer.warmup(state, topo, OptimizationOptions())
+    print(f"# warmup (parallel AOT) {time.time()-t0:.1f}s", file=sys.stderr)
+
+    # cold solve = generation N's precompute pass
+    t0 = time.time()
+    cold = optimizer.optimizations(state, topo, check_sanity=False)
+    cold_s = time.time() - t0
+    print(f"# cold solve {cold_s:.1f}s rounds="
+          f"{sum(cold.rounds_by_goal.values())}", file=sys.stderr)
+
+    # generation N+1: same placement/topology, loads jittered ±noise —
+    # the "new samples arrived" model
+    rng = np.random.default_rng(11)
+    jit_r = (1.0 + noise * (2.0 * rng.random(
+        (state.num_replicas, 1)) - 1.0)).astype(np.float32)
+    jit_p = (1.0 + noise * (2.0 * rng.random(
+        (state.num_partitions, 1)) - 1.0)).astype(np.float32)
+    perturbed = state.replace(
+        replica_base_load=state.replica_base_load * jit_r,
+        partition_leader_bonus=state.partition_leader_bonus * jit_p)
+
+    t0 = time.time()
+    warm = optimizer.optimizations(perturbed, topo, check_sanity=False,
+                                   warm_start=cold.final_state)
+    warm_s = time.time() - t0
+    print(f"# warm-start solve {warm_s:.1f}s rounds="
+          f"{sum(warm.rounds_by_goal.values())} "
+          f"proposals={len(warm.proposals)} "
+          f"balancedness={warm.balancedness_score():.1f}", file=sys.stderr)
+
+    # control: the same perturbed model solved COLD (what the warm start
+    # saves against)
+    t0 = time.time()
+    control = optimizer.optimizations(perturbed, topo, check_sanity=False)
+    control_s = time.time() - t0
+    print(f"# perturbed cold control {control_s:.1f}s rounds="
+          f"{sum(control.rounds_by_goal.values())} "
+          f"balancedness={control.balancedness_score():.1f}",
+          file=sys.stderr)
+
+    print("# warm violated after-all: "
+          + ", ".join(f"{g}={a}" for g, (b, o, a)
+                      in warm.violated_broker_counts.items() if a),
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": (f"warm-start solve {num_b}b/{num_p/1000:g}Kp rf{rf} "
+                   f"noise={noise:g}"),
+        "value": round(warm_s, 3), "unit": "s",
+        "cold_s": round(control_s, 3),
+        "speedup": round(control_s / warm_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
