@@ -343,6 +343,20 @@ def _gather_aux_tables(state: ClusterState, table: jax.Array,
     return load, bonus, leader, ok
 
 
+def _empty_table_planes(num_b: int) -> dict:
+    """Zero-width broker-table planes (the table-less RoundCache form) —
+    single home so a stripped cache's pytree structure can never diverge
+    from a fresh table-less cache's."""
+    return dict(
+        broker_table=jnp.zeros((num_b, 0), dtype=jnp.int32),
+        table_fill=jnp.zeros((num_b,), dtype=jnp.int32),
+        table_load=jnp.zeros((num_b, 0, NUM_RESOURCES), dtype=jnp.float32),
+        table_bonus=jnp.zeros((num_b, 0, NUM_RESOURCES),
+                              dtype=jnp.float32),
+        table_leader=jnp.zeros((num_b, 0), dtype=bool),
+        table_ok=jnp.zeros((num_b, 0), dtype=bool))
+
+
 def make_round_cache(state: ClusterState, table_slots: int = 0,
                      ctx: Optional["OptimizationContext"] = None
                      ) -> RoundCache:
@@ -355,12 +369,10 @@ def make_round_cache(state: ClusterState, table_slots: int = 0,
                                                              ctx)
         r_ok = replica_static_ok(state, ctx)
     else:
-        table = jnp.zeros((num_b, 0), dtype=jnp.int32)
-        fill = jnp.zeros((num_b,), dtype=jnp.int32)
-        t_load = jnp.zeros((num_b, 0, NUM_RESOURCES), dtype=jnp.float32)
-        t_bonus = jnp.zeros((num_b, 0, NUM_RESOURCES), dtype=jnp.float32)
-        t_leader = jnp.zeros((num_b, 0), dtype=bool)
-        t_ok = jnp.zeros((num_b, 0), dtype=bool)
+        empty = _empty_table_planes(num_b)
+        table, fill = empty["broker_table"], empty["table_fill"]
+        t_load, t_bonus = empty["table_load"], empty["table_bonus"]
+        t_leader, t_ok = empty["table_leader"], empty["table_ok"]
         r_ok = jnp.zeros((1,), dtype=bool)
     cache = RoundCache(
         broker_load=load,
@@ -428,15 +440,8 @@ def strip_table(cache: RoundCache) -> RoundCache:
     """Detach the broker table (0-width planes): the leadership sweep
     runs table-less because per-commit slot lookups would dominate its
     round cost (see analyzer/leadership.py module docstring)."""
-    num_b = cache.broker_load.shape[0]
     return dataclasses.replace(
-        cache,
-        broker_table=jnp.zeros((num_b, 0), dtype=jnp.int32),
-        table_fill=jnp.zeros((num_b,), dtype=jnp.int32),
-        table_load=jnp.zeros((num_b, 0, NUM_RESOURCES), dtype=jnp.float32),
-        table_bonus=jnp.zeros((num_b, 0, NUM_RESOURCES), dtype=jnp.float32),
-        table_leader=jnp.zeros((num_b, 0), dtype=bool),
-        table_ok=jnp.zeros((num_b, 0), dtype=bool))
+        cache, **_empty_table_planes(cache.broker_load.shape[0]))
 
 
 def reattach_table(state: ClusterState, cache: RoundCache,

@@ -159,8 +159,14 @@ class Goal(abc.ABC):
                                   ctx: OptimizationContext,
                                   cache: RoundCache):
         """Like move_headroom_terms, for leadership transfers: `w` is
-        indexed by the SOURCE (current leader) replica and is the load
-        that travels with leadership of its partition."""
+        f32[R], the load that arrives with leadership of a replica's
+        partition.  Consumers index it by the PROMOTED replica on the
+        destination side and by the DEMOTED leader on the source side
+        (kernels.leadership_round / leadership.global_leadership_sweep) —
+        per-replica base loads (builder.py follower_loads) make siblings
+        of one partition differ, so the two ends of a transfer may carry
+        different weights (update_cache_for_leadership maintains the same
+        -w[src]/+w[dst] asymmetry)."""
         return None
 
     # ---- violation surface (detector + hard-goal verification) ----

@@ -98,6 +98,15 @@ class KafkaAssignerDiskUsageDistributionGoal(Goal):
             w = cache.replica_load[:, Resource.DISK]
             # per-broker absolute target: same relative fill everywhere
             target = avg * cap
+            # deliberately NO lower/upper band gate here (unlike the
+            # ResourceDistributionGoal swap phases): the reference's
+            # kafka-assigner swap bounds are convergence bounds — each
+            # side may end anywhere the exchange leaves total deviation
+            # improved, capped only by the partner's pre-swap level
+            # (KafkaAssignerDiskUsageDistributionGoal.java:300-330
+            # requirements 2/3/5/6), not by the balance band; both swap
+            # ends here are outside the band by selection, so no in-band
+            # broker can be pushed out
             out_r, in_r, cold_idx, valid = kernels.swap_round(
                 st, w, movable, hot, cold, util, target,
                 lambda r, d: accept(r, d), ctx.partition_replicas,

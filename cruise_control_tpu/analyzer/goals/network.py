@@ -268,9 +268,10 @@ class LeaderBytesInDistributionGoal(Goal):
         return (w <= 0.0) | (lbi[dest_broker] + w <= upper)
 
     def leadership_headroom_terms(self, state, ctx, cache):
-        """Each transfer lands the new leader's base NW_IN at its broker
-        (replicas of one partition share base NW_IN, so indexing by the
-        demoted leader is exact)."""
+        """Each transfer lands the new leader's base NW_IN at its broker;
+        consumers index the dest side by the PROMOTED replica (per-replica
+        base loads may differ within a partition — base.py terms
+        contract)."""
         lbi = cache.leader_bytes_in
         return [("lbi", self._leader_nw_in(state),
                  self._bounds(state, lbi) - lbi, None)]

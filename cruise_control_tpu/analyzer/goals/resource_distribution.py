@@ -195,7 +195,8 @@ class ResourceDistributionGoal(Goal):
             out_r, in_r, cold_idx, valid = kernels.swap_round(
                 st, w, movable, hot, cold, W, target, accept,
                 ctx.partition_replicas, cache=cache,
-                w_rows=cache.table_load[:, :, res])
+                w_rows=cache.table_load[:, :, res],
+                lower=lower, upper=upper)
             st, cache = kernels.commit_swaps_cached(st, cache, out_r, in_r,
                                                     cold_idx, valid)
             return st, cache, jnp.any(valid)
@@ -224,7 +225,8 @@ class ResourceDistributionGoal(Goal):
             out_r, in_r, cold_idx, valid = kernels.swap_round(
                 st, w, movable, hot, cold, W, target, accept,
                 ctx.partition_replicas, cache=cache,
-                w_rows=cache.table_load[:, :, res])
+                w_rows=cache.table_load[:, :, res],
+                lower=lower, upper=upper)
             st, cache = kernels.commit_swaps_cached(st, cache, out_r, in_r,
                                                     cold_idx, valid)
             return st, cache, jnp.any(valid)
@@ -294,26 +296,38 @@ class ResourceDistributionGoal(Goal):
         return jnp.where(src_ok_before & dest_ok_before, strict, relaxed)
 
     def accept_swap(self, state, ctx, cache, out_replica, in_replica):
-        """Net-delta form: accept when each side ends within this goal's
-        bounds or strictly closer to the band midpoint than before."""
+        """Reference swap actionAcceptance, exact two-branch form
+        (ResourceDistributionGoal.java:98-123): with delta = the load the
+        out-side broker GAINS (w_in - w_out), when the losing broker is
+        above the balance lower limit AND the gaining broker under the
+        upper limit before the swap, the strict branch applies — the
+        gainer must stay under its upper limit and the loser above its
+        lower limit after (isSwapViolatingLimit, :864-920, "never make a
+        balanced broker unbalanced"); otherwise the swap must STRICTLY
+        shrink the utilization difference between the two brokers
+        (isSelfSatisfiedAfterSwap -> isGettingMoreBalanced, :837-862).
+        Zero-delta swaps are always accepted."""
         res = int(self.resource)
         W = cache.broker_load[:, res]
         cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
         lower = ctx.balance_lower_pct[res] * cap
         upper = ctx.balance_upper_pct[res] * cap
-        mid = (lower + upper) / 2.0
         w_out = cache.replica_load[:, res][out_replica]
         w_in = cache.replica_load[:, res][in_replica]
         b_out = state.replica_broker[out_replica]
         b_in = state.replica_broker[in_replica]
-        d = w_out - w_in
-
-        def side_ok(b, after):
-            in_bounds = (after >= lower[b]) & (after <= upper[b])
-            closer = jnp.abs(after - mid[b]) <= jnp.abs(W[b] - mid[b])
-            return in_bounds | closer
-
-        return (side_ok(b_out, W[b_out] - d) & side_ok(b_in, W[b_in] + d))
+        d = w_in - w_out                       # what b_out gains
+        gain_b = jnp.where(d > 0, b_out, b_in)
+        lose_b = jnp.where(d > 0, b_in, b_out)
+        mag = jnp.abs(d)
+        both_within = ((W[lose_b] >= lower[lose_b])
+                       & (W[gain_b] <= upper[gain_b]))
+        strict = ((W[gain_b] + mag <= upper[gain_b])
+                  & (W[lose_b] - mag >= lower[lose_b]))
+        prev_diff = W[b_out] / cap[b_out] - W[b_in] / cap[b_in]
+        next_diff = prev_diff + d / cap[b_out] + d / cap[b_in]
+        relaxed = jnp.abs(next_diff) < jnp.abs(prev_diff)
+        return (d == 0) | jnp.where(both_within, strict, relaxed)
 
     def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
         if not self._leadership_applicable():

@@ -820,11 +820,11 @@ def leadership_round(state: ClusterState,
                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One round of batched leadership-transfer search.
 
-    `escalate=False` skips the starvation-escalation tiers (the deep
-    shortlist and the full [R, RF] plane): correct only for
-    OPPORTUNISTIC phases that need no no-stall guarantee — e.g. the
-    leader-count refuel phase, which is capped per sweep anyway; the
-    tiers were its dominant cost.
+    `escalate=False` skips the zero-commit starvation fallbacks (the
+    per-broker deep-64 accepted pick and the full [R, RF] plane):
+    correct only for OPPORTUNISTIC phases that need no no-stall
+    guarantee — e.g. the leader-count refuel phase, which is capped per
+    sweep anyway.
 
     For every leader replica on an overloaded broker, consider handing
     leadership to each of its followers (reference ResourceDistributionGoal
@@ -836,12 +836,17 @@ def leadership_round(state: ClusterState,
 
     Resident-row mode (`bonus_rows` + `value_rows`, both [B, S] from the
     cache aux tables; bonus_rows NEG-masked by the goal): candidate
-    leaders come from a per-broker top-k over `bonus_rows`, and the
-    follower/acceptance planes are evaluated ONLY on those B*k candidates
-    — the full [R, RF] plane costs ~9M gathers per round at north scale
-    (~40ms at the measured ~140M gathered elem/s), the dominant cost of
-    leadership-heavy goals.  A per-broker starvation escalation falls back
-    to the full plane so shortlist truncation can never stall a broker.
+    leaders come from a per-broker STRUCTURAL top-k over `bonus_rows`
+    (no acceptance at selection), compact to the top CAND_COMPACT by
+    gain, and the follower/acceptance planes are evaluated ONLY on the
+    compacted candidates — the full [R, RF] plane costs ~9M gathers per
+    round at north scale (~40ms at the measured ~140M gathered elem/s),
+    and the prior-goal acceptance stack over even the [B*k, RF]
+    candidate planes dominated leadership-heavy round cost at 13 prior
+    goals (round-4 profile, ~150 ms/round).  Starvation safety is a
+    ZERO-COMMIT fallback chain (deep-64 accepted pick, then the full
+    plane — see the in-body comment), so truncation can never stall the
+    goal loop while feasible transfers exist.
 
     `dest_terms` / `src_terms` ([(w f32[R], headroom f32[B]), ...], from
     Goal.leadership_headroom_terms + the optimizing goal's own bound)
@@ -880,28 +885,173 @@ def leadership_round(state: ClusterState,
 
     is_src = src_excess > 0.0
     multi = dest_terms is not None
+    if multi:
+        # the optimizing goal's OWN strict bound leads the dest terms,
+        # tightened by the caller's spreading bound (see move_round)
+        own_hr_l = (jnp.minimum(dest_headroom, dest_stack_headroom)
+                    if dest_stack_headroom is not None else dest_headroom)
+        dest_terms = [(bonus_w, own_hr_l)] + list(dest_terms)
+
+    def run_tail(cand_r_safe, cand_has):
+        """Follower assignment for ONE candidate set ([n] replica ids,
+        any n): prior-goal acceptance stack evaluated on the [n, RF]
+        sibling planes, then the multi-pass assignment.  Shared by the
+        compacted fast path and the (rarely-taken) starvation fallbacks,
+        so the acceptance stack's cost scales with the candidate-set
+        width the caller chose.  Returns (dest_replica i32[n],
+        assigned bool[n])."""
+        cand_bonus = bonus_w[cand_r_safe]
+        sib_c, sib_broker_c, acc_c = options_feasible(cand_r_safe,
+                                                      cand_bonus)
+        acc_c &= cand_has[:, None]
+        pref_c = jnp.where(acc_c, dest_pref[sib_broker_c], NEG)
+
+        # multi-pass follower assignment (see assign_destinations): per
+        # pass, each source broker hands off at most one leadership and
+        # each destination broker gains at most one; without
+        # quantitative terms a broker participates once per ROUND
+        # (boolean-acceptance snapshot), with terms once per PASS under
+        # cumulative strict gating
+        gain = cand_bonus
+        C = cand_r_safe.shape[0]
+        src_of_cand = rb[cand_r_safe]
+        taken_cnt = jnp.zeros(num_b, dtype=jnp.int32)
+        dep_cnt = jnp.zeros(num_b, dtype=jnp.int32)
+        cum_d = [jnp.zeros(num_b, dtype=jnp.float32)
+                 for _ in (dest_terms or ())]
+        assigned = jnp.zeros(C, dtype=bool)
+        dest_replica = jnp.zeros(C, dtype=jnp.int32)
+        n_passes = MULTI_ASSIGN_PASSES if multi else ASSIGN_PASSES
+        finite_p = pref_c > NEG / 2
+        pmax = jnp.max(jnp.where(finite_p, pref_c, -jnp.inf))
+        pmin = jnp.min(jnp.where(finite_p, pref_c, jnp.inf))
+        spread_p = jnp.where(jnp.isfinite(pmax - pmin), pmax - pmin, 0.0)
+        amp_p = 0.35 * spread_p + 1e-6
+        for _pass in range(n_passes):
+            # fresh per-pass jitter spreads equal-gain losers (see
+            # _pairwise_jitter); pass 0 keeps true preferences
+            pref_c_pass = pref_c if _pass == 0 else jnp.where(
+                finite_p, pref_c + amp_p * _pairwise_jitter(
+                    C, pref_c.shape[1], salt=_pass), NEG)
+            if multi:
+                open_d = taken_cnt[sib_broker_c] < MAX_ARRIVALS_PER_ROUND
+                open_pref = jnp.where(open_d, pref_c_pass, NEG)
+                open_pref = jnp.where(assigned[:, None], NEG, open_pref)
+                slot = jnp.argmax(open_pref, axis=1)
+                has = cand_has & (jnp.max(open_pref, axis=1) > NEG / 2)
+                db = sib_broker_c[jnp.arange(C), slot]
+                # dest weights index the PROMOTED replica chosen this
+                # pass: the destination gains what the new leader
+                # carries, and per-replica base loads (builder.py
+                # follower_loads) make siblings differ — matches
+                # update_cache_for_leadership's -w[src]/+w[dst]
+                # maintenance (review finding, round 4)
+                dr_pass = sib_c[jnp.arange(C), slot]
+                d_w = [t_w[dr_pass] for t_w, _ in dest_terms]
+                # ranked prefix acceptance per destination broker (see
+                # rank_accept): several transfers may land on one broker
+                # per pass under the cumulative strict gates
+                keep = rank_accept(
+                    db, gain, has, num_b, taken_cnt,
+                    jnp.full((num_b,), MAX_ARRIVALS_PER_ROUND, jnp.int32),
+                    cum_d, d_w, [hr for _, hr in dest_terms])
+            else:
+                open_pref = jnp.where((taken_cnt[sib_broker_c] > 0)
+                                      | (dep_cnt[src_of_cand] > 0)[:, None],
+                                      NEG, pref_c_pass)
+                open_pref = jnp.where(assigned[:, None], NEG, open_pref)
+                slot = jnp.argmax(open_pref, axis=1)
+                has = cand_has & (jnp.max(open_pref, axis=1) > NEG / 2)
+                db = sib_broker_c[jnp.arange(C), slot]
+                keep = resolve_dest_conflicts(db, gain, has, num_b)
+                # single-commit mode: one transfer per source broker per
+                # round
+                keep = resolve_dest_conflicts(src_of_cand, gain, keep,
+                                              num_b)
+            dest_replica = jnp.where(keep, sib_c[jnp.arange(C), slot],
+                                     dest_replica)
+            assigned = assigned | keep
+            kept_d = jnp.where(keep, db, num_b)
+            kept_s = jnp.where(keep, src_of_cand, num_b)
+            taken_cnt = taken_cnt.at[kept_d].add(1, mode="drop")
+            dep_cnt = dep_cnt.at[kept_s].add(1, mode="drop")
+            for i in range(len(cum_d)):
+                cum_d[i] = cum_d[i].at[kept_d].add(
+                    jnp.where(keep, d_w[i], 0.0), mode="drop")
+        return dest_replica.astype(jnp.int32), assigned
+
     if (bonus_rows is not None and value_rows is not None
             and _has_table(cache)):
-        # per-broker top-k0 structural candidates, ALL kept (the
-        # assignment tail serves as many as its pass budget and gates
-        # allow — with one candidate per broker a round could never
-        # commit more than one transfer per source)
+        # ---- round-5 redesign: candidate COMPACTION for leadership ----
+        # The round-4 profile: the prior-goal acceptance stack evaluated
+        # over the full [B*k0, RF] candidate planes — once at selection
+        # and once in the assignment tail — dominated leadership-heavy
+        # round cost (~150 ms at 2.6K brokers / 13 prior goals).  The
+        # selection is now STRUCTURAL only (a [B, S] top-k, no
+        # acceptance); candidates compact to the top CAND_COMPACT by
+        # gain and the acceptance stack runs ONCE on the compacted
+        # planes (same lever as move_round's compact_candidates, the
+        # decisive round-4 change there).  Starvation safety moves from
+        # the per-round thin-progress tiers to a ZERO-COMMIT fallback
+        # chain below: a round that commits nothing while structural
+        # work exists re-runs with (1) per-broker first-ACCEPTED
+        # candidate among the top-64 (depth rescue), then (2) the full
+        # [R, RF] plane (the no-stall guarantee hard goals need —
+        # without it a falsely-converged round aborts the run).  Both
+        # branches live under lax.cond, so productive rounds never pay
+        # them.
         k0 = min(8, max(cache.broker_table.shape[1], 1))
         top_sc, slots = jax.lax.top_k(bonus_rows, k0)          # [B, k0]
         has_struct_k = top_sc > NEG / 2
         cand_k = jnp.take_along_axis(cache.broker_table, slots, axis=1)
         cand_r = jnp.where(has_struct_k, cand_k, -1).reshape(-1)
-        flat_bonus = jnp.take_along_axis(value_rows, slots,
-                                         axis=1).reshape(-1)
-        _, _, ok_opts0 = options_feasible(
-            jnp.maximum(cand_r, 0), flat_bonus)
-        cand_has = (jnp.any(ok_opts0, axis=1)
-                    & has_struct_k.reshape(-1))                # [B*k0]
-        row_served = jnp.any(cand_has.reshape(num_b, k0), axis=1)
+        cand_has = has_struct_k.reshape(-1)
+        cand_r_safe = jnp.maximum(cand_r, 0)
+        cand_bonus_b = bonus_w[cand_r_safe]
+        if multi and k0 > 1:
+            # source-side strict bounds gate by PREFIX over each
+            # broker's rank-ordered candidates (rank 0 free, rank j
+            # assumes ranks < j commit — conservative; see move_round).
+            # Weights only — needs the [B, k0] row structure, so it runs
+            # BEFORE compaction.
+            w_bk = jnp.where(cand_has, cand_bonus_b,
+                             0.0).reshape(num_b, k0)
+            cum_before = jnp.cumsum(w_bk, axis=1) - w_bk
+            cand_has &= (cum_before < src_excess[:, None]).reshape(-1)
+            rank = jnp.arange(k0, dtype=jnp.int32)[None, :]
+            for t_w, t_hr in (src_terms or ()):
+                tw_bk = jnp.where(cand_has, t_w[cand_r_safe],
+                                  0.0).reshape(num_b, k0)
+                cum_incl = jnp.cumsum(tw_bk, axis=1)
+                cand_has &= ((rank == 0)
+                             | (cum_incl <= t_hr[:, None])).reshape(-1)
+        c_full = cand_r.shape[0]
+        sel, _, ch_c, cr_safe_c = compact_candidates(
+            CAND_COMPACT, cand_bonus_b, cand_has, cand_r_safe)
+        dest_c, asg_c = run_tail(cr_safe_c, ch_c)
+        if sel is not None:
+            dest_full = jnp.zeros((c_full,), jnp.int32).at[sel].set(dest_c)
+            valid_full = jnp.zeros((c_full,), bool).at[sel].set(asg_c)
+        else:
+            dest_full, valid_full = dest_c, asg_c
 
-        def pick_first_ok(k):
+        if not escalate:
+            return cand_r, dest_full, valid_full
+
+        def fb_triple(pick, has):
+            """[B]-candidate fallback result embedded in the [c_full]
+            layout (slot 0 of each broker's row); only reached on
+            zero-commit rounds, so overwriting is safe."""
+            dest_b, asg_b = run_tail(jnp.maximum(pick, 0), has)
+            idx = jnp.arange(num_b, dtype=jnp.int32) * k0
+            cr = jnp.full((c_full,), -1, jnp.int32).at[idx].set(pick)
+            dst = jnp.zeros((c_full,), jnp.int32).at[idx].set(dest_b)
+            vld = jnp.zeros((c_full,), bool).at[idx].set(asg_b & has)
+            return cr, dst, vld
+
+        def deep_pick(k):
             """Per-broker first ACCEPTED candidate among the top-k
-            structural candidates of each row (escalation tiers)."""
+            structural candidates of each row."""
             k = min(k, max(cache.broker_table.shape[1], 1))
             t_sc, t_slots = jax.lax.top_k(bonus_rows, k)       # [B, k]
             hs = t_sc > NEG / 2
@@ -918,161 +1068,40 @@ def leadership_round(state: ClusterState,
                 jnp.take_along_axis(ck, first[:, None], axis=1)[:, 0], -1)
             return pick, has
 
-        def tier_merge(pick, has, cand_r, cand_has, row_served):
-            """Give each still-unserved row its tier pick as slot 0."""
-            take = struct_any & ~row_served & has
-            cr = cand_r.reshape(num_b, k0)
-            ch = cand_has.reshape(num_b, k0)
-            cr = cr.at[:, 0].set(jnp.where(take, pick, cr[:, 0]))
-            ch = ch.at[:, 0].set(ch[:, 0] | take)
-            return cr.reshape(-1), ch.reshape(-1), row_served | take
-
-        # starvation escalation, TWO TIERS (see move_round for the
-        # thin-progress rationale).  The convergence tail triggers thin
-        # rounds repeatedly, so tier 1 stays candidate-level: re-pick from
-        # a DEEP per-broker shortlist (top-64 structural candidates, ~8x
-        # cheaper than the [R, RF] plane).  Tier 2 — the true full plane —
-        # runs only on thin rounds the deep tier could not help at all,
-        # so no broker with a feasible handoff deeper than its top-64 can
-        # stall for a whole phase.
-        struct_any = jnp.any(bonus_rows > NEG / 2, axis=1)
-        thin = (jnp.sum(row_served) * 8 < jnp.sum(struct_any)) \
-            if escalate else jnp.zeros((), bool)
-
-        served_before_deep = jnp.sum(row_served)
-        cand_r, cand_has, row_served = jax.lax.cond(
-            jnp.any(struct_any & ~row_served) & thin,
-            lambda: tier_merge(*pick_first_ok(64), cand_r, cand_has,
-                               row_served),
-            lambda: (cand_r, cand_has, row_served)) \
-            if escalate else (cand_r, cand_has, row_served)
-
-        def full_plane():
+        def full_plane_pick():
             lead_eligible = (movable & state.replica_is_leader
                              & is_src[rb] & (bonus_w > 0.0))
             _, _, ok_full = options_feasible(r_idx, bonus_w)
             r_has = jnp.any(ok_full, axis=1) & lead_eligible
             score = jnp.where(r_has,
                               shed_score(bonus_w, src_excess[rb]), NEG)
-            f_cand, f_has = table_pick_best(cache, score, r_has)
-            return tier_merge(f_cand, f_has, cand_r, cand_has, row_served)
+            return table_pick_best(cache, score, r_has)
 
-        deep_helped = jnp.sum(row_served) > served_before_deep
-        cand_r, cand_has, row_served = jax.lax.cond(
-            jnp.any(struct_any & ~row_served) & thin & ~deep_helped,
-            full_plane, lambda: (cand_r, cand_has, row_served)) \
-            if escalate else (cand_r, cand_has, row_served)
-        cand_r_safe = jnp.maximum(cand_r, 0)
-        cand_bonus_b = bonus_w[cand_r_safe]
+        need_deep = jnp.any(cand_has) & ~jnp.any(valid_full)
+        cand_r2, dest2, valid2 = jax.lax.cond(
+            need_deep, lambda: fb_triple(*deep_pick(64)),
+            lambda: (cand_r, dest_full, valid_full))
+        need_full = need_deep & ~jnp.any(valid2)
+        return jax.lax.cond(
+            need_full, lambda: fb_triple(*full_plane_pick()),
+            lambda: (cand_r2, dest2, valid2))
+
+    # full-plane selection (no resident rows / no table): one candidate
+    # per broker, acceptance evaluated at selection — small models only
+    lead_eligible = (movable & state.replica_is_leader & is_src[rb]
+                     & (bonus_w > 0.0))
+    sib_safe_all, sib_b_all, ok_all = options_feasible(r_idx, bonus_w)
+    feasible = ok_all & lead_eligible[:, None]
+    pref_full = jnp.where(feasible, dest_pref[sib_b_all], NEG)
+    r_has = jnp.max(pref_full, axis=1) > NEG / 2
+    score = jnp.where(r_has, shed_score(bonus_w, src_excess[rb]), NEG)
+    if _has_table(cache):
+        cand_r, cand_has = table_pick_best(cache, score, r_has)
     else:
-        lead_eligible = (movable & state.replica_is_leader & is_src[rb]
-                         & (bonus_w > 0.0))
-        sib_safe_all, sib_b_all, ok_all = options_feasible(r_idx, bonus_w)
-        feasible = ok_all & lead_eligible[:, None]
-        pref_full = jnp.where(feasible, dest_pref[sib_b_all], NEG)
-        r_has = jnp.max(pref_full, axis=1) > NEG / 2
-        score = jnp.where(r_has, shed_score(bonus_w, src_excess[rb]), NEG)
-        if _has_table(cache):
-            cand_r, cand_has = table_pick_best(cache, score, r_has)
-        else:
-            cand_r, _, cand_has = per_segment_argmax(score, rb, num_b,
-                                                     r_has)
-        cand_r_safe = jnp.maximum(cand_r, 0)
-        cand_bonus_b = bonus_w[cand_r_safe]
-
-    # assignment tail on the chosen candidates ([C, RF], small):
-    # acceptance+structural re-evaluated for every path identically
-    sib_c, sib_broker_c, acc_c = options_feasible(cand_r_safe, cand_bonus_b)
-    acc_c &= cand_has[:, None]
-    pref_c = jnp.where(acc_c, dest_pref[sib_broker_c], NEG)
-
-    # multi-pass follower assignment (see assign_destinations): per pass,
-    # each source broker hands off at most one leadership and each
-    # destination broker gains at most one; without quantitative terms a
-    # broker participates once per ROUND (boolean-acceptance snapshot),
-    # with terms once per PASS under cumulative strict gating
-    gain = cand_bonus_b
-    C = cand_r_safe.shape[0]
-    src_of_cand = rb[cand_r_safe]
-    if multi:
-        # source-side strict bounds gate by PREFIX over each broker's
-        # rank-ordered candidates (see move_round: rank 0 free, rank j
-        # assumes ranks < j commit — conservative, and it lets one
-        # broker hand off several leaderships per round without
-        # one-per-pass serialization); candidates of broker b occupy
-        # rows b*k..b*k+k-1, so the reshape below is the row structure
-        kk = max(1, C // num_b)
-        if kk > 1:
-            w_bk = jnp.where(cand_has, cand_bonus_b,
-                             0.0).reshape(num_b, kk)
-            cum_before = jnp.cumsum(w_bk, axis=1) - w_bk
-            cand_has &= (cum_before < src_excess[:, None]).reshape(-1)
-            rank = jnp.arange(kk, dtype=jnp.int32)[None, :]
-            for t_w, t_hr in (src_terms or ()):
-                tw_bk = jnp.where(cand_has, t_w[cand_r_safe],
-                                  0.0).reshape(num_b, kk)
-                cum_incl = jnp.cumsum(tw_bk, axis=1)
-                cand_has &= ((rank == 0)
-                             | (cum_incl <= t_hr[:, None])).reshape(-1)
-        # the optimizing goal's OWN strict bound leads the dest terms,
-        # tightened by the caller's spreading bound (see move_round)
-        own_hr_l = (jnp.minimum(dest_headroom, dest_stack_headroom)
-                    if dest_stack_headroom is not None else dest_headroom)
-        dest_terms = [(bonus_w, own_hr_l)] + list(dest_terms)
-    taken_cnt = jnp.zeros(num_b, dtype=jnp.int32)
-    dep_cnt = jnp.zeros(num_b, dtype=jnp.int32)
-    cum_d = [jnp.zeros(num_b, dtype=jnp.float32) for _ in (dest_terms or ())]
-    d_w = [t_w[cand_r_safe] for t_w, _ in (dest_terms or ())]
-    assigned = jnp.zeros(C, dtype=bool)
-    dest_replica = jnp.zeros(C, dtype=jnp.int32)
-    n_passes = MULTI_ASSIGN_PASSES if multi else ASSIGN_PASSES
-    finite_p = pref_c > NEG / 2
-    pmax = jnp.max(jnp.where(finite_p, pref_c, -jnp.inf))
-    pmin = jnp.min(jnp.where(finite_p, pref_c, jnp.inf))
-    spread_p = jnp.where(jnp.isfinite(pmax - pmin), pmax - pmin, 0.0)
-    amp_p = 0.35 * spread_p + 1e-6
-    for _pass in range(n_passes):
-        # fresh per-pass jitter spreads equal-gain losers (see
-        # _pairwise_jitter); pass 0 keeps true preferences
-        pref_c_pass = pref_c if _pass == 0 else jnp.where(
-            finite_p, pref_c + amp_p * _pairwise_jitter(
-                C, pref_c.shape[1], salt=_pass), NEG)
-        if multi:
-            open_d = taken_cnt[sib_broker_c] < MAX_ARRIVALS_PER_ROUND
-            open_pref = jnp.where(open_d, pref_c_pass, NEG)
-            open_pref = jnp.where(assigned[:, None], NEG, open_pref)
-            slot = jnp.argmax(open_pref, axis=1)
-            has = cand_has & (jnp.max(open_pref, axis=1) > NEG / 2)
-            db = sib_broker_c[jnp.arange(C), slot]
-            # ranked prefix acceptance per destination broker (see
-            # rank_accept): several transfers may land on one broker per
-            # pass under the cumulative strict gates
-            keep = rank_accept(
-                db, gain, has, num_b, taken_cnt,
-                jnp.full((num_b,), MAX_ARRIVALS_PER_ROUND, jnp.int32),
-                cum_d, d_w, [hr for _, hr in dest_terms])
-        else:
-            open_pref = jnp.where((taken_cnt[sib_broker_c] > 0)
-                                  | (dep_cnt[src_of_cand] > 0)[:, None],
-                                  NEG, pref_c_pass)
-            open_pref = jnp.where(assigned[:, None], NEG, open_pref)
-            slot = jnp.argmax(open_pref, axis=1)
-            has = cand_has & (jnp.max(open_pref, axis=1) > NEG / 2)
-            db = sib_broker_c[jnp.arange(C), slot]
-            keep = resolve_dest_conflicts(db, gain, has, num_b)
-            # single-commit mode: one transfer per source broker per round
-            keep = resolve_dest_conflicts(src_of_cand, gain, keep, num_b)
-        dest_replica = jnp.where(keep, sib_c[jnp.arange(C), slot],
-                                 dest_replica)
-        assigned = assigned | keep
-        kept_d = jnp.where(keep, db, num_b)
-        kept_s = jnp.where(keep, src_of_cand, num_b)
-        taken_cnt = taken_cnt.at[kept_d].add(1, mode="drop")
-        dep_cnt = dep_cnt.at[kept_s].add(1, mode="drop")
-        for i in range(len(cum_d)):
-            cum_d[i] = cum_d[i].at[kept_d].add(
-                jnp.where(keep, d_w[i], 0.0), mode="drop")
-    return cand_r, dest_replica.astype(jnp.int32), assigned
+        cand_r, _, cand_has = per_segment_argmax(score, rb, num_b,
+                                                 r_has)
+    dest, asg = run_tail(jnp.maximum(cand_r, 0), cand_has)
+    return cand_r, dest, asg
 
 
 def forced_move_round(state: ClusterState,
@@ -1212,6 +1241,8 @@ def swap_round(state: ClusterState,
                partition_replicas: jax.Array,
                cache=None,
                w_rows: Optional[jax.Array] = None,
+               lower: Optional[jax.Array] = None,
+               upper: Optional[jax.Array] = None,
                ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """One round of batched replica-SWAP search.
 
@@ -1231,6 +1262,19 @@ def swap_round(state: ClusterState,
     either half as an isolated move can still accept the exchange.
 
     `w`, `util` and `target_util` share one absolute unit.
+
+    `lower` / `upper` (optional, f32[B], same unit): the optimizing
+    goal's own balance-band gate on the exchange — the side LOSING load
+    must stay >= lower and the side GAINING load must stay <= upper
+    (reference isSwapViolatingLimit /
+    isSwapViolatingContainerLimit, ResourceDistributionGoal.java:864-920:
+    for a positive source delta, source + delta <= source upper limit
+    AND destination - delta >= destination lower limit).  Without them a
+    deviation-improving trade may push an in-band broker out of the band
+    — measured on the 3-broker deterministic fixture: the under-fill
+    swap phase traded b0's 75-disk leader for b1's 55, dropping b0 from
+    120 to 100 against a lower limit of 106.2, ending the pipeline with
+    MORE violated brokers than it started (round-5 config-1 pin).
 
     Returns (out_r i32[B], in_r i32[B], cold i32[B], valid bool[B]) —
     for hot broker h: move out_r[h] -> cold[h] and in_r[cold[h]] -> h.
@@ -1309,6 +1353,12 @@ def swap_round(state: ClusterState,
                 & (delta > 0) & (imp > 0)
                 & ~dup_out & ~dup_in.T
                 & accept_pair_fn(out_h[:, None], in_c[None, :]))
+    if lower is not None:
+        # loser stays above its balance lower limit (hot sheds delta > 0)
+        feasible &= util[h_ids][:, None] - delta >= lower[h_ids][:, None]
+    if upper is not None:
+        # gainer stays under its balance upper limit
+        feasible &= util[c_ids][None, :] + delta <= upper[c_ids][None, :]
 
     score = jnp.where(feasible, imp, NEG)
     cold_slot = jnp.argmax(score, axis=1)

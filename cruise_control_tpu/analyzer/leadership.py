@@ -134,6 +134,7 @@ def global_leadership_sweep(
         compose_leadership_acceptance, leadership_commit_terms)
 
     num_b = state.num_brokers
+    num_p = ctx.partition_replicas.shape[0]
     rows = ctx.partition_replicas                       # i32[P, RF]
     rows_safe = jnp.maximum(rows, 0)
     # static per-replica eligibility (valid, not excluded topic, movable,
@@ -141,39 +142,86 @@ def global_leadership_sweep(
     static_ok = replica_static_ok(state, ctx)
     big_cap = jnp.full((num_b,), jnp.iinfo(jnp.int32).max // 2, jnp.int32)
     no_taken = jnp.zeros((num_b,), jnp.int32)
+    # loop-invariant [P, RF] jitter plane; rounds gather their window's
+    # rows (XLA hoists the plane out of the while_loop)
+    jit_plane = kernels._pairwise_jitter(rows.shape[0], rows.shape[1],
+                                         salt=0)
 
-    def round_body(st: ClusterState, cache: RoundCache, salt):
+    def round_body(st: ClusterState, cache: RoundCache, cur, salt):
+        """One sweep round.  `cur` (i32[P], the current leader replica per
+        partition) is CARRIED across rounds and maintained on commit —
+        recomputing it was an [R] segment_max per round (~5-10 ms at
+        600K replicas), and the round-5 redesign moved ALL [P, RF]-wide
+        work behind the window selection: only the [P]-sized source-side
+        terms are computed full-width; sibling/acceptance/deficit planes
+        run on the SWEEP_COMPACT window (round-4 profile: the full-width
+        planes plus the post-window acceptance stack dominated sweep
+        round cost at 200K partitions)."""
         W = measure(cache)                              # f32[B]
         alive = st.broker_alive
         shed_to, fill_to, hard_cap = bounds(st, W)
-        cur = S.partition_leader_replica(st)            # i32[P]
-        cur_safe = jnp.maximum(cur, 0)
-        src_b = st.replica_broker[cur_safe]
-        value_leave = value_r[cur_safe]                 # f32[P]
-        live = ((cur >= 0) & static_ok[cur_safe]
-                & (W[src_b] > shed_to[src_b]) & (value_leave > 0.0))
-
-        cand_b = st.replica_broker[rows_safe]           # i32[P, RF]
-        value_arrive = value_r[rows_safe]               # f32[P, RF]
-        ok = ((rows >= 0) & (rows != cur[:, None])
-              & static_ok[rows_safe]
-              & alive[cand_b] & ctx.broker_leader_ok[cand_b]
-              & (W[cand_b] + value_arrive <= hard_cap[cand_b]))
-        deficit = (fill_to - W)[cand_b]                 # f32[P, RF]
+        cur_safe0 = jnp.maximum(cur, 0)
+        src_b0 = st.replica_broker[cur_safe0]
+        value_leave0 = value_r[cur_safe0]               # f32[P]
+        live = ((cur >= 0) & static_ok[cur_safe0]
+                & (W[src_b0] > shed_to[src_b0]) & (value_leave0 > 0.0))
         if improve_gate:
-            # STRICT inequalities: an exact-mirror transfer (value equal
+            # STRICT inequality: an exact-mirror transfer (value equal
             # to twice the imbalance on both ends) passes <= gates in
             # both directions and ping-pongs between two brokers until
             # max_rounds is exhausted whenever the alive-broker average
             # lands on a half-integer (review finding, round 4)
-            ok &= ((value_leave[:, None]
-                    < 2.0 * (W[src_b] - shed_to[src_b])[:, None])
-                   & (value_arrive < 2.0 * deficit))
+            live &= value_leave0 < 2.0 * (W[src_b0] - shed_to[src_b0])
+        gain0 = value_leave0                             # bigger sheds first
+
+        # ---- window selection on [P]-sized terms only ----
+        # WINDOW SELECTION and COMMIT RANKING are split: selection adds
+        # full-spread salted jitter so rotation reaches every candidate
+        # across rounds (sibling feasibility and the acceptance stack
+        # run only on the window — without full-range rotation, vetoed
+        # occupants whose gain exceeds the feasible tail's would hold
+        # the window until the dry-round exit; measured round 4: weak
+        # 0.1 jitter left 233 violated vs 194 with full-width
+        # acceptance), while rank_accept still orders the window by the
+        # TRUE gain (bigger sheds first).  select_jitter scales the
+        # rotation: 1.0 (full spread) for uniform-gain sweeps (leader
+        # counts — rotation coverage is everything); smaller for
+        # value-weighted sweeps (bytes-in), where a mostly-greedy window
+        # preserves progress-per-round (measured at north: full rotation
+        # on the bytes-in sweep left its residual at 266 — barely below
+        # the 269 start — while the count sweep improved 201 -> 116).
+        # Round-5 note: the window now admits partitions with no
+        # feasible sibling (feasibility is evaluated post-window); they
+        # waste window slots for a round and rotate out — measured
+        # cheaper than the full-width [P, RF] feasibility planes.
+        g_lo = jnp.min(jnp.where(live, gain0, jnp.inf))
+        g_hi = jnp.max(jnp.where(live, gain0, -jnp.inf))
+        amp = jnp.where(g_hi > g_lo, g_hi - g_lo, 1.0) * select_jitter
+        gain_sel = gain0 + amp * kernels.salted_jitter(
+            gain0.shape[0], (salt * 100.0).astype(jnp.int32))
+        (sel, _, has, cur_safe, src_b,
+         value_leave, gain) = kernels.compact_candidates(
+            SWEEP_COMPACT, gain_sel, live, cur_safe0, src_b0,
+            value_leave0, gain0)
+        if sel is None:                     # tiny model: no compaction
+            sel = jnp.arange(num_p, dtype=jnp.int32)
+
+        # ---- sibling planes on the window ([W, RF]) ----
+        rows_w = rows[sel]
+        rows_w_safe = rows_safe[sel]
+        cand_b = st.replica_broker[rows_w_safe]         # i32[W, RF]
+        value_arrive = value_r[rows_w_safe]             # f32[W, RF]
+        ok = ((rows_w >= 0) & (rows_w != cur_safe[:, None])
+              & static_ok[rows_w_safe]
+              & alive[cand_b] & ctx.broker_leader_ok[cand_b]
+              & (W[cand_b] + value_arrive <= hard_cap[cand_b]))
+        deficit = (fill_to - W)[cand_b]                 # f32[W, RF]
+        if improve_gate:
+            ok &= value_arrive < 2.0 * deficit
         # per-round salted jitter so a partition whose best pick keeps
         # failing the acceptance stack tries a different sibling next
         # round (same rationale as kernels._pairwise_jitter)
-        jit = kernels._pairwise_jitter(rows.shape[0], rows.shape[1],
-                                       salt=0)          # static plane
+        jit = jit_plane[sel]
         spread = jnp.maximum(jnp.max(jnp.abs(deficit)), 1e-6)
         score = deficit + 0.1 * spread * ((jit + salt) % 1.0)
         if dest_tiebreak is not None:
@@ -182,42 +230,11 @@ def global_leadership_sweep(
             tb_norm = (tb - tb_lo) / jnp.maximum(jnp.max(tb) - tb_lo, 1e-9)
             score = score + 0.2 * spread * tb_norm[cand_b]
         score = jnp.where(ok, score, -jnp.inf)
-        best = jnp.argmax(score, axis=1)                # i32[P]
-        dst_r = jnp.take_along_axis(rows_safe, best[:, None], axis=1)[:, 0]
-        has = live & jnp.any(ok, axis=1)
+        best = jnp.argmax(score, axis=1)                # i32[W]
+        dst_r = jnp.take_along_axis(rows_w_safe, best[:, None],
+                                    axis=1)[:, 0]
+        has = has & jnp.any(ok, axis=1)
         dst_b = st.replica_broker[dst_r]
-        gain = value_leave                               # bigger sheds first
-
-        # compact the [P]-wide proposal set to the top live candidates
-        # before the acceptance stack and the ranked-prefix sorts: a
-        # round commits at most a few thousand transfers, while the
-        # rank_accept lexsorts and every prior goal's acceptance
-        # evaluated over all 200K partitions measured ~200 ms/round at
-        # north scale.  WINDOW SELECTION and COMMIT RANKING are split:
-        # selection adds full-spread salted jitter so rotation reaches
-        # every candidate across rounds (the acceptance stack runs after
-        # compaction — without full-range rotation, vetoed occupants
-        # whose gain exceeds the feasible tail's would hold the window
-        # until the dry-round exit; measured: weak 0.1 jitter left 233
-        # violated vs 194 with full-width acceptance), while rank_accept
-        # still orders the window by the TRUE gain (bigger sheds first).
-        # select_jitter scales the rotation: 1.0 (full spread) for
-        # uniform-gain sweeps (leader counts — any window member is as
-        # good as any other, rotation coverage is everything); smaller
-        # for value-weighted sweeps (bytes-in), where a mostly-greedy
-        # window preserves progress-per-round (measured at north: full
-        # rotation on the bytes-in sweep left its residual at 266 —
-        # barely below the 269 start — while the count sweep improved
-        # 201 -> 116)
-        g_lo = jnp.min(jnp.where(has, gain, jnp.inf))
-        g_hi = jnp.max(jnp.where(has, gain, -jnp.inf))
-        amp = jnp.where(g_hi > g_lo, g_hi - g_lo, 1.0) * select_jitter
-        gain_sel = gain + amp * kernels.salted_jitter(
-            gain.shape[0], (salt * 100.0).astype(jnp.int32))
-        (sel, _, has, cur_safe, src_b, dst_r, dst_b,
-         value_leave, gain) = kernels.compact_candidates(
-            SWEEP_COMPACT, gain_sel, has, cur_safe, src_b, dst_r, dst_b,
-            value_leave, gain)
 
         # previously-optimized goals' boolean acceptance on the chosen
         # transfer (single-action snapshot)
@@ -244,7 +261,13 @@ def global_leadership_sweep(
             src_cap, [zero] * len(src_w), src_w, src_hr)
 
         # --- destination side: fill toward fill_to ---
-        dst_w = [value_r[dst_r]] + [t_w[cur_safe] for t_w, _ in (lt_d or ())]
+        # prior-goal dest weights index the PROMOTED replica (dst_r): the
+        # destination broker gains what the new leader carries, and
+        # builder.py permits per-replica base loads (explicit
+        # follower_loads), so siblings of one partition may differ —
+        # update_cache_for_leadership applies the same -w[src]/+w[dst]
+        # asymmetry (review finding, round 4)
+        dst_w = [value_r[dst_r]] + [t_w[dst_r] for t_w, _ in (lt_d or ())]
         dst_hr = [fill_to - W] + [hr for _, hr in (lt_d or ())]
         valid = kernels.rank_accept(
             jnp.where(has, dst_b, num_b), gain, has, num_b, no_taken,
@@ -253,32 +276,39 @@ def global_leadership_sweep(
         new_st = S.apply_leadership_transfers(st, cur_safe, dst_r, valid)
         cache = update_cache_for_leadership(st, cache, cur_safe, dst_r,
                                             valid)
-        return new_st, cache, jnp.any(valid)
+        # maintain the carried leader index: committed partitions point
+        # at their promoted replica (scatter by partition, drop invalid)
+        p_w = st.replica_partition[cur_safe]
+        cur = cur.at[jnp.where(valid, p_w, num_p)].set(
+            dst_r, mode="drop")
+        return new_st, cache, cur, jnp.any(valid)
 
     def cond(carry):
-        st, cache, rounds, dry = carry
+        st, cache, cur, rounds, dry = carry
         W = measure(cache)
         shed_to, _, _ = bounds(st, W)
         work = jnp.any(st.broker_alive & (W > shed_to))
         # a zero-commit round does NOT end the sweep immediately: the
         # compaction window holds only SWEEP_COMPACT of the [P] proposals
-        # and the acceptance stack runs after compaction, so a starved
-        # window needs the salted-jitter rotation of the NEXT rounds to
-        # reach the feasible candidates outside it (review finding,
-        # round 4); three consecutive dry rounds end it.
+        # and sibling feasibility + the acceptance stack run after
+        # compaction, so a starved window needs the salted-jitter
+        # rotation of the NEXT rounds to reach the feasible candidates
+        # outside it (review finding, round 4); three consecutive dry
+        # rounds end it.
         return (dry < 3) & work & (rounds < max_rounds)
 
     def body(carry):
-        st, cache, rounds, dry = carry
-        st, cache, committed = round_body(st, cache,
-                                          rounds.astype(jnp.float32) * 0.37)
+        st, cache, cur, rounds, dry = carry
+        st, cache, cur, committed = round_body(
+            st, cache, cur, rounds.astype(jnp.float32) * 0.37)
         dry = jnp.where(committed, 0, dry + 1)
-        return st, cache, rounds + 1, dry
+        return st, cache, cur, rounds + 1, dry
 
     if cache0 is None:
         cache0 = make_round_cache(state, 0, ctx)
-    state, cache0, rounds, _ = jax.lax.while_loop(
-        cond, body, (state, cache0,
+    cur0 = S.partition_leader_replica(state)            # once, not per round
+    state, cache0, _, rounds, _ = jax.lax.while_loop(
+        cond, body, (state, cache0, cur0,
                      jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)))
     return state, rounds, cache0
 

@@ -441,8 +441,41 @@ class GoalOptimizer:
             ctx = dataclasses.replace(ctx,
                                       table_slots=_table_slots_override)
         initial = state
+        t_sb = time.time()
         stats_before = jax.device_get(
             self._run("__stats__", compute_stats, state))
+        if self.profile_segments:
+            LOG.info("stats_before: %.0fms", (time.time() - t_sb) * 1e3)
+        if warm_start is not None:
+            # the seed must agree with the live placement wherever THIS
+            # request's context forbids acting — the facade's
+            # compatibility check covers membership/topology, but the
+            # options can exclude topics/brokers the seed predates
+            # (review finding, round 5): a transplanted move of an
+            # excluded replica could never be undone by the goals
+            # (ctx.replica_excluded gates every action) and would leak
+            # into the proposals.  One [R]-sized device reduction.
+            frozen = ~(ctx.replica_movable & ~ctx.replica_excluded)
+            valid = state.replica_valid
+            seed_moved = valid & (warm_start.replica_broker
+                                  != state.replica_broker)
+            promoted = valid & (warm_start.replica_is_leader
+                                & ~state.replica_is_leader)
+            seed_b = jnp.minimum(warm_start.replica_broker,
+                                 state.num_brokers - 1)
+            bad = (
+                (frozen & valid
+                 & ((warm_start.replica_broker != state.replica_broker)
+                    | (warm_start.replica_disk != state.replica_disk)
+                    | (warm_start.replica_is_leader
+                       != state.replica_is_leader)))
+                | (seed_moved & ~ctx.broker_dest_ok[seed_b])
+                | (promoted & ~ctx.broker_leader_ok[seed_b]))
+            if bool(jax.device_get(jnp.any(bad))):
+                LOG.info("warm-start seed ignored: it repositions "
+                         "replicas this request's options freeze "
+                         "(excluded topics/brokers)")
+                warm_start = None
         if warm_start is not None:
             # placement transplant: same shapes, so every compiled
             # program is reused verbatim
@@ -483,10 +516,14 @@ class GoalOptimizer:
         LOG.debug("goal pipeline (%d segments) ran in %.0fms",
                   (len(self.goals) + seg - 1) // seg,
                   (time.time() - t0) * 1e3)
+        t_host = time.time()
         (stacked_h, own_h, rounds_h, vb_h, va_h, still_offline, broken,
          max_count, pre_rounds) = jax.device_get(
             (stacked_parts, own_parts, rounds_parts, vb_dev, va_dev,
              still_dev, broken_dev, maxc_dev, pre_rounds_dev))
+        if profile:
+            LOG.info("post sweep + host transfer: %.0fms",
+                     (time.time() - t_host) * 1e3)
         if ctx.table_slots and int(max_count) > ctx.table_slots:
             # self-healing runs table-less and may concentrate replicas
             # past the broker-table width sized from the PRE-heal counts;
@@ -560,8 +597,12 @@ class GoalOptimizer:
         if check_sanity:
             sanity_check(state)
 
+        t_diff = time.time()
         partition_rows = np.asarray(ctx.partition_replicas)
         proposals = diff_proposals(initial, state, topology, partition_rows)
+        if profile:
+            LOG.info("diff_proposals (%d proposals): %.0fms",
+                     len(proposals), (time.time() - t_diff) * 1e3)
         stats_after = (stats_by_goal[self.goals[-1].name] if self.goals
                        else jax.device_get(
                            self._run("__stats__", compute_stats, state)))

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import logging.handlers
 import signal
 import sys
 import threading
@@ -549,7 +550,6 @@ def main(argv=None) -> int:
     # (reference webserver.accesslog.{path,retention.days})
     accesslog_path = config.get("webserver.accesslog.path")
     if accesslog_path and config.get_boolean("webserver.accesslog.enabled"):
-        import logging.handlers
         handler = logging.handlers.TimedRotatingFileHandler(
             accesslog_path, when="D",
             backupCount=config.get_int("webserver.accesslog.retention.days"))

@@ -72,24 +72,10 @@ def compute_stats(state: ClusterState) -> ClusterModelStats:
         S.potential_leadership_load(state))
 
 
-def compute_stats_cached(state: ClusterState, cache) -> ClusterModelStats:
-    """compute_stats from a maintained RoundCache's aggregates — [B]-sized
-    work instead of [R] segment reductions (~131 ms → ~free at 600K
-    replicas).  The per-goal stats instrument inside pipeline segments
-    (the reference likewise reads its incrementally-maintained Load
-    aggregates when computing ClusterModelStats per goal,
-    GoalOptimizer.java:445-452)."""
-    return _stats_from(
-        state, cache.broker_util,
-        cache.replica_count.astype(jnp.float32),
-        cache.leader_count.astype(jnp.float32),
-        cache.broker_topic_count.astype(jnp.float32),
-        cache.potential_nw_out)
-
-
 def compute_stats_fresh_loads(state: ClusterState,
                               cache) -> ClusterModelStats:
-    """compute_stats_cached with the FLOAT aggregates (utilization,
+    """compute_stats from a maintained RoundCache, with the FLOAT
+    aggregates (utilization,
     potential NW_OUT) recomputed from state while counts come from the
     (exact, integer-maintained) cache.  The per-goal stats feed the
     stats-regression abort whose comparators check at ~1e-6 epsilons —

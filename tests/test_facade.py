@@ -81,6 +81,25 @@ def feed_samples(cc, clock, rounds=8):
 
 
 class TestFacade:
+    @pytest.mark.slow
+    def test_full_default_goal_stack_smoke(self):
+        """Facade wired with the PRODUCTION default goal list end to end
+        (the other facade tests run the trimmed FACADE_TEST_GOALS stack
+        for tracing economics — this one guards facade/goal-list wiring:
+        registry instantiation, segment slicing, per-goal stats plumbing
+        for the full 15-goal chain).  Marked slow; deselect with
+        `-m "not slow"` for quick iterations."""
+        from cruise_control_tpu.analyzer.goals.registry import \
+            DEFAULT_GOAL_ORDER
+        sim, cc, clock = make_stack(goal_names=DEFAULT_GOAL_ORDER)
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        result = cc.optimizations()
+        assert [g.name for g in cc.optimizer.goals] == DEFAULT_GOAL_ORDER
+        assert set(result.stats_by_goal) == set(DEFAULT_GOAL_ORDER)
+        assert not result.violated_goals_after
+        cc.shutdown()
+
     def test_cluster_model_and_cached_proposals(self):
         sim, cc, clock = make_stack()
         cc.start_up(do_sampling=False, start_detection=False)
