@@ -242,7 +242,7 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
             measure=lambda cache: cache.leader_count.astype(jnp.float32),
             value_r=jnp.ones(state.num_replicas, jnp.float32),
             bounds=mean_bounds(_upper_of), improve_gate=True,
-            max_rounds=72,
+            max_rounds=128,
             # same-deficit receivers tie-break toward LOW bytes-in so the
             # bulk count transfers also even out the later
             # LeaderBytesInDistributionGoal's surface instead of

@@ -188,7 +188,7 @@ class LeaderBytesInDistributionGoal(Goal):
             measure=lambda cache: cache.leader_bytes_in,
             value_r=value_r,
             bounds=mean_bounds(_upper_of), improve_gate=True,
-            max_rounds=72, select_jitter=VALUE_WEIGHTED_SELECT_JITTER)
+            max_rounds=128, select_jitter=VALUE_WEIGHTED_SELECT_JITTER)
         note_rounds(sweep_rounds)
 
         base_movable = replica_static_ok(state, ctx)

@@ -1000,7 +1000,11 @@ def leadership_round(state: ClusterState,
         # without it a falsely-converged round aborts the run).  Both
         # branches live under lax.cond, so productive rounds never pay
         # them.
-        k0 = min(8, max(cache.broker_table.shape[1], 1))
+        # k0=16 (round 5; was 8): structural selection is acceptance-free
+        # now, so doubling per-broker depth costs only the [B, S] top-k —
+        # and deeper rows mean fewer zero-commit fallbacks when a
+        # broker's best candidates are vetoed
+        k0 = min(16, max(cache.broker_table.shape[1], 1))
         top_sc, slots = jax.lax.top_k(bonus_rows, k0)          # [B, k0]
         has_struct_k = top_sc > NEG / 2
         cand_k = jnp.take_along_axis(cache.broker_table, slots, axis=1)
@@ -1026,8 +1030,28 @@ def leadership_round(state: ClusterState,
                 cand_has &= ((rank == 0)
                              | (cum_incl <= t_hr[:, None])).reshape(-1)
         c_full = cand_r.shape[0]
+        # window tie-rotation: leadership_round is called fresh each
+        # round with no round counter, so the salt derives from a
+        # state-dependent hash (leader counts + loads weighted by a
+        # fixed pseudo-random vector — any committed transfer or move
+        # perturbs it).  Without rotation, uniform-gain candidate sets
+        # (count goals: every transfer weighs 1) keep the same 2048
+        # window every round and vetoed occupants starve the rest
+        # (round-5 quality regression: CpuUsage violated 52 -> 81 when
+        # the compaction first landed without rotation).
+        hash_w = salted_jitter(num_b, jnp.zeros((), jnp.int32) + 13)
+        salt_r = (jnp.sum(cache.leader_count.astype(jnp.float32) * hash_w)
+                  + jnp.sum(cache.broker_load[:, 0] * hash_w)
+                  ).astype(jnp.int32) if cache is not None else \
+            jnp.zeros((), jnp.int32)
+        g_lo = jnp.min(jnp.where(cand_has, cand_bonus_b, jnp.inf))
+        g_hi = jnp.max(jnp.where(cand_has, cand_bonus_b, -jnp.inf))
+        spread_g = jnp.where(g_hi > g_lo, g_hi - g_lo,
+                             jnp.maximum(jnp.abs(g_hi), 1.0))
+        gain_sel = cand_bonus_b + 0.35 * spread_g * salted_jitter(
+            c_full, salt_r)
         sel, _, ch_c, cr_safe_c = compact_candidates(
-            CAND_COMPACT, cand_bonus_b, cand_has, cand_r_safe)
+            CAND_COMPACT, gain_sel, cand_has, cand_r_safe)
         dest_c, asg_c = run_tail(cr_safe_c, ch_c)
         if sel is not None:
             dest_full = jnp.zeros((c_full,), jnp.int32).at[sel].set(dest_c)

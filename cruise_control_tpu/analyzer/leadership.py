@@ -147,7 +147,7 @@ def global_leadership_sweep(
     jit_plane = kernels._pairwise_jitter(rows.shape[0], rows.shape[1],
                                          salt=0)
 
-    def round_body(st: ClusterState, cache: RoundCache, cur, salt):
+    def round_body(st: ClusterState, cache: RoundCache, cur, failed, salt):
         """One sweep round.  `cur` (i32[P], the current leader replica per
         partition) is CARRIED across rounds and maintained on commit —
         recomputing it was an [R] segment_max per round (~5-10 ms at
@@ -196,15 +196,29 @@ def global_leadership_sweep(
         # cheaper than the full-width [P, RF] feasibility planes.
         g_lo = jnp.min(jnp.where(live, gain0, jnp.inf))
         g_hi = jnp.max(jnp.where(live, gain0, -jnp.inf))
-        amp = jnp.where(g_hi > g_lo, g_hi - g_lo, 1.0) * select_jitter
-        gain_sel = gain0 + amp * kernels.salted_jitter(
-            gain0.shape[0], (salt * 100.0).astype(jnp.int32))
+        spread0 = jnp.where(g_hi > g_lo, g_hi - g_lo, 1.0)
+        amp = spread0 * select_jitter
+        # window-failure yielding (round 5): feasibility now runs only
+        # on the window, so a partition that made the window and
+        # committed nothing (no feasible sibling / acceptance veto) is
+        # KNOWN dead under the current surface — penalize it below the
+        # untried candidates so a mostly-greedy window (value-weighted
+        # sweeps, select_jitter=0.35) cannot be squatted by vetoed
+        # occupants until the dry-round exit; any commit round clears
+        # the penalties (the surface changed).  Without this the
+        # bytes-in sweep regressed its residual at north (307 vs 269
+        # start) when the post-window feasibility redesign landed.
+        gain_sel = (gain0
+                    + amp * kernels.salted_jitter(
+                        gain0.shape[0], (salt * 100.0).astype(jnp.int32))
+                    - failed * (spread0 + amp))
         (sel, _, has, cur_safe, src_b,
          value_leave, gain) = kernels.compact_candidates(
             SWEEP_COMPACT, gain_sel, live, cur_safe0, src_b0,
             value_leave0, gain0)
         if sel is None:                     # tiny model: no compaction
             sel = jnp.arange(num_p, dtype=jnp.int32)
+        live_w = has                        # window members, pre-checks
 
         # ---- sibling planes on the window ([W, RF]) ----
         rows_w = rows[sel]
@@ -225,10 +239,15 @@ def global_leadership_sweep(
         spread = jnp.maximum(jnp.max(jnp.abs(deficit)), 1e-6)
         score = deficit + 0.1 * spread * ((jit + salt) % 1.0)
         if dest_tiebreak is not None:
+            # 0.5x spread (round 5; 0.2x measured too weak): the count
+            # sweep's thousands of same-deficit receivers must lean hard
+            # toward low-bytes-in brokers or the bulk re-election
+            # scrambles the later LeaderBytesInDistributionGoal's
+            # surface (r4 regression 157 -> 227)
             tb = dest_tiebreak(cache)                   # f32[B]
             tb_lo = jnp.min(tb)
             tb_norm = (tb - tb_lo) / jnp.maximum(jnp.max(tb) - tb_lo, 1e-9)
-            score = score + 0.2 * spread * tb_norm[cand_b]
+            score = score + 0.5 * spread * tb_norm[cand_b]
         score = jnp.where(ok, score, -jnp.inf)
         best = jnp.argmax(score, axis=1)                # i32[W]
         dst_r = jnp.take_along_axis(rows_w_safe, best[:, None],
@@ -281,10 +300,15 @@ def global_leadership_sweep(
         p_w = st.replica_partition[cur_safe]
         cur = cur.at[jnp.where(valid, p_w, num_p)].set(
             dst_r, mode="drop")
-        return new_st, cache, cur, jnp.any(valid)
+        # window-failure bookkeeping: members that committed clear their
+        # mark, members that could not commit gain one (see gain_sel)
+        failed = failed.at[sel].set(
+            jnp.where(valid, 0.0,
+                      jnp.where(live_w & ~valid, 1.0, failed[sel])))
+        return new_st, cache, cur, failed, jnp.any(valid)
 
     def cond(carry):
-        st, cache, cur, rounds, dry = carry
+        st, cache, cur, failed, rounds, dry = carry
         W = measure(cache)
         shed_to, _, _ = bounds(st, W)
         work = jnp.any(st.broker_alive & (W > shed_to))
@@ -298,17 +322,18 @@ def global_leadership_sweep(
         return (dry < 3) & work & (rounds < max_rounds)
 
     def body(carry):
-        st, cache, cur, rounds, dry = carry
-        st, cache, cur, committed = round_body(
-            st, cache, cur, rounds.astype(jnp.float32) * 0.37)
+        st, cache, cur, failed, rounds, dry = carry
+        st, cache, cur, failed, committed = round_body(
+            st, cache, cur, failed, rounds.astype(jnp.float32) * 0.37)
         dry = jnp.where(committed, 0, dry + 1)
-        return st, cache, cur, rounds + 1, dry
+        return st, cache, cur, failed, rounds + 1, dry
 
     if cache0 is None:
         cache0 = make_round_cache(state, 0, ctx)
     cur0 = S.partition_leader_replica(state)            # once, not per round
-    state, cache0, _, rounds, _ = jax.lax.while_loop(
+    state, cache0, _, _, rounds, _ = jax.lax.while_loop(
         cond, body, (state, cache0, cur0,
+                     jnp.zeros((num_p,), jnp.float32),
                      jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)))
     return state, rounds, cache0
 

@@ -111,11 +111,28 @@ def diff_proposals(initial: ClusterState, optimized: ClusterState,
     only partitions whose replica brokers or leader flags changed produce a
     proposal (AnalyzerUtils.getDiff semantics).
     """
-    init = {k: np.asarray(getattr(initial, k)) for k in
-            ("replica_broker", "replica_is_leader", "replica_disk")}
-    opt = {k: np.asarray(getattr(optimized, k)) for k in
-           ("replica_broker", "replica_is_leader", "replica_disk")}
-    valid = np.asarray(initial.replica_valid)
+    # ONE batched device_get: each np.asarray on a device array is a
+    # separate synchronous device->host transfer — over a tunneled TPU
+    # transport the 8 serial round trips measured ~3.5 s at north scale
+    # against ~0.6 s for the whole host-side diff.  Disk-less models
+    # (num_disks == 0: no JBOD) skip the two [R] disk arrays entirely
+    # (~a third of the transferred bytes).
+    import jax
+    keys = ("replica_broker", "replica_is_leader")
+    has_disks = initial.num_disks > 0
+    if has_disks:
+        keys = keys + ("replica_disk",)
+    (init_t, opt_t, valid, base_disk) = jax.device_get((
+        tuple(getattr(initial, k) for k in keys),
+        tuple(getattr(optimized, k) for k in keys),
+        initial.replica_valid,
+        initial.replica_base_load[:, Resource.DISK]))
+    init = dict(zip(keys, init_t))
+    opt = dict(zip(keys, opt_t))
+    if not has_disks:
+        no_disk = np.full(initial.num_replicas, -1, dtype=np.int32)
+        init["replica_disk"] = no_disk
+        opt["replica_disk"] = no_disk
     changed_r = valid & (
         (init["replica_broker"] != opt["replica_broker"])
         | (init["replica_is_leader"] != opt["replica_is_leader"])
@@ -140,34 +157,46 @@ def diff_proposals(initial: ClusterState, optimized: ClusterState,
         gather(opt["replica_broker"]), gather(opt["replica_is_leader"]),
         gather(opt["replica_disk"]), row_valid, topology)
 
-    base = np.asarray(initial.replica_base_load)
-    sizes = np.where(row_valid, base[rows_safe, Resource.DISK], 0.0) \
-        .max(axis=1)
+    sizes = np.where(row_valid, base_disk[rows_safe], 0.0).max(axis=1)
     broker_ids = np.asarray(topology.broker_ids)
     old_bid = broker_ids[old_b]
     new_bid = broker_ids[new_b]
     # leader broker id (first ordered slot is a leader when one exists)
     old_leader = np.where(old_l[:, 0], old_bid[:, 0], -1)
 
+    # host-loop economics (measured at north scale, 74K proposals /
+    # 450K placements: 4.5 s -> ~1 s): batch-convert every array to
+    # Python lists once (per-element numpy scalar access dominates
+    # otherwise) and MEMOIZE ReplicaPlacement — distinct (broker,
+    # logdir) pairs number in the thousands while placements number in
+    # the hundreds of thousands, and the frozen dataclass is immutable
+    # so sharing instances is safe.
     disk_names = topology.disk_names
+    place_cache: dict = {}
+
+    def place(b: int, d: int) -> ReplicaPlacement:
+        p = place_cache.get((b, d))
+        if p is None:
+            p = ReplicaPlacement(b, disk_names[d][1] if d >= 0 else None)
+            place_cache[(b, d)] = p
+        return p
+
+    n_valid = row_valid.sum(axis=1).tolist()
+    old_bid_l, new_bid_l = old_bid.tolist(), new_bid.tolist()
+    old_d_l, new_d_l = old_d.tolist(), new_d.tolist()
+    sizes_l = sizes.tolist()
+    old_leader_l = old_leader.tolist()
+    partitions = topology.partitions
     proposals = []
-    for m, p in enumerate(changed_p):
-        n = int(row_valid[m].sum())
-        olds = tuple(
-            ReplicaPlacement(int(old_bid[m, i]),
-                             disk_names[old_d[m, i]][1]
-                             if old_d[m, i] >= 0 else None)
-            for i in range(n))
-        news = tuple(
-            ReplicaPlacement(int(new_bid[m, i]),
-                             disk_names[new_d[m, i]][1]
-                             if new_d[m, i] >= 0 else None)
-            for i in range(n))
+    for m, p_idx in enumerate(changed_p.tolist()):
+        n = n_valid[m]
+        ob, od = old_bid_l[m], old_d_l[m]
+        nb, nd = new_bid_l[m], new_d_l[m]
         proposals.append(ExecutionProposal(
-            partition=topology.partitions[int(p)],
-            old_leader=int(old_leader[m]),
-            old_replicas=olds,
-            new_replicas=news,
-            partition_size=float(sizes[m]),
+            partition=partitions[p_idx],
+            old_leader=old_leader_l[m],
+            old_replicas=tuple(place(ob[i], od[i]) for i in range(n)),
+            new_replicas=tuple(place(nb[i], nd[i]) for i in range(n)),
+            partition_size=sizes_l[m],
         ))
     return proposals

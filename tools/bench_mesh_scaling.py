@@ -16,15 +16,21 @@ import sys
 import time
 
 DEVICES = [int(d) for d in sys.argv[2:]] or [1, 2, 4, 8]
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + f" --xla_force_host_platform_device_count={max(DEVICES)}")
-
-import jax  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
+
+# a platform hook (sitecustomize) may have imported jax already with the
+# axon TPU backend registered — env vars alone are then a no-op and the
+# "virtual mesh" would silently target the one real TPU chip (and fight
+# any concurrent bench for it).  force_cpu_devices applies jax.config
+# updates that still take effect pre-computation.
+from cruise_control_tpu.testing.virtual_mesh import (  # noqa: E402
+    force_cpu_devices)
+
+force_cpu_devices(max(DEVICES))
+
+import jax  # noqa: E402
 
 from cruise_control_tpu.analyzer.context import (  # noqa: E402
     BalancingConstraint, OptimizationOptions, make_context)

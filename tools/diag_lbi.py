@@ -1,0 +1,127 @@
+"""Diagnose the LeaderBytesIn residual at north scale.
+
+Solves the north config (cached programs), then — on the FINAL state —
+enumerates every lbi-over broker's candidate leadership transfers and
+classifies the veto that blocks each: the goal's own bounds (dest
+already over / improve gate), the leader-count band, the CPU band, the
+NW_OUT band, structural (no eligible sibling).  The north-scale analog
+of tests/test_leader_semantics.py's hand enumeration: it separates
+"strict-priority semantics the reference would also leave" from
+"search interference this framework should fix".
+"""
+import os
+import sys
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from cruise_control_tpu.analyzer.context import (  # noqa: E402
+    OptimizationOptions, make_context, make_round_cache)
+from cruise_control_tpu.analyzer.goals.registry import (  # noqa: E402
+    default_goals)
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer  # noqa: E402
+from cruise_control_tpu.model import state as S  # noqa: E402
+from cruise_control_tpu.testing.random_cluster import (  # noqa: E402
+    RandomClusterSpec, random_cluster)
+
+
+def main() -> None:
+    state, topo = random_cluster(RandomClusterSpec(
+        num_brokers=2600, num_partitions=200_000, replication_factor=3,
+        num_racks=26, num_topics=100, seed=4, skew_fraction=0.2))
+    goals = default_goals(max_rounds=192)
+    opt = GoalOptimizer(goals, pipeline_segment_size=2)
+    opt.warmup(state, topo, OptimizationOptions())
+    res = opt.optimizations(state, topo, OptimizationOptions(),
+                            check_sanity=False)
+    fs = res.final_state
+    print("violated:", {g: c for g, c in res.violated_broker_counts.items()
+                        if any(c)})
+
+    ctx = make_context(fs, opt.constraint, OptimizationOptions(), topo)
+    cache = make_round_cache(fs, 0, ctx)
+    lbi_goal = next(g for g in goals
+                    if g.name == "LeaderBytesInDistributionGoal")
+    lr_goal = next(g for g in goals
+                   if g.name == "LeaderReplicaDistributionGoal")
+    prev = goals[:goals.index(lbi_goal)]
+
+    @jax.jit
+    def classify(fs, cache):
+        lbi = cache.leader_bytes_in
+        # _bounds returns a scalar threshold; broadcast per broker
+        upper = jnp.broadcast_to(lbi_goal._bounds(fs, lbi),
+                                 (fs.num_brokers,))
+        over = fs.broker_alive & (lbi > upper)
+        rows = ctx.partition_replicas
+        rows_safe = jnp.maximum(rows, 0)
+        cur = S.partition_leader_replica(fs)
+        cur_safe = jnp.maximum(cur, 0)
+        src_b = fs.replica_broker[cur_safe]
+        # partitions whose leader sits on an over-lbi broker and carries
+        # positive bytes-in
+        value = fs.replica_base_load[cur_safe, 1] * fs.replica_valid[
+            cur_safe]
+        live = (cur >= 0) & over[src_b] & (value > 0.0)
+        cand_b = fs.replica_broker[rows_safe]
+        struct = ((rows >= 0) & (rows != cur[:, None])
+                  & fs.replica_valid[rows_safe]
+                  & fs.broker_alive[cand_b] & ctx.broker_leader_ok[cand_b])
+        # own-goal: dest stays under the lbi upper bound
+        arrive = fs.replica_base_load[rows_safe, 1]
+        own_ok = lbi[cand_b] + arrive <= upper[cand_b]
+        # per-prior-goal acceptance, evaluated separately
+        per_goal_ok = {}
+        for g in prev:
+            a = g.accept_leadership(fs, ctx, cache, cur_safe[:, None],
+                                    rows_safe)
+            per_goal_ok[g.name] = a
+        all_prev = jnp.ones_like(struct)
+        for a in per_goal_ok.values():
+            all_prev &= a
+        fixable = live[:, None] & struct & own_ok & all_prev
+        # per-partition: does ANY option survive everything?
+        has_fix = jnp.any(fixable, axis=1) & live
+        # veto attribution: options passing struct+own but killed by
+        # exactly this goal (all other prev goals accept)
+        attribution = {}
+        base_ok = live[:, None] & struct & own_ok
+        for name, a in per_goal_ok.items():
+            others = jnp.ones_like(struct)
+            for n2, a2 in per_goal_ok.items():
+                if n2 != name:
+                    others &= a2
+            sole = base_ok & others & ~a
+            attribution[name] = jnp.sum(jnp.any(sole, axis=1)
+                                        & ~has_fix & live)
+        return (jnp.sum(over), jnp.sum(live), jnp.sum(has_fix),
+                jnp.sum(live & ~jnp.any(struct & own_ok, axis=1)),
+                attribution)
+
+    over_n, live_n, fix_n, own_blocked, attr = jax.device_get(
+        classify(fs, cache))
+    print(f"over-lbi brokers: {over_n}")
+    print(f"live candidate partitions (leader on over broker): {live_n}")
+    print(f"partitions with a FULLY acceptable fixing transfer: {fix_n}")
+    print(f"partitions blocked by own-goal/structural alone: {own_blocked}")
+    print("sole-veto attribution (options alive but for this ONE goal):")
+    for name, n in attr.items():
+        if int(n):
+            print(f"  {name}: {int(n)} partitions")
+
+
+if __name__ == "__main__":
+    main()
