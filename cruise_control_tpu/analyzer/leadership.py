@@ -239,11 +239,12 @@ def global_leadership_sweep(
         spread = jnp.maximum(jnp.max(jnp.abs(deficit)), 1e-6)
         score = deficit + 0.1 * spread * ((jit + salt) % 1.0)
         if dest_tiebreak is not None:
-            # 0.5x spread (round 5; 0.2x measured too weak): the count
-            # sweep's thousands of same-deficit receivers must lean hard
-            # toward low-bytes-in brokers or the bulk re-election
-            # scrambles the later LeaderBytesInDistributionGoal's
-            # surface (r4 regression 157 -> 227)
+            # 0.5x spread is the SHIPPED freeze value (round 5): vs the
+            # round-4 0.2x it measured within noise at north (LBI 284
+            # with 0.2 vs 291-295 with 0.5 across runs) — kept because
+            # the freeze artifacts (determinism battery, diag_lbi proof,
+            # config battery) were recorded at 0.5; see PARITY round-5
+            # negative-tuning notes before re-tuning this
             tb = dest_tiebreak(cache)                   # f32[B]
             tb_lo = jnp.min(tb)
             tb_norm = (tb - tb_lo) / jnp.maximum(jnp.max(tb) - tb_lo, 1e-9)
@@ -301,7 +302,18 @@ def global_leadership_sweep(
         cur = cur.at[jnp.where(valid, p_w, num_p)].set(
             dst_r, mode="drop")
         # window-failure bookkeeping: members that committed clear their
-        # mark, members that could not commit gain one (see gain_sel)
+        # mark, members that could not commit gain one (see gain_sel).
+        # Marks are NOT decayed within the sweep: decaying them on
+        # committing rounds (so a past veto cannot exile a partition
+        # whose surface later improved — a review concern) was measured
+        # STRICTLY WORSE at north (CpuUsage 69 -> 89, LeaderReplica
+        # 179 -> 220, LeaderBytesIn 291 -> 314 violated after-all with
+        # 0.5x decay): re-admitted vetoed occupants refill the
+        # mostly-greedy windows and starve untried candidates again.
+        # Exile is bounded structurally instead — `failed` starts at
+        # zero on EVERY sweep invocation (one goal's pre-pass), and the
+        # goal's table-round phases afterwards serve any partition the
+        # sweep left behind.
         failed = failed.at[sel].set(
             jnp.where(valid, 0.0,
                       jnp.where(live_w & ~valid, 1.0, failed[sel])))

@@ -43,6 +43,38 @@ from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats
 
 LOG = logging.getLogger(__name__)
 
+#: process-wide cache of jitted pipeline programs keyed by
+#: (program key, goal-list identity) — see GoalOptimizer._get_compiled.
+#: BOUNDED: at most _MAX_SHARED_GOAL_LISTS distinct goal lists are
+#: retained (LRU); evicting one drops all its programs so their traced
+#: jaxprs + per-shape executables can be freed — an unbounded cache
+#: accumulated every (goal list, shape) program of a whole test suite
+#: in one process (previously each died with its optimizer instance)
+_SHARED_PROGRAMS: Dict[Tuple, object] = {}
+_SHARED_LRU: List[Tuple] = []   # goal-list keys, most recent last
+_MAX_SHARED_GOAL_LISTS = 3
+#: concurrent solves are an expected scenario (the facade's background
+#: precompute thread races request-path optimizers) — the cache and its
+#: LRU mutate under one lock
+_SHARED_LOCK = threading.Lock()
+
+
+def _shared_program(key: str, gk: Tuple, make):
+    full = (key, gk)
+    with _SHARED_LOCK:
+        prog = _SHARED_PROGRAMS.get(full)
+        if prog is None:
+            prog = make()
+            _SHARED_PROGRAMS[full] = prog
+        if gk in _SHARED_LRU:
+            _SHARED_LRU.remove(gk)
+        _SHARED_LRU.append(gk)
+        while len(_SHARED_LRU) > _MAX_SHARED_GOAL_LISTS:
+            old = _SHARED_LRU.pop(0)
+            for k in [k for k in _SHARED_PROGRAMS if k[1] == old]:
+                del _SHARED_PROGRAMS[k]
+    return prog
+
 
 @dataclasses.dataclass
 class OptimizerResult:
@@ -624,11 +656,46 @@ class GoalOptimizer:
         result.balancedness_weights = self.balancedness_weights
         return result
 
+    def _goals_share_key(self):
+        """Hashable identity of this optimizer's goal list for the
+        process-wide program cache, or None when any goal carries
+        non-primitive state (no sharing then — correctness first).
+        Two optimizers whose goals have identical class + primitive
+        attributes trace identical programs: the pipeline functions
+        close over nothing else that affects tracing (constraint and
+        options enter via the traced/static ctx argument)."""
+        parts = []
+        for g in self.goals:
+            items = []
+            for k, v in sorted(vars(g).items()):
+                if isinstance(v, (int, float, str, bool, tuple,
+                                  type(None), frozenset)):
+                    items.append((k, v))
+                else:
+                    return None
+            parts.append((type(g).__module__, type(g).__qualname__,
+                          tuple(items)))
+        return tuple(parts)
+
     def _get_compiled(self, key: str, fn):
         if not self._jit_goals:
             return fn
         if key not in self._compiled:
-            self._compiled[key] = jax.jit(fn)
+            # share jitted pipeline programs across optimizer INSTANCES
+            # with identical goal lists: every GoalOptimizer otherwise
+            # re-traces the whole pipeline (its segment functions are
+            # fresh closures), which dominated test-suite wall-clock on
+            # the 1-core CI host (~tens of seconds per instance at even
+            # small scale).  The jit cache keyed by (segment, goal
+            # identity) makes the second instance free; XLA-level
+            # compilation was already shared via the persistent cache,
+            # this shares the TRACE.
+            gk = self._goals_share_key()
+            if gk is None:
+                self._compiled[key] = jax.jit(fn)
+            else:
+                self._compiled[key] = _shared_program(
+                    key, gk, lambda: jax.jit(fn))
         return self._compiled[key]
 
     def _run(self, key: str, fn, *args):

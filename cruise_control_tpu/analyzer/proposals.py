@@ -122,11 +122,12 @@ def diff_proposals(initial: ClusterState, optimized: ClusterState,
     has_disks = initial.num_disks > 0
     if has_disks:
         keys = keys + ("replica_disk",)
-    (init_t, opt_t, valid, base_disk) = jax.device_get((
+    (init_t, opt_t, valid, base_disk, part) = jax.device_get((
         tuple(getattr(initial, k) for k in keys),
         tuple(getattr(optimized, k) for k in keys),
         initial.replica_valid,
-        initial.replica_base_load[:, Resource.DISK]))
+        initial.replica_base_load[:, Resource.DISK],
+        initial.replica_partition))
     init = dict(zip(keys, init_t))
     opt = dict(zip(keys, opt_t))
     if not has_disks:
@@ -139,7 +140,6 @@ def diff_proposals(initial: ClusterState, optimized: ClusterState,
         | (init["replica_disk"] != opt["replica_disk"]))
     if not changed_r.any():
         return []
-    part = np.asarray(initial.replica_partition)
     changed_p = np.unique(part[changed_r])
 
     rows_mat = partition_rows[changed_p]                # [M, RF]

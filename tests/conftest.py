@@ -10,9 +10,18 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # persistent compilation cache: the goal kernels recompile per optimizer
-# instance otherwise, dominating test wall-clock
+# instance otherwise, dominating test wall-clock.
+#
+# The cache is SPLIT by compile provenance: with the platform hook
+# (sitecustomize from the axon site dir) present, CPU programs may be
+# compiled by the remote compile service on a DIFFERENT x86 microarch
+# (avx512/+prefer-no-scatter machine flags); a hook-stripped run
+# (PYTHONPATH= python -m pytest ...) loading those AOT blobs SIGSEGVs
+# (cpu_aot_loader: "Machine type used for XLA:CPU compilation doesn't
+# match").  One cache dir per mode keeps both safe.
+_suffix = "" if "sitecustomize" in sys.modules else "_localcpu"
 _cache_dir = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), ".jax_cache")
+    os.path.abspath(__file__))), ".jax_cache" + _suffix)
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
@@ -27,3 +36,29 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update(
     "jax_persistent_cache_min_compile_time_secs",
     float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+
+import pytest  # noqa: E402
+
+#: modules that compile FULL multi-goal pipelines (big XLA:CPU programs):
+#: after many accumulated compiles in one long suite process, the next
+#: big compile can SEGFAULT inside XLA:CPU (reproduced three times in
+#: round 5, each at a different full-stack test depending on ordering —
+#: test_goal_stack, test_parallel, test_random_goal_order; each passes
+#: solo).  Dropping every live executable/trace before these modules
+#: relieves the process pressure; the persistent disk cache keeps the
+#: re-compiles cheap.
+_HEAVY_PIPELINE_MODULES = {
+    "test_goal_stack", "test_parallel", "test_random_goal_order",
+    "test_facade", "test_differential_reference",
+}
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _relieve_xla_process_pressure(request):
+    name = request.module.__name__.rsplit(".", 1)[-1]
+    if name in _HEAVY_PIPELINE_MODULES:
+        from cruise_control_tpu.analyzer import optimizer as _opt
+        _opt._SHARED_PROGRAMS.clear()
+        _opt._SHARED_LRU.clear()
+        jax.clear_caches()
+    yield

@@ -95,7 +95,8 @@ class TestFacade:
         cc.start_up(do_sampling=False, start_detection=False)
         feed_samples(cc, clock)
         result = cc.optimizations()
-        assert [g.name for g in cc.optimizer.goals] == DEFAULT_GOAL_ORDER
+        assert [g.name
+                for g in cc.goal_optimizer.goals] == DEFAULT_GOAL_ORDER
         assert set(result.stats_by_goal) == set(DEFAULT_GOAL_ORDER)
         assert not result.violated_goals_after
         cc.shutdown()

@@ -6,6 +6,8 @@ single-device solve and (b) actually lay the replica arrays out across
 devices.  This is the in-suite counterpart of the driver's
 `dryrun_multichip` entry point.
 """
+import os
+
 import conftest  # noqa: F401
 
 import jax
@@ -85,7 +87,29 @@ def test_sharded_full_goal_stack_runs_and_matches_quality():
     This is a LAYOUT check, not a convergence test (round-3 VERDICT
     weak-5: at max_rounds=12 it cost 345 s of suite wall-clock) — the
     round budget is kept to the minimum that still executes every
-    goal's phase structure at least once."""
+    goal's phase structure at least once.
+
+    Runs in a SUBPROCESS: this is the one place the whole 15-goal chain
+    compiles as a single SPMD program (production segments it), and
+    that compile SEGFAULTS the XLA:CPU compiler when it runs late in a
+    suite process that has already compiled hundreds of programs
+    (reproduced twice at different suite positions, round 5; passes
+    solo in ~6 min cold / seconds warm-cache).  Process isolation keeps
+    the coverage without the crash."""
+    import subprocess
+    import sys
+
+    if not os.environ.get("CC_TPU_SHARDED_SUBPROC"):
+        env = dict(os.environ, CC_TPU_SHARDED_SUBPROC="1")
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "-x", "-n", "0",
+             f"{__file__}::"
+             "test_sharded_full_goal_stack_runs_and_matches_quality"],
+            env=env, capture_output=True, text=True, timeout=1800,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-1000:])
+        return
+
     from cruise_control_tpu.analyzer.context import make_round_cache
     from cruise_control_tpu.parallel.mesh import solver_mesh
 
