@@ -7,8 +7,14 @@ check: lint test
 lint:
 	python tools/lint.py
 
+# parallel when pytest-xdist is installed (whole files per worker:
+# bounds per-process XLA:CPU program accumulation — see pyproject
+# comment + README "Testing"); serial otherwise (conftest clears compile
+# caches per module so serial runs survive, just slower)
+XDIST_FLAGS := $(shell python -c "import importlib.util as u; print('-n auto --dist loadfile' if u.find_spec('xdist') else '')")
+
 test:
-	python -m pytest tests/ -q
+	python -m pytest tests/ -q $(XDIST_FLAGS)
 
 bench:
 	python bench.py

@@ -18,6 +18,12 @@ BENCH_CONFIG selects a BASELINE.json eval config:
 
 Other knobs: BENCH_BROKERS, BENCH_PARTITIONS, BENCH_RF, BENCH_ROUNDS,
 BENCH_GOALS (comma list), BENCH_SEGMENT, BENCH_SKIP_WARMUP.
+
+CC_TPU_PROFILE=1 (or legacy BENCH_PROFILE=1) enables the segment-level
+profiler: per-goal programs with explicit sync points, emitting the
+per-segment attribution table (prebalance / per-goal rounds / stats
+epilogues / leadership / diff / transfer) on stderr — see
+cruise_control_tpu/utils/profiling.py and tools/profile_segments.py.
 """
 import json
 import os
@@ -108,13 +114,23 @@ def main() -> None:
     goals = default_goals(max_rounds=rounds, names=names)
     segment = int(os.environ.get("BENCH_SEGMENT", 2))
     optimizer = GoalOptimizer(goals, pipeline_segment_size=segment)
-    if os.environ.get("BENCH_PROFILE"):
-        # per-segment wall-clock on stderr (adds sync points; the measured
-        # number is then NOT comparable to an unprofiled run)
+    profiler = None
+    from cruise_control_tpu.utils import profiling
+    if (os.environ.get("BENCH_PROFILE", "") not in ("", "0")
+            or profiling.enabled()):
+        # segment-level profiling (CC_TPU_PROFILE=1 / legacy
+        # BENCH_PROFILE=1; "0" disables either, matching
+        # profiling.enabled()): per-goal programs with explicit sync
+        # points and a per-segment attribution table on stderr after the
+        # measured run.  Sync points cost transport latency and profile
+        # mode re-segments the pipeline, so the measured number is NOT
+        # comparable to an unprofiled run.
+        os.environ[profiling.PROFILE_ENV] = "1"
         import logging
         logging.basicConfig(stream=sys.stderr, level=logging.INFO,
                             format="# %(message)s")
         optimizer.profile_segments = True
+        profiler = profiling.install()
 
     def run_once(st, topo, options):
         return optimizer.optimizations(st, topo, options, check_sanity=False)
@@ -164,9 +180,19 @@ def main() -> None:
         print(f"# warmup (compile+first run) {time.time()-t0:.1f}s",
               file=sys.stderr)
 
+    if profiler is not None:
+        # drop warmup-run records so the table attributes the MEASURED run
+        profiler.reset()
     t0 = time.time()
     results = run_config(state, topo)
     elapsed = time.time() - t0
+
+    if profiler is not None:
+        print("# segment profile (CC_TPU_PROFILE: sync points inserted; "
+              "wall-clock not comparable to an unprofiled run)",
+              file=sys.stderr)
+        for line in profiler.table().splitlines():
+            print(f"# {line}", file=sys.stderr)
 
     total_props = sum(len(r.proposals) for r in results)
     print(f"# proposals={total_props} "

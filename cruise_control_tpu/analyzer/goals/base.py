@@ -177,11 +177,21 @@ class Goal(abc.ABC):
         return jnp.zeros(state.num_brokers, dtype=bool)
 
     # ---- stats regression check ----
-    def stats_not_worse(self, before, after) -> bool:
-        """Host-side check that optimization did not regress this goal's
-        statistic (reference AbstractGoal.optimize post-check :92-101 via
-        ClusterModelStatsComparator).  `before`/`after` are
-        ClusterModelStats on host (numpy)."""
+    def stats_not_worse(self, before, after):
+        """Did optimization avoid regressing this goal's statistic?
+        (reference AbstractGoal.optimize post-check :92-101 via
+        ClusterModelStatsComparator).
+
+        `before`/`after` are ClusterModelStats.  Implementations should
+        be DTYPE-GENERIC — plain comparisons on the stats fields, no
+        `float()` casts — because the optimizer fuses traceable
+        comparators into the goal's own jitted epilogue (the regression
+        flag then rides the [G]-shaped instrument tables fetched in one
+        end-of-solve device_get).  A comparator that cannot trace (it
+        concretizes values or returns a non-scalar) is automatically
+        evaluated on HOST instead, against the fetched numpy stats —
+        same semantics, one extra host evaluation, zero extra
+        transfers (see GoalOptimizer._regression_traceable)."""
         return True
 
     def __repr__(self) -> str:  # pragma: no cover - trivial

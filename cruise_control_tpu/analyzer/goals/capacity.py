@@ -214,12 +214,14 @@ class CapacityGoal(Goal):
         return state.broker_alive & (
             cache.broker_load[:, res] > self._limit(state, ctx))
 
-    def stats_not_worse(self, before, after) -> bool:
+    def stats_not_worse(self, before, after):
+        import jax.numpy as jnp
         res = int(self.resource)
         # the worst broker must not get worse (it may stay put if other
-        # goals legitimately filled headroom below the threshold)
-        return (float(after.util_max[res])
-                <= max(float(before.util_max[res]), 1.0) + 1e-6)
+        # goals legitimately filled headroom below the threshold);
+        # dtype-generic: traced into the goal's fused epilogue
+        return (after.util_max[res]
+                <= jnp.maximum(before.util_max[res], 1.0) + 1e-6)
 
 
 class CpuCapacityGoal(CapacityGoal):

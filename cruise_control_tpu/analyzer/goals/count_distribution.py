@@ -186,9 +186,9 @@ class ReplicaDistributionGoal(Goal):
         lower, upper = _count_bounds(avg, self.pct_margin)
         return state.broker_alive & ((counts > upper) | (counts < lower))
 
-    def stats_not_worse(self, before, after) -> bool:
-        return (float(after.replica_count_std)
-                <= float(before.replica_count_std) + 1e-6)
+    def stats_not_worse(self, before, after):
+        # dtype-generic: traced into the goal's fused epilogue
+        return after.replica_count_std <= before.replica_count_std + 1e-6
 
 
 class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
@@ -408,9 +408,9 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
         ones = jnp.ones(state.num_replicas, dtype=jnp.float32)
         return [("leadcount", ones, upper - counts, counts - lower)]
 
-    def stats_not_worse(self, before, after) -> bool:
-        return (float(after.leader_count_std)
-                <= float(before.leader_count_std) + 1e-6)
+    def stats_not_worse(self, before, after):
+        # dtype-generic: traced into the goal's fused epilogue
+        return after.leader_count_std <= before.leader_count_std + 1e-6
 
 
 class TopicReplicaDistributionGoal(Goal):
@@ -524,6 +524,7 @@ class TopicReplicaDistributionGoal(Goal):
         over = jnp.any(tc > upper[None, :], axis=1)
         return state.broker_alive & over
 
-    def stats_not_worse(self, before, after) -> bool:
-        return (float(after.topic_replica_count_std)
-                <= float(before.topic_replica_count_std) + 0.3)
+    def stats_not_worse(self, before, after):
+        # dtype-generic: traced into the goal's fused epilogue
+        return (after.topic_replica_count_std
+                <= before.topic_replica_count_std + 0.3)

@@ -141,9 +141,11 @@ class PotentialNwOutGoal(Goal):
         return state.broker_alive & (
             cache.potential_nw_out > self._limit(state, ctx))
 
-    def stats_not_worse(self, before, after) -> bool:
-        return (float(after.potential_nw_out_max)
-                <= float(before.potential_nw_out_max) * 1.0001 + 1e-3)
+    def stats_not_worse(self, before, after):
+        # dtype-generic (numpy or tracers): the optimizer fuses this
+        # comparator into the goal's jitted epilogue (see base.Goal)
+        return (after.potential_nw_out_max
+                <= before.potential_nw_out_max * 1.0001 + 1e-3)
 
 
 class LeaderBytesInDistributionGoal(Goal):

@@ -380,13 +380,13 @@ class ResourceDistributionGoal(Goal):
         upper = ctx.balance_upper_pct[res] * cap
         return state.broker_alive & ((W > upper) | (W < lower))
 
-    def stats_not_worse(self, before, after) -> bool:
+    def stats_not_worse(self, before, after):
         """Utilization spread for the resource must not regress (reference
         ResourceDistributionGoalStatsComparator counts balanced brokers; the
-        st.dev is the continuous equivalent)."""
-        import numpy as np
+        st.dev is the continuous equivalent).  Dtype-generic: traced into
+        the goal's fused epilogue."""
         res = int(self.resource)
-        return float(after.util_std[res]) <= float(before.util_std[res]) + 1e-6
+        return after.util_std[res] <= before.util_std[res] + 1e-6
 
 
 class CpuUsageDistributionGoal(ResourceDistributionGoal):

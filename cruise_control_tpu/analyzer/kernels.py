@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.state import ClusterState
+from cruise_control_tpu.utils import profiling
 
 NEG = -1e30
 
@@ -392,6 +393,7 @@ def move_round(state: ClusterState,
     re-proving the stall on full-width planes every round measured +6 s
     at the north config (44.7 s vs 37.9 s) for marginal quality.
     """
+    profiling.trace_count("kernels.move_round")
     num_b = state.num_brokers
     rb = state.replica_broker
     multi = dest_terms is not None
@@ -680,6 +682,32 @@ def salted_jitter(n: int, salt: jax.Array) -> jax.Array:
     return (x & jnp.uint32(0xFFFFFF)).astype(jnp.float32) / float(1 << 24)
 
 
+def rotation_salt(leader_count: jax.Array, load_col: jax.Array) -> jax.Array:
+    """i32 scalar state-hash salt for window tie-rotation: any committed
+    transfer or move perturbs it, so uniform-gain candidate windows
+    rotate across rounds (see leadership_round).
+
+    int32-SAFE by construction (ADVICE round 5: the previous direct
+    ``.astype(jnp.int32)`` of the float mix SATURATED to INT32_MAX for
+    deployments with large-magnitude loads — a frozen salt re-creates
+    exactly the vetoed-occupant starvation the rotation exists to
+    prevent; sub-1.0 fractional deltas also truncated to the same salt):
+
+    * the float mix is reduced ``mod 2**31`` BEFORE the cast, and
+    * an INTEGRAL leader-count term (weights scattered over [0, 1021))
+      is mixed in with native int32 wraparound, so the salt changes on
+      every committed leadership transfer even when f32 absorption
+      swallows the load delta against a huge load sum.
+    """
+    num_b = leader_count.shape[0]
+    hash_w = salted_jitter(num_b, jnp.zeros((), jnp.int32) + 13)
+    float_mix = (jnp.sum(leader_count.astype(jnp.float32) * hash_w)
+                 + jnp.sum(load_col * hash_w))
+    int_w = (hash_w * 1021.0).astype(jnp.int32)
+    int_mix = jnp.sum(leader_count.astype(jnp.int32) * int_w)
+    return jnp.mod(float_mix, 2.0 ** 31).astype(jnp.int32) + int_mix
+
+
 def _pairwise_jitter(num_c: int, num_b: int, salt: int = 0) -> jax.Array:
     """f32[C, B] deterministic pseudo-random values in [0, 1) — spreads
     candidates with identical destination preferences across destinations.
@@ -861,6 +889,7 @@ def leadership_round(state: ClusterState,
     Returns (src_replica i32[C], dest_replica i32[C], valid bool[C]).
     """
     num_b = state.num_brokers
+    profiling.trace_count("kernels.leadership_round")
     rb = state.replica_broker
     rf = partition_replicas.shape[1]
     r_idx = jnp.arange(rb.shape[0], dtype=jnp.int32)
@@ -1038,12 +1067,12 @@ def leadership_round(state: ClusterState,
         # (count goals: every transfer weighs 1) keep the same 2048
         # window every round and vetoed occupants starve the rest
         # (round-5 quality regression: CpuUsage violated 52 -> 81 when
-        # the compaction first landed without rotation).
-        hash_w = salted_jitter(num_b, jnp.zeros((), jnp.int32) + 13)
-        salt_r = (jnp.sum(cache.leader_count.astype(jnp.float32) * hash_w)
-                  + jnp.sum(cache.broker_load[:, 0] * hash_w)
-                  ).astype(jnp.int32) if cache is not None else \
-            jnp.zeros((), jnp.int32)
+        # the compaction first landed without rotation).  rotation_salt
+        # is the int32-safe mix (mod-before-cast + integral leader-count
+        # term — a saturated cast froze the salt for large loads).
+        salt_r = (rotation_salt(cache.leader_count,
+                                cache.broker_load[:, 0])
+                  if cache is not None else jnp.zeros((), jnp.int32))
         g_lo = jnp.min(jnp.where(cand_has, cand_bonus_b, jnp.inf))
         g_hi = jnp.max(jnp.where(cand_has, cand_bonus_b, -jnp.inf))
         spread_g = jnp.where(g_hi > g_lo, g_hi - g_lo,
@@ -1162,6 +1191,7 @@ def forced_move_round(state: ClusterState,
 
     Returns (cand_r i32[K], cand_dest i32[K], cand_valid bool[K]).
     """
+    profiling.trace_count("kernels.forced_move_round")
     num_b = state.num_brokers
     rb = state.replica_broker
     max_candidates = min(max_candidates, state.num_replicas)
@@ -1303,6 +1333,7 @@ def swap_round(state: ClusterState,
     Returns (out_r i32[B], in_r i32[B], cold i32[B], valid bool[B]) —
     for hot broker h: move out_r[h] -> cold[h] and in_r[cold[h]] -> h.
     """
+    profiling.trace_count("kernels.swap_round")
     num_b = state.num_brokers
     rb = state.replica_broker
     arange_b = jnp.arange(num_b, dtype=jnp.int32)

@@ -132,7 +132,9 @@ def global_leadership_sweep(
     """
     from cruise_control_tpu.analyzer.goals.base import (
         compose_leadership_acceptance, leadership_commit_terms)
+    from cruise_control_tpu.utils import profiling
 
+    profiling.trace_count("leadership.global_sweep")
     num_b = state.num_brokers
     num_p = ctx.partition_replicas.shape[0]
     rows = ctx.partition_replicas                       # i32[P, RF]

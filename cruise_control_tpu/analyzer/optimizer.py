@@ -39,9 +39,37 @@ from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.sanity import sanity_check
 from cruise_control_tpu.model.state import ClusterState
-from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats
+from cruise_control_tpu.model.stats import (ClusterModelStats, compute_stats,
+                                            stats_aval)
+from cruise_control_tpu.utils import profiling
 
 LOG = logging.getLogger(__name__)
+
+
+def _regression_traceable(goal: Goal) -> bool:
+    """Can `goal`'s stats comparator be fused into its jitted epilogue?
+
+    True for the default (never regresses) and for any override that is
+    dtype-generic (plain comparisons on the stats fields, scalar bool
+    result) — probed with eval_shape against abstract stats, so arbitrary
+    plugin goals are classified without running device work.  A False
+    verdict is never wrong, just slower: the optimizer re-evaluates that
+    goal's comparator on HOST against the fetched numpy stats (which the
+    single end-of-solve device_get carries anyway)."""
+    if type(goal).stats_not_worse is Goal.stats_not_worse:
+        return True
+    # build the aval OUTSIDE the try: a stats_aval() that drifted from
+    # ClusterModelStats' fields must raise loudly, not silently classify
+    # every comparator as host-only
+    aval_in = stats_aval()
+    try:
+        aval = jax.eval_shape(
+            lambda b, a: jnp.asarray(goal.stats_not_worse(b, a),
+                                     dtype=bool),
+            aval_in, aval_in)
+        return aval.shape == ()
+    except Exception:  # noqa: BLE001 - comparator won't trace → host
+        return False
 
 #: process-wide cache of jitted pipeline programs keyed by
 #: (program key, goal-list identity) — see GoalOptimizer._get_compiled.
@@ -190,11 +218,33 @@ class GoalOptimizer:
                  jit_goals: bool = True,
                  pipeline_segment_size: int = 4,
                  balancedness_weights: Tuple[float, float] = (1.1, 1.5),
-                 auto_warmup: bool = False):
+                 auto_warmup: bool = False,
+                 eager_hard_abort: bool = False):
         self.goals = list(goals)
         self.constraint = constraint or BalancingConstraint()
         self.balancedness_weights = balancedness_weights
         self._jit_goals = jit_goals
+        #: OPT-IN: read each segment's hard-goal abort predicate EAGERLY
+        #: (one device scalar sync per segment) instead of deferring it to
+        #: the single end-of-solve fetch.  The default (deferred) keeps
+        #: the solve free of inter-goal host round-trips — an aborting
+        #: solve discards its result either way, so deferral only delays
+        #: the exception, it never changes what a successful solve
+        #: returns.  Eager mode reproduces the reference's abort-at-goal
+        #: timing (AbstractGoal.optimize throws inside the failing goal),
+        #: useful for the facade's background precompute: a doomed solve
+        #: stops paying device time at the first unconverged hard goal
+        #: (facade `precompute_eager_hard_abort`).  The eager predicate is
+        #: after-own-run; the deferred check reads the end state, so in
+        #: the rare case a LATER goal's accepted actions incidentally fix
+        #: a hard violation, eager aborts where deferred succeeds — the
+        #: reference aborts there too.
+        self.eager_hard_abort = eager_hard_abort
+        #: lazy per-goal device-comparator flags (_regression_traceable)
+        self._device_cmp: Optional[Tuple[bool, ...]] = None
+        #: lazy cached _goals_share_key() (goal lists are fixed at
+        #: construction); sentinel False = not yet computed
+        self._gk_cache = False
         #: compile every pipeline program in PARALLEL before the first
         #: solve (warmup()) instead of paying sequential per-segment
         #: compiles inside it — the facade enables this so the
@@ -241,9 +291,15 @@ class GoalOptimizer:
         return active, "ReplicaDistributionGoal" in names, margin
 
     def _pre_fn(self):
-        """(state_initial, state, ctx) -> (violated_broker_counts i32[G],
-        healed state, RoundCache, still_offline, max_broker_count, broken,
-        prebalance_rounds).
+        """(state_initial, state, ctx) -> (stats_before,
+        violated_broker_counts i32[G], healed state, RoundCache,
+        still_offline, max_broker_count, broken, prebalance_rounds).
+
+        `stats_before` (ClusterModelStats of state_initial) is computed
+        HERE rather than by an eager pre-solve device_get: it seeds the
+        device-side regression chain (segment programs compare each
+        goal's stats against the previous goal's) and reaches the host
+        only in the single end-of-solve instrument fetch.
 
         `state_initial` is the TRUE initial model and is only read for the
         violated-before sweep; `state` is what the pipeline optimizes.
@@ -270,6 +326,7 @@ class GoalOptimizer:
 
         def run(state_initial: ClusterState, state: ClusterState,
                 ctx: OptimizationContext):
+            stats_before = compute_stats(state_initial)
             cache0 = make_round_cache(state_initial)
             violated_before = (
                 jnp.stack([g.violated_brokers(state_initial, ctx, cache0)
@@ -298,14 +355,26 @@ class GoalOptimizer:
                 cache = ensure_full_cache(state, ctx, None)
             still_offline = jnp.sum(S.self_healing_eligible(state))
             max_count = jnp.max(S.broker_replica_count(state))
-            return (violated_before, state, cache, still_offline,
-                    max_count, broken, pre_rounds)
+            return (stats_before, violated_before, state, cache,
+                    still_offline, max_count, broken, pre_rounds)
         return run
 
     def _segment_fn(self, start: int, stop: int):
-        """(state, cache, ctx) -> (state, cache, (stacked per-goal stats,
-        own-violated counts, per-goal rounds)) for goals[start:stop], with
-        acceptance stacking over ALL prior goals.
+        """(state, cache, prev_stats, ctx) -> (state, cache, last_stats,
+        (stacked per-goal stats, own-violated counts, per-goal rounds,
+        regression flags, hard-violated predicate)) for
+        goals[start:stop], with acceptance stacking over ALL prior goals.
+
+        The FULL per-goal epilogue is fused into this program: stats,
+        own-violated counting, the AbstractGoal.java:92-101 non-regression
+        comparison (against `prev_stats`, the previous goal's stats —
+        threaded goal-to-goal on device, seeded by the pre program's
+        stats_before), and a per-segment hard-violated flag (own-violated
+        of this segment's hard goals) consumed ONLY by the opt-in eager
+        abort sync — the default deferred abort reads the post sweep's
+        violated_after from the single fetch instead.  No scalar leaves
+        the device between goals; every instrument rides the
+        [seg]-shaped outputs into the single end-of-solve fetch.
 
         `cache` is the threaded RoundCache: refreshed float aggregates at
         segment entry (drift control — float scatter-adds accumulate f32
@@ -317,8 +386,10 @@ class GoalOptimizer:
         count separates "this goal could not converge" from "a later goal
         re-violated it"."""
         goals = tuple(self.goals)
+        traceable = self._device_comparators()
 
-        def run(state: ClusterState, cache, ctx: OptimizationContext):
+        def run(state: ClusterState, cache, prev_stats,
+                ctx: OptimizationContext):
             from cruise_control_tpu.analyzer.context import (
                 ensure_full_cache, refresh_float_aggregates)
             from cruise_control_tpu.analyzer.goals import base as goals_base
@@ -328,6 +399,7 @@ class GoalOptimizer:
             per_goal_stats = []
             own_violated = []
             rounds_used = []
+            regressed = []
             for i in range(start, stop):
                 sink: List = []
                 goals_base.set_round_sink(sink)
@@ -340,16 +412,102 @@ class GoalOptimizer:
                                    if sink else jnp.zeros((), jnp.int32))
                 c = (cache if cache is not None
                      else make_round_cache(state))
-                per_goal_stats.append(compute_stats_fresh_loads(state, c))
+                goal_stats = compute_stats_fresh_loads(state, c)
+                per_goal_stats.append(goal_stats)
                 own_violated.append(goals[i].violated_brokers(
                     state, ctx, c).sum(dtype=jnp.int32))
+                if traceable[i]:
+                    regressed.append(~jnp.asarray(
+                        goals[i].stats_not_worse(prev_stats, goal_stats),
+                        dtype=bool))
+                else:
+                    # host fallback: the optimizer re-evaluates this
+                    # goal's comparator against the fetched numpy stats
+                    regressed.append(jnp.zeros((), dtype=bool))
+                prev_stats = goal_stats
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
                                    *per_goal_stats)
+            hard_own = [own_violated[i - start]
+                        for i in range(start, stop) if goals[i].is_hard]
+            hard_violated = (jnp.any(jnp.stack(hard_own) > 0) if hard_own
+                             else jnp.zeros((), dtype=bool))
             # a goal that fell back to the cache-less SPI returns None —
             # rebuild so the segment's output structure stays fixed
             cache = ensure_full_cache(state, ctx, cache)
-            return state, cache, (stacked, jnp.stack(own_violated),
-                                  jnp.stack(rounds_used))
+            return state, cache, prev_stats, (
+                stacked, jnp.stack(own_violated), jnp.stack(rounds_used),
+                jnp.stack(regressed), hard_violated)
+        return run
+
+    def _device_comparators(self) -> Tuple[bool, ...]:
+        """Per-goal: fuse the stats comparator on device (True) or fall
+        back to a host evaluation post-fetch (False)?  Deterministic for
+        a given goal list, so shared segment programs stay consistent."""
+        if self._device_cmp is None:
+            self._device_cmp = tuple(_regression_traceable(g)
+                                     for g in self.goals)
+        return self._device_cmp
+
+    # -- profile mode (CC_TPU_PROFILE=1): per-goal programs -------------
+    #
+    # The fused segments are opaque to wall-clock attribution: a
+    # multi-goal program answers "how long did goals 5-6 plus their
+    # epilogues take" only in aggregate.  Profile mode re-segments the
+    # pipeline one goal per program, SPLIT into the search rounds and the
+    # stats epilogue, with an explicit sync point after each — the
+    # segment table then attributes the solve to table rounds (shards)
+    # vs stats/diff (replicates) directly.  Sync points and the finer
+    # segmentation change float-refresh cadence and dispatch overlap, so
+    # profiled wall-clock and quality counts may differ slightly from an
+    # unprofiled run; the table is for attribution, not the headline.
+
+    def _goal_rounds_fn(self, i: int):
+        """(state, cache, ctx) -> (state, cache, rounds i32[1]) — goal
+        i's search rounds only (profile mode)."""
+        goals = tuple(self.goals)
+
+        def run(state: ClusterState, cache, ctx: OptimizationContext):
+            from cruise_control_tpu.analyzer.context import (
+                ensure_full_cache, refresh_float_aggregates)
+            from cruise_control_tpu.analyzer.goals import base as goals_base
+            cache = refresh_float_aggregates(state, cache)
+            sink: List = []
+            goals_base.set_round_sink(sink)
+            try:
+                state, cache = goals[i].optimize_cached(
+                    state, ctx, goals[:i], cache)
+            finally:
+                goals_base.set_round_sink(None)
+            rounds = sum(sink) if sink else jnp.zeros((), jnp.int32)
+            cache = ensure_full_cache(state, ctx, cache)
+            return state, cache, jnp.stack([rounds])
+        return run
+
+    def _goal_epilogue_fn(self, i: int):
+        """(state, cache, prev_stats, ctx) -> (goal_stats, (stacked[1],
+        own[1], regressed[1], hard_violated)) — goal i's fused epilogue
+        as its own program (profile mode times it separately)."""
+        goals = tuple(self.goals)
+        traceable = self._device_comparators()
+
+        def run(state: ClusterState, cache, prev_stats,
+                ctx: OptimizationContext):
+            from cruise_control_tpu.model.stats import \
+                compute_stats_fresh_loads
+            goal_stats = compute_stats_fresh_loads(state, cache)
+            own = goals[i].violated_brokers(state, ctx, cache).sum(
+                dtype=jnp.int32)
+            if traceable[i]:
+                regr = ~jnp.asarray(
+                    goals[i].stats_not_worse(prev_stats, goal_stats),
+                    dtype=bool)
+            else:
+                regr = jnp.zeros((), dtype=bool)
+            hard_violated = ((own > 0) if goals[i].is_hard
+                             else jnp.zeros((), dtype=bool))
+            stacked = jax.tree.map(lambda x: x[None], goal_stats)
+            return goal_stats, (stacked, own[None], regr[None],
+                                hard_violated)
         return run
 
     def _post_fn(self):
@@ -403,6 +561,9 @@ class GoalOptimizer:
         # against its abstract shape (no device work)
         cache_aval = jax.eval_shape(
             lambda s: make_round_cache(s, ctx.table_slots, ctx), state)
+        # segments also take the previous goal's stats (device regression
+        # threading) — lower against the abstract stats shape
+        stats_aval_in = jax.eval_shape(compute_stats, state)
         jobs = [("__stats__", compute_stats, (state,)),
                 ("__pre__", self._pre_fn(), (state, state, ctx)),
                 ("__post__", self._post_fn(), (state, cache_aval, ctx))]
@@ -410,19 +571,20 @@ class GoalOptimizer:
             stop = min(start + seg, len(self.goals))
             jobs.append((f"__seg_{start}_{stop}__",
                          self._segment_fn(start, stop),
-                         (state, cache_aval, ctx)))
+                         (state, cache_aval, stats_aval_in, ctx)))
 
         def compile_one(job):
             key, fn, args = job
             for attempt in range(attempts):
                 try:
-                    return key, jax.jit(fn).lower(*args).compile()
+                    return key, self._jit_program(key, fn).lower(
+                        *args).compile()
                 except jax.errors.JaxRuntimeError as exc:
                     LOG.warning("warmup compile %s attempt %d failed: %s",
                                 key, attempt,
                                 str(exc).splitlines()[0][:120])
                     _time.sleep(5.0)
-            return key, jax.jit(fn).lower(*args).compile()
+            return key, self._jit_program(key, fn).lower(*args).compile()
 
         with concurrent.futures.ThreadPoolExecutor(max_workers) as pool:
             for key, compiled in pool.map(compile_one, jobs):
@@ -434,10 +596,29 @@ class GoalOptimizer:
                       options: Optional[OptimizationOptions] = None,
                       check_sanity: bool = True,
                       _table_slots_override: Optional[int] = None,
-                      warm_start: Optional[ClusterState] = None
+                      warm_start: Optional[ClusterState] = None,
+                      eager_hard_abort: Optional[bool] = None
                       ) -> OptimizerResult:
         """Run all goals in priority order and diff out proposals
         (reference GoalOptimizer.optimizations :409-480).
+
+        DEVICE-RESIDENT end to end: between the first goal's dispatch and
+        the single end-of-solve instrument fetch, NO scalar crosses
+        device→host (asserted by the transfer-guard test,
+        tests/test_fused_pipeline.py).  Every per-goal instrument —
+        stats, violated-broker counts, rounds, the non-regression flags,
+        the hard-goal abort predicate — accumulates into [G]-shaped
+        device tables inside the goal programs and reaches the host in
+        ONE device_get; the inter-goal ClusterState/RoundCache arrays are
+        buffer-donated program-to-program (see _jit_program).  The two
+        sanctioned host regions are wrapped in
+        `jax.transfer_guard_device_to_host("allow")`: pre-dispatch
+        request setup (context build + warm-start validation) and the
+        end-of-solve fetch + host tail (diff, sanity, result assembly).
+
+        `eager_hard_abort` (None → the constructor default) re-enables a
+        per-segment device sync that reads the hard-goal abort predicate
+        eagerly — see the constructor docstring for the trade-off.
 
         `warm_start` (optional) is a PREVIOUS solve's final state over the
         SAME topology (caller validates — facade._warm_start_compatible):
@@ -461,200 +642,279 @@ class GoalOptimizer:
         2K+-broker scale (one program holding every goal overwhelms the
         compiler)."""
         t_start = time.time()
-        options = options or OptimizationOptions()
-        if self._auto_warmup:
-            with self._warmup_lock:
-                if not self._aot:
-                    warm_s = self.warmup(state, topology, options)
-                    LOG.info("auto-warmup compiled the pipeline in %.1fs",
-                             warm_s)
-        ctx = make_context(state, self.constraint, options, topology)
-        if _table_slots_override is not None:
-            ctx = dataclasses.replace(ctx,
-                                      table_slots=_table_slots_override)
-        initial = state
-        t_sb = time.time()
-        stats_before = jax.device_get(
-            self._run("__stats__", compute_stats, state))
-        if self.profile_segments:
-            LOG.info("stats_before: %.0fms", (time.time() - t_sb) * 1e3)
-        if warm_start is not None:
-            # the seed must agree with the live placement wherever THIS
-            # request's context forbids acting — the facade's
-            # compatibility check covers membership/topology, but the
-            # options can exclude topics/brokers the seed predates
-            # (review finding, round 5): a transplanted move of an
-            # excluded replica could never be undone by the goals
-            # (ctx.replica_excluded gates every action) and would leak
-            # into the proposals.  One [R]-sized device reduction.
-            frozen = ~(ctx.replica_movable & ~ctx.replica_excluded)
-            valid = state.replica_valid
-            seed_moved = valid & (warm_start.replica_broker
-                                  != state.replica_broker)
-            promoted = valid & (warm_start.replica_is_leader
-                                & ~state.replica_is_leader)
-            seed_b = jnp.minimum(warm_start.replica_broker,
-                                 state.num_brokers - 1)
-            bad = (
-                (frozen & valid
-                 & ((warm_start.replica_broker != state.replica_broker)
-                    | (warm_start.replica_disk != state.replica_disk)
-                    | (warm_start.replica_is_leader
-                       != state.replica_is_leader)))
-                | (seed_moved & ~ctx.broker_dest_ok[seed_b])
-                | (promoted & ~ctx.broker_leader_ok[seed_b]))
-            if bool(jax.device_get(jnp.any(bad))):
-                LOG.info("warm-start seed ignored: it repositions "
-                         "replicas this request's options freeze "
-                         "(excluded topics/brokers)")
-                warm_start = None
-        if warm_start is not None:
-            # placement transplant: same shapes, so every compiled
-            # program is reused verbatim
-            state = state.replace(
-                replica_broker=warm_start.replica_broker,
-                replica_is_leader=warm_start.replica_is_leader,
-                replica_disk=warm_start.replica_disk)
+        eager = (self.eager_hard_abort if eager_hard_abort is None
+                 else eager_hard_abort)
+        profile = self.profile_segments or profiling.enabled()
+        prof = profiling.ensure_active() if profile else None
+        with jax.transfer_guard_device_to_host("allow"):
+            # sanctioned pre-dispatch host region: context building and
+            # warm-start validation read the model from host BEFORE the
+            # first goal program is dispatched
+            options = options or OptimizationOptions()
+            if self._auto_warmup:
+                with self._warmup_lock:
+                    if not self._aot:
+                        warm_s = self.warmup(state, topology, options)
+                        LOG.info("auto-warmup compiled the pipeline in "
+                                 "%.1fs", warm_s)
+            ctx = make_context(state, self.constraint, options, topology)
+            if _table_slots_override is not None:
+                ctx = dataclasses.replace(
+                    ctx, table_slots=_table_slots_override)
+            initial = state
+            if warm_start is not None:
+                # the seed must agree with the live placement wherever
+                # THIS request's context forbids acting — the facade's
+                # compatibility check covers membership/topology, but the
+                # options can exclude topics/brokers the seed predates
+                # (review finding, round 5): a transplanted move of an
+                # excluded replica could never be undone by the goals
+                # (ctx.replica_excluded gates every action) and would
+                # leak into the proposals.  One [R]-sized device
+                # reduction.
+                frozen = ~(ctx.replica_movable & ~ctx.replica_excluded)
+                valid = state.replica_valid
+                seed_moved = valid & (warm_start.replica_broker
+                                      != state.replica_broker)
+                promoted = valid & (warm_start.replica_is_leader
+                                    & ~state.replica_is_leader)
+                seed_b = jnp.minimum(warm_start.replica_broker,
+                                     state.num_brokers - 1)
+                bad = (
+                    (frozen & valid
+                     & ((warm_start.replica_broker != state.replica_broker)
+                        | (warm_start.replica_disk != state.replica_disk)
+                        | (warm_start.replica_is_leader
+                           != state.replica_is_leader)))
+                    | (seed_moved & ~ctx.broker_dest_ok[seed_b])
+                    | (promoted & ~ctx.broker_leader_ok[seed_b]))
+                if bool(jax.device_get(jnp.any(bad))):
+                    LOG.info("warm-start seed ignored: it repositions "
+                             "replicas this request's options freeze "
+                             "(excluded topics/brokers)")
+                    warm_start = None
+            if warm_start is not None:
+                # placement transplant: same shapes, so every compiled
+                # program is reused verbatim
+                state = state.replace(
+                    replica_broker=warm_start.replica_broker,
+                    replica_is_leader=warm_start.replica_is_leader,
+                    replica_disk=warm_start.replica_disk)
 
         t0 = time.time()
-        profile = self.profile_segments
-        (vb_dev, state, cache, still_dev, maxc_dev, broken_dev,
+        (stats0_dev, vb_dev, state, cache, still_dev, maxc_dev, broken_dev,
          pre_rounds_dev) = self._run("__pre__", self._pre_fn(), initial,
                                      state, ctx)
-        if profile:
+        if prof is not None:
             jax.block_until_ready(state.replica_broker)
-            LOG.info("segment pre+heal+prebalance: %.0fms",
-                     (time.time() - t0) * 1e3)
+            prof.record("pre+heal+prebalance", "prebalance",
+                        time.time() - t0)
         seg = max(1, self.pipeline_segment_size)
+        prev_stats = stats0_dev
         stacked_parts = []
         own_parts = []
         rounds_parts = []
-        for start in range(0, len(self.goals), seg):
-            stop = min(start + seg, len(self.goals))
-            t_seg = time.time()
-            state, cache, (stacked_seg, own_seg, rounds_seg) = self._run(
-                f"__seg_{start}_{stop}__",
-                self._segment_fn(start, stop), state, cache, ctx)
-            if profile:
+        regr_parts = []
+
+        def eager_check(hard_dev, goals_window, own_dev):
+            # opt-in per-segment abort sync (see eager_hard_abort)
+            with jax.transfer_guard_device_to_host("allow"):
+                if not bool(jax.device_get(hard_dev)):
+                    return
+                own_now = np.asarray(jax.device_get(own_dev))
+            for g, o in zip(goals_window, own_now):
+                if g.is_hard and int(o):
+                    raise OptimizationFailure(
+                        f"hard goal {g.name} still violated after its "
+                        f"own optimization (eager abort)")
+
+        if prof is not None:
+            # profile mode: one goal per program, search rounds split
+            # from the stats epilogue, explicit sync point after each
+            # (shards-vs-replicates attribution; see _goal_rounds_fn)
+            for i, g in enumerate(self.goals):
+                t_seg = time.time()
+                state, cache, rounds_g = self._run(
+                    f"__goal_{i}_rounds__", self._goal_rounds_fn(i),
+                    state, cache, ctx)
                 jax.block_until_ready(state.replica_broker)
-                LOG.info("segment %s: %.0fms",
-                         "+".join(g.name for g in self.goals[start:stop]),
-                         (time.time() - t_seg) * 1e3)
-            stacked_parts.append(stacked_seg)
-            own_parts.append(own_seg)
-            rounds_parts.append(rounds_seg)
+                prof.record(f"goal:{g.name}:rounds",
+                            profiling.category_for_goal(g.name),
+                            time.time() - t_seg)
+                t_epi = time.time()
+                prev_stats, (stacked_g, own_g, regr_g, hard_g) = self._run(
+                    f"__goal_{i}_epi__", self._goal_epilogue_fn(i),
+                    state, cache, prev_stats, ctx)
+                jax.block_until_ready(own_g)
+                prof.record(f"goal:{g.name}:stats", "stats",
+                            time.time() - t_epi)
+                stacked_parts.append(stacked_g)
+                own_parts.append(own_g)
+                rounds_parts.append(rounds_g)
+                regr_parts.append(regr_g)
+                if eager:
+                    eager_check(hard_g, [g], own_g)
+        else:
+            for start in range(0, len(self.goals), seg):
+                stop = min(start + seg, len(self.goals))
+                (state, cache, prev_stats,
+                 (stacked_seg, own_seg, rounds_seg, regr_seg,
+                  hard_seg)) = self._run(
+                    f"__seg_{start}_{stop}__",
+                    self._segment_fn(start, stop), state, cache,
+                    prev_stats, ctx)
+                stacked_parts.append(stacked_seg)
+                own_parts.append(own_seg)
+                rounds_parts.append(rounds_seg)
+                regr_parts.append(regr_seg)
+                if eager:
+                    eager_check(hard_seg, self.goals[start:stop], own_seg)
+        t_post = time.time()
         va_dev = self._run("__post__", self._post_fn(), state, cache, ctx)
-        jax.block_until_ready(state.replica_broker)
-        LOG.debug("goal pipeline (%d segments) ran in %.0fms",
-                  (len(self.goals) + seg - 1) // seg,
-                  (time.time() - t0) * 1e3)
+        if prof is not None:
+            jax.block_until_ready(va_dev)
+            prof.record("post violation sweep", "stats",
+                        time.time() - t_post)
         t_host = time.time()
-        (stacked_h, own_h, rounds_h, vb_h, va_h, still_offline, broken,
-         max_count, pre_rounds) = jax.device_get(
-            (stacked_parts, own_parts, rounds_parts, vb_dev, va_dev,
-             still_dev, broken_dev, maxc_dev, pre_rounds_dev))
-        if profile:
-            LOG.info("post sweep + host transfer: %.0fms",
-                     (time.time() - t_host) * 1e3)
-        if ctx.table_slots and int(max_count) > ctx.table_slots:
-            # self-healing runs table-less and may concentrate replicas
-            # past the broker-table width sized from the PRE-heal counts;
-            # goals that rebuilt their table then silently dropped the
-            # overflow rows (rank >= S), hiding replicas from selection.
-            # Rare (healing + extreme concentration), so the pipeline runs
-            # optimistically and only an actual overflow pays a re-run
-            # with a wider static width (recompile, logged) instead of
-            # every call paying a mid-pipeline device sync.
-            new_slots = min(state.num_replicas,
-                            -(-int(max_count * 1.5 + 64) // 128) * 128)
-            LOG.warning(
-                "post-heal per-broker replica count %d overflowed the "
-                "broker table width %d; re-running with width %d "
-                "(programs recompile for the new static width)",
-                int(max_count), ctx.table_slots, new_slots)
-            return self.optimizations(initial, topology, options,
-                                      check_sanity=check_sanity,
-                                      _table_slots_override=new_slots,
-                                      warm_start=warm_start)
-        stacked_h = (jax.tree.map(
-            lambda *xs: np.concatenate(xs), *stacked_h)
-            if stacked_h else None)
-        own_h = np.concatenate(own_h) if own_h else np.zeros(0, np.int32)
-        rounds_h = (np.concatenate(rounds_h) if rounds_h
-                    else np.zeros(0, np.int32))
+        with jax.transfer_guard_device_to_host("allow"):
+            # the solve's SINGLE sanctioned instrument fetch — O(1) host
+            # round-trips per solve regardless of goal count: stats_before
+            # + every per-goal instrument + the abort predicates arrive in
+            # one device_get.  The allow block also covers the host tail
+            # (diff/sanity/result), which reads device arrays only AFTER
+            # this fetch has drained the pipeline.
+            (stats_before, stacked_h, own_h, rounds_h, regr_h, vb_h, va_h,
+             still_offline, broken, max_count,
+             pre_rounds) = jax.device_get(
+                (stats0_dev, stacked_parts, own_parts, rounds_parts,
+                 regr_parts, vb_dev, va_dev, still_dev, broken_dev,
+                 maxc_dev, pre_rounds_dev))
+            if prof is not None:
+                prof.record("instrument fetch", "transfer",
+                            time.time() - t_host)
+            LOG.debug("goal pipeline (%d programs) ran in %.0fms",
+                      len(stacked_parts) + 2, (time.time() - t0) * 1e3)
+            if ctx.table_slots and int(max_count) > ctx.table_slots:
+                # self-healing runs table-less and may concentrate
+                # replicas past the broker-table width sized from the
+                # PRE-heal counts; goals that rebuilt their table then
+                # silently dropped the overflow rows (rank >= S), hiding
+                # replicas from selection.  Rare (healing + extreme
+                # concentration), so the pipeline runs optimistically and
+                # only an actual overflow pays a re-run with a wider
+                # static width (recompile, logged) instead of every call
+                # paying a mid-pipeline device sync.
+                new_slots = min(state.num_replicas,
+                                -(-int(max_count * 1.5 + 64) // 128) * 128)
+                LOG.warning(
+                    "post-heal per-broker replica count %d overflowed the "
+                    "broker table width %d; re-running with width %d "
+                    "(programs recompile for the new static width)",
+                    int(max_count), ctx.table_slots, new_slots)
+                return self.optimizations(initial, topology, options,
+                                          check_sanity=check_sanity,
+                                          _table_slots_override=new_slots,
+                                          warm_start=warm_start,
+                                          eager_hard_abort=eager)
+            stacked_h = (jax.tree.map(
+                lambda *xs: np.concatenate(xs), *stacked_h)
+                if stacked_h else None)
+            own_h = (np.concatenate(own_h) if own_h
+                     else np.zeros(0, np.int32))
+            rounds_h = (np.concatenate(rounds_h) if rounds_h
+                        else np.zeros(0, np.int32))
+            regr_h = (np.concatenate(regr_h) if regr_h
+                      else np.zeros(0, bool))
 
-        if int(still_offline):
-            raise OptimizationFailure(
-                f"self-healing could not relocate {int(still_offline)} "
-                f"offline replicas (insufficient capacity or "
-                f"eligible brokers)")
-
-        violated_before = [g.name for g, v in zip(self.goals, vb_h) if v]
-        violated_after = [g.name for g, v in zip(self.goals, va_h) if v]
-        violated_counts = {g.name: (int(b), int(o), int(a)) for g, b, o, a
-                           in zip(self.goals, vb_h, own_h, va_h)}
-        rounds_by_goal = {g.name: int(r)
-                          for g, r in zip(self.goals, rounds_h)}
-        if int(pre_rounds):
-            rounds_by_goal["__prebalance__"] = int(pre_rounds)
-
-        stats_by_goal: Dict[str, ClusterModelStats] = {}
-        regressed: List[str] = []
-        prev_stats = stats_before
-        for i, goal in enumerate(self.goals):
-            goal_stats = jax.tree.map(lambda x, i=i: x[i], stacked_h)
-            stats_by_goal[goal.name] = goal_stats
-            if not goal.stats_not_worse(prev_stats, goal_stats):
-                regressed.append(goal.name)
-                LOG.warning("goal %s regressed its statistic", goal.name)
-            prev_stats = goal_stats
-
-        if regressed and not bool(broken):
-            # reference AbstractGoal.optimize :92-101: a goal whose stats
-            # comparator prefers the BEFORE state is an optimization
-            # failure — waived only while the cluster is broken (dead
-            # brokers/disks), where ANY valid self-healing move beats
-            # balance.  The reference aborts at the offending goal; the
-            # pipelined device run detects it post-hoc, failing the same
-            # request with the same exception type.
-            raise OptimizationFailure(
-                "optimization made goal statistics worse than before for: "
-                + ", ".join(regressed))
-
-        for goal in self.goals:
-            if goal.is_hard and goal.name in violated_after:
+            if int(still_offline):
                 raise OptimizationFailure(
-                    f"hard goal {goal.name} still violated after optimization")
+                    f"self-healing could not relocate {int(still_offline)} "
+                    f"offline replicas (insufficient capacity or "
+                    f"eligible brokers)")
 
-        if check_sanity:
-            sanity_check(state)
+            violated_before = [g.name
+                               for g, v in zip(self.goals, vb_h) if v]
+            violated_after = [g.name
+                              for g, v in zip(self.goals, va_h) if v]
+            violated_counts = {g.name: (int(b), int(o), int(a))
+                               for g, b, o, a
+                               in zip(self.goals, vb_h, own_h, va_h)}
+            rounds_by_goal = {g.name: int(r)
+                              for g, r in zip(self.goals, rounds_h)}
+            if int(pre_rounds):
+                rounds_by_goal["__prebalance__"] = int(pre_rounds)
 
-        t_diff = time.time()
-        partition_rows = np.asarray(ctx.partition_replicas)
-        proposals = diff_proposals(initial, state, topology, partition_rows)
-        if profile:
-            LOG.info("diff_proposals (%d proposals): %.0fms",
-                     len(proposals), (time.time() - t_diff) * 1e3)
-        stats_after = (stats_by_goal[self.goals[-1].name] if self.goals
-                       else jax.device_get(
-                           self._run("__stats__", compute_stats, state)))
-        result = OptimizerResult(
-            proposals=proposals,
-            stats_before=stats_before,
-            stats_after=stats_after,
-            stats_by_goal=stats_by_goal,
-            violated_goals_before=violated_before,
-            violated_goals_after=violated_after,
-            regressed_goals=regressed,
-            final_state=state,
-            duration_s=time.time() - t_start,
-            violated_broker_counts=violated_counts,
-            rounds_by_goal=rounds_by_goal,
-        )
-        result.hard_goal_names = frozenset(
-            g.name for g in self.goals if g.is_hard)
-        result.balancedness_weights = self.balancedness_weights
-        return result
+            stats_by_goal: Dict[str, ClusterModelStats] = {}
+            regressed: List[str] = []
+            traceable = self._device_comparators()
+            prev_host = stats_before
+            for i, goal in enumerate(self.goals):
+                goal_stats = jax.tree.map(lambda x, i=i: x[i], stacked_h)
+                stats_by_goal[goal.name] = goal_stats
+                # traceable comparators were fused into the goal's device
+                # epilogue (regr_h); the rest re-evaluate HERE against
+                # the fetched numpy stats — same inputs, same semantics
+                flag = (bool(regr_h[i]) if traceable[i]
+                        else not goal.stats_not_worse(prev_host,
+                                                      goal_stats))
+                if flag:
+                    regressed.append(goal.name)
+                    LOG.warning("goal %s regressed its statistic",
+                                goal.name)
+                prev_host = goal_stats
+
+            if regressed and not bool(broken):
+                # reference AbstractGoal.optimize :92-101: a goal whose
+                # stats comparator prefers the BEFORE state is an
+                # optimization failure — waived only while the cluster is
+                # broken (dead brokers/disks), where ANY valid
+                # self-healing move beats balance.  The reference aborts
+                # at the offending goal; the pipelined device run detects
+                # it post-hoc, failing the same request with the same
+                # exception type.
+                raise OptimizationFailure(
+                    "optimization made goal statistics worse than before "
+                    "for: " + ", ".join(regressed))
+
+            for goal in self.goals:
+                if goal.is_hard and goal.name in violated_after:
+                    raise OptimizationFailure(
+                        f"hard goal {goal.name} still violated after "
+                        f"optimization")
+
+            if check_sanity:
+                sanity_check(state)
+
+            t_diff = time.time()
+            partition_rows = np.asarray(ctx.partition_replicas)
+            proposals = diff_proposals(initial, state, topology,
+                                       partition_rows)
+            if prof is not None:
+                prof.record("diff_proposals", "diff",
+                            time.time() - t_diff,
+                            proposals=len(proposals))
+            stats_after = (stats_by_goal[self.goals[-1].name]
+                           if self.goals
+                           else jax.device_get(
+                               self._run("__stats__", compute_stats,
+                                         state)))
+            result = OptimizerResult(
+                proposals=proposals,
+                stats_before=stats_before,
+                stats_after=stats_after,
+                stats_by_goal=stats_by_goal,
+                violated_goals_before=violated_before,
+                violated_goals_after=violated_after,
+                regressed_goals=regressed,
+                final_state=state,
+                duration_s=time.time() - t_start,
+                violated_broker_counts=violated_counts,
+                rounds_by_goal=rounds_by_goal,
+            )
+            result.hard_goal_names = frozenset(
+                g.name for g in self.goals if g.is_hard)
+            result.balancedness_weights = self.balancedness_weights
+            return result
 
     def _goals_share_key(self):
         """Hashable identity of this optimizer's goal list for the
@@ -677,26 +937,51 @@ class GoalOptimizer:
                           tuple(items)))
         return tuple(parts)
 
+    def _jit_program(self, key: str, fn):
+        """jax.jit with the pipeline's buffer-donation policy: the goal
+        programs (fused segments / profile-mode round programs) CONSUME
+        the threaded ClusterState + RoundCache — the caller rebinds both
+        to the outputs and never touches the inputs again — so donating
+        them lets XLA alias input→output and kills the inter-goal copies
+        of the [R]-sized state arrays and [B, S, ·] cache planes.  NOT
+        donated: `initial` / the pre program's inputs (diffed at the
+        end), the post program's inputs (final_state outlives the call),
+        prev_stats (segment 0's is also fetched as stats_before), and
+        ctx (shared by every program of the solve).  Donation is skipped
+        on CPU (unsupported there; avoids a warning per compile)."""
+        donate = ()
+        if (key.startswith("__seg_")
+                or (key.startswith("__goal_") and key.endswith("_rounds__"))):
+            if jax.default_backend() != "cpu":
+                donate = (0, 1)
+        return jax.jit(fn, donate_argnums=donate)
+
     def _get_compiled(self, key: str, fn):
         if not self._jit_goals:
             return fn
-        if key not in self._compiled:
-            # share jitted pipeline programs across optimizer INSTANCES
-            # with identical goal lists: every GoalOptimizer otherwise
-            # re-traces the whole pipeline (its segment functions are
-            # fresh closures), which dominated test-suite wall-clock on
-            # the 1-core CI host (~tens of seconds per instance at even
-            # small scale).  The jit cache keyed by (segment, goal
-            # identity) makes the second instance free; XLA-level
-            # compilation was already shared via the persistent cache,
-            # this shares the TRACE.
-            gk = self._goals_share_key()
-            if gk is None:
-                self._compiled[key] = jax.jit(fn)
-            else:
-                self._compiled[key] = _shared_program(
-                    key, gk, lambda: jax.jit(fn))
-        return self._compiled[key]
+        # share jitted pipeline programs across optimizer INSTANCES
+        # with identical goal lists: every GoalOptimizer otherwise
+        # re-traces the whole pipeline (its segment functions are
+        # fresh closures), which dominated test-suite wall-clock on
+        # the 1-core CI host (~tens of seconds per instance at even
+        # small scale).  The jit cache keyed by (segment, goal
+        # identity) makes the second instance free; XLA-level
+        # compilation was already shared via the persistent cache,
+        # this shares the TRACE.
+        if self._gk_cache is False:
+            self._gk_cache = self._goals_share_key()
+        gk = self._gk_cache
+        if gk is None:
+            if key not in self._compiled:
+                self._compiled[key] = self._jit_program(key, fn)
+            return self._compiled[key]
+        # look the shared dict up on EVERY call instead of pinning the
+        # program object in self._compiled: pinning kept LRU-evicted
+        # programs (traced jaxprs + per-shape executables) alive for as
+        # long as the instance lived, so eviction freed nothing for a
+        # long-lived facade cycling >3 goal lists (ADVICE round 5); the
+        # lookup also refreshes this goal list's LRU recency
+        return _shared_program(key, gk, lambda: self._jit_program(key, fn))
 
     def _run(self, key: str, fn, *args):
         """Prefer a warmup-retained AOT executable; fall back to jit when

@@ -101,7 +101,9 @@ def prebalance(state: ClusterState, ctx: OptimizationContext,
     """
     from cruise_control_tpu.analyzer.goals.base import (new_broker_dest_mask,
                                                         shed_rows)
+    from cruise_control_tpu.utils import profiling
 
+    profiling.trace_count("prebalance.prebalance")
     cache = ensure_full_cache(state, ctx, cache)
     if ctx.table_slots == 0:
         # a table-less context (e.g. an empty cluster, where make_context

@@ -156,7 +156,8 @@ class CruiseControl:
                  monitor_kwargs: Optional[dict] = None,
                  executor_kwargs: Optional[dict] = None,
                  auto_warmup: bool = True,
-                 warm_start_proposals: bool = True) -> None:
+                 warm_start_proposals: bool = True,
+                 precompute_eager_hard_abort: bool = False) -> None:
         self._admin = admin
         self._time = time_fn or _time.time
         self._constraint = constraint or BalancingConstraint()
@@ -256,6 +257,15 @@ class CruiseControl:
         #: only changes where the search starts, never what it returns —
         #: see GoalOptimizer.optimizations warm_start)
         self._warm_start_enabled = warm_start_proposals
+        #: OPT-IN eager hard-goal abort for the background precompute
+        #: path ONLY: the precompute loop retries every interval anyway,
+        #: so a doomed solve (unconverged hard goal) may as well stop at
+        #: the first failing segment instead of paying the full pipeline
+        #: — at the cost of one device sync per segment, which the
+        #: request path deliberately avoids (the optimizer's default is
+        #: the deferred, O(1)-round-trip check; see
+        #: GoalOptimizer.eager_hard_abort)
+        self._precompute_eager_hard_abort = precompute_eager_hard_abort
         self._warm_seed_state = None
         self._precompute_stop = threading.Event()
         self._precompute_thread: Optional[threading.Thread] = None
@@ -322,8 +332,11 @@ class CruiseControl:
             if self._cache_valid(generation):
                 return False
         try:
-            self.optimizations(_allow_capacity_estimation=(
-                self._allow_capacity_estimation_precompute))
+            self.optimizations(
+                _allow_capacity_estimation=(
+                    self._allow_capacity_estimation_precompute),
+                _eager_hard_abort=(True if self._precompute_eager_hard_abort
+                                   else None))
             return True
         except Exception as exc:  # noqa: BLE001 - keep the loop alive
             LOG.warning("proposal precompute failed: %s", exc)
@@ -506,7 +519,8 @@ class CruiseControl:
                       goals: Optional[Sequence[str]] = None,
                       options: Optional[OptimizationOptions] = None,
                       ignore_proposal_cache: bool = False,
-                      _allow_capacity_estimation: Optional[bool] = None
+                      _allow_capacity_estimation: Optional[bool] = None,
+                      _eager_hard_abort: Optional[bool] = None
                       ) -> OptimizerResult:
         """Proposals for the current cluster model.  The cache is only used
         for the default goal list with default options and is invalidated
@@ -536,7 +550,13 @@ class CruiseControl:
             result = optimizer.optimizations(
                 state, topo, self._options_generator.generate(
                     options or OptimizationOptions(), topo),
-                warm_start=warm)
+                warm_start=warm, eager_hard_abort=_eager_hard_abort)
+        from cruise_control_tpu.utils import profiling
+        prof = profiling.active()
+        if prof is not None and profiling.enabled():
+            # CC_TPU_PROFILE: expose the solve's segment attribution as
+            # segment-profile-<category>-timer sensors (STATE endpoint)
+            prof.publish(self.metrics)
         if cacheable:
             with self._cache_lock:
                 self._warm_seed_state = result.final_state

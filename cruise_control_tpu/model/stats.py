@@ -44,6 +44,26 @@ class ClusterModelStats:
     num_offline_replicas: jax.Array
 
 
+def stats_aval() -> ClusterModelStats:
+    """ClusterModelStats of abstract ShapeDtypeStructs — the input aval
+    for probing whether a goal's stats comparator is traceable
+    (GoalOptimizer fuses traceable comparators into the goal's own
+    jitted program; see optimizer._regression_traceable) and for
+    lowering pipeline programs without device work (warmup)."""
+    f32 = lambda shape=(): jax.ShapeDtypeStruct(shape, jnp.float32)  # noqa: E731
+    i32 = lambda: jax.ShapeDtypeStruct((), jnp.int32)                # noqa: E731
+    res = (NUM_RESOURCES,)
+    return ClusterModelStats(
+        util_avg=f32(res), util_max=f32(res), util_min=f32(res),
+        util_std=f32(res),
+        replica_count_avg=f32(), replica_count_max=f32(),
+        replica_count_min=f32(), replica_count_std=f32(),
+        leader_count_std=f32(), topic_replica_count_std=f32(),
+        potential_nw_out_max=f32(), potential_nw_out_total=f32(),
+        num_alive_brokers=i32(), num_replicas=i32(),
+        num_offline_replicas=i32())
+
+
 def _masked_stats(values: jax.Array, mask: jax.Array):
     count = jnp.maximum(jnp.sum(mask), 1)
     total = jnp.sum(values * mask)
@@ -62,6 +82,8 @@ def compute_stats(state: ClusterState) -> ClusterModelStats:
     standard deviation and balanced-broker counts — all derivable from the
     fields here.
     """
+    from cruise_control_tpu.utils import profiling
+    profiling.trace_count("stats.compute_stats")
     load = S.broker_load(state)
     cap = jnp.maximum(state.broker_capacity, 1e-9)
     return _stats_from(
@@ -81,6 +103,8 @@ def compute_stats_fresh_loads(state: ClusterState,
     stats-regression abort whose comparators check at ~1e-6 epsilons —
     tighter than the threaded cache's f32 scatter-add drift bound — so
     those two aggregates must be exact; the count tensors stay free."""
+    from cruise_control_tpu.utils import profiling
+    profiling.trace_count("stats.compute_stats_fresh_loads")
     load = S.broker_load(state)
     cap = jnp.maximum(state.broker_capacity, 1e-9)
     return _stats_from(

@@ -148,6 +148,13 @@ class MetricRegistry:
     def timer(self, name: str) -> Timer:
         return self._get(name, lambda: Timer(self._time))
 
+    def update_timer(self, name: str, duration_s: float) -> None:
+        """Record one duration sample into the named timer — for
+        instrumentation that measures outside a with-block (e.g. the
+        segment profiler publishing per-category solve time, see
+        utils/profiling.SegmentProfiler.publish)."""
+        self.timer(name).update(duration_s)
+
     def gauge(self, name: str, fn: Callable[[], float]) -> Gauge:
         with self._lock:
             g = self._sensors.get(name)

@@ -1,0 +1,232 @@
+"""Segment-level solve profiler (CC_TPU_PROFILE=1).
+
+VERDICT round 5's #1 missing item: "a segment-by-segment analysis of
+which of the 28 s [north solve is] shards (table rounds) vs replicates
+(stats, diff)".  This module is the attribution instrument: under
+``CC_TPU_PROFILE=1`` the optimizer re-segments the pipeline per goal,
+inserts explicit sync points (``jax.block_until_ready``) after every
+program, and records one row per segment here; ``table()`` renders the
+per-segment table plus the category rollup that answers
+shards-vs-replicates directly:
+
+  * ``rounds``      — per-goal table/search rounds (the sharded work:
+                      ``[B, S]`` broker-table planes, move/swap kernels)
+  * ``leadership``  — leadership-goal rounds/sweeps (``[P, RF]`` planes;
+                      replicated today, shardable on the partition axis)
+  * ``stats``       — per-goal stats epilogues + violation sweeps
+                      (replicated ``[B]``/``[R]`` reductions)
+  * ``prebalance``  — the joint pre-pass (+ heal + before-sweep)
+  * ``diff``        — final initial→final proposal diff (host side)
+  * ``transfer``    — the single end-of-solve instrument fetch
+
+Sync points cost transport latency, and profile mode runs one program
+per goal instead of the fused multi-goal segments, so a profiled
+wall-clock is NOT comparable to an unprofiled run — the table is for
+attribution, not for the headline number.
+
+Trace-structure counters (`trace_count`) are the in-kernel hooks:
+`kernels.py` / `leadership.py` / `prebalance.py` / `model/stats.py` call
+them while a program is TRACED, so the table can also report how many
+round bodies / stats reductions each compiled program contains (tracing
+happens once per program; the counts describe program structure, not
+per-run execution).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+from typing import Dict, List, Optional
+
+LOG = logging.getLogger(__name__)
+
+#: the opt-in env var (any value but "" / "0" enables profiling)
+PROFILE_ENV = "CC_TPU_PROFILE"
+
+#: goal names whose optimization is leadership-dominated ([P, RF]
+#: transfer planes / global sweeps rather than [B, S] table rounds)
+_LEADERSHIP_GOAL_MARKER = "Leader"
+
+
+def enabled() -> bool:
+    """True when CC_TPU_PROFILE requests segment profiling."""
+    return os.environ.get(PROFILE_ENV, "") not in ("", "0")
+
+
+def category_for_goal(goal_name: str) -> str:
+    """Coarse shards-vs-replicates attribution bucket for a goal's
+    optimization rounds (its stats epilogue is always ``stats``)."""
+    if (_LEADERSHIP_GOAL_MARKER in goal_name
+            or goal_name == "PreferredLeaderElectionGoal"):
+        return "leadership"
+    return "rounds"
+
+
+@dataclasses.dataclass
+class SegmentRecord:
+    name: str
+    category: str
+    seconds: float
+    meta: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+class SegmentProfiler:
+    """Collects SegmentRecords across one or more solves; thread-safe
+    (the facade's precompute thread may race request-path solves)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.records: List[SegmentRecord] = []
+        #: program-structure counters filled at trace time (trace_count)
+        self.trace_counts: Dict[str, int] = {}
+        #: LIFETIME per-category seconds — unlike `records` (bounded,
+        #: trimmed) and reset(), this only grows, so publish() deltas
+        #: stay monotonic across record-buffer wraps and resets
+        self._cum_totals: Dict[str, float] = {}
+        #: per-category seconds already published to a MetricRegistry
+        self._published: Dict[str, float] = {}
+
+    #: bound on retained records: a long-lived facade with
+    #: CC_TPU_PROFILE=1 left on records ~2·G+5 segments per precompute
+    #: solve forever — without a cap the list (and any table() output)
+    #: grows monotonically.  When full, the OLDEST half is dropped, so
+    #: the table always covers the most recent solves.
+    MAX_RECORDS = 4096
+
+    def record(self, name: str, category: str, seconds: float,
+               **meta) -> None:
+        with self._lock:
+            self.records.append(SegmentRecord(name, category, seconds,
+                                              dict(meta)))
+            self._cum_totals[category] = (
+                self._cum_totals.get(category, 0.0) + seconds)
+            if len(self.records) > self.MAX_RECORDS:
+                del self.records[:len(self.records) // 2]
+        LOG.info("segment %-42s %-10s %8.0fms%s", name, category,
+                 seconds * 1e3,
+                 "".join(f" {k}={v}" for k, v in meta.items()))
+
+    def reset(self) -> None:
+        """Drop recorded segments (keeps trace counts — program structure
+        does not change between a warmup run and the measured run)."""
+        with self._lock:
+            self.records.clear()
+
+    def note_trace(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.trace_counts[key] = self.trace_counts.get(key, 0) + n
+
+    def _category_totals_locked(self) -> Dict[str, float]:
+        totals: Dict[str, float] = {}
+        for r in self.records:
+            totals[r.category] = totals.get(r.category, 0.0) + r.seconds
+        return totals
+
+    def category_totals(self) -> Dict[str, float]:
+        with self._lock:
+            return self._category_totals_locked()
+
+    def table(self) -> str:
+        """The per-segment table + category rollup, ready to print."""
+        with self._lock:
+            records = list(self.records)
+            traces = dict(self.trace_counts)
+        lines = ["segment                                      category   "
+                 "    wall",
+                 "-" * 68]
+        for r in records:
+            meta = "".join(f"  {k}={v}" for k, v in sorted(r.meta.items()))
+            lines.append(f"{r.name:<44} {r.category:<10} {r.seconds:7.3f}s"
+                         f"{meta}")
+        total = sum(r.seconds for r in records)
+        lines.append("-" * 68)
+        for cat, secs in sorted(self.category_totals().items(),
+                                key=lambda kv: -kv[1]):
+            pct = 100.0 * secs / total if total else 0.0
+            lines.append(f"{'total ' + cat:<44} {'':10} {secs:7.3f}s"
+                         f"  ({pct:.0f}%)")
+        lines.append(f"{'total':<44} {'':10} {total:7.3f}s")
+        if traces:
+            lines.append("")
+            lines.append("program structure (bodies traced per compile):")
+            for key, n in sorted(traces.items()):
+                lines.append(f"  {key}: {n}")
+        return "\n".join(lines)
+
+    def publish(self, registry) -> None:
+        """Push per-category time ACCRUED SINCE THE LAST PUBLISH into a
+        utils.metrics.MetricRegistry as `segment-profile-<cat>-timer`
+        sensors (the facade calls this after each profiled solve, so the
+        STATE endpoint's `sensors` substate exposes the attribution).
+
+        Deltas derive from the lifetime `_cum_totals` (monotonic even
+        when the bounded `records` buffer trims or reset() runs), and
+        the read-compare-store happens under one lock hold so concurrent
+        publishes (precompute thread racing a request path) neither
+        double-count nor lose an interval; only the registry update runs
+        outside the lock."""
+        with self._lock:
+            totals = dict(self._cum_totals)
+            deltas = {cat: secs - self._published.get(cat, 0.0)
+                      for cat, secs in totals.items()}
+            self._published = totals
+        for cat, delta in deltas.items():
+            if delta > 0:
+                registry.update_timer(f"segment-profile-{cat}-timer",
+                                      delta)
+
+    def to_json(self) -> dict:
+        with self._lock:
+            # NB: must not call category_totals() here — self._lock is
+            # not reentrant (that deadlocked --json runs once)
+            return {
+                "segments": [dataclasses.asdict(r) for r in self.records],
+                "category_totals_s": self._category_totals_locked(),
+                "trace_counts": dict(self.trace_counts),
+            }
+
+
+#: process-wide active profiler (None when not installed); the optimizer
+#: records into it when CC_TPU_PROFILE is set, installing one on demand
+#: so a bare `CC_TPU_PROFILE=1 python bench.py` needs no extra wiring
+_ACTIVE: Optional[SegmentProfiler] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active() -> Optional[SegmentProfiler]:
+    return _ACTIVE
+
+
+def install(profiler: Optional[SegmentProfiler] = None) -> SegmentProfiler:
+    """Install (and return) the process-wide profiler."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = profiler or SegmentProfiler()
+        return _ACTIVE
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = None
+
+
+def ensure_active() -> SegmentProfiler:
+    """The active profiler, installing one if none is — check and
+    install under ONE lock hold, so concurrent solves (facade precompute
+    racing a request path) agree on a single profiler instead of the
+    second install orphaning the first's records."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is None:
+            _ACTIVE = SegmentProfiler()
+        return _ACTIVE
+
+
+def trace_count(key: str, n: int = 1) -> None:
+    """Trace-time structure hook for kernels/stats: a no-op unless
+    profiling is enabled AND a profiler is installed (zero overhead on
+    the production path — one dict lookup per TRACE, never per run)."""
+    if _ACTIVE is not None and enabled():
+        _ACTIVE.note_trace(key, n)

@@ -32,6 +32,8 @@ import numpy as np
 
 import conftest  # noqa: F401
 
+import pytest
+
 from cruise_control_tpu.analyzer.goals.registry import (DEFAULT_HARD_GOALS,
                                                         default_goals)
 from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
@@ -100,6 +102,7 @@ def test_hard_goals_reproduce_derived_reference_outcome():
     assert not result.violated_goals_after
 
 
+@pytest.mark.slow
 def test_full_pipeline_pins_config1_outcome():
     """BENCH config 1 (the 3-broker deterministic fixture, full default
     goal stack) end-state pin, derived by hand — the full-pipeline analog

@@ -1,0 +1,244 @@
+"""Device-resident goal pipeline: fusion, transfer, and abort semantics.
+
+Pins the PR-1 tentpole contract (analyzer/optimizer.py):
+
+* O(1) host round-trips per solve — no device→host transfer between the
+  first goal's dispatch and the single end-of-solve instrument fetch
+  (asserted with jax's transfer guard + a device_get call counter);
+* the fused path (per-goal epilogues — stats, violated counts,
+  non-regression flags, hard-goal predicate — inside the goal programs,
+  instruments fetched once) reproduces the PRE-FUSION evaluation order:
+  an eager per-goal reference driver built from the same goal SPI, with
+  a host fetch after every goal, must agree on violated_broker_counts,
+  rounds_by_goal, regression flags, and the final proposals on the
+  config-1 differential fixture;
+* hard-goal abort: deferred (default) and eager (opt-in) modes both
+  raise OptimizationFailure for an unsatisfiable hard goal;
+* profile mode (CC_TPU_PROFILE=1) re-segments per goal and reports the
+  same instruments.
+"""
+import numpy as np
+
+import conftest  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cruise_control_tpu.analyzer.context import (OptimizationOptions,
+                                                 make_context)
+from cruise_control_tpu.analyzer.goals.base import (Goal,
+                                                    OptimizationFailure)
+from cruise_control_tpu.analyzer.goals.registry import default_goals
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.testing import fixtures
+
+#: mixed subset exercising forced moves (hard), capacity (hard), count
+#: distribution, usage distribution, and both leadership paths — small
+#: enough to compile quickly on the CI CPU, wide enough that every
+#: epilogue variety (traceable comparators, hard predicates, leadership
+#: sweeps) appears in the fused programs
+GOAL_SUBSET = [
+    "RackAwareGoal", "DiskCapacityGoal", "ReplicaDistributionGoal",
+    "DiskUsageDistributionGoal", "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+]
+
+
+def test_no_host_transfers_between_dispatch_and_fetch(monkeypatch):
+    """The solve performs EXACTLY ONE device_get (the end-of-solve
+    instrument fetch), and no device→host transfer escapes the two
+    sanctioned allow-regions — asserted by running the whole solve under
+    jax.transfer_guard_device_to_host("disallow")."""
+    state, topo = fixtures.small_cluster()
+    opt = GoalOptimizer(default_goals(max_rounds=24, names=GOAL_SUBSET),
+                        pipeline_segment_size=2)
+
+    calls = []
+    real_device_get = jax.device_get
+
+    def counting_device_get(x):
+        calls.append(1)
+        return real_device_get(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_device_get)
+    with jax.transfer_guard_device_to_host("disallow"):
+        result = opt.optimizations(state, topo, OptimizationOptions(),
+                                   check_sanity=False)
+    # exactly TWO device_get calls, both in the sanctioned tail: the
+    # end-of-solve instrument fetch, then diff_proposals' one batched
+    # placement fetch (round-5 diff economics).  O(1) per solve — the
+    # pre-fusion path paid one per goal epilogue on top.
+    assert len(calls) == 2, (
+        f"expected instrument fetch + diff fetch, saw "
+        f"{len(calls)} device_get calls")
+    # the one fetch populated every instrument
+    assert set(result.violated_broker_counts) == set(GOAL_SUBSET)
+    assert set(result.rounds_by_goal) >= set(GOAL_SUBSET)
+    assert result.stats_before is not None
+    assert result.proposals  # the fixture's forced rack move
+
+
+def _unfused_reference_solve(opt, state, topo, options):
+    """Pre-fusion reference driver: the SAME goal SPI and pre program,
+    but every goal's epilogue evaluated EAGERLY — a device_get after
+    each goal for stats/violated counts and a host-side regression
+    comparison — replicating the pipeline's exact cadence (float
+    aggregates refreshed at segment entry, cache threaded goal to goal,
+    table re-ensured at segment exit)."""
+    from cruise_control_tpu.analyzer.context import (
+        ensure_full_cache, refresh_float_aggregates)
+    from cruise_control_tpu.analyzer.goals import base as goals_base
+    from cruise_control_tpu.model.stats import (compute_stats,
+                                                compute_stats_fresh_loads)
+
+    goals = list(opt.goals)
+    ctx = make_context(state, opt.constraint, options, topo)
+    initial = state
+    stats_before = jax.device_get(jax.jit(compute_stats)(state))
+    (_, vb_dev, state, cache, _, _, _, pre_rounds) = jax.jit(
+        opt._pre_fn())(initial, state, ctx)
+    vb = np.asarray(jax.device_get(vb_dev))
+
+    def goal_step(i):
+        def fn(st, ca, cx):
+            sink = []
+            goals_base.set_round_sink(sink)
+            try:
+                st, ca = goals[i].optimize_cached(st, cx, goals[:i], ca)
+            finally:
+                goals_base.set_round_sink(None)
+            rounds = sum(sink) if sink else jnp.zeros((), jnp.int32)
+            return st, ca, rounds
+        return jax.jit(fn)
+
+    seg = max(1, opt.pipeline_segment_size)
+    own, rounds, regressed = {}, {}, []
+    prev_stats = stats_before
+    for start in range(0, len(goals), seg):
+        stop = min(start + seg, len(goals))
+        cache = jax.jit(refresh_float_aggregates)(state, cache)
+        for i in range(start, stop):
+            state, cache, r_dev = goal_step(i)(state, cache, ctx)
+            rounds[goals[i].name] = int(jax.device_get(r_dev))
+            goal_stats = jax.device_get(
+                jax.jit(compute_stats_fresh_loads)(state, cache))
+            own[goals[i].name] = int(jax.device_get(jax.jit(
+                lambda st, ca, cx, i=i: goals[i].violated_brokers(
+                    st, cx, ca).sum(dtype=jnp.int32))(state, cache, ctx)))
+            if not goals[i].stats_not_worse(prev_stats, goal_stats):
+                regressed.append(goals[i].name)
+            prev_stats = goal_stats
+        cache = jax.jit(ensure_full_cache)(state, ctx, cache)
+    va = np.asarray(jax.device_get(jax.jit(opt._post_fn())(
+        state, cache, ctx)))
+    pre_rounds_h = int(jax.device_get(pre_rounds))
+    if pre_rounds_h:
+        rounds["__prebalance__"] = pre_rounds_h
+
+    from cruise_control_tpu.analyzer.proposals import diff_proposals
+    proposals = diff_proposals(initial, state, topo,
+                               np.asarray(ctx.partition_replicas))
+    counts = {g.name: (int(b), own[g.name], int(a))
+              for g, b, a in zip(goals, vb, va)}
+    return dict(counts=counts, rounds=rounds, regressed=regressed,
+                proposals=proposals, final_state=state)
+
+
+def test_fused_reproduces_prefusion_path_on_config1():
+    """Equivalence pin (config-1 differential fixture): the fused
+    single-fetch pipeline and the eager pre-fusion driver agree on every
+    instrument and on the proposal set."""
+    state, topo = fixtures.small_cluster()
+    options = OptimizationOptions()
+    opt = GoalOptimizer(default_goals(max_rounds=24, names=GOAL_SUBSET),
+                        pipeline_segment_size=2)
+    fused = opt.optimizations(state, topo, options, check_sanity=False)
+    ref = _unfused_reference_solve(opt, state, topo, options)
+
+    assert fused.violated_broker_counts == ref["counts"]
+    assert fused.rounds_by_goal == ref["rounds"]
+    assert fused.regressed_goals == ref["regressed"]
+    # proposals bitwise: same partitions, same placements, same leaders
+    def key(p):
+        return (p.partition.topic, p.partition.partition,
+                tuple(r.broker_id for r in p.old_replicas),
+                tuple(r.broker_id for r in p.new_replicas))
+    assert sorted(map(key, fused.proposals)) == sorted(
+        map(key, ref["proposals"]))
+    assert np.array_equal(
+        np.asarray(fused.final_state.replica_broker),
+        np.asarray(ref["final_state"].replica_broker))
+
+
+class _UnsatisfiableHardGoal(Goal):
+    """Hard goal that never converges: every alive broker stays
+    violated, its optimize is a no-op."""
+
+    name = "UnsatisfiableHardGoal"
+    is_hard = True
+
+    def optimize_cached(self, state, ctx, prev_goals, cache=None):
+        return state, cache
+
+    def violated_brokers(self, state, ctx, cache):
+        return state.broker_alive
+
+
+def test_hard_goal_abort_deferred_and_eager():
+    state, topo = fixtures.small_cluster()
+    # deferred (default): the abort predicate is read from the single
+    # end-of-solve fetch
+    opt = GoalOptimizer([_UnsatisfiableHardGoal()])
+    with pytest.raises(OptimizationFailure, match="still violated"):
+        opt.optimizations(state, topo, check_sanity=False)
+    # eager (opt-in): per-segment sync raises at the failing segment
+    opt_eager = GoalOptimizer([_UnsatisfiableHardGoal()],
+                              eager_hard_abort=True)
+    with pytest.raises(OptimizationFailure, match="eager abort"):
+        opt_eager.optimizations(state, topo, check_sanity=False)
+    # per-call override beats the constructor default
+    with pytest.raises(OptimizationFailure, match="eager abort"):
+        opt.optimizations(state, topo, check_sanity=False,
+                          eager_hard_abort=True)
+
+
+def test_profile_mode_reports_same_instruments(monkeypatch):
+    """CC_TPU_PROFILE=1 re-segments the pipeline per goal with sync
+    points; instruments must match the fused run and the profiler must
+    attribute every pipeline phase."""
+    from cruise_control_tpu.utils import profiling
+
+    state, topo = fixtures.small_cluster()
+    names = ["RackAwareGoal", "DiskUsageDistributionGoal",
+             "LeaderReplicaDistributionGoal"]
+    fused = GoalOptimizer(default_goals(max_rounds=16, names=names),
+                          pipeline_segment_size=2).optimizations(
+        state, topo, check_sanity=False)
+
+    monkeypatch.setenv(profiling.PROFILE_ENV, "1")
+    prof = profiling.install()
+    try:
+        profiled = GoalOptimizer(
+            default_goals(max_rounds=16, names=names),
+            pipeline_segment_size=2).optimizations(
+            state, topo, check_sanity=False)
+    finally:
+        profiling.uninstall()
+
+    assert profiled.violated_broker_counts == fused.violated_broker_counts
+    assert profiled.rounds_by_goal == fused.rounds_by_goal
+    assert ([(p.partition.topic, p.partition.partition)
+             for p in profiled.proposals]
+            == [(p.partition.topic, p.partition.partition)
+                for p in fused.proposals])
+
+    cats = {r.category for r in prof.records}
+    assert {"prebalance", "rounds", "leadership", "stats",
+            "transfer", "diff"} <= cats
+    names_recorded = {r.name for r in prof.records}
+    for n in names:
+        assert f"goal:{n}:rounds" in names_recorded
+        assert f"goal:{n}:stats" in names_recorded
+    table = prof.table()
+    assert "total rounds" in table and "instrument fetch" in table

@@ -117,6 +117,7 @@ def test_preferred_leader_election():
     assert final_lead[src] and not final_lead[dst]
 
 
+@pytest.mark.slow
 def test_full_default_stack_small():
     spec = RandomClusterSpec(num_brokers=16, num_partitions=200,
                              replication_factor=3, num_racks=4,
@@ -139,6 +140,7 @@ def test_full_default_stack_small():
     assert prc.max() == 1
 
 
+@pytest.mark.slow
 def test_full_stack_self_healing_random():
     spec = RandomClusterSpec(num_brokers=16, num_partitions=150,
                              replication_factor=3, num_racks=4,
@@ -175,6 +177,7 @@ def test_registry_completeness():
         make_goal("NoSuchGoal")
 
 
+@pytest.mark.slow
 def test_jbod_random_cluster_self_healing():
     """BASELINE eval config 5 shape: JBOD logdirs with broken disks; the
     stack must bring every offline replica back online within capacity
@@ -224,6 +227,7 @@ def test_stats_regression_waived_during_self_healing():
         ~np.asarray(state.broker_alive)].any()
 
 
+@pytest.mark.slow
 def test_warmup_aot_path_serves_optimizations():
     """GoalOptimizer.warmup retains AOT executables and optimizations()
     dispatches through them (the facade's auto_warmup path — its

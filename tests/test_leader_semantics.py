@@ -36,6 +36,8 @@ band — matching what the reference's greedy would do — and that is
 asserted here, together with the per-action vetoes."""
 import conftest  # noqa: F401
 
+import pytest
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -136,6 +138,7 @@ def test_every_fixing_action_is_vetoed_by_cpu_goal():
             assert not ok, (int(r_id), dest)
 
 
+@pytest.mark.slow
 def test_pipeline_leaves_the_semantic_residual():
     state, topo = _fixture()
     ctx = make_context(state, BalancingConstraint(), OptimizationOptions(),

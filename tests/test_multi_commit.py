@@ -74,6 +74,7 @@ def test_segment_rank_matches_table_append_contract():
 
 
 @pytest.mark.parametrize("seed", [4, 9])
+@pytest.mark.slow
 def test_leader_goal_escapes_band_floor(seed):
     """End-to-end: after the full stack, leader-count violations shrink
     to a small residual — the refuel phase must break the measured

@@ -36,6 +36,7 @@ def _spec():
                              dead_brokers=1)
 
 
+@pytest.mark.slow
 def test_sharded_full_step_matches_single_device():
     state, topo = random_cluster(_spec())
     goals = default_goals(max_rounds=8, names=[
@@ -78,6 +79,7 @@ def test_sharded_full_step_matches_single_device():
                 & np.asarray(out.replica_valid)).any()
 
 
+@pytest.mark.slow
 def test_sharded_full_goal_stack_runs_and_matches_quality():
     """The FULL default goal stack (15 goals) jitted over the 8-device
     mesh with the solver-mesh table constraints active must execute and
@@ -101,8 +103,11 @@ def test_sharded_full_goal_stack_runs_and_matches_quality():
 
     if not os.environ.get("CC_TPU_SHARDED_SUBPROC"):
         env = dict(os.environ, CC_TPU_SHARDED_SUBPROC="1")
+        # -p no:xdist (not "-n 0"): disables parallelism whether or not
+        # pytest-xdist is installed — "-n" is an unknown flag wherever
+        # xdist is absent (addopts no longer injects xdist flags either)
         r = subprocess.run(
-            [sys.executable, "-m", "pytest", "-q", "-x", "-n", "0",
+            [sys.executable, "-m", "pytest", "-q", "-x", "-p", "no:xdist",
              f"{__file__}::"
              "test_sharded_full_goal_stack_runs_and_matches_quality"],
             env=env, capture_output=True, text=True, timeout=1800,

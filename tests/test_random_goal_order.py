@@ -54,6 +54,7 @@ def _shuffled_order(seed: int):
 
 
 @pytest.mark.parametrize("order_seed", [0, 1, 2])
+@pytest.mark.slow
 def test_shuffled_goal_orders_hold_invariants(fixed_cluster, order_seed):
     state, topo = fixed_cluster
     names = _shuffled_order(order_seed)
@@ -64,6 +65,7 @@ def test_shuffled_goal_orders_hold_invariants(fixed_cluster, order_seed):
         names, result.violated_goals_after)
 
 
+@pytest.mark.slow
 def test_shuffled_order_with_dead_broker():
     """Self-healing must complete under a non-default goal order too
     (reference RandomSelfHealingTest shuffles goals over dead-broker

@@ -3,6 +3,8 @@
 plus self-healing fixtures)."""
 import numpy as np
 
+import pytest
+
 from cruise_control_tpu.analyzer.context import (BalancingConstraint,
                                                  OptimizationOptions,
                                                  make_context,
@@ -21,6 +23,7 @@ from cruise_control_tpu.testing.verifier import run_and_verify
 from cruise_control_tpu.testing.fixtures import util_spread as _util_spread
 
 
+@pytest.mark.slow
 def test_disk_distribution_on_unbalanced():
     state, topo = fixtures.unbalanced_cluster()
     before = _util_spread(state, R.DISK)
@@ -33,6 +36,7 @@ def test_disk_distribution_on_unbalanced():
     assert int(np.asarray(result.final_state.replica_valid).sum()) == 12
 
 
+@pytest.mark.slow
 def test_nw_out_distribution_uses_leadership_moves():
     state, topo = fixtures.unbalanced_cluster()
     before = _util_spread(state, R.NW_OUT)
@@ -45,6 +49,7 @@ def test_nw_out_distribution_uses_leadership_moves():
     assert leaders[0] < 6
 
 
+@pytest.mark.slow
 def test_self_healing_dead_broker():
     state, topo = fixtures.dead_broker_cluster()
     opt = GoalOptimizer([DiskUsageDistributionGoal()])
@@ -64,6 +69,7 @@ def test_proposals_have_valid_shape():
         assert json["topicPartition"]["topic"] == p.partition.topic
 
 
+@pytest.mark.slow
 def test_random_cluster_disk_distribution():
     spec = RandomClusterSpec(num_brokers=24, num_partitions=400,
                              replication_factor=3, num_racks=4,

@@ -1,0 +1,62 @@
+"""Window-rotation salt: int32 safety under large-magnitude loads.
+
+Regression pin for ADVICE round 5 (kernels.py salt_r): the old salt cast
+an unreduced float mix straight to int32 — for deployments whose loads
+are stored in large absolute units the cast SATURATED to INT32_MAX on
+every round, freezing the rotation salt and re-creating the
+vetoed-occupant starvation the rotation was added to prevent.
+kernels.rotation_salt now reduces modulo 2**31 before the cast and mixes
+in an integral leader-count term, so the salt changes on every committed
+transfer even when f32 absorption swallows the load delta.
+"""
+import numpy as np
+
+import conftest  # noqa: F401
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.kernels import rotation_salt
+
+INT32_MAX = np.iinfo(np.int32).max
+
+
+def _transfer(lc, src, dst):
+    """Leader counts after one leadership transfer src→dst broker."""
+    return lc.at[src].add(-1).at[dst].add(1)
+
+
+def test_salt_does_not_saturate_on_large_loads():
+    # large-magnitude loads (e.g. raw bytes): the old formula's float
+    # mix exceeded int32 range and the cast saturated to a constant
+    lc = jnp.asarray(np.full(64, 1000, np.int32))
+    load = jnp.asarray(np.linspace(1e10, 9e10, 64), dtype=jnp.float32)
+    s = int(rotation_salt(lc, load))
+    assert s != INT32_MAX and s != -INT32_MAX - 1
+
+
+def test_salt_changes_per_commit_despite_float_absorption():
+    # a single ±1 leader-count commit against a HUGE load sum: the f32
+    # term absorbs the delta entirely, so only the integral term can
+    # rotate the window — the salt must still change every step
+    lc = jnp.asarray(np.full(128, 50_000, np.int32))
+    load = jnp.asarray(np.full(128, 7e11), dtype=jnp.float32)
+    salts = []
+    rng = np.random.RandomState(7)
+    for _ in range(6):
+        salts.append(int(rotation_salt(lc, load)))
+        src, dst = rng.choice(128, size=2, replace=False)
+        lc = _transfer(lc, int(src), int(dst))
+    assert len(set(salts)) == len(salts), (
+        f"rotation salt repeated across distinct states: {salts}")
+    assert INT32_MAX not in salts
+
+
+def test_salt_changes_with_moderate_loads_too():
+    # the pre-fix behavior was correct at moderate magnitudes — keep it
+    lc = jnp.asarray(np.arange(16, dtype=np.int32))
+    load = jnp.asarray(np.linspace(0.0, 40.0, 16), dtype=jnp.float32)
+    s1 = int(rotation_salt(lc, load))
+    s2 = int(rotation_salt(_transfer(lc, 3, 9), load))
+    s3 = int(rotation_salt(lc, load * 1.01))
+    assert s1 != s2          # integral term sees the commit
+    assert s1 != s3          # float term sees load movement

@@ -266,6 +266,7 @@ def test_table_compaction_small_slots(cluster):
     assert compacted, "compaction branch never executed — raise step count"
 
 
+@pytest.mark.slow
 def test_dest_shortlist_truncation_and_escalation(monkeypatch):
     """Exercise the K < B shortlist path: with a tiny shortlist the
     optimizer must still converge (rounds that would commit nothing under
@@ -286,6 +287,7 @@ def test_dest_shortlist_truncation_and_escalation(monkeypatch):
     assert result.proposals
 
 
+@pytest.mark.slow
 def test_table_overflow_triggers_rerun_with_wider_table(caplog):
     """A broker-table width too small for the actual per-broker counts must
     not silently truncate rows: optimizations() detects the overflow from

@@ -89,6 +89,9 @@ class TestHs256:
 
 
 def _rsa_keypair():
+    # optional dependency: RS256/TLS tests need `cryptography` to forge
+    # keys/certs (the server-side verification under test is stdlib-only)
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
@@ -143,6 +146,7 @@ class TestRs256:
 
 
 def _self_signed_cert(tmp_path):
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa

@@ -44,6 +44,7 @@ from cruise_control_tpu.testing.fixtures import util_spread as _spread
     (NetworkOutboundUsageDistributionGoal, Resource.NW_OUT),
 ])
 @pytest.mark.parametrize("seed", [3, 11])
+@pytest.mark.slow
 def test_goal_outcomes_comparable(goal_cls, res, seed):
     state, topo = _cluster(seed)
     ctx = make_context(state, BalancingConstraint(), OptimizationOptions(),
@@ -71,6 +72,7 @@ def test_goal_outcomes_comparable(goal_cls, res, seed):
 
 
 @pytest.mark.parametrize("seed", [5])
+@pytest.mark.slow
 def test_count_goals_comparable(seed):
     state, topo = _cluster(seed)
     ctx = make_context(state, BalancingConstraint(), OptimizationOptions(),

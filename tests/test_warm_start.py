@@ -45,6 +45,7 @@ def _perturb(state, noise=0.03, seed=3):
         partition_leader_bonus=state.partition_leader_bonus * jit_p)
 
 
+@pytest.mark.slow
 def test_warm_start_valid_and_cheaper(cluster, optimizer):
     state, topo = cluster
     cold = optimizer.optimizations(state, topo)

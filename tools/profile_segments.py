@@ -1,0 +1,100 @@
+"""Segment-level solve profiler CLI (VERDICT round-5 missing item #1).
+
+Runs one full multi-goal solve over a BASELINE.json eval config with the
+segment profiler active (CC_TPU_PROFILE) and prints the per-segment
+attribution table: prebalance / per-goal table rounds / per-goal stats
+epilogues / leadership / final diff / instrument transfer — the
+shards-vs-replicates breakdown of the north wall-clock.
+
+    python tools/profile_segments.py              # BENCH_CONFIG=north
+    BENCH_CONFIG=2 python tools/profile_segments.py
+    python tools/profile_segments.py --json out.json
+
+Profile mode inserts explicit sync points and runs one program per goal,
+so the total here is NOT comparable to an unprofiled `python bench.py`
+run — use it for attribution, bench.py for the headline number.  The
+first solve additionally pays per-goal program compiles (the fused
+warmup programs do not cover the profile-mode segmentation); pass
+--solves 2 to also time a compile-warm second solve.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("CC_TPU_PROFILE", "1")
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", help="also write the profile as JSON here")
+    ap.add_argument("--solves", type=int, default=1,
+                    help="profiled solves to run (2 = add a compile-warm "
+                         "pass; only the LAST solve is reported)")
+    args = ap.parse_args()
+
+    import logging
+    logging.basicConfig(stream=sys.stderr, level=logging.INFO,
+                        format="# %(message)s")
+
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+
+    import bench
+    from cruise_control_tpu.analyzer.context import OptimizationOptions
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.utils import profiling
+
+    config = os.environ.get("BENCH_CONFIG", "north")
+    num_b = int(os.environ.get("BENCH_BROKERS", 2600 if config in
+                               ("north", "4", "5") else 200))
+    num_p = int(os.environ.get("BENCH_PARTITIONS", 200_000 if config in
+                               ("north", "4", "5") else 20_000))
+    rf = int(os.environ.get("BENCH_RF", 3))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 192))
+    names = (os.environ.get("BENCH_GOALS").split(",")
+             if os.environ.get("BENCH_GOALS") else None)
+
+    backend = jax.devices()[0].platform
+    print(f"# profile_segments config={config} backend={backend}",
+          file=sys.stderr)
+    state, topo = bench._build(config, num_b, num_p, rf)
+    print(f"# model: B={state.num_brokers} P={state.num_partitions} "
+          f"R={state.num_replicas}", file=sys.stderr)
+
+    optimizer = GoalOptimizer(default_goals(max_rounds=rounds, names=names))
+    profiler = profiling.install()
+    result = None
+    for i in range(max(1, args.solves)):
+        profiler.reset()
+        t0 = time.time()
+        result = optimizer.optimizations(state, topo, OptimizationOptions(),
+                                         check_sanity=False)
+        print(f"# solve {i}: {time.time() - t0:.1f}s (profiled; includes "
+              f"sync points{' + compiles' if i == 0 else ''})",
+              file=sys.stderr)
+
+    print(profiler.table())
+    print(f"proposals={len(result.proposals)} "
+          f"violated_after={len(result.violated_goals_after)} "
+          f"balancedness={result.balancedness_score():.1f}")
+    if args.json:
+        payload = profiler.to_json()
+        payload["config"] = config
+        payload["backend"] = backend
+        payload["rounds_by_goal"] = result.rounds_by_goal
+        with open(args.json, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"# wrote {args.json}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
