@@ -170,6 +170,10 @@ class AgentMetricsReporterSampler(MetricSampler):
         self._transport = transport
         self._max_records = max_records_per_round
         self._processor = MetricsProcessor()
+        #: lifetime count of records dropped as undeserializable — the
+        #: sampler's data-loss instrument, exported by the facade as the
+        #: `sampler-corrupt-records` sensor
+        self.num_corrupt_records: int = 0
 
     def get_samples(self, cluster: ClusterSnapshot,
                     assigned_partitions: Set[TopicPartition],
@@ -177,13 +181,21 @@ class AgentMetricsReporterSampler(MetricSampler):
                     mode: SamplingMode = SamplingMode.ALL) -> Samples:
         raw = self._transport.poll(self._max_records)
         records = []
+        skipped = 0
         for data in raw:
             try:
                 # no time filtering: the aggregator buckets each sample by
                 # its own timestamp, so late records land in their window
                 records.append(deserialize(data))
-            except Exception:  # noqa: BLE001 - skip corrupt records
-                LOG.warning("dropping undeserializable metric record")
+            except Exception as exc:  # noqa: BLE001 - skip corrupt records
+                skipped += 1
+                LOG.debug("dropping undeserializable metric record: %s",
+                          exc)
+        if skipped:
+            self.num_corrupt_records += skipped
+            LOG.warning("dropped %d undeserializable metric records this "
+                        "round (%d total this process)", skipped,
+                        self.num_corrupt_records)
         return self._processor.process(records, cluster,
                                        assigned_partitions, mode)
 

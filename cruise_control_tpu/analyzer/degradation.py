@@ -1,0 +1,265 @@
+"""Solver degradation ladder: failure classification, retry backoff, and
+a circuit breaker for the device-resident goal pipeline.
+
+PR 1 made the multi-goal solve fully device-resident; the price is that a
+single compile failure, device fault, or NaN-bearing model surfaces as one
+opaque exception per solve.  This module gives the facade the same
+self-healing discipline the framework applies to Kafka clusters, applied
+to the solver itself — the reconfiguration-under-failure pattern of
+"Integrative Dynamic Reconfiguration in a Parallel Stream Processing
+Engine" (PAPERS.md): classify the failure, retry with exponential backoff
+plus deterministic jitter, step down a degradation ladder of solver
+implementations, and trip a circuit breaker that pins the lower rung
+until a cooldown elapses.
+
+The ladder's rungs (facade `CruiseControl._solve_on_rung`):
+
+  FUSED  — the PR-1 pipeline: fused per-goal epilogues, buffer donation,
+           one end-of-solve instrument fetch.  Fastest; one XLA program
+           per goal segment.
+  EAGER  — one program per goal with an eager hard-abort sync after each
+           (GoalOptimizer eager driver).  Smaller programs survive
+           segment-level compile failures and localize device faults.
+  CPU    — the host-side numpy fallback (model/cpu_model.py
+           host_fallback_solve): self-healing-only placement repair with
+           no XLA dispatch at all.  Degraded but never unavailable —
+           offline replicas still get relocated while the device solver
+           is down.
+
+Classification drives policy: INVALID_INPUT (NaN/Inf/negative loads in
+the model) never retries or descends — garbage solves the same at every
+rung, so the request fails fast while ingest quarantine
+(monitor/sampling/holder.py) starves the source.  COMPILE and RUNTIME
+retry on the same rung with backoff, then descend.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import random
+import threading
+from typing import Callable, Optional
+
+LOG = logging.getLogger(__name__)
+
+
+class FailureKind(enum.Enum):
+    """What layer a solve failure belongs to (drives retry policy)."""
+
+    INVALID_INPUT = "INVALID_INPUT"   # NaN/Inf/negative model inputs
+    COMPILE = "COMPILE"               # program build / XLA compilation
+    RUNTIME = "RUNTIME"               # device execution / everything else
+
+
+class SolverRung(enum.IntEnum):
+    """Degradation ladder rungs, best (0) to most degraded (2)."""
+
+    FUSED = 0
+    EAGER = 1
+    CPU = 2
+
+
+class InvalidModelInputError(ValueError):
+    """The cluster model carries NaN/Inf/negative loads or capacities —
+    detected device-side inside the fused pre program and raised at the
+    single end-of-solve fetch (no extra host syncs on the happy path)."""
+
+
+def classify_failure(exc: BaseException) -> FailureKind:
+    """Bucket a solve failure.  Injected faults (utils/faults.FaultError)
+    classify by the site they were injected at, so chaos scenarios
+    exercise the same policy branches real failures take."""
+    from cruise_control_tpu.utils.faults import FaultError
+    if isinstance(exc, InvalidModelInputError):
+        return FailureKind.INVALID_INPUT
+    if isinstance(exc, FaultError):
+        return (FailureKind.COMPILE if ".compile" in exc.site
+                else FailureKind.RUNTIME)
+    text = f"{type(exc).__name__}: {exc}".lower()
+    if "compil" in text or "lowering" in text or "hlo" in text:
+        return FailureKind.COMPILE
+    # NO text heuristic for INVALID_INPUT: the ladder fail-fasts on that
+    # class (no retry, no descent), so only the typed verdict from the
+    # device-side validity sweep may claim it — a device error whose
+    # MESSAGE happens to mention NaN is still a runtime fault and must
+    # be retried/descended like one
+    return FailureKind.RUNTIME
+
+
+@dataclasses.dataclass(frozen=True)
+class BackoffPolicy:
+    """Exponential backoff with deterministic jitter.
+
+    delay(attempt) = min(base * 2^attempt, max) * (1 + jitter*u) where u
+    is drawn from a seeded RNG — retries spread out under contention yet
+    chaos runs reproduce exactly."""
+
+    base_s: float = 1.0
+    max_s: float = 60.0
+    jitter: float = 0.25
+    seed: int = 0
+
+    def delays(self):
+        """Stateful generator of successive delays (one RNG per solve
+        request keeps concurrent requests independent).  The cap applies
+        AFTER jitter: max_s is a hard bound an operator can tune to
+        bound request latency, never exceeded."""
+        rng = random.Random(self.seed)
+        attempt = 0
+        while True:
+            d = self.base_s * (2.0 ** attempt) \
+                * (1.0 + self.jitter * rng.random())
+            yield min(d, self.max_s)
+            attempt += 1
+
+
+class BreakerState(enum.Enum):
+    CLOSED = "CLOSED"         # normal service
+    OPEN = "OPEN"             # pinned to the degraded rung until cooldown
+    HALF_OPEN = "HALF_OPEN"   # cooldown elapsed: probing one rung up
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker (reference pattern; thread-safe).
+
+    CLOSED → (N consecutive failures) → OPEN → (cooldown) → HALF_OPEN →
+    success closes / failure re-opens with a fresh cooldown."""
+
+    def __init__(self, failure_threshold: int = 3,
+                 cooldown_s: float = 300.0,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        import time as _time
+        self.failure_threshold = max(1, failure_threshold)
+        self.cooldown_s = cooldown_s
+        self._time = time_fn or _time.time
+        self._lock = threading.Lock()
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+
+    @property
+    def state(self) -> BreakerState:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> BreakerState:
+        if self._opened_at is None:
+            return BreakerState.CLOSED
+        if self._time() - self._opened_at >= self.cooldown_s:
+            return BreakerState.HALF_OPEN
+        return BreakerState.OPEN
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._consecutive_failures
+
+    def cooldown_remaining_s(self) -> float:
+        with self._lock:
+            if self._opened_at is None:
+                return 0.0
+            return max(0.0,
+                       self.cooldown_s - (self._time() - self._opened_at))
+
+    def record_failure(self) -> bool:
+        """Returns True when THIS failure transitions the breaker from
+        CLOSED to OPEN (callers emit the degradation anomaly exactly once
+        per open)."""
+        with self._lock:
+            self._consecutive_failures += 1
+            was_open = self._opened_at is not None
+            if self._consecutive_failures >= self.failure_threshold:
+                # a failure while OPEN/HALF_OPEN restarts the cooldown
+                self._opened_at = self._time()
+                return not was_open
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._opened_at = None
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state_locked().value,
+                "consecutiveFailures": self._consecutive_failures,
+                "failureThreshold": self.failure_threshold,
+                "cooldownRemainingS": round(
+                    0.0 if self._opened_at is None else max(
+                        0.0, self.cooldown_s
+                        - (self._time() - self._opened_at)), 3),
+            }
+
+
+class DegradationLadder:
+    """Rung state machine shared by every solve of one facade.
+
+    The RESTING rung is where service has settled.  While the breaker is
+    OPEN the resting rung is pinned — every solve runs there, and
+    successes at the pinned rung do NOT close the breaker (a working
+    fallback says nothing about the rung that failed).  Once the
+    cooldown elapses (HALF_OPEN) — and whenever the breaker is simply
+    CLOSED with service still degraded — the next solve PROBES one rung
+    up; a successful probe climbs the resting rung one step and closes
+    the breaker, so recovery is one rung per solve back to FUSED."""
+
+    def __init__(self, breaker: CircuitBreaker,
+                 start_rung: SolverRung = SolverRung.FUSED) -> None:
+        self.breaker = breaker
+        self._lock = threading.Lock()
+        self._rung = start_rung
+        #: lifetime descent count (sensor food)
+        self.total_descents = 0
+
+    @property
+    def rung(self) -> SolverRung:
+        with self._lock:
+            return self._rung
+
+    def entry_rung(self) -> SolverRung:
+        """Where the next solve should start: the pinned resting rung
+        while the breaker is OPEN, one rung up otherwise (the recovery
+        probe; FUSED when service is healthy)."""
+        state = self.breaker.state
+        with self._lock:
+            if (state is not BreakerState.OPEN
+                    and self._rung > SolverRung.FUSED):
+                return SolverRung(self._rung - 1)
+            return self._rung
+
+    def on_failure(self, rung: SolverRung) -> bool:
+        """Record a failed attempt at `rung` (a failed probe simply stays
+        pinned at the resting rung).  Returns True when this failure
+        tripped the breaker (caller emits the anomaly once)."""
+        return self.breaker.record_failure()
+
+    def descend(self, from_rung: SolverRung) -> Optional[SolverRung]:
+        """Step down one rung; returns the new rung or None at bottom."""
+        with self._lock:
+            if from_rung >= SolverRung.CPU:
+                return None
+            nxt = SolverRung(from_rung + 1)
+            if nxt > self._rung:
+                self._rung = nxt
+                self.total_descents += 1
+            return nxt
+
+    def on_success(self, rung: SolverRung) -> None:
+        """A solve succeeded at `rung`.  A success ABOVE the resting rung
+        (a probe) or at FUSED climbs/settles the ladder and closes the
+        breaker; a success AT a degraded resting rung changes nothing —
+        the fallback working is expected, not recovery."""
+        with self._lock:
+            probe = rung < self._rung
+            if probe:
+                self._rung = rung
+        if probe or rung is SolverRung.FUSED:
+            self.breaker.record_success()
+
+    def to_json(self) -> dict:
+        with self._lock:
+            rung = self._rung
+        return {"rung": rung.name, "rungValue": int(rung),
+                "totalDescents": self.total_descents,
+                "breaker": self.breaker.to_json()}

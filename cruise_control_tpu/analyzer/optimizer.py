@@ -41,9 +41,25 @@ from cruise_control_tpu.model.sanity import sanity_check
 from cruise_control_tpu.model.state import ClusterState
 from cruise_control_tpu.model.stats import (ClusterModelStats, compute_stats,
                                             stats_aval)
-from cruise_control_tpu.utils import profiling
+from cruise_control_tpu.utils import faults, profiling
 
 LOG = logging.getLogger(__name__)
+
+
+def inputs_invalid(state: ClusterState) -> jax.Array:
+    """Device-side model-input validity: True when any valid replica load,
+    partition leadership bonus, or broker capacity is NaN/Inf/negative.
+    Computed INSIDE the fused pre program so the verdict rides the single
+    end-of-solve instrument fetch — the happy path pays zero extra host
+    syncs (transfer-guard pin, tests/test_fused_pipeline.py)."""
+    def bad(x, mask=None):
+        b = ~jnp.isfinite(x) | (x < 0.0)
+        if mask is not None:
+            b = b & mask
+        return jnp.any(b)
+    return (bad(state.replica_base_load, state.replica_valid[:, None])
+            | bad(state.partition_leader_bonus)
+            | bad(state.broker_capacity))
 
 
 def _regression_traceable(goal: Goal) -> bool:
@@ -68,7 +84,9 @@ def _regression_traceable(goal: Goal) -> bool:
                                      dtype=bool),
             aval_in, aval_in)
         return aval.shape == ()
-    except Exception:  # noqa: BLE001 - comparator won't trace → host
+    except Exception as exc:  # noqa: BLE001 - comparator won't trace → host
+        LOG.debug("stats comparator of %s is not traceable (%s); "
+                  "re-evaluating it on host post-fetch", goal.name, exc)
         return False
 
 #: process-wide cache of jitted pipeline programs keyed by
@@ -293,7 +311,14 @@ class GoalOptimizer:
     def _pre_fn(self):
         """(state_initial, state, ctx) -> (stats_before,
         violated_broker_counts i32[G], healed state, RoundCache,
-        still_offline, max_broker_count, broken, prebalance_rounds).
+        still_offline, max_broker_count, broken, prebalance_rounds,
+        invalid_inputs).
+
+        `invalid_inputs` is the device-side model-validity verdict
+        (NaN/Inf/negative loads or capacities, see inputs_invalid): it is
+        read from the single end-of-solve fetch and raises
+        InvalidModelInputError there, classifying the failure as
+        invalid-input for the degradation ladder (no retry, no descent).
 
         `stats_before` (ClusterModelStats of state_initial) is computed
         HERE rather than by an eager pre-solve device_get: it seeds the
@@ -356,7 +381,8 @@ class GoalOptimizer:
             still_offline = jnp.sum(S.self_healing_eligible(state))
             max_count = jnp.max(S.broker_replica_count(state))
             return (stats_before, violated_before, state, cache,
-                    still_offline, max_count, broken, pre_rounds)
+                    still_offline, max_count, broken, pre_rounds,
+                    inputs_invalid(state_initial))
         return run
 
     def _segment_fn(self, start: int, stop: int):
@@ -575,6 +601,7 @@ class GoalOptimizer:
 
         def compile_one(job):
             key, fn, args = job
+            faults.inject("optimizer.compile")
             for attempt in range(attempts):
                 try:
                     return key, self._jit_program(key, fn).lower(
@@ -597,7 +624,8 @@ class GoalOptimizer:
                       check_sanity: bool = True,
                       _table_slots_override: Optional[int] = None,
                       warm_start: Optional[ClusterState] = None,
-                      eager_hard_abort: Optional[bool] = None
+                      eager_hard_abort: Optional[bool] = None,
+                      eager_driver: bool = False
                       ) -> OptimizerResult:
         """Run all goals in priority order and diff out proposals
         (reference GoalOptimizer.optimizations :409-480).
@@ -619,6 +647,15 @@ class GoalOptimizer:
         `eager_hard_abort` (None → the constructor default) re-enables a
         per-segment device sync that reads the hard-goal abort predicate
         eagerly — see the constructor docstring for the trade-off.
+
+        `eager_driver` re-segments the pipeline ONE GOAL PER PROGRAM (the
+        same segmentation profile mode uses, without the profiler's sync
+        points): the EAGER rung of the solver degradation ladder
+        (analyzer/degradation.py).  Smaller programs survive segment-level
+        compile failures and localize device faults to the goal that hit
+        them; instruments and results are identical to the fused path
+        (pinned by test_profile_mode_reports_same_instruments, which runs
+        this exact segmentation).
 
         `warm_start` (optional) is a PREVIOUS solve's final state over the
         SAME topology (caller validates — facade._warm_start_compatible):
@@ -703,8 +740,8 @@ class GoalOptimizer:
 
         t0 = time.time()
         (stats0_dev, vb_dev, state, cache, still_dev, maxc_dev, broken_dev,
-         pre_rounds_dev) = self._run("__pre__", self._pre_fn(), initial,
-                                     state, ctx)
+         pre_rounds_dev, invalid_dev) = self._run(
+            "__pre__", self._pre_fn(), initial, state, ctx)
         if prof is not None:
             jax.block_until_ready(state.replica_broker)
             prof.record("pre+heal+prebalance", "prebalance",
@@ -728,26 +765,30 @@ class GoalOptimizer:
                         f"hard goal {g.name} still violated after its "
                         f"own optimization (eager abort)")
 
-        if prof is not None:
-            # profile mode: one goal per program, search rounds split
-            # from the stats epilogue, explicit sync point after each
-            # (shards-vs-replicates attribution; see _goal_rounds_fn)
+        if prof is not None or eager_driver:
+            # per-goal segmentation: profile mode (one goal per program,
+            # search rounds split from the stats epilogue, explicit sync
+            # point after each — shards-vs-replicates attribution, see
+            # _goal_rounds_fn) and the degradation ladder's EAGER rung
+            # (same programs, no profiler syncs)
             for i, g in enumerate(self.goals):
                 t_seg = time.time()
                 state, cache, rounds_g = self._run(
                     f"__goal_{i}_rounds__", self._goal_rounds_fn(i),
                     state, cache, ctx)
-                jax.block_until_ready(state.replica_broker)
-                prof.record(f"goal:{g.name}:rounds",
-                            profiling.category_for_goal(g.name),
-                            time.time() - t_seg)
+                if prof is not None:
+                    jax.block_until_ready(state.replica_broker)
+                    prof.record(f"goal:{g.name}:rounds",
+                                profiling.category_for_goal(g.name),
+                                time.time() - t_seg)
                 t_epi = time.time()
                 prev_stats, (stacked_g, own_g, regr_g, hard_g) = self._run(
                     f"__goal_{i}_epi__", self._goal_epilogue_fn(i),
                     state, cache, prev_stats, ctx)
-                jax.block_until_ready(own_g)
-                prof.record(f"goal:{g.name}:stats", "stats",
-                            time.time() - t_epi)
+                if prof is not None:
+                    jax.block_until_ready(own_g)
+                    prof.record(f"goal:{g.name}:stats", "stats",
+                                time.time() - t_epi)
                 stacked_parts.append(stacked_g)
                 own_parts.append(own_g)
                 rounds_parts.append(rounds_g)
@@ -785,15 +826,27 @@ class GoalOptimizer:
             # this fetch has drained the pipeline.
             (stats_before, stacked_h, own_h, rounds_h, regr_h, vb_h, va_h,
              still_offline, broken, max_count,
-             pre_rounds) = jax.device_get(
+             pre_rounds, invalid_inp) = jax.device_get(
                 (stats0_dev, stacked_parts, own_parts, rounds_parts,
                  regr_parts, vb_dev, va_dev, still_dev, broken_dev,
-                 maxc_dev, pre_rounds_dev))
+                 maxc_dev, pre_rounds_dev, invalid_dev))
             if prof is not None:
                 prof.record("instrument fetch", "transfer",
                             time.time() - t_host)
             LOG.debug("goal pipeline (%d programs) ran in %.0fms",
                       len(stacked_parts) + 2, (time.time() - t0) * 1e3)
+            if bool(invalid_inp):
+                # the model carried NaN/Inf/negative loads — the whole
+                # solve is poisoned; fail as invalid-input (the ladder
+                # neither retries nor descends for this class) before
+                # reading anything else out of the fetch
+                from cruise_control_tpu.analyzer.degradation import \
+                    InvalidModelInputError
+                raise InvalidModelInputError(
+                    "cluster model carries NaN/Inf/negative replica "
+                    "loads, leadership bonuses, or broker capacities "
+                    "(device-side validity sweep); quarantine should "
+                    "have dropped the offending samples at ingest")
             if ctx.table_slots and int(max_count) > ctx.table_slots:
                 # self-healing runs table-less and may concentrate
                 # replicas past the broker-table width sized from the
@@ -815,7 +868,8 @@ class GoalOptimizer:
                                           check_sanity=check_sanity,
                                           _table_slots_override=new_slots,
                                           warm_start=warm_start,
-                                          eager_hard_abort=eager)
+                                          eager_hard_abort=eager,
+                                          eager_driver=eager_driver)
             stacked_h = (jax.tree.map(
                 lambda *xs: np.concatenate(xs), *stacked_h)
                 if stacked_h else None)
@@ -949,6 +1003,7 @@ class GoalOptimizer:
         prev_stats (segment 0's is also fetched as stats_before), and
         ctx (shared by every program of the solve).  Donation is skipped
         on CPU (unsupported there; avoids a warning per compile)."""
+        faults.inject("optimizer.compile")
         donate = ()
         if (key.startswith("__seg_")
                 or (key.startswith("__goal_") and key.endswith("_rounds__"))):
@@ -987,6 +1042,7 @@ class GoalOptimizer:
         """Prefer a warmup-retained AOT executable; fall back to jit when
         none exists or the argument shapes changed (an AOT executable is
         pinned to the avals it was lowered for)."""
+        faults.inject("optimizer.execute")
         aot = self._aot.get(key)
         if aot is not None:
             try:

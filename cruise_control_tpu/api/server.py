@@ -318,8 +318,10 @@ class CruiseControlApp:
             return 202, hdrs, {"progress": [{"operation": endpoint,
                                              "status": "InProgress"}],
                                "version": 1}
-        except Exception as exc:  # operation failed
+        except Exception as exc:  # noqa: BLE001 - operation failed
             status = 409 if isinstance(exc, OngoingExecutionError) else 500
+            LOG.warning("async %s operation failed: %s: %s", endpoint,
+                        type(exc).__name__, exc)
             return status, hdrs, {"errorMessage":
                                   f"{type(exc).__name__}: {exc}",
                                   "version": 1}

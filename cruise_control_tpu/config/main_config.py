@@ -213,7 +213,40 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
              "Cached proposals older than this are recomputed.")
     d.define("proposal.precompute.interval.ms", Type.LONG, 30_000,
              in_range(min_value=1), _L,
-             "Pause between background proposal precompute passes.")
+             "Pause between background proposal precompute passes "
+             "(consecutive failures back off exponentially from this, "
+             "capped at 32 intervals).")
+    d.define("proposal.precompute.solve.deadline.ms", Type.LONG,
+             1_800_000, in_range(min_value=1), _L,
+             "Watchdog deadline for one precompute solve: a solve still "
+             "running past this is considered wedged — shutdown stops "
+             "waiting for it and the STATE endpoint flags it.")
+    d.define("solver.degradation.enabled", Type.BOOLEAN, True, None, _M,
+             "Run solves through the degradation ladder (classify "
+             "failures, retry with backoff, fall back fused pipeline -> "
+             "eager per-goal driver -> host/CPU self-healing-only "
+             "solver, circuit breaker).  Disabled: every solve runs the "
+             "fused pipeline once and failures propagate raw.")
+    d.define("solver.max.retries.per.rung", Type.INT, 1,
+             in_range(min_value=0), _L,
+             "Same-rung retries (with backoff) before the ladder "
+             "descends a rung.")
+    d.define("solver.retry.backoff.base.ms", Type.LONG, 1_000,
+             in_range(min_value=1), _L,
+             "Base of the exponential retry backoff between solve "
+             "attempts.")
+    d.define("solver.retry.backoff.max.ms", Type.LONG, 60_000,
+             in_range(min_value=1), _L,
+             "Cap of the exponential retry backoff.")
+    d.define("solver.circuit.breaker.failure.threshold", Type.INT, 3,
+             in_range(min_value=1), _L,
+             "Consecutive solve failures that trip the circuit breaker "
+             "(pinning the degraded rung until the cooldown elapses).")
+    d.define("solver.circuit.breaker.cooldown.ms", Type.LONG, 300_000,
+             in_range(min_value=1), _L,
+             "Cooldown after the breaker trips; once elapsed the next "
+             "solve probes one rung up and success re-closes the "
+             "breaker.")
     d.define("proposal.warm.start.enabled", Type.BOOLEAN, True, None, _L,
              "Seed default-stack solves from the previous solve's final "
              "placement when the model generation moved but the topology "

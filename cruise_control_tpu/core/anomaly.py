@@ -27,6 +27,10 @@ class AnomalyType(enum.Enum):
     METRIC_ANOMALY = 2
     GOAL_VIOLATION = 3
     TOPIC_ANOMALY = 4
+    #: the SOLVER degraded (rung descent / circuit-breaker trip in the
+    #: degradation ladder, analyzer/degradation.py) — informational: the
+    #: ladder already IS the fix, so notification-only, lowest priority
+    SOLVER_DEGRADATION = 5
 
 
 class Anomaly(abc.ABC):

@@ -142,6 +142,48 @@ class SlowBrokers(Anomaly):
 
 
 @dataclasses.dataclass
+class SolverDegraded(Anomaly):
+    """The goal solver degraded: a rung descent on the degradation
+    ladder (fused → eager → CPU) or a circuit-breaker trip
+    (analyzer/degradation.py).  Notification-only — the ladder itself is
+    the remediation; this anomaly routes the event through the normal
+    notifier plane (webhook/log) so operators see solver trouble exactly
+    like cluster trouble."""
+
+    from_rung: str
+    to_rung: Optional[str]          # None: the bottom rung itself failed
+    failure_kind: str               # degradation.FailureKind value
+    breaker_tripped: bool
+    description: str = ""
+    detected_ms: float = 0.0
+    _id: str = dataclasses.field(
+        default_factory=lambda: _new_id("solver-degraded"))
+
+    @property
+    def anomaly_type(self) -> AnomalyType:
+        return AnomalyType.SOLVER_DEGRADATION
+
+    @property
+    def anomaly_id(self) -> str:
+        return self._id
+
+    def fix(self) -> bool:
+        return False   # the ladder already degraded/recovered by itself
+
+    def __str__(self) -> str:
+        if self.breaker_tripped:
+            arrow = (f"breaker OPEN, pinned at "
+                     f"{self.to_rung or self.from_rung}")
+        elif self.to_rung:
+            arrow = f"{self.from_rung}->{self.to_rung}"
+        else:
+            arrow = f"{self.from_rung} (bottom rung failed)"
+        return (f"SolverDegraded({arrow}, kind={self.failure_kind}, "
+                f"breakerTripped={self.breaker_tripped}, "
+                f"{self.description})")
+
+
+@dataclasses.dataclass
 class TopicAnomaly(Anomaly):
     """Topics violating a policy — e.g. replication factor != target
     (reference TopicReplicationFactorAnomaly.java) or oversized partitions

@@ -29,6 +29,7 @@ from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy
 from cruise_control_tpu.executor.task import (ExecutionTask, TaskState,
                                               TaskType)
 from cruise_control_tpu.executor.task_manager import ExecutionTaskManager
+from cruise_control_tpu.utils import faults
 
 LOG = logging.getLogger(__name__)
 #: operations audit log — one INFO line per started execution, emitted here
@@ -117,6 +118,17 @@ class Executor:
         self._sleep = sleep_fn or _time.sleep
 
         self._lock = threading.RLock()
+        #: transient admin-client failures tolerated during progress
+        #: polls (the poll retries next interval instead of failing the
+        #: whole execution; submission paths stay fail-fast)
+        self.num_poll_failures_tolerated = 0
+        #: CONSECUTIVE tolerated poll failures before the execution
+        #: fails anyway: tolerance is for transient blips — a
+        #: permanently broken admin client must still fail the execution
+        #: (pre-tolerance behavior) instead of wedging it forever with
+        #: has_ongoing_execution pinned true
+        self._max_consecutive_poll_failures = 10
+        self._consecutive_poll_failures = 0
         self._manager: Optional[ExecutionTaskManager] = None
         self._phase = ExecutorPhase.NO_TASK_IN_PROGRESS
         self._stop_requested = False
@@ -127,6 +139,14 @@ class Executor:
         #: broker id -> removal/demotion time (reference Executor.java:309-366)
         self._removed_brokers: Dict[int, float] = {}
         self._demoted_brokers: Dict[int, float] = {}
+
+    def _admin_call(self, op: str, *args, **kwargs):
+        """Every admin-client interaction funnels through here so the
+        fault harness (utils/faults.py, sites `executor.admin.<op>`) can
+        script transient cluster failures against the exact call the
+        executor makes."""
+        faults.inject(f"executor.admin.{op}")
+        return getattr(self._admin, op)(*args, **kwargs)
 
     # ------------------------------------------------------------------
     # public surface
@@ -162,6 +182,7 @@ class Executor:
             self._uuid = uuid or str(_uuid.uuid4())
             self._reason = reason
             self._alerted_tasks.clear()
+            self._consecutive_poll_failures = 0
             now = self._time()
             for b in removed_brokers:
                 self._removed_brokers[b] = now
@@ -176,7 +197,7 @@ class Executor:
                 if concurrent_leader_movements is not None
                 else self._leader_cap,
                 strategy or self._default_strategy)
-            snapshot = self._admin.describe_cluster()
+            snapshot = self._admin_call("describe_cluster")
             mgr.load_proposals(proposals,
                                sorted(snapshot.all_broker_ids))
             if (self._max_cluster_movements is not None
@@ -282,10 +303,10 @@ class Executor:
                 self._load_monitor.pause_metric_sampling(
                     "executing proposals")
             if throttle is not None:
-                snapshot = self._admin.describe_cluster()
+                snapshot = self._admin_call("describe_cluster")
                 throttled_brokers = sorted(snapshot.alive_broker_ids)
-                self._admin.set_replication_throttle(throttled_brokers,
-                                                     throttle)
+                self._admin_call("set_replication_throttle",
+                                 throttled_brokers, throttle)
             self._set_phase(
                 ExecutorPhase.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS)
             self._inter_broker_move_replicas(mgr)
@@ -304,7 +325,8 @@ class Executor:
         finally:
             if throttled_brokers:
                 try:
-                    self._admin.clear_replication_throttle(throttled_brokers)
+                    self._admin_call("clear_replication_throttle",
+                                     throttled_brokers)
                 except Exception:  # noqa: BLE001
                     LOG.exception("failed to clear throttles")
             if self._load_monitor is not None:
@@ -336,7 +358,7 @@ class Executor:
                       for t in in_flight
                       if t.task_type == TaskType.INTER_BROKER_REPLICA_ACTION}
             if cancel:
-                self._admin.alter_partition_reassignments(cancel)
+                self._admin_call("alter_partition_reassignments", cancel)
             for t in list(in_flight):
                 mgr.mark_aborting(t, now_ms)
                 mgr.finish_task(t, TaskState.ABORTED, now_ms)
@@ -355,7 +377,7 @@ class Executor:
             now_ms = self._time() * 1000.0
             new_tasks = mgr.next_inter_broker_tasks(now_ms)
             if new_tasks:
-                alive = self._admin.describe_cluster().alive_broker_ids
+                alive = self._admin_call("describe_cluster").alive_broker_ids
                 targets = {}
                 for t in new_tasks:
                     if any(b not in alive
@@ -369,7 +391,7 @@ class Executor:
                                    for r in t.proposal.new_replicas]
                     in_flight.append(t)
                 if targets:
-                    self._admin.alter_partition_reassignments(targets)
+                    self._admin_call("alter_partition_reassignments", targets)
             if not in_flight and not new_tasks:
                 counts = mgr.counts(TaskType.INTER_BROKER_REPLICA_ACTION)
                 if counts.pending == 0:
@@ -397,10 +419,36 @@ class Executor:
                                        t.proposal.partition.partition): None
                         for t in in_flight}
                     if cancel:
-                        self._admin.alter_partition_reassignments(cancel)
+                        self._admin_call("alter_partition_reassignments", cancel)
                     for t in list(in_flight):
                         mgr.finish_task(t, TaskState.ABORTED, now_ms)
                     in_flight.clear()
+
+    def _tolerate_poll_failure(self, phase: str, exc: Exception) -> None:
+        """A progress POLL hit a transient admin/cluster failure: the
+        in-flight work is still running inside the cluster, so failing
+        the whole execution would abandon it for an observation error —
+        count it, log it, and observe again next interval.  (Submission
+        paths stay fail-fast: not requesting work is recoverable by the
+        caller, silently dropping requested work is not.)  Bounded:
+        after `_max_consecutive_poll_failures` in a row the failure is
+        re-raised and the execution fails — a permanently dead admin
+        client must not wedge has_ongoing_execution forever."""
+        self.num_poll_failures_tolerated += 1
+        self._consecutive_poll_failures += 1
+        if self._consecutive_poll_failures \
+                > self._max_consecutive_poll_failures:
+            LOG.error(
+                "%s progress poll failed %d consecutive times; the admin "
+                "client looks permanently broken — failing the execution",
+                phase, self._consecutive_poll_failures)
+            raise exc
+        LOG.warning(
+            "%s progress poll failed (%s: %s); retrying next interval "
+            "(%d/%d consecutive, %d tolerated this process)", phase,
+            type(exc).__name__, exc, self._consecutive_poll_failures,
+            self._max_consecutive_poll_failures,
+            self.num_poll_failures_tolerated)
 
     def _poll_inter_broker(self, mgr: ExecutionTaskManager,
                            in_flight: List[ExecutionTask]) -> None:
@@ -409,10 +457,19 @@ class Executor:
         waitForExecutionTaskToFinish + maybeReexecuteTasks — re-execution
         happens only when the cluster no longer knows about the
         reassignment, never on a wall-clock timer, so slow transfers are
-        simply waited out)."""
-        snapshot = self._admin.describe_cluster()
+        simply waited out).  Transient admin failures skip the poll
+        (retried next interval) instead of failing the execution."""
+        try:
+            self._poll_inter_broker_once(mgr, in_flight)
+            self._consecutive_poll_failures = 0
+        except Exception as exc:  # noqa: BLE001 - poll is observational
+            self._tolerate_poll_failure("inter-broker", exc)
+
+    def _poll_inter_broker_once(self, mgr: ExecutionTaskManager,
+                                in_flight: List[ExecutionTask]) -> None:
+        snapshot = self._admin_call("describe_cluster")
         reassigning = {r.tp for r in
-                       self._admin.list_partition_reassignments()}
+                       self._admin_call("list_partition_reassignments")}
         alive = snapshot.alive_broker_ids
         now_ms = self._time() * 1000.0
         for task in list(in_flight):
@@ -433,21 +490,21 @@ class Executor:
                 in_flight.remove(task)
             elif any(b not in alive for b in p.replicas_to_add):
                 # a destination broker died: task cannot finish
-                self._admin.alter_partition_reassignments({tp: None})
+                self._admin_call("alter_partition_reassignments", {tp: None})
                 mgr.finish_task(task, TaskState.DEAD, now_ms)
                 in_flight.remove(task)
             elif tp not in reassigning:
                 # the cluster lost the reassignment (e.g. controller
                 # failover): re-submit it
-                self._admin.alter_partition_reassignments(
-                    {tp: new_brokers})
+                self._admin_call("alter_partition_reassignments",
+                                 {tp: new_brokers})
                 task.reexecution_count += 1
             else:
                 age_s = (now_ms - task.start_time_ms) / 1e3
                 if age_s > self._max_lifetime:
                     # absolute lifetime exceeded (reference
                     # max.execution.task.lifetime.ms): cancel + mark dead
-                    self._admin.alter_partition_reassignments({tp: None})
+                    self._admin_call("alter_partition_reassignments", {tp: None})
                     mgr.finish_task(task, TaskState.DEAD, now_ms)
                     in_flight.remove(task)
                 else:
@@ -488,7 +545,7 @@ class Executor:
                             moves.setdefault(tp, {})[r.broker_id] = r.logdir
                 if moves:
                     _t0 = self._time()
-                    self._admin.alter_replica_log_dirs(moves)
+                    self._admin_call("alter_replica_log_dirs", moves)
                     if self._time() - _t0 > self._logdir_timeout_s:
                         LOG.warning(
                             "alter_replica_log_dirs took %.1fs (> "
@@ -502,7 +559,12 @@ class Executor:
             self._check_stop(mgr, in_flight)
             self._sleep(self._check_interval)
             # poll: logdir placement matches the proposal
-            snapshot = self._admin.describe_cluster()
+            try:
+                snapshot = self._admin_call("describe_cluster")
+                self._consecutive_poll_failures = 0
+            except Exception as exc:  # noqa: BLE001 - observational
+                self._tolerate_poll_failure("intra-broker", exc)
+                continue
             alive = snapshot.alive_broker_ids
             now_ms = self._time() * 1000.0
             for task in list(in_flight):
@@ -555,7 +617,7 @@ class Executor:
             # the preferred replica (an in-place same-set reassignment), then
             # trigger preferred-leader election — the modern equivalent of
             # the reference's ZK PLE path (ExecutorUtils.scala:95-101)
-            snapshot = self._admin.describe_cluster()
+            snapshot = self._admin_call("describe_cluster")
             alive = snapshot.alive_broker_ids
             tps = []
             reorders = {}
@@ -574,8 +636,16 @@ class Executor:
                 tps.append(tp)
                 reorders[tp] = want
             if reorders:
-                self._admin.alter_partition_reassignments(reorders)
-                self._admin.elect_preferred_leaders(tps)
+                try:
+                    self._admin_call("alter_partition_reassignments",
+                                     reorders)
+                    self._admin_call("elect_preferred_leaders", tps)
+                except Exception as exc:  # noqa: BLE001 - deadline decides
+                    # the election request failed (transient admin/
+                    # controller trouble): leadership may still land if
+                    # part of the request went through — poll until the
+                    # leader-movement timeout marks the stragglers DEAD
+                    self._tolerate_poll_failure("leadership-submit", exc)
             deadline_ms = (self._time() + self._leader_timeout) * 1000.0
             pending = list(batch)
             while pending:
@@ -591,8 +661,17 @@ class Executor:
                     raise ExecutionStoppedException()
                 self._sleep(min(self._check_interval,
                                 self._leader_timeout / 10.0))
-                snapshot = self._admin.describe_cluster()
                 now_ms = self._time() * 1000.0
+                try:
+                    snapshot = self._admin_call("describe_cluster")
+                    self._consecutive_poll_failures = 0
+                except Exception as exc:  # noqa: BLE001 - observational
+                    self._tolerate_poll_failure("leadership", exc)
+                    if now_ms > deadline_ms:
+                        for task in pending:
+                            mgr.finish_task(task, TaskState.DEAD, now_ms)
+                        pending.clear()
+                    continue
                 alive = snapshot.alive_broker_ids
                 for task in list(pending):
                     p = task.proposal

@@ -25,6 +25,14 @@ from cruise_control_tpu.analyzer.context import (BalancingConstraint,
                                                  OptimizationOptions)
 from cruise_control_tpu.analyzer.goals.registry import (
     DEFAULT_GOAL_ORDER, KAFKA_ASSIGNER_GOAL_ORDER, default_goals, make_goal)
+from cruise_control_tpu.analyzer.degradation import (BackoffPolicy,
+                                                     CircuitBreaker,
+                                                     DegradationLadder,
+                                                     FailureKind,
+                                                     InvalidModelInputError,
+                                                     SolverRung,
+                                                     classify_failure)
+from cruise_control_tpu.analyzer.goals.base import OptimizationFailure
 from cruise_control_tpu.analyzer.optimizer import (GoalOptimizer,
                                                    OptimizerResult)
 from cruise_control_tpu.cluster.admin import ClusterAdminClient
@@ -48,6 +56,7 @@ from cruise_control_tpu.monitor.completeness import (
     ModelCompletenessRequirements)
 from cruise_control_tpu.monitor.load_monitor import LoadMonitor
 from cruise_control_tpu.monitor.sampling.sampler import MetricSampler
+from cruise_control_tpu.utils import faults
 from cruise_control_tpu.utils.metrics import MetricRegistry
 
 LOG = logging.getLogger(__name__)
@@ -157,9 +166,18 @@ class CruiseControl:
                  executor_kwargs: Optional[dict] = None,
                  auto_warmup: bool = True,
                  warm_start_proposals: bool = True,
-                 precompute_eager_hard_abort: bool = False) -> None:
+                 precompute_eager_hard_abort: bool = False,
+                 solver_degradation_enabled: bool = True,
+                 solver_max_retries_per_rung: int = 1,
+                 solver_retry_backoff_base_s: float = 1.0,
+                 solver_retry_backoff_max_s: float = 60.0,
+                 solver_breaker_failure_threshold: int = 3,
+                 solver_breaker_cooldown_s: float = 300.0,
+                 precompute_solve_deadline_s: float = 1800.0) -> None:
         self._admin = admin
         self._time = time_fn or _time.time
+        self._sleep = sleep_fn or _time.sleep
+        self._sampler = sampler
         self._constraint = constraint or BalancingConstraint()
         self._goal_names = list(goal_names or DEFAULT_GOAL_ORDER)
         self._detection_goal_names = list(detection_goal_names
@@ -269,12 +287,47 @@ class CruiseControl:
         self._warm_seed_state = None
         self._precompute_stop = threading.Event()
         self._precompute_thread: Optional[threading.Thread] = None
+        #: solve-deadline watchdog food: wall-clock of the precompute
+        #: solve currently in flight (None when idle).  A solve can wedge
+        #: (device transport hang, runaway compile) and Python cannot
+        #: abort it — the watchdog makes shutdown stop WAITING for it and
+        #: surfaces the wedge through state()/sensors instead
+        self._precompute_solve_started_at: Optional[float] = None
+        self._precompute_solve_deadline_s = precompute_solve_deadline_s
+
+        # solver degradation ladder (analyzer/degradation.py): classify
+        # solve failures, retry with backoff, fall back fused → eager →
+        # host/CPU, trip a breaker pinning the degraded rung until
+        # cooldown.  Shared by request-path and precompute solves so a
+        # background failure protects foreground requests too.
+        self._solver_degradation_enabled = solver_degradation_enabled
+        self._solver_max_retries_per_rung = max(0,
+                                                solver_max_retries_per_rung)
+        self._solver_backoff = BackoffPolicy(
+            base_s=solver_retry_backoff_base_s,
+            max_s=solver_retry_backoff_max_s)
+        self.solver_breaker = CircuitBreaker(
+            failure_threshold=solver_breaker_failure_threshold,
+            cooldown_s=solver_breaker_cooldown_s, time_fn=self._time)
+        self.solver_ladder = DegradationLadder(self.solver_breaker)
 
         # sensors (reference dropwizard registry, SURVEY.md §5.1)
         self.metrics = MetricRegistry(self._time)
         self.metrics.gauge(
             "balancedness-score",
             lambda: self.goal_violation_detector.last_balancedness_score)
+        self.metrics.gauge("solver-rung",
+                           lambda: int(self.solver_ladder.rung))
+        self.metrics.gauge(
+            "solver-breaker-open",
+            lambda: 0.0 if self.solver_breaker.cooldown_remaining_s() == 0.0
+            else 1.0)
+        self.metrics.gauge(
+            "sampler-quarantined-samples",
+            lambda: self.load_monitor.num_quarantined_samples)
+        self.metrics.gauge(
+            "sampler-corrupt-records",
+            lambda: getattr(self._sampler, "num_corrupt_records", 0))
 
     # ------------------------------------------------------------------
     # lifecycle (reference startUp order :178-184)
@@ -299,14 +352,28 @@ class CruiseControl:
     def shutdown(self) -> None:
         self._precompute_stop.set()
         if self._precompute_thread is not None:
-            self._precompute_thread.join(timeout=5.0)
-            if self._precompute_thread.is_alive():
-                # a full proposal solve can run for minutes; it races the
-                # monitor/executor teardown below (its exceptions are
-                # swallowed by precompute_proposals_once) — make the race
-                # visible instead of silent
-                LOG.warning("proposal-precompute still running after 5s "
-                            "join timeout; shutting down around it")
+            started = self._precompute_solve_started_at
+            if self.precompute_wedged() and started is not None:
+                # solve-deadline watchdog: the in-flight solve overran
+                # its deadline (wedged device transport / runaway
+                # compile) — Python cannot abort it, so don't let it
+                # block shutdown either; the daemon thread dies with the
+                # process
+                LOG.error(
+                    "proposal-precompute solve exceeded its %.0fs "
+                    "deadline (started %.0fs ago); shutting down without "
+                    "waiting for it",
+                    self._precompute_solve_deadline_s,
+                    self._time() - started)
+            else:
+                self._precompute_thread.join(timeout=5.0)
+                if self._precompute_thread.is_alive():
+                    # a full proposal solve can run for minutes; it races
+                    # the monitor/executor teardown below (its exceptions
+                    # are swallowed by the precompute pass) — make the
+                    # race visible instead of silent
+                    LOG.warning("proposal-precompute still running after "
+                                "5s join timeout; shutting down around it")
         self.anomaly_detector.shutdown()
         self.broker_failure_detector.shutdown()
         self.executor.stop_execution(force=True)
@@ -323,24 +390,43 @@ class CruiseControl:
         computed.  Skipped while the monitor has no valid windows, while
         an execution is mutating the cluster, or while the cache is still
         valid for the current model generation."""
+        return self._precompute_once_status() == "computed"
+
+    def _precompute_once_status(self) -> str:
+        """'computed' | 'skipped' | 'failed' — the loop backs off only on
+        FAILURES, never on the routine skips (cache warm, monitor not
+        ready, execution in flight)."""
         if not self._monitor_ready():
-            return False
+            return "skipped"
         if self.executor.has_ongoing_execution:
-            return False
+            return "skipped"
         generation = self.load_monitor.model_generation()
         with self._cache_lock:
             if self._cache_valid(generation):
-                return False
+                return "skipped"
+        self._precompute_solve_started_at = self._time()
         try:
+            faults.inject("facade.precompute")
             self.optimizations(
                 _allow_capacity_estimation=(
                     self._allow_capacity_estimation_precompute),
                 _eager_hard_abort=(True if self._precompute_eager_hard_abort
                                    else None))
-            return True
+            return "computed"
         except Exception as exc:  # noqa: BLE001 - keep the loop alive
-            LOG.warning("proposal precompute failed: %s", exc)
-            return False
+            LOG.warning("proposal precompute failed (%s): %s",
+                        classify_failure(exc).value, exc)
+            return "failed"
+        finally:
+            self._precompute_solve_started_at = None
+
+    def precompute_wedged(self) -> bool:
+        """True when the in-flight precompute solve has overrun its
+        deadline (watchdog verdict; shutdown stops waiting for it)."""
+        started = self._precompute_solve_started_at
+        return (started is not None
+                and self._time() - started
+                > self._precompute_solve_deadline_s)
 
     def _precompute_loop(self) -> None:
         # first pass immediately: waiting a full interval before the first
@@ -348,10 +434,23 @@ class CruiseControl:
         # startup (the reference's GoalOptimizer.run computes on entry).
         # The stop check matters: shutdown right after start_up must not
         # launch a minutes-long solve it then races.
+        consecutive_failures = 0
         if not self._precompute_stop.is_set():
-            self.precompute_proposals_once()
-        while not self._precompute_stop.wait(self._precompute_interval_s):
-            self.precompute_proposals_once()
+            if self._precompute_once_status() == "failed":
+                consecutive_failures = 1
+        while True:
+            # failures back off exponentially (capped at 32 intervals):
+            # the seed behavior retried a failing solve every interval
+            # forever, re-paying a doomed compile each time
+            delay = self._precompute_interval_s * min(
+                2 ** consecutive_failures, 32)
+            if self._precompute_stop.wait(delay):
+                return
+            status = self._precompute_once_status()
+            if status == "failed":
+                consecutive_failures += 1
+            else:
+                consecutive_failures = 0
 
     # ------------------------------------------------------------------
     # detector wiring (self-healing fix runnables, SURVEY.md §3.5)
@@ -496,7 +595,9 @@ class CruiseControl:
         agg = self.load_monitor.broker_aggregator
         try:
             history = agg.aggregate(-np.inf, np.inf).entity_values
-        except Exception:  # noqa: BLE001 - warm-up
+        except Exception as exc:  # noqa: BLE001 - warm-up
+            LOG.debug("broker metric history unavailable (warm-up): %s",
+                      exc)
             return {}, {}
         current = agg.peek_current_window()
         return history, current
@@ -538,19 +639,9 @@ class CruiseControl:
         optimizer = (self.goal_optimizer if goals is None
                      else GoalOptimizer(default_goals(names=list(goals)),
                                         self._constraint))
-        state, topo = self.cluster_model(
-            allow_capacity_estimation=_allow_capacity_estimation)
-        warm = None
-        if cacheable and self._warm_start_enabled:
-            with self._cache_lock:
-                seed = self._warm_seed_state
-            if seed is not None and _warm_start_compatible(seed, state):
-                warm = seed
-        with self.metrics.timer("proposal-computation-timer").time():
-            result = optimizer.optimizations(
-                state, topo, self._options_generator.generate(
-                    options or OptimizationOptions(), topo),
-                warm_start=warm, eager_hard_abort=_eager_hard_abort)
+        result = self._solve_with_ladder(optimizer, cacheable, options,
+                                         _allow_capacity_estimation,
+                                         _eager_hard_abort)
         from cruise_control_tpu.utils import profiling
         prof = profiling.active()
         if prof is not None and profiling.enabled():
@@ -582,6 +673,143 @@ class CruiseControl:
         with self._cache_lock:
             self._cached_result = None
             self._cache_epoch += 1
+
+    # ------------------------------------------------------------------
+    # solver degradation ladder (analyzer/degradation.py)
+    # ------------------------------------------------------------------
+    def _materialize_solve_inputs(self, cacheable: bool,
+                                  allow_capacity_estimation):
+        """(state, topology, warm seed) for ONE solve attempt.
+
+        Called per ATTEMPT, not per request: a failed attempt may have
+        consumed its inputs (the goal programs donate the inter-goal
+        ClusterState/RoundCache buffers on non-CPU backends, so a fault
+        mid-pipeline leaves them invalidated) — the retry re-materializes
+        everything from the host-side model, which is why a retried solve
+        matches the fault-free result bit-for-bit (chaos pin,
+        tests/test_chaos.py)."""
+        state, topo = self.cluster_model(
+            allow_capacity_estimation=allow_capacity_estimation)
+        warm = None
+        if cacheable and self._warm_start_enabled:
+            with self._cache_lock:
+                seed = self._warm_seed_state
+            if seed is not None and _warm_start_compatible(seed, state):
+                warm = seed
+        return state, topo, warm
+
+    def _solve_on_rung(self, rung: SolverRung, optimizer: GoalOptimizer,
+                       cacheable: bool, options, allow_capacity_estimation,
+                       eager_hard_abort) -> OptimizerResult:
+        state, topo, warm = self._materialize_solve_inputs(
+            cacheable, allow_capacity_estimation)
+        gen_options = self._options_generator.generate(
+            options or OptimizationOptions(), topo)
+        with self.metrics.timer("proposal-computation-timer").time():
+            if rung is SolverRung.FUSED:
+                return optimizer.optimizations(
+                    state, topo, gen_options, warm_start=warm,
+                    eager_hard_abort=eager_hard_abort)
+            if rung is SolverRung.EAGER:
+                # one goal per program + eager hard-abort sync: smaller
+                # programs survive segment-level compile failures and
+                # localize device faults (degradation.SolverRung.EAGER)
+                return optimizer.optimizations(
+                    state, topo, gen_options, warm_start=warm,
+                    eager_hard_abort=True, eager_driver=True)
+            # bottom rung: numpy-only self-healing repair, zero XLA
+            # dispatch (balance goals stand down; broker-level exclusions
+            # from the request options still hold — host_fallback_solve)
+            from cruise_control_tpu.model.cpu_model import \
+                host_fallback_solve
+            return host_fallback_solve(state, topo, options=gen_options,
+                                       time_fn=self._time)
+
+    def _solve_with_ladder(self, optimizer: GoalOptimizer, cacheable: bool,
+                           options, allow_capacity_estimation,
+                           eager_hard_abort) -> OptimizerResult:
+        """Run one solve request through the degradation ladder: retry
+        with exponential backoff + jitter on the entry rung, descend
+        fused → eager → CPU when a rung exhausts its retries, and let the
+        breaker pin the degraded rung until cooldown.
+
+        NOT ladder material: OptimizationFailure (a legitimate solver
+        verdict — unsatisfiable hard goal, stats regression — identical
+        at every rung) and InvalidModelInputError (garbage in, garbage
+        at every rung; quarantine starves the source) both propagate
+        immediately."""
+        if not self._solver_degradation_enabled:
+            return self._solve_on_rung(SolverRung.FUSED, optimizer,
+                                       cacheable, options,
+                                       allow_capacity_estimation,
+                                       eager_hard_abort)
+        rung = self.solver_ladder.entry_rung()
+        delays = self._solver_backoff.delays()
+        attempts_on_rung = 0
+        while True:
+            try:
+                result = self._solve_on_rung(rung, optimizer, cacheable,
+                                             options,
+                                             allow_capacity_estimation,
+                                             eager_hard_abort)
+            except (OptimizationFailure, InvalidModelInputError) as exc:
+                if isinstance(exc, InvalidModelInputError):
+                    self.metrics.meter("solver-invalid-input").mark()
+                raise
+            except Exception as exc:  # noqa: BLE001 - ladder classifies
+                kind = classify_failure(exc)
+                tripped = self.solver_ladder.on_failure(rung)
+                LOG.warning("solve failed at rung %s (%s): %s", rung.name,
+                            kind.value, exc)
+                if tripped:
+                    # the breaker just opened: the degraded rung is now
+                    # pinned until cooldown — report the transition the
+                    # moment it happens, not at the next descent
+                    self._report_solver_degraded(rung,
+                                                 self.solver_ladder.rung,
+                                                 kind, exc, True)
+                attempts_on_rung += 1
+                if attempts_on_rung <= self._solver_max_retries_per_rung:
+                    self.metrics.meter("solver-retries").mark()
+                    self._sleep(next(delays))
+                    continue
+                nxt = self.solver_ladder.descend(rung)
+                if nxt is None:
+                    # the bottom rung failed: nothing left to degrade to
+                    if not tripped:
+                        self._report_solver_degraded(rung, None, kind, exc,
+                                                     False)
+                    raise
+                self.metrics.meter("solver-descents").mark()
+                if not tripped:
+                    self._report_solver_degraded(rung, nxt, kind, exc,
+                                                 False)
+                rung = nxt
+                attempts_on_rung = 0
+                continue
+            self.solver_ladder.on_success(rung)
+            if rung is not SolverRung.FUSED:
+                LOG.info("solve served from degraded rung %s", rung.name)
+            return result
+
+    def _report_solver_degraded(self, from_rung: SolverRung,
+                                to_rung: Optional[SolverRung],
+                                kind: FailureKind, exc: BaseException,
+                                breaker_tripped: bool) -> None:
+        """Emit a SolverDegraded anomaly through the detector plane so
+        the configured notifier (webhook, self-healing) sees solver
+        trouble exactly like cluster trouble."""
+        from cruise_control_tpu.detector.anomalies import SolverDegraded
+        try:
+            self.anomaly_detector.report(SolverDegraded(
+                from_rung=from_rung.name,
+                to_rung=to_rung.name if to_rung is not None else None,
+                failure_kind=kind.value,
+                breaker_tripped=breaker_tripped,
+                description=f"{type(exc).__name__}: {exc}",
+                detected_ms=self._time() * 1000.0))
+        except Exception:  # noqa: BLE001 - reporting must not mask exc
+            LOG.exception("failed to report SolverDegraded anomaly")
 
     # ------------------------------------------------------------------
     # POST operations (reference servlet/handler/async runnables)
@@ -798,6 +1026,13 @@ class CruiseControl:
                 "isProposalReady": cached is not None,
                 "goals": self._goal_names,
                 "readyGoals": self._goal_names if cached is not None else [],
+                # degradation ladder + breaker (the operator's first stop
+                # when solves degrade): current rung, descent count,
+                # breaker state/cooldown, precompute watchdog verdict
+                "solverDegradation": {
+                    **self.solver_ladder.to_json(),
+                    "precomputeWedged": self.precompute_wedged(),
+                },
             }
         if "anomaly_detector" in want:
             out["AnomalyDetectorState"] = self.anomaly_detector.to_json()

@@ -285,6 +285,20 @@ def build_cruise_control(config: CruiseControlConfig, admin,
             "proposal.precompute.interval.ms") / 1e3,
         warm_start_proposals=config.get_boolean(
             "proposal.warm.start.enabled"),
+        solver_degradation_enabled=config.get_boolean(
+            "solver.degradation.enabled"),
+        solver_max_retries_per_rung=config.get_int(
+            "solver.max.retries.per.rung"),
+        solver_retry_backoff_base_s=config.get_long(
+            "solver.retry.backoff.base.ms") / 1e3,
+        solver_retry_backoff_max_s=config.get_long(
+            "solver.retry.backoff.max.ms") / 1e3,
+        solver_breaker_failure_threshold=config.get_int(
+            "solver.circuit.breaker.failure.threshold"),
+        solver_breaker_cooldown_s=config.get_long(
+            "solver.circuit.breaker.cooldown.ms") / 1e3,
+        precompute_solve_deadline_s=config.get_long(
+            "proposal.precompute.solve.deadline.ms") / 1e3,
         monitor_kwargs=dict(
             sample_store=sample_store,
             num_windows=config.get_int("num.partition.metrics.windows"),

@@ -1,4 +1,6 @@
-"""Trainable linear CPU-estimation model.
+"""CPU-side models: the trainable linear CPU-estimation model and the
+host-side (numpy) fallback solver — the bottom rung of the solver
+degradation ladder.
 
 Reference CC/model/LinearRegressionModelParameters.java:27-374 +
 ModelParameters / ModelUtils.java:41-70: broker CPU utilization is modeled
@@ -9,11 +11,19 @@ CPU attribution in the workload model.
 
 Re-design: instead of the reference's bucketed incremental accumulation,
 training is one batched least-squares solve over the full sample matrix
-(numpy lstsq — the matrix is [samples × 3], tiny)."""
+(numpy lstsq — the matrix is [samples × 3], tiny).
+
+`host_fallback_solve` (new in PR 2) is the degraded-mode solver the
+facade falls back to when both device rungs (fused pipeline, eager
+per-goal driver) are failing: pure numpy, zero XLA dispatch, and scoped
+to the one thing that must never be unavailable — relocating offline
+replicas off dead brokers/disks so self-healing keeps working while the
+device solver recovers (analyzer/degradation.py)."""
 from __future__ import annotations
 
 import dataclasses
 import threading
+import time as _time
 from typing import Optional
 
 import numpy as np
@@ -144,3 +154,228 @@ class LinearRegressionCpuModel:
                 + coefs.leader_bytes_out * rows[:, 2]
                 + coefs.follower_bytes_in * rows[:, 3])
         return float(np.sqrt(np.mean((pred - rows[:, 0]) ** 2)))
+
+
+# ---------------------------------------------------------------------------
+# Host-side fallback solver (degradation-ladder bottom rung)
+# ---------------------------------------------------------------------------
+
+
+def _leader_bonus_rows(part, bonus):
+    """bonus[part] with jnp-style clamping: padding replica rows may
+    carry out-of-range partition ids (device indexing clamps, numpy
+    raises) and a windowless model can have zero partitions."""
+    if bonus.shape[0] == 0:
+        return np.zeros((part.shape[0], bonus.shape[1]))
+    return bonus[np.minimum(part, bonus.shape[0] - 1)]
+
+
+def _host_stats(valid, part, broker, leader, base_load, bonus, cap, alive,
+                topic_of_partition, num_topics, offline):
+    """numpy mirror of model/stats._stats_from over host arrays — honest
+    (if approximate-free) statistics for the fallback OptimizerResult so
+    STATE/PROPOSALS responses render normally in degraded mode."""
+    from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+    from cruise_control_tpu.model.stats import ClusterModelStats
+
+    num_brokers = cap.shape[0]
+    load_r = (base_load + leader[:, None]
+              * _leader_bonus_rows(part, bonus)) * valid[:, None]
+    bload = np.zeros((num_brokers, NUM_RESOURCES), dtype=np.float64)
+    np.add.at(bload, broker[valid], load_r[valid])
+    util = bload / np.maximum(cap, 1e-9)
+
+    def masked(values):
+        count = max(int(alive.sum()), 1)
+        sel = values[alive] if alive.any() else np.zeros(1)
+        avg = float(values[alive].sum()) / count if alive.any() else 0.0
+        var = float(((sel - avg) ** 2).sum()) / count
+        return (np.float32(avg), np.float32(sel.max(initial=-np.inf)),
+                np.float32(sel.min(initial=np.inf)),
+                np.float32(np.sqrt(var)))
+
+    avg = np.zeros(NUM_RESOURCES, np.float32)
+    vmax = np.zeros(NUM_RESOURCES, np.float32)
+    vmin = np.zeros(NUM_RESOURCES, np.float32)
+    vstd = np.zeros(NUM_RESOURCES, np.float32)
+    for res in range(NUM_RESOURCES):
+        avg[res], vmax[res], vmin[res], vstd[res] = masked(util[:, res])
+
+    rcount = np.zeros(num_brokers, dtype=np.float64)
+    np.add.at(rcount, broker[valid], 1.0)
+    lcount = np.zeros(num_brokers, dtype=np.float64)
+    np.add.at(lcount, broker[valid & leader], 1.0)
+    rc = masked(rcount)
+    lc = masked(lcount)
+
+    tcount = np.zeros((num_brokers, max(num_topics, 1)), dtype=np.float64)
+    if valid.any() and topic_of_partition.shape[0]:
+        topic_rows = topic_of_partition[np.minimum(
+            part[valid], topic_of_partition.shape[0] - 1)]
+        np.add.at(tcount, (broker[valid], topic_rows), 1.0)
+    n_alive = max(int(alive.sum()), 1)
+    t_avg = tcount[alive].sum(axis=0) / n_alive
+    t_var = ((tcount[alive] - t_avg[None, :]) ** 2).sum(axis=0) / n_alive
+    topic_std = np.float32(np.sqrt(t_var).mean())
+
+    pot = np.zeros(num_brokers, dtype=np.float64)
+    nw_out_as_leader = ((base_load[:, Resource.NW_OUT]
+                         + _leader_bonus_rows(part, bonus)[:,
+                                              Resource.NW_OUT]) * valid)
+    np.add.at(pot, broker[valid], nw_out_as_leader[valid])
+    pot_sel = pot[alive] if alive.any() else np.zeros(1)
+
+    return ClusterModelStats(
+        util_avg=avg, util_max=vmax, util_min=vmin, util_std=vstd,
+        replica_count_avg=rc[0], replica_count_max=rc[1],
+        replica_count_min=rc[2], replica_count_std=rc[3],
+        leader_count_std=lc[3], topic_replica_count_std=topic_std,
+        potential_nw_out_max=np.float32(pot_sel.max(initial=-np.inf)),
+        potential_nw_out_total=np.float32(float((pot * alive).sum())),
+        num_alive_brokers=np.int32(alive.sum()),
+        num_replicas=np.int32(valid.sum()),
+        num_offline_replicas=np.int32((valid & offline).sum()))
+
+
+def host_fallback_solve(state, topology, options=None, time_fn=None):
+    """Degraded-mode solve: numpy-only self-healing placement repair.
+
+    The bottom rung of the solver degradation ladder
+    (analyzer/degradation.py SolverRung.CPU): every offline replica
+    (dead broker / broken disk) moves to the least-DISK-utilized alive
+    broker that does not already hold its partition and has capacity
+    headroom, leadership traveling with the replica.  No balance goals
+    run — the contract is availability (self-healing never goes down
+    with the device solver), not balance; the ladder climbs back to the
+    device rungs as soon as they heal.
+
+    `options` (OptimizationOptions) is honored at the broker level
+    exactly like the device self-healing pre-pass: destinations exclude
+    `excluded_brokers_for_replica_move` and respect
+    `requested_destination_broker_ids`.  Offline replicas of EXCLUDED
+    TOPICS still move — the device heal pass moves them too (an offline
+    replica must relocate regardless of topic policy).
+
+    Returns a normal OptimizerResult (honest numpy stats, empty per-goal
+    tables, rounds under ``__host_fallback__``) so callers — PROPOSALS
+    responses, the executor, the proposal cache — are rung-agnostic.
+    """
+    from cruise_control_tpu.analyzer.context import partition_replica_index
+    from cruise_control_tpu.analyzer.goals.base import OptimizationFailure
+    from cruise_control_tpu.analyzer.optimizer import OptimizerResult
+    from cruise_control_tpu.analyzer.proposals import diff_proposals
+    from cruise_control_tpu.common.resources import Resource
+
+    t0 = (time_fn or _time.time)()
+    valid = np.asarray(state.replica_valid)
+    part = np.asarray(state.replica_partition)
+    broker = np.array(np.asarray(state.replica_broker))
+    disk = np.array(np.asarray(state.replica_disk))
+    leader = np.asarray(state.replica_is_leader)
+    offline = np.array(np.asarray(state.replica_offline))
+    base_load = np.asarray(state.replica_base_load, dtype=np.float64)
+    bonus = np.asarray(state.partition_leader_bonus, dtype=np.float64)
+    alive = np.asarray(state.broker_alive)
+    cap = np.asarray(state.broker_capacity, dtype=np.float64)
+    disk_broker = np.asarray(state.disk_broker)
+    disk_alive = np.asarray(state.disk_alive)
+    disk_cap = np.asarray(state.disk_capacity, dtype=np.float64)
+    topic_of_partition = np.asarray(state.partition_topic)
+
+    if not np.isfinite(base_load).all() or (base_load < 0).any() \
+            or not np.isfinite(cap).all() or (cap < 0).any():
+        from cruise_control_tpu.analyzer.degradation import \
+            InvalidModelInputError
+        raise InvalidModelInputError(
+            "cluster model carries NaN/Inf/negative loads or capacities "
+            "(host-side validity sweep)")
+
+    stats_before = _host_stats(valid, part, broker, leader, base_load,
+                               bonus, cap, alive, topic_of_partition,
+                               state.num_topics, offline)
+
+    # broker-level destination policy (mirrors make_context's
+    # broker_dest_ok): operator exclusions hold even in degraded mode
+    broker_ids = np.asarray(topology.broker_ids)
+    dest_ok = alive.copy()
+    if options is not None:
+        excluded = set(options.excluded_brokers_for_replica_move or ())
+        requested = set(options.requested_destination_broker_ids or ())
+        for i, ext in enumerate(broker_ids.tolist()):
+            if ext in excluded or (requested and ext not in requested):
+                dest_ok[i] = False
+
+    load_r = (base_load + leader[:, None]
+              * _leader_bonus_rows(part, bonus)) * valid[:, None]
+    bload = np.zeros_like(cap)
+    np.add.at(bload, broker[valid], load_r[valid])
+    dload = np.zeros(max(state.num_disks, 1), dtype=np.float64)
+    on_disk = valid & (disk >= 0)
+    np.add.at(dload, np.maximum(disk[on_disk], 0),
+              load_r[on_disk][:, Resource.DISK])
+
+    # partition -> brokers currently holding it (no-duplicate constraint)
+    pr_rows = partition_replica_index(state)
+    holders = [set(broker[r] for r in row if r >= 0 and valid[r])
+               for row in pr_rows]
+
+    to_heal = np.nonzero(valid & offline)[0]
+    moved = 0
+    unplaced = 0
+    for r in to_heal:
+        need = load_r[r]
+        p = int(part[r])
+        candidates = [b for b in np.nonzero(dest_ok)[0]
+                      if b not in holders[p]
+                      and np.all(bload[b] + need <= cap[b])]
+        if not candidates:
+            unplaced += 1
+            continue
+        dest = min(candidates,
+                   key=lambda b: bload[b, Resource.DISK]
+                   / max(cap[b, Resource.DISK], 1e-9))
+        holders[p].discard(int(broker[r]))
+        holders[p].add(int(dest))
+        bload[int(broker[r])] -= need
+        bload[dest] += need
+        broker[r] = dest
+        if state.num_disks > 0 and disk[r] >= 0:
+            # JBOD-tracked replica: land it on the destination's least-
+            # utilized alive logdir (a replica without a logdir stays
+            # logdir-less — the model isn't tracking disks for it)
+            dests = [d for d in np.nonzero(disk_alive)[0]
+                     if disk_broker[d] == dest]
+            if dests:
+                best = min(dests, key=lambda d: dload[d]
+                           / max(disk_cap[d], 1e-9))
+                dload[disk[r]] -= need[Resource.DISK]
+                dload[best] += need[Resource.DISK]
+                disk[r] = best
+        offline[r] = False
+        moved += 1
+    if unplaced:
+        raise OptimizationFailure(
+            f"host fallback could not relocate {unplaced} offline "
+            f"replicas (insufficient capacity or eligible brokers)")
+
+    final_state = state.replace(
+        replica_broker=broker.astype(np.int32),
+        replica_disk=disk.astype(np.int32),
+        replica_offline=offline)
+    stats_after = _host_stats(valid, part, broker, leader, base_load,
+                              bonus, cap, alive, topic_of_partition,
+                              state.num_topics, offline)
+    proposals = diff_proposals(state, final_state, topology, pr_rows)
+    return OptimizerResult(
+        proposals=proposals,
+        stats_before=stats_before,
+        stats_after=stats_after,
+        stats_by_goal={},
+        violated_goals_before=[],
+        violated_goals_after=[],
+        regressed_goals=[],
+        final_state=final_state,
+        duration_s=(time_fn or _time.time)() - t0,
+        violated_broker_counts={},
+        rounds_by_goal={"__host_fallback__": moved},
+    )

@@ -204,6 +204,13 @@ class LoadMonitor:
     # accessors
     # ------------------------------------------------------------------
     @property
+    def num_quarantined_samples(self) -> int:
+        """Samples dropped by the ingest quarantine (NaN/Inf/negative
+        values) — exported by the facade as the
+        `sampler-quarantined-samples` sensor."""
+        return self._fetcher.num_quarantined_samples
+
+    @property
     def metadata(self) -> MetadataClient:
         return self._metadata
 

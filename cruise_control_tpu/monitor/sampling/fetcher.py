@@ -16,10 +16,12 @@ from typing import List, Optional, Sequence, Set
 from cruise_control_tpu.cluster.types import ClusterSnapshot, TopicPartition
 from cruise_control_tpu.monitor.aggregators import (
     BrokerMetricSampleAggregator, PartitionMetricSampleAggregator)
+from cruise_control_tpu.monitor.sampling.holder import quarantine_invalid
 from cruise_control_tpu.monitor.sampling.sample_store import SampleStore
 from cruise_control_tpu.monitor.sampling.sampler import (MetricSampler,
                                                          Samples,
                                                          SamplingMode)
+from cruise_control_tpu.utils import faults
 
 LOG = logging.getLogger(__name__)
 
@@ -78,6 +80,9 @@ class MetricFetcherManager:
         # sampling stats for the REST state endpoint
         self.last_sampling_ms: float = 0.0
         self.last_sampling_duration_s: float = 0.0
+        #: samples dropped by the ingest quarantine (NaN/Inf/negative
+        #: values; holder.quarantine_invalid) — data loss made visible
+        self.num_quarantined_samples: int = 0
 
     def fetch_metrics_for_model(self, cluster: ClusterSnapshot,
                                 start_ms: float, end_ms: float,
@@ -104,21 +109,38 @@ class MetricFetcherManager:
                 continue   # fetcher 0 already covers all broker metrics
             else:
                 m = SamplingMode.PARTITION_METRICS_ONLY
-            futures.append(self._pool.submit(
-                self._sampler.get_samples, cluster, bucket, start_ms,
-                end_ms, m))
+            def fetch_one(bucket=bucket, m=m):
+                faults.inject("monitor.sampler.fetch")
+                return self._sampler.get_samples(cluster, bucket, start_ms,
+                                                 end_ms, m)
+            futures.append(self._pool.submit(fetch_one))
         for fut in futures:
             try:
                 merged.merge(fut.result(timeout=self._timeout_s))
             except Exception:  # noqa: BLE001 - sampler is a plugin
                 LOG.exception("metric sampler failed; continuing with "
                               "partial samples")
+        # ingest quarantine: a NaN/Inf/negative value admitted into a
+        # window poisons every model built from it — drop the sample
+        # here, behind a counter, instead (holder.quarantine_invalid)
+        merged.partition_samples, dropped_p = quarantine_invalid(
+            merged.partition_samples)
+        merged.broker_samples, dropped_b = quarantine_invalid(
+            merged.broker_samples)
+        if dropped_p or dropped_b:
+            self.num_quarantined_samples += dropped_p + dropped_b
+            LOG.warning(
+                "ingest quarantine dropped %d partition and %d broker "
+                "samples carrying NaN/Inf/negative values (%d total this "
+                "process)", dropped_p, dropped_b,
+                self.num_quarantined_samples)
         n_p = self._partition_aggregator.add_partition_samples(
             merged.partition_samples)
         n_b = self._broker_aggregator.add_broker_samples(
             merged.broker_samples)
         if self._sample_store is not None:
             try:
+                faults.inject("monitor.sampler.store")
                 self._sample_store.store_samples(merged)
             except Exception:  # noqa: BLE001 - store is a plugin
                 LOG.exception("sample store failed to persist samples")

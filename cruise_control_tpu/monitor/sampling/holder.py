@@ -1,15 +1,24 @@
-"""Metric sample holders + binary serde.
+"""Metric sample holders + binary serde + ingest quarantine.
 
 Reference: CC/monitor/sampling/holder/PartitionMetricSample.java and
 BrokerMetricSample.java:1-359 — the typed sample objects built by the
 metrics processor, persisted by the sample store (binary serde with a
 version byte), and fed to the windowed aggregators.
-"""
+
+The quarantine (new in PR 2) is the INGEST half of the solver's
+invalid-input defense: a NaN/Inf/negative metric value admitted into a
+window poisons every model built from it, and the device-resident solve
+only detects the damage at its end-of-solve fetch
+(analyzer/optimizer.inputs_invalid).  Dropping the offending sample here
+— behind a counter so data loss is visible — keeps the model clean at
+the source; the device-side sweep remains as the last line for values
+corrupted past ingest."""
 from __future__ import annotations
 
 import dataclasses
+import math
 import struct
-from typing import Dict, Mapping
+from typing import Dict, Iterable, List, Mapping, Tuple
 
 from cruise_control_tpu.cluster.types import TopicPartition
 from cruise_control_tpu.core.aggregator import MetricSample
@@ -102,6 +111,31 @@ class BrokerMetricSample:
             off += _METRIC.size
             values[mid] = val
         return cls(broker_id, float(time_ms), values)
+
+
+def sample_values_valid(values: Mapping[int, float]) -> bool:
+    """True when every metric value is finite and non-negative (all the
+    framework's metrics are rates/sizes/percentages — a negative value is
+    as corrupt as a NaN)."""
+    for v in values.values():
+        if not math.isfinite(v) or v < 0.0:
+            return False
+    return True
+
+
+def quarantine_invalid(samples: Iterable) -> Tuple[List, int]:
+    """Split a batch of Partition/BrokerMetricSamples into (valid,
+    dropped-count); the caller owns the counting (the fetcher keeps the
+    per-process counter the facade exports as
+    `sampler-quarantined-samples`)."""
+    valid = []
+    dropped = 0
+    for s in samples:
+        if sample_values_valid(s.values):
+            valid.append(s)
+        else:
+            dropped += 1
+    return valid, dropped
 
 
 def complete_partition_values(partial: Mapping[int, float]) -> Dict[int, float]:

@@ -1,0 +1,195 @@
+"""Deterministic fault-injection harness.
+
+The self-healing discipline the framework applies to Kafka clusters
+(detect → degrade → recover → report) is applied to the solver itself by
+PR 2; proving that discipline needs REPRODUCIBLE faults.  This module
+provides named injection sites compiled into the hot paths — optimizer
+compile/execute, facade precompute, monitor sampler fetch/store, executor
+admin calls — that are inert (one None check) unless a test or a chaos
+sweep installs a `FaultPlan`.
+
+Scripting surface:
+
+    plan = FaultPlan(seed=7)
+    plan.fail_nth("optimizer.execute", 1)            # fail the 1st call
+    plan.fail_nth("executor.admin.describe_cluster", (2, 3))
+    plan.fail_probability("monitor.sampler.fetch", 0.25)  # seeded RNG
+    plan.fail_always("optimizer.compile", until=4)   # calls 1-4 fail
+    with faults.injected(plan):
+        ...
+
+Every injected exception is a `FaultError` carrying its `.site`, so the
+degradation ladder's failure classifier can bucket scripted faults by the
+layer they hit (compile vs runtime vs I/O) exactly as it buckets real
+ones.  Sites self-register on first `inject()` so `known_sites()` reports
+the wired surface; per-site call and failure counts make scenario
+assertions exact.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import random
+import threading
+from typing import Dict, Iterable, Optional, Tuple, Union
+
+#: every site that executed at least one inject() in this process —
+#: the live map of where faults CAN be injected
+_KNOWN_SITES: set = set()
+_KNOWN_LOCK = threading.Lock()
+
+
+class FaultError(RuntimeError):
+    """An injected fault.  `site` names the injection point so failure
+    classification can treat a scripted compile fault exactly like a real
+    compiler error."""
+
+    def __init__(self, site: str, message: str = "") -> None:
+        super().__init__(message or f"injected fault at {site}")
+        self.site = site
+
+
+@dataclasses.dataclass
+class _SiteRule:
+    fail_calls: frozenset = frozenset()      # 1-based call numbers
+    fail_until: int = 0                      # calls 1..fail_until fail
+    probability: float = 0.0
+    exc_factory: Optional[object] = None     # callable(site) -> Exception
+
+
+class FaultPlan:
+    """A deterministic script of faults, keyed by site name."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self._rules: Dict[str, _SiteRule] = {}
+        self._rng = random.Random(seed)
+
+    def _rule(self, site: str) -> _SiteRule:
+        return self._rules.setdefault(site, _SiteRule())
+
+    def fail_nth(self, site: str, nth: Union[int, Iterable[int]],
+                 exc_factory=None) -> "FaultPlan":
+        """Fail the nth call (1-based), or each call in an iterable."""
+        calls = frozenset((nth,) if isinstance(nth, int) else nth)
+        rule = self._rule(site)
+        rule.fail_calls = rule.fail_calls | calls
+        if exc_factory is not None:
+            rule.exc_factory = exc_factory
+        return self
+
+    def fail_always(self, site: str, until: Optional[int] = None,
+                    exc_factory=None) -> "FaultPlan":
+        """Fail every call, or calls 1..until when `until` is given."""
+        rule = self._rule(site)
+        rule.fail_until = (2 ** 31 if until is None else int(until))
+        if exc_factory is not None:
+            rule.exc_factory = exc_factory
+        return self
+
+    def fail_probability(self, site: str, p: float,
+                         exc_factory=None) -> "FaultPlan":
+        """Fail each call with probability p (seeded — reruns of the same
+        plan over the same call sequence reproduce the same faults)."""
+        rule = self._rule(site)
+        rule.probability = float(p)
+        if exc_factory is not None:
+            rule.exc_factory = exc_factory
+        return self
+
+    def should_fail(self, site: str, call_number: int) -> bool:
+        rule = self._rules.get(site)
+        if rule is None:
+            return False
+        if call_number in rule.fail_calls or call_number <= rule.fail_until:
+            return True
+        return rule.probability > 0.0 \
+            and self._rng.random() < rule.probability
+
+    def exception_for(self, site: str) -> BaseException:
+        rule = self._rules.get(site)
+        if rule is not None and rule.exc_factory is not None:
+            return rule.exc_factory(site)
+        return FaultError(site)
+
+
+class FaultInjector:
+    """An installed plan plus per-site call/failure counters."""
+
+    def __init__(self, plan: FaultPlan) -> None:
+        self._plan = plan
+        self._lock = threading.Lock()
+        self._calls: Dict[str, int] = {}
+        self._failures: Dict[str, int] = {}
+
+    def fire(self, site: str) -> None:
+        with self._lock:
+            n = self._calls.get(site, 0) + 1
+            self._calls[site] = n
+            fail = self._plan.should_fail(site, n)
+            if fail:
+                self._failures[site] = self._failures.get(site, 0) + 1
+        if fail:
+            raise self._plan.exception_for(site)
+
+    def call_count(self, site: str) -> int:
+        with self._lock:
+            return self._calls.get(site, 0)
+
+    def failure_count(self, site: str) -> int:
+        with self._lock:
+            return self._failures.get(site, 0)
+
+    def counts(self) -> Dict[str, Tuple[int, int]]:
+        """{site: (calls, failures)} for every site that fired."""
+        with self._lock:
+            return {s: (c, self._failures.get(s, 0))
+                    for s, c in sorted(self._calls.items())}
+
+
+#: the process-wide active injector (None = harness inert)
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def inject(site: str) -> None:
+    """The injection point: a no-op unless a plan is installed.  Called
+    from production code; the only cost on the happy path is one global
+    read (plus first-call site registration)."""
+    if site not in _KNOWN_SITES:
+        with _KNOWN_LOCK:
+            _KNOWN_SITES.add(site)
+    injector = _ACTIVE
+    if injector is not None:
+        injector.fire(site)
+
+
+def known_sites() -> set:
+    """Sites that executed at least once in this process."""
+    with _KNOWN_LOCK:
+        return set(_KNOWN_SITES)
+
+
+def install(plan: FaultPlan) -> FaultInjector:
+    """Install a plan process-wide; returns the injector for counters."""
+    global _ACTIVE
+    injector = FaultInjector(plan)
+    _ACTIVE = injector
+    return injector
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def injected(plan: FaultPlan):
+    """Scoped installation: `with faults.injected(plan) as injector:`."""
+    injector = install(plan)
+    try:
+        yield injector
+    finally:
+        uninstall()

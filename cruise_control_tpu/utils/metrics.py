@@ -10,10 +10,13 @@ as JSON through the STATE endpoint's `sensors` substate.
 """
 from __future__ import annotations
 
+import logging
 import math
 import threading
 import time as _time
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
+
+LOG = logging.getLogger(__name__)
 
 
 class Counter:
@@ -121,13 +124,23 @@ class _TimerContext:
 
 
 class Gauge:
-    def __init__(self, fn: Callable[[], float]) -> None:
+    def __init__(self, fn: Callable[[], float],
+                 on_error: Optional[Callable] = None,
+                 name: str = "") -> None:
         self._fn = fn
+        self._on_error = on_error
+        self._name = name
 
     def to_json(self) -> dict:
         try:
             return {"type": "gauge", "value": self._fn()}
-        except Exception:  # noqa: BLE001 - gauges must never break export
+        except Exception as exc:  # noqa: BLE001 - never break export
+            # a broken gauge callable must not break the whole sensor
+            # export, but silence hid real wiring bugs: the registry
+            # counts it (sensor-export-errors meter) and logs once per
+            # gauge name
+            if self._on_error is not None:
+                self._on_error(self._name, exc)
             return {"type": "gauge", "value": None}
 
 
@@ -138,6 +151,9 @@ class MetricRegistry:
         self._time = time_fn
         self._lock = threading.Lock()
         self._sensors: Dict[str, object] = {}
+        #: gauge names whose export failure was already logged (log once
+        #: per gauge — a broken gauge fires on EVERY export)
+        self._gauge_errors_logged: set = set()
 
     def counter(self, name: str) -> Counter:
         return self._get(name, Counter)
@@ -159,9 +175,24 @@ class MetricRegistry:
         with self._lock:
             g = self._sensors.get(name)
             if not isinstance(g, Gauge):
-                g = Gauge(fn)
+                g = Gauge(fn, on_error=self._on_gauge_error, name=name)
                 self._sensors[name] = g
             return g
+
+    def _on_gauge_error(self, name: str, exc: BaseException) -> None:
+        """A gauge callable raised during export: meter it
+        (`sensor-export-errors`) and log once per gauge name."""
+        self.meter("sensor-export-errors").mark()
+        first = False
+        with self._lock:
+            if name not in self._gauge_errors_logged:
+                self._gauge_errors_logged.add(name)
+                first = True
+        if first:
+            LOG.warning("gauge %r failed to export (%s: %s); exporting "
+                        "null and counting into sensor-export-errors "
+                        "(logged once per gauge)",
+                        name, type(exc).__name__, exc)
 
     def _get(self, name: str, factory):
         with self._lock:

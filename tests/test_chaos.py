@@ -1,0 +1,452 @@
+"""Scripted chaos suite: the solver degradation ladder under injected
+faults (utils/faults.py harness + analyzer/degradation.py ladder).
+
+Deterministic scenarios proving the PR-2 robustness contract:
+
+(a) NaN/Inf loads are quarantined at ingest (monitor/sampling/holder.py)
+    and flagged device-side with NO extra host syncs (the invalid-input
+    verdict rides the single end-of-solve fetch; the transfer-guard pin
+    in test_fused_pipeline.py stays green);
+(b) the ladder descends fused → eager → CPU on injected compile/runtime
+    faults, the breaker pins the degraded rung, and after cooldown the
+    probes climb back with the breaker re-closing;
+(c) SolverDegraded anomalies reach the notifier and the rung/breaker
+    state appears in the STATE endpoint response;
+(d) a solve retried after a donated-buffer failure re-materializes its
+    inputs and matches the fault-free result bit-for-bit.
+
+Everything runs under JAX_PLATFORMS=cpu with the facade's virtual clock
+and the seeded fault plans — reruns reproduce the same faults at the
+same calls.
+"""
+import conftest  # noqa: F401
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import OptimizationOptions
+from cruise_control_tpu.analyzer.degradation import (BackoffPolicy,
+                                                     BreakerState,
+                                                     CircuitBreaker,
+                                                     FailureKind,
+                                                     InvalidModelInputError,
+                                                     SolverRung,
+                                                     classify_failure)
+from cruise_control_tpu.analyzer.goals.base import OptimizationFailure
+from cruise_control_tpu.analyzer.goals.registry import default_goals
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.detector.anomalies import SolverDegraded
+from cruise_control_tpu.detector.notifier import (AnomalyNotifier,
+                                                  NotificationAction)
+from cruise_control_tpu.testing import fixtures
+from cruise_control_tpu.utils import faults
+
+from test_facade import make_stack, feed_samples
+
+pytestmark = pytest.mark.chaos
+
+CHAOS_GOALS = ["RackAwareGoal", "DiskCapacityGoal",
+               "ReplicaDistributionGoal"]
+
+
+class RecordingNotifier(AnomalyNotifier):
+    def __init__(self):
+        self.anomalies = []
+
+    def on_anomaly(self, anomaly):
+        self.anomalies.append(anomaly)
+        return NotificationAction.ignore()
+
+    def self_healing_enabled(self):
+        return {}
+
+
+# ---------------------------------------------------------------------------
+# harness + classification units
+# ---------------------------------------------------------------------------
+
+class TestFaultHarness:
+    def test_fail_nth_and_counts(self):
+        plan = faults.FaultPlan().fail_nth("site.a", (1, 3))
+        with faults.injected(plan) as inj:
+            for expected in (True, False, True, False):
+                if expected:
+                    with pytest.raises(faults.FaultError):
+                        faults.inject("site.a")
+                else:
+                    faults.inject("site.a")
+            assert inj.counts() == {"site.a": (4, 2)}
+        faults.inject("site.a")   # uninstalled: inert
+
+    def test_fail_probability_is_seeded_deterministic(self):
+        def run():
+            plan = faults.FaultPlan(seed=42).fail_probability("s", 0.5)
+            hits = []
+            with faults.injected(plan):
+                for _ in range(20):
+                    try:
+                        faults.inject("s")
+                        hits.append(0)
+                    except faults.FaultError:
+                        hits.append(1)
+            return hits
+        first = run()
+        assert first == run() and 0 < sum(first) < 20
+
+    def test_classification_buckets(self):
+        assert classify_failure(
+            faults.FaultError("optimizer.compile")) is FailureKind.COMPILE
+        assert classify_failure(
+            faults.FaultError("optimizer.execute")) is FailureKind.RUNTIME
+        assert classify_failure(
+            InvalidModelInputError("x")) is FailureKind.INVALID_INPUT
+        assert classify_failure(
+            RuntimeError("XLA compilation failed")) is FailureKind.COMPILE
+        assert classify_failure(
+            RuntimeError("device halted")) is FailureKind.RUNTIME
+
+    def test_backoff_is_deterministic_and_capped(self):
+        import itertools
+        pol = BackoffPolicy(base_s=1.0, max_s=4.0, jitter=0.25, seed=7)
+        a = list(itertools.islice(pol.delays(), 6))
+        b = list(itertools.islice(pol.delays(), 6))
+        assert a == b
+        assert all(d <= 4.0 for d in a)   # max_s is a HARD cap
+        assert a[0] < a[1] < a[2]   # exponential until the cap
+
+    def test_breaker_transitions(self):
+        clock = {"now": 0.0}
+        br = CircuitBreaker(failure_threshold=2, cooldown_s=10.0,
+                            time_fn=lambda: clock["now"])
+        assert br.state is BreakerState.CLOSED
+        assert br.record_failure() is False
+        assert br.record_failure() is True      # trips exactly once
+        assert br.record_failure() is False     # already open
+        assert br.state is BreakerState.OPEN
+        clock["now"] += 11.0
+        assert br.state is BreakerState.HALF_OPEN
+        br.record_failure()                     # failed probe re-opens
+        assert br.state is BreakerState.OPEN
+        clock["now"] += 11.0
+        br.record_success()
+        assert br.state is BreakerState.CLOSED
+        assert br.consecutive_failures == 0
+
+
+# ---------------------------------------------------------------------------
+# (a) invalid inputs: ingest quarantine + device-side flag
+# ---------------------------------------------------------------------------
+
+class TestInvalidInputs:
+    def test_nan_samples_quarantined_at_ingest(self):
+        from cruise_control_tpu.monitor.sampling.holder import (
+            BrokerMetricSample, PartitionMetricSample, quarantine_invalid,
+            sample_values_valid)
+        from cruise_control_tpu.cluster.types import TopicPartition
+
+        good = PartitionMetricSample(0, TopicPartition("t", 0), 1000.0,
+                                     {0: 1.0, 1: 2.0})
+        for bad_value in (float("nan"), float("inf"), -1.0):
+            bad = BrokerMetricSample(1, 1000.0, {0: bad_value})
+            assert not sample_values_valid(bad.values)
+            valid, dropped = quarantine_invalid([good, bad])
+            assert valid == [good] and dropped == 1
+        assert sample_values_valid(good.values)
+
+    def test_fetcher_quarantine_counts_and_starves_aggregator(self):
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        fetcher = cc.load_monitor._fetcher
+        before = fetcher.num_quarantined_samples
+
+        # corrupt the sampler output: every partition sample carries NaN
+        orig = fetcher._sampler.get_samples
+
+        def corrupting(*args, **kwargs):
+            out = orig(*args, **kwargs)
+            out.partition_samples = [
+                type(s)(s.broker_id, s.tp, s.sample_time_ms,
+                        {k: float("nan") for k in s.values})
+                for s in out.partition_samples]
+            return out
+
+        fetcher._sampler.get_samples = corrupting
+        try:
+            cc.load_monitor.task_runner.sample_once()
+        finally:
+            fetcher._sampler.get_samples = orig
+        assert fetcher.num_quarantined_samples > before
+        sensors = cc.metrics.to_json()
+        assert sensors["sampler-quarantined-samples"]["value"] \
+            == fetcher.num_quarantined_samples
+        cc.shutdown()
+
+    def test_device_side_flag_without_extra_syncs(self, monkeypatch):
+        """A NaN-bearing model raises InvalidModelInputError from the
+        single end-of-solve fetch: exactly the same TWO device_gets as a
+        healthy solve (instrument fetch raises before the diff fetch —
+        so at MOST two), under a disallow transfer guard."""
+        state, topo = fixtures.small_cluster()
+        bad = state.replace(
+            replica_base_load=state.replica_base_load.at[0, 0].set(
+                jnp.nan))
+        opt = GoalOptimizer(default_goals(max_rounds=8, names=CHAOS_GOALS),
+                            pipeline_segment_size=2)
+        calls = []
+        real_device_get = jax.device_get
+
+        def counting(x):
+            calls.append(1)
+            return real_device_get(x)
+
+        monkeypatch.setattr(jax, "device_get", counting)
+        with jax.transfer_guard_device_to_host("disallow"):
+            with pytest.raises(InvalidModelInputError):
+                opt.optimizations(bad, topo, OptimizationOptions(),
+                                  check_sanity=False)
+        assert len(calls) == 1   # the instrument fetch; no diff fetch
+
+    def test_invalid_input_never_retries_or_descends(self):
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+
+        orig = cc.cluster_model
+
+        def poisoned(*args, **kwargs):
+            state, topo = orig(*args, **kwargs)
+            return state.replace(
+                replica_base_load=state.replica_base_load.at[0, 0].set(
+                    jnp.nan)), topo
+
+        cc.cluster_model = poisoned
+        with pytest.raises(InvalidModelInputError):
+            cc.optimizations(ignore_proposal_cache=True)
+        # the ladder did NOT move: garbage input is not a solver fault
+        assert cc.solver_ladder.rung is SolverRung.FUSED
+        assert cc.solver_breaker.state is BreakerState.CLOSED
+        assert cc.metrics.to_json()["solver-invalid-input"]["count"] == 1
+        cc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (b) + (c) ladder descent, breaker pin, recovery, anomaly + STATE
+# ---------------------------------------------------------------------------
+
+class TestDegradationLadder:
+    def test_descends_pins_recovers_and_reports(self):
+        notifier = RecordingNotifier()
+        sim, cc, clock = make_stack(notifier=notifier)
+        cc.solver_breaker.cooldown_s = 50.0
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+
+        healthy = cc.optimizations()
+        assert healthy.proposals
+        assert cc.solver_ladder.rung is SolverRung.FUSED
+
+        # persistent compile+runtime faults: FUSED and EAGER both fail,
+        # the CPU rung (no XLA) serves, the breaker trips and pins
+        feed_samples(cc, clock, rounds=1)
+        plan = faults.FaultPlan() \
+            .fail_always("optimizer.compile") \
+            .fail_always("optimizer.execute")
+        with faults.injected(plan):
+            degraded = cc.optimizations(ignore_proposal_cache=True)
+        assert degraded is not None   # served, even if with no proposals
+        assert cc.solver_ladder.rung is SolverRung.CPU
+        assert cc.solver_ladder.total_descents == 2
+        assert cc.solver_breaker.state is BreakerState.OPEN
+
+        # while OPEN the rung is pinned: no device dispatch happens even
+        # though the faults are gone (the solve runs the CPU rung)
+        feed_samples(cc, clock, rounds=1)
+        pinned_plan = faults.FaultPlan().fail_always("optimizer.execute")
+        with faults.injected(pinned_plan) as inj:
+            cc.optimizations(ignore_proposal_cache=True)
+            assert inj.call_count("optimizer.execute") == 0
+        assert cc.solver_ladder.rung is SolverRung.CPU
+        assert cc.solver_breaker.state is BreakerState.OPEN
+
+        # (c) the degradation events reached the notifier
+        cc.anomaly_detector.process_all()
+        degraded_events = [a for a in notifier.anomalies
+                           if isinstance(a, SolverDegraded)]
+        assert len(degraded_events) == 3   # 2 descents + 1 breaker trip
+        assert any(a.breaker_tripped for a in degraded_events)
+        assert {(a.from_rung, a.to_rung) for a in degraded_events} \
+            >= {("FUSED", "EAGER"), ("EAGER", "CPU")}
+
+        # recovery: cooldown elapses -> HALF_OPEN probe one rung up,
+        # success climbs one rung per solve, breaker re-closes
+        clock["now"] += 55.0
+        feed_samples(cc, clock, rounds=8)
+        assert cc.solver_breaker.state is BreakerState.HALF_OPEN
+        cc.optimizations(ignore_proposal_cache=True)
+        assert cc.solver_ladder.rung is SolverRung.EAGER
+        assert cc.solver_breaker.state is BreakerState.CLOSED
+        feed_samples(cc, clock, rounds=1)
+        recovered = cc.optimizations(ignore_proposal_cache=True)
+        assert cc.solver_ladder.rung is SolverRung.FUSED
+        assert recovered.proposals
+        cc.shutdown()
+
+    def test_transient_fault_retried_on_same_rung(self):
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        # exactly one mid-solve fault: the retry (same rung) succeeds
+        plan = faults.FaultPlan().fail_nth("optimizer.execute", 2)
+        with faults.injected(plan):
+            result = cc.optimizations()
+        assert result.proposals
+        assert cc.solver_ladder.rung is SolverRung.FUSED
+        assert cc.metrics.to_json()["solver-retries"]["count"] == 1
+        cc.shutdown()
+
+    def test_rung_and_breaker_in_state_endpoint(self):
+        from cruise_control_tpu.api.server import CruiseControlApp
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        feed_samples(cc, clock, rounds=1)
+        plan = faults.FaultPlan() \
+            .fail_always("optimizer.compile") \
+            .fail_always("optimizer.execute")
+        with faults.injected(plan):
+            cc.optimizations(ignore_proposal_cache=True)
+        app = CruiseControlApp(cc)
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/state", "substates=analyzer",
+            {}, client="test")
+        assert status == 200
+        deg = body["AnalyzerState"]["solverDegradation"]
+        assert deg["rung"] == "CPU"
+        assert deg["breaker"]["state"] == "OPEN"
+        assert deg["totalDescents"] == 2
+        assert deg["precomputeWedged"] is False
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/state", "substates=sensors",
+            {}, client="test")
+        assert body["Sensors"]["solver-rung"]["value"] == 2
+        assert body["Sensors"]["solver-breaker-open"]["value"] == 1.0
+        cc.shutdown()
+
+    def test_optimization_failure_is_not_ladder_material(self):
+        """An unsatisfiable hard goal is a solver VERDICT: it must
+        propagate unchanged — no retry, no descent — at every rung."""
+        sim, cc, clock = make_stack(
+            goal_names=["RackAwareGoal", "DiskCapacityGoal"])
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+
+        from cruise_control_tpu.analyzer.goals.base import Goal
+
+        class Unsatisfiable(Goal):
+            name = "UnsatisfiableHardGoal"
+            is_hard = True
+
+            def optimize_cached(self, state, ctx, prev_goals, cache=None):
+                return state, cache
+
+            def violated_brokers(self, state, ctx, cache):
+                return state.broker_alive
+
+        cc.goal_optimizer = GoalOptimizer([Unsatisfiable()])
+        with pytest.raises(OptimizationFailure):
+            cc.optimizations(ignore_proposal_cache=True)
+        assert cc.solver_ladder.rung is SolverRung.FUSED
+        assert cc.solver_breaker.state is BreakerState.CLOSED
+        cc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (d) donated-buffer retry: re-materialized inputs, bit-for-bit result
+# ---------------------------------------------------------------------------
+
+class TestRetryDeterminism:
+    def _result_fingerprint(self, result):
+        placements = sorted(
+            (p.partition.topic, p.partition.partition,
+             tuple(r.broker_id for r in p.old_replicas),
+             tuple(r.broker_id for r in p.new_replicas))
+            for p in result.proposals)
+        return placements, np.asarray(result.final_state.replica_broker)
+
+    def test_retry_after_midsolve_fault_matches_fault_free(self):
+        """The goal programs donate their input buffers (non-CPU
+        backends), so a fault mid-pipeline leaves the solve's inputs
+        consumed; the ladder re-materializes the model per attempt
+        (facade._materialize_solve_inputs) — the retried solve must
+        reproduce the fault-free solve exactly."""
+        fault_free = make_stack()
+        sim1, cc1, clock1 = fault_free
+        cc1.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc1, clock1)
+        baseline = cc1.optimizations()
+        cc1.shutdown()
+
+        sim2, cc2, clock2 = make_stack()
+        cc2.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc2, clock2)
+        # fail the 2nd program dispatch: the pre program already ran, so
+        # the threaded state/cache of attempt 1 are poisoned mid-flight
+        plan = faults.FaultPlan().fail_nth("optimizer.execute", 2)
+        with faults.injected(plan) as inj:
+            retried = cc2.optimizations()
+            assert inj.failure_count("optimizer.execute") == 1
+        assert cc2.metrics.to_json()["solver-retries"]["count"] == 1
+        cc2.shutdown()
+
+        base_p, base_state = self._result_fingerprint(baseline)
+        retry_p, retry_state = self._result_fingerprint(retried)
+        assert retry_p == base_p
+        assert np.array_equal(base_state, retry_state)
+
+
+# ---------------------------------------------------------------------------
+# precompute loop: fault site, backoff, watchdog
+# ---------------------------------------------------------------------------
+
+class TestPrecomputeRobustness:
+    def test_precompute_survives_injected_faults_and_recovers(self):
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        plan = faults.FaultPlan().fail_nth("facade.precompute", 1)
+        with faults.injected(plan):
+            assert cc._precompute_once_status() == "failed"
+            assert cc._precompute_once_status() == "computed"
+        cc.shutdown()
+
+    def test_wedged_precompute_does_not_block_shutdown(self):
+        import threading
+        import time as _real_time
+        sim, cc, clock = make_stack()
+        cc._precompute_solve_deadline_s = 10.0
+        cc.start_up(do_sampling=False, start_detection=False)
+        # simulate a wedged solve: a precompute thread stuck for longer
+        # than shutdown would ever wait, started past the deadline
+        release = threading.Event()
+        wedged = threading.Thread(target=release.wait, daemon=True)
+        wedged.start()
+        cc._precompute_thread = wedged
+        cc._precompute_solve_started_at = clock["now"] - 60.0
+        assert cc.precompute_wedged()
+        t0 = _real_time.monotonic()
+        cc.shutdown()
+        assert _real_time.monotonic() - t0 < 4.0   # did not join(5.0)
+        release.set()
+
+    def test_precompute_age_within_deadline_is_not_wedged(self):
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        assert not cc.precompute_wedged()
+        cc._precompute_solve_started_at = clock["now"] - 1.0
+        assert not cc.precompute_wedged()
+        cc._precompute_solve_started_at = None
+        cc.shutdown()

@@ -274,3 +274,93 @@ class TestReviewRegressions:
         task = [t for t in ex._manager._planner.all_tasks()
                 if t.task_type == TaskType.INTER_BROKER_REPLICA_ACTION][0]
         assert task.reexecution_count >= 1
+
+
+class TestFaultInjection:
+    """Executor behavior under scripted admin-client failures
+    (utils/faults.py harness, sites `executor.admin.<op>`): progress
+    polls tolerate transient faults, stuck-task re-execution survives a
+    failed re-submit, and a dead election path lands on the
+    leader-movement timeout instead of wedging or crashing."""
+
+    def test_poll_survives_transient_describe_faults(self):
+        from cruise_control_tpu.utils import faults
+        sim = _sim()
+        sim.create_topic("t", [[0, 1]], size_bytes=50e6)
+        sim._move_rate = 10e6   # several poll intervals to finish
+        ex = _executor(sim)
+        # calls 3-4 of describe_cluster are the first progress polls
+        # (call 1: execute_proposals snapshot, call 2: the submit-path
+        # alive-broker check, which stays fail-fast by design)
+        plan = faults.FaultPlan().fail_nth(
+            "executor.admin.describe_cluster", (3, 4))
+        with faults.injected(plan):
+            ex.execute_proposals(
+                [_proposal("t", 0, [0, 1], [2, 1], size=50e6)], wait=True)
+        snap = sim.describe_cluster()
+        assert set(snap.partition(TopicPartition("t", 0)).replicas) == {1, 2}
+        assert ex.num_poll_failures_tolerated >= 1
+
+    def test_stuck_task_reexecution_survives_failed_resubmit(self):
+        from cruise_control_tpu.utils import faults
+        sim = _sim()
+        sim.create_topic("t", [[0, 1]], size_bytes=100e6)
+        sim._move_rate = 10e6
+        ex = _executor(sim)
+        # cancel the reassignment out from under the executor once (the
+        # stuck-task condition), from inside its own sleep
+        cancelled = []
+        orig_sleep = ex._sleep
+
+        def sabotaging_sleep(s):
+            orig_sleep(s)
+            if not cancelled and sim.list_partition_reassignments():
+                sim.alter_partition_reassignments(
+                    {TopicPartition("t", 0): None})
+                cancelled.append(True)
+        ex._sleep = sabotaging_sleep
+        # the FIRST re-submit attempt (alter call 2: call 1 is the
+        # original submission) also fails — the poll must tolerate it
+        # and re-execute on a later poll instead of failing the run
+        plan = faults.FaultPlan().fail_nth(
+            "executor.admin.alter_partition_reassignments", 2)
+        with faults.injected(plan):
+            ex.execute_proposals(
+                [_proposal("t", 0, [0, 1], [2, 1], size=100e6)], wait=True)
+        snap = sim.describe_cluster()
+        assert set(snap.partition(TopicPartition("t", 0)).replicas) == {1, 2}
+        task = [t for t in ex._manager._planner.all_tasks()
+                if t.task_type == TaskType.INTER_BROKER_REPLICA_ACTION][0]
+        assert task.state == TaskState.COMPLETED
+        assert task.reexecution_count >= 1
+        assert ex.num_poll_failures_tolerated >= 1
+
+    def test_leader_movement_timeout_under_election_faults(self):
+        from cruise_control_tpu.utils import faults
+        sim = _sim()
+        sim.create_topic("t", [[0, 1]], size_bytes=1e6)
+        ex = _executor(sim, leader_movement_timeout_s=5.0)
+        finished = []
+
+        class Notifier:
+            def on_execution_finished(self, uuid, ok, msg):
+                finished.append((ok, msg))
+
+        ex._notifier = Notifier()
+        # every election request fails: leadership can never move, so
+        # the leader-movement timeout must mark the tasks DEAD and the
+        # execution must still complete (not crash, not hang)
+        plan = faults.FaultPlan().fail_always(
+            "executor.admin.elect_preferred_leaders")
+        with faults.injected(plan):
+            ex.execute_proposals(
+                [_proposal("t", 0, [0, 1], [1, 0], old_leader=0)],
+                wait=True)
+        snap = sim.describe_cluster()
+        assert snap.partition(TopicPartition("t", 0)).leader == 0
+        leader_tasks = [t for t in ex._manager._planner.all_tasks()
+                        if t.task_type == TaskType.LEADER_ACTION]
+        assert leader_tasks and all(t.state == TaskState.DEAD
+                                    for t in leader_tasks)
+        assert finished == [(True, "execution completed")]
+        assert ex.num_poll_failures_tolerated >= 1

@@ -96,7 +96,7 @@ def _unfused_reference_solve(opt, state, topo, options):
     ctx = make_context(state, opt.constraint, options, topo)
     initial = state
     stats_before = jax.device_get(jax.jit(compute_stats)(state))
-    (_, vb_dev, state, cache, _, _, _, pre_rounds) = jax.jit(
+    (_, vb_dev, state, cache, _, _, _, pre_rounds, _) = jax.jit(
         opt._pre_fn())(initial, state, ctx)
     vb = np.asarray(jax.device_get(vb_dev))
 

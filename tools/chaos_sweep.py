@@ -1,0 +1,213 @@
+"""Scripted chaos sweep: drive the solver degradation ladder through
+deterministic fault scenarios against an in-process simulated stack and
+report one JSON line per scenario.
+
+The operational counterpart of tests/test_chaos.py: where the test suite
+pins the contract, this tool lets an operator (or CI job) replay the
+scenarios against the CURRENT build and inspect the ladder's behavior —
+rungs visited, breaker transitions, anomalies emitted, quarantine
+counts.  Exit code 0 = every scenario behaved; 1 = a scenario deviated.
+
+Usage: JAX_PLATFORMS=cpu python tools/chaos_sweep.py [--json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from cruise_control_tpu.analyzer.degradation import (BreakerState,  # noqa: E402
+                                                     SolverRung)
+from cruise_control_tpu.cluster.simulated import SimulatedCluster  # noqa: E402
+from cruise_control_tpu.cluster.types import TopicPartition  # noqa: E402
+from cruise_control_tpu.detector.anomalies import SolverDegraded  # noqa: E402
+from cruise_control_tpu.detector.notifier import (AnomalyNotifier,  # noqa: E402
+                                                  NotificationAction)
+from cruise_control_tpu.facade import CruiseControl  # noqa: E402
+from cruise_control_tpu.monitor.sampling.sampler import (  # noqa: E402
+    SimulatedClusterSampler)
+from cruise_control_tpu.utils import faults  # noqa: E402
+
+GOALS = ["RackAwareGoal", "DiskCapacityGoal", "ReplicaDistributionGoal",
+         "DiskUsageDistributionGoal"]
+
+
+class _Recorder(AnomalyNotifier):
+    def __init__(self):
+        self.anomalies = []
+
+    def on_anomaly(self, anomaly):
+        self.anomalies.append(anomaly)
+        return NotificationAction.ignore()
+
+    def self_healing_enabled(self):
+        return {}
+
+
+def build_stack(num_brokers=4, partitions=12):
+    sim = SimulatedCluster()
+    clock = {"now": 10_000.0}
+    for b in range(num_brokers):
+        sim.add_broker(b, rack=f"rack{b % 2}")
+    assignments = [[0, 1] for _ in range(partitions)]   # skewed on 0/1
+    sim.create_topic("t0", assignments, size_bytes=1e4)
+    for p in range(partitions):
+        sim.set_partition_load(TopicPartition("t0", p), leader_cpu=2.0,
+                               nw_in=100.0, nw_out=300.0)
+    notifier = _Recorder()
+    cc = CruiseControl(
+        sim, SimulatedClusterSampler(sim),
+        anomaly_notifier=notifier,
+        time_fn=lambda: clock["now"],
+        sleep_fn=lambda s: (sim.advance(s),
+                            clock.__setitem__("now", clock["now"] + s)),
+        monitor_kwargs=dict(num_windows=3, window_ms=10_000,
+                            min_samples_per_window=1,
+                            sampling_interval_ms=5_000),
+        executor_kwargs=dict(progress_check_interval_s=1.0),
+        auto_warmup=False,
+        solver_breaker_cooldown_s=50.0,
+        goal_names=GOALS)
+    cc.start_up(do_sampling=False, start_detection=False)
+    return sim, cc, clock, notifier
+
+
+def feed(cc, clock, rounds=8):
+    for _ in range(rounds):
+        cc.load_monitor.task_runner.sample_once()
+        clock["now"] += 10.0
+
+
+def scenario_quarantine():
+    """NaN samples are dropped at ingest, behind a counter."""
+    sim, cc, clock, _ = build_stack()
+    try:
+        feed(cc, clock)
+        fetcher = cc.load_monitor._fetcher
+        orig = fetcher._sampler.get_samples
+
+        def corrupting(*args, **kwargs):
+            out = orig(*args, **kwargs)
+            out.partition_samples = [
+                type(s)(s.broker_id, s.tp, s.sample_time_ms,
+                        {k: float("nan") for k in s.values})
+                for s in out.partition_samples]
+            return out
+
+        fetcher._sampler.get_samples = corrupting
+        try:
+            cc.load_monitor.task_runner.sample_once()
+        finally:
+            fetcher._sampler.get_samples = orig
+        quarantined = fetcher.num_quarantined_samples
+        return {"scenario": "quarantine", "ok": quarantined > 0,
+                "quarantined": quarantined}
+    finally:
+        cc.shutdown()
+
+
+def scenario_ladder_descent_and_recovery():
+    """Persistent device faults: fused -> eager -> CPU, breaker pins,
+    cooldown elapses, probes climb back, breaker re-closes."""
+    sim, cc, clock, notifier = build_stack()
+    try:
+        feed(cc, clock)
+        cc.optimizations()
+        path = [cc.solver_ladder.rung.name]
+        feed(cc, clock, rounds=1)
+        plan = faults.FaultPlan() \
+            .fail_always("optimizer.compile") \
+            .fail_always("optimizer.execute")
+        with faults.injected(plan):
+            cc.optimizations(ignore_proposal_cache=True)
+        path.append(cc.solver_ladder.rung.name)
+        breaker_open = cc.solver_breaker.state is BreakerState.OPEN
+        clock["now"] += 55.0
+        feed(cc, clock, rounds=8)
+        cc.optimizations(ignore_proposal_cache=True)
+        path.append(cc.solver_ladder.rung.name)
+        feed(cc, clock, rounds=1)
+        cc.optimizations(ignore_proposal_cache=True)
+        path.append(cc.solver_ladder.rung.name)
+        cc.anomaly_detector.process_all()
+        events = [str(a) for a in notifier.anomalies
+                  if isinstance(a, SolverDegraded)]
+        recovered = (cc.solver_ladder.rung is SolverRung.FUSED
+                     and cc.solver_breaker.state is BreakerState.CLOSED)
+        return {"scenario": "ladder-descent-recovery",
+                "ok": (path == ["FUSED", "CPU", "EAGER", "FUSED"]
+                       and breaker_open and recovered
+                       and len(events) == 3),
+                "rungPath": path, "breakerTripped": breaker_open,
+                "anomalies": events}
+    finally:
+        cc.shutdown()
+
+
+def scenario_retry_bit_for_bit():
+    """A solve retried after a mid-pipeline fault matches the
+    fault-free solve exactly (re-materialized inputs)."""
+    def fingerprint(result):
+        placements = sorted(
+            (p.partition.topic, p.partition.partition,
+             tuple(r.broker_id for r in p.old_replicas),
+             tuple(r.broker_id for r in p.new_replicas))
+            for p in result.proposals)
+        return placements, np.asarray(result.final_state.replica_broker)
+
+    sim, cc, clock, _ = build_stack()
+    try:
+        feed(cc, clock)
+        baseline = cc.optimizations()
+    finally:
+        cc.shutdown()
+    sim2, cc2, clock2, _ = build_stack()
+    try:
+        feed(cc2, clock2)
+        with faults.injected(
+                faults.FaultPlan().fail_nth("optimizer.execute", 2)):
+            retried = cc2.optimizations()
+        retries = cc2.metrics.to_json()["solver-retries"]["count"]
+    finally:
+        cc2.shutdown()
+    bp, bs = fingerprint(baseline)
+    rp, rs = fingerprint(retried)
+    ok = bp == rp and np.array_equal(bs, rs) and retries == 1
+    return {"scenario": "retry-bit-for-bit", "ok": ok,
+            "proposals": len(bp), "retries": retries}
+
+
+SCENARIOS = [scenario_quarantine, scenario_ladder_descent_and_recovery,
+             scenario_retry_bit_for_bit]
+
+
+def main(argv) -> int:
+    as_json = "--json" in argv
+    results = []
+    for fn in SCENARIOS:
+        try:
+            results.append(fn())
+        except Exception as exc:  # noqa: BLE001 - a crash fails the sweep
+            results.append({"scenario": fn.__name__, "ok": False,
+                            "error": f"{type(exc).__name__}: {exc}"})
+    ok = all(r["ok"] for r in results)
+    if as_json:
+        print(json.dumps({"ok": ok, "scenarios": results}))
+    else:
+        for r in results:
+            print(("PASS" if r["ok"] else "FAIL"), r["scenario"],
+                  {k: v for k, v in r.items()
+                   if k not in ("scenario", "ok")})
+        print("chaos sweep:", "OK" if ok else "FAILED", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -26,7 +26,6 @@ jax.config.update("jax_compilation_cache_dir",
                   os.environ["JAX_COMPILATION_CACHE_DIR"])
 
 import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
 
 from cruise_control_tpu.analyzer.context import (  # noqa: E402
     OptimizationOptions, make_context, make_round_cache)

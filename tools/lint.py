@@ -7,7 +7,11 @@ Checks:
   * files parse (syntax);
   * unused imports (module scope, honoring __all__ and re-export files);
   * tabs in indentation, trailing whitespace, missing final newline;
-  * lines longer than 100 columns.
+  * lines longer than 100 columns;
+  * no fully-silent `except Exception` swallows in cruise_control_tpu/:
+    every broad handler must log, re-raise, or increment a sensor (a
+    swallowed solver/sampler failure is invisible until it pages — the
+    PR-2 robustness rule).
 
 Usage: python tools/lint.py [paths...]   (default: the package + tests)
 Exit code 1 when any finding is reported.
@@ -21,6 +25,59 @@ from pathlib import Path
 MAX_LINE = 100
 DEFAULT_PATHS = ["cruise_control_tpu", "tests", "tools", "bench.py",
                  "__graft_entry__.py"]
+
+#: a broad handler "signals" when its body calls something whose name
+#: carries one of these tokens (logging, alerting, sensor increments,
+#: error routing) — permissive by design: the rule exists to catch the
+#: FULLY silent `except Exception: pass/return` shape
+_HANDLER_SIGNAL_TOKENS = ("log", "warn", "error", "exception", "debug",
+                          "info", "alert", "critical", "mark", "inc",
+                          "update", "record", "report", "tolerate",
+                          "quarantine", "fail")
+
+
+def _catches_broad(handler_type) -> bool:
+    """Does this except clause catch Exception/BaseException?"""
+    types = (handler_type.elts if isinstance(handler_type, ast.Tuple)
+             else [handler_type])
+    return any(isinstance(t, ast.Name)
+               and t.id in ("Exception", "BaseException") for t in types)
+
+
+def _call_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _handler_signals(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = _call_name(node.func).lower()
+            if any(tok in name for tok in _HANDLER_SIGNAL_TOKENS):
+                return True
+    return False
+
+
+def _silent_swallows(path: Path, tree: ast.AST) -> list:
+    """Every `except Exception` in the package must log, re-raise, or
+    increment a sensor — no fully-silent swallows (robustness rule)."""
+    if "cruise_control_tpu" not in path.parts:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) \
+                and node.type is not None \
+                and _catches_broad(node.type) \
+                and not _handler_signals(node):
+            findings.append(
+                f"{path}:{node.lineno}: silent `except Exception` "
+                f"swallow — log it, re-raise, or count it in a sensor")
+    return findings
 
 
 def _imported_names(tree: ast.AST):
@@ -83,6 +140,8 @@ def lint_file(path: Path) -> list:
             findings.append(f"{path}:{i}: line longer than {MAX_LINE} cols")
     if text and not text.endswith("\n"):
         findings.append(f"{path}:{len(lines)}: missing final newline")
+
+    findings.extend(_silent_swallows(path, tree))
 
     # unused imports: __init__.py files are re-export surfaces; a module
     # __all__ also marks intentional re-exports; `annotations` is the
