@@ -15,6 +15,14 @@ BENCH_CONFIG selects a BASELINE.json eval config:
   4                2600b/200Kp add-broker + remove-broker operations
   5                2600b JBOD (4 logdirs/broker, broken disks) with
                    DiskUsageDistributionGoal + offline-replica self-healing
+  scenario         batched what-if engine (scenario/engine.py): solves
+                   K = BENCH_SCENARIO_BATCHES (default 1,8,32) scenario
+                   variants per vmapped program and records per-batch
+                   compile + solve latency, so the one-compile-amortized-
+                   over-K claim is MEASURED (the output JSON carries a
+                   "scenario" block; value = per-scenario solve seconds
+                   at the largest K, vs_baseline = K=1-per-scenario /
+                   largest-K-per-scenario, >1 = batching wins)
 
 Other knobs: BENCH_BROKERS, BENCH_PARTITIONS, BENCH_RF, BENCH_ROUNDS,
 BENCH_GOALS (comma list), BENCH_SEGMENT, BENCH_SKIP_WARMUP.
@@ -77,6 +85,8 @@ def main() -> None:
     from cruise_control_tpu.model import state as S
 
     config = os.environ.get("BENCH_CONFIG", "north")
+    if config == "scenario":
+        return _scenario_bench()
     presets = {  # (brokers, partitions, goal subset, metric label)
         "north": (2600, 200_000, None, "full-stack proposal generation"),
         "1": (3, 30, None, "deterministic fixture"),
@@ -226,5 +236,108 @@ def main() -> None:
     }))
 
 
+def _scenario_bench() -> None:
+    """BENCH_CONFIG=scenario: measure the batched what-if engine at
+    K = BENCH_SCENARIO_BATCHES scenarios per program (default 1,8,32).
+
+    Per batch size the engine runs TWICE: the first pass pays the
+    vmapped-program compile (recorded), the second measures the warm
+    solve — per-scenario latency is warm-solve / K.  The amortization
+    verdict (vs_baseline) compares per-scenario latency at the largest K
+    against the K=1 batch — same model, same goal list."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(os.environ[
+                          "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+
+    from cruise_control_tpu.analyzer.context import BalancingConstraint
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.scenario.engine import ScenarioEngine
+    from cruise_control_tpu.scenario.spec import ScenarioSpec
+
+    num_b = int(os.environ.get("BENCH_BROKERS", 200))
+    num_p = int(os.environ.get("BENCH_PARTITIONS", 20_000))
+    rf = int(os.environ.get("BENCH_RF", 3))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 64))
+    goal_names = os.environ.get("BENCH_GOALS")
+    names = (goal_names.split(",") if goal_names
+             else ["RackAwareGoal", "DiskCapacityGoal",
+                   "ReplicaDistributionGoal", "DiskUsageDistributionGoal"])
+    batches = [int(k) for k in os.environ.get(
+        "BENCH_SCENARIO_BATCHES", "1,8,32").split(",") if k.strip()]
+    if 1 not in batches:
+        # vs_baseline is defined as K=1-per-scenario / largest-K: always
+        # measure the K=1 baseline rather than silently substituting the
+        # smallest requested batch
+        batches = [1] + batches
+
+    backend = jax.devices()[0].platform
+    state, topo = _build("2", num_b, num_p, rf)
+    print(f"# scenario bench: B={state.num_brokers} "
+          f"P={state.num_partitions} R={state.num_replicas} goals={names} "
+          f"batches={batches} [{backend}]", file=sys.stderr)
+
+    constraint = BalancingConstraint()
+    optimizer = GoalOptimizer(
+        default_goals(max_rounds=rounds, names=names), constraint,
+        pipeline_segment_size=int(os.environ.get("BENCH_SEGMENT", 2)))
+    engine = ScenarioEngine(
+        lambda g: optimizer if g is None else GoalOptimizer(
+            default_goals(max_rounds=rounds, names=g), constraint),
+        constraint, max_batch_size=max(batches))
+
+    def specs_for(k: int):
+        # base + distinct load-scale variants: different solves, one shape
+        out = [ScenarioSpec(name="base")]
+        for i in range(1, k):
+            out.append(ScenarioSpec(
+                name=f"grow-{i}",
+                load_scale={"disk": 1.0 + 0.05 * i,
+                            "nw_in": 1.0 + 0.03 * i}))
+        return out
+
+    results = {}
+    for k in batches:
+        specs = specs_for(k)
+        cold = engine.evaluate(state, topo, specs,
+                               include_proposals=False)
+        warm = engine.evaluate(state, topo, specs,
+                               include_proposals=False)
+        infeasible = sum(1 for o in warm.outcomes if not o.feasible)
+        results[str(k)] = {
+            "compile_s": round(cold.compile_s, 3),
+            "cold_solve_s": round(cold.solve_s, 3),
+            "warm_solve_s": round(warm.solve_s, 3),
+            "per_scenario_s": round(warm.solve_s / k, 4),
+            "oom_halvings": cold.oom_halvings + warm.oom_halvings,
+            "rung": warm.rung,
+            "infeasible": infeasible,
+        }
+        print(f"# K={k}: compile {results[str(k)]['compile_s']}s, warm "
+              f"solve {results[str(k)]['warm_solve_s']}s "
+              f"({results[str(k)]['per_scenario_s']}s/scenario), "
+              f"rung={warm.rung}", file=sys.stderr)
+
+    k_max = str(max(batches))
+    per_max = results[k_max]["per_scenario_s"]
+    per_one = results["1"]["per_scenario_s"]
+    print(json.dumps({
+        "metric": (f"scenario what-if batch K={k_max} "
+                   f"{state.num_brokers}b/{state.num_partitions/1000:g}Kp "
+                   f"rf{rf} [{backend}]"),
+        "value": per_max,
+        "unit": "s",
+        # amortization factor: K=1 per-scenario latency / largest-K
+        # per-scenario latency (>1 = batching wins)
+        "vs_baseline": round(per_one / per_max, 3) if per_max else 0.0,
+        "scenario": results,
+    }))
+
+
 if __name__ == "__main__":
     main()
+

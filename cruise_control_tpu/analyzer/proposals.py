@@ -130,10 +130,27 @@ def diff_proposals(initial: ClusterState, optimized: ClusterState,
         initial.replica_partition))
     init = dict(zip(keys, init_t))
     opt = dict(zip(keys, opt_t))
-    if not has_disks:
-        no_disk = np.full(initial.num_replicas, -1, dtype=np.int32)
-        init["replica_disk"] = no_disk
-        opt["replica_disk"] = no_disk
+    return diff_proposals_host(init, opt, valid, base_disk, part, topology,
+                               partition_rows)
+
+
+def diff_proposals_host(init: dict, opt: dict, valid: np.ndarray,
+                        base_disk: np.ndarray, part: np.ndarray,
+                        topology: ClusterTopology,
+                        partition_rows: np.ndarray
+                        ) -> List[ExecutionProposal]:
+    """Host core of `diff_proposals` over already-fetched numpy arrays.
+
+    `init`/`opt` map ``replica_broker``/``replica_is_leader`` (and
+    optionally ``replica_disk``) to [R] arrays.  Split out so callers
+    that fetched the placements in their OWN batched device_get — the
+    scenario engine fetches K scenarios' placements at once — can diff
+    without any further device transfer (the batched transfer-guard pin
+    counts total device_gets per batch, tests/test_scenario.py)."""
+    if "replica_disk" not in init:
+        no_disk = np.full(valid.shape[0], -1, dtype=np.int32)
+        init = dict(init, replica_disk=no_disk)
+        opt = dict(opt, replica_disk=no_disk)
     changed_r = valid & (
         (init["replica_broker"] != opt["replica_broker"])
         | (init["replica_is_leader"] != opt["replica_is_leader"])

@@ -57,13 +57,17 @@ VALID_PARAMS: Dict[str, Set[str]] = {
     "TOPIC_CONFIGURATION": {"topic", "replication_factor", "goals",
                             "dryrun", "verbose", "json", "reason",
                             "review_id"},
+    # batched what-if analysis (framework extension, scenario/ engine):
+    # the scenario list rides in the JSON request BODY (see
+    # scenario/spec.py SCENARIOS_REQUEST_SCHEMA), not the query string
+    "SCENARIOS": {"verbose", "json", "reason", "review_id"},
 }
 
 #: POST endpoints subject to purgatory review when two-step is enabled
 POST_ENDPOINTS = {
     "REBALANCE", "ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER",
     "FIX_OFFLINE_REPLICAS", "STOP_PROPOSAL_EXECUTION", "PAUSE_SAMPLING",
-    "RESUME_SAMPLING", "ADMIN", "TOPIC_CONFIGURATION",
+    "RESUME_SAMPLING", "ADMIN", "TOPIC_CONFIGURATION", "SCENARIOS",
 }
 GET_ENDPOINTS = set(VALID_PARAMS) - POST_ENDPOINTS - {"REVIEW"}
 

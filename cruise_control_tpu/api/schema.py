@@ -131,6 +131,44 @@ REVIEW_BOARD = _obj({
     "requestInfo": _arr(_REVIEW_REQUEST), "version": _INT,
 }, required=["requestInfo", "version"])
 
+_SCENARIO_OUTCOME = _obj({
+    "name": _STR,
+    "feasible": _BOOL,
+    "rung": {"enum": ["FUSED", "EAGER", "CPU"]},
+    "reason": _STR,
+    "balancedness": _NUM,
+    "numReplicaMoves": _INT,
+    "numLeadershipMoves": _INT,
+    "dataToMoveMB": _NUM,
+    "violatedGoalsBefore": _arr(_STR),
+    "violatedGoalsAfter": _arr(_STR),
+    "statsAfter": _obj({}, extra=True),
+    "vsBase": _obj({
+        "balancednessDelta": _NUM,
+        "violatedGoalsAfterDelta": _INT,
+        "dataToMoveDeltaMB": _NUM,
+        "numReplicaMovesDelta": _INT,
+    }),
+    "numProposals": _INT,
+    "proposals": _arr(_PROPOSAL),
+}, required=["name", "feasible", "rung", "balancedness"])
+
+SCENARIOS = _obj({
+    "scenarios": _arr(_SCENARIO_OUTCOME),
+    "base": {"oneOf": [_SCENARIO_OUTCOME, {"type": "null"}]},
+    "batch": _obj({
+        "numScenarios": _INT,
+        "rung": {"enum": ["FUSED", "EAGER", "CPU"]},
+        "oomHalvings": _INT,
+        "deviceBatchSizes": _arr(_INT),
+        "compileS": _NUM,
+        "solveS": _NUM,
+        "durationS": _NUM,
+    }, required=["numScenarios", "rung", "oomHalvings"]),
+    "dryRun": {"const": True},
+    "version": _INT,
+}, required=["scenarios", "batch", "dryRun", "version"])
+
 MESSAGE = _obj({"message": _STR, "version": _INT},
                required=["message", "version"])
 
@@ -176,6 +214,7 @@ ENDPOINT_SCHEMAS: Dict[str, dict] = {
     "DEMOTE_BROKER": OPTIMIZATION_RESULT,
     "FIX_OFFLINE_REPLICAS": OPTIMIZATION_RESULT,
     "TOPIC_CONFIGURATION": OPTIMIZATION_RESULT,
+    "SCENARIOS": SCENARIOS,
 }
 
 #: non-200 body schemas by meaning
@@ -188,11 +227,14 @@ AUX_SCHEMAS: Dict[str, dict] = {
 
 def document() -> dict:
     """The full schema artifact as one JSON document."""
+    from cruise_control_tpu.scenario.spec import SCENARIOS_REQUEST_SCHEMA
     return {
         "$schema": "https://json-schema.org/draft/2020-12/schema",
         "title": "cruise_control_tpu REST response schemas",
         "endpoints": ENDPOINT_SCHEMAS,
         "aux": AUX_SCHEMAS,
+        # endpoints that take a JSON request BODY publish its schema too
+        "requests": {"SCENARIOS": SCENARIOS_REQUEST_SCHEMA},
     }
 
 

@@ -145,9 +145,12 @@ class CruiseControlApp:
     # ------------------------------------------------------------------
     def handle_request(self, method: str, path: str, query_string: str = "",
                        headers: Optional[Mapping[str, str]] = None,
-                       client: str = "local"
+                       client: str = "local",
+                       body: Optional[str] = None
                        ) -> Tuple[int, Dict[str, str], dict]:
-        """(status, response headers, json body)."""
+        """(status, response headers, json body).  `body` is the raw
+        request body (SCENARIOS carries its spec list there); it joins
+        the user-task dedup key for async POSTs."""
         headers = dict(headers or {})
         # peer address as a pseudo-header for providers that filter on it
         # (trusted.proxy.services.ip.regex) — OVERWRITE unconditionally: a
@@ -194,11 +197,12 @@ class CruiseControlApp:
                                                   query_string, client)
                     if parked is not None:
                         return parked
-                body = (request.handle_sync(self, params) if request
-                        else self._handle_sync(endpoint, params))
-                return 200, {}, body
+                out = (request.handle_sync(self, params) if request
+                       else self._handle_sync(endpoint, params))
+                return 200, {}, out
             return self._handle_async(endpoint, params, query_string,
-                                      client, headers, request=request)
+                                      client, headers, request=request,
+                                      body=body)
         except (ParameterError, ValueError) as exc:
             return self._error(400, exc)
         except AuthenticationError as exc:
@@ -249,8 +253,8 @@ class CruiseControlApp:
     def default_sync_handler(self, endpoint: str, params) -> dict:
         return self._handle_sync(endpoint, params)
 
-    def default_operation(self, endpoint: str, params):
-        return self._operation_for(endpoint, params)
+    def default_operation(self, endpoint: str, params, body=None):
+        return self._operation_for(endpoint, params, body=body)
 
     def _endpoint_of(self, method: str, path: str) -> str:
         base = self.base_path
@@ -269,12 +273,22 @@ class CruiseControlApp:
         return endpoint
 
     def _purgatory_gate(self, endpoint: str, params: QueryParams,
-                        query_string: str, client: str
+                        query_string: str, client: str,
+                        body: Optional[str] = None
                         ) -> Optional[Tuple[int, Dict[str, str], dict]]:
         """Two-step verification: park unreviewed POSTs, consume approvals.
-        Returns a parked-response triple, or None to proceed."""
+        Returns a parked-response triple, or None to proceed.
+
+        For body-carrying endpoints (SCENARIOS) the BODY HASH joins the
+        reviewed request identity: an approval must not be replayable
+        with a different payload behind the same query string."""
         if self.purgatory is None or endpoint not in POST_ENDPOINTS:
             return None
+        if body:
+            from cruise_control_tpu.api.user_tasks import body_fingerprint
+            sep = "&" if query_string else ""
+            query_string = (f"{query_string}{sep}"
+                            f"body_sha={body_fingerprint(body)}")
         review_id = params.get_int("review_id")
         if review_id is None:
             req = self.purgatory.submit(endpoint, query_string, client)
@@ -288,7 +302,7 @@ class CruiseControlApp:
     def _handle_async(self, endpoint: str, params: QueryParams,
                       query_string: str, client: str,
                       headers: Mapping[str, str],
-                      request=None
+                      request=None, body: Optional[str] = None
                       ) -> Tuple[int, Dict[str, str], dict]:
         task_id = None
         for k, v in headers.items():
@@ -298,13 +312,20 @@ class CruiseControlApp:
         # review id was already consumed when the task started)
         if task_id is None:
             parked = self._purgatory_gate(endpoint, params, query_string,
-                                          client)
+                                          client, body=body)
             if parked is not None:
                 return parked
-        op = (request.operation(self, params) if request is not None
-              else self._operation_for(endpoint, params))
+        if task_id is not None:
+            # attach-only: get_or_create never runs the operation when a
+            # task id is given (and a body-less re-poll must not trip
+            # body validation in the operation builder)
+            op: Callable[[], dict] = lambda: {}  # noqa: E731
+        else:
+            op = (request.operation(self, params) if request is not None
+                  else self._operation_for(endpoint, params, body=body))
         info = self.user_tasks.get_or_create(endpoint, query_string, client,
-                                             op, task_id=task_id)
+                                             op, task_id=task_id,
+                                             body=body)
         hdrs = {USER_TASK_ID_HEADER: info.task_id,
                 # async session cookie scoped to the configured path
                 # (reference webserver.session.path; the reference tracks
@@ -329,9 +350,35 @@ class CruiseControlApp:
     # ------------------------------------------------------------------
     # per-endpoint operations
     # ------------------------------------------------------------------
-    def _operation_for(self, endpoint: str,
-                       params: QueryParams) -> Callable[[], dict]:
+    def _operation_for(self, endpoint: str, params: QueryParams,
+                       body: Optional[str] = None) -> Callable[[], dict]:
         cc = self.cc
+        if endpoint == "SCENARIOS":
+            # batched what-if analysis (scenario/engine.py): spec list in
+            # the JSON body, DRY-RUN ONLY — the engine ranks
+            # hypotheticals, it can never execute them.  Body validation
+            # happens HERE (request time, 400 on garbage), not inside the
+            # async task.
+            from cruise_control_tpu.scenario.report import batch_report
+            from cruise_control_tpu.scenario.spec import \
+                parse_scenarios_payload
+            if not getattr(cc, "_scenario_enabled", True):
+                # deterministic configuration rejection: answer 400 at
+                # request time, not a failed task at poll time
+                raise ValueError("the scenario engine is disabled "
+                                 "(scenario.engine.enabled=false)")
+            specs, goal_override, include_base = \
+                parse_scenarios_payload(body)
+            verbose = params.get_bool("verbose")
+            reason = params.get("reason", "SCENARIOS via REST")
+
+            def scenarios_op() -> dict:
+                result = cc.evaluate_scenarios(
+                    specs, goals=goal_override,
+                    include_base=include_base, reason=reason)
+                return batch_report(result, verbose=verbose)
+            return scenarios_op
+
         if endpoint == "PROPOSALS":
             goals = params.get_csv("goals")
             verbose = params.get_bool("verbose")
@@ -409,7 +456,21 @@ class CruiseControlApp:
         strategy = strategy_from_names(strategies) if strategies else None
 
         if endpoint in ("ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER"):
-            broker_ids = params.get_csv_ints("brokerid")
+            raw = params.get("brokerid") or ""
+            if ";" in raw:
+                # K candidate broker sets ("1,2;3,4"): the facade routes
+                # these through the scenario engine (dry-run only) and
+                # returns the ranked what-if report
+                try:
+                    broker_ids = [[int(x) for x in grp.split(",")
+                                   if x.strip()]
+                                  for grp in raw.split(";") if grp.strip()]
+                except ValueError:
+                    raise ParameterError(
+                        "brokerid candidate sets must be CSV integers "
+                        "separated by ';'")
+            else:
+                broker_ids = params.get_csv_ints("brokerid")
             if not broker_ids:
                 raise ParameterError(f"{endpoint} requires brokerid")
         else:
@@ -459,13 +520,19 @@ class CruiseControlApp:
             if op.optimizer_result is not None:
                 body = R.optimization_result(op.optimizer_result,
                                              verbose=verbose)
-            else:   # direct-proposal operations (RF change)
+            else:   # direct-proposal operations (RF change, what-ifs)
                 body = {"summary": {
                     "numReplicaMovements": sum(
                         1 for p in op.proposals if p.has_replica_action),
-                    "numProposals": len(op.proposals)}}
+                    "numProposals": len(op.proposals)},
+                    "goalSummary": []}
                 if verbose:
                     body["proposals"] = [p.to_json() for p in op.proposals]
+            if op.scenario_report is not None:
+                # multiple candidate broker sets were ranked by the
+                # scenario engine: the full report rides along, the
+                # summary/proposals above describe the best candidate
+                body["scenarioReport"] = op.scenario_report
             body["dryRun"] = op.dryrun
             if op.execution_uuid:
                 body["executionId"] = op.execution_uuid
@@ -551,12 +618,28 @@ class CruiseControlApp:
         app = self
 
         class Handler(BaseHTTPRequestHandler):
+            MAX_BODY_BYTES = 16 * 1024 * 1024
+
             def _dispatch(self, method: str) -> None:
                 parsed = urllib.parse.urlsplit(self.path)
+                request_body: Optional[str] = None
+                if method == "POST":
+                    try:
+                        length = int(self.headers.get("Content-Length",
+                                                      0) or 0)
+                    except ValueError:
+                        length = 0
+                    if length > self.MAX_BODY_BYTES:
+                        self.send_error(413, "request body too large")
+                        return
+                    if length > 0:
+                        request_body = self.rfile.read(length).decode(
+                            "utf-8", errors="replace")
                 status, hdrs, body = app.handle_request(
                     method, parsed.path, parsed.query,
                     dict(self.headers.items()),
-                    client=self.client_address[0])
+                    client=self.client_address[0],
+                    body=request_body)
                 hdrs = {**hdrs, **app._cors_headers}
                 if isinstance(body, dict) and "__raw__" in body:
                     data = body["__raw__"]

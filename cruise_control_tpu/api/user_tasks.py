@@ -42,7 +42,21 @@ ENDPOINT_CATEGORY: Dict[str, str] = {
     "ADMIN": "cruise.control.admin",
     "REVIEW": "cruise.control.admin",
     "TOPIC_CONFIGURATION": "kafka.admin",
+    "SCENARIOS": "kafka.monitor",
 }
+
+
+def body_fingerprint(body) -> str:
+    """Stable short hash of a request body ("" for no body).  Dedup of
+    async tasks keys on (client, endpoint+query, BODY): two scenario
+    batches submitted with identical query strings but different JSON
+    bodies are different operations and must not coalesce."""
+    if body is None or body == "" or body == b"":
+        return ""
+    if isinstance(body, str):
+        body = body.encode("utf-8", errors="replace")
+    import hashlib
+    return hashlib.sha256(body).hexdigest()[:16]
 
 
 class TaskStatus(enum.Enum):
@@ -61,9 +75,14 @@ class UserTaskInfo:
     future: Future
     status: TaskStatus = TaskStatus.ACTIVE
     end_ms: float = 0.0
+    #: hash of the POST body this task was started with (dedup scope)
+    body_hash: str = ""
+    #: approximate JSON size of the completed result — large scenario
+    #: reports are visible in USER_TASKS without fetching them
+    result_bytes: Optional[int] = None
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "UserTaskId": self.task_id,
             "RequestURL": f"{self.endpoint}?{self.query}" if self.query
                           else self.endpoint,
@@ -71,6 +90,11 @@ class UserTaskInfo:
             "StartMs": self.start_ms,
             "Status": self.status.value,
         }
+        if self.body_hash:
+            out["RequestBodySha"] = self.body_hash
+        if self.result_bytes is not None:
+            out["ResultSizeBytes"] = self.result_bytes
+        return out
 
 
 class UserTaskManager:
@@ -100,19 +124,28 @@ class UserTaskManager:
         self._time = time_fn or _time.time
         self._lock = threading.Lock()
         self._tasks: Dict[str, UserTaskInfo] = {}
-        #: (client_id, endpoint+query) -> task id, for implicit resumption
-        self._by_request: Dict[Tuple[str, str], str] = {}
+        #: (client_id, endpoint+query, body hash) -> task id, for
+        #: implicit resumption (body_fingerprint("")="" for body-less
+        #: requests)
+        self._by_request: Dict[Tuple[str, str, str], str] = {}
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="user-task")
 
     # ------------------------------------------------------------------
     def get_or_create(self, endpoint: str, query: str, client_id: str,
                       operation: Callable[[], Any],
-                      task_id: Optional[str] = None) -> UserTaskInfo:
-        """Attach to an existing task (by explicit id or same client+URL)
-        or start `operation` on the pool."""
+                      task_id: Optional[str] = None,
+                      body: Optional[str] = None) -> UserTaskInfo:
+        """Attach to an existing task (by explicit id or same
+        client+URL+body) or start `operation` on the pool.
+
+        `body` is the raw POST body (endpoints like SCENARIOS carry
+        their payload there): its hash joins the implicit dedup key so
+        two different bodies behind identical query strings never
+        coalesce into one task."""
         now_ms = self._time() * 1000.0
-        key = (client_id, f"{endpoint}?{query}")
+        body_hash = body_fingerprint(body)
+        key = (client_id, f"{endpoint}?{query}", body_hash)
         with self._lock:
             self._expire(now_ms)
             if task_id is not None:
@@ -135,6 +168,13 @@ class UserTaskManager:
                         f"user task {task_id} belongs to "
                         f"{info.endpoint}?{info.query}, not "
                         f"{endpoint}?{query}")
+                if body_hash and body_hash != info.body_hash:
+                    # re-polls may omit the body (header-only long-poll);
+                    # a DIFFERENT body under a reused header may not
+                    # attach to the old operation
+                    raise ValueError(
+                        f"user task {task_id} was started with a "
+                        f"different request body")
                 return info
             existing = self._by_request.get(key)
             if existing is not None:
@@ -151,7 +191,7 @@ class UserTaskManager:
             def run() -> Any:
                 try:
                     result = operation()
-                    self._finish(new_id, TaskStatus.COMPLETED)
+                    self._finish(new_id, TaskStatus.COMPLETED, result)
                     return result
                 except BaseException:
                     self._finish(new_id, TaskStatus.COMPLETED_WITH_ERROR)
@@ -161,17 +201,35 @@ class UserTaskManager:
             # visible with future=None (a concurrent identical request
             # attaches to it immediately)
             info = UserTaskInfo(new_id, endpoint, query, client_id, now_ms,
-                                future=self._pool.submit(run))
+                                future=self._pool.submit(run),
+                                body_hash=body_hash)
             self._tasks[new_id] = info
             self._by_request[key] = new_id
         return info
 
-    def _finish(self, task_id: str, status: TaskStatus) -> None:
+    @staticmethod
+    def _result_size_bytes(result) -> Optional[int]:
+        import json
+        try:
+            return len(json.dumps(result, default=str))
+        except (TypeError, ValueError, RecursionError) as exc:
+            # size is a courtesy note; an unserializable result is the
+            # response layer's problem, not the task registry's
+            import logging
+            logging.getLogger(__name__).debug(
+                "result size estimation failed: %s", exc)
+            return None
+
+    def _finish(self, task_id: str, status: TaskStatus,
+                result: Any = None) -> None:
+        size = (self._result_size_bytes(result)
+                if status is TaskStatus.COMPLETED else None)
         with self._lock:
             info = self._tasks.get(task_id)
             if info is not None:
                 info.status = status
                 info.end_ms = self._time() * 1000.0
+                info.result_bytes = size
 
     def _retention_for(self, endpoint: str) -> float:
         cat = ENDPOINT_CATEGORY.get(endpoint)
@@ -185,13 +243,15 @@ class UserTaskManager:
         for tid in dead:
             info = self._tasks.pop(tid)
             self._by_request.pop(
-                (info.client_id, f"{info.endpoint}?{info.query}"), None)
+                (info.client_id, f"{info.endpoint}?{info.query}",
+                 info.body_hash), None)
 
         def evict_oldest_beyond(tasks, cap):
             done = sorted(tasks, key=lambda t: t.end_ms)
             for info in done[:max(0, len(done) - cap)]:
                 self._tasks.pop(info.task_id, None)
-                key = (info.client_id, f"{info.endpoint}?{info.query}")
+                key = (info.client_id, f"{info.endpoint}?{info.query}",
+                       info.body_hash)
                 # only sever the binding if it still points at THIS task —
                 # a newer ACTIVE task may have re-bound the same key
                 if self._by_request.get(key) == info.task_id:

@@ -76,6 +76,20 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--reason")
         p.add_argument("--review-id", type=int)
 
+    p = add("scenarios", help="batched what-if analysis (dry run)")
+    p.add_argument("--spec-file",
+                   help="JSON file with the request body "
+                        '({"scenarios": [...]}) or a bare scenario list')
+    p.add_argument("--spec",
+                   help="inline JSON (same format as --spec-file)")
+    p.add_argument("--goals", type=_csv,
+                   help="goal-list override for every scenario")
+    p.add_argument("--no-base", action="store_true",
+                   help="skip the implicit base (do-nothing) solve")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--reason")
+    p.add_argument("--review-id", type=int)
+
     p = add("topic_configuration", help="change topic replication factor")
     p.add_argument("topic")
     p.add_argument("replication_factor", type=int)
@@ -146,6 +160,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                       "demote_broker": client.demote_broker}[cmd]
                 dryrun = params.pop("dryrun")
                 out = fn(args.brokers, dryrun=dryrun, **params)
+        elif cmd == "scenarios":
+            if bool(args.spec_file) == bool(args.spec):
+                raise SystemExit(
+                    "scenarios needs exactly one of --spec-file/--spec")
+            raw = (open(args.spec_file).read() if args.spec_file
+                   else args.spec)
+            payload = json.loads(raw)
+            if isinstance(payload, list):     # bare scenario list
+                payload = {"scenarios": payload}
+            params = {}
+            if args.reason:
+                params["reason"] = args.reason
+            if args.review_id is not None:
+                params["review_id"] = args.review_id
+            out = client.scenarios(
+                payload.get("scenarios", []),
+                goals=args.goals or payload.get("goals"),
+                include_base=(not args.no_base
+                              and payload.get("includeBase", True)),
+                verbose=args.verbose, **params)
         elif cmd == "topic_configuration":
             out = client.topic_configuration(args.topic,
                                              args.replication_factor,

@@ -43,7 +43,12 @@ class CruiseControlClient:
     # ------------------------------------------------------------------
     def request(self, endpoint: str,
                 params: Optional[Mapping[str, object]] = None,
-                wait: Optional[bool] = None) -> dict:
+                wait: Optional[bool] = None,
+                body: Optional[dict] = None) -> dict:
+        """`body` (a JSON-serializable dict) becomes the POST request
+        body — SCENARIOS carries its spec list there.  Sent on the
+        first request only; once a `User-Task-ID` is attached, re-polls
+        go header-only (the server attaches by task id)."""
         if wait is None:
             wait = self._wait_default
         endpoint = endpoint.upper()
@@ -51,6 +56,7 @@ class CruiseControlClient:
         if legal is None:
             raise ValueError(f"unknown endpoint {endpoint}")
         method = "GET" if endpoint in GET_ENDPOINTS else "POST"
+        data = (json.dumps(body).encode() if body is not None else None)
         query = {}
         for k, v in (params or {}).items():
             if v is None:
@@ -70,7 +76,11 @@ class CruiseControlClient:
         deadline = time.time() + self._timeout_s
         task_id: Optional[str] = None
         while True:
-            status, headers, body = self._http(method, url, task_id)
+            # once a task id is attached, re-polls go header-only: the
+            # server allows body-less re-polls, and re-uploading a large
+            # spec body every poll interval is pure waste
+            status, headers, body = self._http(
+                method, url, task_id, data=None if task_id else data)
             task_id = headers.get(USER_TASK_ID_HEADER, task_id)
             if status == 200:
                 return body
@@ -90,9 +100,11 @@ class CruiseControlClient:
             raise CruiseControlClientError(
                 status, body.get("errorMessage", str(body)))
 
-    def _http(self, method: str, url: str, task_id: Optional[str]
-              ):
-        req = urllib.request.Request(url, method=method)
+    def _http(self, method: str, url: str, task_id: Optional[str],
+              data: Optional[bytes] = None):
+        req = urllib.request.Request(url, method=method, data=data)
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
         if self._auth:
             req.add_header("Authorization", self._auth)
         if task_id:
@@ -193,3 +205,18 @@ class CruiseControlClient:
 
     def review_board(self) -> dict:
         return self.request("REVIEW_BOARD")
+
+    def scenarios(self, scenarios: Sequence[dict],
+                  goals: Optional[Sequence[str]] = None,
+                  include_base: bool = True,
+                  verbose: bool = False, **params) -> dict:
+        """Batched what-if analysis (dry-run only).  `scenarios` is a
+        list of scenario objects in the JSON form of
+        scenario/spec.py::SCENARIO_SPEC_SCHEMA."""
+        body: dict = {"scenarios": list(scenarios)}
+        if goals:
+            body["goals"] = list(goals)
+        if not include_base:
+            body["includeBase"] = False
+        return self.request("SCENARIOS", {"verbose": verbose, **params},
+                            body=body)

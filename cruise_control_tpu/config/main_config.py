@@ -247,6 +247,26 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
              "Cooldown after the breaker trips; once elapsed the next "
              "solve probes one rung up and success re-closes the "
              "breaker.")
+    d.define("scenario.engine.enabled", Type.BOOLEAN, True, None, _M,
+             "Serve the SCENARIOS endpoint and multi-candidate broker "
+             "operations through the batched what-if engine "
+             "(scenario/engine.py).  Disabled: SCENARIOS requests fail "
+             "and candidate-set requests are rejected.")
+    d.define("scenario.max.batch.size", Type.INT, 32,
+             in_range(min_value=1), _M,
+             "Scenarios evaluated per batched device program; larger "
+             "batches amortize one compile over more scenarios but cost "
+             "K x the solve's HBM working set (see docs/SCENARIOS.md "
+             "sizing guidance).")
+    d.define("scenario.max.oom.halvings", Type.INT, 4,
+             in_range(min_value=0), _L,
+             "How many times a RESOURCE_EXHAUSTED scenario batch is "
+             "halved and retried before the engine descends its "
+             "degradation ladder (per-scenario eager loop, then host "
+             "CPU fallback).")
+    d.define("scenario.include.base.solve", Type.BOOLEAN, True, None, _L,
+             "Prepend a no-op base scenario to every SCENARIOS batch so "
+             "the report diffs each what-if against doing nothing.")
     d.define("proposal.warm.start.enabled", Type.BOOLEAN, True, None, _L,
              "Seed default-stack solves from the previous solve's final "
              "placement when the model generation moved but the topology "

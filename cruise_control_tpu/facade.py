@@ -56,6 +56,11 @@ from cruise_control_tpu.monitor.completeness import (
     ModelCompletenessRequirements)
 from cruise_control_tpu.monitor.load_monitor import LoadMonitor
 from cruise_control_tpu.monitor.sampling.sampler import MetricSampler
+from cruise_control_tpu.scenario.engine import (BASE_SCENARIO_NAME,
+                                                ScenarioBatchResult,
+                                                ScenarioEngine)
+from cruise_control_tpu.scenario.spec import (BrokerAdd, ScenarioSpec,
+                                              candidate_broker_sets)
 from cruise_control_tpu.utils import faults
 from cruise_control_tpu.utils.metrics import MetricRegistry
 
@@ -113,6 +118,10 @@ class OperationResult:
     execution_uuid: Optional[str] = None
     proposals: List = dataclasses.field(default_factory=list)
     dryrun: bool = True
+    #: ranked what-if report when the request carried MULTIPLE candidate
+    #: broker sets and was served by the scenario engine (always dry-run;
+    #: `proposals` then holds the best-ranked candidate's proposals)
+    scenario_report: Optional[dict] = None
 
     def __post_init__(self) -> None:
         if self.optimizer_result is not None and not self.proposals:
@@ -173,7 +182,11 @@ class CruiseControl:
                  solver_retry_backoff_max_s: float = 60.0,
                  solver_breaker_failure_threshold: int = 3,
                  solver_breaker_cooldown_s: float = 300.0,
-                 precompute_solve_deadline_s: float = 1800.0) -> None:
+                 precompute_solve_deadline_s: float = 1800.0,
+                 scenario_engine_enabled: bool = True,
+                 scenario_max_batch_size: int = 32,
+                 scenario_max_oom_halvings: int = 4,
+                 scenario_include_base: bool = True) -> None:
         self._admin = admin
         self._time = time_fn or _time.time
         self._sleep = sleep_fn or _time.sleep
@@ -300,6 +313,24 @@ class CruiseControl:
         # host/CPU, trip a breaker pinning the degraded rung until
         # cooldown.  Shared by request-path and precompute solves so a
         # background failure protects foreground requests too.
+        # batched what-if scenario engine (scenario/engine.py): K cluster
+        # variants evaluated in ONE vmapped device program, behind the
+        # SCENARIOS endpoint and the multi-candidate broker operations.
+        # It shares the facade's goal optimizers (so scenario programs
+        # share the process-wide trace cache) but owns its OWN
+        # degradation ladder — a failing what-if batch must not pin the
+        # request-path solver
+        self._scenario_enabled = scenario_engine_enabled
+        self._scenario_include_base = scenario_include_base
+        self.scenario_engine = ScenarioEngine(
+            self._optimizer_for, constraint=self._constraint,
+            max_batch_size=scenario_max_batch_size,
+            max_oom_halvings=scenario_max_oom_halvings,
+            breaker_failure_threshold=solver_breaker_failure_threshold,
+            breaker_cooldown_s=solver_breaker_cooldown_s,
+            balancedness_weights=balancedness_weights,
+            time_fn=self._time)
+
         self._solver_degradation_enabled = solver_degradation_enabled
         self._solver_max_retries_per_rung = max(0,
                                                 solver_max_retries_per_rung)
@@ -328,6 +359,15 @@ class CruiseControl:
         self.metrics.gauge(
             "sampler-corrupt-records",
             lambda: getattr(self._sampler, "num_corrupt_records", 0))
+        # scenario-* sensors: the engine marks its own meters/timers
+        # (scenario-compile-timer / scenario-execute-timer /
+        # scenario-oom-halvings / scenario-descents) once the registry is
+        # attached; the gauges read engine telemetry
+        self.scenario_engine.attach_metrics(self.metrics)
+        self.metrics.gauge("scenario-batch-size",
+                           lambda: self.scenario_engine.last_batch_size)
+        self.metrics.gauge("scenario-rung",
+                           lambda: int(self.scenario_engine.ladder.rung))
 
     # ------------------------------------------------------------------
     # lifecycle (reference startUp order :178-184)
@@ -834,12 +874,99 @@ class CruiseControl:
         return self._maybe_execute(result, dryrun, reason, strategy,
                                    **execute_kwargs)
 
+    # ------------------------------------------------------------------
+    # batched what-if scenarios (scenario/engine.py; SCENARIOS endpoint)
+    # ------------------------------------------------------------------
+    def evaluate_scenarios(self, specs: Sequence[ScenarioSpec],
+                           goals: Optional[Sequence[str]] = None,
+                           include_base: Optional[bool] = None,
+                           include_proposals: bool = True,
+                           reason: str = "scenarios"
+                           ) -> ScenarioBatchResult:
+        """Evaluate K what-if cluster variants in one batched device
+        solve (DRY-RUN ONLY — the engine can rank hypotheticals, never
+        execute them).  Unless disabled, a no-op base scenario is
+        prepended so the report can diff every what-if against "do
+        nothing"."""
+        if not self._scenario_enabled:
+            raise ValueError(
+                "the scenario engine is disabled "
+                "(scenario.engine.enabled=false)")
+        specs = list(specs)
+        if not specs:
+            raise ValueError("no scenarios given")
+        if include_base is None:
+            include_base = self._scenario_include_base
+        if include_base and not any(s.name == BASE_SCENARIO_NAME
+                                    for s in specs):
+            specs = [ScenarioSpec(name=BASE_SCENARIO_NAME)] + specs
+        state, topo = self.cluster_model()
+        gen_options = self._options_generator.generate(
+            OptimizationOptions(), topo)
+        OPERATION_LOG.info("%s: evaluating %d scenarios (dry run)",
+                           reason, len(specs))
+        return self.scenario_engine.evaluate(
+            state, topo, specs, goals=goals, options=gen_options,
+            include_proposals=include_proposals)
+
+    def _broker_candidates(self, op: str, sets, goals, dryrun: bool,
+                           reason: str) -> OperationResult:
+        """ADD/REMOVE/DEMOTE_BROKER with K candidate broker sets: one
+        batched what-if ranks the alternatives; the best candidate's
+        proposals come back with the full report attached.  Never
+        executes — choosing a candidate IS the analysis; re-submit the
+        winner as a single set to act on it."""
+        from cruise_control_tpu.scenario.report import batch_report, rank
+        if not dryrun:
+            raise ValueError(
+                f"{op} with multiple candidate broker sets is a what-if "
+                f"analysis (dry-run only); execute with ONE broker set")
+        specs = []
+        for s in sets:
+            name = f"{op}-{'-'.join(str(b) for b in s)}"
+            if op == "add":
+                specs.append(ScenarioSpec(
+                    name=name,
+                    add_brokers=tuple(BrokerAdd(broker_id=b) for b in s),
+                    only_move_to_added=True,
+                    goals=tuple(goals) if goals else None))
+            elif op == "remove":
+                specs.append(ScenarioSpec(
+                    name=name, remove_brokers=tuple(s),
+                    goals=tuple(goals) if goals else None))
+            else:
+                specs.append(ScenarioSpec(
+                    name=name, demote_brokers=tuple(s),
+                    goals=("PreferredLeaderElectionGoal",)))
+        result = self.evaluate_scenarios(specs, reason=reason)
+        candidates = [o for o in result.outcomes
+                      if o.spec.name != BASE_SCENARIO_NAME]
+        best = rank(candidates)[0]
+        OPERATION_LOG.info(
+            "%s: best of %d candidates is %r (feasible=%s, "
+            "balancedness=%.1f), dryrun=True", reason, len(candidates),
+            best.spec.name, best.feasible, best.balancedness)
+        return OperationResult(None, proposals=list(best.proposals),
+                               dryrun=True,
+                               scenario_report=batch_report(result))
+
     def add_brokers(self, broker_ids: Sequence[int],
                     goals: Optional[Sequence[str]] = None,
                     dryrun: bool = True, reason: str = "add brokers",
                     **execute_kwargs) -> OperationResult:
         """Move replicas ONTO the new brokers only (reference
-        AddBrokerRunnable; OptimizationVerifier forbids old→old moves)."""
+        AddBrokerRunnable; OptimizationVerifier forbids old→old moves).
+
+        `broker_ids` may be a sequence of SEQUENCES — K alternative
+        broker sets — in which case the scenario engine evaluates all K
+        in one batched what-if (dry-run only) and returns the ranked
+        report; a flat list keeps today's single-solve path untouched."""
+        sets = candidate_broker_sets(broker_ids)
+        if sets is not None and len(sets) > 1:
+            return self._broker_candidates("add", sets, goals, dryrun,
+                                           reason)
+        if sets is not None:
+            broker_ids = sets[0]
         self._sanity_check_execution(dryrun)
         state, topo = self.cluster_model()
         idx = topo.broker_index
@@ -861,7 +988,14 @@ class CruiseControl:
                        **execute_kwargs) -> OperationResult:
         """Drain all replicas off the given brokers (reference
         RemoveBrokerRunnable: brokers modeled as dead so self-healing
-        relocates everything)."""
+        relocates everything).  A sequence of sequences routes through
+        the scenario engine (see add_brokers)."""
+        sets = candidate_broker_sets(broker_ids)
+        if sets is not None and len(sets) > 1:
+            return self._broker_candidates("remove", sets, goals, dryrun,
+                                           reason)
+        if sets is not None:
+            broker_ids = sets[0]
         self._sanity_check_execution(dryrun)
         state, topo = self.cluster_model()
         idx = topo.broker_index
@@ -877,7 +1011,15 @@ class CruiseControl:
                        dryrun: bool = True, reason: str = "demote brokers",
                        **execute_kwargs) -> OperationResult:
         """Shift leadership (and preferred-leader order) off the brokers
-        (reference DemoteBrokerRunnable + PreferredLeaderElectionGoal)."""
+        (reference DemoteBrokerRunnable + PreferredLeaderElectionGoal).
+        A sequence of sequences routes through the scenario engine (see
+        add_brokers)."""
+        sets = candidate_broker_sets(broker_ids)
+        if sets is not None and len(sets) > 1:
+            return self._broker_candidates("demote", sets, None, dryrun,
+                                           reason)
+        if sets is not None:
+            broker_ids = sets[0]
         self._sanity_check_execution(dryrun)
         state, topo = self.cluster_model()
         idx = topo.broker_index
@@ -1003,7 +1145,7 @@ class CruiseControl:
     def state(self, substates: Optional[Sequence[str]] = None) -> dict:
         want = {s.lower() for s in (substates or
                                     ("monitor", "executor", "analyzer",
-                                     "anomaly_detector"))}
+                                     "anomaly_detector", "scenario"))}
         out: dict = {}
         if "monitor" in want:
             ms = self.load_monitor.get_state()
@@ -1036,6 +1178,11 @@ class CruiseControl:
             }
         if "anomaly_detector" in want:
             out["AnomalyDetectorState"] = self.anomaly_detector.to_json()
+        if "scenario" in want:
+            out["ScenarioEngineState"] = {
+                "enabled": self._scenario_enabled,
+                **self.scenario_engine.to_json(),
+            }
         if "sensors" in want:
             out["Sensors"] = self.metrics.to_json()
         return out

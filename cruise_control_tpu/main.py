@@ -299,6 +299,13 @@ def build_cruise_control(config: CruiseControlConfig, admin,
             "solver.circuit.breaker.cooldown.ms") / 1e3,
         precompute_solve_deadline_s=config.get_long(
             "proposal.precompute.solve.deadline.ms") / 1e3,
+        scenario_engine_enabled=config.get_boolean(
+            "scenario.engine.enabled"),
+        scenario_max_batch_size=config.get_int("scenario.max.batch.size"),
+        scenario_max_oom_halvings=config.get_int(
+            "scenario.max.oom.halvings"),
+        scenario_include_base=config.get_boolean(
+            "scenario.include.base.solve"),
         monitor_kwargs=dict(
             sample_store=sample_store,
             num_windows=config.get_int("num.partition.metrics.windows"),

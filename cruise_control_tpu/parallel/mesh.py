@@ -99,6 +99,21 @@ def _pad_to_multiple(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
+def pad_leading(x, pad: int, fill):
+    """Pad `pad` rows of `fill` onto the leading axis of `x` (later axes
+    untouched) — shared by the replica-axis mesh padding below and the
+    scenario compiler's broker-axis padding (scenario/compiler.py), so
+    heterogeneous shapes always pad the same way.  Numpy inputs stay on
+    host (np.pad): the scenario compiler pads many small host arrays
+    per batch and must not pay a device round trip per array."""
+    if pad <= 0:
+        return x
+    widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+    if isinstance(x, np.ndarray):
+        return np.pad(x, widths, constant_values=fill)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
 def pad_state(state: ClusterState, multiple: int) -> ClusterState:
     """Pad the replica axis so it divides the mesh size; padding rows are
     invalid replicas parked on broker 0."""
@@ -109,8 +124,7 @@ def pad_state(state: ClusterState, multiple: int) -> ClusterState:
     pad = target - num_r
 
     def pad_arr(x, fill):
-        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
-        return jnp.pad(x, widths, constant_values=fill)
+        return pad_leading(x, pad, fill)
 
     return state.replace(
         replica_valid=pad_arr(state.replica_valid, False),

@@ -1,0 +1,24 @@
+"""Batched what-if scenario engine.
+
+`spec` — declarative ScenarioSpec + JSON schema; `compiler` — K specs ->
+one padded, stacked tensor batch; `engine` — vmapped runs of the fused
+goal pipeline with OOM halving and ladder degradation; `report` —
+ranking + diff against the base solve.  See docs/SCENARIOS.md.
+"""
+from cruise_control_tpu.scenario.engine import (BASE_SCENARIO_NAME,
+                                                ScenarioBatchResult,
+                                                ScenarioEngine,
+                                                ScenarioOutcome)
+from cruise_control_tpu.scenario.spec import (SCENARIO_SPEC_SCHEMA,
+                                              SCENARIOS_REQUEST_SCHEMA,
+                                              BrokerAdd, ScenarioSpec,
+                                              ScenarioSpecError,
+                                              candidate_broker_sets,
+                                              parse_scenarios_payload)
+
+__all__ = [
+    "BASE_SCENARIO_NAME", "BrokerAdd", "SCENARIO_SPEC_SCHEMA",
+    "SCENARIOS_REQUEST_SCHEMA", "ScenarioBatchResult", "ScenarioEngine",
+    "ScenarioOutcome", "ScenarioSpec", "ScenarioSpecError",
+    "candidate_broker_sets", "parse_scenarios_payload",
+]

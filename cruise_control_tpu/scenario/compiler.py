@@ -1,0 +1,291 @@
+"""Scenario compiler: K specs + one base ClusterModel -> one stacked
+tensor batch.
+
+Pure host-side assembly (numpy; zero device dispatch): each spec is
+materialized into a variant `ClusterState` sharing ONE padded shape with
+every other variant of the batch — heterogeneous scenarios (different
+broker counts, new racks/hosts) pad the broker/rack/host axes to the
+batch maximum, reusing the leading-axis padding helper of
+`parallel/mesh.py` — and the variants then stack along a new leading
+scenario axis so the engine can `vmap` the fused goal pipeline over them
+(one compile amortized over K scenarios).
+
+Padded broker rows are dead (`broker_alive=False`) with zero capacity
+and hold no replicas: every statistic and goal masks on `broker_alive`,
+so a scenario with fewer brokers can never leak padded-broker load into
+its stats (pinned in tests/test_scenario.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationContext,
+                                                 OptimizationOptions,
+                                                 make_context)
+from cruise_control_tpu.common.resources import NUM_RESOURCES
+from cruise_control_tpu.model.builder import ClusterTopology
+from cruise_control_tpu.model.state import ClusterState
+from cruise_control_tpu.scenario.spec import ScenarioSpec, ScenarioSpecError
+
+
+@dataclasses.dataclass
+class CompiledBatch:
+    """K materialized variants of one base model, ready to stack.
+
+    `states`/`contexts` are LISTS of per-scenario pytrees with identical
+    shapes and static fields; `stack()` turns them into the leading-axis
+    batch the engine vmaps over.  `topologies` carries the per-scenario
+    name<->index maps (added brokers extend them) for the host-side
+    proposal diff."""
+
+    specs: List[ScenarioSpec]
+    states: List[ClusterState]
+    contexts: List[OptimizationContext]
+    topologies: List[ClusterTopology]
+    num_brokers: int
+    #: i32[P, RF] host-side partition->replica rows: replica/partition
+    #: membership is scenario-invariant (specs mutate brokers and loads,
+    #: never membership), so ONE table serves every scenario's diff
+    partition_rows: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0, 1), np.int32))
+
+    def stack(self) -> Tuple[ClusterState, OptimizationContext]:
+        import jax
+        import jax.numpy as jnp
+        stacked_state = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                     *self.states)
+        stacked_ctx = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                   *self.contexts)
+        return stacked_state, stacked_ctx
+
+    def slice(self, start: int, stop: Optional[int]) -> "CompiledBatch":
+        """Sub-batch view (the OOM-halving retry re-dispatches halves
+        without re-materializing anything)."""
+        return CompiledBatch(
+            specs=self.specs[start:stop], states=self.states[start:stop],
+            contexts=self.contexts[start:stop],
+            topologies=self.topologies[start:stop],
+            num_brokers=self.num_brokers,
+            partition_rows=self.partition_rows)
+
+
+def _batch_geometry(base_state: ClusterState, topology: ClusterTopology,
+                    specs: Sequence[ScenarioSpec]):
+    """Shared padded sizes for the batch: broker count, rack/host counts
+    (hypothetical brokers may introduce new racks; each gets its own
+    host), and the per-spec hypothetical-broker orderings."""
+    base_b = base_state.num_brokers
+    known = set(topology.broker_ids)
+    rack_index = {r: i for i, r in enumerate(topology.rack_ids)}
+    new_racks: List[str] = []
+    max_new = 0
+    for spec in specs:
+        hypothetical = [a for a in spec.add_brokers
+                        if a.broker_id not in known]
+        max_new = max(max_new, len(hypothetical))
+        for a in hypothetical:
+            if (a.rack is not None and a.rack not in rack_index
+                    and a.rack not in new_racks):
+                new_racks.append(a.rack)
+    for i, r in enumerate(new_racks):
+        rack_index[r] = len(topology.rack_ids) + i
+    return (base_b + max_new, rack_index,
+            base_state.num_racks + len(new_racks),
+            base_state.num_hosts + max_new)
+
+
+def _pad_broker_axis(arrays: dict, pad: int) -> dict:
+    from cruise_control_tpu.parallel.mesh import pad_leading
+    fills = dict(broker_alive=False, broker_new=False, broker_demoted=False,
+                 broker_bad_disks=False, broker_capacity=0.0,
+                 broker_rack=0, broker_host=0)
+    return {k: pad_leading(v, pad, fills[k]) for k, v in arrays.items()}
+
+
+def materialize(base_state: ClusterState, topology: ClusterTopology,
+                spec: ScenarioSpec, num_brokers: int, rack_index: dict,
+                num_racks: int, num_hosts: int
+                ) -> Tuple[ClusterState, ClusterTopology,
+                           OptimizationOptions]:
+    """One variant (state, topology, per-scenario options) at the shared
+    padded geometry.  Everything is host-side numpy; the caller stacks
+    and ships the batch in one go."""
+    import jax.numpy as jnp
+
+    spec.validate(topology)
+    base_b = base_state.num_brokers
+    pad = num_brokers - base_b
+    broker_index = dict(topology.broker_index)
+    broker_ids = list(topology.broker_ids)
+    host_names = list(topology.host_names)
+    rack_ids = sorted(rack_index, key=rack_index.get)
+
+    arrays = _pad_broker_axis(
+        dict(broker_alive=np.asarray(base_state.broker_alive),
+             broker_new=np.asarray(base_state.broker_new),
+             broker_demoted=np.asarray(base_state.broker_demoted),
+             broker_bad_disks=np.asarray(base_state.broker_bad_disks),
+             broker_capacity=np.asarray(base_state.broker_capacity,
+                                        dtype=np.float32),
+             broker_rack=np.asarray(base_state.broker_rack),
+             broker_host=np.asarray(base_state.broker_host)), pad)
+    arrays = {k: np.array(v) for k, v in arrays.items()}
+    alive = arrays["broker_alive"]
+    mean_cap = (np.asarray(base_state.broker_capacity)[alive[:base_b]]
+                .mean(axis=0) if alive[:base_b].any()
+                else np.zeros(NUM_RESOURCES))
+
+    # broker additions: known ids are marked new in place (freshly joined,
+    # ADD_BROKER semantics); unknown ids take the next padded slot
+    from cruise_control_tpu.scenario.spec import RESOURCE_NAMES
+    next_slot = base_b
+    added_ids: List[int] = []
+    for add in spec.add_brokers:
+        added_ids.append(add.broker_id)
+        if add.broker_id in topology.broker_index:
+            b = topology.broker_index[add.broker_id]
+            if add.capacity:
+                for name, v in add.capacity.items():
+                    arrays["broker_capacity"][b,
+                                              RESOURCE_NAMES.index(name)] = v
+        else:
+            if next_slot >= num_brokers:
+                raise ScenarioSpecError(
+                    f"{spec.name}: more hypothetical brokers than the "
+                    f"batch geometry allows")
+            b = next_slot
+            next_slot += 1
+            broker_index[add.broker_id] = b
+            broker_ids.append(add.broker_id)
+            host_names.append(f"scenario-host-{add.broker_id}")
+            arrays["broker_alive"][b] = True
+            rack = (rack_index[add.rack] if add.rack is not None
+                    else b % max(len(topology.rack_ids), 1))
+            arrays["broker_rack"][b] = rack
+            arrays["broker_host"][b] = base_state.num_hosts + (b - base_b)
+            cap = np.asarray(mean_cap, dtype=np.float32).copy()
+            if add.capacity:
+                for name, v in add.capacity.items():
+                    cap[RESOURCE_NAMES.index(name)] = v
+            arrays["broker_capacity"][b] = cap
+        arrays["broker_new"][b] = True
+
+    replica_offline = np.array(np.asarray(base_state.replica_offline))
+    original_offline = np.array(
+        np.asarray(base_state.replica_original_offline))
+    replica_broker = np.asarray(base_state.replica_broker)
+    replica_valid = np.asarray(base_state.replica_valid)
+
+    for b_ext in spec.remove_brokers:
+        b = broker_index[b_ext]
+        arrays["broker_alive"][b] = False
+        on_broker = (replica_broker == b) & replica_valid
+        # replicas on a broker still in JBOD-broken state stay offline
+        # after a revive — removal only ever ADDS offline flags here
+        replica_offline |= on_broker
+        original_offline |= on_broker
+    for b_ext in spec.demote_brokers:
+        arrays["broker_demoted"][broker_index[b_ext]] = True
+    for b_ext, caps in spec.capacity_overrides.items():
+        from cruise_control_tpu.scenario.spec import RESOURCE_NAMES
+        for name, v in caps.items():
+            arrays["broker_capacity"][broker_index[b_ext],
+                                      RESOURCE_NAMES.index(name)] = v
+
+    scale = spec.load_scale_vector()
+    base_load = np.asarray(base_state.replica_base_load)
+    bonus = np.asarray(base_state.partition_leader_bonus)
+    if spec.load_scale:
+        base_load = base_load * scale[None, :]
+        bonus = bonus * scale[None, :]
+
+    state = ClusterState(
+        replica_valid=jnp.asarray(replica_valid),
+        replica_partition=base_state.replica_partition,
+        replica_broker=base_state.replica_broker,
+        replica_disk=base_state.replica_disk,
+        replica_is_leader=base_state.replica_is_leader,
+        replica_offline=jnp.asarray(replica_offline),
+        replica_original_offline=jnp.asarray(original_offline),
+        replica_base_load=jnp.asarray(base_load, dtype=jnp.float32),
+        partition_topic=base_state.partition_topic,
+        partition_leader_bonus=jnp.asarray(bonus, dtype=jnp.float32),
+        broker_alive=jnp.asarray(arrays["broker_alive"]),
+        broker_new=jnp.asarray(arrays["broker_new"]),
+        broker_demoted=jnp.asarray(arrays["broker_demoted"]),
+        broker_bad_disks=jnp.asarray(arrays["broker_bad_disks"]),
+        broker_capacity=jnp.asarray(arrays["broker_capacity"],
+                                    dtype=jnp.float32),
+        broker_rack=jnp.asarray(arrays["broker_rack"], dtype=jnp.int32),
+        broker_host=jnp.asarray(arrays["broker_host"], dtype=jnp.int32),
+        disk_broker=base_state.disk_broker,
+        disk_capacity=base_state.disk_capacity,
+        disk_alive=base_state.disk_alive,
+        num_racks=num_racks,
+        num_hosts=num_hosts,
+        num_topics=base_state.num_topics,
+    )
+    variant_topo = ClusterTopology(
+        broker_ids=broker_ids,
+        rack_ids=rack_ids,
+        host_names=host_names,
+        topics=list(topology.topics),
+        partitions=list(topology.partitions),
+        disk_names=list(topology.disk_names),
+    )
+    options = OptimizationOptions(
+        requested_destination_broker_ids=(
+            frozenset(added_ids) if spec.only_move_to_added
+            else frozenset()))
+    return state, variant_topo, options
+
+
+def compile_batch(base_state: ClusterState, topology: ClusterTopology,
+                  specs: Sequence[ScenarioSpec],
+                  constraint: Optional[BalancingConstraint] = None,
+                  options: Optional[OptimizationOptions] = None,
+                  table_slots_override: Optional[int] = None
+                  ) -> CompiledBatch:
+    """Materialize + context-build every spec at one shared geometry.
+
+    Per-scenario contexts CAN differ in their array planes (different
+    dead-broker masks, destination restrictions) — they stack along the
+    scenario axis like the states do — but their STATIC fields must
+    agree for one program to serve the whole batch; `table_slots` is
+    therefore unified to the batch maximum."""
+    constraint = constraint or BalancingConstraint()
+    base_options = options or OptimizationOptions()
+    geometry = _batch_geometry(base_state, topology, specs)
+    num_brokers, rack_index, num_racks, num_hosts = geometry
+
+    states: List[ClusterState] = []
+    contexts: List[OptimizationContext] = []
+    topologies: List[ClusterTopology] = []
+    for spec in specs:
+        state, topo, spec_options = materialize(
+            base_state, topology, spec, num_brokers, rack_index,
+            num_racks, num_hosts)
+        merged = base_options
+        if spec_options.requested_destination_broker_ids:
+            merged = dataclasses.replace(
+                base_options,
+                requested_destination_broker_ids=(
+                    spec_options.requested_destination_broker_ids))
+        contexts.append(make_context(state, constraint, merged, topo))
+        states.append(state)
+        topologies.append(topo)
+
+    slots = (table_slots_override if table_slots_override is not None
+             else max((c.table_slots for c in contexts), default=0))
+    contexts = [c if c.table_slots == slots
+                else dataclasses.replace(c, table_slots=slots)
+                for c in contexts]
+    from cruise_control_tpu.analyzer.context import partition_replica_index
+    return CompiledBatch(specs=list(specs), states=states,
+                         contexts=contexts, topologies=topologies,
+                         num_brokers=num_brokers,
+                         partition_rows=partition_replica_index(states[0]))

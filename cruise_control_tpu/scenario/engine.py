@@ -1,0 +1,674 @@
+"""Batched what-if engine: K scenario solves in one device program.
+
+The PR-1 fused goal pipeline (analyzer/optimizer.py: pre program, fused
+per-goal segments with on-device prev-stats threading, post sweep) is
+`vmap`ped over a new leading SCENARIO axis: one compile serves every
+scenario of a batch, the per-goal instruments accumulate into
+[K, G]-shaped device tables, and the whole batch pays exactly ONE
+end-of-batch instrument fetch plus one placement fetch for the host-side
+proposal diff — the same 2-`device_get` transfer discipline the
+single-solve path pins in tests/test_fused_pipeline.py, now per BATCH
+instead of per solve (pinned in tests/test_scenario.py).
+
+Failure discipline (the PR-2 ladder, applied to batches):
+
+* RESOURCE_EXHAUSTED on the batched dispatch halves the batch and
+  retries both halves (a K-scenario program can exceed HBM where K/2
+  fits; see docs/SCENARIOS.md for sizing guidance), up to
+  `max_oom_halvings` times;
+* any other batched failure descends the engine's own degradation
+  ladder (analyzer/degradation.py): EAGER = a per-scenario loop through
+  `GoalOptimizer.optimizations(eager_driver=True)`, CPU =
+  `model/cpu_model.host_fallback_solve` per scenario — scenario
+  evaluation degrades but never goes dark;
+* per-scenario solver VERDICTS (unsatisfiable hard goal, stats
+  regression, invalid inputs, unhealed offline replicas) are NOT
+  failures: the batched path reports them as infeasible outcomes from
+  the instrument fetch, so one doomed scenario cannot poison its
+  batchmates.
+
+Fault-injection sites: ``scenario.compile`` (batched program build) and
+``scenario.execute`` (batched dispatch) — the eager/CPU rungs run under
+the optimizer's own ``optimizer.*`` sites.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time as _time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions)
+from cruise_control_tpu.analyzer.degradation import (CircuitBreaker,
+                                                     DegradationLadder,
+                                                     InvalidModelInputError,
+                                                     SolverRung,
+                                                     classify_failure)
+from cruise_control_tpu.analyzer.goals.base import OptimizationFailure
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.state import ClusterState
+from cruise_control_tpu.scenario.compiler import (CompiledBatch,
+                                                  _batch_geometry,
+                                                  compile_batch, materialize)
+from cruise_control_tpu.scenario.spec import ScenarioSpec
+from cruise_control_tpu.utils import faults
+
+LOG = logging.getLogger(__name__)
+
+#: base-solve scenario prepended by the facade (spec.is_noop() == True)
+BASE_SCENARIO_NAME = "__base__"
+
+
+def _is_resource_exhausted(exc: BaseException) -> bool:
+    text = f"{type(exc).__name__}: {exc}"
+    return ("RESOURCE_EXHAUSTED" in text
+            or "resource exhausted" in text.lower()
+            or "out of memory" in text.lower())
+
+
+class _TableOverflow(Exception):
+    """Post-heal replica concentration overflowed the broker-table width;
+    the chunk re-runs with `slots` (mirrors the single-solve re-run in
+    GoalOptimizer.optimizations)."""
+
+    def __init__(self, slots: int) -> None:
+        super().__init__(f"broker table overflow; need width {slots}")
+        self.slots = slots
+
+
+@dataclasses.dataclass
+class ScenarioOutcome:
+    """One scenario's verdict + instruments (host-side values only)."""
+
+    spec: ScenarioSpec
+    feasible: bool
+    reason: str = ""                       #: why infeasible ("" when not)
+    rung: str = "FUSED"                    #: rung that served this solve
+    violated_goals_before: List[str] = dataclasses.field(
+        default_factory=list)
+    violated_goals_after: List[str] = dataclasses.field(
+        default_factory=list)
+    violated_broker_counts: Dict[str, Tuple[int, int, int]] = \
+        dataclasses.field(default_factory=dict)
+    rounds_by_goal: Dict[str, int] = dataclasses.field(default_factory=dict)
+    stats_before: Optional[object] = None  #: host ClusterModelStats
+    stats_after: Optional[object] = None
+    balancedness: float = 0.0
+    num_replica_moves: int = 0
+    num_leadership_moves: int = 0
+    data_to_move: float = 0.0
+    proposals: List = dataclasses.field(default_factory=list)
+
+    @property
+    def num_violated_goals_after(self) -> int:
+        return len(self.violated_goals_after)
+
+
+@dataclasses.dataclass
+class ScenarioBatchResult:
+    """The whole evaluation: outcomes in request order + batch telemetry."""
+
+    outcomes: List[ScenarioOutcome]
+    duration_s: float = 0.0
+    compile_s: float = 0.0
+    solve_s: float = 0.0
+    oom_halvings: int = 0
+    batch_sizes: List[int] = dataclasses.field(default_factory=list)
+    rung: str = "FUSED"
+
+    def outcome(self, name: str) -> Optional[ScenarioOutcome]:
+        for o in self.outcomes:
+            if o.spec.name == name:
+                return o
+        return None
+
+
+class ScenarioEngine:
+    """Evaluates batches of what-if scenarios against one base model.
+
+    `optimizer_factory(goal_names_or_None)` returns the GoalOptimizer to
+    run (the facade passes its own, so scenario programs share the
+    process-wide trace cache with request-path solves).  The engine owns
+    its OWN degradation ladder — a failing scenario batch must not pin
+    the request-path solver, and vice versa."""
+
+    def __init__(self, optimizer_factory: Callable,
+                 constraint: Optional[BalancingConstraint] = None,
+                 max_batch_size: int = 32,
+                 max_oom_halvings: int = 4,
+                 breaker_failure_threshold: int = 3,
+                 breaker_cooldown_s: float = 300.0,
+                 balancedness_weights: Tuple[float, float] = (1.1, 1.5),
+                 metrics=None,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._optimizer_factory = optimizer_factory
+        self._constraint = constraint or BalancingConstraint()
+        self.balancedness_weights = balancedness_weights
+        self.max_batch_size = max(1, max_batch_size)
+        self.max_oom_halvings = max(0, max_oom_halvings)
+        self._metrics = metrics
+        self._time = time_fn or _time.time
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_failure_threshold,
+            cooldown_s=breaker_cooldown_s, time_fn=self._time)
+        self.ladder = DegradationLadder(self.breaker)
+        self._lock = threading.Lock()
+        #: serializes whole evaluations: concurrent SCENARIOS user tasks
+        #: would otherwise interleave per-call telemetry and double-pay
+        #: identical program compiles (device solves serialize on one
+        #: chip anyway, so queueing here costs nothing extra)
+        self._eval_lock = threading.Lock()
+        #: AOT-compiled vmapped programs, LRU-bounded (each holds traced
+        #: jaxprs + executables; unbounded growth mirrors the
+        #: _SHARED_PROGRAMS leak fixed in PR 1)
+        self._programs: "OrderedDict[tuple, object]" = OrderedDict()
+        self._max_programs = 24
+        # telemetry (STATE ScenarioEngineState + scenario-* sensors)
+        self.last_batch_size = 0
+        self.total_batches = 0
+        self.total_scenarios = 0
+        self.total_oom_halvings = 0
+        self.last_compile_s = 0.0
+        self.last_solve_s = 0.0
+
+    # ------------------------------------------------------------------
+    def attach_metrics(self, registry) -> None:
+        """Late-bind the facade's MetricRegistry (the engine is built
+        before the registry during facade construction)."""
+        self._metrics = registry
+
+    def to_json(self) -> dict:
+        return {
+            "rung": self.ladder.rung.name,
+            "breaker": self.breaker.to_json(),
+            "lastBatchSize": self.last_batch_size,
+            "totalBatches": self.total_batches,
+            "totalScenarios": self.total_scenarios,
+            "totalOomHalvings": self.total_oom_halvings,
+            "lastCompileS": round(self.last_compile_s, 3),
+            "lastSolveS": round(self.last_solve_s, 3),
+        }
+
+    # ------------------------------------------------------------------
+    def evaluate(self, base_state: ClusterState, topology,
+                 specs: Sequence[ScenarioSpec],
+                 goals: Optional[Sequence[str]] = None,
+                 options: Optional[OptimizationOptions] = None,
+                 include_proposals: bool = True) -> ScenarioBatchResult:
+        """Solve every spec; outcomes return in request order.
+
+        Scenarios sharing a goal list share one vmapped program (a
+        per-spec `goals` override opens a separate sub-batch); each
+        sub-batch is capped at `max_batch_size` scenarios per device
+        program."""
+        for spec in specs:
+            spec.validate(topology)
+        with self._eval_lock:
+            return self._evaluate_locked(base_state, topology, specs,
+                                         goals, options,
+                                         include_proposals)
+
+    def _evaluate_locked(self, base_state, topology, specs, goals,
+                         options, include_proposals) -> ScenarioBatchResult:
+        t0 = self._time()
+        result = ScenarioBatchResult(outcomes=[None] * len(specs))
+        self.last_compile_s = 0.0
+        self.last_solve_s = 0.0
+
+        groups: "OrderedDict[Optional[Tuple[str, ...]], list]" = \
+            OrderedDict()
+        default_key = tuple(goals) if goals is not None else None
+        for i, spec in enumerate(specs):
+            key = spec.goals if spec.goals is not None else default_key
+            groups.setdefault(key, []).append((i, spec))
+
+        for goal_key, group in groups.items():
+            optimizer = self._optimizer_factory(
+                list(goal_key) if goal_key is not None else None)
+            for start in range(0, len(group), self.max_batch_size):
+                chunk = group[start:start + self.max_batch_size]
+                outs = self._solve_chunk(
+                    optimizer, base_state, topology,
+                    [s for _, s in chunk], options, include_proposals,
+                    result)
+                for (idx, _), out in zip(chunk, outs):
+                    result.outcomes[idx] = out
+
+        result.duration_s = self._time() - t0
+        result.compile_s = self.last_compile_s
+        result.solve_s = self.last_solve_s
+        result.rung = self.ladder.rung.name
+        with self._lock:
+            self.last_batch_size = len(specs)
+            self.total_batches += 1
+            self.total_scenarios += len(specs)
+        if self._metrics is not None:
+            # compile time is already sampled per program inside _run;
+            # recording the batch sum here too would double-count it
+            self._metrics.update_timer("scenario-execute-timer",
+                                       result.duration_s)
+        return result
+
+    # ------------------------------------------------------------------
+    # rung dispatch
+    # ------------------------------------------------------------------
+    def _solve_chunk(self, optimizer, base_state, topology,
+                     specs: List[ScenarioSpec], options, include_proposals,
+                     result: ScenarioBatchResult,
+                     table_override: Optional[int] = None
+                     ) -> List[ScenarioOutcome]:
+        import jax
+        rung = self.ladder.entry_rung()
+        if rung is SolverRung.FUSED:
+            try:
+                with jax.transfer_guard_device_to_host("allow"):
+                    # host-side variant assembly reads the base model's
+                    # device arrays (sanctioned pre-dispatch region)
+                    batch = compile_batch(
+                        base_state, topology, specs, self._constraint,
+                        options, table_slots_override=table_override)
+                outs = self._solve_fused(optimizer, batch,
+                                         self.max_oom_halvings,
+                                         include_proposals, result)
+                self.ladder.on_success(SolverRung.FUSED)
+                return outs
+            except _TableOverflow as overflow:
+                return self._solve_chunk(optimizer, base_state, topology,
+                                         specs, options, include_proposals,
+                                         result,
+                                         table_override=overflow.slots)
+            except Exception as exc:  # noqa: BLE001 - ladder classifies
+                kind = classify_failure(exc)
+                self.ladder.on_failure(SolverRung.FUSED)
+                self._descend_metered(SolverRung.FUSED)
+                LOG.warning("batched scenario solve failed (%s): %s; "
+                            "descending to per-scenario EAGER loop",
+                            kind.value, exc)
+                rung = SolverRung.EAGER
+        return self._solve_per_scenario(optimizer, base_state, topology,
+                                        specs, options, include_proposals,
+                                        rung, result)
+
+    def _solve_per_scenario(self, optimizer, base_state, topology,
+                            specs, options, include_proposals,
+                            rung: SolverRung, result: ScenarioBatchResult
+                            ) -> List[ScenarioOutcome]:
+        """Degraded rungs: EAGER = one eager-driver solve per scenario
+        (per-goal programs localize device faults); CPU = numpy
+        host-fallback per scenario (no XLA dispatch at all)."""
+        import jax
+        outs: List[ScenarioOutcome] = []
+        eager_failed = False
+        served_any_at_rung = False
+        for spec in specs:
+            with jax.transfer_guard_device_to_host("allow"):
+                geometry = _batch_geometry(base_state, topology, [spec])
+                v_state, v_topo, spec_opts = materialize(
+                    base_state, topology, spec, *geometry)
+            merged = options or OptimizationOptions()
+            if spec_opts.requested_destination_broker_ids:
+                merged = dataclasses.replace(
+                    merged, requested_destination_broker_ids=(
+                        spec_opts.requested_destination_broker_ids))
+            if rung is SolverRung.EAGER:
+                try:
+                    res = optimizer.optimizations(v_state, v_topo, merged,
+                                                  check_sanity=False,
+                                                  eager_driver=True)
+                    outs.append(self._outcome_from_result(
+                        spec, res, "EAGER", include_proposals))
+                    served_any_at_rung = True
+                    continue
+                except (OptimizationFailure,
+                        InvalidModelInputError) as exc:
+                    outs.append(ScenarioOutcome(
+                        spec=spec, feasible=False, reason=str(exc),
+                        rung="EAGER"))
+                    served_any_at_rung = True
+                    continue
+                except Exception as exc:  # noqa: BLE001
+                    eager_failed = True
+                    self.ladder.on_failure(SolverRung.EAGER)
+                    LOG.warning("eager scenario solve %r failed (%s); "
+                                "host fallback", spec.name,
+                                classify_failure(exc).value)
+            try:
+                from cruise_control_tpu.model.cpu_model import \
+                    host_fallback_solve
+                res = host_fallback_solve(v_state, v_topo, options=merged,
+                                          time_fn=self._time)
+                outs.append(self._outcome_from_result(
+                    spec, res, "CPU", include_proposals))
+            except (OptimizationFailure, InvalidModelInputError) as exc:
+                outs.append(ScenarioOutcome(
+                    spec=spec, feasible=False, reason=str(exc),
+                    rung="CPU"))
+            except Exception as exc:  # noqa: BLE001 - bottom rung failed
+                self.ladder.on_failure(SolverRung.CPU)
+                outs.append(ScenarioOutcome(
+                    spec=spec, feasible=False,
+                    reason=f"solve failed at every rung: {exc}",
+                    rung="CPU"))
+        if eager_failed:
+            self._descend_metered(SolverRung.EAGER)
+        elif served_any_at_rung:
+            self.ladder.on_success(rung)
+        result.batch_sizes.extend([1] * len(specs))
+        return outs
+
+    def _descend_metered(self, from_rung: SolverRung) -> None:
+        """Descend and meter `scenario-descents` only when the RESTING
+        rung actually moved (a failed probe back onto an already-pinned
+        rung is not a new descent)."""
+        before = self.ladder.rung
+        self.ladder.descend(from_rung)
+        if self._metrics is not None and self.ladder.rung != before:
+            self._metrics.meter("scenario-descents").mark()
+
+    def _outcome_from_result(self, spec, res, rung: str,
+                             include_proposals: bool) -> ScenarioOutcome:
+        return ScenarioOutcome(
+            spec=spec, feasible=True, rung=rung,
+            violated_goals_before=list(res.violated_goals_before),
+            violated_goals_after=list(res.violated_goals_after),
+            violated_broker_counts=dict(res.violated_broker_counts),
+            rounds_by_goal=dict(res.rounds_by_goal),
+            stats_before=res.stats_before, stats_after=res.stats_after,
+            balancedness=res.balancedness_score(),
+            num_replica_moves=res.num_replica_movements,
+            num_leadership_moves=res.num_leadership_movements,
+            data_to_move=res.data_to_move,
+            proposals=list(res.proposals) if include_proposals else [])
+
+    # ------------------------------------------------------------------
+    # FUSED rung: the vmapped batch
+    # ------------------------------------------------------------------
+    def _solve_fused(self, optimizer, batch: CompiledBatch,
+                     halvings_left: int, include_proposals: bool,
+                     result: ScenarioBatchResult) -> List[ScenarioOutcome]:
+        try:
+            return self._solve_batched(optimizer, batch,
+                                       include_proposals, result)
+        except _TableOverflow:
+            raise
+        except Exception as exc:  # noqa: BLE001 - OOM gets the halving path
+            if (_is_resource_exhausted(exc) and len(batch.specs) > 1
+                    and halvings_left > 0):
+                with self._lock:
+                    self.total_oom_halvings += 1
+                result.oom_halvings += 1
+                if self._metrics is not None:
+                    self._metrics.meter("scenario-oom-halvings").mark()
+                half = len(batch.specs) // 2
+                LOG.warning("batched scenario solve of %d hit "
+                            "RESOURCE_EXHAUSTED; retrying as %d + %d",
+                            len(batch.specs), half,
+                            len(batch.specs) - half)
+                return (self._solve_fused(optimizer, batch.slice(0, half),
+                                          halvings_left - 1,
+                                          include_proposals, result)
+                        + self._solve_fused(optimizer,
+                                            batch.slice(half, None),
+                                            halvings_left - 1,
+                                            include_proposals, result))
+            raise
+
+    def _solve_batched(self, optimizer, batch: CompiledBatch,
+                       include_proposals: bool,
+                       result: ScenarioBatchResult
+                       ) -> List[ScenarioOutcome]:
+        """One vmapped run of the fused pipeline over the batch: pre →
+        fused goal segments (prev-stats threaded on device along the goal
+        axis, exactly as in the single-solve path) → post sweep →
+        movement epilogue, then the single end-of-batch instrument fetch
+        and one placement fetch for the host diff."""
+        import jax
+
+        if not optimizer.goals:
+            raise ValueError("scenario solves need at least one goal")
+        k = len(batch.specs)
+        t_solve = self._time()
+        with jax.transfer_guard_device_to_host("allow"):
+            # sanctioned pre-dispatch host region (host-side variant
+            # assembly reads the base model's device arrays)
+            stacked_state, stacked_ctx = batch.stack()
+        initial = stacked_state
+        ctx0 = batch.contexts[0]
+        shapes = (k, initial.replica_valid.shape[1], batch.num_brokers,
+                  ctx0.table_slots, ctx0.rf_max, initial.num_racks,
+                  initial.num_hosts)
+
+        faults.inject("scenario.execute")
+        (stats0_dev, vb_dev, state, cache, still_dev, maxc_dev,
+         broken_dev, pre_rounds_dev, invalid_dev) = self._run(
+            optimizer, "__pre__", optimizer._pre_fn(), shapes, (),
+            initial, stacked_state, stacked_ctx)
+        seg = max(1, optimizer.pipeline_segment_size)
+        prev_stats = stats0_dev
+        stacked_parts, own_parts, rounds_parts, regr_parts = [], [], [], []
+        for start in range(0, len(optimizer.goals), seg):
+            stop = min(start + seg, len(optimizer.goals))
+            (state, cache, prev_stats,
+             (stacked_seg, own_seg, rounds_seg, regr_seg, _hard)) = \
+                self._run(optimizer, f"__seg_{start}_{stop}__",
+                          optimizer._segment_fn(start, stop), shapes,
+                          (0, 1), state, cache, prev_stats, stacked_ctx)
+            stacked_parts.append(stacked_seg)
+            own_parts.append(own_seg)
+            rounds_parts.append(rounds_seg)
+            regr_parts.append(regr_seg)
+        va_dev = self._run(optimizer, "__post__", optimizer._post_fn(),
+                           shapes, (), state, cache, stacked_ctx)
+        moves_dev = self._run(optimizer, "__moves__", _movement_metrics,
+                              shapes, (), initial, state)
+
+        goals = optimizer.goals
+        traceable = optimizer._device_comparators()
+        with jax.transfer_guard_device_to_host("allow"):
+            # fetch 1/2: every instrument of the whole batch in ONE
+            # device_get — [K]- and [K, G]-shaped tables
+            (stats0_h, stacked_h, own_h, rounds_h, regr_h, vb_h, va_h,
+             still_h, maxc_h, broken_h, pre_rounds_h, invalid_h,
+             moves_h) = jax.device_get(
+                (stats0_dev, stacked_parts, own_parts, rounds_parts,
+                 regr_parts, vb_dev, va_dev, still_dev, maxc_dev,
+                 broken_dev, pre_rounds_dev, invalid_dev, moves_dev))
+            slots = ctx0.table_slots
+            max_count = int(np.max(maxc_h)) if k else 0
+            if slots and max_count > slots:
+                new_slots = min(int(initial.replica_valid.shape[1]),
+                                -(-int(max_count * 1.5 + 64) // 128) * 128)
+                LOG.warning("scenario batch overflowed broker table "
+                            "width %d (max count %d); re-running with "
+                            "width %d", slots, max_count, new_slots)
+                raise _TableOverflow(new_slots)
+
+            # fetch 2/2: final + initial placements for the host diff
+            has_disks = batch.states[0].num_disks > 0
+            fetch2: tuple = (state.replica_broker, state.replica_is_leader,
+                             initial.replica_broker[0],
+                             initial.replica_is_leader[0],
+                             initial.replica_valid[0],
+                             initial.replica_base_load[:, :, Resource.DISK],
+                             initial.replica_partition[0])
+            if has_disks:
+                fetch2 = fetch2 + (state.replica_disk,
+                                   initial.replica_disk[0])
+            fetched2 = jax.device_get(fetch2)
+        self.last_solve_s += self._time() - t_solve
+        result.batch_sizes.append(k)
+
+        (fin_b, fin_l, init_b, init_l, valid, base_disk, part) = \
+            fetched2[:7]
+        init_d = fetched2[8] if has_disks else None
+        fin_d = fetched2[7] if has_disks else None
+
+        own_all = np.concatenate(own_h, axis=1) if own_h else \
+            np.zeros((k, 0), np.int32)
+        rounds_all = np.concatenate(rounds_h, axis=1) if rounds_h else \
+            np.zeros((k, 0), np.int32)
+        regr_all = np.concatenate(regr_h, axis=1) if regr_h else \
+            np.zeros((k, 0), bool)
+        stacked_all = jax.tree.map(
+            lambda *xs: np.concatenate(xs, axis=1), *stacked_h)
+
+        outcomes: List[ScenarioOutcome] = []
+        for i in range(k):
+            outcomes.append(self._assemble_outcome(
+                batch, i, goals, traceable,
+                jax.tree.map(lambda x, i=i: x[i], stats0_h),
+                jax.tree.map(lambda x, i=i: x[i], stacked_all),
+                own_all[i], rounds_all[i], regr_all[i], vb_h[i], va_h[i],
+                int(still_h[i]), bool(broken_h[i]), int(pre_rounds_h[i]),
+                bool(invalid_h[i]), tuple(m[i] for m in moves_h),
+                include_proposals,
+                dict(fin_b=fin_b[i], fin_l=fin_l[i],
+                     fin_d=None if fin_d is None else fin_d[i],
+                     init_b=init_b, init_l=init_l, init_d=init_d,
+                     valid=valid, base_disk=base_disk[i], part=part)))
+        return outcomes
+
+    def _assemble_outcome(self, batch, i, goals, traceable, stats_before,
+                          stats_by_idx, own, rounds, regr, vb, va,
+                          still_offline, broken, pre_rounds, invalid,
+                          moves, include_proposals, placements
+                          ) -> ScenarioOutcome:
+        """Host tail for scenario i — the same evaluation order as the
+        single-solve host tail in GoalOptimizer.optimizations, but
+        verdicts become per-scenario feasibility instead of exceptions."""
+        spec = batch.specs[i]
+        violated_before = [g.name for g, v in zip(goals, vb) if v]
+        violated_after = [g.name for g, v in zip(goals, va) if v]
+        counts = {g.name: (int(b), int(o), int(a))
+                  for g, b, o, a in zip(goals, vb, own, va)}
+        rounds_by_goal = {g.name: int(r) for g, r in zip(goals, rounds)}
+        if pre_rounds:
+            rounds_by_goal["__prebalance__"] = pre_rounds
+
+        import jax
+        stats_by_goal = {}
+        regressed: List[str] = []
+        prev = stats_before
+        for gi, goal in enumerate(goals):
+            goal_stats = jax.tree.map(lambda x, gi=gi: x[gi], stats_by_idx)
+            stats_by_goal[goal.name] = goal_stats
+            flag = (bool(regr[gi]) if traceable[gi]
+                    else not goal.stats_not_worse(prev, goal_stats))
+            if flag:
+                regressed.append(goal.name)
+            prev = goal_stats
+        stats_after = (stats_by_goal[goals[-1].name] if goals
+                       else stats_before)
+
+        num_moves, leader_moves, data = (int(moves[0]), int(moves[1]),
+                                         float(moves[2]))
+        feasible, reason = True, ""
+        if invalid:
+            feasible, reason = False, (
+                "model carries NaN/Inf/negative loads or capacities")
+        elif still_offline:
+            feasible, reason = False, (
+                f"{still_offline} offline replicas could not be "
+                f"relocated (insufficient capacity or eligible brokers)")
+        elif regressed and not broken:
+            feasible, reason = False, (
+                "optimization made goal statistics worse than before "
+                "for: " + ", ".join(regressed))
+        else:
+            hard_violated = [g.name for g in goals
+                             if g.is_hard and g.name in violated_after]
+            if hard_violated:
+                feasible, reason = False, (
+                    "hard goals still violated after optimization: "
+                    + ", ".join(hard_violated))
+
+        from cruise_control_tpu.scenario.report import balancedness_score
+        balancedness = balancedness_score(
+            [g.name for g in goals],
+            frozenset(g.name for g in goals if g.is_hard),
+            violated_after, self.balancedness_weights)
+
+        proposals: List = []
+        if include_proposals and feasible:
+            from cruise_control_tpu.analyzer.proposals import \
+                diff_proposals_host
+            p = placements
+            init = dict(replica_broker=p["init_b"],
+                        replica_is_leader=p["init_l"])
+            opt = dict(replica_broker=p["fin_b"],
+                       replica_is_leader=p["fin_l"])
+            if p["init_d"] is not None:
+                init["replica_disk"] = p["init_d"]
+                opt["replica_disk"] = p["fin_d"]
+            proposals = diff_proposals_host(
+                init, opt, p["valid"], p["base_disk"], p["part"],
+                batch.topologies[i], batch.partition_rows)
+
+        return ScenarioOutcome(
+            spec=spec, feasible=feasible, reason=reason, rung="FUSED",
+            violated_goals_before=violated_before,
+            violated_goals_after=violated_after,
+            violated_broker_counts=counts,
+            rounds_by_goal=rounds_by_goal,
+            stats_before=stats_before, stats_after=stats_after,
+            balancedness=balancedness,
+            num_replica_moves=num_moves,
+            num_leadership_moves=leader_moves,
+            data_to_move=data,
+            proposals=proposals)
+
+    # ------------------------------------------------------------------
+    # program cache (AOT-compiled vmapped pipeline programs)
+    # ------------------------------------------------------------------
+    def _run(self, optimizer, key: str, fn, shapes: tuple,
+             donate: tuple, *args):
+        import jax
+        gk = optimizer._goals_share_key()
+        cache_key = ((gk if gk is not None else id(optimizer)),
+                     key, shapes)
+        with self._lock:
+            entry = self._programs.get(cache_key)
+            if entry is not None:
+                self._programs.move_to_end(cache_key)
+        if entry is None:
+            faults.inject("scenario.compile")
+            if jax.default_backend() == "cpu":
+                donate = ()
+            t0 = self._time()
+            prog = jax.jit(jax.vmap(fn),
+                           donate_argnums=donate).lower(*args).compile()
+            dt = self._time() - t0
+            self.last_compile_s += dt
+            if self._metrics is not None:
+                self._metrics.update_timer("scenario-compile-timer", dt)
+            # the entry PINS the optimizer: id()-keyed entries (goal
+            # lists with non-primitive state) must never outlive their
+            # optimizer, or a recycled id could serve a different goal
+            # list's compiled program
+            entry = (prog, optimizer)
+            with self._lock:
+                self._programs[cache_key] = entry
+                self._programs.move_to_end(cache_key)
+                while len(self._programs) > self._max_programs:
+                    self._programs.popitem(last=False)
+        return entry[0](*args)
+
+
+def _movement_metrics(initial: ClusterState, final: ClusterState):
+    """(replica moves i32, leadership-only moves i32, data-to-move f32) —
+    the on-device movement-cost estimate, riding the single instrument
+    fetch so ranking never needs the per-scenario proposal diff."""
+    import jax.numpy as jnp
+    valid = initial.replica_valid
+    moved = valid & (final.replica_broker != initial.replica_broker)
+    promoted = (valid & final.replica_is_leader
+                & ~initial.replica_is_leader & ~moved)
+    data = jnp.sum(initial.replica_base_load[:, Resource.DISK] * moved)
+    return (jnp.sum(moved.astype(jnp.int32)),
+            jnp.sum(promoted.astype(jnp.int32)),
+            data)

@@ -409,6 +409,80 @@ class TestRetryDeterminism:
 
 
 # ---------------------------------------------------------------------------
+# scenario-engine ladder: batched what-if solves degrade independently
+# ---------------------------------------------------------------------------
+
+class TestScenarioLadder:
+    """Descent through the degradation ladder for the `scenario.*` fault
+    sites (PR-3): the batched FUSED path fails -> per-scenario EAGER
+    loop; EAGER's device programs fail too -> CPU host fallback; the
+    request-path solver ladder never moves; recovery probes climb one
+    rung per batch once faults clear."""
+
+    def _specs(self, n=2):
+        from cruise_control_tpu.scenario import ScenarioSpec
+        return [ScenarioSpec(name=f"s{i}",
+                             load_scale={"disk": 1.0 + 0.1 * (i + 1)})
+                for i in range(n)]
+
+    def test_scenario_ladder_descends_and_recovers(self):
+        sim, cc, clock = make_stack()
+        cc.scenario_engine.breaker.cooldown_s = 50.0
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+
+        # healthy: batched FUSED
+        res = cc.evaluate_scenarios(self._specs(), include_base=False)
+        assert all(o.rung == "FUSED" for o in res.outcomes)
+
+        # batched dispatch faulted -> EAGER per-scenario loop serves
+        with faults.injected(
+                faults.FaultPlan().fail_always("scenario.execute")):
+            res = cc.evaluate_scenarios(self._specs(),
+                                        include_base=False)
+        assert all(o.feasible and o.rung == "EAGER"
+                   for o in res.outcomes)
+        assert cc.scenario_engine.ladder.rung is SolverRung.EAGER
+
+        # batched AND per-goal device programs faulted -> CPU fallback
+        with faults.injected(faults.FaultPlan()
+                             .fail_always("scenario.execute")
+                             .fail_always("optimizer.execute")):
+            res = cc.evaluate_scenarios(self._specs(),
+                                        include_base=False)
+        assert all(o.rung == "CPU" for o in res.outcomes)
+        assert cc.scenario_engine.ladder.rung is SolverRung.CPU
+        assert cc.scenario_engine.breaker.state is BreakerState.OPEN
+        # isolation: the REQUEST-PATH solver ladder never moved
+        assert cc.solver_ladder.rung is SolverRung.FUSED
+        assert cc.solver_breaker.state is BreakerState.CLOSED
+
+        # rung + breaker visible in STATE and sensors
+        state = cc.state(["scenario", "sensors"])
+        eng = state["ScenarioEngineState"]
+        assert eng["rung"] == "CPU"
+        assert eng["breaker"]["state"] == "OPEN"
+        assert state["Sensors"]["scenario-rung"]["value"] == 2
+        assert state["Sensors"]["scenario-descents"]["count"] == 2
+
+        # recovery: cooldown elapses, probes climb one rung per batch
+        clock["now"] += 55.0
+        res = cc.evaluate_scenarios(self._specs(), include_base=False)
+        assert cc.scenario_engine.ladder.rung is SolverRung.EAGER
+        res = cc.evaluate_scenarios(self._specs(), include_base=False)
+        assert cc.scenario_engine.ladder.rung is SolverRung.FUSED
+        assert cc.scenario_engine.breaker.state is BreakerState.CLOSED
+        assert all(o.rung == "FUSED" for o in res.outcomes)
+        cc.shutdown()
+
+    def test_scenario_compile_fault_classifies_compile(self):
+        assert classify_failure(
+            faults.FaultError("scenario.compile")) is FailureKind.COMPILE
+        assert classify_failure(
+            faults.FaultError("scenario.execute")) is FailureKind.RUNTIME
+
+
+# ---------------------------------------------------------------------------
 # precompute loop: fault site, backoff, watchdog
 # ---------------------------------------------------------------------------
 

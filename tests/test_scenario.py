@@ -1,0 +1,584 @@
+"""Batched what-if scenario engine (cruise_control_tpu/scenario/).
+
+Pins the PR-3 tentpole contract:
+
+* batch-of-1 equivalence — the vmapped scenario solve reproduces the
+  plain fused solve BIT-IDENTICALLY (stats, instruments, proposals) for
+  the same model;
+* heterogeneous-shape padding — a batch mixing broker counts shares one
+  padded shape, and padded (dead, zero-capacity) broker rows never leak
+  into any scenario's stats;
+* transfer discipline — ≤ 2 device_gets for a WHOLE batch (one
+  instrument fetch + one placement fetch), under a disallow transfer
+  guard;
+* halve-the-batch retry on RESOURCE_EXHAUSTED;
+* facade routing — multiple candidate broker sets go through the
+  engine (dry-run only) while the K=1 path stays byte-identical to the
+  single-solve behavior;
+* SCENARIOS REST endpoint: JSON body in, ranked report out, body-hash
+  task dedup, result-size notes in USER_TASKS.
+
+Ladder descent for the scenario fault sites lives in tests/test_chaos.py
+(TestScenarioLadder).
+"""
+import json
+import time
+
+import conftest  # noqa: F401
+
+import numpy as np
+import pytest
+
+import jax
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions)
+from cruise_control_tpu.analyzer.goals.registry import default_goals
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.scenario import (BASE_SCENARIO_NAME, BrokerAdd,
+                                         ScenarioEngine, ScenarioSpec,
+                                         ScenarioSpecError,
+                                         candidate_broker_sets,
+                                         parse_scenarios_payload)
+from cruise_control_tpu.testing import fixtures
+from cruise_control_tpu.utils import faults
+
+pytestmark = pytest.mark.scenario
+
+SCENARIO_GOALS = ["RackAwareGoal", "DiskCapacityGoal",
+                  "ReplicaDistributionGoal"]
+
+
+@pytest.fixture(scope="module")
+def rig():
+    """Shared (state, topo, optimizer, engine): one vmapped-program
+    compile serves the whole module."""
+    state, topo = fixtures.small_cluster()
+    constraint = BalancingConstraint()
+    opt = GoalOptimizer(default_goals(max_rounds=16, names=SCENARIO_GOALS),
+                        constraint, pipeline_segment_size=2)
+
+    def factory(names):
+        return opt if names is None else GoalOptimizer(
+            default_goals(max_rounds=16, names=names), constraint)
+
+    engine = ScenarioEngine(factory, constraint)
+    return state, topo, opt, engine
+
+
+# ---------------------------------------------------------------------------
+# spec + payload validation
+# ---------------------------------------------------------------------------
+
+class TestSpec:
+    def test_json_roundtrip(self):
+        spec = ScenarioSpec(
+            name="s1",
+            add_brokers=(BrokerAdd(broker_id=9, rack="B",
+                                   capacity={"disk": 123.0}),),
+            remove_brokers=(1,), demote_brokers=(2,),
+            load_scale={"disk": 1.5},
+            capacity_overrides={0: {"cpu": 50.0}},
+            goals=("RackAwareGoal",), only_move_to_added=True)
+        again = ScenarioSpec.from_json(spec.to_json())
+        assert again == spec
+        assert not spec.is_noop()
+        assert ScenarioSpec(name="base").is_noop()
+
+    def test_validation_rejects_garbage(self):
+        with pytest.raises(ScenarioSpecError, match="name"):
+            ScenarioSpec(name="").validate()
+        with pytest.raises(ScenarioSpecError, match="unknown resource"):
+            ScenarioSpec(name="x", load_scale={"ram": 2.0}).validate()
+        with pytest.raises(ScenarioSpecError, match="positive"):
+            ScenarioSpec(name="x", load_scale={"disk": -1.0}).validate()
+        with pytest.raises(ScenarioSpecError, match="added and removed"):
+            ScenarioSpec(name="x", add_brokers=(BrokerAdd(1),),
+                         remove_brokers=(1,)).validate()
+        _, topo = fixtures.small_cluster()
+        with pytest.raises(ScenarioSpecError, match="unknown brokers"):
+            ScenarioSpec(name="x", remove_brokers=(77,)).validate(topo)
+
+    def test_payload_parser(self):
+        specs, goals, include_base = parse_scenarios_payload(json.dumps({
+            "scenarios": [{"name": "a"}, {"name": "b",
+                                          "loadScale": {"cpu": 2.0}}],
+            "goals": ["RackAwareGoal"], "includeBase": False}))
+        assert [s.name for s in specs] == ["a", "b"]
+        assert goals == ["RackAwareGoal"] and include_base is False
+        # absent includeBase -> None: the facade's config default
+        # (scenario.include.base.solve) must not be overridden
+        _, _, absent = parse_scenarios_payload(
+            json.dumps({"scenarios": [{"name": "a"}]}))
+        assert absent is None
+        with pytest.raises(ScenarioSpecError):
+            parse_scenarios_payload(None)
+        with pytest.raises(ScenarioSpecError):
+            parse_scenarios_payload("{}")
+        with pytest.raises(ScenarioSpecError, match="unique"):
+            parse_scenarios_payload(json.dumps(
+                {"scenarios": [{"name": "a"}, {"name": "a"}]}))
+
+    def test_candidate_broker_sets(self):
+        assert candidate_broker_sets([1, 2]) is None
+        assert candidate_broker_sets([]) is None
+        assert candidate_broker_sets([[2, 1], [3]]) == [[1, 2], [3]]
+        with pytest.raises(ScenarioSpecError, match="mix"):
+            candidate_broker_sets([1, [2]])
+
+
+# ---------------------------------------------------------------------------
+# batch-of-1 equivalence + padding correctness
+# ---------------------------------------------------------------------------
+
+class TestBatchedSolve:
+    def test_batch_of_one_bit_identical_to_fused_solve(self, rig):
+        """The vmapped scenario solve of the no-op scenario must
+        reproduce the plain fused solve EXACTLY: same stats bits, same
+        instruments, same proposals."""
+        state, topo, opt, engine = rig
+        single = opt.optimizations(state, topo, OptimizationOptions(),
+                                   check_sanity=False)
+        res = engine.evaluate(state, topo,
+                              [ScenarioSpec(name=BASE_SCENARIO_NAME)])
+        out = res.outcomes[0]
+        assert out.feasible and out.rung == "FUSED"
+        assert out.violated_goals_before == single.violated_goals_before
+        assert out.violated_goals_after == single.violated_goals_after
+        assert out.violated_broker_counts == single.violated_broker_counts
+        assert out.rounds_by_goal == single.rounds_by_goal
+        for field in ("util_avg", "util_std", "util_max",
+                      "replica_count_std", "leader_count_std"):
+            assert np.array_equal(
+                np.asarray(getattr(single.stats_after, field)),
+                np.asarray(getattr(out.stats_after, field))), field
+
+        def key(p):
+            return (p.partition.topic, p.partition.partition,
+                    tuple(r.broker_id for r in p.old_replicas),
+                    tuple(r.broker_id for r in p.new_replicas))
+        assert sorted(map(key, single.proposals)) == \
+            sorted(map(key, out.proposals))
+        assert out.num_replica_moves == single.num_replica_movements
+
+    def test_heterogeneous_padding_does_not_leak(self, rig):
+        """A batch mixing broker counts (hypothetical addition + base)
+        pads everyone to the widest shape; the base scenario's stats
+        must be identical to its unbatched, unpadded solve — padded
+        rows are dead and weightless."""
+        state, topo, opt, engine = rig
+        single = opt.optimizations(state, topo, OptimizationOptions(),
+                                   check_sanity=False)
+        res = engine.evaluate(state, topo, [
+            ScenarioSpec(name=BASE_SCENARIO_NAME),
+            ScenarioSpec(name="add",
+                         add_brokers=(BrokerAdd(broker_id=42, rack="B"),)),
+        ])
+        base = res.outcome(BASE_SCENARIO_NAME)
+        added = res.outcome("add")
+        # base solved at the PADDED width yet sees only its 3 brokers
+        assert int(np.asarray(base.stats_after.num_alive_brokers)) == 3
+        assert np.array_equal(np.asarray(base.stats_after.util_std),
+                              np.asarray(single.stats_after.util_std))
+        assert base.violated_broker_counts == \
+            single.violated_broker_counts
+        # the addition scenario sees 4 alive brokers
+        assert int(np.asarray(added.stats_after.num_alive_brokers)) == 4
+        # one device batch served both shapes
+        assert res.batch_sizes == [2]
+
+    def test_goal_override_opens_own_subbatch(self, rig):
+        state, topo, opt, engine = rig
+        res = engine.evaluate(state, topo, [
+            ScenarioSpec(name="default-goals"),
+            ScenarioSpec(name="rack-only", goals=("RackAwareGoal",)),
+        ])
+        assert sorted(res.batch_sizes) == [1, 1]   # two programs
+        assert set(res.outcome("rack-only").violated_broker_counts) == \
+            {"RackAwareGoal"}
+        assert set(res.outcome("default-goals").violated_broker_counts) \
+            == set(SCENARIO_GOALS)
+
+    def test_transfer_guard_two_device_gets_per_batch(self, rig,
+                                                      monkeypatch):
+        """≤ 2 device_gets for the WHOLE batch — the instrument fetch
+        and the placement fetch — under a disallow transfer guard."""
+        state, topo, opt, engine = rig
+        specs = [ScenarioSpec(name=BASE_SCENARIO_NAME),
+                 ScenarioSpec(name="g1", load_scale={"disk": 1.2}),
+                 ScenarioSpec(name="g2", load_scale={"nw_in": 1.3}),
+                 ScenarioSpec(name="g3", demote_brokers=(1,))]
+        calls = []
+        real_device_get = jax.device_get
+
+        def counting(x):
+            calls.append(1)
+            return real_device_get(x)
+
+        monkeypatch.setattr(jax, "device_get", counting)
+        with jax.transfer_guard_device_to_host("disallow"):
+            res = engine.evaluate(state, topo, specs)
+        assert len(calls) <= 2, (
+            f"expected instrument fetch + placement fetch, saw "
+            f"{len(calls)} device_gets for the batch")
+        assert all(o.feasible for o in res.outcomes)
+        assert res.batch_sizes == [4]
+
+    def test_oom_halving_retry(self, rig):
+        """A scripted RESOURCE_EXHAUSTED on the first batched dispatch
+        halves the batch and solves both halves; the ladder does NOT
+        descend (OOM is a sizing problem, not a solver fault)."""
+        from cruise_control_tpu.analyzer.degradation import SolverRung
+        state, topo, opt, engine = rig
+        specs = [ScenarioSpec(name=f"g{i}",
+                              load_scale={"disk": 1.0 + 0.1 * i})
+                 for i in range(4)]
+        plan = faults.FaultPlan().fail_nth(
+            "scenario.execute", 1,
+            exc_factory=lambda site: RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory allocating scenario "
+                "batch"))
+        with faults.injected(plan):
+            res = engine.evaluate(state, topo, specs)
+        assert res.oom_halvings == 1
+        assert sorted(res.batch_sizes) == [2, 2]
+        assert all(o.feasible and o.rung == "FUSED"
+                   for o in res.outcomes)
+        assert engine.ladder.rung is SolverRung.FUSED
+
+    def test_oom_at_batch_of_one_descends(self, rig):
+        """Un-halvable OOM (K=1) exhausts the halving path and descends
+        the ladder instead of failing the request."""
+        from cruise_control_tpu.analyzer.degradation import SolverRung
+        state, topo, opt, engine = rig
+        plan = faults.FaultPlan().fail_always(
+            "scenario.execute",
+            exc_factory=lambda site: RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory"))
+        try:
+            with faults.injected(plan):
+                res = engine.evaluate(
+                    state, topo, [ScenarioSpec(name="solo")])
+            assert res.outcomes[0].feasible
+            assert res.outcomes[0].rung == "EAGER"
+            assert engine.ladder.rung is SolverRung.EAGER
+        finally:
+            # heal the module-shared engine for later tests
+            engine.ladder.on_success(SolverRung.EAGER)
+            res = engine.evaluate(state, topo,
+                                  [ScenarioSpec(name="heal")])
+            assert engine.ladder.rung is SolverRung.FUSED
+
+
+# ---------------------------------------------------------------------------
+# ranking report
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ranked_run(rig):
+    """One shared K=4 evaluation (same shapes as the transfer-guard
+    batch, so the programs are compiled once per module) feeding the
+    infeasibility-verdict, ranking, and schema tests."""
+    state, topo, opt, engine = rig
+    return engine.evaluate(state, topo, [
+        ScenarioSpec(name=BASE_SCENARIO_NAME),
+        ScenarioSpec(name="ok", load_scale={"disk": 1.1}),
+        ScenarioSpec(name="ok2", load_scale={"nw_in": 1.2}),
+        ScenarioSpec(name="doomed", remove_brokers=(2,)),
+    ])
+
+
+class TestReport:
+    def test_doomed_scenario_reports_infeasible_not_raises(self,
+                                                           ranked_run):
+        """Removing the only rack-B broker makes RackAwareGoal
+        unsatisfiable: the batched path must report THAT scenario
+        infeasible (clean verdict, no exception) while its batchmates
+        solve normally."""
+        assert ranked_run.outcome(BASE_SCENARIO_NAME).feasible
+        bad = ranked_run.outcome("doomed")
+        assert not bad.feasible
+        assert "RackAwareGoal" in bad.reason
+        assert bad.proposals == []
+        assert ranked_run.outcome("ok").feasible
+
+    def test_ranking_and_vs_base(self, ranked_run):
+        from cruise_control_tpu.scenario.report import batch_report, rank
+        ranked = rank(ranked_run.outcomes)
+        assert ranked[-1].spec.name == "doomed"   # infeasible ranks last
+        report = batch_report(ranked_run, verbose=True)
+        names = [s["name"] for s in report["scenarios"]]
+        assert BASE_SCENARIO_NAME not in names
+        assert names[-1] == "doomed"
+        assert report["base"]["name"] == BASE_SCENARIO_NAME
+        assert report["dryRun"] is True
+        ok = next(s for s in report["scenarios"] if s["name"] == "ok")
+        assert "vsBase" in ok and "balancednessDelta" in ok["vsBase"]
+        assert "proposals" in ok   # verbose
+        doomed = next(s for s in report["scenarios"]
+                      if s["name"] == "doomed")
+        assert doomed["feasible"] is False and doomed["reason"]
+
+    def test_report_conforms_to_schema(self, ranked_run):
+        jsonschema = pytest.importorskip("jsonschema")
+        from cruise_control_tpu.api.schema import ENDPOINT_SCHEMAS
+        from cruise_control_tpu.scenario.report import batch_report
+        jsonschema.validate(batch_report(ranked_run),
+                            ENDPOINT_SCHEMAS["SCENARIOS"])
+
+
+# ---------------------------------------------------------------------------
+# facade routing: candidate broker sets + K=1 pin
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def facade_rig():
+    """ONE facade stack + app shared by the routing and endpoint tests:
+    every class building its own stack re-traces the whole vmapped
+    pipeline (~1 min per stack on the 1-core CI host); sharing the
+    engine lets same-shape batches reuse compiled programs."""
+    from test_facade import feed_samples, make_stack
+    from cruise_control_tpu.api.server import CruiseControlApp
+    sim, cc, clock = make_stack(num_brokers=4, skewed=True)
+    cc.start_up(do_sampling=False, start_detection=False)
+    feed_samples(cc, clock)
+    app = CruiseControlApp(cc, async_response_timeout_s=30.0)
+    yield sim, cc, clock, app
+    cc.shutdown()
+
+
+class TestFacadeRouting:
+    @pytest.fixture()
+    def stack(self, facade_rig):
+        sim, cc, clock, _app = facade_rig
+        return sim, cc, clock
+
+    def test_k1_path_is_byte_identical_and_engine_free(self, stack,
+                                                       monkeypatch):
+        """A flat broker list (and a single candidate set) must take
+        TODAY'S single-solve path — the scenario engine is never
+        consulted — and produce identical results either way."""
+        sim, cc, clock = stack
+
+        def boom(*a, **k):
+            raise AssertionError("scenario engine used for K=1 request")
+
+        monkeypatch.setattr(cc.scenario_engine, "evaluate", boom)
+        flat = cc.remove_brokers([3], dryrun=True)
+        nested = cc.remove_brokers([[3]], dryrun=True)
+        assert flat.scenario_report is None
+        assert nested.scenario_report is None
+
+        def key(p):
+            return (p.partition.topic, p.partition.partition,
+                    tuple(r.broker_id for r in p.old_replicas),
+                    tuple(r.broker_id for r in p.new_replicas))
+        assert sorted(map(key, flat.proposals)) == \
+            sorted(map(key, nested.proposals))
+        assert np.array_equal(
+            np.asarray(flat.optimizer_result.final_state.replica_broker),
+            np.asarray(
+                nested.optimizer_result.final_state.replica_broker))
+
+    def test_multi_candidate_routes_through_engine(self, stack):
+        sim, cc, clock = stack
+        op = cc.remove_brokers([[0], [3]], dryrun=True)
+        assert op.dryrun and op.execution_uuid is None
+        assert op.scenario_report is not None
+        names = {s["name"] for s in op.scenario_report["scenarios"]}
+        assert names == {"remove-0", "remove-3"}
+        assert op.scenario_report["base"] is not None
+        # best candidate's proposals came back
+        assert op.proposals
+
+    def test_multi_candidate_refuses_execution(self, stack):
+        sim, cc, clock = stack
+        with pytest.raises(ValueError, match="dry-run only"):
+            cc.remove_brokers([[0], [3]], dryrun=False)
+
+    @pytest.mark.slow
+    def test_demote_candidates_use_leadership_goal(self, stack):
+        """slow: compiles the PreferredLeaderElectionGoal pipeline on
+        top of the shared stack's programs."""
+        sim, cc, clock = stack
+        op = cc.demote_brokers([[0], [1]], dryrun=True)
+        assert op.scenario_report is not None
+        for s in op.scenario_report["scenarios"]:
+            assert s["name"] in ("demote-0", "demote-1")
+        # demotion what-ifs must not move replicas, only leadership
+        for p in op.proposals:
+            assert not p.replicas_to_add
+
+    def test_state_and_sensors_expose_engine(self, stack):
+        sim, cc, clock = stack
+        st = cc.state()
+        eng = st["ScenarioEngineState"]
+        assert eng["enabled"] is True
+        assert eng["totalScenarios"] >= 2
+        sensors = cc.metrics.to_json()
+        assert "scenario-batch-size" in sensors
+        assert sensors["scenario-rung"]["value"] == 0
+
+
+# ---------------------------------------------------------------------------
+# REST endpoint + user-task body dedup
+# ---------------------------------------------------------------------------
+
+class TestScenariosEndpoint:
+    @pytest.fixture()
+    def app_rig(self, facade_rig):
+        sim, cc, _clock, app = facade_rig
+        return sim, cc, app
+
+    def _post_body(self, app, body, query="", headers=None,
+                   deadline_s=300.0):
+        from cruise_control_tpu.api.user_tasks import USER_TASK_ID_HEADER
+        headers = dict(headers or {})
+        end = time.time() + deadline_s
+        while True:
+            status, hdrs, out = app.handle_request(
+                "POST", "/kafkacruisecontrol/scenarios", query, headers,
+                body=body)
+            if status != 202:
+                return status, hdrs, out
+            headers = {USER_TASK_ID_HEADER: hdrs[USER_TASK_ID_HEADER]}
+            assert time.time() < end, "scenario task never completed"
+            time.sleep(0.2)
+
+    def test_post_roundtrip(self, app_rig):
+        sim, cc, app = app_rig
+        body = json.dumps({"scenarios": [
+            {"name": "grow", "loadScale": {"disk": 1.3}},
+            {"name": "demote-1", "demoteBrokers": [1]},
+        ]})
+        status, _, out = self._post_body(app, body, "verbose=true")
+        assert status == 200, out
+        assert out["dryRun"] is True
+        assert {s["name"] for s in out["scenarios"]} == \
+            {"grow", "demote-1"}
+        assert out["base"]["name"] == BASE_SCENARIO_NAME
+        assert out["batch"]["numScenarios"] == 3
+
+    def test_bad_body_is_400(self, app_rig):
+        sim, cc, app = app_rig
+        status, _, out = app.handle_request(
+            "POST", "/kafkacruisecontrol/scenarios", "", {},
+            body="this is not json")
+        assert status == 400 and "JSON" in out["errorMessage"]
+        status, _, out = app.handle_request(
+            "POST", "/kafkacruisecontrol/scenarios", "", {}, body=None)
+        assert status == 400
+        status, _, out = app.handle_request(
+            "POST", "/kafkacruisecontrol/scenarios", "", {},
+            body=json.dumps({"scenarios": [{"name": "x",
+                                            "bogusField": 1}]}))
+        assert status == 400 and "bogusField" in out["errorMessage"]
+
+    def test_disabled_engine_rejected_at_request_time(self, app_rig,
+                                                      monkeypatch):
+        sim, cc, app = app_rig
+        monkeypatch.setattr(cc, "_scenario_enabled", False)
+        status, _, out = app.handle_request(
+            "POST", "/kafkacruisecontrol/scenarios", "", {},
+            body=json.dumps({"scenarios": [{"name": "x"}]}))
+        assert status == 400 and "disabled" in out["errorMessage"]
+
+    def test_brokerid_candidate_sets_via_rest(self, app_rig):
+        sim, cc, app = app_rig
+        from test_api import TestDispatch
+        status, _, out = TestDispatch._poll(
+            app, "POST", "/kafkacruisecontrol/remove_broker",
+            "brokerid=0;3&dryrun=true")
+        assert status == 200, out
+        assert out["dryRun"] is True
+        assert "scenarioReport" in out
+        assert {s["name"] for s in out["scenarioReport"]["scenarios"]} \
+            == {"remove-0", "remove-3"}
+
+    def test_two_step_approval_binds_the_body(self, facade_rig):
+        """With two-step verification on, an approved SCENARIOS review
+        is bound to the reviewed BODY: replaying the approval with a
+        different payload must be rejected."""
+        from cruise_control_tpu.api.server import CruiseControlApp
+        sim, cc, _clock, _app = facade_rig
+        app2 = CruiseControlApp(cc, two_step_verification=True,
+                                async_response_timeout_s=30.0)
+        body = json.dumps({"scenarios": [
+            {"name": "r1", "loadScale": {"disk": 1.1}},
+            {"name": "r2", "loadScale": {"nw_in": 1.1}}]})
+        status, _, out = app2.handle_request(
+            "POST", "/kafkacruisecontrol/scenarios", "", {}, body=body)
+        assert status == 202 and "reviewResult" in out
+        rid = out["reviewResult"]["Id"]
+        app2.handle_request("POST", "/kafkacruisecontrol/review",
+                            f"approve={rid}")
+        # a DIFFERENT body behind the approved review id: rejected
+        status, _, out = app2.handle_request(
+            "POST", "/kafkacruisecontrol/scenarios",
+            f"review_id={rid}", {},
+            body=json.dumps({"scenarios": [{"name": "evil"}]}))
+        assert status == 400
+        # the reviewed body goes through
+        status, hdrs, out = app2.handle_request(
+            "POST", "/kafkacruisecontrol/scenarios",
+            f"review_id={rid}", {}, body=body)
+        from cruise_control_tpu.api.user_tasks import USER_TASK_ID_HEADER
+        headers = {USER_TASK_ID_HEADER: hdrs[USER_TASK_ID_HEADER]}
+        end = time.time() + 300.0
+        while status == 202:
+            assert time.time() < end
+            time.sleep(0.2)
+            status, hdrs, out = app2.handle_request(
+                "POST", "/kafkacruisecontrol/scenarios",
+                f"review_id={rid}", headers, body=body)
+        assert status == 200, out
+        assert {s["name"] for s in out["scenarios"]} == {"r1", "r2"}
+
+    def test_user_task_dedup_includes_body_hash(self):
+        """Two ACTIVE tasks with identical endpoint+query but different
+        bodies must not coalesce; identical bodies must."""
+        from cruise_control_tpu.api.user_tasks import UserTaskManager
+        utm = UserTaskManager()
+
+        def slow_op():
+            time.sleep(0.5)
+            return {"ok": True}
+
+        a = utm.get_or_create("SCENARIOS", "verbose=true", "c", slow_op,
+                              body='{"scenarios":[{"name":"a"}]}')
+        b = utm.get_or_create("SCENARIOS", "verbose=true", "c", slow_op,
+                              body='{"scenarios":[{"name":"b"}]}')
+        a2 = utm.get_or_create("SCENARIOS", "verbose=true", "c", slow_op,
+                               body='{"scenarios":[{"name":"a"}]}')
+        assert a.task_id != b.task_id
+        assert a2.task_id == a.task_id
+        # a reused task id with a DIFFERENT body must not attach
+        with pytest.raises(ValueError, match="different request body"):
+            utm.get_or_create("SCENARIOS", "verbose=true", "c", slow_op,
+                              task_id=a.task_id,
+                              body='{"scenarios":[{"name":"z"}]}')
+        # body-less re-poll attaches fine (header-only long-poll)
+        same = utm.get_or_create("SCENARIOS", "verbose=true", "c2",
+                                 slow_op, task_id=a.task_id)
+        assert same.task_id == a.task_id
+        a.future.result(timeout=5.0)
+        b.future.result(timeout=5.0)
+        utm.shutdown()
+
+    def test_user_task_reports_result_size(self):
+        from cruise_control_tpu.api.user_tasks import (TaskStatus,
+                                                       UserTaskManager)
+        utm = UserTaskManager()
+        info = utm.get_or_create("SCENARIOS", "", "c",
+                                 lambda: {"big": "x" * 1000},
+                                 body='{"scenarios":[{"name":"s"}]}')
+        info.future.result(timeout=5.0)
+        for _ in range(50):
+            if info.status is not TaskStatus.ACTIVE:
+                break
+            time.sleep(0.05)
+        out = info.to_json()
+        assert out["Status"] == "Completed"
+        assert out["ResultSizeBytes"] > 1000
+        assert out["RequestBodySha"]
+        utm.shutdown()

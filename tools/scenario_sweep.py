@@ -1,0 +1,129 @@
+"""Operator CLI: submit a what-if scenario sweep, poll the async user
+task, print the ranked report.
+
+Drives a RUNNING cruise-control-tpu REST server through the SCENARIOS
+endpoint (the spec list rides in the JSON request body; see
+docs/SCENARIOS.md for the format).  The sweep is dry-run by
+construction — the engine ranks hypotheticals, it never executes them.
+
+Usage:
+    python tools/scenario_sweep.py --spec-file sweep.json \
+        [--address http://127.0.0.1:9090/kafkacruisecontrol] \
+        [--goals G1,G2] [--verbose] [--json] [--timeout 600]
+
+`sweep.json` is either the full request body ({"scenarios": [...]}) or
+a bare scenario list.  Exit code 0 when every scenario solved (feasible
+or a clean infeasibility verdict), 1 on transport or engine errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from cruise_control_tpu.client.client import (CruiseControlClient,  # noqa: E402
+                                              CruiseControlClientError)
+from cruise_control_tpu.scenario.spec import (ScenarioSpec,  # noqa: E402
+                                              ScenarioSpecError)
+
+
+def _load_payload(path: str) -> dict:
+    with open(path) as fh:
+        payload = json.load(fh)
+    if isinstance(payload, list):
+        payload = {"scenarios": payload}
+    # validate CLIENT-side before paying a round trip: the same parser
+    # the server runs (scenario/spec.py), so errors read identically
+    for s in payload.get("scenarios", []):
+        ScenarioSpec.from_json(s)
+    return payload
+
+
+def _print_report(report: dict) -> None:
+    batch = report.get("batch", {})
+    print(f"# batch: {batch.get('numScenarios')} scenarios, "
+          f"rung={batch.get('rung')}, "
+          f"oom_halvings={batch.get('oomHalvings')}, "
+          f"device_batches={batch.get('deviceBatchSizes')}, "
+          f"compile={batch.get('compileS')}s "
+          f"solve={batch.get('solveS')}s")
+    base = report.get("base")
+    if base:
+        print(f"# base solve: balancedness={base.get('balancedness')} "
+              f"moves={base.get('numReplicaMoves')} "
+              f"violated_after={base.get('violatedGoalsAfter')}")
+    header = (f"{'rank':>4}  {'scenario':<28} {'feasible':<9} "
+              f"{'balance':>8} {'moves':>7} {'data MB':>10}  vs base")
+    print(header)
+    print("-" * len(header))
+    for i, s in enumerate(report.get("scenarios", []), 1):
+        vs = s.get("vsBase") or {}
+        delta = vs.get("balancednessDelta")
+        note = (f"{delta:+.2f}" if delta is not None else "-")
+        if not s.get("feasible"):
+            note = s.get("reason", "infeasible")[:48]
+        print(f"{i:>4}  {s['name']:<28} {str(s['feasible']):<9} "
+              f"{s.get('balancedness', 0):>8.2f} "
+              f"{s.get('numReplicaMoves', 0):>7} "
+              f"{s.get('dataToMoveMB', 0):>10.2f}  {note}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="scenario_sweep",
+        description="Submit a what-if scenario sweep and print the "
+                    "ranked report")
+    parser.add_argument("--spec-file", required=True,
+                        help="JSON request body or bare scenario list")
+    parser.add_argument("-a", "--address",
+                        default="http://127.0.0.1:9090/kafkacruisecontrol")
+    parser.add_argument("--goals", help="CSV goal-list override")
+    parser.add_argument("--no-base", action="store_true",
+                        help="skip the implicit base solve")
+    parser.add_argument("--verbose", action="store_true",
+                        help="per-goal counts + proposals in the report")
+    parser.add_argument("--json", action="store_true",
+                        help="print the raw report JSON instead of the "
+                             "table")
+    parser.add_argument("--timeout", type=float, default=600.0,
+                        help="seconds to poll the async user task")
+    parser.add_argument("--user", help="basic-auth user:password")
+    args = parser.parse_args(argv)
+
+    try:
+        payload = _load_payload(args.spec_file)
+    except (OSError, json.JSONDecodeError, ScenarioSpecError) as exc:
+        print(f"error: bad spec file: {exc}", file=sys.stderr)
+        return 1
+
+    auth = None
+    if args.user:
+        import base64
+        auth = "Basic " + base64.b64encode(args.user.encode()).decode()
+    client = CruiseControlClient(args.address, auth_header=auth,
+                                 timeout_s=args.timeout)
+    goals = (args.goals.split(",") if args.goals
+             else payload.get("goals"))
+    try:
+        report = client.scenarios(
+            payload.get("scenarios", []), goals=goals,
+            include_base=(not args.no_base
+                          and payload.get("includeBase", True)),
+            verbose=args.verbose)
+    except CruiseControlClientError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        _print_report(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
