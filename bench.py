@@ -23,6 +23,17 @@ BENCH_CONFIG selects a BASELINE.json eval config:
                    "scenario" block; value = per-scenario solve seconds
                    at the largest K, vs_baseline = K=1-per-scenario /
                    largest-K-per-scenario, >1 = batching wins)
+  sched            device-time scheduler (sched/): N concurrent mixed
+                   clients (N = BENCH_SCHED_CLIENTS, default 1,8,32;
+                   USER_INTERACTIVE / PRECOMPUTE round-robin with
+                   repeated identical requests in the mix) submit
+                   BENCH_SCHED_REQUESTS solves each, scheduled vs the
+                   unscheduled free-for-all; records p50/p99 end-to-end
+                   latency + device occupancy per N (the output JSON
+                   carries a "sched" block; value = scheduled p99 at the
+                   largest N, vs_baseline = unscheduled p99 / scheduled
+                   p99, >1 = the scheduler wins via coalescing +
+                   ordering)
 
 Other knobs: BENCH_BROKERS, BENCH_PARTITIONS, BENCH_RF, BENCH_ROUNDS,
 BENCH_GOALS (comma list), BENCH_SEGMENT, BENCH_SKIP_WARMUP.
@@ -87,6 +98,8 @@ def main() -> None:
     config = os.environ.get("BENCH_CONFIG", "north")
     if config == "scenario":
         return _scenario_bench()
+    if config == "sched":
+        return _sched_bench()
     presets = {  # (brokers, partitions, goal subset, metric label)
         "north": (2600, 200_000, None, "full-stack proposal generation"),
         "1": (3, 30, None, "deterministic fixture"),
@@ -335,6 +348,168 @@ def _scenario_bench() -> None:
         # per-scenario latency (>1 = batching wins)
         "vs_baseline": round(per_one / per_max, 3) if per_max else 0.0,
         "scenario": results,
+    }))
+
+
+def _sched_bench() -> None:
+    """BENCH_CONFIG=sched: end-to-end request latency under concurrent
+    mixed solve traffic, scheduled (sched/DeviceTimeScheduler: priority
+    admission + single-flight coalescing) vs the unscheduled baseline
+    (every client thread calls the optimizer directly — the pre-PR-4
+    free-for-all).
+
+    Per client count N (BENCH_SCHED_CLIENTS, default 1,8,32): N threads
+    each issue BENCH_SCHED_REQUESTS (default 4) requests.  The mix
+    mirrors production traffic: every client's requests alternate
+    USER_INTERACTIVE and PRECOMPUTE class, and half the interactive
+    requests are IDENTICAL across clients (same goal list, same model —
+    the dashboard-rebalance stampede) so single-flight coalescing is
+    measured, not just queueing.  Records per-N p50/p99 latency and the
+    scheduler's device occupancy; vs_baseline = unscheduled p99 /
+    scheduled p99 at the largest N (>1 = the scheduler wins)."""
+    import threading
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(os.environ[
+                          "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+
+    from cruise_control_tpu.analyzer.context import OptimizationOptions
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.sched import (DeviceTimeScheduler,
+                                          SchedulerClass, SchedulerPolicy,
+                                          SolveJob)
+
+    num_b = int(os.environ.get("BENCH_BROKERS", 200))
+    num_p = int(os.environ.get("BENCH_PARTITIONS", 20_000))
+    rf = int(os.environ.get("BENCH_RF", 3))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 64))
+    goal_names = os.environ.get("BENCH_GOALS")
+    names = (goal_names.split(",") if goal_names
+             else ["RackAwareGoal", "DiskCapacityGoal",
+                   "ReplicaDistributionGoal", "DiskUsageDistributionGoal"])
+    clients = [int(k) for k in os.environ.get(
+        "BENCH_SCHED_CLIENTS", "1,8,32").split(",") if k.strip()]
+    per_client = int(os.environ.get("BENCH_SCHED_REQUESTS", 4))
+
+    backend = jax.devices()[0].platform
+    state, topo = _build("2", num_b, num_p, rf)
+    optimizer = GoalOptimizer(
+        default_goals(max_rounds=rounds, names=names),
+        pipeline_segment_size=int(os.environ.get("BENCH_SEGMENT", 2)))
+    print(f"# sched bench: B={state.num_brokers} P={state.num_partitions} "
+          f"goals={names} clients={clients} x{per_client} req [{backend}]",
+          file=sys.stderr)
+    # warm the programs so the measured passes compare scheduling, not
+    # first-compile luck
+    optimizer.optimizations(state, topo, OptimizationOptions(),
+                            check_sanity=False)
+
+    def solve(variant: int):
+        # distinct variants exclude different (nonexistent) topics: same
+        # shapes -> compiled programs are reused, but the requests are
+        # NOT identical so they cannot coalesce; variant 0 is the shared
+        # identical request
+        options = (OptimizationOptions() if variant == 0 else
+                   OptimizationOptions(
+                       excluded_topics=frozenset({f"__bench_{variant}__"})))
+        return optimizer.optimizations(state, topo, options,
+                                       check_sanity=False)
+
+    def run_load(n_clients: int, scheduler):
+        """Returns per-request latencies; scheduler=None = unscheduled
+        baseline (direct concurrent calls)."""
+        latencies = []
+        lat_lock = threading.Lock()
+        barrier = threading.Barrier(n_clients)
+
+        def client(ci: int):
+            for r in range(per_client):
+                if r == 0:
+                    barrier.wait()
+                # mix: even requests interactive (half of them the
+                # SHARED variant 0), odd requests precompute-class
+                interactive = r % 2 == 0
+                # globally unique per (client, request): nominally
+                # distinct requests must never share a coalesce key, or
+                # the scheduled run gets coalescing wins the unscheduled
+                # baseline cannot and vs_baseline overstates the benefit
+                variant = 0 if (interactive and ci % 2 == 0) \
+                    else 1 + ci * per_client + r
+                t0 = time.time()
+                if scheduler is None:
+                    solve(variant)
+                else:
+                    scheduler.submit(SolveJob(
+                        klass=(SchedulerClass.USER_INTERACTIVE
+                               if interactive
+                               else SchedulerClass.PRECOMPUTE),
+                        run=lambda v=variant: solve(v),
+                        coalesce_key=("bench", variant),
+                        label=f"bench-{ci}-{r}"))
+                with lat_lock:
+                    latencies.append(time.time() - t0)
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return latencies
+
+    def pct(values, q):
+        ordered = sorted(values)
+        return ordered[min(len(ordered) - 1,
+                           int(round(q * (len(ordered) - 1))))]
+
+    results = {}
+    for n in clients:
+        base_lat = run_load(n, None)
+        policy = SchedulerPolicy.from_lists(
+            queue_caps=[max(64, n * per_client)] * 4)
+        sched = DeviceTimeScheduler(policy)
+        t0 = time.time()
+        sched_lat = run_load(n, sched)
+        wall = time.time() - t0
+        occupancy = min(1.0, sched.stats.busy_s / wall) if wall else 0.0
+        coalesced = sched.stats.coalesced
+        sched.stop()
+        results[str(n)] = {
+            "unsched_p50_s": round(pct(base_lat, 0.50), 4),
+            "unsched_p99_s": round(pct(base_lat, 0.99), 4),
+            "sched_p50_s": round(pct(sched_lat, 0.50), 4),
+            "sched_p99_s": round(pct(sched_lat, 0.99), 4),
+            "device_occupancy": round(occupancy, 4),
+            "coalesced": coalesced,
+        }
+        print(f"# N={n}: unsched p50/p99 "
+              f"{results[str(n)]['unsched_p50_s']}/"
+              f"{results[str(n)]['unsched_p99_s']}s, sched p50/p99 "
+              f"{results[str(n)]['sched_p50_s']}/"
+              f"{results[str(n)]['sched_p99_s']}s, occupancy "
+              f"{results[str(n)]['device_occupancy']}, "
+              f"coalesced {coalesced}", file=sys.stderr)
+
+    n_max = str(max(clients))
+    p99_sched = results[n_max]["sched_p99_s"]
+    p99_unsched = results[n_max]["unsched_p99_s"]
+    print(json.dumps({
+        "metric": (f"sched {n_max} concurrent mixed clients "
+                   f"{state.num_brokers}b/{state.num_partitions/1000:g}Kp "
+                   f"rf{rf} [{backend}]"),
+        "value": p99_sched,
+        "unit": "s",
+        # scheduling win at the largest client count: unscheduled p99 /
+        # scheduled p99 (>1 = priority order + coalescing beat the
+        # free-for-all)
+        "vs_baseline": (round(p99_unsched / p99_sched, 3)
+                        if p99_sched else 0.0),
+        "sched": results,
     }))
 
 

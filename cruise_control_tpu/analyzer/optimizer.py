@@ -38,6 +38,7 @@ from cruise_control_tpu.analyzer.proposals import (ExecutionProposal,
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.sanity import sanity_check
+from cruise_control_tpu.sched.runtime import segment_checkpoint
 from cruise_control_tpu.model.state import ClusterState
 from cruise_control_tpu.model.stats import (ClusterModelStats, compute_stats,
                                             stats_aval)
@@ -772,6 +773,10 @@ class GoalOptimizer:
             # _goal_rounds_fn) and the degradation ladder's EAGER rung
             # (same programs, no profiler syncs)
             for i, g in enumerate(self.goals):
+                # scheduler checkpoint: a preemptible solve yields the
+                # device here when a higher-priority request is queued
+                # (sched/runtime.py; no-op outside a preemptible job)
+                segment_checkpoint()
                 t_seg = time.time()
                 state, cache, rounds_g = self._run(
                     f"__goal_{i}_rounds__", self._goal_rounds_fn(i),
@@ -797,6 +802,8 @@ class GoalOptimizer:
                     eager_check(hard_g, [g], own_g)
         else:
             for start in range(0, len(self.goals), seg):
+                # scheduler preemption checkpoint (see the eager loop)
+                segment_checkpoint()
                 stop = min(start + seg, len(self.goals))
                 (state, cache, prev_stats,
                  (stacked_seg, own_seg, rounds_seg, regr_seg,

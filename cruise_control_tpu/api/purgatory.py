@@ -137,6 +137,20 @@ class Purgatory:
             req.status_update_ms = self._time() * 1000.0
             return req
 
+    def re_arm(self, review_id: int) -> None:
+        """Roll a consumed (SUBMITTED) approval back to APPROVED because
+        the submission was rejected at the scheduler's queue cap — the
+        reviewed operation never executed, so burning the one-shot
+        approval would turn documented backpressure ("retry later") into
+        a permanent failure.  One execution per approval still holds:
+        only the request that consumed the approval re-arms it, and only
+        when the solve was never admitted."""
+        with self._lock:
+            req = self._requests.get(review_id)
+            if req is not None and req.status == ReviewStatus.SUBMITTED:
+                req.status = ReviewStatus.APPROVED
+                req.status_update_ms = self._time() * 1000.0
+
     def all_requests(self, review_ids: Optional[List[int]] = None
                      ) -> List[ReviewRequest]:
         with self._lock:

@@ -107,6 +107,7 @@ STATE = _obj({
     "ExecutorState": _obj({}, extra=True),
     "AnalyzerState": _obj({}, extra=True),
     "AnomalyDetectorState": _obj({}, extra=True),
+    "SchedulerState": _obj({}, extra=True),
     "version": _INT,
 }, required=["version"])
 
@@ -116,6 +117,14 @@ _USER_TASK = _obj({
     "RequestURL": _STR,
     "ClientIdentity": _STR,
     "StartMs": _NUM,
+    # device-time scheduler visibility (present while the task's solve
+    # is queued or running): priority class, 1-based dispatch-order
+    # position while queued with 0 reserved for on-the-device-now, and
+    # the estimated/actual start
+    "SchedulerClass": {"enum": ["ANOMALY_HEAL", "USER_INTERACTIVE",
+                                "PRECOMPUTE", "SCENARIO_SWEEP"]},
+    "QueuePosition": {"type": "integer", "minimum": 0},
+    "EstimatedStartMs": _NUM,
 }, required=["UserTaskId", "Status"])
 
 USER_TASKS = _obj({
@@ -192,6 +201,14 @@ REVIEW_PARKED = _obj({
 ERROR = _obj({"errorMessage": _STR, "version": _INT},
              required=["errorMessage", "version"])
 
+#: 429 body when the device-time scheduler rejects at a class queue cap
+#: (the same hint also rides the `Retry-After` response header)
+RATE_LIMITED = _obj({
+    "errorMessage": _STR,
+    "retryAfterSeconds": _NUM,
+    "version": _INT,
+}, required=["errorMessage", "retryAfterSeconds", "version"])
+
 #: endpoint → JSON Schema of the 200 response body
 ENDPOINT_SCHEMAS: Dict[str, dict] = {
     "STATE": STATE,
@@ -221,6 +238,7 @@ ENDPOINT_SCHEMAS: Dict[str, dict] = {
 AUX_SCHEMAS: Dict[str, dict] = {
     "async_progress_202": ASYNC_PROGRESS,
     "review_parked_202": REVIEW_PARKED,
+    "rate_limited_429": RATE_LIMITED,
     "error": ERROR,
 }
 

@@ -36,6 +36,7 @@ from cruise_control_tpu.analyzer.context import OptimizationOptions
 from cruise_control_tpu.core.anomaly import AnomalyType
 from cruise_control_tpu.executor.strategy import strategy_from_names
 from cruise_control_tpu.facade import CruiseControl, OngoingExecutionError
+from cruise_control_tpu.sched.queue import QueueFullError
 
 LOG = logging.getLogger(__name__)
 #: NCSA-style access log, one line per HTTP request (reference
@@ -216,6 +217,10 @@ class CruiseControlApp:
             return self._error(404, exc)
         except OngoingExecutionError as exc:
             return self._error(409, exc)
+        except QueueFullError as exc:
+            # scheduler backpressure: the class queue is at its cap —
+            # 429 with a Retry-After derived from the solve-latency EWMA
+            return self._rate_limited(exc)
         except HttpError as exc:
             return self._error(exc.status, exc)
         except Exception as exc:  # noqa: BLE001 - 500 with message
@@ -227,6 +232,20 @@ class CruiseControlApp:
                                                      dict]:
         return status, {}, {"errorMessage": f"{type(exc).__name__}: {exc}",
                             "version": 1}
+
+    @staticmethod
+    def _rate_limited(exc: "QueueFullError",
+                      extra_headers: Optional[Dict[str, str]] = None
+                      ) -> Tuple[int, Dict[str, str], dict]:
+        """429 + Retry-After for scheduler queue-cap rejections.  The
+        body repeats the hint as `retryAfterSeconds` for clients that
+        cannot read headers."""
+        import math
+        retry_after = max(1, int(math.ceil(exc.retry_after_s)))
+        return 429, {**(extra_headers or {}),
+                     "Retry-After": str(retry_after)}, \
+            {"errorMessage": f"{type(exc).__name__}: {exc}",
+             "retryAfterSeconds": retry_after, "version": 1}
 
     def _serve_ui(self, path: str) -> Tuple[int, Dict[str, str], dict]:
         """Serve the bundled UI from disk (reference
@@ -296,6 +315,30 @@ class CruiseControlApp:
         self.purgatory.take_approved(review_id, endpoint, query_string)
         return None
 
+    def _re_arming(self, op: Callable[[], dict], endpoint: str,
+                   params: QueryParams) -> Callable[[], dict]:
+        """Wrap a gated operation so a scheduler queue-cap rejection
+        rolls the consumed one-shot approval back to APPROVED.  The
+        rollback runs INSIDE the task (worker thread, exactly once,
+        before the failed future resolves) rather than in the poll
+        handler: the rejection may surface on the initial request, on a
+        later re-poll carrying the task id, or on no poll at all — and a
+        stale poll of a dead task must never re-arm an approval a
+        successful retry has since re-consumed."""
+        if self.purgatory is None or endpoint not in POST_ENDPOINTS:
+            return op
+        review_id = params.get_int("review_id")
+        if review_id is None:
+            return op
+
+        def gated_op() -> dict:
+            try:
+                return op()
+            except QueueFullError:
+                self.purgatory.re_arm(review_id)
+                raise
+        return gated_op
+
     # ------------------------------------------------------------------
     # async machinery (reference handler/async + UserTaskManager)
     # ------------------------------------------------------------------
@@ -323,6 +366,7 @@ class CruiseControlApp:
         else:
             op = (request.operation(self, params) if request is not None
                   else self._operation_for(endpoint, params, body=body))
+            op = self._re_arming(op, endpoint, params)
         info = self.user_tasks.get_or_create(endpoint, query_string, client,
                                              op, task_id=task_id,
                                              body=body)
@@ -340,9 +384,18 @@ class CruiseControlApp:
                                              "status": "InProgress"}],
                                "version": 1}
         except Exception as exc:  # noqa: BLE001 - operation failed
-            status = 409 if isinstance(exc, OngoingExecutionError) else 500
             LOG.warning("async %s operation failed: %s: %s", endpoint,
                         type(exc).__name__, exc)
+            if isinstance(exc, QueueFullError):
+                # the solve was rejected at the scheduler's queue cap:
+                # backpressure, not failure — 429 + Retry-After (the
+                # task id headers still ride along for diagnostics).
+                # The consumed two-step approval was already re-armed
+                # inside the task itself (_re_arming): the rejection may
+                # surface on ANY poll of the task — or on none, if the
+                # client gives up — so the rollback cannot live here
+                return self._rate_limited(exc, extra_headers=hdrs)
+            status = 409 if isinstance(exc, OngoingExecutionError) else 500
             return status, hdrs, {"errorMessage":
                                   f"{type(exc).__name__}: {exc}",
                                   "version": 1}

@@ -16,6 +16,8 @@ import uuid as _uuid
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from cruise_control_tpu.sched import runtime as sched_runtime
+
 USER_TASK_ID_HEADER = "User-Task-ID"
 
 #: endpoint -> task category (reference CruiseControlEndPoint.java:17-36
@@ -80,6 +82,10 @@ class UserTaskInfo:
     #: approximate JSON size of the completed result — large scenario
     #: reports are visible in USER_TASKS without fetching them
     result_bytes: Optional[int] = None
+    #: scheduler ticket of the task's most recent solve submission
+    #: (sched/queue.SolveTicket): surfaces WHY a task is waiting —
+    #: class, queue position, estimated start
+    sched_ticket: Optional[object] = None
 
     def to_json(self) -> dict:
         out = {
@@ -94,6 +100,24 @@ class UserTaskInfo:
             out["RequestBodySha"] = self.body_hash
         if self.result_bytes is not None:
             out["ResultSizeBytes"] = self.result_bytes
+        ticket = self.sched_ticket
+        if (ticket is not None and self.status == TaskStatus.ACTIVE
+                and not ticket.done()):
+            # device-time scheduler visibility: the class this task's
+            # solve dispatches at (coalesced solves report the BEST
+            # attached waiter's class), its 1-BASED place in the dispatch
+            # order
+            # (0 = on the device RIGHT NOW, never a queued state), and
+            # the start estimate (actual start once dispatched,
+            # queue-depth x latency-EWMA before).  Dropped once the
+            # solve RESOLVES: a task still ACTIVE through a long
+            # execution phase is no longer on (or waiting for) the
+            # device, and reporting QueuePosition=0 for it would read
+            # as a solve occupying the device
+            out["SchedulerClass"] = ticket.klass.name
+            position = ticket.queue_position()
+            out["QueuePosition"] = 0 if position is None else position + 1
+            out["EstimatedStartMs"] = round(ticket.estimated_start_ms(), 1)
         return out
 
 
@@ -189,6 +213,11 @@ class UserTaskManager:
             new_id = str(_uuid.uuid4())
 
             def run() -> Any:
+                # every scheduler submission the operation makes on this
+                # worker thread lands back on the task, so USER_TASKS can
+                # report QueuePosition/SchedulerClass/EstimatedStartMs
+                sched_runtime.set_submission_listener(
+                    lambda ticket: self._attach_ticket(new_id, ticket))
                 try:
                     result = operation()
                     self._finish(new_id, TaskStatus.COMPLETED, result)
@@ -196,6 +225,8 @@ class UserTaskManager:
                 except BaseException:
                     self._finish(new_id, TaskStatus.COMPLETED_WITH_ERROR)
                     raise
+                finally:
+                    sched_runtime.clear_submission_listener()
 
             # submit while still holding the lock: the task must never be
             # visible with future=None (a concurrent identical request
@@ -219,6 +250,12 @@ class UserTaskManager:
             logging.getLogger(__name__).debug(
                 "result size estimation failed: %s", exc)
             return None
+
+    def _attach_ticket(self, task_id: str, ticket: object) -> None:
+        with self._lock:
+            info = self._tasks.get(task_id)
+            if info is not None:
+                info.sched_ticket = ticket
 
     def _finish(self, task_id: str, status: TaskStatus,
                 result: Any = None) -> None:

@@ -35,6 +35,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--user", help="basic-auth user:password")
     parser.add_argument("--no-wait", action="store_true",
                         help="do not poll async operations to completion")
+    parser.add_argument("--max-retries", type=int, default=4,
+                        help="retries after HTTP 429 (scheduler "
+                             "backpressure), honoring Retry-After with "
+                             "capped exponential backoff + deterministic "
+                             "jitter; 0 fails fast (default: 4)")
     sub = parser.add_subparsers(dest="command", required=True)
 
     def add(name: str, **kwargs) -> argparse.ArgumentParser:
@@ -120,7 +125,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.user:
         auth = "Basic " + base64.b64encode(args.user.encode()).decode()
     client = CruiseControlClient(args.address, auth_header=auth,
-                                 wait_default=not args.no_wait)
+                                 wait_default=not args.no_wait,
+                                 max_retries_429=args.max_retries)
 
     cmd = args.command
     try:

@@ -6,12 +6,14 @@ parameters, a Responder that long-polls async responses via the
 """
 from __future__ import annotations
 
+import hashlib
 import json
+import os
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Mapping, Optional, Sequence
+from typing import Callable, Mapping, Optional, Sequence
 
 from cruise_control_tpu.api.parameters import GET_ENDPOINTS, VALID_PARAMS
 from cruise_control_tpu.api.user_tasks import USER_TASK_ID_HEADER
@@ -32,13 +34,33 @@ class CruiseControlClient:
                  auth_header: Optional[str] = None,
                  poll_interval_s: float = 1.0,
                  timeout_s: float = 600.0,
-                 wait_default: bool = True) -> None:
+                 wait_default: bool = True,
+                 max_retries_429: int = 4,
+                 retry_backoff_base_s: float = 1.0,
+                 retry_backoff_max_s: float = 30.0,
+                 retry_jitter_token: Optional[str] = None,
+                 sleep_fn: Optional[Callable[[float], None]] = None
+                 ) -> None:
         self._base = base_url.rstrip("/")
         self._auth = auth_header
         self._poll_s = poll_interval_s
         self._timeout_s = timeout_s
         #: long-poll async operations to completion unless overridden
         self._wait_default = wait_default
+        #: HTTP 429 (scheduler backpressure) retry policy: honor
+        #: `Retry-After` with capped exponential backoff + deterministic
+        #: jitter; 0 restores fail-fast
+        self._max_retries_429 = max(0, max_retries_429)
+        self._retry_base_s = retry_backoff_base_s
+        self._retry_max_s = retry_backoff_max_s
+        #: per-client jitter identity: each client hashes to its own
+        #: point in the [0.5, 1.0) jitter window, so a fleet rejected
+        #: together does not retry together; pass an explicit token for
+        #: reproducible delays
+        self._jitter_token = (retry_jitter_token
+                              if retry_jitter_token is not None
+                              else f"{os.getpid()}:{id(self):x}")
+        self._sleep = sleep_fn or time.sleep
 
     # ------------------------------------------------------------------
     def request(self, endpoint: str,
@@ -75,6 +97,7 @@ class CruiseControlClient:
                + (f"?{urllib.parse.urlencode(query)}" if query else ""))
         deadline = time.time() + self._timeout_s
         task_id: Optional[str] = None
+        retries_429 = 0
         while True:
             # once a task id is attached, re-polls go header-only: the
             # server allows body-less re-polls, and re-uploading a large
@@ -84,6 +107,25 @@ class CruiseControlClient:
             task_id = headers.get(USER_TASK_ID_HEADER, task_id)
             if status == 200:
                 return body
+            if status == 429:
+                # scheduler backpressure (solve queue at its cap): honor
+                # Retry-After with capped exponential backoff +
+                # deterministic jitter, then resubmit.  The 429 carries
+                # the FAILED task's User-Task-ID for diagnostics — drop
+                # it, or the retry would attach to the dead task (and
+                # replay its cached rejection) instead of resubmitting
+                task_id = None
+                delay = self._retry_delay_429(endpoint, retries_429,
+                                              headers, body)
+                if (retries_429 >= self._max_retries_429
+                        or time.time() + delay > deadline):
+                    raise CruiseControlClientError(
+                        429, body.get("errorMessage",
+                                      "rejected: solve queue full")
+                        + f" (gave up after {retries_429} retries)")
+                retries_429 += 1
+                self._sleep(delay)
+                continue
             if status == 202 and "reviewResult" in body:
                 # two-step verification parked the request — re-polling
                 # would file duplicate reviews; hand the review back
@@ -99,6 +141,39 @@ class CruiseControlClient:
                 return body
             raise CruiseControlClientError(
                 status, body.get("errorMessage", str(body)))
+
+    def _retry_delay_429(self, endpoint: str, attempt: int,
+                         headers: Mapping[str, str], body: Mapping
+                         ) -> float:
+        """Backoff before resubmitting a 429-rejected request: the
+        server's `Retry-After` (header, or `retryAfterSeconds` in the
+        body) floors a capped exponential backoff, and BOTH terms are
+        scaled by a DETERMINISTIC jitter — same client token +
+        endpoint + attempt always waits the same time (reproducible),
+        while distinct clients hash to distinct points in the jitter
+        window.  Retry-After is jittered UPWARD (never below the
+        server's floor): when it dominates the backoff, an unjittered
+        max() would have every rejected client sleep exactly the
+        server's value and re-stampede the queue in lockstep."""
+        retry_after = 0.0
+        for k, v in headers.items():
+            if k.lower() == "retry-after":
+                try:
+                    retry_after = float(v)
+                except ValueError:
+                    retry_after = 0.0
+        if not retry_after:
+            try:
+                retry_after = float(body.get("retryAfterSeconds", 0.0))
+            except (TypeError, ValueError):
+                retry_after = 0.0
+        backoff = min(self._retry_max_s,
+                      self._retry_base_s * (2 ** attempt))
+        seed = hashlib.sha256(
+            f"{self._jitter_token}:{endpoint}:{attempt}".encode()).digest()
+        jitter = 0.5 + seed[0] / 512.0          # [0.5, 1.0)
+        jitter_up = 1.0 + seed[1] / 512.0       # [1.0, 1.5)
+        return max(retry_after * jitter_up, backoff * jitter)
 
     def _http(self, method: str, url: str, task_id: Optional[str],
               data: Optional[bytes] = None):

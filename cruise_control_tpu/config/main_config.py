@@ -267,6 +267,42 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
     d.define("scenario.include.base.solve", Type.BOOLEAN, True, None, _L,
              "Prepend a no-op base scenario to every SCENARIOS batch so "
              "the report diffs each what-if against doing nothing.")
+    d.define("scheduler.enabled", Type.BOOLEAN, True, None, _M,
+             "Route every device solve (REST operations, proposal "
+             "precompute, anomaly self-healing, scenario sweeps) through "
+             "the device-time scheduler (cruise_control_tpu/sched/): "
+             "priority admission, single-flight coalescing of identical "
+             "requests, scenario folding, segment-boundary preemption "
+             "and queue-cap backpressure (HTTP 429 + Retry-After).  "
+             "Disabled: solves run inline on the calling thread (the "
+             "pre-scheduler free-for-all).")
+    d.define("scheduler.preemption.enabled", Type.BOOLEAN, True, None, _L,
+             "Allow the dispatch loop to preempt preemptible classes "
+             "(PRECOMPUTE, SCENARIO_SWEEP) at the next goal-segment "
+             "boundary when a higher-priority solve queues up; the "
+             "preempted job is re-queued with its aging credit intact.")
+    d.define("scheduler.class.weights", Type.LIST, "8,4,2,1", None, _L,
+             "Anti-starvation aging weight per scheduler class, in "
+             "ANOMALY_HEAL,USER_INTERACTIVE,PRECOMPUTE,SCENARIO_SWEEP "
+             "order: a class earns weight x (waited / deadline budget) "
+             "priority classes of credit while queued, so background "
+             "work can be delayed but never starved.")
+    d.define("scheduler.class.queue.caps", Type.LIST, "8,6,2,8", None,
+             _M,
+             "Admission cap per scheduler class (same class order as "
+             "scheduler.class.weights).  An offer beyond the cap is "
+             "rejected with HTTP 429 and a Retry-After derived from the "
+             "observed solve-latency EWMA x queue depth.  Keep the "
+             "USER_INTERACTIVE cap below the USER_TASKS pool width "
+             "(max_workers, 8): each pool worker holds at most one "
+             "queued solve, so a larger cap can never fill from REST "
+             "traffic and backpressure degrades to invisible pool "
+             "queueing.")
+    d.define("scheduler.class.deadline.budget.ms", Type.LIST,
+             "5000,30000,120000,300000", None, _L,
+             "Per-class deadline budget (same class order): the queue "
+             "wait that earns one full priority class of aging credit "
+             "(scaled by the class weight).")
     d.define("proposal.warm.start.enabled", Type.BOOLEAN, True, None, _L,
              "Seed default-stack solves from the previous solve's final "
              "placement when the model generation moved but the topology "

@@ -61,6 +61,12 @@ from cruise_control_tpu.scenario.engine import (BASE_SCENARIO_NAME,
                                                 ScenarioEngine)
 from cruise_control_tpu.scenario.spec import (BrokerAdd, ScenarioSpec,
                                               candidate_broker_sets)
+from cruise_control_tpu.sched import runtime as sched_runtime
+from cruise_control_tpu.sched.policy import (SchedulerClass,
+                                             SchedulerPolicy)
+from cruise_control_tpu.sched.runtime import SolvePreempted
+from cruise_control_tpu.sched.scheduler import (DeviceTimeScheduler,
+                                                SolveJob)
 from cruise_control_tpu.utils import faults
 from cruise_control_tpu.utils.metrics import MetricRegistry
 
@@ -96,6 +102,19 @@ def _warm_start_compatible(seed, state) -> bool:
                            np.asarray(state.disk_broker))
         and np.array_equal(np.asarray(seed.broker_rack),
                            np.asarray(state.broker_rack)))
+
+
+def _options_fingerprint(options: Optional[OptimizationOptions]):
+    """Hashable identity of a request's options for single-flight
+    coalescing: requests whose options differ in ANY field must never
+    share a solve.  The frozen dataclass IS the fingerprint — its
+    field-wise __eq__/__hash__ automatically cover fields added later,
+    so the coalesce key cannot silently drift from the dataclass (a
+    hand-enumerated field list here would let two requests differing
+    only in a new field share one solve)."""
+    return options
+
+
 #: operations audit log (reference `operationLogger`,
 #: CC/executor/Executor.java:76,775): one INFO line per requested mutation
 OPERATION_LOG = logging.getLogger("operationLogger")
@@ -186,7 +205,13 @@ class CruiseControl:
                  scenario_engine_enabled: bool = True,
                  scenario_max_batch_size: int = 32,
                  scenario_max_oom_halvings: int = 4,
-                 scenario_include_base: bool = True) -> None:
+                 scenario_include_base: bool = True,
+                 scheduler_enabled: bool = True,
+                 scheduler_preemption_enabled: bool = True,
+                 scheduler_class_weights: Optional[Sequence[float]] = None,
+                 scheduler_class_queue_caps: Optional[Sequence[int]] = None,
+                 scheduler_class_deadline_budgets_s: Optional[
+                     Sequence[float]] = None) -> None:
         self._admin = admin
         self._time = time_fn or _time.time
         self._sleep = sleep_fn or _time.sleep
@@ -307,6 +332,11 @@ class CruiseControl:
         #: surfaces the wedge through state()/sensors instead
         self._precompute_solve_started_at: Optional[float] = None
         self._precompute_solve_deadline_s = precompute_solve_deadline_s
+        #: scheduler ticket of the precompute pass in flight (None when
+        #: idle or answered from cache): the watchdog clocks
+        #: ticket.started_at, not submission time — queue wait in front
+        #: of the solve must not read as a wedge
+        self._precompute_ticket = None
 
         # solver degradation ladder (analyzer/degradation.py): classify
         # solve failures, retry with backoff, fall back fused → eager →
@@ -342,6 +372,21 @@ class CruiseControl:
             cooldown_s=solver_breaker_cooldown_s, time_fn=self._time)
         self.solver_ladder = DegradationLadder(self.solver_breaker)
 
+        # device-time solve scheduler (sched/): the SINGLE GATEWAY for
+        # every solve in the process — request-path, precompute,
+        # self-healing, scenario sweeps — giving priority admission,
+        # single-flight coalescing, scenario folding, segment-boundary
+        # preemption and queue-cap backpressure over the one device.
+        # Disabled, it degenerates to inline execution on the calling
+        # thread (the seed behavior), byte-identical for a single client.
+        self.solve_scheduler = DeviceTimeScheduler(
+            SchedulerPolicy.from_lists(
+                weights=scheduler_class_weights,
+                queue_caps=scheduler_class_queue_caps,
+                deadline_budgets_s=scheduler_class_deadline_budgets_s,
+                preemption_enabled=scheduler_preemption_enabled),
+            enabled=scheduler_enabled, time_fn=self._time)
+
         # sensors (reference dropwizard registry, SURVEY.md §5.1)
         self.metrics = MetricRegistry(self._time)
         self.metrics.gauge(
@@ -368,6 +413,10 @@ class CruiseControl:
                            lambda: self.scenario_engine.last_batch_size)
         self.metrics.gauge("scenario-rung",
                            lambda: int(self.scenario_engine.ladder.rung))
+        # sched-* sensors: per-class queue depth/wait gauges,
+        # device-busy-seconds, occupancy; the scheduler marks its own
+        # coalesce/preempt/reject/fold meters as events happen
+        self.solve_scheduler.attach_metrics(self.metrics)
 
     # ------------------------------------------------------------------
     # lifecycle (reference startUp order :178-184)
@@ -391,6 +440,10 @@ class CruiseControl:
 
     def shutdown(self) -> None:
         self._precompute_stop.set()
+        # stop the solve scheduler first: queued tickets fail fast (a
+        # precompute pass blocked on one unblocks and sees the stop
+        # event), and nothing new is admitted during teardown
+        self.solve_scheduler.stop()
         if self._precompute_thread is not None:
             started = self._precompute_solve_started_at
             if self.precompute_wedged() and started is not None:
@@ -445,13 +498,25 @@ class CruiseControl:
             if self._cache_valid(generation):
                 return "skipped"
         self._precompute_solve_started_at = self._time()
+        self._precompute_ticket = None
         try:
             faults.inject("facade.precompute")
-            self.optimizations(
-                _allow_capacity_estimation=(
-                    self._allow_capacity_estimation_precompute),
-                _eager_hard_abort=(True if self._precompute_eager_hard_abort
-                                   else None))
+            # capture the scheduler ticket: the watchdog must clock the
+            # SOLVE, not the queue wait in front of it (a precompute
+            # queued behind a long sweep is waiting, not wedged — and a
+            # queued ticket fails fast on scheduler stop anyway)
+            sched_runtime.set_submission_listener(
+                lambda ticket: setattr(self, "_precompute_ticket", ticket))
+            try:
+                self.optimizations(
+                    _allow_capacity_estimation=(
+                        self._allow_capacity_estimation_precompute),
+                    _eager_hard_abort=(True
+                                       if self._precompute_eager_hard_abort
+                                       else None),
+                    _scheduler_class=SchedulerClass.PRECOMPUTE)
+            finally:
+                sched_runtime.clear_submission_listener()
             return "computed"
         except Exception as exc:  # noqa: BLE001 - keep the loop alive
             LOG.warning("proposal precompute failed (%s): %s",
@@ -459,14 +524,24 @@ class CruiseControl:
             return "failed"
         finally:
             self._precompute_solve_started_at = None
+            self._precompute_ticket = None
 
     def precompute_wedged(self) -> bool:
-        """True when the in-flight precompute solve has overrun its
-        deadline (watchdog verdict; shutdown stops waiting for it)."""
+        """True when the in-flight precompute SOLVE has overrun its
+        deadline (watchdog verdict; shutdown stops waiting for it).
+        Scheduler queue wait does not count: the clock starts when the
+        dispatch loop actually picks the solve up (ticket.started_at),
+        falling back to submission time when the pass answered without
+        a scheduler ticket (cache hit)."""
         started = self._precompute_solve_started_at
-        return (started is not None
-                and self._time() - started
-                > self._precompute_solve_deadline_s)
+        if started is None:
+            return False
+        ticket = self._precompute_ticket
+        if ticket is not None:
+            started = ticket.started_at
+            if started is None:        # still queued (or re-queued after
+                return False           # a preemption): waiting, not wedged
+        return self._time() - started > self._precompute_solve_deadline_s
 
     def _precompute_loop(self) -> None:
         # first pass immediately: waiting a full interval before the first
@@ -580,9 +655,10 @@ class CruiseControl:
 
     def _heal_rebalance(self) -> bool:
         try:
-            result = self.rebalance(dryrun=False,
-                                    options=self._self_healing_options(),
-                                    reason="self-healing: goal violation")
+            result = self.rebalance(
+                dryrun=False, options=self._self_healing_options(),
+                reason="self-healing: goal violation",
+                _scheduler_class=SchedulerClass.ANOMALY_HEAL)
             return result.execution_uuid is not None
         except Exception:  # noqa: BLE001 - healing failure is handled
             LOG.exception("self-healing rebalance failed")
@@ -593,8 +669,10 @@ class CruiseControl:
         if not failed:
             return False
         try:
-            result = self.remove_brokers(failed, dryrun=False,
-                                         reason="self-healing: broker failure")
+            result = self.remove_brokers(
+                failed, dryrun=False,
+                reason="self-healing: broker failure",
+                _scheduler_class=SchedulerClass.ANOMALY_HEAL)
             return result.execution_uuid is not None
         except Exception:  # noqa: BLE001
             LOG.exception("self-healing broker removal failed")
@@ -603,7 +681,8 @@ class CruiseControl:
     def _heal_offline_replicas(self) -> bool:
         try:
             result = self.fix_offline_replicas(
-                dryrun=False, reason="self-healing: disk failure")
+                dryrun=False, reason="self-healing: disk failure",
+                _scheduler_class=SchedulerClass.ANOMALY_HEAL)
             return result.execution_uuid is not None
         except Exception:  # noqa: BLE001
             LOG.exception("self-healing offline-replica fix failed")
@@ -613,7 +692,8 @@ class CruiseControl:
         try:
             result = self.demote_brokers(
                 broker_ids, dryrun=False,
-                reason="self-healing: slow brokers (demote)")
+                reason="self-healing: slow brokers (demote)",
+                _scheduler_class=SchedulerClass.ANOMALY_HEAL)
             return result.execution_uuid is not None
         except Exception:  # noqa: BLE001
             LOG.exception("self-healing slow-broker demotion failed")
@@ -623,7 +703,8 @@ class CruiseControl:
         try:
             result = self.remove_brokers(
                 broker_ids, dryrun=False,
-                reason="self-healing: slow brokers (remove)")
+                reason="self-healing: slow brokers (remove)",
+                _scheduler_class=SchedulerClass.ANOMALY_HEAL)
             return result.execution_uuid is not None
         except Exception:  # noqa: BLE001
             LOG.exception("self-healing slow-broker removal failed")
@@ -661,44 +742,66 @@ class CruiseControl:
                       options: Optional[OptimizationOptions] = None,
                       ignore_proposal_cache: bool = False,
                       _allow_capacity_estimation: Optional[bool] = None,
-                      _eager_hard_abort: Optional[bool] = None
+                      _eager_hard_abort: Optional[bool] = None,
+                      _scheduler_class: Optional[SchedulerClass] = None
                       ) -> OptimizerResult:
         """Proposals for the current cluster model.  The cache is only used
         for the default goal list with default options and is invalidated
         when the model generation moves (reference
         GoalOptimizer.validCachedProposal :210-217,
-        KafkaCruiseControl.ignoreProposalCache :499-517)."""
+        KafkaCruiseControl.ignoreProposalCache :499-517).
+
+        The solve itself runs THROUGH THE DEVICE-TIME SCHEDULER (sched/):
+        cache hits answer from the calling thread, everything else is a
+        SolveJob keyed on (goal list, model generation, options hash) so
+        identical concurrent requests coalesce into one compile+solve.
+        `_scheduler_class` picks the priority class (default
+        USER_INTERACTIVE; the precompute loop and the self-healing fix
+        paths pass their own)."""
+        klass = (_scheduler_class if _scheduler_class is not None
+                 else SchedulerClass.USER_INTERACTIVE)
         cacheable = goals is None and options is None
         generation = self.load_monitor.model_generation()
         if cacheable and not ignore_proposal_cache:
             with self._cache_lock:
                 if self._cache_valid(generation):
                     return self._cached_result
-        with self._cache_lock:
-            epoch = self._cache_epoch
-        optimizer = (self.goal_optimizer if goals is None
-                     else GoalOptimizer(default_goals(names=list(goals)),
-                                        self._constraint))
-        result = self._solve_with_ladder(optimizer, cacheable, options,
-                                         _allow_capacity_estimation,
-                                         _eager_hard_abort)
-        from cruise_control_tpu.utils import profiling
-        prof = profiling.active()
-        if prof is not None and profiling.enabled():
-            # CC_TPU_PROFILE: expose the solve's segment attribution as
-            # segment-profile-<category>-timer sensors (STATE endpoint)
-            prof.publish(self.metrics)
-        if cacheable:
+
+        def run_solve() -> OptimizerResult:
             with self._cache_lock:
-                self._warm_seed_state = result.final_state
-                # drop the result if the cache was invalidated while the
-                # solve ran (an execution started mutating the cluster) —
-                # storing it would serve pre-execution proposals
-                if self._cache_epoch == epoch:
-                    self._cached_result = result
-                    self._cached_generation = generation
-                    self._cached_at = self._time()
-        return result
+                epoch = self._cache_epoch
+            optimizer = (self.goal_optimizer if goals is None
+                         else GoalOptimizer(default_goals(names=list(goals)),
+                                            self._constraint))
+            result = self._solve_with_ladder(optimizer, cacheable, options,
+                                             _allow_capacity_estimation,
+                                             _eager_hard_abort)
+            from cruise_control_tpu.utils import profiling
+            prof = profiling.active()
+            if prof is not None and profiling.enabled():
+                # CC_TPU_PROFILE: expose the solve's segment attribution
+                # as segment-profile-<category>-timer sensors (STATE
+                # endpoint)
+                prof.publish(self.metrics)
+            if cacheable:
+                with self._cache_lock:
+                    self._warm_seed_state = result.final_state
+                    # drop the result if the cache was invalidated while
+                    # the solve ran (an execution started mutating the
+                    # cluster) — storing it would serve pre-execution
+                    # proposals
+                    if self._cache_epoch == epoch:
+                        self._cached_result = result
+                        self._cached_generation = generation
+                        self._cached_at = self._time()
+            return result
+
+        key = ("optimizations",
+               tuple(goals) if goals is not None else None,
+               generation, _options_fingerprint(options),
+               _allow_capacity_estimation, _eager_hard_abort)
+        return self._scheduled_solve(klass, run_solve, coalesce_key=key,
+                                     label="optimizations")
 
     def _cache_valid(self, generation) -> bool:
         """Caller holds _cache_lock."""
@@ -713,6 +816,25 @@ class CruiseControl:
         with self._cache_lock:
             self._cached_result = None
             self._cache_epoch += 1
+
+    # ------------------------------------------------------------------
+    # device-time scheduler gateway (sched/)
+    # ------------------------------------------------------------------
+    def _scheduled_solve(self, klass: SchedulerClass, run,
+                         coalesce_key=None, label: str = "",
+                         fold_key=None, fold_payload=None, fold_run=None):
+        """Submit one solve to the device-time scheduler and block until
+        it runs (or is rejected with QueueFullError at the class queue
+        cap — the REST layer turns that into 429 + Retry-After).  EVERY
+        device solve the facade performs goes through here: the
+        single-gateway invariant the lint rule and the chaos stress test
+        pin."""
+        return self.solve_scheduler.submit(SolveJob(
+            klass=klass, run=run, label=label,
+            coalesce_key=coalesce_key,
+            preemptible=self.solve_scheduler.policy.is_preemptible(klass),
+            fold_key=fold_key, fold_payload=fold_payload,
+            fold_run=fold_run))
 
     # ------------------------------------------------------------------
     # solver degradation ladder (analyzer/degradation.py)
@@ -775,9 +897,10 @@ class CruiseControl:
 
         NOT ladder material: OptimizationFailure (a legitimate solver
         verdict — unsatisfiable hard goal, stats regression — identical
-        at every rung) and InvalidModelInputError (garbage in, garbage
-        at every rung; quarantine starves the source) both propagate
-        immediately."""
+        at every rung), InvalidModelInputError (garbage in, garbage
+        at every rung; quarantine starves the source) and SolvePreempted
+        (scheduler control flow — the dispatch loop re-queues the job)
+        all propagate immediately."""
         if not self._solver_degradation_enabled:
             return self._solve_on_rung(SolverRung.FUSED, optimizer,
                                        cacheable, options,
@@ -792,7 +915,8 @@ class CruiseControl:
                                              options,
                                              allow_capacity_estimation,
                                              eager_hard_abort)
-            except (OptimizationFailure, InvalidModelInputError) as exc:
+            except (OptimizationFailure, InvalidModelInputError,
+                    SolvePreempted) as exc:
                 if isinstance(exc, InvalidModelInputError):
                     self.metrics.meter("solver-invalid-input").mark()
                 raise
@@ -861,6 +985,7 @@ class CruiseControl:
                   strategy: Optional[ReplicaMovementStrategy] = None,
                   ignore_proposal_cache: bool = False,
                   kafka_assigner: bool = False,
+                  _scheduler_class: Optional[SchedulerClass] = None,
                   **execute_kwargs) -> OperationResult:
         self._sanity_check_execution(dryrun)
         if kafka_assigner:
@@ -870,7 +995,8 @@ class CruiseControl:
         result = self.optimizations(
             goals, options,
             ignore_proposal_cache=ignore_proposal_cache
-            or options is not None or kafka_assigner)
+            or options is not None or kafka_assigner,
+            _scheduler_class=_scheduler_class)
         return self._maybe_execute(result, dryrun, reason, strategy,
                                    **execute_kwargs)
 
@@ -881,13 +1007,20 @@ class CruiseControl:
                            goals: Optional[Sequence[str]] = None,
                            include_base: Optional[bool] = None,
                            include_proposals: bool = True,
-                           reason: str = "scenarios"
-                           ) -> ScenarioBatchResult:
+                           reason: str = "scenarios",
+                           _scheduler_class: Optional[SchedulerClass]
+                           = None) -> ScenarioBatchResult:
         """Evaluate K what-if cluster variants in one batched device
         solve (DRY-RUN ONLY — the engine can rank hypotheticals, never
         execute them).  Unless disabled, a no-op base scenario is
         prepended so the report can diff every what-if against "do
-        nothing"."""
+        nothing".
+
+        Runs as a SCENARIO_SWEEP job under the device-time scheduler:
+        compatible sweeps queued at dispatch time (same goal override,
+        same model generation) FOLD into one vmapped engine batch — one
+        compile amortized across callers — and each caller gets back
+        exactly its own outcomes."""
         if not self._scenario_enabled:
             raise ValueError(
                 "the scenario engine is disabled "
@@ -900,14 +1033,61 @@ class CruiseControl:
         if include_base and not any(s.name == BASE_SCENARIO_NAME
                                     for s in specs):
             specs = [ScenarioSpec(name=BASE_SCENARIO_NAME)] + specs
-        state, topo = self.cluster_model()
-        gen_options = self._options_generator.generate(
-            OptimizationOptions(), topo)
+        klass = (_scheduler_class if _scheduler_class is not None
+                 else SchedulerClass.SCENARIO_SWEEP)
+        generation = self.load_monitor.model_generation()
+        goal_key = tuple(goals) if goals is not None else None
         OPERATION_LOG.info("%s: evaluating %d scenarios (dry run)",
                            reason, len(specs))
-        return self.scenario_engine.evaluate(
-            state, topo, specs, goals=goals, options=gen_options,
-            include_proposals=include_proposals)
+
+        def fold_run(spec_lists: List[List[ScenarioSpec]]
+                     ) -> List[ScenarioBatchResult]:
+            state, topo = self.cluster_model()
+            gen_options = self._options_generator.generate(
+                OptimizationOptions(), topo)
+            if len(spec_lists) == 1:
+                return [self.scenario_engine.evaluate(
+                    state, topo, spec_lists[0], goals=goals,
+                    options=gen_options,
+                    include_proposals=include_proposals)]
+            # every folded caller prepends the SAME no-op base scenario:
+            # solve it once and hand the shared outcome back to each —
+            # the saved slots are the fold's whole point
+            has_base = [bool(lst) and lst[0].name == BASE_SCENARIO_NAME
+                        and lst[0].is_noop() for lst in spec_lists]
+            merged: List[ScenarioSpec] = (
+                [ScenarioSpec(name=BASE_SCENARIO_NAME)] if any(has_base)
+                else [])
+            for lst, hb in zip(spec_lists, has_base):
+                merged.extend(lst[1:] if hb else lst)
+            OPERATION_LOG.info(
+                "scenario fold: %d compatible sweeps merged into one "
+                "%d-scenario batch", len(spec_lists), len(merged))
+            batch = self.scenario_engine.evaluate(
+                state, topo, merged, goals=goals, options=gen_options,
+                include_proposals=include_proposals)
+            base_outcome = batch.outcomes[0] if any(has_base) else None
+            split, i = [], 1 if any(has_base) else 0
+            for lst, hb in zip(spec_lists, has_base):
+                n = len(lst) - (1 if hb else 0)
+                outs = batch.outcomes[i:i + n]
+                i += n
+                if hb:
+                    outs = [base_outcome] + outs
+                split.append(ScenarioBatchResult(
+                    outcomes=outs, duration_s=batch.duration_s,
+                    compile_s=batch.compile_s, solve_s=batch.solve_s,
+                    oom_halvings=batch.oom_halvings,
+                    batch_sizes=list(batch.batch_sizes),
+                    rung=batch.rung))
+            return split
+
+        fold_key = ("scenarios", goal_key, generation, include_proposals)
+        coalesce_key = fold_key + (tuple(repr(s) for s in specs),)
+        return self._scheduled_solve(
+            klass, lambda: fold_run([specs])[0],
+            coalesce_key=coalesce_key, label="scenarios",
+            fold_key=fold_key, fold_payload=specs, fold_run=fold_run)
 
     def _broker_candidates(self, op: str, sets, goals, dryrun: bool,
                            reason: str) -> OperationResult:
@@ -953,6 +1133,7 @@ class CruiseControl:
     def add_brokers(self, broker_ids: Sequence[int],
                     goals: Optional[Sequence[str]] = None,
                     dryrun: bool = True, reason: str = "add brokers",
+                    _scheduler_class: Optional[SchedulerClass] = None,
                     **execute_kwargs) -> OperationResult:
         """Move replicas ONTO the new brokers only (reference
         AddBrokerRunnable; OptimizationVerifier forbids old→old moves).
@@ -978,13 +1159,17 @@ class CruiseControl:
         options = OptimizationOptions(
             requested_destination_broker_ids=frozenset(broker_ids))
         optimizer = self._optimizer_for(goals)
-        result = optimizer.optimizations(state, topo, options)
+        result = self._scheduled_solve(
+            _scheduler_class or SchedulerClass.USER_INTERACTIVE,
+            lambda: optimizer.optimizations(state, topo, options),
+            label="add-brokers")
         return self._maybe_execute(result, dryrun, reason, None,
                                    **execute_kwargs)
 
     def remove_brokers(self, broker_ids: Sequence[int],
                        goals: Optional[Sequence[str]] = None,
                        dryrun: bool = True, reason: str = "remove brokers",
+                       _scheduler_class: Optional[SchedulerClass] = None,
                        **execute_kwargs) -> OperationResult:
         """Drain all replicas off the given brokers (reference
         RemoveBrokerRunnable: brokers modeled as dead so self-healing
@@ -1002,13 +1187,17 @@ class CruiseControl:
         for b in broker_ids:
             state = S.set_broker_state(state, idx[b], alive=False)
         optimizer = self._optimizer_for(goals)
-        result = optimizer.optimizations(state, topo)
+        result = self._scheduled_solve(
+            _scheduler_class or SchedulerClass.USER_INTERACTIVE,
+            lambda: optimizer.optimizations(state, topo),
+            label="remove-brokers")
         return self._maybe_execute(result, dryrun, reason, None,
                                    removed_brokers=list(broker_ids),
                                    **execute_kwargs)
 
     def demote_brokers(self, broker_ids: Sequence[int],
                        dryrun: bool = True, reason: str = "demote brokers",
+                       _scheduler_class: Optional[SchedulerClass] = None,
                        **execute_kwargs) -> OperationResult:
         """Shift leadership (and preferred-leader order) off the brokers
         (reference DemoteBrokerRunnable + PreferredLeaderElectionGoal).
@@ -1025,7 +1214,10 @@ class CruiseControl:
         idx = topo.broker_index
         for b in broker_ids:
             state = S.set_broker_state(state, idx[b], demoted=True)
-        result = self._ple_optimizer.optimizations(state, topo)
+        result = self._scheduled_solve(
+            _scheduler_class or SchedulerClass.USER_INTERACTIVE,
+            lambda: self._ple_optimizer.optimizations(state, topo),
+            label="demote-brokers")
         return self._maybe_execute(result, dryrun, reason, None,
                                    demoted_brokers=list(broker_ids),
                                    **execute_kwargs)
@@ -1033,6 +1225,8 @@ class CruiseControl:
     def fix_offline_replicas(self, goals: Optional[Sequence[str]] = None,
                              dryrun: bool = True,
                              reason: str = "fix offline replicas",
+                             _scheduler_class: Optional[SchedulerClass]
+                             = None,
                              **execute_kwargs) -> OperationResult:
         """Relocate offline replicas to healthy brokers/disks (reference
         FixOfflineReplicasRunnable)."""
@@ -1041,7 +1235,10 @@ class CruiseControl:
         if not bool(np.asarray(S.self_healing_eligible(state)).any()):
             raise ValueError("no offline replicas to fix")
         optimizer = self._optimizer_for(goals)
-        result = optimizer.optimizations(state, topo)
+        result = self._scheduled_solve(
+            _scheduler_class or SchedulerClass.USER_INTERACTIVE,
+            lambda: optimizer.optimizations(state, topo),
+            label="fix-offline-replicas")
         return self._maybe_execute(result, dryrun, reason, None,
                                    **execute_kwargs)
 
@@ -1145,7 +1342,8 @@ class CruiseControl:
     def state(self, substates: Optional[Sequence[str]] = None) -> dict:
         want = {s.lower() for s in (substates or
                                     ("monitor", "executor", "analyzer",
-                                     "anomaly_detector", "scenario"))}
+                                     "anomaly_detector", "scenario",
+                                     "scheduler"))}
         out: dict = {}
         if "monitor" in want:
             ms = self.load_monitor.get_state()
@@ -1183,6 +1381,11 @@ class CruiseControl:
                 "enabled": self._scenario_enabled,
                 **self.scenario_engine.to_json(),
             }
+        if "scheduler" in want:
+            # the operator's first stop when requests wait: per-class
+            # queue depth/wait, device occupancy, coalesce/preempt/
+            # reject counters (sched/stats.py)
+            out["SchedulerState"] = self.solve_scheduler.to_json()
         if "sensors" in want:
             out["Sensors"] = self.metrics.to_json()
         return out

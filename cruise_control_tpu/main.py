@@ -306,6 +306,18 @@ def build_cruise_control(config: CruiseControlConfig, admin,
             "scenario.max.oom.halvings"),
         scenario_include_base=config.get_boolean(
             "scenario.include.base.solve"),
+        scheduler_enabled=config.get_boolean("scheduler.enabled"),
+        scheduler_preemption_enabled=config.get_boolean(
+            "scheduler.preemption.enabled"),
+        scheduler_class_weights=[
+            float(x) for x in config.get_list("scheduler.class.weights")
+            if str(x).strip()],
+        scheduler_class_queue_caps=[
+            int(x) for x in config.get_list("scheduler.class.queue.caps")
+            if str(x).strip()],
+        scheduler_class_deadline_budgets_s=[
+            float(x) / 1e3 for x in config.get_list(
+                "scheduler.class.deadline.budget.ms") if str(x).strip()],
         monitor_kwargs=dict(
             sample_store=sample_store,
             num_windows=config.get_int("num.partition.metrics.windows"),

@@ -56,6 +56,8 @@ from cruise_control_tpu.scenario.compiler import (CompiledBatch,
                                                   _batch_geometry,
                                                   compile_batch, materialize)
 from cruise_control_tpu.scenario.spec import ScenarioSpec
+from cruise_control_tpu.sched.runtime import (SolvePreempted,
+                                              segment_checkpoint)
 from cruise_control_tpu.utils import faults
 
 LOG = logging.getLogger(__name__)
@@ -282,6 +284,10 @@ class ScenarioEngine:
                                          specs, options, include_proposals,
                                          result,
                                          table_override=overflow.slots)
+            except SolvePreempted:
+                # scheduler preemption is control flow, never ladder
+                # material: the dispatch loop re-queues the whole sweep
+                raise
             except Exception as exc:  # noqa: BLE001 - ladder classifies
                 kind = classify_failure(exc)
                 self.ladder.on_failure(SolverRung.FUSED)
@@ -331,6 +337,8 @@ class ScenarioEngine:
                         rung="EAGER"))
                     served_any_at_rung = True
                     continue
+                except SolvePreempted:
+                    raise
                 except Exception as exc:  # noqa: BLE001
                     eager_failed = True
                     self.ladder.on_failure(SolverRung.EAGER)
@@ -452,6 +460,10 @@ class ScenarioEngine:
         prev_stats = stats0_dev
         stacked_parts, own_parts, rounds_parts, regr_parts = [], [], [], []
         for start in range(0, len(optimizer.goals), seg):
+            # scheduler preemption checkpoint: a queued ANOMALY_HEAL /
+            # USER_INTERACTIVE solve takes the device at the next
+            # segment boundary; the whole sweep re-queues
+            segment_checkpoint()
             stop = min(start + seg, len(optimizer.goals))
             (state, cache, prev_stats,
              (stacked_seg, own_seg, rounds_seg, regr_seg, _hard)) = \

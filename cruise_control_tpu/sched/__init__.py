@@ -1,0 +1,25 @@
+"""Device-time solve scheduler: the single gateway for every solve.
+
+Pieces: policy.py (priority classes, caps, deadline budgets, aging),
+queue.py (bounded admission + single-flight coalescing + backpressure),
+scheduler.py (the dispatch loop: priority order, scenario folding,
+segment-boundary preemption), stats.py (SchedulerState + sched-*
+sensors), runtime.py (the thread-local hooks the solver pipeline and the
+USER_TASKS layer share with the scheduler).
+"""
+from cruise_control_tpu.sched.policy import (PREEMPTIBLE_CLASSES,
+                                             ClassPolicy, SchedulerClass,
+                                             SchedulerPolicy)
+from cruise_control_tpu.sched.queue import (AdmissionQueue, QueueFullError,
+                                            SolveTicket)
+from cruise_control_tpu.sched.runtime import SolvePreempted
+from cruise_control_tpu.sched.scheduler import (DeviceTimeScheduler,
+                                                SchedulerStoppedError,
+                                                SolveJob)
+
+__all__ = [
+    "AdmissionQueue", "ClassPolicy", "DeviceTimeScheduler",
+    "PREEMPTIBLE_CLASSES", "QueueFullError", "SchedulerClass",
+    "SchedulerPolicy", "SchedulerStoppedError", "SolveJob",
+    "SolvePreempted", "SolveTicket",
+]

@@ -1,0 +1,96 @@
+"""Thread-local runtime hooks tying the device-time scheduler to the
+solver pipeline without an import cycle.
+
+The scheduler (sched/scheduler.py) sits ABOVE the facade's solve paths;
+the solver pipeline (analyzer/optimizer.py, scenario/engine.py) sits
+BELOW them.  Both ends need a tiny shared surface:
+
+* the *gateway* flag — set for the duration of a scheduled job so tests
+  (and the chaos stress suite) can assert every device solve entered
+  through the scheduler ("single-gateway" invariant; the static half is
+  tools/lint.py's gateway rule);
+* the *segment checkpoint* — the dispatch loop installs a preemption
+  check around a preemptible job; the optimizer and the scenario engine
+  call `segment_checkpoint()` between goal segments, and when the check
+  fires the in-flight solve unwinds with `SolvePreempted` at that
+  boundary (device buffers are simply dropped; the scheduler re-queues
+  the job and serves the higher-priority request first);
+* the *submission listener* — the USER_TASKS pool registers a callback
+  per operation run so the user-task registry can attach the scheduler
+  ticket (queue position / class / ETA) to the task it is serving.
+
+This module has NO dependencies inside the package, so the optimizer can
+import it without pulling the scheduler (and vice versa).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Callable, Optional
+
+_TLS = threading.local()
+
+
+class SolvePreempted(Exception):
+    """Control-flow signal, not an error: the dispatch loop asked the
+    in-flight solve to yield the device at the next segment boundary
+    because a higher-priority request is queued.  The scheduler catches
+    it, re-queues the preempted job (original enqueue time kept, so its
+    anti-starvation aging continues), and dispatches the higher-priority
+    work.  Never ladder material — a preempted solve did not FAIL."""
+
+
+def under_gateway() -> bool:
+    """True while the current thread is executing a scheduled solve job
+    (or an inline job of a disabled scheduler) — the runtime half of the
+    single-gateway invariant."""
+    return getattr(_TLS, "gateway_depth", 0) > 0
+
+
+@contextlib.contextmanager
+def gateway(preempt_check: Optional[Callable[[], bool]] = None):
+    """Mark the current thread as inside the solve gateway; when
+    `preempt_check` is given, `segment_checkpoint()` consults it between
+    goal segments and raises SolvePreempted when it returns True."""
+    depth = getattr(_TLS, "gateway_depth", 0)
+    prev_check = getattr(_TLS, "preempt_check", None)
+    _TLS.gateway_depth = depth + 1
+    _TLS.preempt_check = preempt_check
+    try:
+        yield
+    finally:
+        _TLS.gateway_depth = depth
+        _TLS.preempt_check = prev_check
+
+
+def segment_checkpoint() -> None:
+    """Called by the solver between goal segments (and by the scenario
+    engine between batched segments): a no-op unless the scheduler
+    installed a preemption check for the running job.  One host-side
+    predicate read per segment — no device sync."""
+    check = getattr(_TLS, "preempt_check", None)
+    if check is not None and check():
+        raise SolvePreempted(
+            "higher-priority solve queued; yielding the device at a "
+            "segment boundary")
+
+
+# ---------------------------------------------------------------------------
+# submission listener (user-task <-> scheduler-ticket linkage)
+# ---------------------------------------------------------------------------
+def set_submission_listener(cb: Callable[[object], None]) -> None:
+    """Install a per-thread callback invoked with every SolveTicket the
+    current thread's work submits to the scheduler."""
+    _TLS.submission_listener = cb
+
+
+def clear_submission_listener() -> None:
+    _TLS.submission_listener = None
+
+
+def notify_submission(ticket: object) -> None:
+    """Report a scheduler submission to the current thread's listener
+    (no-op without one)."""
+    cb = getattr(_TLS, "submission_listener", None)
+    if cb is not None:
+        cb(ticket)

@@ -1,0 +1,298 @@
+"""The device-time scheduler: one dispatch loop owning the device token.
+
+Every solve in the process — REST rebalances, the proposal precompute,
+anomaly-remediation solves, scenario sweeps — is wrapped in a `SolveJob`
+and submitted here; submitters block on a `SolveTicket` while the single
+dispatch thread runs jobs one at a time in effective-priority order
+(policy.py).  That buys, over the unscheduled free-for-all of 8
+USER_TASKS pool threads + the precompute loop + detector self-healing
+racing one accelerator:
+
+* **priority admission** — an anomaly heal never sits behind a queued
+  32-scenario sweep; aging keeps the background classes from starving;
+* **single-flight coalescing** — N identical queued/in-flight requests
+  attach to ONE compile+solve (queue.py);
+* **scenario folding** — compatible queued SCENARIO_SWEEP jobs merge
+  into one vmapped engine batch (one compile amortized over all of
+  them) and their outcomes are split back per caller;
+* **preemption** — preemptible jobs (PRECOMPUTE / SCENARIO_SWEEP) are
+  asked to yield at the next goal-segment boundary when a
+  higher-priority class queues up (runtime.segment_checkpoint); the
+  abandoned job is re-queued with its aging intact, compiled programs
+  and the proposal cache untouched;
+* **backpressure** — admission beyond a class's queue cap raises
+  QueueFullError, surfaced as HTTP 429 + Retry-After.
+
+The solve itself is whatever the facade wrapped — the PR-2 degradation
+ladder, the PR-1 fused pipeline, the PR-3 scenario engine all run
+unchanged inside the job.
+
+Fault site: ``sched.dispatch`` fires before every job execution so chaos
+tests can fail dispatches deterministically.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any, Callable, List, Optional
+
+from cruise_control_tpu.sched import runtime
+from cruise_control_tpu.sched.policy import SchedulerClass, SchedulerPolicy
+from cruise_control_tpu.sched.queue import (AdmissionQueue, QueueFullError,
+                                            SolveTicket)
+from cruise_control_tpu.sched.stats import SchedulerStats, attach_metrics
+from cruise_control_tpu.utils import faults
+
+LOG = logging.getLogger(__name__)
+
+__all__ = ["SolveJob", "DeviceTimeScheduler", "QueueFullError",
+           "SchedulerClass", "SolveTicket"]
+
+
+@dataclasses.dataclass
+class SolveJob:
+    """One unit of device work.
+
+    `run` executes the solve and returns its result.  `coalesce_key`
+    (optional) enables single-flight: identical keys share one
+    execution.  Fold support (SCENARIO_SWEEP): jobs sharing a non-None
+    `fold_key` may be merged — the scheduler calls `fold_run` with the
+    list of every folded job's `fold_payload` and expects one result per
+    payload, in order."""
+
+    klass: SchedulerClass
+    run: Callable[[], Any]
+    label: str = ""
+    coalesce_key: Optional[tuple] = None
+    preemptible: bool = False
+    fold_key: Optional[tuple] = None
+    fold_payload: Any = None
+    fold_run: Optional[Callable[[List[Any]], List[Any]]] = None
+
+
+class SchedulerStoppedError(RuntimeError):
+    """The scheduler shut down while this request was queued."""
+
+
+class DeviceTimeScheduler:
+    """See module docstring.  `enabled=False` degenerates to running
+    every job inline on the submitting thread (still inside the gateway,
+    so the single-gateway invariant holds either way) — the K=1
+    single-client path is byte-identical in both modes because the job
+    body is the same code."""
+
+    def __init__(self, policy: Optional[SchedulerPolicy] = None,
+                 enabled: bool = True,
+                 max_fold: int = 8,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        import time as _time
+        self.policy = policy or SchedulerPolicy.default()
+        self.enabled = enabled
+        self._max_fold = max(1, max_fold)
+        self._time = time_fn or _time.time
+        self.queue = AdmissionQueue(self.policy, self._time)
+        self.stats = SchedulerStats(self._time)
+        self._metrics = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._thread_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def attach_metrics(self, registry) -> None:
+        self._metrics = registry
+        attach_metrics(registry, self)
+
+    def _mark(self, sensor: str, n: int = 1) -> None:
+        if self._metrics is not None:
+            self._metrics.meter(sensor).mark(n)
+
+    # ------------------------------------------------------------------
+    # submission (blocking: the caller's thread waits on the ticket)
+    # ------------------------------------------------------------------
+    def submit(self, job: SolveJob,
+               timeout: Optional[float] = None) -> Any:
+        """Run `job` under the scheduler and return its result (or raise
+        what it raised).  Raises QueueFullError at the class queue cap.
+
+        Inline execution (no queue) happens when the scheduler is
+        disabled or when the DISPATCH THREAD itself submits (a scheduled
+        job that submits nested device work must not deadlock waiting
+        for the busy dispatcher).  A submission after stop() is rejected
+        with SchedulerStoppedError — running it inline would race the
+        rest of teardown with a full device solve (facade.shutdown
+        relies on nothing new being admitted)."""
+        if (self._stop.is_set() and self.enabled
+                and threading.current_thread() is not self._thread):
+            raise SchedulerStoppedError(
+                "scheduler is stopped; not accepting new solves")
+        self.stats.record_submitted()
+        if (not self.enabled
+                or threading.current_thread() is self._thread):
+            t0 = self._time()
+            failed = True
+            try:
+                with runtime.gateway():
+                    result = job.run()
+                failed = False
+                return result
+            finally:
+                self.stats.record_done(self._time() - t0, failed)
+        try:
+            ticket, created = self.queue.offer(job)
+        except QueueFullError:
+            self.stats.record_rejected()
+            self._mark("sched-rejected-requests")
+            raise
+        if created:
+            self._ensure_dispatcher()
+        else:
+            self.stats.record_coalesced()
+            self._mark("sched-coalesced-requests")
+        runtime.notify_submission(ticket)
+        return ticket.wait(timeout)
+
+    def _ensure_dispatcher(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._thread_lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="solve-scheduler", daemon=True)
+                self._thread.start()
+
+    # ------------------------------------------------------------------
+    # dispatch loop
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            entry = self.queue.take(self._stop)
+            if entry is None:
+                continue
+            entries = [entry]
+            job = entry.job
+            if job.fold_key is not None and job.fold_run is not None:
+                entries += self.queue.take_fold_peers(job.fold_key,
+                                                      self._max_fold - 1)
+            self._execute(entries)
+        for entry in self.queue.drain():
+            self.queue.finish(entry)
+            entry.ticket.fail(SchedulerStoppedError(
+                "scheduler stopped while the request was queued"))
+
+    def _execute(self, entries: List) -> None:
+        job = entries[0].job
+        now = self._time()
+        best = min(e.best_klass for e in entries)
+        for e in entries:
+            # wait sampled since the LAST (re)queue: a redispatch after
+            # preemption logs only the incremental wait, not the full
+            # original wait again
+            self.stats.record_dispatch(e.best_klass,
+                                       now - e.last_queued_at)
+            if self._metrics is not None:
+                name = e.best_klass.name.lower().replace("_", "-")
+                self._metrics.update_timer(f"sched-wait-timer-{name}",
+                                           now - e.last_queued_at)
+        check = None
+        if (job.preemptible and self.policy.preemption_enabled):
+            # evaluate BOTH sides LIVE at each checkpoint: a more urgent
+            # request coalescing onto this in-flight solve upgrades
+            # best_klass, and the running job's own aging credit keeps
+            # accruing (requeue preserves enqueued_at) — so each
+            # preemption raises the bar the queued traffic must clear,
+            # and a repeatedly-preempted job eventually completes
+            # instead of livelocking under sustained interactive load
+            def check():
+                now = self._time()
+                running = min(self.policy.effective_priority(
+                    e.best_klass, now - e.enqueued_at) for e in entries)
+                return self.queue.has_effective_better_than(running)
+        t0 = self._time()
+        try:
+            faults.inject("sched.dispatch")
+            with runtime.gateway(check):
+                if len(entries) > 1:
+                    results = job.fold_run(
+                        [e.job.fold_payload for e in entries])
+                    if len(results) != len(entries):
+                        raise RuntimeError(
+                            f"fold_run returned {len(results)} results "
+                            f"for {len(entries)} folded jobs")
+                else:
+                    results = [job.run()]
+        except runtime.SolvePreempted:
+            # the yielded segments really ran on the device: count them
+            # busy (occupancy must not read idle under preemption
+            # thrash), but not as a latency sample
+            self.stats.record_preempted(len(entries),
+                                        busy_s=self._time() - t0)
+            self._mark("sched-preemptions", len(entries))
+            LOG.info("preempted %s job %r at a segment boundary "
+                     "(%d queued above it); re-queued",
+                     best.name, job.label, self.queue.depth())
+            for e in entries:
+                self.queue.requeue(e)
+            return
+        except BaseException as exc:  # noqa: BLE001 - resolve the waiters
+            duration = self._time() - t0
+            self.stats.record_done(duration, failed=True)
+            # NOT a latency sample (same rule as preemption): a solve
+            # failing fast — e.g. invalid model input raised in 0.1s —
+            # would collapse the EWMA and have Retry-After tell rejected
+            # clients to hammer the server every ~1s for the duration of
+            # an incident, instead of backing off on the scale of a real
+            # solve
+            LOG.warning("scheduled %s job %r failed: %s: %s", best.name,
+                        job.label, type(exc).__name__, exc)
+            for e in entries:
+                self.queue.finish(e)
+                e.ticket.fail(exc)
+            return
+        duration = self._time() - t0
+        self.stats.record_done(duration, failed=False)
+        self.queue.observe_latency(duration)
+        self._mark("sched-dispatches")
+        if self._metrics is not None:
+            self._metrics.update_timer("sched-solve-timer", duration)
+        if len(entries) > 1:
+            self.stats.record_folded(len(entries) - 1)
+            self._mark("sched-folded-sweeps", len(entries) - 1)
+        for e, result in zip(entries, results):
+            self.queue.finish(e)
+            e.ticket.resolve(result)
+
+    # ------------------------------------------------------------------
+    def stop(self, join_timeout_s: float = 5.0) -> None:
+        """Stop dispatching; pending tickets fail with
+        SchedulerStoppedError.  A wedged in-flight solve cannot be
+        aborted from Python — the daemon dispatch thread dies with the
+        process, mirroring the precompute watchdog's shutdown rule."""
+        self._stop.set()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=join_timeout_s)
+            if thread.is_alive():
+                LOG.warning("solve-scheduler still running after %.0fs "
+                            "join timeout; shutting down around it",
+                            join_timeout_s)
+        # the loop drains on exit; drain here too for the never-started
+        # or wedged-thread cases
+        for entry in self.queue.drain():
+            self.queue.finish(entry)
+            entry.ticket.fail(SchedulerStoppedError(
+                "scheduler stopped while the request was queued"))
+
+    # ------------------------------------------------------------------
+    def to_json(self) -> dict:
+        depths = self.queue.depths()
+        return {
+            "enabled": self.enabled,
+            "policy": self.policy.to_json(),
+            "queueDepthByClass": {c.name: d for c, d in depths.items()},
+            "queueDepth": sum(depths.values()),
+            "oldestWaitS": round(self.queue.oldest_wait_s(), 3),
+            "latencyEwmaS": round(self.queue.latency_ewma_s(), 3),
+            "occupancy": round(self.stats.occupancy(), 4),
+            **self.stats.to_json(),
+        }

@@ -97,6 +97,25 @@ class TestPurgatory:
             purgatory.take_approved(req.review_id, "REBALANCE",
                                     "dryrun=false")
 
+    def test_re_arm_restores_consumed_approval(self):
+        """A 429-rejected submission consumed its one-shot approval
+        without ever executing — re_arm rolls it back to APPROVED so the
+        client's automatic retry is not burned on a dead review."""
+        purgatory = Purgatory()
+        req = purgatory.submit("REBALANCE", "dryrun=false", "alice")
+        purgatory.review([req.review_id], [], reason="lgtm")
+        purgatory.take_approved(req.review_id, "REBALANCE", "dryrun=false")
+        purgatory.re_arm(req.review_id)
+        assert req.status.value == "APPROVED"
+        taken = purgatory.take_approved(req.review_id, "REBALANCE",
+                                        "dryrun=false")
+        assert taken.status.value == "SUBMITTED"
+        # no-ops: a not-yet-consumed review and an unknown id
+        req2 = purgatory.submit("REBALANCE", "", "bob")
+        purgatory.re_arm(req2.review_id)
+        assert req2.status.value == "PENDING_REVIEW"
+        purgatory.re_arm(999999)
+
     def test_discard_and_wrong_endpoint(self):
         purgatory = Purgatory()
         req = purgatory.submit("REMOVE_BROKER", "brokerid=1", "bob")

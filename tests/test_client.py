@@ -70,6 +70,136 @@ class TestClient:
         assert "userTasks" in tasks
 
 
+class TestRetry429:
+    """Scheduler backpressure handling: HTTP 429 + Retry-After gets
+    capped exponential backoff with DETERMINISTIC jitter, then the
+    request is resubmitted (previously a 429 was a hard failure)."""
+
+    def make_client(self, responses, sleeps, token="pinned-test-client"):
+        client = CruiseControlClient(
+            "http://cc.test/kafkacruisecontrol",
+            retry_backoff_base_s=1.0, retry_backoff_max_s=30.0,
+            retry_jitter_token=token,
+            sleep_fn=sleeps.append)
+        calls = []
+
+        def fake_http(method, url, task_id, data=None):
+            calls.append((method, url, task_id, data))
+            return responses[min(len(calls) - 1, len(responses) - 1)]
+        client._http = fake_http
+        return client, calls
+
+    def test_429_retries_honor_retry_after_and_succeed(self):
+        sleeps = []
+        rejected = (429, {"Retry-After": "7"},
+                    {"errorMessage": "QueueFullError: solve queue full",
+                     "retryAfterSeconds": 7, "version": 1})
+        ok = (200, {}, {"version": 1, "summary": {}})
+        client, calls = self.make_client([rejected, rejected, ok], sleeps)
+        out = client.request("PROPOSALS")
+        assert out["version"] == 1
+        assert len(calls) == 3
+        # Retry-After (7s) floors the 1s/2s exponential backoff, and
+        # per-client jitter scales it UP — never sleep less than the
+        # server's floor, never exactly the floor for every client
+        assert len(sleeps) == 2
+        for delay in sleeps:
+            assert 7.0 <= delay < 7.0 * 1.5
+
+    def test_429_backoff_is_exponential_with_deterministic_jitter(self):
+        def run():
+            sleeps = []
+            rejected = (429, {}, {"errorMessage": "full", "version": 1})
+            ok = (200, {}, {"version": 1})
+            client, _ = self.make_client(
+                [rejected, rejected, rejected, ok], sleeps)
+            client.request("PROPOSALS")
+            return sleeps
+
+        first, second = run(), run()
+        assert first == second                 # deterministic per token
+        assert len(first) == 3
+        # capped exponential shape: each delay within [0.5, 1.0) x
+        # base * 2^attempt, and strictly growing
+        for attempt, delay in enumerate(first):
+            assert 0.5 * 2 ** attempt <= delay < 1.0 * 2 ** attempt
+        assert first[0] < first[1] < first[2]
+
+    def test_429_jitter_desynchronizes_distinct_clients(self):
+        """A fleet rejected at the same instant must NOT retry in
+        lockstep (that would refill the queue and 429 everyone again):
+        distinct client tokens hash to distinct delays, and the
+        auto-generated token is distinct per client instance."""
+        def run(token, headers=None):
+            sleeps = []
+            rejected = (429, headers or {},
+                        {"errorMessage": "full", "version": 1})
+            ok = (200, {}, {"version": 1})
+            client, _ = self.make_client(
+                [rejected, rejected, rejected, ok], sleeps, token=token)
+            client.request("PROPOSALS")
+            return sleeps
+
+        assert run("client-a") != run("client-b")
+        # jitter must survive a dominating Retry-After: an unjittered
+        # max(retry_after, backoff*jitter) would give every client
+        # exactly 7.0 and re-stampede the queue in lockstep
+        floored = {"Retry-After": "7"}
+        a, b = run("client-a", floored), run("client-b", floored)
+        assert a != b
+        assert all(d >= 7.0 for d in a + b)
+        c1 = CruiseControlClient("http://cc.test")
+        c2 = CruiseControlClient("http://cc.test")
+        assert c1._jitter_token != c2._jitter_token
+
+    def test_429_retry_discards_the_failed_task_id_and_resends_body(self):
+        """The 429 response carries the FAILED task's User-Task-ID for
+        diagnostics; the retry must NOT reuse it (it would attach to the
+        dead task and replay its cached rejection) and must resend the
+        request body."""
+        from cruise_control_tpu.api.user_tasks import USER_TASK_ID_HEADER
+        sleeps = []
+        rejected = (429, {USER_TASK_ID_HEADER: "dead-task",
+                          "Retry-After": "1"},
+                    {"errorMessage": "QueueFullError: full", "version": 1})
+        ok = (200, {}, {"version": 1, "scenarios": [], "batch": {},
+                        "dryRun": True})
+        client, calls = self.make_client([rejected, ok], sleeps)
+        out = client.request("SCENARIOS", body={"scenarios": []})
+        assert out["version"] == 1
+        assert len(calls) == 2
+        # retry went out WITHOUT the dead task id and WITH the body
+        assert calls[1][2] is None
+        assert calls[1][3] is not None
+
+    def test_429_gives_up_after_max_retries(self):
+        sleeps = []
+        rejected = (429, {"Retry-After": "1"},
+                    {"errorMessage": "QueueFullError: full", "version": 1})
+        client, calls = self.make_client([rejected], sleeps)
+        client._max_retries_429 = 2
+        with pytest.raises(CruiseControlClientError) as err:
+            client.request("PROPOSALS")
+        assert err.value.status == 429
+        assert "gave up after 2 retries" in err.value.message
+        assert len(calls) == 3                 # initial + 2 retries
+
+    def test_zero_retries_fails_fast(self):
+        sleeps = []
+        rejected = (429, {}, {"errorMessage": "full", "version": 1})
+        client, calls = self.make_client([rejected], sleeps)
+        client._max_retries_429 = 0
+        with pytest.raises(CruiseControlClientError):
+            client.request("PROPOSALS")
+        assert len(calls) == 1 and not sleeps
+
+    def test_cli_exposes_max_retries(self):
+        args = build_parser().parse_args(["--max-retries", "0", "state"])
+        assert args.max_retries == 0
+        args = build_parser().parse_args(["state"])
+        assert args.max_retries == 4
+
+
 class TestCli:
     def test_parser_covers_endpoints(self):
         parser = build_parser()

@@ -1,0 +1,1115 @@
+"""Device-time solve scheduler (cruise_control_tpu/sched/).
+
+Host-side units (policy aging, admission caps, coalescing, folding,
+preemption, no-starvation — stub jobs, no device work) plus the
+chaos-marker stress scenarios the PR-4 acceptance pins:
+
+* single-gateway: under 16 concurrent mixed requests every device solve
+  enters via sched/ (runtime `under_gateway` assertion; the static half
+  is tools/lint.py's gateway rule, unit-tested here too);
+* single-flight: N identical concurrent requests coalesce to exactly
+  one compile+solve;
+* preemption ordering: an ANOMALY_HEAL submitted mid-precompute begins
+  executing before the preempted precompute work resumes;
+* backpressure: clean 429 + Retry-After at the queue cap;
+* the single-client K=1 path stays byte-identical to the unscheduled
+  solve.
+"""
+import threading
+import time as _real_time
+
+import conftest  # noqa: F401
+
+import pytest
+
+from cruise_control_tpu.sched import runtime
+from cruise_control_tpu.sched.policy import (PREEMPTIBLE_CLASSES,
+                                             SchedulerClass,
+                                             SchedulerPolicy)
+from cruise_control_tpu.sched.queue import AdmissionQueue, QueueFullError
+from cruise_control_tpu.sched.scheduler import (DeviceTimeScheduler,
+                                                SchedulerStoppedError,
+                                                SolveJob)
+
+from test_facade import feed_samples, make_stack
+
+pytestmark = pytest.mark.chaos
+
+HEAL = SchedulerClass.ANOMALY_HEAL
+USER = SchedulerClass.USER_INTERACTIVE
+PRE = SchedulerClass.PRECOMPUTE
+SWEEP = SchedulerClass.SCENARIO_SWEEP
+
+
+def job(klass=USER, run=lambda: "ok", **kw):
+    return SolveJob(klass=klass, run=run, **kw)
+
+
+# ---------------------------------------------------------------------------
+# policy units
+# ---------------------------------------------------------------------------
+class TestPolicy:
+    def test_base_priority_order(self):
+        p = SchedulerPolicy.default()
+        scores = [p.effective_priority(c, 0.0) for c in SchedulerClass]
+        assert scores == sorted(scores)
+
+    def test_aging_beats_base_priority_eventually(self):
+        """A SCENARIO_SWEEP that waited past its deadline budget earns
+        enough credit to beat a fresh PRECOMPUTE — and with enough wait,
+        even a fresh heal (no starvation)."""
+        p = SchedulerPolicy.default()
+        assert p.effective_priority(SWEEP, 0.0) \
+            > p.effective_priority(PRE, 0.0)
+        budget = p.classes[SWEEP].deadline_budget_s
+        assert p.effective_priority(SWEEP, budget * 2) \
+            < p.effective_priority(PRE, 0.0)
+        assert p.effective_priority(SWEEP, budget * 100) \
+            < p.effective_priority(HEAL, 0.0)
+
+    def test_from_lists_validates(self):
+        with pytest.raises(ValueError, match="exactly 4"):
+            SchedulerPolicy.from_lists(weights=[1, 2, 3])
+        with pytest.raises(ValueError, match="queue cap"):
+            SchedulerPolicy.from_lists(queue_caps=[0, 1, 1, 1])
+
+    def test_preemptible_classes(self):
+        p = SchedulerPolicy.default()
+        assert not p.is_preemptible(HEAL)
+        assert not p.is_preemptible(USER)
+        assert p.is_preemptible(PRE)
+        assert p.is_preemptible(SWEEP)
+        assert PREEMPTIBLE_CLASSES == {PRE, SWEEP}
+
+
+# ---------------------------------------------------------------------------
+# queue units
+# ---------------------------------------------------------------------------
+class TestAdmissionQueue:
+    def make(self, caps=(8, 16, 2, 8), now=None):
+        clock = now if now is not None else {"t": 0.0}
+        q = AdmissionQueue(SchedulerPolicy.from_lists(queue_caps=caps),
+                           lambda: clock["t"])
+        return q, clock
+
+    def test_cap_rejects_with_retry_after(self):
+        q, clock = self.make(caps=(8, 2, 2, 8))
+        q.offer(job())
+        q.offer(job(coalesce_key=None))
+        q.observe_latency(3.0)
+        with pytest.raises(QueueFullError) as exc:
+            q.offer(job())
+        assert exc.value.klass is USER
+        # depth 2 + the incoming one, 3.0s EWMA
+        assert exc.value.retry_after_s == pytest.approx(9.0)
+
+    def test_caps_are_per_class(self):
+        q, clock = self.make(caps=(1, 1, 1, 1))
+        q.offer(job(klass=USER))
+        with pytest.raises(QueueFullError):
+            q.offer(job(klass=USER))
+        q.offer(job(klass=HEAL))  # other classes unaffected
+
+    def test_coalesce_attaches_and_upgrades(self):
+        q, clock = self.make()
+        t1, created1 = q.offer(job(klass=PRE, coalesce_key=("k",)))
+        t2, created2 = q.offer(job(klass=HEAL, coalesce_key=("k",)))
+        assert created1 and not created2 and t1 is t2
+        assert t1.attach_count == 1
+        assert q.depth() == 1
+        # the heal waiter upgraded the entry's dispatch class: it now
+        # beats a fresh USER on the real preemption predicate
+        assert q.has_effective_better_than(float(USER.value))
+        # ...and the shared ticket reports the upgraded class, so a
+        # USER_TASKS row for the heal waiter is not mislabeled as
+        # background precompute work
+        assert t1.klass is HEAL
+        stop = threading.Event()
+        entry = q.take(stop)
+        assert entry.best_klass is HEAL and entry.klass is PRE
+
+    def test_inflight_coalesce_until_finish(self):
+        q, clock = self.make()
+        t1, _ = q.offer(job(coalesce_key=("k",)))
+        entry = q.take(threading.Event())
+        # dispatched but unresolved: identical offers still attach
+        t2, created = q.offer(job(coalesce_key=("k",)))
+        assert t2 is t1 and not created
+        q.finish(entry)
+        t1.resolve("r")
+        t3, created = q.offer(job(coalesce_key=("k",)))
+        assert created and t3 is not t1
+
+    def test_dispatch_order_priority_then_fifo(self):
+        q, clock = self.make()
+        ta, _ = q.offer(job(klass=SWEEP, run=lambda: "a"))
+        tb, _ = q.offer(job(klass=USER, run=lambda: "b"))
+        tc, _ = q.offer(job(klass=USER, run=lambda: "c"))
+        stop = threading.Event()
+        order = [q.take(stop).ticket for _ in range(3)]
+        assert order == [tb, tc, ta]
+
+    def test_queue_position_and_eta(self):
+        q, clock = self.make()
+        q.observe_latency(2.0)
+        t1, _ = q.offer(job(klass=USER))
+        t2, _ = q.offer(job(klass=SWEEP))
+        assert t1.queue_position() == 0 and t2.queue_position() == 1
+        # queued ETA: now + (pos + 1) * ewma
+        assert t2.estimated_start_ms() == pytest.approx(4000.0)
+        entry = q.take(threading.Event())
+        assert entry.ticket is t1
+        assert t1.queue_position() is None
+        assert t1.estimated_start_ms() == pytest.approx(0.0)
+
+    def test_requeue_keeps_enqueue_time(self):
+        q, clock = self.make()
+        t1, _ = q.offer(job(klass=PRE))
+        entry = q.take(threading.Event())
+        clock["t"] = 100.0
+        q.requeue(entry)
+        assert entry.enqueued_at == 0.0
+        assert q.oldest_wait_s() == pytest.approx(100.0)
+
+    def test_preemption_predicate_respects_running_aging(self):
+        """The segment-checkpoint predicate compares EFFECTIVE
+        priorities on both sides: a freshly-dispatched PRECOMPUTE
+        yields to a fresh USER, but one whose aging credit has closed
+        the base-class gap does NOT — so sustained interactive traffic
+        delays a preemptible job a bounded number of segments instead
+        of livelocking it (a heal still preempts until the credit
+        covers two classes)."""
+        clock = {"t": 0.0}
+        p = SchedulerPolicy.default()   # PRE: weight 2, budget 120s
+        q = AdmissionQueue(p, lambda: clock["t"])
+        q.offer(job(klass=PRE))
+        entry = q.take(threading.Event())
+
+        def running_eff():
+            return p.effective_priority(entry.best_klass,
+                                        clock["t"] - entry.enqueued_at)
+
+        clock["t"] = 1.0
+        q.offer(job(klass=USER))
+        assert q.has_effective_better_than(running_eff())
+        q.take(threading.Event())       # drain the USER entry
+        # 70s of accrued aging: credit 2*(70/120) > the 1-class gap to
+        # USER_INTERACTIVE, < the 2-class gap to ANOMALY_HEAL
+        clock["t"] = 70.0
+        q.offer(job(klass=USER))
+        assert not q.has_effective_better_than(running_eff())
+        q.offer(job(klass=HEAL))
+        assert q.has_effective_better_than(running_eff())
+
+
+# ---------------------------------------------------------------------------
+# scheduler units (stub jobs, no device work)
+# ---------------------------------------------------------------------------
+class TestSchedulerUnits:
+    def blocked_scheduler(self, policy=None):
+        """A scheduler whose dispatcher is parked on a gate job, so
+        submissions from test threads queue deterministically."""
+        sched = DeviceTimeScheduler(policy or SchedulerPolicy.default())
+        gate = threading.Event()
+        started = threading.Event()
+
+        def gate_run():
+            started.set()
+            assert gate.wait(30.0)
+            return "gate"
+
+        waiter = threading.Thread(
+            target=lambda: sched.submit(job(klass=USER, run=gate_run)),
+            daemon=True)
+        waiter.start()
+        assert started.wait(10.0)
+        return sched, gate
+
+    def submit_async(self, sched, j):
+        out = {}
+
+        def run():
+            try:
+                out["result"] = sched.submit(j)
+            except BaseException as exc:  # noqa: BLE001 - asserted below
+                out["exc"] = exc
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return t, out
+
+    def test_coalesced_submits_share_one_execution(self):
+        sched, gate = self.blocked_scheduler()
+        calls = []
+
+        def solve():
+            calls.append(1)
+            return "r"
+
+        threads = [self.submit_async(
+            sched, job(run=solve, coalesce_key=("same",)))
+            for _ in range(6)]
+        deadline = _real_time.monotonic() + 10.0
+        while sched.queue.depth() < 1 and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        # 6 submissions -> 1 queued entry
+        assert sched.queue.depth() == 1
+        gate.set()
+        for t, out in threads:
+            t.join(timeout=10.0)
+            assert out.get("result") == "r"
+        assert len(calls) == 1
+        assert sched.stats.coalesced == 5
+        sched.stop()
+
+    def test_priority_dispatch_and_fold(self):
+        sched, gate = self.blocked_scheduler()
+        order = []
+
+        def fold_run(payloads):
+            order.append(("fold", sorted(payloads)))
+            return [f"r{p}" for p in payloads]
+
+        waiters = []
+        for i in range(3):
+            waiters.append(self.submit_async(sched, job(
+                klass=SWEEP, run=lambda: None, fold_key=("f",),
+                fold_payload=i, fold_run=fold_run)))
+        waiters.append(self.submit_async(sched, job(
+            klass=HEAL, run=lambda: order.append("heal") or "h")))
+        deadline = _real_time.monotonic() + 10.0
+        while sched.queue.depth() < 4 and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        gate.set()
+        for t, _ in waiters:
+            t.join(timeout=10.0)
+        # the heal dispatched first; the three sweeps folded into ONE
+        # execution whose results were split back per caller
+        assert order[0] == "heal"
+        assert order[1] == ("fold", [0, 1, 2])
+        assert waiters[0][1]["result"] == "r0"
+        assert waiters[2][1]["result"] == "r2"
+        assert sched.stats.folded == 2
+        sched.stop()
+
+    def test_preemption_requeues_and_runs_urgent_first(self):
+        sched = DeviceTimeScheduler(SchedulerPolicy.default())
+        order = []
+        pre_entered = threading.Event()
+        heal_queued = threading.Event()
+
+        def pre_run():
+            order.append("pre-start")
+            pre_entered.set()
+            assert heal_queued.wait(10.0)
+            runtime.segment_checkpoint()   # the optimizer does this
+            order.append("pre-finish")     # only reached on the re-run
+            return "pre"
+
+        pre_thread, pre_out = self.submit_async(
+            sched, job(klass=PRE, run=pre_run, preemptible=True))
+        assert pre_entered.wait(10.0)
+        pre_entered.clear()
+        heal_thread, heal_out = self.submit_async(
+            sched, job(klass=HEAL,
+                       run=lambda: order.append("heal") or "h"))
+        deadline = _real_time.monotonic() + 10.0
+        while sched.queue.depth() < 1 and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        heal_queued.set()
+        heal_thread.join(timeout=10.0)
+        pre_thread.join(timeout=10.0)
+        assert heal_out["result"] == "h"
+        assert pre_out["result"] == "pre"
+        # preempted at the checkpoint, heal ran, THEN the re-run finished
+        assert order == ["pre-start", "heal", "pre-start", "pre-finish"]
+        assert sched.stats.preemptions == 1
+        sched.stop()
+
+    def test_no_preemption_when_disabled(self):
+        sched = DeviceTimeScheduler(
+            SchedulerPolicy.default(preemption_enabled=False))
+        entered = threading.Event()
+        release = threading.Event()
+
+        def pre_run():
+            entered.set()
+            assert release.wait(10.0)
+            runtime.segment_checkpoint()   # must NOT raise
+            return "pre"
+
+        pre_thread, pre_out = self.submit_async(
+            sched, job(klass=PRE, run=pre_run, preemptible=True))
+        assert entered.wait(10.0)
+        heal_thread, heal_out = self.submit_async(
+            sched, job(klass=HEAL, run=lambda: "h"))
+        deadline = _real_time.monotonic() + 10.0
+        while sched.queue.depth() < 1 and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        release.set()
+        pre_thread.join(timeout=10.0)
+        heal_thread.join(timeout=10.0)
+        assert pre_out["result"] == "pre" and heal_out["result"] == "h"
+        assert sched.stats.preemptions == 0
+        sched.stop()
+
+    def test_no_starvation_under_sustained_high_priority(self):
+        """A queued SCENARIO_SWEEP must dispatch even under a sustained
+        stream of FRESH high-priority arrivals: its aging credit
+        (weight x waited / deadline budget) eventually beats the fresh
+        class's base priority.  Deterministic virtual clock against the
+        real queue: every round one fresh USER request arrives and one
+        entry dispatches, each 'solve' taking 10s."""
+        clock = {"t": 0.0}
+        q = AdmissionQueue(
+            SchedulerPolicy.from_lists(
+                deadline_budgets_s=[5.0, 30.0, 120.0, 60.0]),
+            lambda: clock["t"])
+        sweep_ticket, _ = q.offer(job(klass=SWEEP))
+        stop = threading.Event()
+        rounds = 0
+        for rounds in range(1, 101):
+            q.offer(job(klass=USER))          # fresh arrival every round
+            entry = q.take(stop)
+            clock["t"] += 10.0                # the solve runs
+            if entry.ticket is sweep_ticket:
+                break
+        # weight 1, budget 60s: the sweep needs 2 classes of credit
+        # (base 3 -> beat fresh USER base 1) = 120s waited = 12 rounds
+        assert entry.ticket is sweep_ticket
+        assert rounds < 20, "sweep starved behind fresh USER traffic"
+
+    def test_disabled_scheduler_runs_inline_under_gateway(self):
+        sched = DeviceTimeScheduler(enabled=False)
+        seen = {}
+
+        def solve():
+            seen["gateway"] = runtime.under_gateway()
+            seen["thread"] = threading.current_thread().name
+            return "inline"
+
+        assert sched.submit(job(run=solve)) == "inline"
+        assert seen["gateway"] is True
+        assert seen["thread"] == threading.current_thread().name
+        assert sched.stats.completed == 1
+        sched.stop()
+
+    def test_nested_submit_from_dispatcher_runs_inline(self):
+        sched = DeviceTimeScheduler()
+
+        def outer():
+            # a scheduled job submitting nested device work must not
+            # deadlock on the busy dispatcher
+            return sched.submit(job(run=lambda: "inner"))
+
+        assert sched.submit(job(run=outer)) == "inner"
+        sched.stop()
+
+    def test_failure_propagates_to_every_waiter(self):
+        sched, gate = self.blocked_scheduler()
+        boom = RuntimeError("solve exploded")
+        waiters = [self.submit_async(sched, job(
+            run=lambda: (_ for _ in ()).throw(boom),
+            coalesce_key=("fail",))) for _ in range(3)]
+        deadline = _real_time.monotonic() + 10.0
+        while sched.queue.depth() < 1 and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        gate.set()
+        for t, out in waiters:
+            t.join(timeout=10.0)
+            assert out["exc"] is boom
+        sched.stop()
+
+    def test_failed_solves_do_not_feed_the_latency_ewma(self):
+        """A fast failure is NOT a latency sample (same rule as
+        preemption): a crash-looping solver (0.1s per failure vs minutes
+        per real solve) would collapse the EWMA and have Retry-After
+        invite a client stampede mid-incident."""
+        sched = DeviceTimeScheduler(SchedulerPolicy.default())
+        with pytest.raises(RuntimeError, match="boom"):
+            sched.submit(job(
+                run=lambda: (_ for _ in ()).throw(RuntimeError("boom"))))
+        assert sched.queue.latency_ewma_s() == 0.0
+        assert sched.submit(job(
+            run=lambda: (_real_time.sleep(0.005), "ok")[1])) == "ok"
+        assert sched.queue.latency_ewma_s() > 0.0
+        sched.stop()
+
+    def test_stop_fails_queued_tickets(self):
+        sched, gate = self.blocked_scheduler()
+        t, out = self.submit_async(sched, job(run=lambda: "late"))
+        deadline = _real_time.monotonic() + 10.0
+        while sched.queue.depth() < 1 and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        stopper = threading.Thread(target=sched.stop, daemon=True)
+        stopper.start()
+        gate.set()                 # unblock the gate job
+        stopper.join(timeout=10.0)
+        t.join(timeout=10.0)
+        assert isinstance(out.get("exc"), SchedulerStoppedError)
+
+    def test_submit_after_stop_is_rejected(self):
+        """A post-stop submission fails fast instead of silently running
+        a full device solve inline on the caller's thread, racing the
+        rest of facade teardown.  The disabled scheduler keeps its
+        inline semantics regardless."""
+        sched = DeviceTimeScheduler()
+        assert sched.submit(job(run=lambda: "ok")) == "ok"
+        sched.stop()
+        with pytest.raises(SchedulerStoppedError):
+            sched.submit(job(run=lambda: "late"))
+        inline = DeviceTimeScheduler(enabled=False)
+        inline.stop()
+        assert inline.submit(job(run=lambda: "still")) == "still"
+
+    def test_chaos_dispatch_fault_resolves_waiter(self):
+        from cruise_control_tpu.utils import faults
+        sched, gate = self.blocked_scheduler()
+        # the gate job already dispatched before the plan installed, so
+        # the NEXT dispatch is call #1 for this injector
+        plan = faults.FaultPlan().fail_nth("sched.dispatch", 1)
+        with faults.injected(plan):
+            t, out = self.submit_async(sched, job(run=lambda: "x"))
+            deadline = _real_time.monotonic() + 10.0
+            while sched.queue.depth() < 1 \
+                    and _real_time.monotonic() < deadline:
+                _real_time.sleep(0.01)
+            gate.set()
+            t.join(timeout=10.0)
+        assert isinstance(out.get("exc"), faults.FaultError)
+        assert out["exc"].site == "sched.dispatch"
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# the optimizer really checkpoints between segments
+# ---------------------------------------------------------------------------
+class TestOptimizerCheckpoint:
+    def test_segment_loop_raises_solve_preempted(self):
+        from cruise_control_tpu.analyzer.goals.registry import default_goals
+        from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+        from cruise_control_tpu.testing import fixtures
+        state, topo = fixtures.small_cluster()
+        optimizer = GoalOptimizer(default_goals(
+            names=["RackAwareGoal", "DiskCapacityGoal"]))
+        with runtime.gateway(lambda: True):
+            with pytest.raises(runtime.SolvePreempted):
+                optimizer.optimizations(state, topo, check_sanity=False)
+        # without a check the same solve completes
+        result = optimizer.optimizations(state, topo, check_sanity=False)
+        assert result.final_state is not None
+
+
+# ---------------------------------------------------------------------------
+# lint single-gateway rule (the static half of the invariant)
+# ---------------------------------------------------------------------------
+class TestGatewayLintRule:
+    def lint(self, tmp_path, relpath, source):
+        import importlib.util
+        import pathlib
+        spec = importlib.util.spec_from_file_location(
+            "cc_lint", pathlib.Path(conftest.__file__).parent.parent
+            / "tools" / "lint.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+        return [f for f in mod.lint_file(path) if "single-gateway" in f]
+
+    def test_flags_direct_optimizer_solve_outside_gateway(self, tmp_path):
+        bad = ("def f(optimizer, s, t):\n"
+               "    return optimizer.optimizations(s, t)\n")
+        assert self.lint(tmp_path, "cruise_control_tpu/rogue.py", bad)
+        # same code inside the gateway files / sched/ is fine
+        assert not self.lint(tmp_path, "cruise_control_tpu/facade.py", bad)
+        assert not self.lint(tmp_path,
+                             "cruise_control_tpu/sched/rogue.py", bad)
+        # outside the package the rule does not apply
+        assert not self.lint(tmp_path, "tools/rogue.py", bad)
+
+    def test_flags_scenario_engine_and_host_fallback(self, tmp_path):
+        bad = ("def f(self, s, t, specs, opts):\n"
+               "    self.scenario_engine.evaluate(s, t, specs)\n"
+               "    return host_fallback_solve(s, t, options=opts)\n")
+        findings = self.lint(tmp_path, "cruise_control_tpu/rogue.py", bad)
+        assert len(findings) == 2
+
+    def test_facade_methods_not_flagged(self, tmp_path):
+        ok = ("def op(cc):\n"
+              "    return cc.optimizations()\n")
+        assert not self.lint(tmp_path, "cruise_control_tpu/api/x.py", ok)
+
+    def test_exemption_is_by_relative_path_not_filename(self, tmp_path):
+        """Only the REAL solver modules are exempt — a future module
+        that merely shares a filename (detector/engine.py,
+        monitor/optimizer.py) must not inherit the exemption."""
+        bad = ("def f(optimizer, s, t):\n"
+               "    return optimizer.optimizations(s, t)\n")
+        assert not self.lint(
+            tmp_path, "cruise_control_tpu/analyzer/optimizer.py", bad)
+        assert not self.lint(
+            tmp_path, "cruise_control_tpu/scenario/engine.py", bad)
+        assert self.lint(
+            tmp_path, "cruise_control_tpu/monitor/optimizer.py", bad)
+        assert self.lint(
+            tmp_path, "cruise_control_tpu/detector/engine.py", bad)
+
+
+# ---------------------------------------------------------------------------
+# chaos stress: the wired stack under mixed concurrent load
+# ---------------------------------------------------------------------------
+class TestSchedulerStress:
+    @pytest.fixture()
+    def stack(self):
+        sim, cc, clock = make_stack()
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        yield sim, cc, clock
+        cc.shutdown()
+
+    def test_identical_concurrent_rebalances_coalesce_to_one_solve(
+            self, stack):
+        sim, cc, clock = stack
+        solves = []
+        orig = cc.goal_optimizer.optimizations
+
+        def counting(*a, **k):
+            solves.append(1)
+            return orig(*a, **k)
+
+        cc.goal_optimizer.optimizations = counting
+        gate = threading.Event()
+        started = threading.Event()
+
+        def gate_run():
+            started.set()
+            assert gate.wait(30.0)
+            return None
+
+        gate_thread = threading.Thread(
+            target=lambda: cc.solve_scheduler.submit(
+                SolveJob(klass=USER, run=gate_run, label="gate")),
+            daemon=True)
+        gate_thread.start()
+        assert started.wait(10.0)
+
+        results = []
+        lock = threading.Lock()
+
+        def rebalance():
+            r = cc.optimizations(ignore_proposal_cache=True)
+            with lock:
+                results.append(r)
+
+        threads = [threading.Thread(target=rebalance, daemon=True)
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        deadline = _real_time.monotonic() + 10.0
+        while cc.solve_scheduler.queue.depth() < 1 \
+                and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        assert cc.solve_scheduler.queue.depth() == 1  # 6 requests, 1 entry
+        gate.set()
+        for t in threads:
+            t.join(timeout=60.0)
+        gate_thread.join(timeout=10.0)
+        assert len(results) == 6
+        assert len(solves) == 1                       # ONE compile+solve
+        assert all(r is results[0] for r in results)  # shared result
+        assert cc.solve_scheduler.stats.coalesced >= 5
+
+    def test_heal_preempts_inflight_precompute(self, stack):
+        """An ANOMALY_HEAL submitted mid-precompute begins executing
+        before the preempted precompute work resumes (the acceptance
+        pin).  The precompute solve blocks at its first real segment
+        checkpoint until the heal is queued."""
+        from cruise_control_tpu.analyzer.context import OptimizationOptions
+        sim, cc, clock = stack
+        order = []
+        order_lock = threading.Lock()
+        heal_queued = threading.Event()
+        orig = cc.goal_optimizer.optimizations
+
+        def note(tag):
+            with order_lock:
+                order.append(tag)
+
+        def hooked(*a, **k):
+            # classify by options: the heal request carries an exclusion
+            opts = k.get("options") or (a[2] if len(a) > 2 else None)
+            is_heal = opts is not None and opts.excluded_topics
+            note("heal-solve" if is_heal else "pre-solve")
+            if not is_heal:
+                assert heal_queued.wait(30.0)
+                runtime.segment_checkpoint()
+                note("pre-complete")
+            return orig(*a, **k)
+
+        cc.goal_optimizer.optimizations = hooked
+
+        pre_out = {}
+
+        def precompute():
+            pre_out["status"] = cc._precompute_once_status()
+
+        pre_thread = threading.Thread(target=precompute, daemon=True)
+        pre_thread.start()
+        deadline = _real_time.monotonic() + 10.0
+        while not order and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        assert order == ["pre-solve"]        # precompute on the device
+
+        heal_out = {}
+
+        def heal():
+            heal_out["result"] = cc.rebalance(
+                dryrun=True,
+                options=OptimizationOptions(
+                    excluded_topics=frozenset({"__none__"})),
+                reason="self-healing: goal violation",
+                _scheduler_class=HEAL)
+
+        heal_thread = threading.Thread(target=heal, daemon=True)
+        heal_thread.start()
+        deadline = _real_time.monotonic() + 10.0
+        while cc.solve_scheduler.queue.depth() < 1 \
+                and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        heal_queued.set()
+        heal_thread.join(timeout=120.0)
+        pre_thread.join(timeout=120.0)
+        assert heal_out["result"].proposals is not None
+        assert pre_out["status"] == "computed"
+        # preempted precompute yielded; heal solved FIRST; precompute
+        # then re-ran to completion
+        assert order == ["pre-solve", "heal-solve", "pre-solve",
+                         "pre-complete"]
+        assert cc.solve_scheduler.stats.preemptions >= 1
+
+    def test_sixteen_concurrent_mixed_requests_single_gateway(self, stack):
+        """16 concurrent mixed requests (REST rebalances + proposals,
+        some identical across clients, plus precompute passes): every
+        optimizer invocation must happen inside the scheduler gateway,
+        and every request must complete cleanly."""
+        from cruise_control_tpu.api.server import CruiseControlApp
+        from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+        sim, cc, clock = stack
+        app = CruiseControlApp(cc, async_response_timeout_s=120.0)
+        violations = []
+        orig = GoalOptimizer.optimizations
+
+        def asserting(self, *a, **k):
+            if not runtime.under_gateway():
+                violations.append("optimizer call outside the gateway")
+            return orig(self, *a, **k)
+
+        GoalOptimizer.optimizations = asserting
+        try:
+            statuses = []
+            lock = threading.Lock()
+
+            def rest(i):
+                # half the rebalances share a query -> coalesce; the
+                # rest are distinct
+                if i % 4 == 0:
+                    status, _, _ = app.handle_request(
+                        "POST", "/kafkacruisecontrol/rebalance",
+                        "dryrun=true", {}, client=f"client{i}")
+                elif i % 4 == 1:
+                    status, _, _ = app.handle_request(
+                        "GET", "/kafkacruisecontrol/proposals",
+                        "ignore_proposal_cache=true", {},
+                        client=f"client{i}")
+                elif i % 4 == 2:
+                    status, _, _ = app.handle_request(
+                        "POST", "/kafkacruisecontrol/rebalance",
+                        "dryrun=true&verbose=true", {},
+                        client=f"client{i}")
+                else:
+                    status = (200 if cc.precompute_proposals_once()
+                              in (True, False) else 500)
+                with lock:
+                    statuses.append(status)
+
+            threads = [threading.Thread(target=rest, args=(i,),
+                                        daemon=True) for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180.0)
+            assert len(statuses) == 16
+            assert all(s in (200, 202) for s in statuses)
+            assert not violations
+        finally:
+            GoalOptimizer.optimizations = orig
+            app.user_tasks.shutdown()
+
+    def test_queue_cap_surfaces_as_429_with_retry_after(self):
+        """At the class queue cap the REST layer answers 429 with a
+        Retry-After header (clean backpressure, not a 500)."""
+        from cruise_control_tpu.api.server import CruiseControlApp
+        sim, cc, clock = make_stack()
+        try:
+            cc.start_up(do_sampling=False, start_detection=False)
+            feed_samples(cc, clock)
+            # shrink the USER_INTERACTIVE cap to 1
+            cc.solve_scheduler.policy = SchedulerPolicy.from_lists(
+                queue_caps=[8, 1, 2, 8])
+            cc.solve_scheduler.queue._policy = cc.solve_scheduler.policy
+            app = CruiseControlApp(cc, async_response_timeout_s=5.0)
+            gate = threading.Event()
+            started = threading.Event()
+
+            def gate_run():
+                started.set()
+                assert gate.wait(30.0)
+                return None
+
+            gate_thread = threading.Thread(
+                target=lambda: cc.solve_scheduler.submit(
+                    SolveJob(klass=USER, run=gate_run, label="gate")),
+                daemon=True)
+            gate_thread.start()
+            assert started.wait(10.0)
+
+            # fills the single USER queue slot (async task; distinct
+            # queries so user-task dedup does not attach)
+            filler = {}
+
+            def fill():
+                filler["resp"] = app.handle_request(
+                    "GET", "/kafkacruisecontrol/proposals",
+                    "ignore_proposal_cache=true", {}, client="a")
+
+            fill_thread = threading.Thread(target=fill, daemon=True)
+            fill_thread.start()
+            deadline = _real_time.monotonic() + 10.0
+            while cc.solve_scheduler.queue.depth() < 1 \
+                    and _real_time.monotonic() < deadline:
+                _real_time.sleep(0.01)
+            assert cc.solve_scheduler.queue.depth() == 1
+
+            # an IDENTICAL request coalesces rather than rejects (that
+            # is the point of single-flight) — to hit the cap the next
+            # request must be a different solve (excluded topics change
+            # the options fingerprint)
+            status, headers, body = app.handle_request(
+                "GET", "/kafkacruisecontrol/proposals",
+                "ignore_proposal_cache=true&excluded_topics=zzz", {},
+                client="b")
+            assert status == 429
+            assert int(headers["Retry-After"]) >= 1
+            assert body["retryAfterSeconds"] >= 1
+            assert "QueueFullError" in body["errorMessage"]
+
+            # and USER_TASKS shows the queued task's scheduler fields
+            _, _, tasks = app.handle_request(
+                "GET", "/kafkacruisecontrol/user_tasks", "", {},
+                client="a")
+            active = [t for t in tasks["userTasks"]
+                      if t["Status"] == "Active"
+                      and "SchedulerClass" in t]
+            assert active
+            assert active[0]["SchedulerClass"] == "USER_INTERACTIVE"
+            # queued -> 1-based position (0 is reserved for on-device)
+            assert active[0]["QueuePosition"] == 1
+            assert "EstimatedStartMs" in active[0]
+
+            gate.set()
+            fill_thread.join(timeout=60.0)
+            gate_thread.join(timeout=10.0)
+            app.user_tasks.shutdown()
+        finally:
+            cc.shutdown()
+
+    def test_429_re_arms_consumed_two_step_approval(self):
+        """A reviewed request rejected at the queue cap must not burn
+        its one-shot approval: the purgatory gate consumes the review
+        BEFORE scheduler admission, so the 429 path rolls it back to
+        APPROVED and the client's automatic retry (same review_id) is
+        admitted once capacity frees up."""
+        from cruise_control_tpu.api.server import CruiseControlApp
+        sim, cc, clock = make_stack()
+        try:
+            cc.start_up(do_sampling=False, start_detection=False)
+            feed_samples(cc, clock)
+            cc.solve_scheduler.policy = SchedulerPolicy.from_lists(
+                queue_caps=[8, 1, 2, 8])
+            cc.solve_scheduler.queue._policy = cc.solve_scheduler.policy
+            app = CruiseControlApp(cc, two_step_verification=True,
+                                   async_response_timeout_s=30.0)
+            # park + approve a dry-run rebalance (excluded_topics makes
+            # its solve distinct from the filler below: an identical
+            # request would coalesce instead of hitting the cap)
+            query = "dryrun=true&excluded_topics=zzz"
+            status, _, parked = app.handle_request(
+                "POST", "/kafkacruisecontrol/rebalance", query, {},
+                client="op")
+            assert status == 202 and "reviewResult" in parked
+            review_id = parked["reviewResult"]["Id"]
+            app.purgatory.review([review_id], [], reason="lgtm")
+
+            gate = threading.Event()
+            started = threading.Event()
+
+            def gate_run():
+                started.set()
+                assert gate.wait(30.0)
+                return None
+
+            gate_thread = threading.Thread(
+                target=lambda: cc.solve_scheduler.submit(
+                    SolveJob(klass=USER, run=gate_run, label="gate")),
+                daemon=True)
+            gate_thread.start()
+            assert started.wait(10.0)
+            filler = {}
+
+            def fill():
+                filler["resp"] = app.handle_request(
+                    "GET", "/kafkacruisecontrol/proposals",
+                    "ignore_proposal_cache=true", {}, client="a")
+
+            fill_thread = threading.Thread(target=fill, daemon=True)
+            fill_thread.start()
+            deadline = _real_time.monotonic() + 10.0
+            while cc.solve_scheduler.queue.depth() < 1 \
+                    and _real_time.monotonic() < deadline:
+                _real_time.sleep(0.01)
+            assert cc.solve_scheduler.queue.depth() == 1
+
+            status, _, _ = app.handle_request(
+                "POST", "/kafkacruisecontrol/rebalance",
+                f"{query}&review_id={review_id}", {}, client="op")
+            assert status == 429
+            # the consumed approval was rolled back, not burned
+            assert app.purgatory._requests[review_id].status.value \
+                == "APPROVED"
+
+            gate.set()
+            fill_thread.join(timeout=60.0)
+            gate_thread.join(timeout=10.0)
+            # the retry client.py would send after Retry-After: same
+            # review id, now admitted and consumed for real
+            status, _, _ = app.handle_request(
+                "POST", "/kafkacruisecontrol/rebalance",
+                f"{query}&review_id={review_id}", {}, client="op")
+            assert status in (200, 202)
+            assert app.purgatory._requests[review_id].status.value \
+                == "SUBMITTED"
+            app.user_tasks.shutdown()
+        finally:
+            cc.shutdown()
+
+    def test_re_arm_fires_without_a_poll_and_never_after_retry(self):
+        """The queue-cap rejection of a reviewed request may surface on
+        a re-poll (task id attached) or on NO poll at all — the re-arm
+        runs inside the task, so the approval is restored either way;
+        and a stale poll of the dead task after a successful retry must
+        NOT re-arm the approval the retry re-consumed (that would
+        authorize a second execution of a one-shot review)."""
+        from cruise_control_tpu.api.server import CruiseControlApp
+        from cruise_control_tpu.api.user_tasks import USER_TASK_ID_HEADER
+        sim, cc, clock = make_stack()
+        try:
+            cc.start_up(do_sampling=False, start_detection=False)
+            feed_samples(cc, clock)
+            cc.solve_scheduler.policy = SchedulerPolicy.from_lists(
+                queue_caps=[8, 1, 2, 8])
+            cc.solve_scheduler.queue._policy = cc.solve_scheduler.policy
+            # tiny async timeout: the initial request answers 202 before
+            # the worker hits the queue cap, so NO response carries the
+            # rejection to the gate-running request
+            app = CruiseControlApp(cc, two_step_verification=True,
+                                   async_response_timeout_s=0.05)
+            query = "dryrun=true&excluded_topics=zzz"
+            status, _, parked = app.handle_request(
+                "POST", "/kafkacruisecontrol/rebalance", query, {},
+                client="op")
+            assert status == 202 and "reviewResult" in parked
+            review_id = parked["reviewResult"]["Id"]
+            app.purgatory.review([review_id], [], reason="lgtm")
+
+            gate = threading.Event()
+            started = threading.Event()
+
+            def gate_run():
+                started.set()
+                assert gate.wait(30.0)
+                return None
+
+            gate_thread = threading.Thread(
+                target=lambda: cc.solve_scheduler.submit(
+                    SolveJob(klass=USER, run=gate_run, label="gate")),
+                daemon=True)
+            gate_thread.start()
+            assert started.wait(10.0)
+            filler = {}
+
+            def fill():
+                filler["resp"] = app.handle_request(
+                    "GET", "/kafkacruisecontrol/proposals",
+                    "ignore_proposal_cache=true", {}, client="a")
+
+            fill_thread = threading.Thread(target=fill, daemon=True)
+            fill_thread.start()
+            deadline = _real_time.monotonic() + 10.0
+            while cc.solve_scheduler.queue.depth() < 1 \
+                    and _real_time.monotonic() < deadline:
+                _real_time.sleep(0.01)
+            assert cc.solve_scheduler.queue.depth() == 1
+
+            status, hdrs, _ = app.handle_request(
+                "POST", "/kafkacruisecontrol/rebalance",
+                f"{query}&review_id={review_id}", {}, client="op")
+            dead_task = hdrs[USER_TASK_ID_HEADER]
+            if status == 202:
+                # rejection not yet surfaced — the re-arm still happens,
+                # inside the task, with no poll observing it
+                deadline = _real_time.monotonic() + 10.0
+                while (app.purgatory._requests[review_id].status.value
+                       != "APPROVED"
+                       and _real_time.monotonic() < deadline):
+                    _real_time.sleep(0.01)
+            assert app.purgatory._requests[review_id].status.value \
+                == "APPROVED"
+            # a re-poll of the dead task replays the rejection as 429
+            status, _, _ = app.handle_request(
+                "POST", "/kafkacruisecontrol/rebalance",
+                f"{query}&review_id={review_id}",
+                {USER_TASK_ID_HEADER: dead_task}, client="op")
+            assert status == 429
+
+            gate.set()
+            fill_thread.join(timeout=60.0)
+            gate_thread.join(timeout=10.0)
+            # the retry re-consumes the re-armed approval...
+            status, _, _ = app.handle_request(
+                "POST", "/kafkacruisecontrol/rebalance",
+                f"{query}&review_id={review_id}", {}, client="op")
+            assert status in (200, 202)
+            assert app.purgatory._requests[review_id].status.value \
+                == "SUBMITTED"
+            # ...and a STALE poll of the dead task must not re-arm it
+            status, _, _ = app.handle_request(
+                "POST", "/kafkacruisecontrol/rebalance",
+                f"{query}&review_id={review_id}",
+                {USER_TASK_ID_HEADER: dead_task}, client="op")
+            assert status == 429
+            assert app.purgatory._requests[review_id].status.value \
+                == "SUBMITTED"
+            app.user_tasks.shutdown()
+        finally:
+            cc.shutdown()
+
+    def test_k1_path_byte_identical_scheduled_vs_inline(self):
+        """The single-client path must be byte-identical with the
+        scheduler on and off (pinned: same fixture, same proposals,
+        same final placement)."""
+        import numpy as np
+        sim1, cc1, clock1 = make_stack()
+        sim2, cc2, clock2 = make_stack()
+        cc2.solve_scheduler.enabled = False
+        try:
+            for cc, clock in ((cc1, clock1), (cc2, clock2)):
+                cc.start_up(do_sampling=False, start_detection=False)
+                feed_samples(cc, clock)
+            r1 = cc1.optimizations()
+            r2 = cc2.optimizations()
+
+            def key(p):
+                return (p.partition.topic, p.partition.partition,
+                        tuple(r.broker_id for r in p.old_replicas),
+                        tuple(r.broker_id for r in p.new_replicas))
+            assert sorted(map(key, r1.proposals)) == \
+                sorted(map(key, r2.proposals))
+            assert np.array_equal(
+                np.asarray(r1.final_state.replica_broker),
+                np.asarray(r2.final_state.replica_broker))
+            assert np.array_equal(
+                np.asarray(r1.final_state.replica_is_leader),
+                np.asarray(r2.final_state.replica_is_leader))
+        finally:
+            cc1.shutdown()
+            cc2.shutdown()
+
+    def test_concurrent_sweeps_fold_into_one_engine_batch(self, stack):
+        """Two compatible concurrent evaluate_scenarios calls fold into
+        ONE engine evaluation with the shared no-op base solved once;
+        each caller gets back exactly its own scenarios (base first)."""
+        from cruise_control_tpu.scenario.engine import BASE_SCENARIO_NAME
+        from cruise_control_tpu.scenario.spec import ScenarioSpec
+        sim, cc, clock = stack
+        engine_calls = []
+        orig_evaluate = cc.scenario_engine.evaluate
+
+        def counting_evaluate(state, topo, specs, **kw):
+            engine_calls.append([s.name for s in specs])
+            return orig_evaluate(state, topo, specs, **kw)
+
+        cc.scenario_engine.evaluate = counting_evaluate
+        gate = threading.Event()
+        started = threading.Event()
+
+        def gate_run():
+            started.set()
+            assert gate.wait(30.0)
+            return None
+
+        gate_thread = threading.Thread(
+            target=lambda: cc.solve_scheduler.submit(
+                SolveJob(klass=USER, run=gate_run, label="gate")),
+            daemon=True)
+        gate_thread.start()
+        assert started.wait(10.0)
+
+        results = {}
+
+        def sweep(name, scale):
+            results[name] = cc.evaluate_scenarios(
+                [ScenarioSpec(name=name, load_scale={"disk": scale})],
+                include_proposals=False)
+
+        t1 = threading.Thread(target=sweep, args=("grow", 1.2),
+                              daemon=True)
+        t2 = threading.Thread(target=sweep, args=("shrink", 0.8),
+                              daemon=True)
+        t1.start()
+        deadline = _real_time.monotonic() + 10.0
+        while cc.solve_scheduler.queue.depth() < 1 \
+                and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        t2.start()
+        while cc.solve_scheduler.queue.depth() < 2 \
+                and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        gate.set()
+        t1.join(timeout=300.0)
+        t2.join(timeout=300.0)
+        gate_thread.join(timeout=10.0)
+
+        # ONE engine evaluation: shared base + both callers' scenarios
+        assert len(engine_calls) == 1
+        assert engine_calls[0] == [BASE_SCENARIO_NAME, "grow", "shrink"]
+        assert cc.solve_scheduler.stats.folded == 1
+        for name in ("grow", "shrink"):
+            outs = results[name].outcomes
+            assert [o.spec.name for o in outs] == [BASE_SCENARIO_NAME,
+                                                   name]
+        # the shared base outcome is literally shared
+        assert results["grow"].outcomes[0] is results["shrink"].outcomes[0]
+
+    def test_scheduler_state_and_sensors_exposed(self, stack):
+        sim, cc, clock = stack
+        cc.optimizations()
+        st = cc.state()
+        sched_state = st["SchedulerState"]
+        assert sched_state["enabled"] is True
+        assert sched_state["completed"] >= 1
+        assert sched_state["deviceBusySeconds"] >= 0.0
+        assert "ANOMALY_HEAL" in sched_state["queueDepthByClass"]
+        sensors = cc.metrics.to_json()
+        assert "sched-queue-depth" in sensors
+        assert "sched-occupancy" in sensors
+        assert "sched-queue-depth-user-interactive" in sensors

@@ -11,7 +11,14 @@ Checks:
   * no fully-silent `except Exception` swallows in cruise_control_tpu/:
     every broad handler must log, re-raise, or increment a sensor (a
     swallowed solver/sampler failure is invisible until it pages — the
-    PR-2 robustness rule).
+    PR-2 robustness rule);
+  * single-gateway rule: no direct GoalOptimizer solve
+    (`*.optimizations(...)` on an optimizer, `GoalOptimizer(...)
+    .optimizations(...)`, `host_fallback_solve(...)`) or scenario-engine
+    `.evaluate(...)` call outside facade.py / sched/ and the solver
+    implementation itself — every device solve must enter through the
+    device-time scheduler (the PR-4 invariant; its runtime half is the
+    chaos stress test's under_gateway assertion).
 
 Usage: python tools/lint.py [paths...]   (default: the package + tests)
 Exit code 1 when any finding is reported.
@@ -80,6 +87,70 @@ def _silent_swallows(path: Path, tree: ast.AST) -> list:
     return findings
 
 
+#: package-relative paths allowed to call the solver directly: the
+#: gateway itself (facade.py routes through sched/), the scheduler
+#: package, the solver implementation (analyzer/optimizer.py recurses,
+#: scenario/engine.py drives the degraded rungs), and the test-support
+#: verifier harness.  Full relative paths, not bare filenames: a future
+#: detector/engine.py or monitor/optimizer.py must NOT inherit the
+#: exemption just by sharing a name
+_GATEWAY_ALLOWED_RELPATHS = {"facade.py", "analyzer/optimizer.py",
+                             "scenario/engine.py", "testing/verifier.py"}
+
+
+def _receiver_name(node) -> str:
+    """Terminal identifier of a call receiver: `self.goal_optimizer`
+    -> 'goal_optimizer', `optimizer` -> 'optimizer', `GoalOptimizer(...)`
+    -> 'GoalOptimizer'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return _receiver_name(node.func)
+    return ""
+
+
+def _gateway_violations(path: Path, tree: ast.AST) -> list:
+    """Single-gateway rule: solve entry points may only be called from
+    facade.py / sched/ (and the solver implementation itself) — the
+    static half of the every-solve-goes-through-the-scheduler invariant.
+    """
+    parts = path.parts
+    if "cruise_control_tpu" not in parts:
+        return []
+    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
+    rel = "/".join(parts[pkg + 1:])
+    if rel.startswith("sched/") or rel in _GATEWAY_ALLOWED_RELPATHS:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            recv = _receiver_name(func.value).lower()
+            if func.attr == "optimizations" and "optimizer" in recv:
+                findings.append(
+                    f"{path}:{node.lineno}: direct GoalOptimizer solve "
+                    f"call outside facade.py/sched/ — route it through "
+                    f"the device-time scheduler (single-gateway rule)")
+            elif func.attr == "evaluate" and (
+                    "scenario_engine" in recv
+                    or recv == "scenarioengine"):
+                findings.append(
+                    f"{path}:{node.lineno}: direct scenario-engine solve "
+                    f"call outside facade.py/sched/ — route it through "
+                    f"the device-time scheduler (single-gateway rule)")
+        elif isinstance(func, ast.Name) \
+                and func.id == "host_fallback_solve":
+            findings.append(
+                f"{path}:{node.lineno}: direct host_fallback_solve call "
+                f"outside facade.py/sched/ — route it through the "
+                f"device-time scheduler (single-gateway rule)")
+    return findings
+
+
 def _imported_names(tree: ast.AST):
     """{local binding name: node} for every module-scope import."""
     out = {}
@@ -142,6 +213,7 @@ def lint_file(path: Path) -> list:
         findings.append(f"{path}:{len(lines)}: missing final newline")
 
     findings.extend(_silent_swallows(path, tree))
+    findings.extend(_gateway_violations(path, tree))
 
     # unused imports: __init__.py files are re-export surfaces; a module
     # __all__ also marks intentional re-exports; `annotations` is the
