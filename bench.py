@@ -23,6 +23,20 @@ BENCH_CONFIG selects a BASELINE.json eval config:
                    "scenario" block; value = per-scenario solve seconds
                    at the largest K, vs_baseline = K=1-per-scenario /
                    largest-K-per-scenario, >1 = batching wins)
+  fleet            shape-bucketed fleet serving (fleet/buckets.py):
+                   K = BENCH_FLEET_TENANTS (default 1,4,16) tenants with
+                   DIFFERENT broker counts inside one power-of-two
+                   bucket solve through ONE shared goal stack, bucketed
+                   (every tenant padded to the bucket -> one compiled
+                   program set) vs the 16-separate-facades baseline
+                   (each raw shape compiles its own programs); records
+                   per-solve latency and COMPILE COUNT per mode (the
+                   output JSON carries a "fleet" block; value = bucketed
+                   warm per-solve seconds at the largest K, vs_baseline
+                   = unbucketed compile count / bucketed compile count,
+                   >1 = program sharing is sublinear in tenants), and
+                   verifies per-tenant proposals are identical bucketed
+                   vs raw
   sched            device-time scheduler (sched/): N concurrent mixed
                    clients (N = BENCH_SCHED_CLIENTS, default 1,8,32;
                    USER_INTERACTIVE / PRECOMPUTE round-robin with
@@ -100,6 +114,8 @@ def main() -> None:
         return _scenario_bench()
     if config == "sched":
         return _sched_bench()
+    if config == "fleet":
+        return _fleet_bench()
     presets = {  # (brokers, partitions, goal subset, metric label)
         "north": (2600, 200_000, None, "full-stack proposal generation"),
         "1": (3, 30, None, "deterministic fixture"),
@@ -348,6 +364,164 @@ def _scenario_bench() -> None:
         # per-scenario latency (>1 = batching wins)
         "vs_baseline": round(per_one / per_max, 3) if per_max else 0.0,
         "scenario": results,
+    }))
+
+
+def _fleet_bench() -> None:
+    """BENCH_CONFIG=fleet: MEASURE the shared-bucket-program claim.
+
+    K tenants (BENCH_FLEET_TENANTS, default 1,4,16) get K different
+    broker counts that all land in ONE power-of-two shape bucket.  Two
+    modes per K:
+
+    * bucketed — every tenant's state pads to the bucket
+      (fleet/buckets.py) before solving, so the process-wide program
+      cache (analyzer/optimizer._SHARED_PROGRAMS) serves every tenant
+      from the FIRST tenant's compile;
+    * unbucketed — the 16-separate-facades baseline: each tenant solves
+      at its raw shape, compiling its own program set.
+
+    Compile count per mode = the number of shape-specialized
+    executables across the shared pipeline programs (each jitted
+    pre/segment/post program compiles once per distinct argument
+    shape — `jit._cache_size()` sums them).  vs_baseline = unbucketed
+    compiles / bucketed compiles at the largest K (>1 = compile count
+    sublinear in tenant count); per-tenant results are checked identical
+    bucketed vs raw (dead-row padding invariant).
+    """
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(os.environ[
+                          "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+
+    from cruise_control_tpu.analyzer import optimizer as opt_mod
+    from cruise_control_tpu.analyzer.context import OptimizationOptions
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.fleet.buckets import BucketIndex
+    from cruise_control_tpu.testing.random_cluster import (
+        RandomClusterSpec, random_cluster)
+
+    num_b = int(os.environ.get("BENCH_BROKERS", 48))
+    num_p = int(os.environ.get("BENCH_PARTITIONS", 2400))
+    rf = int(os.environ.get("BENCH_RF", 2))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 32))
+    goal_names = os.environ.get("BENCH_GOALS")
+    names = (goal_names.split(",") if goal_names
+             else ["RackAwareGoal", "DiskCapacityGoal",
+                   "ReplicaDistributionGoal"])
+    tenant_counts = [int(k) for k in os.environ.get(
+        "BENCH_FLEET_TENANTS", "1,4,16").split(",") if k.strip()]
+    k_max = max(tenant_counts)
+
+    backend = jax.devices()[0].platform
+    optimizer = GoalOptimizer(
+        default_goals(max_rounds=rounds, names=names),
+        pipeline_segment_size=int(os.environ.get("BENCH_SEGMENT", 2)))
+    buckets = BucketIndex(floor=8)
+
+    def tenant_model(i: int):
+        # i DISTINCT broker counts inside one bucket: num_b - i stays
+        # above the previous power of two for every i < k_max
+        return random_cluster(RandomClusterSpec(
+            num_brokers=num_b - i, num_partitions=num_p,
+            replication_factor=rf, num_racks=8,
+            num_topics=max(4, num_p // 1000), seed=100 + i,
+            skew_fraction=0.3))
+
+    models = [tenant_model(i) for i in range(k_max)]
+    bucket = buckets.bucket_for(models[0][0])
+    print(f"# fleet bench: {k_max} tenants, brokers "
+          f"{num_b - k_max + 1}..{num_b} -> bucket {bucket.brokers}b/"
+          f"{bucket.replicas}r, goals={names} [{backend}]",
+          file=sys.stderr)
+
+    def solve(state, topo):
+        return optimizer.optimizations(state, topo,
+                                       OptimizationOptions(),
+                                       check_sanity=False)
+
+    def compiled_executables() -> int:
+        """Shape-specialized executables across the shared pipeline
+        programs: what a tenant of a NEW shape actually pays."""
+        with opt_mod._SHARED_LOCK:
+            progs = list(opt_mod._SHARED_PROGRAMS.values())
+        total = 0
+        for prog in progs:
+            size = getattr(prog, "_cache_size", None)
+            total += size() if callable(size) else 1
+        return total
+
+    def run_mode(k: int, bucketed: bool):
+        # each (K, mode) measures from a cold program cache so compile
+        # counts are per-run absolutes, not cross-run deltas (the
+        # persistent disk cache keeps the re-compiles themselves cheap)
+        with opt_mod._SHARED_LOCK:
+            opt_mod._SHARED_PROGRAMS.clear()
+            opt_mod._SHARED_LRU.clear()
+        jax.clear_caches()
+        cold, warm = [], []
+        for state, topo in models[:k]:
+            if bucketed:
+                state = buckets.pad(state)
+            t0 = time.time()
+            solve(state, topo)
+            cold.append(time.time() - t0)
+            t0 = time.time()
+            result = solve(state, topo)
+            warm.append(time.time() - t0)
+        return compiled_executables(), cold, warm, result
+
+    def key(p):
+        return (p.partition.topic, p.partition.partition,
+                tuple(r.broker_id for r in p.old_replicas),
+                tuple(r.broker_id for r in p.new_replicas))
+
+    # per-tenant correctness: bucketed == raw proposals (tenant k_max-1,
+    # the smallest -> maximum padding)
+    state, topo = models[-1]
+    raw = solve(state, topo)
+    padded = solve(buckets.pad(state), topo)
+    identical = sorted(map(key, raw.proposals)) == \
+        sorted(map(key, padded.proposals))
+    print(f"# per-tenant results identical bucketed vs raw: {identical}",
+          file=sys.stderr)
+
+    results = {}
+    for k in tenant_counts:
+        b_compiles, b_cold, b_warm, _ = run_mode(k, bucketed=True)
+        u_compiles, u_cold, u_warm, _ = run_mode(k, bucketed=False)
+        results[str(k)] = {
+            "bucketed_compiled_programs": b_compiles,
+            "unbucketed_compiled_programs": u_compiles,
+            "bucketed_first_solve_s": round(sum(b_cold) / k, 4),
+            "bucketed_warm_solve_s": round(sum(b_warm) / k, 4),
+            "unbucketed_first_solve_s": round(sum(u_cold) / k, 4),
+            "unbucketed_warm_solve_s": round(sum(u_warm) / k, 4),
+        }
+        print(f"# K={k}: compiled programs bucketed={b_compiles} "
+              f"unbucketed={u_compiles}, warm solve "
+              f"{results[str(k)]['bucketed_warm_solve_s']}s vs "
+              f"{results[str(k)]['unbucketed_warm_solve_s']}s",
+              file=sys.stderr)
+
+    top = results[str(k_max)]
+    b, u = (top["bucketed_compiled_programs"],
+            top["unbucketed_compiled_programs"])
+    print(json.dumps({
+        "metric": (f"fleet {k_max} tenants {num_b}b/"
+                   f"{num_p/1000:g}Kp rf{rf} bucket={bucket.brokers}b "
+                   f"[{backend}]"),
+        "value": top["bucketed_warm_solve_s"],
+        "unit": "s",
+        # compile-sharing factor at the largest K: unbucketed compiles /
+        # bucketed compiles (>1 = compile count sublinear in tenants)
+        "vs_baseline": round(u / b, 3) if b else 0.0,
+        "results_identical": identical,
+        "fleet": results,
     }))
 
 

@@ -63,6 +63,16 @@ VALID_PARAMS: Dict[str, Set[str]] = {
     "SCENARIOS": {"verbose", "json", "reason", "review_id"},
 }
 
+#: fleet tenancy (framework extension, fleet/): EVERY endpoint accepts
+#: `cluster=<id>` selecting the tenant — 404 on an unknown id, the
+#: default tenant when omitted (docs/FLEET.md)
+for _params in VALID_PARAMS.values():
+    _params.add("cluster")
+
+#: fleet-level tenant listing (GET; no `cluster` param — it spans the
+#: whole fleet by definition)
+VALID_PARAMS["FLEET"] = {"verbose", "json"}
+
 #: POST endpoints subject to purgatory review when two-step is enabled
 POST_ENDPOINTS = {
     "REBALANCE", "ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER",

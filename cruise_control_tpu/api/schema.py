@@ -108,8 +108,42 @@ STATE = _obj({
     "AnalyzerState": _obj({}, extra=True),
     "AnomalyDetectorState": _obj({}, extra=True),
     "SchedulerState": _obj({}, extra=True),
+    "FleetState": _obj({}, extra=True),
     "version": _INT,
 }, required=["version"])
+
+_FLEET_TENANT = _obj({
+    "clusterId": _STR,
+    "status": {"enum": ["ACTIVE", "DRAINING"]},
+    "isDefault": _BOOL,
+    "registeredAtMs": _INT,
+    "monitor": _obj({}, extra=True),
+    "solverRung": {"enum": ["FUSED", "EAGER", "CPU"]},
+    "hasOngoingExecution": _BOOL,
+    "state": _obj({}, extra=True),
+    "stateError": _STR,
+}, required=["clusterId", "status", "isDefault"])
+
+#: fleet tenant listing (multi-cluster serving, fleet/registry.py)
+FLEET = _obj({
+    "clusters": _arr(_FLEET_TENANT),
+    "defaultTenant": {"oneOf": [_STR, {"type": "null"}]},
+    "buckets": _obj({
+        "bucketFloor": _INT,
+        "trackedCombos": _INT,
+        "totalCombos": _INT,
+        "maxTracked": _INT,
+    }, required=["bucketFloor", "totalCombos"]),
+    "foldEnabled": _BOOL,
+    "router": _obj({
+        "totalFoldedSolves": _INT,
+        "totalFoldBatches": _INT,
+        "totalFallbacks": _INT,
+        "maxGroup": _INT,
+    }),
+    "version": _INT,
+}, required=["clusters", "defaultTenant", "buckets", "foldEnabled",
+             "version"])
 
 _USER_TASK = _obj({
     "UserTaskId": _STR,
@@ -232,6 +266,7 @@ ENDPOINT_SCHEMAS: Dict[str, dict] = {
     "FIX_OFFLINE_REPLICAS": OPTIMIZATION_RESULT,
     "TOPIC_CONFIGURATION": OPTIMIZATION_RESULT,
     "SCENARIOS": SCENARIOS,
+    "FLEET": FLEET,
 }
 
 #: non-200 body schemas by meaning

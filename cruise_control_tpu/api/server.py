@@ -50,7 +50,7 @@ BASE_PATH = "/kafkacruisecontrol"
 #: endpoints answered synchronously (no user task)
 SYNC_ENDPOINTS = {"STATE", "KAFKA_CLUSTER_STATE", "USER_TASKS",
                   "REVIEW_BOARD", "REVIEW", "STOP_PROPOSAL_EXECUTION",
-                  "PAUSE_SAMPLING", "RESUME_SAMPLING", "ADMIN"}
+                  "PAUSE_SAMPLING", "RESUME_SAMPLING", "ADMIN", "FLEET"}
 
 
 class HttpError(Exception):
@@ -102,8 +102,15 @@ class CruiseControlApp:
                  session_path: str = "/",
                  ui_diskpath: str = "",
                  ui_urlprefix: str = "/ui",
-                 time_fn: Optional[Callable[[], float]] = None) -> None:
+                 time_fn: Optional[Callable[[], float]] = None,
+                 fleet=None) -> None:
         self.cc = cruise_control
+        #: fleet registry (fleet/registry.FleetRegistry) when this
+        #: process serves multiple clusters: `?cluster=<id>` selects the
+        #: tenant on every endpoint (404 unknown, 503 draining, default
+        #: tenant when omitted) and the FLEET endpoint lists them.  None
+        #: = the single-tenant path, byte-identical to pre-fleet
+        self.fleet = fleet
         self.security = security or NoSecurityProvider()
         #: per-endpoint (request class, parameters class) overrides
         #: (reference CruiseControlRequestConfig /
@@ -172,11 +179,6 @@ class CruiseControlApp:
             return self._serve_ui(path)
         try:
             endpoint = self._endpoint_of(method, path)
-            # per-endpoint request sensors (reference servlet meters/timers,
-            # KafkaCruiseControlServlet.java:60-65)
-            registry = getattr(self.cc, "metrics", None)
-            if registry is not None:
-                registry.meter(f"{endpoint}-request-rate").mark()
             principal = self.security.authenticate(headers)
             self.security.authorize(principal, endpoint)
             req_cls, par_cls = self._endpoint_classes.get(
@@ -184,6 +186,16 @@ class CruiseControlApp:
             params = par_cls(
                 endpoint, urllib.parse.parse_qs(query_string,
                                                 keep_blank_values=True))
+            # tenant resolution (fleet/): 404 unknown, 503 draining —
+            # resolved BEFORE metering so the per-endpoint request
+            # sensors land in the addressed tenant's registry
+            cc = self._cc_for(params,
+                              for_write=endpoint in POST_ENDPOINTS)
+            # per-endpoint request sensors (reference servlet meters/timers,
+            # KafkaCruiseControlServlet.java:60-65)
+            registry = getattr(cc, "metrics", None)
+            if registry is not None:
+                registry.meter(f"{endpoint}-request-rate").mark()
             if (self._reason_required and endpoint in POST_ENDPOINTS
                     and "reason" in VALID_PARAMS[endpoint]
                     and not params.get("reason")):
@@ -199,11 +211,11 @@ class CruiseControlApp:
                     if parked is not None:
                         return parked
                 out = (request.handle_sync(self, params) if request
-                       else self._handle_sync(endpoint, params))
+                       else self._handle_sync(endpoint, params, cc=cc))
                 return 200, {}, out
             return self._handle_async(endpoint, params, query_string,
                                       client, headers, request=request,
-                                      body=body)
+                                      body=body, cc=cc)
         except (ParameterError, ValueError) as exc:
             return self._error(400, exc)
         except AuthenticationError as exc:
@@ -267,13 +279,39 @@ class CruiseControlApp:
             return 200, {}, {"__raw__": fh.read(),
                              "__content_type__": ctype}
 
+    # ------------------------------------------------------------------
+    # fleet tenant resolution
+    # ------------------------------------------------------------------
+    def _cc_for(self, params: QueryParams, for_write: bool = False):
+        """The facade addressed by `?cluster=` (default tenant when
+        omitted).  Unknown tenants answer 404; draining tenants answer
+        503 for mutating endpoints (`for_write`)."""
+        cluster = params.get("cluster") if "cluster" in \
+            VALID_PARAMS.get(params.endpoint, set()) else None
+        if self.fleet is None:
+            if cluster is not None:
+                raise HttpError(
+                    404, f"unknown cluster {cluster!r}: this server is "
+                         f"not running a fleet (--fleet-config)")
+            return self.cc
+        from cruise_control_tpu.fleet.registry import (TenantDrainingError,
+                                                       UnknownTenantError)
+        try:
+            return self.fleet.facade_for(cluster, for_write=for_write)
+        except UnknownTenantError as exc:
+            raise HttpError(404, str(exc))
+        except TenantDrainingError as exc:
+            raise HttpError(503, str(exc))
+
     # public delegates for configured Request classes
     # (api.request_registry.Request defaults call back into these)
     def default_sync_handler(self, endpoint: str, params) -> dict:
-        return self._handle_sync(endpoint, params)
+        return self._handle_sync(endpoint, params,
+                                 cc=self._cc_for(params))
 
     def default_operation(self, endpoint: str, params, body=None):
-        return self._operation_for(endpoint, params, body=body)
+        return self._operation_for(endpoint, params, body=body,
+                                   cc=self._cc_for(params, for_write=True))
 
     def _endpoint_of(self, method: str, path: str) -> str:
         base = self.base_path
@@ -345,8 +383,8 @@ class CruiseControlApp:
     def _handle_async(self, endpoint: str, params: QueryParams,
                       query_string: str, client: str,
                       headers: Mapping[str, str],
-                      request=None, body: Optional[str] = None
-                      ) -> Tuple[int, Dict[str, str], dict]:
+                      request=None, body: Optional[str] = None,
+                      cc=None) -> Tuple[int, Dict[str, str], dict]:
         task_id = None
         for k, v in headers.items():
             if k.lower() == USER_TASK_ID_HEADER.lower():
@@ -365,7 +403,8 @@ class CruiseControlApp:
             op: Callable[[], dict] = lambda: {}  # noqa: E731
         else:
             op = (request.operation(self, params) if request is not None
-                  else self._operation_for(endpoint, params, body=body))
+                  else self._operation_for(endpoint, params, body=body,
+                                           cc=cc))
             op = self._re_arming(op, endpoint, params)
         info = self.user_tasks.get_or_create(endpoint, query_string, client,
                                              op, task_id=task_id,
@@ -404,8 +443,9 @@ class CruiseControlApp:
     # per-endpoint operations
     # ------------------------------------------------------------------
     def _operation_for(self, endpoint: str, params: QueryParams,
-                       body: Optional[str] = None) -> Callable[[], dict]:
-        cc = self.cc
+                       body: Optional[str] = None,
+                       cc=None) -> Callable[[], dict]:
+        cc = cc if cc is not None else self.cc
         if endpoint == "SCENARIOS":
             # batched what-if analysis (scenario/engine.py): spec list in
             # the JSON body, DRY-RUN ONLY — the engine ranks
@@ -484,13 +524,13 @@ class CruiseControlApp:
         if endpoint in ("REBALANCE", "ADD_BROKER", "REMOVE_BROKER",
                         "DEMOTE_BROKER", "FIX_OFFLINE_REPLICAS",
                         "TOPIC_CONFIGURATION"):
-            return self._mutation_operation(endpoint, params)
+            return self._mutation_operation(endpoint, params, cc=cc)
 
         raise HttpError(404, f"unhandled endpoint {endpoint}")
 
-    def _mutation_operation(self, endpoint: str,
-                            params: QueryParams) -> Callable[[], dict]:
-        cc = self.cc
+    def _mutation_operation(self, endpoint: str, params: QueryParams,
+                            cc=None) -> Callable[[], dict]:
+        cc = cc if cc is not None else self.cc
         dryrun = params.get_bool("dryrun", default=True)
         verbose = params.get_bool("verbose")
         goals = params.get_csv("goals")
@@ -595,11 +635,28 @@ class CruiseControlApp:
     # ------------------------------------------------------------------
     # sync endpoints
     # ------------------------------------------------------------------
-    def _handle_sync(self, endpoint: str, params: QueryParams) -> dict:
-        cc = self.cc
+    def _handle_sync(self, endpoint: str, params: QueryParams,
+                     cc=None) -> dict:
+        cc = cc if cc is not None else self.cc
+        if endpoint == "FLEET":
+            if self.fleet is None:
+                raise HttpError(
+                    404, "fleet serving is not configured "
+                         "(start with --fleet-config)")
+            return {**self.fleet.fleet_json(
+                verbose=params.get_bool("verbose")), "version": 1}
         if endpoint == "STATE":
             substates = params.get_csv("substates")
             out = cc.state(substates)
+            if self.fleet is not None:
+                want = {s.lower() for s in (substates or ("fleet",))}
+                if "fleet" in want:
+                    out["FleetState"] = self.fleet.state_json()
+                if "sensors" in want and "Sensors" in out:
+                    # fleet-level sensors (fleet-bucket-compiles,
+                    # fleet-folded-solves, shared-scheduler meters) ride
+                    # along with the tenant's own
+                    out["Sensors"].update(self.fleet.metrics.to_json())
             out["version"] = 1
             return out
         if endpoint == "KAFKA_CLUSTER_STATE":

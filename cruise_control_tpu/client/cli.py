@@ -40,6 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
                              "backpressure), honoring Retry-After with "
                              "capped exponential backoff + deterministic "
                              "jitter; 0 fails fast (default: 4)")
+    parser.add_argument("--cluster",
+                        help="fleet tenant to address (rides as "
+                             "cluster=<id> on every subcommand; the "
+                             "server's default tenant when omitted; an "
+                             "unknown tenant is a clean 404 error)")
     sub = parser.add_subparsers(dest="command", required=True)
 
     def add(name: str, **kwargs) -> argparse.ArgumentParser:
@@ -63,6 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
     add("kafka_cluster_state", help="raw cluster metadata")
     add("user_tasks", help="async task history")
     add("review_board", help="pending two-step reviews")
+
+    p = add("fleet", help="fleet tenant listing (multi-cluster servers)")
+    p.add_argument("--verbose", action="store_true",
+                   help="include each tenant's full state")
 
     for name, needs_brokers in (("rebalance", False), ("add_broker", True),
                                 ("remove_broker", True),
@@ -126,7 +135,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         auth = "Basic " + base64.b64encode(args.user.encode()).decode()
     client = CruiseControlClient(args.address, auth_header=auth,
                                  wait_default=not args.no_wait,
-                                 max_retries_429=args.max_retries)
+                                 max_retries_429=args.max_retries,
+                                 cluster=args.cluster)
 
     cmd = args.command
     try:
@@ -146,6 +156,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             out = client.user_tasks()
         elif cmd == "review_board":
             out = client.review_board()
+        elif cmd == "fleet":
+            out = client.fleet(verbose=args.verbose)
         elif cmd in ("rebalance", "add_broker", "remove_broker",
                      "demote_broker", "fix_offline_replicas"):
             params = {"dryrun": not args.execute,

@@ -39,9 +39,14 @@ class CruiseControlClient:
                  retry_backoff_base_s: float = 1.0,
                  retry_backoff_max_s: float = 30.0,
                  retry_jitter_token: Optional[str] = None,
+                 cluster: Optional[str] = None,
                  sleep_fn: Optional[Callable[[float], None]] = None
                  ) -> None:
         self._base = base_url.rstrip("/")
+        #: fleet tenant this client addresses: `cluster=<id>` rides on
+        #: every request (server default tenant when None); an unknown
+        #: tenant's 404 surfaces as CruiseControlClientError(404)
+        self._cluster = cluster
         self._auth = auth_header
         self._poll_s = poll_interval_s
         self._timeout_s = timeout_s
@@ -79,8 +84,14 @@ class CruiseControlClient:
             raise ValueError(f"unknown endpoint {endpoint}")
         method = "GET" if endpoint in GET_ENDPOINTS else "POST"
         data = (json.dumps(body).encode() if body is not None else None)
+        params = dict(params or {})
+        if self._cluster is not None and "cluster" in legal \
+                and "cluster" not in params:
+            # thread the client's tenant through every subcommand
+            # (FLEET spans the whole fleet and takes no cluster)
+            params["cluster"] = self._cluster
         query = {}
-        for k, v in (params or {}).items():
+        for k, v in params.items():
             if v is None:
                 continue
             if k.lower() not in legal:
@@ -201,6 +212,10 @@ class CruiseControlClient:
     # ------------------------------------------------------------------
     def state(self, substates: Optional[Sequence[str]] = None) -> dict:
         return self.request("STATE", {"substates": substates})
+
+    def fleet(self, verbose: bool = False) -> dict:
+        """Fleet tenant listing (404 on a non-fleet server)."""
+        return self.request("FLEET", {"verbose": verbose or None})
 
     def load(self) -> dict:
         return self.request("LOAD")

@@ -303,6 +303,34 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
              "Per-class deadline budget (same class order): the queue "
              "wait that earns one full priority class of aging credit "
              "(scaled by the class weight).")
+    d.define("fleet.bucket.floor", Type.INT, 8, in_range(min_value=1), _M,
+             "Smallest shape-bucket edge for fleet serving "
+             "(fleet/buckets.py): every tenant's model pads each axis "
+             "up to the next power of two, floored here, so tenants of "
+             "similar size share ONE compiled program per (bucket, goal "
+             "list).  Raise it when the fleet-bucket-compiles sensor "
+             "shows tenant geometry fragmenting into too many buckets.")
+    d.define("fleet.bucket.max.tracked", Type.INT, 64,
+             in_range(min_value=1), _L,
+             "LRU cap on tracked (bucket, goal-list) combos in the "
+             "fleet bucket index; crossing it logs the bucket-explosion "
+             "warning (the cap bounds tracking, not XLA executables).")
+    d.define("fleet.fold.enabled", Type.BOOLEAN, True, None, _M,
+             "Batch compatible queued solves from DIFFERENT tenants in "
+             "the same shape bucket into one vmapped device dispatch "
+             "(fleet/router.py; outcomes split back per tenant, "
+             "fleet-folded-solves meter).  Disabled: tenants still "
+             "share bucketed compiled programs but every solve "
+             "dispatches alone.")
+    d.define("fleet.max.tenants", Type.INT, 64, in_range(min_value=1),
+             _M,
+             "Registration cap for the fleet registry; registering "
+             "beyond it fails (protects one device from unbounded "
+             "tenant fan-in).")
+    d.define("fleet.default.cluster.id", Type.STRING, "", None, _L,
+             "Cluster id served when a request names no ?cluster= "
+             "(must be one of the --fleet-config clusters; empty = the "
+             "first configured cluster).")
     d.define("proposal.warm.start.enabled", Type.BOOLEAN, True, None, _L,
              "Seed default-stack solves from the previous solve's final "
              "placement when the model generation moved but the topology "

@@ -211,7 +211,9 @@ class CruiseControl:
                  scheduler_class_weights: Optional[Sequence[float]] = None,
                  scheduler_class_queue_caps: Optional[Sequence[int]] = None,
                  scheduler_class_deadline_budgets_s: Optional[
-                     Sequence[float]] = None) -> None:
+                     Sequence[float]] = None,
+                 solve_scheduler=None,
+                 fleet_binding=None) -> None:
         self._admin = admin
         self._time = time_fn or _time.time
         self._sleep = sleep_fn or _time.sleep
@@ -379,13 +381,31 @@ class CruiseControl:
         # preemption and queue-cap backpressure over the one device.
         # Disabled, it degenerates to inline execution on the calling
         # thread (the seed behavior), byte-identical for a single client.
-        self.solve_scheduler = DeviceTimeScheduler(
+        # Under fleet serving (fleet/registry.py) ONE scheduler is
+        # injected and shared by every tenant facade — this facade then
+        # neither owns nor stops it, and its scheduler.* knobs are
+        # governed by the fleet's shared instance.
+        self._owns_scheduler = solve_scheduler is None
+        self.solve_scheduler = solve_scheduler or DeviceTimeScheduler(
             SchedulerPolicy.from_lists(
                 weights=scheduler_class_weights,
                 queue_caps=scheduler_class_queue_caps,
                 deadline_budgets_s=scheduler_class_deadline_budgets_s,
                 preemption_enabled=scheduler_preemption_enabled),
             enabled=scheduler_enabled, time_fn=self._time)
+        #: fleet tenancy (fleet/registry.FleetBinding): identifies this
+        #: facade's tenant, pads every solve's model to the fleet shape
+        #: bucket, and offers compatible solves to the cross-tenant
+        #: fold.  None = the single-tenant path, which must stay
+        #: byte-identical to pre-fleet behavior (engine-free pin,
+        #: tests/test_fleet.py) — every fleet hook below is gated on it.
+        self._fleet_binding = fleet_binding
+        #: scopes coalesce/fold keys to this facade: two tenants' model
+        #: generations are independent counters whose VALUES collide, so
+        #: keys on a shared scheduler must carry the tenant identity
+        self._coalesce_scope = (fleet_binding.tenant_id
+                                if fleet_binding is not None
+                                else f"cc-{id(self):x}")
 
         # sensors (reference dropwizard registry, SURVEY.md §5.1)
         self.metrics = MetricRegistry(self._time)
@@ -415,8 +435,12 @@ class CruiseControl:
                            lambda: int(self.scenario_engine.ladder.rung))
         # sched-* sensors: per-class queue depth/wait gauges,
         # device-busy-seconds, occupancy; the scheduler marks its own
-        # coalesce/preempt/reject/fold meters as events happen
-        self.solve_scheduler.attach_metrics(self.metrics)
+        # coalesce/preempt/reject/fold meters as events happen.  A
+        # SHARED (fleet) scheduler exports through the fleet registry's
+        # sensor surface instead — per-tenant registries must not fight
+        # over one scheduler's meter bindings
+        if self._owns_scheduler:
+            self.solve_scheduler.attach_metrics(self.metrics)
 
     # ------------------------------------------------------------------
     # lifecycle (reference startUp order :178-184)
@@ -442,8 +466,11 @@ class CruiseControl:
         self._precompute_stop.set()
         # stop the solve scheduler first: queued tickets fail fast (a
         # precompute pass blocked on one unblocks and sees the stop
-        # event), and nothing new is admitted during teardown
-        self.solve_scheduler.stop()
+        # event), and nothing new is admitted during teardown.  A fleet
+        # tenant does NOT own the shared scheduler — the other tenants
+        # keep solving; its own queued tickets drain normally
+        if self._owns_scheduler:
+            self.solve_scheduler.stop()
         if self._precompute_thread is not None:
             started = self._precompute_solve_started_at
             if self.precompute_wedged() and started is not None:
@@ -767,12 +794,30 @@ class CruiseControl:
                 if self._cache_valid(generation):
                     return self._cached_result
 
+        optimizer = (self.goal_optimizer if goals is None
+                     else GoalOptimizer(default_goals(names=list(goals)),
+                                        self._constraint))
+
+        def store_cacheable(result: OptimizerResult, epoch) -> None:
+            if not cacheable:
+                return
+            with self._cache_lock:
+                if result.final_state is not None:
+                    # folded fleet results carry no final state: keep
+                    # the previous warm seed rather than clearing it
+                    self._warm_seed_state = result.final_state
+                # drop the result if the cache was invalidated while
+                # the solve ran (an execution started mutating the
+                # cluster) — storing it would serve pre-execution
+                # proposals
+                if self._cache_epoch == epoch:
+                    self._cached_result = result
+                    self._cached_generation = generation
+                    self._cached_at = self._time()
+
         def run_solve() -> OptimizerResult:
             with self._cache_lock:
                 epoch = self._cache_epoch
-            optimizer = (self.goal_optimizer if goals is None
-                         else GoalOptimizer(default_goals(names=list(goals)),
-                                            self._constraint))
             result = self._solve_with_ladder(optimizer, cacheable, options,
                                              _allow_capacity_estimation,
                                              _eager_hard_abort)
@@ -783,25 +828,84 @@ class CruiseControl:
                 # as segment-profile-<category>-timer sensors (STATE
                 # endpoint)
                 prof.publish(self.metrics)
-            if cacheable:
-                with self._cache_lock:
-                    self._warm_seed_state = result.final_state
-                    # drop the result if the cache was invalidated while
-                    # the solve ran (an execution started mutating the
-                    # cluster) — storing it would serve pre-execution
-                    # proposals
-                    if self._cache_epoch == epoch:
-                        self._cached_result = result
-                        self._cached_generation = generation
-                        self._cached_at = self._time()
+            store_cacheable(result, epoch)
             return result
 
-        key = ("optimizations",
+        key = ("optimizations", self._coalesce_scope,
                tuple(goals) if goals is not None else None,
                generation, _options_fingerprint(options),
                _allow_capacity_estimation, _eager_hard_abort)
+        fold_key, fold_payload, fold_run = self._fleet_fold_spec(
+            optimizer, cacheable, options, _allow_capacity_estimation,
+            _eager_hard_abort, run_solve, store_cacheable)
         return self._scheduled_solve(klass, run_solve, coalesce_key=key,
-                                     label="optimizations")
+                                     label="optimizations",
+                                     fold_key=fold_key,
+                                     fold_payload=fold_payload,
+                                     fold_run=fold_run)
+
+    def _fleet_fold_spec(self, optimizer: GoalOptimizer, cacheable: bool,
+                         options, allow_capacity_estimation,
+                         eager_hard_abort, run_inline, store_cacheable):
+        """(fold_key, fold_payload, fold_run) offering this request-path
+        solve to the fleet's cross-tenant fold (fleet/router.py), or
+        (None, None, None) when ineligible: no fleet binding or router,
+        a goal list that cannot share programs (non-primitive goal
+        state), or an eager-hard-abort override (the batched path has no
+        eager abort) all stay inline.  Queued solves from DIFFERENT
+        tenants sharing this fold key batch into one vmapped dispatch;
+        a lone dispatch runs `run_inline` — the exact single-solve
+        path."""
+        binding = self._fleet_binding
+        if binding is None or binding.router is None:
+            return None, None, None
+        goal_key = optimizer._goals_share_key()
+        if goal_key is None or eager_hard_abort is not None:
+            return None, None, None
+        from cruise_control_tpu.fleet.router import FleetSolvePayload
+        epoch_cell: Dict[str, int] = {}
+
+        def materialize():
+            with self._cache_lock:
+                epoch_cell["epoch"] = self._cache_epoch
+            state, topo, _warm = self._materialize_solve_inputs(
+                cacheable, allow_capacity_estimation, goal_key=goal_key)
+            gen_options = self._options_generator.generate(
+                options or OptimizationOptions(), topo)
+            return state, topo, gen_options
+
+        def commit(result: OptimizerResult) -> None:
+            store_cacheable(result, epoch_cell.get("epoch"))
+
+        payload = FleetSolvePayload(
+            tenant_id=binding.tenant_id, optimizer=optimizer,
+            constraint=self._constraint,
+            balancedness_weights=self._balancedness_weights,
+            materialize=materialize, run_inline=run_inline,
+            commit=commit,
+            fused_ok=lambda: (not self._solver_degradation_enabled
+                              or self.solver_ladder.entry_rung()
+                              is SolverRung.FUSED))
+        fold_key = ("fleet-solve", goal_key,
+                    _options_fingerprint(options),
+                    allow_capacity_estimation)
+        return fold_key, payload, binding.router.fold_run
+
+    def _fleet_pad(self, state, optimizer=None):
+        """Bucket-pad one solve's state when serving in a fleet (no-op
+        without a binding — the single-tenant byte-identical pin).  The
+        optimizations() path pads inside _materialize_solve_inputs;
+        every OTHER device solve (add/remove/demote brokers, fix
+        offline, the scenario base model) pads here so a tenant's whole
+        solve surface stays on its bucket shape — without this the
+        bread-and-butter bucket sharing would not cover operator
+        endpoints and each tenant would compile its own program per raw
+        shape, invisibly to the fleet-bucket-compiles alarm."""
+        if self._fleet_binding is None:
+            return state
+        goal_key = (optimizer._goals_share_key()
+                    if optimizer is not None else None)
+        return self._fleet_binding.pad_state(state, goal_key)
 
     def _cache_valid(self, generation) -> bool:
         """Caller holds _cache_lock."""
@@ -840,7 +944,8 @@ class CruiseControl:
     # solver degradation ladder (analyzer/degradation.py)
     # ------------------------------------------------------------------
     def _materialize_solve_inputs(self, cacheable: bool,
-                                  allow_capacity_estimation):
+                                  allow_capacity_estimation,
+                                  goal_key=None):
         """(state, topology, warm seed) for ONE solve attempt.
 
         Called per ATTEMPT, not per request: a failed attempt may have
@@ -849,9 +954,17 @@ class CruiseControl:
         mid-pipeline leaves them invalidated) — the retry re-materializes
         everything from the host-side model, which is why a retried solve
         matches the fault-free result bit-for-bit (chaos pin,
-        tests/test_chaos.py)."""
+        tests/test_chaos.py).
+
+        Fleet tenants pad the state to the fleet shape bucket here
+        (fleet/buckets.py dead-row padding: results identical, shapes
+        shared fleet-wide so tenants reuse one compiled program per
+        bucket); without a binding the state passes through untouched —
+        the single-tenant byte-identical pin."""
         state, topo = self.cluster_model(
             allow_capacity_estimation=allow_capacity_estimation)
+        if self._fleet_binding is not None:
+            state = self._fleet_binding.pad_state(state, goal_key)
         warm = None
         if cacheable and self._warm_start_enabled:
             with self._cache_lock:
@@ -864,7 +977,8 @@ class CruiseControl:
                        cacheable: bool, options, allow_capacity_estimation,
                        eager_hard_abort) -> OptimizerResult:
         state, topo, warm = self._materialize_solve_inputs(
-            cacheable, allow_capacity_estimation)
+            cacheable, allow_capacity_estimation,
+            goal_key=optimizer._goals_share_key())
         gen_options = self._options_generator.generate(
             options or OptimizationOptions(), topo)
         with self.metrics.timer("proposal-computation-timer").time():
@@ -1043,6 +1157,12 @@ class CruiseControl:
         def fold_run(spec_lists: List[List[ScenarioSpec]]
                      ) -> List[ScenarioBatchResult]:
             state, topo = self.cluster_model()
+            # fleet tenants solve scenarios at the bucket shape too, so
+            # one tenant's sweeps reuse shapes across model-generation
+            # growth within a bucket (hypothetical broker adds still
+            # append rows beyond the bucket — the compiler's geometry
+            # widens past the padded axis)
+            state = self._fleet_pad(state)
             gen_options = self._options_generator.generate(
                 OptimizationOptions(), topo)
             if len(spec_lists) == 1:
@@ -1082,7 +1202,11 @@ class CruiseControl:
                     rung=batch.rung))
             return split
 
-        fold_key = ("scenarios", goal_key, generation, include_proposals)
+        # scoped to this facade: on a SHARED fleet scheduler two
+        # tenants' generation counters collide in value, and a scenario
+        # fold must never merge sweeps against different base models
+        fold_key = ("scenarios", self._coalesce_scope, goal_key,
+                    generation, include_proposals)
         coalesce_key = fold_key + (tuple(repr(s) for s in specs),)
         return self._scheduled_solve(
             klass, lambda: fold_run([specs])[0],
@@ -1159,6 +1283,7 @@ class CruiseControl:
         options = OptimizationOptions(
             requested_destination_broker_ids=frozenset(broker_ids))
         optimizer = self._optimizer_for(goals)
+        state = self._fleet_pad(state, optimizer)
         result = self._scheduled_solve(
             _scheduler_class or SchedulerClass.USER_INTERACTIVE,
             lambda: optimizer.optimizations(state, topo, options),
@@ -1187,6 +1312,7 @@ class CruiseControl:
         for b in broker_ids:
             state = S.set_broker_state(state, idx[b], alive=False)
         optimizer = self._optimizer_for(goals)
+        state = self._fleet_pad(state, optimizer)
         result = self._scheduled_solve(
             _scheduler_class or SchedulerClass.USER_INTERACTIVE,
             lambda: optimizer.optimizations(state, topo),
@@ -1214,6 +1340,7 @@ class CruiseControl:
         idx = topo.broker_index
         for b in broker_ids:
             state = S.set_broker_state(state, idx[b], demoted=True)
+        state = self._fleet_pad(state, self._ple_optimizer)
         result = self._scheduled_solve(
             _scheduler_class or SchedulerClass.USER_INTERACTIVE,
             lambda: self._ple_optimizer.optimizations(state, topo),
@@ -1235,6 +1362,7 @@ class CruiseControl:
         if not bool(np.asarray(S.self_healing_eligible(state)).any()):
             raise ValueError("no offline replicas to fix")
         optimizer = self._optimizer_for(goals)
+        state = self._fleet_pad(state, optimizer)
         result = self._scheduled_solve(
             _scheduler_class or SchedulerClass.USER_INTERACTIVE,
             lambda: optimizer.optimizations(state, topo),

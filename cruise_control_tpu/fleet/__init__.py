@@ -1,0 +1,19 @@
+"""Fleet serving: K independent Kafka clusters (tenants) in one process
+sharing one device, one PR-4 scheduler, and shape-bucketed compiled
+programs.  See docs/FLEET.md."""
+from cruise_control_tpu.fleet.buckets import (BucketIndex, FleetBucket,
+                                              bucket_of, next_pow2,
+                                              pad_state_to_bucket)
+from cruise_control_tpu.fleet.registry import (FleetBinding, FleetRegistry,
+                                               Tenant, TenantDrainingError,
+                                               TenantStatus,
+                                               UnknownTenantError)
+from cruise_control_tpu.fleet.router import FleetRouter, FleetSolvePayload
+
+__all__ = [
+    "BucketIndex", "FleetBucket", "bucket_of", "next_pow2",
+    "pad_state_to_bucket",
+    "FleetBinding", "FleetRegistry", "Tenant", "TenantDrainingError",
+    "TenantStatus", "UnknownTenantError",
+    "FleetRouter", "FleetSolvePayload",
+]

@@ -1,0 +1,197 @@
+"""Shape buckets: pad every tenant's model to power-of-two geometry so
+tenants share compiled device programs.
+
+One compiled fused-pipeline program exists per (array shapes, goal list)
+— that is how XLA works and how the process-wide program cache
+(analyzer/optimizer._SHARED_PROGRAMS, scenario/engine program LRU) is
+keyed.  K tenants with K slightly-different cluster sizes would compile
+K copies of every program; padding each tenant's `ClusterState` up to
+the next power-of-two bucket on every axis makes tenants of similar size
+land on ONE shape, so the first tenant in a bucket pays the compile and
+the rest reuse it (the sublinear-compile-count claim bench.py
+BENCH_CONFIG=fleet measures).
+
+Padding follows THE dead-row convention of `parallel/mesh.DEAD_ROW_FILLS`
+(shared with the replica-axis mesh padding and the scenario compiler's
+broker padding, so the three padders cannot drift): padded brokers are
+dead with zero capacity, padded replicas are invalid and weightless,
+padded partitions own no replicas, padded disks are dead.  Every goal
+and statistic masks on aliveness/validity, so a bucket-padded solve
+returns results identical to the unpadded solve (pinned in
+tests/test_fleet.py: bucket-padding no-leak pin).
+
+The static axes (racks, hosts, topics) bucket too — they are static
+fields of the state pytree, and two states whose static fields differ
+can never share a program.  Extra racks/hosts/topics are simply empty.
+
+`BucketIndex` is the fleet-wide accountant: it tracks which (bucket,
+goal-list) combos exist, meters `fleet-bucket-compiles` when a NEW combo
+appears (each one is a full pipeline compile somewhere downstream — the
+operator's bucket-explosion alarm), and LRU-bounds its tracking table.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional, Tuple
+
+from cruise_control_tpu.model.state import ClusterState
+from cruise_control_tpu.parallel.mesh import (pad_broker_axis,
+                                              pad_disk_axis,
+                                              pad_partition_axis,
+                                              pad_replica_axis)
+
+LOG = logging.getLogger(__name__)
+
+#: smallest bucket edge: clusters below this pad up to it, so tiny
+#: tenants (3 vs 5 brokers) land in one bucket instead of two
+DEFAULT_BUCKET_FLOOR = 8
+
+
+def next_pow2(n: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(n, floor)."""
+    target = max(int(n), int(floor), 1)
+    return 1 << (target - 1).bit_length()
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetBucket:
+    """One shape bucket: the padded geometry every tenant inside it
+    shares.  Hashable — it IS the program-sharing key (joined with the
+    goal list by the BucketIndex)."""
+
+    brokers: int
+    replicas: int
+    partitions: int
+    disks: int           #: 0/1 disk axes stay as-is (the JBOD dummy)
+    racks: int
+    hosts: int
+    topics: int
+
+    def signature(self) -> Tuple[int, ...]:
+        return (self.brokers, self.replicas, self.partitions, self.disks,
+                self.racks, self.hosts, self.topics)
+
+    def to_json(self) -> dict:
+        return {"brokers": self.brokers, "replicas": self.replicas,
+                "partitions": self.partitions, "disks": self.disks,
+                "racks": self.racks, "hosts": self.hosts,
+                "topics": self.topics}
+
+
+def bucket_of(state: ClusterState,
+              floor: int = DEFAULT_BUCKET_FLOOR) -> FleetBucket:
+    """The power-of-two bucket `state` belongs to.  The disk axis only
+    buckets when JBOD is actually modeled (num_disks > 1): the D == 1
+    dummy axis must stay width 1, or every non-JBOD tenant would pay a
+    phantom JBOD table."""
+    return FleetBucket(
+        brokers=next_pow2(state.num_brokers, floor),
+        replicas=next_pow2(state.num_replicas, floor),
+        partitions=next_pow2(state.num_partitions, floor),
+        disks=(next_pow2(state.num_disks, floor)
+               if state.num_disks > 1 else state.num_disks),
+        racks=next_pow2(state.num_racks),
+        hosts=next_pow2(state.num_hosts),
+        topics=next_pow2(state.num_topics),
+    )
+
+
+def pad_state_to_bucket(state: ClusterState,
+                        bucket: FleetBucket) -> ClusterState:
+    """`state` padded up to `bucket` on every axis (dead-row convention;
+    see module docstring).  A state already at the bucket shape is
+    returned unchanged — the identity the single-tenant byte-identical
+    pin relies on when no fleet is configured is that this function is
+    never called at all."""
+    padded = pad_replica_axis(state, bucket.replicas)
+    padded = pad_partition_axis(padded, bucket.partitions)
+    padded = pad_broker_axis(padded, bucket.brokers)
+    if bucket.disks > state.num_disks:
+        padded = pad_disk_axis(padded, bucket.disks)
+    if (bucket.racks != state.num_racks or bucket.hosts != state.num_hosts
+            or bucket.topics != state.num_topics):
+        padded = padded.replace(num_racks=bucket.racks,
+                                num_hosts=bucket.hosts,
+                                num_topics=bucket.topics)
+    return padded
+
+
+class BucketIndex:
+    """Fleet-wide (bucket, goal-list) accounting with an LRU cap.
+
+    `observe(state, goal_key)` returns the bucket and marks
+    `fleet-bucket-compiles` whenever the combo is NEW — each new combo
+    means a full pipeline compile somewhere downstream (the optimizer's
+    shared program cache / the scenario engine LRU key on exactly these
+    shapes), so the meter's rate is the operator's signal that tenant
+    geometry is too diverse for the configured floor (docs/FLEET.md
+    "bucket explosion").  The cap bounds the TRACKING table only; it
+    cannot evict XLA executables, so crossing it logs a warning instead
+    of silently rolling over."""
+
+    def __init__(self, floor: int = DEFAULT_BUCKET_FLOOR,
+                 max_tracked: int = 64, metrics=None) -> None:
+        self.floor = max(1, int(floor))
+        self.max_tracked = max(1, int(max_tracked))
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        #: (bucket signature, goal key) -> solve count, LRU-ordered
+        self._combos: "OrderedDict[tuple, int]" = OrderedDict()
+        self.total_combos = 0          #: lifetime distinct combos seen
+        self._warned_cap = False
+
+    def attach_metrics(self, registry) -> None:
+        self._metrics = registry
+
+    def bucket_for(self, state: ClusterState) -> FleetBucket:
+        return bucket_of(state, self.floor)
+
+    def pad(self, state: ClusterState) -> ClusterState:
+        return pad_state_to_bucket(state, self.bucket_for(state))
+
+    def observe(self, state: ClusterState,
+                goal_key: Optional[tuple]) -> FleetBucket:
+        """Record one solve landing in `state`'s bucket under
+        `goal_key` (the optimizer's goals-share key; callers whose goal
+        list cannot share programs pass a per-tenant surrogate key —
+        FleetBinding.pad_state — so unshareable compiles meter once per
+        tenant, not once fleet-wide)."""
+        bucket = self.bucket_for(state)
+        key = (bucket.signature(), goal_key)
+        with self._lock:
+            if key in self._combos:
+                self._combos[key] += 1
+                self._combos.move_to_end(key)
+                return bucket
+            self.total_combos += 1
+            self._combos[key] = 1
+            if len(self._combos) > self.max_tracked:
+                evicted, _ = self._combos.popitem(last=False)
+                if not self._warned_cap:
+                    self._warned_cap = True
+                    LOG.warning(
+                        "fleet bucket/goal combos exceed the tracking cap "
+                        "(%d): tenant geometry is too diverse to share "
+                        "programs — raise fleet.bucket.floor or expect "
+                        "one compile per tenant (first evicted: %r)",
+                        self.max_tracked, evicted)
+        if self._metrics is not None:
+            self._metrics.meter("fleet-bucket-compiles").mark()
+        return bucket
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {
+                "bucketFloor": self.floor,
+                "trackedCombos": len(self._combos),
+                "totalCombos": self.total_combos,
+                "maxTracked": self.max_tracked,
+            }
+
+
+#: type of the facade's state padder hook (fleet binding installs
+#: BucketIndex.pad here; None = no padding, the pre-fleet path)
+StatePadder = Callable[[ClusterState], ClusterState]

@@ -1,0 +1,315 @@
+"""Fleet registry: the ONE root object holding every tenant.
+
+A *tenant* is one Kafka cluster served by this process: its own admin
+client, LoadMonitor, detector wiring, degradation ladder, proposal cache
+and config overlay — i.e. a full `CruiseControl` facade — while the
+expensive substrate is SHARED across the fleet: one device, one PR-4
+device-time scheduler (every tenant's solves queue through the same
+priority/coalescing/backpressure gateway), one bucket index (shape
+buckets so tenants share compiled programs, fleet/buckets.py) and one
+router (cross-tenant batched dispatch, fleet/router.py).
+
+Isolation contract (pinned in tests/test_fleet.py): per-tenant state is
+reachable ONLY through this registry — tools/lint.py forbids mutable
+module-level state in fleet/ so no tenant data can leak into process
+globals — and each tenant keeps its own ladder/breaker/caches, so one
+tenant's faults or OOM halvings never move another tenant's rung.
+
+Lifecycle: `register` adds a tenant (the facade must have been built
+with this registry's `binding_for(cluster_id)` and `scheduler`);
+`drain` stops admitting new mutating work while reads and in-flight
+solves finish; `unregister` shuts the drained tenant's facade down
+(monitor, detectors, executor — NOT the shared scheduler) and removes
+it.  The default tenant serves every request that names no `?cluster=`
+and cannot be drained or unregistered while other tenants exist.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import threading
+import time as _time
+from typing import Callable, Dict, List, Optional
+
+from cruise_control_tpu.fleet.buckets import (DEFAULT_BUCKET_FLOOR,
+                                              BucketIndex)
+from cruise_control_tpu.fleet.router import FleetRouter
+from cruise_control_tpu.utils.metrics import MetricRegistry
+
+LOG = logging.getLogger(__name__)
+
+
+class UnknownTenantError(KeyError):
+    """No such cluster registered — the REST layer answers 404."""
+
+    def __init__(self, cluster_id: str, known: List[str]) -> None:
+        super().__init__(
+            f"unknown cluster {cluster_id!r}; registered: "
+            f"{sorted(known) or '[]'}")
+        self.cluster_id = cluster_id
+
+
+class TenantDrainingError(RuntimeError):
+    """The tenant is draining: no new mutating work is admitted — the
+    REST layer answers 503 so clients fail over."""
+
+    def __init__(self, cluster_id: str) -> None:
+        super().__init__(f"cluster {cluster_id!r} is draining; no new "
+                         f"operations are admitted")
+        self.cluster_id = cluster_id
+
+
+class TenantStatus(enum.Enum):
+    ACTIVE = "ACTIVE"
+    DRAINING = "DRAINING"
+
+
+@dataclasses.dataclass
+class Tenant:
+    """One registered cluster."""
+
+    cluster_id: str
+    facade: object                       #: CruiseControl
+    status: TenantStatus = TenantStatus.ACTIVE
+    registered_at: float = 0.0
+
+    def to_json(self, default_id: Optional[str] = None) -> dict:
+        return {
+            "clusterId": self.cluster_id,
+            "status": self.status.value,
+            "isDefault": self.cluster_id == default_id,
+            "registeredAtMs": int(self.registered_at * 1000.0),
+        }
+
+
+@dataclasses.dataclass
+class FleetBinding:
+    """What a tenant facade holds of the fleet: its identity plus the
+    shared bucket index and router.  The facade uses it to (a) pad every
+    solve's model to the shape bucket and (b) offer compatible solves to
+    the cross-tenant fold.  It deliberately does NOT expose other
+    tenants — the registry is the only tenant root."""
+
+    tenant_id: str
+    buckets: BucketIndex
+    router: Optional[FleetRouter] = None
+
+    def pad_state(self, state, goal_key=None):
+        """Bucket-pad one solve's ClusterState, accounting the (bucket,
+        goal-list) combo in the fleet-bucket-compiles meter.  A None
+        goal key means the goal list cannot share programs across
+        tenants (non-primitive goal state, scenario goal overrides), so
+        the compile it stands for is per-tenant: it is tracked under a
+        per-tenant surrogate key — K tenants on unshareable goals meter
+        as K combos, not one."""
+        if goal_key is None:
+            goal_key = ("unshared", self.tenant_id)
+        self.buckets.observe(state, goal_key)
+        return self.buckets.pad(state)
+
+
+class FleetRegistry:
+    """See module docstring.  Construction order in main.py:
+
+        registry = FleetRegistry(scheduler=shared_scheduler, ...)
+        cc = build_cruise_control(tenant_config, admin,
+                                  solve_scheduler=registry.scheduler,
+                                  fleet_binding=registry.binding_for(cid))
+        registry.register(cid, cc, default=...)
+    """
+
+    def __init__(self, scheduler,
+                 bucket_floor: int = DEFAULT_BUCKET_FLOOR,
+                 bucket_max_tracked: int = 64,
+                 fold_enabled: bool = True,
+                 max_tenants: int = 64,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self.scheduler = scheduler
+        self._time = time_fn or _time.time
+        self.max_tenants = max(1, int(max_tenants))
+        #: fleet-level sensors (fleet-bucket-compiles,
+        #: fleet-folded-solves, fleet-fold-fallbacks); per-tenant sensors
+        #: stay in each facade's own registry and are exported tagged
+        #: (see sensors_json)
+        self.metrics = MetricRegistry(self._time)
+        self.buckets = BucketIndex(floor=bucket_floor,
+                                   max_tracked=bucket_max_tracked,
+                                   metrics=self.metrics)
+        self.router = (FleetRouter(metrics=self.metrics,
+                                   time_fn=self._time)
+                       if fold_enabled else None)
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, Tenant] = {}
+        self._default_id: Optional[str] = None
+        self.metrics.gauge("fleet-tenant-count",
+                           lambda: float(len(self._tenants)))
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def binding_for(self, cluster_id: str) -> FleetBinding:
+        return FleetBinding(tenant_id=cluster_id, buckets=self.buckets,
+                            router=self.router)
+
+    def register(self, cluster_id: str, facade,
+                 default: bool = False) -> Tenant:
+        with self._lock:
+            if cluster_id in self._tenants:
+                raise ValueError(f"cluster {cluster_id!r} is already "
+                                 f"registered")
+            if len(self._tenants) >= self.max_tenants:
+                raise ValueError(
+                    f"fleet is at its tenant cap ({self.max_tenants}); "
+                    f"raise fleet.max.tenants to register more")
+            binding = getattr(facade, "_fleet_binding", None)
+            if binding is not None and binding.tenant_id != cluster_id:
+                raise ValueError(
+                    f"facade was bound as {binding.tenant_id!r}, cannot "
+                    f"register as {cluster_id!r}")
+            tenant = Tenant(cluster_id=cluster_id, facade=facade,
+                            registered_at=self._time())
+            self._tenants[cluster_id] = tenant
+            if default or self._default_id is None:
+                self._default_id = cluster_id
+        LOG.info("fleet: registered tenant %r (default=%s, %d total)",
+                 cluster_id, self._default_id == cluster_id,
+                 len(self._tenants))
+        return tenant
+
+    def drain(self, cluster_id: str) -> Tenant:
+        """Stop admitting new mutating work for the tenant; reads and
+        already-queued solves finish normally."""
+        with self._lock:
+            tenant = self._get_locked(cluster_id)
+            if (cluster_id == self._default_id
+                    and len(self._tenants) > 1):
+                raise ValueError(
+                    f"cluster {cluster_id!r} is the default tenant; "
+                    f"drain the others first or re-register a new "
+                    f"default")
+            tenant.status = TenantStatus.DRAINING
+        LOG.info("fleet: draining tenant %r", cluster_id)
+        return tenant
+
+    def unregister(self, cluster_id: str) -> None:
+        """Remove a DRAINING tenant: shuts its facade down (monitor,
+        detectors, executor) and drops it.  The shared scheduler keeps
+        running — the facade knows it does not own it."""
+        with self._lock:
+            tenant = self._get_locked(cluster_id)
+            if tenant.status is not TenantStatus.DRAINING:
+                raise ValueError(
+                    f"cluster {cluster_id!r} must be drained before "
+                    f"unregistering")
+            del self._tenants[cluster_id]
+            if self._default_id == cluster_id:
+                self._default_id = next(iter(self._tenants), None)
+        tenant.facade.shutdown()
+        LOG.info("fleet: unregistered tenant %r", cluster_id)
+
+    def shutdown(self) -> None:
+        """Shut every tenant down, then stop the shared scheduler."""
+        with self._lock:
+            tenants = list(self._tenants.values())
+            self._tenants.clear()
+            self._default_id = None
+        for tenant in tenants:
+            try:
+                tenant.facade.shutdown()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                LOG.exception("fleet: shutdown of tenant %r failed",
+                              tenant.cluster_id)
+        self.scheduler.stop()
+
+    # ------------------------------------------------------------------
+    # resolution
+    # ------------------------------------------------------------------
+    def _get_locked(self, cluster_id: str) -> Tenant:
+        tenant = self._tenants.get(cluster_id)
+        if tenant is None:
+            raise UnknownTenantError(cluster_id, list(self._tenants))
+        return tenant
+
+    def get(self, cluster_id: Optional[str] = None,
+            for_write: bool = False) -> Tenant:
+        """Resolve a tenant (default when `cluster_id` is None).  Raises
+        UnknownTenantError (-> 404) for unknown ids and, when
+        `for_write`, TenantDrainingError (-> 503) for draining ones."""
+        with self._lock:
+            if cluster_id is None:
+                if self._default_id is None:
+                    raise UnknownTenantError("<default>", [])
+                tenant = self._tenants[self._default_id]
+            else:
+                tenant = self._get_locked(cluster_id)
+        if for_write and tenant.status is not TenantStatus.ACTIVE:
+            raise TenantDrainingError(tenant.cluster_id)
+        return tenant
+
+    def facade_for(self, cluster_id: Optional[str] = None,
+                   for_write: bool = False):
+        return self.get(cluster_id, for_write=for_write).facade
+
+    @property
+    def default_id(self) -> Optional[str]:
+        return self._default_id
+
+    def tenants(self) -> List[Tenant]:
+        with self._lock:
+            return list(self._tenants.values())
+
+    # ------------------------------------------------------------------
+    # observability (FLEET endpoint + STATE substates=fleet)
+    # ------------------------------------------------------------------
+    def state_json(self) -> dict:
+        """FleetState: tenant list + shared-substrate telemetry."""
+        out = {
+            "tenants": [t.to_json(self._default_id)
+                        for t in self.tenants()],
+            "defaultTenant": self._default_id,
+            "buckets": self.buckets.to_json(),
+            "foldEnabled": self.router is not None,
+        }
+        if self.router is not None:
+            out["router"] = self.router.to_json()
+        return out
+
+    def fleet_json(self, verbose: bool = False) -> dict:
+        """FLEET endpoint body: per-tenant status + state summary."""
+        clusters = []
+        for tenant in self.tenants():
+            entry = tenant.to_json(self._default_id)
+            cc = tenant.facade
+            try:
+                ms = cc.load_monitor.get_state()
+                entry["monitor"] = {"state": ms.state,
+                                    "numValidWindows": ms.num_valid_windows}
+                entry["solverRung"] = cc.solver_ladder.rung.name
+                entry["hasOngoingExecution"] = \
+                    cc.executor.has_ongoing_execution
+                if verbose:
+                    entry["state"] = cc.state(
+                        ("monitor", "analyzer", "executor"))
+            except Exception as exc:  # noqa: BLE001 - one sick tenant
+                # must not take the fleet listing down with it
+                LOG.warning("fleet: state of tenant %r unavailable: %s",
+                            tenant.cluster_id, exc)
+                entry["stateError"] = f"{type(exc).__name__}: {exc}"
+            clusters.append(entry)
+        shared = self.state_json()
+        # `clusters` above IS the tenant list (with live monitor/ladder
+        # summaries) — FleetState's bare `tenants` array would duplicate
+        # every row in the FLEET body
+        del shared["tenants"]
+        return {"clusters": clusters, **shared}
+
+    def sensors_json(self) -> dict:
+        """Fleet sensors + every tenant's sensors tagged
+        `cluster.<id>.<sensor>` so one scrape sees the whole fleet."""
+        out = dict(self.metrics.to_json())
+        for tenant in self.tenants():
+            tagged = tenant.facade.metrics.to_json()
+            out.update({f"cluster.{tenant.cluster_id}.{name}": value
+                        for name, value in tagged.items()})
+        return out

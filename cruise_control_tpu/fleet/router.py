@@ -1,0 +1,311 @@
+"""Cross-tenant solve router: batch compatible queued solves from
+DIFFERENT tenants into one vmapped device dispatch.
+
+PR 4's scheduler already folds compatible SCENARIO_SWEEP jobs; the fleet
+extends the same fold hook to the bread-and-butter request-path solves:
+when the dispatch loop pops a tenant's proposal solve and finds other
+tenants' solves queued with the same fold key (same goal list, same
+options, fold-eligible), all of them hand their payloads to
+`FleetRouter.fold_run`, which
+
+1. materializes each tenant's bucket-padded model (fleet/buckets.py —
+   same bucket => same array shapes),
+2. groups lanes whose pytree structure/shapes/static fields actually
+   match (the fold key is necessary but not sufficient: rf_max or
+   max_replicas_per_broker can differ per tenant config overlay),
+3. stacks each group into a `CompiledBatch` with
+   ``shared_membership=False`` — unlike a scenario batch, every lane is
+   a DIFFERENT base model, so the engine fetches the full [K, R]
+   initial placement planes and diffs each lane against its own
+   membership (scenario/compiler.py groundwork),
+4. runs the group through the scenario engine's batched fused pipeline
+   (one compile amortized across tenants, `fleet-folded-solves` meter),
+   and
+5. splits the outcomes back per tenant as `OptimizerResult`s; a lane's
+   solver verdict (hard-goal violation, regression, invalid input)
+   fails ONLY that tenant's ticket (`FoldedFailure`).
+
+Isolation: the router owns NO ladder and touches NO tenant ladder.  If
+the batched dispatch itself fails (compile error, device fault, OOM the
+halving cannot fix), the router falls back to running every payload's
+inline solve individually — each tenant's own PR-2 degradation ladder
+then classifies ITS failure, so a fault injected into one tenant's solve
+degrades one rung in one tenant (tenant-isolation chaos pin,
+tests/test_fleet.py).
+
+Folded results carry ``final_state=None``: the warm-start seed is an
+optimization the inline path keeps, not a semantic (the facade skips
+seeding when it is absent).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+from cruise_control_tpu.analyzer.context import (make_context,
+                                                 partition_replica_index)
+from cruise_control_tpu.analyzer.degradation import InvalidModelInputError
+from cruise_control_tpu.analyzer.goals.base import OptimizationFailure
+from cruise_control_tpu.analyzer.optimizer import OptimizerResult
+from cruise_control_tpu.scenario.compiler import CompiledBatch
+from cruise_control_tpu.scenario.engine import (ScenarioEngine,
+                                                ScenarioOutcome)
+from cruise_control_tpu.scenario.spec import ScenarioSpec
+from cruise_control_tpu.sched.runtime import SolvePreempted, shielded
+from cruise_control_tpu.sched.scheduler import FoldedFailure
+
+LOG = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class FleetSolvePayload:
+    """One tenant's request-path solve, offered to the cross-tenant
+    fold.  `materialize` returns (bucket-padded state, topology,
+    generated options) for THIS solve; `run_inline` is the tenant's full
+    single-solve path (degradation ladder included) used when the job
+    dispatches alone or the batched path fails; `commit` stores a folded
+    result into the tenant's proposal cache exactly like the inline path
+    would have."""
+
+    tenant_id: str
+    optimizer: Any                                  #: GoalOptimizer
+    constraint: Any                                 #: BalancingConstraint
+    balancedness_weights: Tuple[float, float]
+    materialize: Callable[[], tuple]
+    run_inline: Callable[[], OptimizerResult]
+    commit: Callable[[OptimizerResult], None]
+    #: False while the tenant's degradation ladder is off the FUSED
+    #: rung: a degraded tenant must keep its pinned rung (EAGER/CPU)
+    #: instead of riding a fused cross-tenant batch
+    fused_ok: Callable[[], bool] = lambda: True
+
+
+class FleetRouter:
+    """See module docstring.  One per fleet; stateless apart from the
+    shared program cache (the engine's LRU) and telemetry counters —
+    tenant state lives in the registry only (lint-enforced)."""
+
+    def __init__(self, metrics=None, max_group: int = 8,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        import time as _t
+        self._metrics = metrics
+        self.max_group = max(1, max_group)
+        self._time = time_fn or _t.time
+        # program-cache host only: the router always passes the
+        # optimizer explicitly (solve_compiled), so the engine's
+        # evaluate()-path factory must never be consulted
+        self._engine = ScenarioEngine(
+            _no_factory, max_batch_size=self.max_group,
+            time_fn=self._time)
+        self._lock = threading.Lock()
+        self.total_folded = 0        #: solves served from a shared batch
+        self.total_fold_batches = 0
+        self.total_fallbacks = 0     #: batched failures -> inline retries
+
+    # ------------------------------------------------------------------
+    def fold_run(self, payloads: List[FleetSolvePayload]) -> List[Any]:
+        """The scheduler's fold entry point: one result (or
+        FoldedFailure) per payload, in order."""
+        if len(payloads) == 1:
+            return [payloads[0].run_inline()]
+        lanes = []
+        for p in payloads:
+            if not p.fused_ok():
+                # the tenant's ladder is pinned below FUSED: its solve
+                # runs inline on its own rung, never in a fused batch
+                lanes.append((p, None, None, None, None))
+                continue
+            try:
+                state, topo, options = p.materialize()
+                ctx = make_context(state, p.constraint, options, topo)
+                lanes.append((p, state, topo, options, ctx))
+            except Exception as exc:  # noqa: BLE001 - lane-local failure
+                LOG.warning("fleet fold: materialize failed for tenant "
+                            "%r: %s", p.tenant_id, exc)
+                lanes.append((p, None, None, None, None))
+        groups: dict = {}
+        order: List[tuple] = []
+        for lane in lanes:
+            if lane[1] is None:
+                order.append(("solo", lane))
+                continue
+            key = self._lane_group_key(lane)
+            if key is None:
+                order.append(("solo", lane))
+                continue
+            if key not in groups:
+                groups[key] = []
+                order.append(("group", key))
+            groups[key].append(lane)
+
+        results: dict = {}
+        #: completed work units.  A fold spanning several groups commits
+        #: results as each group finishes; once ANY unit is done, a
+        #: SolvePreempted would make the scheduler re-queue (and re-run)
+        #: finished work, so every later unit runs with the preemption
+        #: checkpoint shielded — only the FIRST unit may yield cleanly.
+        done: List[bool] = []
+
+        def shield():
+            return shielded() if done else contextlib.nullcontext()
+
+        for kind, ref in order:
+            chunks = ([[ref]] if kind == "solo"
+                      else [groups[ref][i:i + self.max_group]
+                            for i in range(0, len(groups[ref]),
+                                           self.max_group)])
+            for chunk in chunks:
+                with shield():
+                    if len(chunk) == 1:
+                        results[id(chunk[0][0])] = \
+                            self._run_one(chunk[0][0])
+                    else:
+                        for payload, result in self._run_group(chunk,
+                                                               done):
+                            results[id(payload)] = result
+                done.append(True)
+        return [results[id(p)] for p in payloads]
+
+    # ------------------------------------------------------------------
+    def _lane_group_key(self, lane) -> Optional[tuple]:
+        """Lanes may stack only when state AND context agree in pytree
+        structure (static fields included), shapes and dtypes —
+        table_slots excluded (unified to the group max before
+        stacking)."""
+        import jax
+        payload, state, _topo, _options, ctx = lane
+        try:
+            s_leaves, s_def = jax.tree.flatten(state)
+            c_leaves, c_def = jax.tree.flatten(
+                dataclasses.replace(ctx, table_slots=0))
+            return (s_def,
+                    tuple((x.shape, str(x.dtype)) for x in s_leaves),
+                    c_def,
+                    tuple((x.shape, str(x.dtype)) for x in c_leaves),
+                    payload.optimizer.pipeline_segment_size)
+        except Exception as exc:  # noqa: BLE001 - ungroupable lane runs
+            # alone rather than poisoning the batch
+            LOG.warning("fleet fold: lane for tenant %r not groupable "
+                        "(%s); running it alone", payload.tenant_id, exc)
+            return None
+
+    def _run_one(self, payload: FleetSolvePayload):
+        try:
+            return payload.run_inline()
+        except SolvePreempted:
+            raise
+        except BaseException as exc:  # noqa: BLE001 - fail ONE ticket
+            return FoldedFailure(exc)
+
+    def _run_group(self, group, done: List[bool]) -> List[tuple]:
+        """One batched dispatch for `group`; per-payload results.  Any
+        batched failure (except preemption) falls back to per-tenant
+        inline solves so one tenant's fault cannot fail its peers."""
+        payloads = [lane[0] for lane in group]
+        try:
+            batch = self._build_batch(group)
+            telemetry = self._engine.solve_compiled(
+                payloads[0].optimizer, batch, include_proposals=True)
+        except SolvePreempted:
+            raise
+        except Exception as exc:  # noqa: BLE001 - isolation fallback
+            with self._lock:
+                self.total_fallbacks += 1
+            if self._metrics is not None:
+                self._metrics.meter("fleet-fold-fallbacks").mark()
+            LOG.warning(
+                "fleet fold of %d tenants (%s) failed batched (%s: %s); "
+                "falling back to per-tenant inline solves",
+                len(payloads), [p.tenant_id for p in payloads],
+                type(exc).__name__, exc)
+            out = []
+            for p in payloads:
+                # same completed-work rule as fold_run: after the first
+                # inline result, a preemption would discard it
+                with (shielded() if (done or out)
+                      else contextlib.nullcontext()):
+                    out.append((p, self._run_one(p)))
+            return out
+        with self._lock:
+            self.total_folded += len(payloads)
+            self.total_fold_batches += 1
+        if self._metrics is not None:
+            self._metrics.meter("fleet-folded-solves").mark(len(payloads))
+        out = []
+        for lane, outcome in zip(group, telemetry.outcomes):
+            payload = lane[0]
+            try:
+                result = self._result_from_outcome(payload, outcome,
+                                                   telemetry.duration_s)
+                payload.commit(result)
+                out.append((payload, result))
+            except BaseException as exc:  # noqa: BLE001 - one lane's
+                # verdict fails one ticket
+                out.append((payload, FoldedFailure(exc)))
+        return out
+
+    def _build_batch(self, group) -> CompiledBatch:
+        specs, states, contexts, topologies, rows_per = [], [], [], [], []
+        slots = max(lane[4].table_slots for lane in group)
+        for payload, state, topo, _options, ctx in group:
+            specs.append(ScenarioSpec(name=f"fleet:{payload.tenant_id}"))
+            states.append(state)
+            contexts.append(ctx if ctx.table_slots == slots
+                            else dataclasses.replace(ctx,
+                                                     table_slots=slots))
+            topologies.append(topo)
+            rows_per.append(partition_replica_index(
+                state, rf_max=ctx.rf_max))
+        return CompiledBatch(
+            specs=specs, states=states, contexts=contexts,
+            topologies=topologies, num_brokers=states[0].num_brokers,
+            partition_rows=rows_per[0],
+            shared_membership=False, partition_rows_per=rows_per)
+
+    def _result_from_outcome(self, payload: FleetSolvePayload,
+                             outcome: ScenarioOutcome,
+                             duration_s: float) -> OptimizerResult:
+        """One lane's ScenarioOutcome as the OptimizerResult the inline
+        path would have returned.  Lane VERDICTS re-raise exactly like
+        the single-solve path raises them (the batched engine reports
+        them as infeasibility so one doomed lane cannot poison the
+        batch; here each lane has its own ticket to fail)."""
+        if not outcome.feasible:
+            if outcome.invalid_input:
+                raise InvalidModelInputError(outcome.reason)
+            raise OptimizationFailure(outcome.reason)
+        goals = payload.optimizer.goals
+        return OptimizerResult(
+            proposals=list(outcome.proposals),
+            stats_before=outcome.stats_before,
+            stats_after=outcome.stats_after,
+            stats_by_goal=dict(outcome.stats_by_goal),
+            violated_goals_before=list(outcome.violated_goals_before),
+            violated_goals_after=list(outcome.violated_goals_after),
+            regressed_goals=list(outcome.regressed_goals),
+            final_state=None,
+            duration_s=duration_s,
+            violated_broker_counts=dict(outcome.violated_broker_counts),
+            rounds_by_goal=dict(outcome.rounds_by_goal),
+            hard_goal_names=frozenset(g.name for g in goals
+                                      if g.is_hard),
+            balancedness_weights=payload.balancedness_weights)
+
+    # ------------------------------------------------------------------
+    def to_json(self) -> dict:
+        with self._lock:
+            return {
+                "totalFoldedSolves": self.total_folded,
+                "totalFoldBatches": self.total_fold_batches,
+                "totalFallbacks": self.total_fallbacks,
+                "maxGroup": self.max_group,
+            }
+
+
+def _no_factory(names):
+    raise RuntimeError(
+        "the fleet router's engine is program-cache host only; solves "
+        "always pass their optimizer explicitly (solve_compiled)")

@@ -168,10 +168,17 @@ def _slow_broker_config(config: CruiseControlConfig):
 
 
 def build_cruise_control(config: CruiseControlConfig, admin,
-                         sampler: Optional[MetricSampler] = None
-                         ) -> CruiseControl:
+                         sampler: Optional[MetricSampler] = None,
+                         solve_scheduler=None,
+                         fleet_binding=None) -> CruiseControl:
     """Assemble the facade from config (reference KafkaCruiseControl
-    constructor wiring :100-113)."""
+    constructor wiring :100-113).
+
+    `solve_scheduler`/`fleet_binding` are the fleet-serving hooks
+    (fleet/registry.py): a shared device-time scheduler and this
+    tenant's binding (shape-bucket padding + cross-tenant fold).  Both
+    default to None — the single-tenant path, byte-identical to
+    pre-fleet behavior."""
     if sampler is None:
         sampler = config.get_configured_instance(
             "metric.sampler.class", MetricSampler)
@@ -318,6 +325,8 @@ def build_cruise_control(config: CruiseControlConfig, admin,
         scheduler_class_deadline_budgets_s=[
             float(x) / 1e3 for x in config.get_list(
                 "scheduler.class.deadline.budget.ms") if str(x).strip()],
+        solve_scheduler=solve_scheduler,
+        fleet_binding=fleet_binding,
         monitor_kwargs=dict(
             sample_store=sample_store,
             num_windows=config.get_int("num.partition.metrics.windows"),
@@ -391,6 +400,125 @@ def build_cruise_control(config: CruiseControlConfig, admin,
                 config.get_long("default.replication.throttle")
                 if config.get_long("default.replication.throttle") > 0
                 else None)))
+
+
+def _demo_admin(num_brokers: int = 6, num_partitions: int = 24):
+    """(admin, sampler) for an in-process simulated cluster — the
+    --demo-cluster path and the `"demo": true` fleet-config clusters."""
+    import time as _t
+    from cruise_control_tpu.cluster.simulated import SimulatedCluster
+    from cruise_control_tpu.cluster.types import TopicPartition
+    from cruise_control_tpu.monitor.sampling.sampler import (
+        SimulatedClusterSampler)
+    admin = SimulatedCluster(time_fn=_t.time)
+    for b in range(num_brokers):
+        admin.add_broker(b, rack=f"rack{b % 3}")
+    # sizes well inside StaticCapacityResolver's default DISK capacity
+    admin.create_topic(
+        "demo", [[b % num_brokers, (b + 1) % num_brokers]
+                 for b in range(num_partitions)],
+        size_bytes=1e4)
+    for p in range(num_partitions):
+        admin.set_partition_load(TopicPartition("demo", p),
+                                 leader_cpu=1.0, nw_in=50.0,
+                                 nw_out=100.0)
+    return admin, SimulatedClusterSampler(admin)
+
+
+def build_fleet(config: CruiseControlConfig, fleet_config_path: str):
+    """FleetRegistry from a --fleet-config JSON file: K tenants, each a
+    full facade over its own admin client and config OVERLAY of the base
+    properties, all sharing one device-time scheduler, one bucket index
+    and one cross-tenant router (docs/FLEET.md).
+
+    File format::
+
+        {"clusters": [
+            {"id": "alpha", "demo": true,
+             "brokers": 6, "partitions": 24,
+             "overrides": {"cpu.balance.threshold": "1.3"}},
+            {"id": "beta",
+             "overrides": {"cluster.admin.class": "my.mod.AdminImpl"}}
+         ],
+         "default": "alpha"}
+
+    Non-demo clusters take their ClusterAdminClient from
+    `cluster.admin.class` in the overlay (or the base properties).
+    """
+    import json as _json
+    from cruise_control_tpu.common.config import resolve_class
+    from cruise_control_tpu.fleet import FleetRegistry
+    from cruise_control_tpu.sched.policy import SchedulerPolicy
+    from cruise_control_tpu.sched.scheduler import DeviceTimeScheduler
+
+    with open(fleet_config_path) as fh:
+        spec = _json.load(fh)
+    clusters = spec.get("clusters") or []
+    if not clusters:
+        raise ConfigException(
+            f"{fleet_config_path}: fleet config needs a non-empty "
+            f"'clusters' list")
+    ids = [c.get("id") for c in clusters]
+    if len(set(ids)) != len(ids) or not all(ids):
+        raise ConfigException(
+            f"{fleet_config_path}: cluster ids must be unique and "
+            f"non-empty, got {ids}")
+
+    # ONE scheduler for the whole fleet (the PR-4 gateway), policy from
+    # the BASE config — per-tenant scheduler.* overrides are ignored by
+    # design: admission/priority over the one device is fleet policy
+    scheduler = DeviceTimeScheduler(
+        SchedulerPolicy.from_lists(
+            weights=[float(x) for x in config.get_list(
+                "scheduler.class.weights") if str(x).strip()],
+            queue_caps=[int(x) for x in config.get_list(
+                "scheduler.class.queue.caps") if str(x).strip()],
+            deadline_budgets_s=[float(x) / 1e3 for x in config.get_list(
+                "scheduler.class.deadline.budget.ms") if str(x).strip()],
+            preemption_enabled=config.get_boolean(
+                "scheduler.preemption.enabled")),
+        enabled=config.get_boolean("scheduler.enabled"))
+    registry = FleetRegistry(
+        scheduler,
+        bucket_floor=config.get_int("fleet.bucket.floor"),
+        bucket_max_tracked=config.get_int("fleet.bucket.max.tracked"),
+        fold_enabled=config.get_boolean("fleet.fold.enabled"),
+        max_tenants=config.get_int("fleet.max.tenants"))
+    # the shared scheduler's sched-* sensors export through the fleet
+    # registry (per-tenant registries must not fight over them)
+    scheduler.attach_metrics(registry.metrics)
+
+    default_id = (spec.get("default")
+                  or config.get("fleet.default.cluster.id") or ids[0])
+    if default_id not in ids:
+        raise ConfigException(
+            f"fleet default cluster {default_id!r} is not in {ids}")
+    for entry in clusters:
+        cid = entry["id"]
+        merged = dict(config.originals)
+        merged.update({k: str(v)
+                       for k, v in (entry.get("overrides") or {}).items()})
+        tenant_config = CruiseControlConfig(merged)
+        sampler = None
+        if entry.get("demo"):
+            admin, sampler = _demo_admin(
+                num_brokers=int(entry.get("brokers", 6)),
+                num_partitions=int(entry.get("partitions", 24)))
+        else:
+            admin_cls = (tenant_config.get("cluster.admin.class")
+                         or tenant_config.get(
+                             "network.client.provider.class"))
+            if not admin_cls:
+                raise ConfigException(
+                    f"fleet cluster {cid!r}: set \"demo\": true or a "
+                    f"cluster.admin.class override")
+            admin = resolve_class(admin_cls)()
+        cc = build_cruise_control(
+            tenant_config, admin, sampler=sampler,
+            solve_scheduler=scheduler,
+            fleet_binding=registry.binding_for(cid))
+        registry.register(cid, cc, default=(cid == default_id))
+    return registry
 
 
 def build_security(config: CruiseControlConfig):
@@ -495,7 +623,8 @@ def build_ssl_context(config: CruiseControlConfig):
 
 
 def build_app(config: CruiseControlConfig,
-              cruise_control: CruiseControl) -> CruiseControlApp:
+              cruise_control: CruiseControl,
+              fleet=None) -> CruiseControlApp:
     from cruise_control_tpu.api.request_registry import (
         resolve_endpoint_classes)
     security = build_security(config)
@@ -558,7 +687,8 @@ def build_app(config: CruiseControlConfig,
             "request.reason.required"),
         session_path=config.get("webserver.session.path") or "/",
         ui_diskpath=config.get("webserver.ui.diskpath") or "",
-        ui_urlprefix=config.get("webserver.ui.urlprefix") or "/ui")
+        ui_urlprefix=config.get("webserver.ui.urlprefix") or "/ui",
+        fleet=fleet)
 
 
 def main(argv=None) -> int:
@@ -572,6 +702,10 @@ def main(argv=None) -> int:
     parser.add_argument("--demo-cluster", action="store_true",
                         help="run against an in-process simulated cluster "
                              "(no external infrastructure)")
+    parser.add_argument("--fleet-config",
+                        help="JSON file describing a multi-cluster fleet "
+                             "(one tenant per cluster sharing this "
+                             "process's device; see docs/FLEET.md)")
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -596,24 +730,16 @@ def main(argv=None) -> int:
                  "is the ClusterAdminClient implementation's "
                  "responsibility (docs/DECISIONS.md)")
 
-    if args.demo_cluster:
-        from cruise_control_tpu.cluster.simulated import SimulatedCluster
-        from cruise_control_tpu.monitor.sampling.sampler import (
-            SimulatedClusterSampler)
-        import time as _t
-        admin = SimulatedCluster(time_fn=_t.time)
-        for b in range(6):
-            admin.add_broker(b, rack=f"rack{b % 3}")
-        from cruise_control_tpu.cluster.types import TopicPartition
-        # sizes well inside StaticCapacityResolver's default DISK capacity
-        admin.create_topic(
-            "demo", [[b % 6, (b + 1) % 6] for b in range(24)],
-            size_bytes=1e4)
-        for p in range(24):
-            admin.set_partition_load(TopicPartition("demo", p),
-                                     leader_cpu=1.0, nw_in=50.0,
-                                     nw_out=100.0)
-        sampler = SimulatedClusterSampler(admin)
+    fleet = None
+    if args.fleet_config:
+        fleet = build_fleet(config, args.fleet_config)
+        cc = fleet.facade_for()
+        LOG.info("fleet: %d tenants (%s), default %r",
+                 len(fleet.tenants()),
+                 ", ".join(t.cluster_id for t in fleet.tenants()),
+                 fleet.default_id)
+    elif args.demo_cluster:
+        admin, sampler = _demo_admin()
         cc = build_cruise_control(config, admin, sampler=sampler)
     else:
         admin_cls = config.get("cluster.admin.class") \
@@ -632,11 +758,16 @@ def main(argv=None) -> int:
         admin = resolve_class(admin_cls)()
         cc = build_cruise_control(config, admin)
 
-    app = build_app(config, cc)
-    cc.start_up(
+    app = build_app(config, cc, fleet=fleet)
+    startup_kwargs = dict(
         skip_loading_samples=config.get_boolean("skip.loading.samples"),
         start_proposal_precompute=config.get_int(
             "num.proposal.precompute.threads") > 0)
+    if fleet is not None:
+        for tenant in fleet.tenants():
+            tenant.facade.start_up(**startup_kwargs)
+    else:
+        cc.start_up(**startup_kwargs)
     host = args.host or config.get("webserver.http.address")
     port = args.port if args.port is not None \
         else config.get_int("webserver.http.port")
@@ -657,7 +788,10 @@ def main(argv=None) -> int:
     finally:
         LOG.info("shutting down")
         app.stop()
-        cc.shutdown()
+        if fleet is not None:
+            fleet.shutdown()
+        else:
+            cc.shutdown()
     return 0
 
 

@@ -114,29 +114,108 @@ def pad_leading(x, pad: int, fill):
     return jnp.pad(x, widths, constant_values=fill)
 
 
+#: THE dead-row convention: the fill that keeps a padded leading-axis row
+#: inert for every ClusterState field.  One table serves all three
+#: padders — the replica-axis mesh padding (`pad_state`), the scenario
+#: compiler's broker-axis padding (scenario/compiler.py) and the fleet
+#: shape buckets (fleet/buckets.py) — so membership/weight conventions
+#: cannot drift between them: padded replicas are invalid and weightless
+#: (parked on broker 0, no disk), padded brokers are dead with zero
+#: capacity in rack/host 0, padded disks are dead with zero capacity on
+#: broker 0.  Every statistic and goal masks on replica_valid /
+#: broker_alive / disk_alive, so dead rows can never leak load (pinned in
+#: tests/test_scenario.py and tests/test_fleet.py).
+DEAD_ROW_FILLS = {
+    # replica axis [R, ...]
+    "replica_valid": False,
+    "replica_partition": 0,
+    "replica_broker": 0,
+    "replica_disk": -1,
+    "replica_is_leader": False,
+    "replica_offline": False,
+    "replica_original_offline": False,
+    "replica_base_load": 0.0,
+    # partition axis [P, ...] (fleet shape buckets pad partitions too:
+    # a padded partition belongs to topic 0 but owns NO replicas, so it
+    # contributes to no count, load, or topic statistic)
+    "partition_topic": 0,
+    "partition_leader_bonus": 0.0,
+    # broker axis [B, ...]
+    "broker_alive": False,
+    "broker_new": False,
+    "broker_demoted": False,
+    "broker_bad_disks": False,
+    "broker_capacity": 0.0,
+    "broker_rack": 0,
+    "broker_host": 0,
+    # disk axis [D]
+    "disk_broker": 0,
+    "disk_capacity": 0.0,
+    "disk_alive": False,
+}
+
+#: ClusterState fields per paddable leading axis (the other fields of
+#: each axis group are untouched by that axis's padding)
+REPLICA_AXIS_FIELDS = ("replica_valid", "replica_partition",
+                       "replica_broker", "replica_disk",
+                       "replica_is_leader", "replica_offline",
+                       "replica_original_offline", "replica_base_load")
+PARTITION_AXIS_FIELDS = ("partition_topic", "partition_leader_bonus")
+BROKER_AXIS_FIELDS = ("broker_alive", "broker_new", "broker_demoted",
+                      "broker_bad_disks", "broker_capacity", "broker_rack",
+                      "broker_host")
+DISK_AXIS_FIELDS = ("disk_broker", "disk_capacity", "disk_alive")
+
+
+def pad_field(name: str, x, pad: int):
+    """pad_leading with the registered dead-row fill for `name`."""
+    return pad_leading(x, pad, DEAD_ROW_FILLS[name])
+
+
+def _pad_axis(state: ClusterState, fields, target: int,
+              current: int) -> ClusterState:
+    if target <= current:
+        return state
+    pad = target - current
+    return state.replace(**{f: pad_field(f, getattr(state, f), pad)
+                            for f in fields})
+
+
+def pad_replica_axis(state: ClusterState, target: int) -> ClusterState:
+    """Pad the replica axis to exactly `target` rows; padding rows are
+    invalid replicas parked on broker 0 (dead-row convention above)."""
+    return _pad_axis(state, REPLICA_AXIS_FIELDS, target,
+                     state.num_replicas)
+
+
+def pad_partition_axis(state: ClusterState, target: int) -> ClusterState:
+    """Pad the partition axis to exactly `target` rows; padding rows are
+    empty partitions of topic 0 holding no replicas (no replica ever
+    references a padded partition index, so they carry no load)."""
+    return _pad_axis(state, PARTITION_AXIS_FIELDS, target,
+                     state.num_partitions)
+
+
+def pad_broker_axis(state: ClusterState, target: int) -> ClusterState:
+    """Pad the broker axis to exactly `target` rows; padding rows are
+    dead brokers with zero capacity in rack/host 0 (the scenario
+    compiler's convention, now shared)."""
+    return _pad_axis(state, BROKER_AXIS_FIELDS, target,
+                     state.num_brokers)
+
+
+def pad_disk_axis(state: ClusterState, target: int) -> ClusterState:
+    """Pad the disk axis to exactly `target` rows; padding rows are dead
+    zero-capacity disks parked on broker 0."""
+    return _pad_axis(state, DISK_AXIS_FIELDS, target, state.num_disks)
+
+
 def pad_state(state: ClusterState, multiple: int) -> ClusterState:
     """Pad the replica axis so it divides the mesh size; padding rows are
     invalid replicas parked on broker 0."""
     num_r = state.num_replicas
-    target = _pad_to_multiple(max(num_r, 1), multiple)
-    if target == num_r:
-        return state
-    pad = target - num_r
-
-    def pad_arr(x, fill):
-        return pad_leading(x, pad, fill)
-
-    return state.replace(
-        replica_valid=pad_arr(state.replica_valid, False),
-        replica_partition=pad_arr(state.replica_partition, 0),
-        replica_broker=pad_arr(state.replica_broker, 0),
-        replica_disk=pad_arr(state.replica_disk, -1),
-        replica_is_leader=pad_arr(state.replica_is_leader, False),
-        replica_offline=pad_arr(state.replica_offline, False),
-        replica_original_offline=pad_arr(state.replica_original_offline,
-                                         False),
-        replica_base_load=pad_arr(state.replica_base_load, 0.0),
-    )
+    return pad_replica_axis(state, _pad_to_multiple(max(num_r, 1),
+                                                    multiple))
 
 
 def state_shardings(state: ClusterState, mesh: Mesh) -> ClusterState:

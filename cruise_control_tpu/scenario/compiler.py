@@ -52,6 +52,15 @@ class CompiledBatch:
     #: never membership), so ONE table serves every scenario's diff
     partition_rows: np.ndarray = dataclasses.field(
         default_factory=lambda: np.zeros((0, 1), np.int32))
+    #: scenario batches share one base model: replica membership (and the
+    #: initial placement) is identical across the batch, so the engine
+    #: fetches placement row 0 once for every lane's diff.  Cross-tenant
+    #: FLEET batches (fleet/router.py) stack DIFFERENT base models: set
+    #: False and provide `partition_rows_per` so each lane diffs against
+    #: its own membership/placement.
+    shared_membership: bool = True
+    #: per-lane partition->replica rows when membership differs per lane
+    partition_rows_per: Optional[List[np.ndarray]] = None
 
     def stack(self) -> Tuple[ClusterState, OptimizationContext]:
         import jax
@@ -62,6 +71,21 @@ class CompiledBatch:
                                    *self.contexts)
         return stacked_state, stacked_ctx
 
+    def rows_of(self, i: int) -> np.ndarray:
+        """Partition->replica rows for lane i's host diff."""
+        if self.partition_rows_per is not None:
+            return self.partition_rows_per[i]
+        return self.partition_rows
+
+    def with_table_slots(self, slots: int) -> "CompiledBatch":
+        """Same batch with every context re-widened to `slots` (the
+        fleet router's _TableOverflow re-run; mirrors the
+        table_slots_override re-compile in compile_batch)."""
+        return dataclasses.replace(
+            self, contexts=[c if c.table_slots == slots
+                            else dataclasses.replace(c, table_slots=slots)
+                            for c in self.contexts])
+
     def slice(self, start: int, stop: Optional[int]) -> "CompiledBatch":
         """Sub-batch view (the OOM-halving retry re-dispatches halves
         without re-materializing anything)."""
@@ -70,7 +94,10 @@ class CompiledBatch:
             contexts=self.contexts[start:stop],
             topologies=self.topologies[start:stop],
             num_brokers=self.num_brokers,
-            partition_rows=self.partition_rows)
+            partition_rows=self.partition_rows,
+            shared_membership=self.shared_membership,
+            partition_rows_per=(None if self.partition_rows_per is None
+                                else self.partition_rows_per[start:stop]))
 
 
 def _batch_geometry(base_state: ClusterState, topology: ClusterTopology,
@@ -99,11 +126,11 @@ def _batch_geometry(base_state: ClusterState, topology: ClusterTopology,
 
 
 def _pad_broker_axis(arrays: dict, pad: int) -> dict:
-    from cruise_control_tpu.parallel.mesh import pad_leading
-    fills = dict(broker_alive=False, broker_new=False, broker_demoted=False,
-                 broker_bad_disks=False, broker_capacity=0.0,
-                 broker_rack=0, broker_host=0)
-    return {k: pad_leading(v, pad, fills[k]) for k, v in arrays.items()}
+    # dead-row convention shared with the mesh padding and the fleet
+    # shape buckets (parallel/mesh.DEAD_ROW_FILLS): one fill table, so
+    # the three padders cannot drift apart
+    from cruise_control_tpu.parallel.mesh import pad_field
+    return {k: pad_field(k, v, pad) for k, v in arrays.items()}
 
 
 def materialize(base_state: ClusterState, topology: ClusterTopology,

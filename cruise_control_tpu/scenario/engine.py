@@ -100,6 +100,15 @@ class ScenarioOutcome:
     rounds_by_goal: Dict[str, int] = dataclasses.field(default_factory=dict)
     stats_before: Optional[object] = None  #: host ClusterModelStats
     stats_after: Optional[object] = None
+    #: per-goal stats snapshots (the fused path computes these anyway;
+    #: the fleet router needs them to rebuild a full OptimizerResult)
+    stats_by_goal: Dict[str, object] = dataclasses.field(
+        default_factory=dict)
+    regressed_goals: List[str] = dataclasses.field(default_factory=list)
+    #: the infeasibility is an input-validity verdict (NaN/Inf/negative
+    #: model), not a solver verdict — the router re-raises it as
+    #: InvalidModelInputError to match the single-solve path
+    invalid_input: bool = False
     balancedness: float = 0.0
     num_replica_moves: int = 0
     num_leadership_moves: int = 0
@@ -254,6 +263,46 @@ class ScenarioEngine:
             # recording the batch sum here too would double-count it
             self._metrics.update_timer("scenario-execute-timer",
                                        result.duration_s)
+        return result
+
+    # ------------------------------------------------------------------
+    # pre-compiled batches (fleet/router.py cross-tenant folds)
+    # ------------------------------------------------------------------
+    def solve_compiled(self, optimizer, batch: CompiledBatch,
+                       include_proposals: bool = True
+                       ) -> ScenarioBatchResult:
+        """Run a caller-assembled CompiledBatch through the batched
+        fused pipeline (OOM halving included) and return the outcomes +
+        telemetry.  NO ladder here: the caller owns failure policy (the
+        fleet router falls back to per-tenant inline solves so each
+        tenant's own ladder classifies its own failure).  Broker-table
+        overflow re-runs at the widened slot count, exactly like the
+        compile_batch path."""
+        t0 = self._time()
+        result = ScenarioBatchResult(outcomes=[])
+        with self._eval_lock:
+            self.last_compile_s = 0.0
+            self.last_solve_s = 0.0
+            for _ in range(3):
+                try:
+                    result.outcomes = self._solve_fused(
+                        optimizer, batch, self.max_oom_halvings,
+                        include_proposals, result)
+                    break
+                except _TableOverflow as overflow:
+                    batch = batch.with_table_slots(overflow.slots)
+            else:
+                raise RuntimeError(
+                    "broker table kept overflowing after 3 re-widened "
+                    "runs; the batch cannot be solved fused")
+        result.duration_s = self._time() - t0
+        result.compile_s = self.last_compile_s
+        result.solve_s = self.last_solve_s
+        result.rung = "FUSED"
+        with self._lock:
+            self.last_batch_size = len(batch.specs)
+            self.total_batches += 1
+            self.total_scenarios += len(batch.specs)
         return result
 
     # ------------------------------------------------------------------
@@ -500,17 +549,25 @@ class ScenarioEngine:
                             "width %d", slots, max_count, new_slots)
                 raise _TableOverflow(new_slots)
 
-            # fetch 2/2: final + initial placements for the host diff
+            # fetch 2/2: final + initial placements for the host diff.
+            # Scenario batches share one base membership/placement, so
+            # lane 0's initial rows serve the whole batch; cross-tenant
+            # fleet batches stack different base models and fetch the
+            # full [K, R] initial planes instead
             has_disks = batch.states[0].num_disks > 0
+            shared = batch.shared_membership
+
+            def _init(x):
+                return x[0] if shared else x
             fetch2: tuple = (state.replica_broker, state.replica_is_leader,
-                             initial.replica_broker[0],
-                             initial.replica_is_leader[0],
-                             initial.replica_valid[0],
+                             _init(initial.replica_broker),
+                             _init(initial.replica_is_leader),
+                             _init(initial.replica_valid),
                              initial.replica_base_load[:, :, Resource.DISK],
-                             initial.replica_partition[0])
+                             _init(initial.replica_partition))
             if has_disks:
                 fetch2 = fetch2 + (state.replica_disk,
-                                   initial.replica_disk[0])
+                                   _init(initial.replica_disk))
             fetched2 = jax.device_get(fetch2)
         self.last_solve_s += self._time() - t_solve
         result.batch_sizes.append(k)
@@ -529,6 +586,8 @@ class ScenarioEngine:
         stacked_all = jax.tree.map(
             lambda *xs: np.concatenate(xs, axis=1), *stacked_h)
 
+        def _lane(x, i):
+            return x if shared else x[i]
         outcomes: List[ScenarioOutcome] = []
         for i in range(k):
             outcomes.append(self._assemble_outcome(
@@ -541,8 +600,10 @@ class ScenarioEngine:
                 include_proposals,
                 dict(fin_b=fin_b[i], fin_l=fin_l[i],
                      fin_d=None if fin_d is None else fin_d[i],
-                     init_b=init_b, init_l=init_l, init_d=init_d,
-                     valid=valid, base_disk=base_disk[i], part=part)))
+                     init_b=_lane(init_b, i), init_l=_lane(init_l, i),
+                     init_d=None if init_d is None else _lane(init_d, i),
+                     valid=_lane(valid, i), base_disk=base_disk[i],
+                     part=_lane(part, i))))
         return outcomes
 
     def _assemble_outcome(self, batch, i, goals, traceable, stats_before,
@@ -619,7 +680,7 @@ class ScenarioEngine:
                 opt["replica_disk"] = p["fin_d"]
             proposals = diff_proposals_host(
                 init, opt, p["valid"], p["base_disk"], p["part"],
-                batch.topologies[i], batch.partition_rows)
+                batch.topologies[i], batch.rows_of(i))
 
         return ScenarioOutcome(
             spec=spec, feasible=feasible, reason=reason, rung="FUSED",
@@ -628,6 +689,9 @@ class ScenarioEngine:
             violated_broker_counts=counts,
             rounds_by_goal=rounds_by_goal,
             stats_before=stats_before, stats_after=stats_after,
+            stats_by_goal=stats_by_goal,
+            regressed_goals=regressed,
+            invalid_input=bool(invalid),
             balancedness=balancedness,
             num_replica_moves=num_moves,
             num_leadership_moves=leader_moves,

@@ -14,12 +14,13 @@ from cruise_control_tpu.sched.queue import (AdmissionQueue, QueueFullError,
                                             SolveTicket)
 from cruise_control_tpu.sched.runtime import SolvePreempted
 from cruise_control_tpu.sched.scheduler import (DeviceTimeScheduler,
+                                                FoldedFailure,
                                                 SchedulerStoppedError,
                                                 SolveJob)
 
 __all__ = [
     "AdmissionQueue", "ClassPolicy", "DeviceTimeScheduler",
-    "PREEMPTIBLE_CLASSES", "QueueFullError", "SchedulerClass",
-    "SchedulerPolicy", "SchedulerStoppedError", "SolveJob",
-    "SolvePreempted", "SolveTicket",
+    "FoldedFailure", "PREEMPTIBLE_CLASSES", "QueueFullError",
+    "SchedulerClass", "SchedulerPolicy", "SchedulerStoppedError",
+    "SolveJob", "SolvePreempted", "SolveTicket",
 ]

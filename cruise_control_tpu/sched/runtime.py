@@ -63,6 +63,22 @@ def gateway(preempt_check: Optional[Callable[[], bool]] = None):
         _TLS.preempt_check = prev_check
 
 
+@contextlib.contextmanager
+def shielded():
+    """Suppress the preemption checkpoint for the duration.  Used by the
+    fleet router once part of a multi-group fold has committed results:
+    a SolvePreempted past that point would make the dispatch loop
+    re-queue (and re-run) work that is already done, so the remainder of
+    the fold runs to completion and the higher-priority job takes the
+    device right after instead."""
+    prev = getattr(_TLS, "preempt_check", None)
+    _TLS.preempt_check = None
+    try:
+        yield
+    finally:
+        _TLS.preempt_check = prev
+
+
 def segment_checkpoint() -> None:
     """Called by the solver between goal segments (and by the scenario
     engine between batched segments): a no-op unless the scheduler

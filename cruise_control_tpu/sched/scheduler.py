@@ -46,8 +46,21 @@ from cruise_control_tpu.utils import faults
 
 LOG = logging.getLogger(__name__)
 
-__all__ = ["SolveJob", "DeviceTimeScheduler", "QueueFullError",
-           "SchedulerClass", "SolveTicket"]
+__all__ = ["SolveJob", "DeviceTimeScheduler", "FoldedFailure",
+           "QueueFullError", "SchedulerClass", "SolveTicket"]
+
+
+class FoldedFailure:
+    """Per-entry failure marker a `fold_run` may return IN PLACE of a
+    result: that entry's ticket fails with `exc` while its fold peers
+    still resolve normally.  Raising inside fold_run fails the WHOLE
+    fold — one tenant's solver verdict inside a cross-tenant fleet batch
+    must instead fail only that tenant's waiter (fleet/router.py)."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException) -> None:
+        self.exc = exc
 
 
 @dataclasses.dataclass
@@ -260,7 +273,10 @@ class DeviceTimeScheduler:
             self._mark("sched-folded-sweeps", len(entries) - 1)
         for e, result in zip(entries, results):
             self.queue.finish(e)
-            e.ticket.resolve(result)
+            if isinstance(result, FoldedFailure):
+                e.ticket.fail(result.exc)
+            else:
+                e.ticket.resolve(result)
 
     # ------------------------------------------------------------------
     def stop(self, join_timeout_s: float = 5.0) -> None:

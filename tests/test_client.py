@@ -200,6 +200,69 @@ class TestRetry429:
         assert args.max_retries == 4
 
 
+class TestClusterFlag:
+    """Fleet tenancy from the client side: `--cluster` threads
+    `cluster=<id>` through every subcommand, and an unknown tenant's
+    404 surfaces as a clean CruiseControlClientError."""
+
+    def make_client(self, cluster):
+        client = CruiseControlClient("http://cc.test/kafkacruisecontrol",
+                                     cluster=cluster)
+        urls = []
+
+        def fake_http(method, url, task_id, data=None):
+            urls.append(url)
+            return 200, {}, {"version": 1, "summary": {},
+                             "userTasks": [], "clusters": []}
+        client._http = fake_http
+        return client, urls
+
+    def test_cluster_rides_on_every_subcommand(self):
+        client, urls = self.make_client("prod-7")
+        client.state()
+        client.proposals()
+        client.rebalance(dryrun=True)
+        client.user_tasks()
+        client.remove_broker([3])
+        for url in urls:
+            assert "cluster=prod-7" in url
+        # FLEET spans the whole fleet: no cluster param
+        client.fleet()
+        assert "cluster=" not in urls[-1]
+
+    def test_explicit_param_beats_client_default(self):
+        client, urls = self.make_client("prod-7")
+        client.request("STATE", {"cluster": "other"})
+        assert "cluster=other" in urls[0]
+        assert "cluster=prod-7" not in urls[0]
+
+    def test_no_cluster_means_no_param(self):
+        client, urls = self.make_client(None)
+        client.state()
+        assert "cluster=" not in urls[0]
+
+    def test_unknown_tenant_404_is_a_clean_client_error(self,
+                                                        live_server):
+        """The live (fleet-less) server rejects any ?cluster= with 404;
+        the client surfaces it as CruiseControlClientError(404), not a
+        poll loop or a JSON decode crash."""
+        _, _, url = live_server
+        client = CruiseControlClient(url, cluster="nope")
+        with pytest.raises(CruiseControlClientError) as err:
+            client.state()
+        assert err.value.status == 404
+        assert "nope" in err.value.message
+
+    def test_cli_cluster_flag(self):
+        args = build_parser().parse_args(
+            ["--cluster", "prod-7", "rebalance"])
+        assert args.cluster == "prod-7"
+        args = build_parser().parse_args(["fleet", "--verbose"])
+        assert args.command == "fleet" and args.verbose
+        args = build_parser().parse_args(["state"])
+        assert args.cluster is None
+
+
 class TestCli:
     def test_parser_covers_endpoints(self):
         parser = build_parser()

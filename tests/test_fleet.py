@@ -1,0 +1,492 @@
+"""Fleet serving (cruise_control_tpu/fleet/): multi-cluster tenancy on
+one device.
+
+Pins the PR-5 tentpole contract:
+
+* single-tenant byte-identical pin — a facade built WITHOUT a fleet
+  binding never touches fleet code (engine-free: bucket padding and the
+  router are monkeypatched to explode) and produces proposals identical
+  to a fleet tenant serving the same cluster;
+* bucket-padding no-leak pin — a tenant's model padded to the fleet
+  shape bucket (dead brokers / invalid replicas / empty partitions)
+  solves to the same proposals as the unpadded model, and padded rows
+  stay dead end to end;
+* cross-tenant fold split-back — two tenants' queued solves batch into
+  ONE vmapped dispatch and each tenant gets back exactly the result its
+  isolated solve produces;
+* tenant isolation — persistent faults injected while one tenant solves
+  degrade only that tenant's ladder rung; its neighbors stay FUSED, and
+  the degraded tenant is excluded from fused folds;
+* register/drain/unregister lifecycle, the FLEET endpoint, `?cluster=`
+  routing with 404/503, and fleet sensors.
+"""
+import threading
+import time
+
+import conftest  # noqa: F401
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.degradation import SolverRung
+from cruise_control_tpu.api.server import CruiseControlApp
+from cruise_control_tpu.cluster.simulated import SimulatedCluster
+from cruise_control_tpu.cluster.types import TopicPartition
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.fleet import (BucketIndex, FleetRegistry,
+                                      TenantDrainingError, TenantStatus,
+                                      UnknownTenantError, bucket_of,
+                                      next_pow2, pad_state_to_bucket)
+from cruise_control_tpu.fleet import buckets as buckets_mod
+from cruise_control_tpu.fleet.router import FleetRouter
+from cruise_control_tpu.monitor.sampling.sampler import (
+    SimulatedClusterSampler)
+from cruise_control_tpu.sched.policy import SchedulerClass, SchedulerPolicy
+from cruise_control_tpu.sched.scheduler import (DeviceTimeScheduler,
+                                                SolveJob)
+from cruise_control_tpu.testing import fixtures
+from cruise_control_tpu.utils import faults
+
+from test_facade import feed_samples
+
+pytestmark = pytest.mark.fleet
+
+#: trimmed stack (same tracing-economics rationale as FACADE_TEST_GOALS)
+FLEET_GOALS = ["RackAwareGoal", "DiskCapacityGoal",
+               "ReplicaDistributionGoal"]
+
+
+def proposal_keys(proposals):
+    return sorted((p.partition.topic, p.partition.partition,
+                   tuple(r.broker_id for r in p.old_replicas),
+                   tuple(r.broker_id for r in p.new_replicas))
+                  for p in proposals)
+
+
+# ---------------------------------------------------------------------------
+# shape buckets (no device work)
+# ---------------------------------------------------------------------------
+
+class TestBuckets:
+    def test_next_pow2(self):
+        assert next_pow2(1) == 1
+        assert next_pow2(3) == 4
+        assert next_pow2(8) == 8
+        assert next_pow2(9) == 16
+        assert next_pow2(3, floor=8) == 8
+
+    def test_bucket_and_padding_follow_dead_row_convention(self):
+        state, _topo = fixtures.small_cluster()
+        bucket = bucket_of(state, floor=8)
+        assert bucket.brokers == 8 and bucket.replicas == 8
+        padded = pad_state_to_bucket(state, bucket)
+        assert padded.num_brokers == 8
+        assert padded.num_replicas == 8
+        assert padded.num_partitions == 8
+        b0, r0, p0 = (state.num_brokers, state.num_replicas,
+                      state.num_partitions)
+        # padded brokers: dead, zero capacity; padded replicas: invalid,
+        # weightless; padded partitions: zero leader bonus
+        assert not np.asarray(padded.broker_alive)[b0:].any()
+        assert not np.asarray(padded.broker_capacity)[b0:].any()
+        assert not np.asarray(padded.replica_valid)[r0:].any()
+        assert not np.asarray(padded.replica_base_load)[r0:].any()
+        assert not np.asarray(padded.partition_leader_bonus)[p0:].any()
+        # real rows untouched
+        assert np.array_equal(np.asarray(padded.replica_broker)[:r0],
+                              np.asarray(state.replica_broker))
+        # idempotent: a state already at bucket shape passes through
+        again = pad_state_to_bucket(padded, bucket)
+        assert again.num_replicas == padded.num_replicas
+
+    def test_dummy_disk_axis_never_buckets(self):
+        state, _ = fixtures.small_cluster()
+        assert state.num_disks == 1
+        assert bucket_of(state, floor=8).disks == 1
+
+    def test_bucket_index_meters_new_combos_only(self):
+        class _Reg:
+            def __init__(self):
+                self.marks = []
+
+            def meter(self, name):
+                reg = self
+
+                class _M:
+                    def mark(self, n=1):
+                        reg.marks.append(name)
+                return _M()
+
+        reg = _Reg()
+        idx = BucketIndex(floor=8, max_tracked=2, metrics=reg)
+        state, _ = fixtures.small_cluster()
+        idx.observe(state, ("goals-a",))
+        idx.observe(state, ("goals-a",))       # same combo: no new mark
+        idx.observe(state, ("goals-b",))
+        assert reg.marks == ["fleet-bucket-compiles"] * 2
+        assert idx.to_json()["totalCombos"] == 2
+        # LRU cap: a third distinct combo evicts, total keeps counting
+        idx.observe(state, ("goals-c",))
+        assert idx.to_json()["trackedCombos"] == 2
+        assert idx.to_json()["totalCombos"] == 3
+
+
+# ---------------------------------------------------------------------------
+# registry lifecycle (stub facades; no device work)
+# ---------------------------------------------------------------------------
+
+class _StubFacade:
+    def __init__(self):
+        self.shut = False
+
+    def shutdown(self):
+        self.shut = True
+
+
+class TestRegistryLifecycle:
+    def make_registry(self, **kwargs):
+        sched = DeviceTimeScheduler(SchedulerPolicy.default())
+        return FleetRegistry(sched, **kwargs), sched
+
+    def test_register_drain_unregister(self):
+        fleet, sched = self.make_registry()
+        a, b = _StubFacade(), _StubFacade()
+        fleet.register("a", a, default=True)
+        fleet.register("b", b)
+        assert fleet.default_id == "a"
+        assert fleet.get().facade is a            # default resolution
+        assert fleet.get("b").facade is b
+        with pytest.raises(UnknownTenantError):
+            fleet.get("nope")
+        with pytest.raises(ValueError, match="already registered"):
+            fleet.register("b", _StubFacade())
+        # draining: writes rejected, reads fine, then unregister
+        fleet.drain("b")
+        with pytest.raises(TenantDrainingError):
+            fleet.get("b", for_write=True)
+        assert fleet.get("b").status is TenantStatus.DRAINING
+        with pytest.raises(ValueError, match="drained before"):
+            fleet.unregister("a")
+        fleet.unregister("b")
+        assert b.shut
+        with pytest.raises(UnknownTenantError):
+            fleet.get("b")
+        sched.stop()
+
+    def test_default_tenant_protected_and_cap_enforced(self):
+        fleet, sched = self.make_registry(max_tenants=2)
+        fleet.register("a", _StubFacade(), default=True)
+        fleet.register("b", _StubFacade())
+        with pytest.raises(ValueError, match="default tenant"):
+            fleet.drain("a")
+        with pytest.raises(ValueError, match="tenant cap"):
+            fleet.register("c", _StubFacade())
+        sched.stop()
+
+    def test_shutdown_stops_tenants_then_scheduler(self):
+        fleet, sched = self.make_registry()
+        a = _StubFacade()
+        fleet.register("a", a)
+        fleet.shutdown()
+        assert a.shut
+        assert not fleet.tenants()
+
+
+# ---------------------------------------------------------------------------
+# the live rig: a 3-tenant fleet + a fleet-free twin of tenant alpha
+# ---------------------------------------------------------------------------
+
+def _build_sim(num_brokers=4, partitions=12, rf=2, nw_out=300.0,
+               pool=(0, 1)):
+    sim = SimulatedCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b, rack=f"rack{b % 2}")
+    # skewed: everything on two brokers so there is work to do
+    assignments = [[pool[i % len(pool)] for i in range(rf)]
+                   for _ in range(partitions)]
+    sim.create_topic("t0", assignments, size_bytes=1e4)
+    for p in range(partitions):
+        sim.set_partition_load(TopicPartition("t0", p), leader_cpu=2.0,
+                               nw_in=100.0, nw_out=nw_out)
+    return sim
+
+
+def _make_facade(sim, clock, solve_scheduler=None, fleet_binding=None):
+    cc = CruiseControl(
+        sim, SimulatedClusterSampler(sim),
+        time_fn=lambda: clock["now"],
+        sleep_fn=lambda s: (sim.advance(s),
+                            clock.__setitem__("now", clock["now"] + s)),
+        monitor_kwargs=dict(num_windows=3, window_ms=10_000,
+                            min_samples_per_window=1,
+                            sampling_interval_ms=5_000),
+        executor_kwargs=dict(progress_check_interval_s=1.0),
+        auto_warmup=False, goal_names=list(FLEET_GOALS),
+        warm_start_proposals=False,
+        solve_scheduler=solve_scheduler, fleet_binding=fleet_binding)
+    cc.start_up(do_sampling=False, start_detection=False)
+    feed_samples(cc, clock)
+    return cc
+
+
+@pytest.fixture(scope="module")
+def fleet_rig():
+    """One shared fleet: alpha (default) + beta (same bucket, different
+    load) + gamma (chaos victim), plus a fleet-FREE twin of alpha for
+    the byte-identical pin.  Same-bucket tenants share compiled
+    programs, so the rig pays roughly one pipeline compile."""
+    clock = {"now": 10_000.0}
+    sched = DeviceTimeScheduler(SchedulerPolicy.default(),
+                                time_fn=lambda: clock["now"])
+    fleet = FleetRegistry(sched, bucket_floor=8,
+                          time_fn=lambda: clock["now"])
+    sched.attach_metrics(fleet.metrics)
+    tenants = {}
+    # beta: FEWER partitions on DIFFERENT brokers — a genuinely distinct
+    # cluster that still pads into alpha's shape bucket (P 10->16 vs
+    # 12->16, R 20->32 vs 24->32), so the cross-tenant fold really
+    # stacks heterogeneous tenants
+    builds = {"alpha": dict(nw_out=300.0),
+              "beta": dict(nw_out=150.0, partitions=10, pool=(1, 2)),
+              "gamma": dict(nw_out=220.0)}
+    for cid, kwargs in builds.items():
+        cc = _make_facade(_build_sim(**kwargs), clock,
+                          solve_scheduler=sched,
+                          fleet_binding=fleet.binding_for(cid))
+        fleet.register(cid, cc, default=cid == "alpha")
+        tenants[cid] = cc
+    plain = _make_facade(_build_sim(nw_out=300.0), clock)
+    app = CruiseControlApp(tenants["alpha"], fleet=fleet,
+                           async_response_timeout_s=120.0)
+    yield dict(clock=clock, sched=sched, fleet=fleet, app=app,
+               plain=plain, **tenants)
+    plain.shutdown()
+    fleet.shutdown()
+
+
+class TestSingleTenantPin:
+    def test_no_fleet_facade_is_fleet_free_and_byte_identical(
+            self, fleet_rig, monkeypatch):
+        """The pre-fleet path must survive the fleet landing untouched:
+        a binding-less facade never calls bucket padding or the router
+        (both are rigged to explode), and its proposals equal a fleet
+        tenant's over the identical cluster — which simultaneously pins
+        that bucket padding leaks nothing into the fleet solve."""
+        plain = fleet_rig["plain"]
+        assert plain._fleet_binding is None
+        assert plain._owns_scheduler
+
+        def boom(*a, **k):
+            raise AssertionError("fleet code reached from a "
+                                 "single-tenant facade")
+
+        monkeypatch.setattr(buckets_mod, "pad_state_to_bucket", boom)
+        monkeypatch.setattr(FleetRouter, "fold_run", boom)
+        plain_result = plain.optimizations(ignore_proposal_cache=True)
+        monkeypatch.undo()
+
+        fleet_result = fleet_rig["alpha"].optimizations(
+            ignore_proposal_cache=True)
+        assert proposal_keys(plain_result.proposals) == \
+            proposal_keys(fleet_result.proposals)
+        assert plain_result.violated_goals_after == \
+            fleet_result.violated_goals_after
+        assert plain_result.balancedness_score() == \
+            pytest.approx(fleet_result.balancedness_score())
+
+    def test_fleet_solve_is_bucket_padded_and_rows_stay_dead(
+            self, fleet_rig):
+        """The fleet tenant's solve really ran at the bucket shape, and
+        the padded rows never attracted replicas or load: proposals name
+        only real brokers, and the final placement keeps every padded
+        replica row invalid."""
+        cc = fleet_rig["alpha"]
+        result = cc.optimizations(ignore_proposal_cache=True)
+        final = result.final_state
+        assert final.num_brokers == 8            # 4 padded to bucket 8
+        assert final.num_replicas == 32          # 24 padded up
+        assert not np.asarray(final.replica_valid)[24:].any()
+        real_brokers = set(range(4))
+        for p in result.proposals:
+            for r in p.new_replicas:
+                assert r.broker_id in real_brokers
+        # the (bucket, goal-list) combo was accounted
+        assert fleet_rig["fleet"].buckets.total_combos >= 1
+        sensors = fleet_rig["fleet"].metrics.to_json()
+        assert sensors["fleet-bucket-compiles"]["count"] >= 1
+
+
+class TestCrossTenantFold:
+    def test_queued_tenant_solves_fold_and_split_back(self, fleet_rig):
+        """Two tenants' solves queued behind a busy device dispatch as
+        ONE vmapped batch; each caller gets exactly what its isolated
+        solve produces."""
+        sched, fleet = fleet_rig["sched"], fleet_rig["fleet"]
+        cc_a, cc_b = fleet_rig["alpha"], fleet_rig["beta"]
+        # isolated references (dispatch alone: the inline single path)
+        ref_a = cc_a.optimizations(ignore_proposal_cache=True)
+        ref_b = cc_b.optimizations(ignore_proposal_cache=True)
+        assert proposal_keys(ref_a.proposals) != \
+            proposal_keys(ref_b.proposals)       # genuinely distinct
+
+        release, started = threading.Event(), threading.Event()
+
+        def blocker():
+            started.set()
+            release.wait(60.0)
+
+        threads = [threading.Thread(target=lambda: sched.submit(
+            SolveJob(klass=SchedulerClass.ANOMALY_HEAL, run=blocker,
+                     label="blocker")))]
+        threads[0].start()
+        assert started.wait(10.0)
+
+        results = {}
+
+        def solve(cc, key):
+            results[key] = cc.optimizations(ignore_proposal_cache=True)
+
+        for cc, key in ((cc_a, "a"), (cc_b, "b")):
+            t = threading.Thread(target=solve, args=(cc, key))
+            t.start()
+            threads.append(t)
+        deadline = time.time() + 10.0
+        while sched.queue.depth() < 2:
+            assert time.time() < deadline, "solves never queued"
+            time.sleep(0.01)
+        batches_before = fleet.router.total_fold_batches
+        release.set()
+        for t in threads:
+            t.join(timeout=300.0)
+            assert not t.is_alive()
+
+        assert fleet.router.total_fold_batches == batches_before + 1
+        assert fleet.router.total_folded >= 2
+        sensors = fleet.metrics.to_json()
+        assert sensors["fleet-folded-solves"]["count"] >= 2
+        # split-back correctness: folded == isolated, per tenant
+        assert proposal_keys(results["a"].proposals) == \
+            proposal_keys(ref_a.proposals)
+        assert proposal_keys(results["b"].proposals) == \
+            proposal_keys(ref_b.proposals)
+        assert results["a"].violated_goals_after == \
+            ref_a.violated_goals_after
+        assert results["b"].violated_goals_after == \
+            ref_b.violated_goals_after
+        # folded results carry no final state (no warm seed) by design
+        assert results["a"].final_state is None
+
+
+@pytest.mark.chaos
+class TestTenantIsolationChaos:
+    def test_faults_degrade_only_the_targeted_tenant(self, fleet_rig):
+        """Persistent compile+runtime faults while gamma solves walk
+        gamma's ladder down; alpha and beta keep solving FUSED — one
+        tenant's incident never moves a neighbor's rung — and the
+        degraded tenant stops offering itself to fused folds."""
+        cc_g, cc_a = fleet_rig["gamma"], fleet_rig["alpha"]
+        assert cc_g.solver_ladder.rung is SolverRung.FUSED
+
+        plan = faults.FaultPlan() \
+            .fail_always("optimizer.compile") \
+            .fail_always("optimizer.execute")
+        with faults.injected(plan):
+            degraded = cc_g.optimizations(ignore_proposal_cache=True)
+        assert degraded is not None              # served from CPU rung
+        assert cc_g.solver_ladder.rung is SolverRung.CPU
+
+        # neighbors: untouched ladders, healthy fused solves
+        for other in ("alpha", "beta"):
+            cc_o = fleet_rig[other]
+            assert cc_o.solver_ladder.rung is SolverRung.FUSED
+            healthy = cc_o.optimizations(ignore_proposal_cache=True)
+            assert cc_o.solver_ladder.rung is SolverRung.FUSED
+            assert healthy.proposals is not None
+
+        # the degraded tenant is excluded from fused cross-tenant folds
+        _key, payload, _run = cc_g._fleet_fold_spec(
+            cc_g.goal_optimizer, True, None, None, None,
+            lambda: None, lambda r, e: None)
+        assert payload.fused_ok() is False
+        _key, payload_a, _run = cc_a._fleet_fold_spec(
+            cc_a.goal_optimizer, True, None, None, None,
+            lambda: None, lambda r, e: None)
+        assert payload_a.fused_ok() is True
+
+
+class TestFleetRest:
+    def test_fleet_endpoint_lists_tenants(self, fleet_rig):
+        app = fleet_rig["app"]
+        status, _, out = app.handle_request(
+            "GET", "/kafkacruisecontrol/fleet", "")
+        assert status == 200
+        by_id = {c["clusterId"]: c for c in out["clusters"]}
+        assert set(by_id) == {"alpha", "beta", "gamma"}
+        assert by_id["alpha"]["isDefault"] is True
+        assert out["defaultTenant"] == "alpha"
+        assert out["buckets"]["totalCombos"] >= 1
+
+    def test_cluster_param_routes_and_404s(self, fleet_rig):
+        app = fleet_rig["app"]
+        status, _, out = app.handle_request(
+            "GET", "/kafkacruisecontrol/state",
+            "cluster=beta&substates=monitor")
+        assert status == 200
+        assert out["MonitorState"]["numValidWindows"] > 0
+        status, _, out = app.handle_request(
+            "GET", "/kafkacruisecontrol/state", "cluster=nope")
+        assert status == 404
+        assert "unknown cluster" in out["errorMessage"]
+        # omitted cluster = default tenant, unchanged response shape
+        status, _, out = app.handle_request(
+            "GET", "/kafkacruisecontrol/state", "substates=fleet")
+        assert status == 200
+        assert out["FleetState"]["defaultTenant"] == "alpha"
+
+    def test_no_fleet_app_404s_cluster_param(self, fleet_rig):
+        app = CruiseControlApp(fleet_rig["plain"])
+        status, _, out = app.handle_request(
+            "GET", "/kafkacruisecontrol/state", "cluster=alpha")
+        assert status == 404
+        assert "not running a fleet" in out["errorMessage"]
+        status, _, out = app.handle_request(
+            "GET", "/kafkacruisecontrol/fleet", "")
+        assert status == 404
+
+    def test_sensors_are_tenant_tagged(self, fleet_rig):
+        sensors = fleet_rig["fleet"].sensors_json()
+        assert "fleet-bucket-compiles" in sensors
+        assert any(k.startswith("cluster.alpha.") for k in sensors)
+        assert any(k.startswith("cluster.beta.") for k in sensors)
+        # gamma's degraded rung is visible through its tagged sensor
+        assert sensors["cluster.gamma.solver-rung"]["value"] == \
+            float(int(SolverRung.CPU))
+
+
+class TestLifecycleLive:
+    def test_drain_rejects_writes_allows_reads_then_unregister(
+            self, fleet_rig):
+        """Runs LAST: consumes the chaos tenant.  Draining answers 503
+        to mutations while reads keep working; unregistering removes the
+        tenant (404) and shuts its facade down without touching the
+        shared scheduler."""
+        app, fleet = fleet_rig["app"], fleet_rig["fleet"]
+        fleet.drain("gamma")
+        status, _, out = app.handle_request(
+            "POST", "/kafkacruisecontrol/rebalance",
+            "cluster=gamma&dryrun=true")
+        assert status == 503
+        assert "draining" in out["errorMessage"]
+        status, _, _ = app.handle_request(
+            "GET", "/kafkacruisecontrol/state",
+            "cluster=gamma&substates=monitor")
+        assert status == 200
+        fleet.unregister("gamma")
+        status, _, _ = app.handle_request(
+            "GET", "/kafkacruisecontrol/state", "cluster=gamma")
+        assert status == 404
+        # the shared scheduler survived the tenant teardown
+        assert fleet_rig["sched"]._stop.is_set() is False
+        result = fleet_rig["alpha"].optimizations(
+            ignore_proposal_cache=True)
+        assert result is not None

@@ -18,7 +18,15 @@ Checks:
     `.evaluate(...)` call outside facade.py / sched/ and the solver
     implementation itself — every device solve must enter through the
     device-time scheduler (the PR-4 invariant; its runtime half is the
-    chaos stress test's under_gateway assertion).
+    chaos stress test's under_gateway assertion);
+  * tenant-root rule: no mutable module-level state in fleet-reachable
+    modules (cruise_control_tpu/fleet/) — the FleetRegistry INSTANCE is
+    the only root of per-tenant state, so draining a tenant provably
+    leaves nothing behind in process globals (the PR-5 isolation
+    invariant).  Module-scope assignments of list/dict/set displays,
+    comprehensions, or mutable-container constructor calls are
+    findings; immutable constants (tuples, frozensets, strings,
+    numbers) are fine.
 
 Usage: python tools/lint.py [paths...]   (default: the package + tests)
 Exit code 1 when any finding is reported.
@@ -151,6 +159,56 @@ def _gateway_violations(path: Path, tree: ast.AST) -> list:
     return findings
 
 
+#: constructor names whose module-scope call sites create MUTABLE
+#: containers (per-tenant state could silently accrete in them)
+_MUTABLE_CONSTRUCTORS = {"list", "dict", "set", "bytearray", "deque",
+                         "defaultdict", "OrderedDict", "Counter",
+                         "WeakValueDictionary", "WeakKeyDictionary"}
+
+
+def _is_mutable_value(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = _call_name(node.func)
+        return name in _MUTABLE_CONSTRUCTORS
+    return False
+
+
+def _fleet_mutable_globals(path: Path, tree: ast.AST) -> list:
+    """Tenant-root rule: fleet-reachable modules must hold NO mutable
+    module-level state — the registry instance is the only tenant root
+    (see module docstring)."""
+    parts = path.parts
+    if "cruise_control_tpu" not in parts:
+        return []
+    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
+    rel = "/".join(parts[pkg + 1:])
+    if not rel.startswith("fleet/"):
+        return []
+    findings = []
+    body = tree.body if isinstance(tree, ast.Module) else []
+    for node in body:
+        targets, value = [], None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if value is None or not _is_mutable_value(value):
+            continue
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if names and all(n.startswith("__") and n.endswith("__")
+                         for n in names):
+            continue          # __all__ and friends: module metadata
+        findings.append(
+            f"{path}:{node.lineno}: mutable module-level state "
+            f"{names or '<assignment>'} in a fleet module — per-tenant "
+            f"state may live only under the FleetRegistry instance "
+            f"(tenant-root rule)")
+    return findings
+
+
 def _imported_names(tree: ast.AST):
     """{local binding name: node} for every module-scope import."""
     out = {}
@@ -214,6 +272,7 @@ def lint_file(path: Path) -> list:
 
     findings.extend(_silent_swallows(path, tree))
     findings.extend(_gateway_violations(path, tree))
+    findings.extend(_fleet_mutable_globals(path, tree))
 
     # unused imports: __init__.py files are re-export surfaces; a module
     # __all__ also marks intentional re-exports; `annotations` is the
