@@ -37,6 +37,15 @@ BENCH_CONFIG selects a BASELINE.json eval config:
                    >1 = program sharing is sublinear in tenants), and
                    verifies per-tenant proposals are identical bucketed
                    vs raw
+  mesh             mesh-scaled full-stack solve (parallel/mesh.py): the
+                   north-star model solved over 1/2/4/8 devices
+                   (BENCH_MESH_DEVICES, clipped to the visible device
+                   count) through the SAME production pipeline, each
+                   mesh size AOT-warmed then measured, with per-segment
+                   profiler category attribution recorded per mesh size
+                   (the output JSON carries a "mesh" block; value =
+                   solve seconds at the largest mesh, vs_baseline =
+                   mesh1 / largest-mesh, >1 = the mesh wins)
   sched            device-time scheduler (sched/): N concurrent mixed
                    clients (N = BENCH_SCHED_CLIENTS, default 1,8,32;
                    USER_INTERACTIVE / PRECOMPUTE round-robin with
@@ -51,6 +60,19 @@ BENCH_CONFIG selects a BASELINE.json eval config:
 
 Other knobs: BENCH_BROKERS, BENCH_PARTITIONS, BENCH_RF, BENCH_ROUNDS,
 BENCH_GOALS (comma list), BENCH_SEGMENT, BENCH_SKIP_WARMUP.
+
+BENCH_MESH governs the headline device topology: unset/auto = solve
+over ALL visible devices when the backend is not CPU (the v5e-8 path;
+CPU multi-device = the virtual test rig, which stays single-chip),
+"0"/"off" = force single-chip, N = clip the mesh to the first N
+devices (works on the CPU rig too, for local checks).  The headline
+JSON reports `n_devices` + `mesh` shape either way, so BENCH_r06+ is
+attributable to the topology that produced it.
+
+The headline bench FAILS LOUDLY (stderr ERROR + "goal_self_regressions"
+in the JSON + exit code 1) when any goal's own pass worsened its own
+violated-broker count (after-own > at-entry) — the silent
+LeaderBytesInDistributionGoal drift of BENCH_r04/r05.
 
 CC_TPU_PROFILE=1 (or legacy BENCH_PROFILE=1) enables the segment-level
 profiler: per-goal programs with explicit sync points, emitting the
@@ -71,6 +93,39 @@ os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+
+def _resolve_mesh(jax, raw=None):
+    """Headline solve mesh from BENCH_MESH (None = single-chip):
+    auto = all visible devices on non-CPU backends, N = first N devices
+    (any backend), 0/off = disabled.  See the module docstring."""
+    from cruise_control_tpu.parallel.mesh import make_mesh
+    raw = (os.environ.get("BENCH_MESH", "") if raw is None
+           else raw).strip().lower()
+    devices = jax.devices()
+    if raw in ("0", "1", "off", "false", "none"):
+        return None
+    if raw in ("", "auto"):
+        if devices[0].platform == "cpu" or len(devices) < 2:
+            return None
+        return make_mesh(devices)
+    n = min(int(raw), len(devices))
+    return make_mesh(devices[:n]) if n >= 2 else None
+
+
+def _self_regressions(results):
+    """{goal: {entry, own, before}} for every goal whose OWN pass
+    worsened its own violated-broker count (after-own > at-entry) in
+    any measured result — the loud-failure food."""
+    out = {}
+    for r in results:
+        entries = getattr(r, "entry_broker_counts", {}) or {}
+        for g, (b, own, _a) in r.violated_broker_counts.items():
+            e = entries.get(g, b)
+            if own > e:
+                out[g] = {"entry": int(e), "own": int(own),
+                          "before": int(b)}
+    return out
 
 
 def _build(config, num_b, num_p, rf, seed=4):
@@ -116,6 +171,8 @@ def main() -> None:
         return _sched_bench()
     if config == "fleet":
         return _fleet_bench()
+    if config == "mesh":
+        return _mesh_bench()
     presets = {  # (brokers, partitions, goal subset, metric label)
         "north": (2600, 200_000, None, "full-stack proposal generation"),
         "1": (3, 30, None, "deterministic fixture"),
@@ -153,6 +210,11 @@ def main() -> None:
     goals = default_goals(max_rounds=rounds, names=names)
     segment = int(os.environ.get("BENCH_SEGMENT", 2))
     optimizer = GoalOptimizer(goals, pipeline_segment_size=segment)
+    mesh = _resolve_mesh(jax)
+    n_devices = mesh.size if mesh is not None else 1
+    print(f"# solve mesh: {n_devices} device(s)"
+          + (f" over ('replica',) [{mesh.devices.flat[0].platform}]"
+             if mesh is not None else " (single-chip)"), file=sys.stderr)
     profiler = None
     from cruise_control_tpu.utils import profiling
     if (os.environ.get("BENCH_PROFILE", "") not in ("", "0")
@@ -172,7 +234,8 @@ def main() -> None:
         profiler = profiling.install()
 
     def run_once(st, topo, options):
-        return optimizer.optimizations(st, topo, options, check_sanity=False)
+        return optimizer.optimizations(st, topo, options,
+                                       check_sanity=False, mesh=mesh)
 
     def run_config(st, topo):
         """One measured pass; config 4 chains add-broker then
@@ -212,7 +275,8 @@ def main() -> None:
     # of the measured pass.
     if not os.environ.get("BENCH_SKIP_WARMUP"):
         t0 = time.time()
-        warm_s = optimizer.warmup(state, topo, OptimizationOptions())
+        warm_s = optimizer.warmup(state, topo, OptimizationOptions(),
+                                  mesh=mesh)
         print(f"# warmup (parallel AOT compile) {warm_s:.1f}s",
               file=sys.stderr)
         run_with_retry("warmup")
@@ -240,9 +304,11 @@ def main() -> None:
           f"balancedness={results[-1].balancedness_score():.1f}",
           file=sys.stderr)
     counts = results[-1].violated_broker_counts
+    entries = results[-1].entry_broker_counts
     nonzero = {g: c for g, c in counts.items() if any(c)}
-    print("# violated broker counts (before->after-own->after-all): "
-          + (", ".join(f"{g}={b}->{o}->{a}"
+    print("# violated broker counts (before->at-entry->after-own->"
+          "after-all): "
+          + (", ".join(f"{g}={b}->{entries.get(g, b)}->{o}->{a}"
                        for g, (b, o, a) in nonzero.items())
              or "none"), file=sys.stderr)
     print("# rounds by goal: "
@@ -256,12 +322,145 @@ def main() -> None:
     print(f"# vs_baseline below = target_ratio ({TARGET_SECONDS:g}s "
           f"north-star / measured); reference CPU baseline unmeasured "
           f"(no JVM), see BASELINE.md", file=sys.stderr)
-    print(json.dumps({
+    regressions = _self_regressions(results)
+    out = {
         "metric": (f"{label} {state.num_brokers}b/"
                    f"{state.num_partitions/1000:g}Kp rf{rf} [{backend}]"),
         "value": round(elapsed, 3),
         "unit": "s",
         "vs_baseline": round(TARGET_SECONDS / elapsed, 3),
+        # topology attribution: which device layout produced this number
+        "n_devices": n_devices,
+        "mesh": ({"devices": n_devices, "axis": "replica"}
+                 if mesh is not None else {"devices": 1, "axis": None}),
+    }
+    if regressions:
+        out["goal_self_regressions"] = regressions
+        print("# ERROR: goal self-regression — these goals' OWN passes "
+              "worsened their own violated-broker counts "
+              f"(at-entry -> after-own): {regressions}", file=sys.stderr)
+    print(json.dumps(out))
+    if regressions:
+        sys.exit(1)
+
+
+def _mesh_bench() -> None:
+    """BENCH_CONFIG=mesh: full-stack solve latency + per-segment
+    profiler attribution at mesh=1/2/4/8 (BENCH_MESH_DEVICES, clipped
+    to the visible device count), same model, same goal stack, same
+    pipeline — ONLY the device topology varies.  Each mesh size is
+    AOT-warmed (GoalOptimizer.warmup(mesh=...)) and run once unmeasured
+    before the measured pass, so the numbers compare steady-state solve
+    latency, not compile luck.  The profiled pass runs SEPARATELY after
+    the measured one (profiling re-segments the pipeline and inserts
+    sync points, so its wall-clock is attribution-only).
+
+    vs_baseline = mesh1 solve seconds / largest-mesh solve seconds
+    (>1 = the mesh wins); the acceptance criterion for BENCH_r06 is
+    monotone improvement mesh=1 -> mesh=8 on TPU."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(os.environ[
+                          "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+
+    from cruise_control_tpu.analyzer.context import OptimizationOptions
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.utils import profiling
+
+    num_b = int(os.environ.get("BENCH_BROKERS", 2600))
+    num_p = int(os.environ.get("BENCH_PARTITIONS", 200_000))
+    rf = int(os.environ.get("BENCH_RF", 3))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 192))
+    goal_names = os.environ.get("BENCH_GOALS")
+    names = goal_names.split(",") if goal_names else None
+    segment = int(os.environ.get("BENCH_SEGMENT", 2))
+    visible = len(jax.devices())
+    sizes = sorted({min(int(n), visible) for n in os.environ.get(
+        "BENCH_MESH_DEVICES", "1,2,4,8").split(",") if n.strip()})
+    if 1 not in sizes:
+        # vs_baseline is DEFINED as mesh1 / largest-mesh: always measure
+        # the single-chip baseline rather than silently substituting the
+        # smallest requested mesh (same rule as the scenario bench's K=1)
+        sizes = [1] + sizes
+    profile = os.environ.get("BENCH_MESH_PROFILE", "1") not in ("", "0")
+
+    backend = jax.devices()[0].platform
+    state, topo = _build("north", num_b, num_p, rf)
+    print(f"# mesh bench: B={state.num_brokers} P={state.num_partitions} "
+          f"R={state.num_replicas} mesh sizes {sizes} of {visible} "
+          f"visible [{backend}]", file=sys.stderr)
+
+    optimizer = GoalOptimizer(default_goals(max_rounds=rounds,
+                                            names=names),
+                              pipeline_segment_size=segment)
+    results = {}
+    for n in sizes:
+        mesh = _resolve_mesh(jax, raw=str(n))
+        if n > 1 and mesh is None:
+            print(f"# mesh={n}: not enough devices, skipped",
+                  file=sys.stderr)
+            continue
+
+        def solve():
+            return optimizer.optimizations(state, topo,
+                                           OptimizationOptions(),
+                                           check_sanity=False, mesh=mesh)
+
+        t0 = time.time()
+        warm_s = optimizer.warmup(state, topo, OptimizationOptions(),
+                                  mesh=mesh)
+        solve()                                   # first-run host costs
+        warm_total = time.time() - t0
+        t0 = time.time()
+        r = solve()                               # the measured pass
+        solve_s = time.time() - t0
+        entry = {
+            "warmup_s": round(warm_total, 3),
+            "warmup_compile_s": round(warm_s, 3),
+            "solve_s": round(solve_s, 3),
+            "n_devices": r.mesh_devices,
+            "proposals": len(r.proposals),
+            "balancedness": round(r.balancedness_score(), 2),
+        }
+        if profile:
+            # attribution pass: per-goal programs + sync points; its
+            # wall-clock is NOT comparable to solve_s above
+            os.environ[profiling.PROFILE_ENV] = "1"
+            prof = profiling.install()
+            optimizer.profile_segments = True
+            try:
+                solve()
+                entry["profile_category_s"] = {
+                    c: round(s, 3)
+                    for c, s in sorted(prof.category_totals().items())}
+            finally:
+                optimizer.profile_segments = False
+                profiling.uninstall()
+                os.environ[profiling.PROFILE_ENV] = "0"
+        results[str(n)] = entry
+        print(f"# mesh={n}: warm {entry['warmup_s']}s, solve "
+              f"{entry['solve_s']}s, proposals {entry['proposals']}"
+              + (f", attribution {entry.get('profile_category_s')}"
+                 if profile else ""), file=sys.stderr)
+
+    n_max = str(max(int(k) for k in results))
+    base = results.get("1", results[min(results, key=int)])
+    top = results[n_max]
+    print(json.dumps({
+        "metric": (f"mesh-scaled full-stack {state.num_brokers}b/"
+                   f"{state.num_partitions/1000:g}Kp rf{rf} "
+                   f"mesh={n_max} [{backend}]"),
+        "value": top["solve_s"],
+        "unit": "s",
+        # mesh scaling factor: single-chip solve / largest-mesh solve
+        "vs_baseline": (round(base["solve_s"] / top["solve_s"], 3)
+                        if top["solve_s"] else 0.0),
+        "n_devices": top["n_devices"],
+        "mesh": results,
     }))
 
 

@@ -53,8 +53,18 @@ class FailureKind(enum.Enum):
 
 
 class SolverRung(enum.IntEnum):
-    """Degradation ladder rungs, best (0) to most degraded (2)."""
+    """Degradation ladder rungs, best to most degraded.
 
+    MESH sits ABOVE the classic ladder (value -1 keeps FUSED's wire
+    value 0 stable for the solver-rung sensor and every existing pin):
+    the fused pipeline pjit'ed over the scheduler's whole device mesh.
+    It only exists as a rung where a multi-chip mesh token is live —
+    single-chip ladders top out at FUSED exactly as before.  A
+    collective/ICI/runtime failure on the mesh descends MESH→FUSED
+    (same search, one chip) before the classic FUSED→EAGER→CPU ladder
+    engages."""
+
+    MESH = -1
     FUSED = 0
     EAGER = 1
     CPU = 2
@@ -205,10 +215,15 @@ class DegradationLadder:
     the breaker, so recovery is one rung per solve back to FUSED."""
 
     def __init__(self, breaker: CircuitBreaker,
-                 start_rung: SolverRung = SolverRung.FUSED) -> None:
+                 start_rung: Optional[SolverRung] = None,
+                 top_rung: SolverRung = SolverRung.FUSED) -> None:
         self.breaker = breaker
         self._lock = threading.Lock()
-        self._rung = start_rung
+        #: best rung this ladder can serve: MESH when the facade holds a
+        #: multi-chip mesh token, FUSED otherwise (single-chip ladders
+        #: are bit-for-bit the pre-mesh ladder)
+        self.top_rung = top_rung
+        self._rung = top_rung if start_rung is None else start_rung
         #: lifetime descent count (sensor food)
         self.total_descents = 0
 
@@ -220,11 +235,11 @@ class DegradationLadder:
     def entry_rung(self) -> SolverRung:
         """Where the next solve should start: the pinned resting rung
         while the breaker is OPEN, one rung up otherwise (the recovery
-        probe; FUSED when service is healthy)."""
+        probe; the top rung when service is healthy)."""
         state = self.breaker.state
         with self._lock:
             if (state is not BreakerState.OPEN
-                    and self._rung > SolverRung.FUSED):
+                    and self._rung > self.top_rung):
                 return SolverRung(self._rung - 1)
             return self._rung
 
@@ -247,14 +262,15 @@ class DegradationLadder:
 
     def on_success(self, rung: SolverRung) -> None:
         """A solve succeeded at `rung`.  A success ABOVE the resting rung
-        (a probe) or at FUSED climbs/settles the ladder and closes the
-        breaker; a success AT a degraded resting rung changes nothing —
-        the fallback working is expected, not recovery."""
+        (a probe) or at the top rung climbs/settles the ladder and closes
+        the breaker; a success AT a degraded resting rung changes nothing
+        — the fallback working is expected, not recovery."""
         with self._lock:
             probe = rung < self._rung
             if probe:
                 self._rung = rung
-        if probe or rung is SolverRung.FUSED:
+            top = self.top_rung
+        if probe or rung <= top:
             self.breaker.record_success()
 
     def to_json(self) -> dict:

@@ -168,6 +168,11 @@ class LeaderBytesInDistributionGoal(Goal):
         avg = jnp.sum(lbi * alive) / jnp.maximum(jnp.sum(alive), 1)
         return avg * (1 + self.pct_margin)
 
+    def _violated_count(self, st: ClusterState, ctx: OptimizationContext,
+                        cache) -> jax.Array:
+        return jnp.sum(self.violated_brokers(st, ctx, cache),
+                       dtype=jnp.int32)
+
     def optimize_cached(self, state: ClusterState, ctx: OptimizationContext,
                         prev_goals: Sequence[Goal], cache=None):
         from cruise_control_tpu.analyzer.leadership import (
@@ -179,19 +184,44 @@ class LeaderBytesInDistributionGoal(Goal):
             return jnp.full((st.num_brokers,),
                             avg_w * (1 + self.pct_margin))
 
+        def _select(ok, after, before):
+            # whole-pytree select: keep `after` only when the step did
+            # not worsen this goal's own violated-broker count
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b),
+                                after, before)
+
+        # SELF-REGRESSION GATE (device-side, fused into the goal
+        # program): BENCH_r04/r05 measured this goal's own pass
+        # WORSENING its violated-broker count (269 -> 291) — transfers
+        # that unload one broker can push several destinations over the
+        # mean-relative bound, and the per-transfer acceptance cannot
+        # see the aggregate.  Every step below (the re-election sweep,
+        # then each search round) is accepted only if the goal's own
+        # violated count did not grow; a rejected step reverts
+        # state+cache and ends the search (deterministic rounds would
+        # just re-propose it).  The PR-1 stats non-regression flag never
+        # gated this goal (it has no stats comparator), so the gate is
+        # the enforcement — `goal-self-regressions` is the sensor.
+        cache = ensure_full_cache(state, ctx, cache)
+        v_enter = self._violated_count(state, ctx, cache)
+
         # whole-cluster re-election toward the mean bytes-in first (see
         # count_distribution.LeaderReplicaDistributionGoal — same
         # rationale); per-REPLICA value = the replica's own base NW_IN
         # (the model stores base loads per replica, builder.py)
         value_r = (state.replica_base_load[:, Resource.NW_IN]
                    * state.replica_valid)
-        state, sweep_rounds, cache = run_sweep_threaded(
+        swept, sweep_rounds, swept_cache = run_sweep_threaded(
             state, ctx, prev_goals, cache,
             measure=lambda cache: cache.leader_bytes_in,
             value_r=value_r,
             bounds=mean_bounds(_upper_of), improve_gate=True,
             max_rounds=128, select_jitter=VALUE_WEIGHTED_SELECT_JITTER)
         note_rounds(sweep_rounds)
+        sweep_ok = (self._violated_count(swept, ctx, swept_cache)
+                    <= v_enter)
+        state, cache = _select(sweep_ok, (swept, swept_cache),
+                               (state, cache))
 
         base_movable = replica_static_ok(state, ctx)
 
@@ -236,11 +266,17 @@ class LeaderBytesInDistributionGoal(Goal):
 
         def body(carry):
             st, cache, rounds, _ = carry
-            st, cache, committed = round_body(st, cache)
-            return st, cache, rounds + 1, committed
+            v0 = self._violated_count(st, ctx, cache)
+            st2, cache2, committed = round_body(st, cache)
+            # the fused self-regression gate: reject (and stop at) any
+            # round whose accepted transfers grew this goal's own
+            # violated-broker count — see optimize_cached
+            ok = self._violated_count(st2, ctx, cache2) <= v0
+            st, cache = _select(ok, (st2, cache2), (st, cache))
+            return st, cache, rounds + 1, committed & ok
 
         state, cache, rounds, _ = jax.lax.while_loop(
-            cond, body, (state, ensure_full_cache(state, ctx, cache),
+            cond, body, (state, cache,
                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
         note_rounds(rounds)
         return state, cache

@@ -38,6 +38,7 @@ from cruise_control_tpu.analyzer.proposals import (ExecutionProposal,
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.sanity import sanity_check
+from cruise_control_tpu.parallel import mesh as mesh_mod
 from cruise_control_tpu.sched.runtime import segment_checkpoint
 from cruise_control_tpu.model.state import ClusterState
 from cruise_control_tpu.model.stats import (ClusterModelStats, compute_stats,
@@ -147,6 +148,18 @@ class OptimizerResult:
     #: per-goal search rounds consumed (wall-clock is round-count × round
     #: cost, so this is the profiling instrument for the round budget)
     rounds_by_goal: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: devices the solve's tensor program actually spanned (1 =
+    #: single-chip; >1 = the fused pipeline was pjit'ed over the
+    #: ('replica',) mesh — the multichip marker tests assert on this)
+    mesh_devices: int = 1
+    #: per-goal violated-broker count at the goal's OWN ENTRY (after
+    #: every earlier goal ran).  own-vs-entry is the true
+    #: self-regression instrument: own > entry means the goal's own
+    #: accepted moves worsened its statistic (gated device-side for
+    #: LeaderBytesInDistributionGoal); own > before with own <= entry
+    #: means an EARLIER goal interfered — different bug, different fix.
+    entry_broker_counts: Dict[str, int] = \
+        dataclasses.field(default_factory=dict)
 
     @property
     def num_replica_movements(self) -> int:
@@ -425,9 +438,17 @@ class GoalOptimizer:
             cache = refresh_float_aggregates(state, cache)
             per_goal_stats = []
             own_violated = []
+            entry_violated = []
             rounds_used = []
             regressed = []
             for i in range(start, stop):
+                # the goal's violated count at its OWN entry: own-vs-
+                # entry is the self-regression instrument (own-vs-before
+                # conflates earlier goals' interference with it)
+                c0 = (cache if cache is not None
+                      else make_round_cache(state))
+                entry_violated.append(goals[i].violated_brokers(
+                    state, ctx, c0).sum(dtype=jnp.int32))
                 sink: List = []
                 goals_base.set_round_sink(sink)
                 try:
@@ -463,7 +484,8 @@ class GoalOptimizer:
             cache = ensure_full_cache(state, ctx, cache)
             return state, cache, prev_stats, (
                 stacked, jnp.stack(own_violated), jnp.stack(rounds_used),
-                jnp.stack(regressed), hard_violated)
+                jnp.stack(regressed), hard_violated,
+                jnp.stack(entry_violated))
         return run
 
     def _device_comparators(self) -> Tuple[bool, ...]:
@@ -489,8 +511,10 @@ class GoalOptimizer:
     # unprofiled run; the table is for attribution, not the headline.
 
     def _goal_rounds_fn(self, i: int):
-        """(state, cache, ctx) -> (state, cache, rounds i32[1]) — goal
-        i's search rounds only (profile mode)."""
+        """(state, cache, ctx) -> (state, cache, rounds i32[1],
+        entry-violated i32[1]) — goal i's search rounds only (profile
+        mode / eager driver); `entry` is the goal's violated-broker
+        count before its own run (self-regression instrument)."""
         goals = tuple(self.goals)
 
         def run(state: ClusterState, cache, ctx: OptimizationContext):
@@ -498,6 +522,8 @@ class GoalOptimizer:
                 ensure_full_cache, refresh_float_aggregates)
             from cruise_control_tpu.analyzer.goals import base as goals_base
             cache = refresh_float_aggregates(state, cache)
+            entry = goals[i].violated_brokers(state, ctx, cache).sum(
+                dtype=jnp.int32)
             sink: List = []
             goals_base.set_round_sink(sink)
             try:
@@ -507,7 +533,7 @@ class GoalOptimizer:
                 goals_base.set_round_sink(None)
             rounds = sum(sink) if sink else jnp.zeros((), jnp.int32)
             cache = ensure_full_cache(state, ctx, cache)
-            return state, cache, jnp.stack([rounds])
+            return state, cache, jnp.stack([rounds]), entry[None]
         return run
 
     def _goal_epilogue_fn(self, i: int):
@@ -552,7 +578,8 @@ class GoalOptimizer:
 
     def warmup(self, state: ClusterState, topology,
                options: Optional[OptimizationOptions] = None,
-               max_workers: int = 8, attempts: int = 4) -> float:
+               max_workers: int = 8, attempts: int = 4,
+               mesh=None) -> float:
         """AOT-compile every pipeline program for `state`'s shapes, in
         parallel, seeding the persistent compilation cache.
 
@@ -570,8 +597,15 @@ class GoalOptimizer:
         process restarts.  Compile-transport errors are retried per
         program.
 
+        `mesh` (a multi-device jax Mesh, or None) AOT-compiles the
+        MESH-rung programs instead: the state is replica-padded + sharded
+        over the mesh, lowering runs under the solver-mesh table
+        constraints, and the retained executables land under the
+        mesh-suffixed program keys the mesh solve dispatches through.
+
         Returns wall-clock seconds spent."""
         import concurrent.futures
+        import contextlib
         import time as _time
 
         t0 = _time.time()
@@ -582,6 +616,11 @@ class GoalOptimizer:
                         "compiles serve this process only and a restart "
                         "re-pays them")
         options = options or OptimizationOptions()
+        mesh_active = mesh is not None and mesh.size > 1
+        sfx = f"@mesh{mesh.size}" if mesh_active else ""
+        if mesh_active:
+            # idempotent for a caller that already sharded the state
+            state = mesh_mod.shard_state(state, mesh)
         ctx = make_context(state, self.constraint, options, topology)
         seg = max(1, self.pipeline_segment_size)
         # segments take the threaded RoundCache as an input — lower
@@ -602,17 +641,24 @@ class GoalOptimizer:
 
         def compile_one(job):
             key, fn, args = job
+            key = key + sfx
             faults.inject("optimizer.compile")
-            for attempt in range(attempts):
-                try:
-                    return key, self._jit_program(key, fn).lower(
-                        *args).compile()
-                except jax.errors.JaxRuntimeError as exc:
-                    LOG.warning("warmup compile %s attempt %d failed: %s",
-                                key, attempt,
-                                str(exc).splitlines()[0][:120])
-                    _time.sleep(5.0)
-            return key, self._jit_program(key, fn).lower(*args).compile()
+            # solver_mesh is thread-local: each pool thread re-activates
+            # it so the table-plane constraints trace into its program
+            scope = (mesh_mod.solver_mesh(mesh) if mesh_active
+                     else contextlib.nullcontext())
+            with scope:
+                for attempt in range(attempts):
+                    try:
+                        return key, self._jit_program(key, fn).lower(
+                            *args).compile()
+                    except jax.errors.JaxRuntimeError as exc:
+                        LOG.warning("warmup compile %s attempt %d "
+                                    "failed: %s", key, attempt,
+                                    str(exc).splitlines()[0][:120])
+                        _time.sleep(5.0)
+                return key, self._jit_program(key, fn).lower(
+                    *args).compile()
 
         with concurrent.futures.ThreadPoolExecutor(max_workers) as pool:
             for key, compiled in pool.map(compile_one, jobs):
@@ -626,7 +672,8 @@ class GoalOptimizer:
                       _table_slots_override: Optional[int] = None,
                       warm_start: Optional[ClusterState] = None,
                       eager_hard_abort: Optional[bool] = None,
-                      eager_driver: bool = False
+                      eager_driver: bool = False,
+                      mesh=None
                       ) -> OptimizerResult:
         """Run all goals in priority order and diff out proposals
         (reference GoalOptimizer.optimizations :409-480).
@@ -678,10 +725,35 @@ class GoalOptimizer:
         cost seconds over a remote-device transport where every small op is
         an RPC — while keeping each XLA program small enough to compile at
         2K+-broker scale (one program holding every goal overwhelms the
-        compiler)."""
+        compiler).
+
+        `mesh` (a multi-device jax Mesh, or None) is the MESH rung: the
+        model's replica axis is padded to the mesh size and sharded over
+        the 1-D ``('replica',)`` device axis (parallel/mesh.py), every
+        pipeline program is traced under the solver-mesh table
+        constraints (so the hot [B, S] broker tables shard too and XLA
+        inserts the ICI collectives), and the programs live under
+        mesh-suffixed keys so single-chip programs are never disturbed.
+        Proposals, instruments, and the O(1)-fetch discipline are
+        unchanged; `final_state` is un-padded back to the raw replica
+        count so warm starts keep flowing.  ``mesh=None`` (or a 1-device
+        mesh) is byte-identical to the pre-mesh path — no padding, no
+        constraints, no key suffix."""
+        import contextlib
         t_start = time.time()
         eager = (self.eager_hard_abort if eager_hard_abort is None
                  else eager_hard_abort)
+        mesh_active = mesh is not None and mesh.size > 1
+        sfx = f"@mesh{mesh.size}" if mesh_active else ""
+
+        def run_prog(key, fn, *args):
+            # solver-mesh constraints matter at TRACE time only: scoping
+            # the thread-local per program call keeps it exception-safe
+            scope = (mesh_mod.solver_mesh(mesh) if mesh_active
+                     else contextlib.nullcontext())
+            with scope:
+                return self._run(key + sfx, fn, *args)
+
         profile = self.profile_segments or profiling.enabled()
         prof = profiling.ensure_active() if profile else None
         with jax.transfer_guard_device_to_host("allow"):
@@ -689,10 +761,21 @@ class GoalOptimizer:
             # warm-start validation read the model from host BEFORE the
             # first goal program is dispatched
             options = options or OptimizationOptions()
+            num_raw_replicas = state.num_replicas
+            if mesh_active:
+                faults.inject("optimizer.mesh")
+                # pad the replica axis to the mesh size and place every
+                # array with its production sharding; the warm seed pads
+                # identically (dead rows match dead rows, so the
+                # transplant below stays row-aligned)
+                state = mesh_mod.shard_state(state, mesh)
+                if warm_start is not None:
+                    warm_start = mesh_mod.shard_state(warm_start, mesh)
             if self._auto_warmup:
                 with self._warmup_lock:
                     if not self._aot:
-                        warm_s = self.warmup(state, topology, options)
+                        warm_s = self.warmup(state, topology, options,
+                                             mesh=mesh)
                         LOG.info("auto-warmup compiled the pipeline in "
                                  "%.1fs", warm_s)
             ctx = make_context(state, self.constraint, options, topology)
@@ -741,7 +824,7 @@ class GoalOptimizer:
 
         t0 = time.time()
         (stats0_dev, vb_dev, state, cache, still_dev, maxc_dev, broken_dev,
-         pre_rounds_dev, invalid_dev) = self._run(
+         pre_rounds_dev, invalid_dev) = run_prog(
             "__pre__", self._pre_fn(), initial, state, ctx)
         if prof is not None:
             jax.block_until_ready(state.replica_broker)
@@ -753,6 +836,7 @@ class GoalOptimizer:
         own_parts = []
         rounds_parts = []
         regr_parts = []
+        entry_parts = []
 
         def eager_check(hard_dev, goals_window, own_dev):
             # opt-in per-segment abort sync (see eager_hard_abort)
@@ -778,7 +862,7 @@ class GoalOptimizer:
                 # (sched/runtime.py; no-op outside a preemptible job)
                 segment_checkpoint()
                 t_seg = time.time()
-                state, cache, rounds_g = self._run(
+                state, cache, rounds_g, entry_g = run_prog(
                     f"__goal_{i}_rounds__", self._goal_rounds_fn(i),
                     state, cache, ctx)
                 if prof is not None:
@@ -787,7 +871,7 @@ class GoalOptimizer:
                                 profiling.category_for_goal(g.name),
                                 time.time() - t_seg)
                 t_epi = time.time()
-                prev_stats, (stacked_g, own_g, regr_g, hard_g) = self._run(
+                prev_stats, (stacked_g, own_g, regr_g, hard_g) = run_prog(
                     f"__goal_{i}_epi__", self._goal_epilogue_fn(i),
                     state, cache, prev_stats, ctx)
                 if prof is not None:
@@ -798,6 +882,7 @@ class GoalOptimizer:
                 own_parts.append(own_g)
                 rounds_parts.append(rounds_g)
                 regr_parts.append(regr_g)
+                entry_parts.append(entry_g)
                 if eager:
                     eager_check(hard_g, [g], own_g)
         else:
@@ -807,7 +892,7 @@ class GoalOptimizer:
                 stop = min(start + seg, len(self.goals))
                 (state, cache, prev_stats,
                  (stacked_seg, own_seg, rounds_seg, regr_seg,
-                  hard_seg)) = self._run(
+                  hard_seg, entry_seg)) = run_prog(
                     f"__seg_{start}_{stop}__",
                     self._segment_fn(start, stop), state, cache,
                     prev_stats, ctx)
@@ -815,10 +900,11 @@ class GoalOptimizer:
                 own_parts.append(own_seg)
                 rounds_parts.append(rounds_seg)
                 regr_parts.append(regr_seg)
+                entry_parts.append(entry_seg)
                 if eager:
                     eager_check(hard_seg, self.goals[start:stop], own_seg)
         t_post = time.time()
-        va_dev = self._run("__post__", self._post_fn(), state, cache, ctx)
+        va_dev = run_prog("__post__", self._post_fn(), state, cache, ctx)
         if prof is not None:
             jax.block_until_ready(va_dev)
             prof.record("post violation sweep", "stats",
@@ -831,12 +917,12 @@ class GoalOptimizer:
             # one device_get.  The allow block also covers the host tail
             # (diff/sanity/result), which reads device arrays only AFTER
             # this fetch has drained the pipeline.
-            (stats_before, stacked_h, own_h, rounds_h, regr_h, vb_h, va_h,
-             still_offline, broken, max_count,
+            (stats_before, stacked_h, own_h, rounds_h, regr_h, entry_h,
+             vb_h, va_h, still_offline, broken, max_count,
              pre_rounds, invalid_inp) = jax.device_get(
                 (stats0_dev, stacked_parts, own_parts, rounds_parts,
-                 regr_parts, vb_dev, va_dev, still_dev, broken_dev,
-                 maxc_dev, pre_rounds_dev, invalid_dev))
+                 regr_parts, entry_parts, vb_dev, va_dev, still_dev,
+                 broken_dev, maxc_dev, pre_rounds_dev, invalid_dev))
             if prof is not None:
                 prof.record("instrument fetch", "transfer",
                             time.time() - t_host)
@@ -871,12 +957,23 @@ class GoalOptimizer:
                     "broker table width %d; re-running with width %d "
                     "(programs recompile for the new static width)",
                     int(max_count), ctx.table_slots, new_slots)
+                if mesh_active:
+                    # un-pad before recursing: the re-run must capture
+                    # the RAW replica count as its num_raw_replicas, or
+                    # its final_state keeps the padding rows and the
+                    # warm-start compatibility check rejects the seed
+                    initial = mesh_mod.unpad_replica_axis(
+                        initial, num_raw_replicas)
+                    if warm_start is not None:
+                        warm_start = mesh_mod.unpad_replica_axis(
+                            warm_start, num_raw_replicas)
                 return self.optimizations(initial, topology, options,
                                           check_sanity=check_sanity,
                                           _table_slots_override=new_slots,
                                           warm_start=warm_start,
                                           eager_hard_abort=eager,
-                                          eager_driver=eager_driver)
+                                          eager_driver=eager_driver,
+                                          mesh=mesh)
             stacked_h = (jax.tree.map(
                 lambda *xs: np.concatenate(xs), *stacked_h)
                 if stacked_h else None)
@@ -886,6 +983,8 @@ class GoalOptimizer:
                         else np.zeros(0, np.int32))
             regr_h = (np.concatenate(regr_h) if regr_h
                       else np.zeros(0, bool))
+            entry_h = (np.concatenate(entry_h) if entry_h
+                       else np.zeros(0, np.int32))
 
             if int(still_offline):
                 raise OptimizationFailure(
@@ -900,6 +999,8 @@ class GoalOptimizer:
             violated_counts = {g.name: (int(b), int(o), int(a))
                                for g, b, o, a
                                in zip(self.goals, vb_h, own_h, va_h)}
+            entry_counts = {g.name: int(e)
+                            for g, e in zip(self.goals, entry_h)}
             rounds_by_goal = {g.name: int(r)
                               for g, r in zip(self.goals, rounds_h)}
             if int(pre_rounds):
@@ -957,8 +1058,14 @@ class GoalOptimizer:
             stats_after = (stats_by_goal[self.goals[-1].name]
                            if self.goals
                            else jax.device_get(
-                               self._run("__stats__", compute_stats,
-                                         state)))
+                               run_prog("__stats__", compute_stats,
+                                        state)))
+            if mesh_active:
+                # drop the mesh-padding rows so the final state matches
+                # the raw model's shapes again (warm-start seeds must
+                # transplant row-for-row onto the next raw model)
+                state = mesh_mod.unpad_replica_axis(state,
+                                                    num_raw_replicas)
             result = OptimizerResult(
                 proposals=proposals,
                 stats_before=stats_before,
@@ -971,6 +1078,8 @@ class GoalOptimizer:
                 duration_s=time.time() - t_start,
                 violated_broker_counts=violated_counts,
                 rounds_by_goal=rounds_by_goal,
+                mesh_devices=mesh.size if mesh_active else 1,
+                entry_broker_counts=entry_counts,
             )
             result.hard_goal_names = frozenset(
                 g.name for g in self.goals if g.is_hard)
@@ -1012,8 +1121,11 @@ class GoalOptimizer:
         on CPU (unsupported there; avoids a warning per compile)."""
         faults.inject("optimizer.compile")
         donate = ()
+        # suffix-tolerant predicates: mesh-rung programs carry an
+        # "@mesh<N>" key suffix (separate trace: the solver-mesh table
+        # constraints only exist in the mesh programs)
         if (key.startswith("__seg_")
-                or (key.startswith("__goal_") and key.endswith("_rounds__"))):
+                or (key.startswith("__goal_") and "_rounds__" in key)):
             if jax.default_backend() != "cpu":
                 donate = (0, 1)
         return jax.jit(fn, donate_argnums=donate)

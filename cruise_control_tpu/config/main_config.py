@@ -303,6 +303,25 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
              "Per-class deadline budget (same class order): the queue "
              "wait that earns one full priority class of aging credit "
              "(scaled by the class weight).")
+    d.define("mesh.enabled", Type.STRING, "auto", None, _M,
+             "Solve-mesh switch: 'auto' (default) runs the production "
+             "solve over ALL visible accelerator devices on a 1-D "
+             "('replica',) mesh when more than one non-CPU device is "
+             "visible (v5e-8: broker tables and replica tensors shard, "
+             "XLA inserts the ICI collectives); 'true' forces the mesh "
+             "on whenever >1 device is visible (including the virtual "
+             "multi-CPU test rig); 'false' pins single-chip solving.  "
+             "With one device (or off) the solver runs the exact "
+             "pre-mesh single-chip path — byte-identical, no padding, "
+             "no resharding.  The scheduler's dispatch thread owns the "
+             "mesh token; the degradation ladder gains a MESH rung "
+             "above FUSED that descends to single-chip on "
+             "collective/runtime failures (docs/MESH.md).")
+    d.define("mesh.max.devices", Type.INT, 0, in_range(min_value=0), _L,
+             "Clip the solve mesh to the first N visible devices "
+             "(0 = use all).  Useful to reserve chips for other work or "
+             "to A/B mesh scaling (BENCH_CONFIG=mesh automates the "
+             "sweep).")
     d.define("fleet.bucket.floor", Type.INT, 8, in_range(min_value=1), _M,
              "Smallest shape-bucket edge for fleet serving "
              "(fleet/buckets.py): every tenant's model pads each axis "

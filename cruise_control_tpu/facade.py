@@ -212,6 +212,8 @@ class CruiseControl:
                  scheduler_class_queue_caps: Optional[Sequence[int]] = None,
                  scheduler_class_deadline_budgets_s: Optional[
                      Sequence[float]] = None,
+                 mesh_enabled: Optional[bool] = None,
+                 mesh_max_devices: Optional[int] = None,
                  solve_scheduler=None,
                  fleet_binding=None) -> None:
         self._admin = admin
@@ -363,6 +365,24 @@ class CruiseControl:
             balancedness_weights=balancedness_weights,
             time_fn=self._time)
 
+        # solve-mesh token (parallel/mesh.py): the device topology every
+        # solve of this facade runs through.  An OWNED scheduler gets a
+        # token built from the visible devices (mesh.enabled=auto turns
+        # the mesh on only for non-CPU backends — >1 CPU device means
+        # the virtual test rig, where the single-chip byte-identical
+        # pins must hold unless a test forces mesh_enabled=True); a
+        # SHARED (fleet) scheduler brings its own token, which governs
+        # every tenant.  A degenerate (1-device) token keeps the exact
+        # pre-mesh code path everywhere.
+        from cruise_control_tpu.parallel.mesh import (MeshToken,
+                                                      runtime_mesh)
+        if solve_scheduler is not None:
+            self._mesh_token = (getattr(solve_scheduler, "mesh_token",
+                                        None) or MeshToken(None))
+        else:
+            self._mesh_token = runtime_mesh(enabled=mesh_enabled,
+                                            max_devices=mesh_max_devices)
+
         self._solver_degradation_enabled = solver_degradation_enabled
         self._solver_max_retries_per_rung = max(0,
                                                 solver_max_retries_per_rung)
@@ -372,7 +392,20 @@ class CruiseControl:
         self.solver_breaker = CircuitBreaker(
             failure_threshold=solver_breaker_failure_threshold,
             cooldown_s=solver_breaker_cooldown_s, time_fn=self._time)
-        self.solver_ladder = DegradationLadder(self.solver_breaker)
+        #: the ladder tops out at MESH (whole-mesh fused pipeline) when
+        #: the token spans >1 chip; single-chip ladders are exactly the
+        #: pre-mesh FUSED→EAGER→CPU ladder
+        self._solver_top_rung = (SolverRung.MESH
+                                 if self._mesh_token.is_multichip
+                                 else SolverRung.FUSED)
+        self.solver_ladder = DegradationLadder(
+            self.solver_breaker, top_rung=self._solver_top_rung)
+        #: goals whose after-own violated-broker count exceeded their
+        #: before count in the LAST completed solve (the
+        #: goal-self-regressions sensor: a goal's own pass must never
+        #: worsen the statistic it owns — BENCH_r04/r05 caught
+        #: LeaderBytesInDistributionGoal doing exactly that silently)
+        self._goal_self_regressions: List[str] = []
 
         # device-time solve scheduler (sched/): the SINGLE GATEWAY for
         # every solve in the process — request-path, precompute,
@@ -392,7 +425,8 @@ class CruiseControl:
                 queue_caps=scheduler_class_queue_caps,
                 deadline_budgets_s=scheduler_class_deadline_budgets_s,
                 preemption_enabled=scheduler_preemption_enabled),
-            enabled=scheduler_enabled, time_fn=self._time)
+            enabled=scheduler_enabled, mesh_token=self._mesh_token,
+            time_fn=self._time)
         #: fleet tenancy (fleet/registry.FleetBinding): identifies this
         #: facade's tenant, pads every solve's model to the fleet shape
         #: bucket, and offers compatible solves to the cross-tenant
@@ -414,6 +448,11 @@ class CruiseControl:
             lambda: self.goal_violation_detector.last_balancedness_score)
         self.metrics.gauge("solver-rung",
                            lambda: int(self.solver_ladder.rung))
+        self.metrics.gauge("mesh-devices",
+                           lambda: float(self._mesh_token.size))
+        self.metrics.gauge(
+            "goal-self-regressions",
+            lambda: float(len(self._goal_self_regressions)))
         self.metrics.gauge(
             "solver-breaker-open",
             lambda: 0.0 if self.solver_breaker.cooldown_remaining_s() == 0.0
@@ -885,7 +924,7 @@ class CruiseControl:
             commit=commit,
             fused_ok=lambda: (not self._solver_degradation_enabled
                               or self.solver_ladder.entry_rung()
-                              is SolverRung.FUSED))
+                              <= SolverRung.FUSED))
         fold_key = ("fleet-solve", goal_key,
                     _options_fingerprint(options),
                     allow_capacity_estimation)
@@ -982,6 +1021,19 @@ class CruiseControl:
         gen_options = self._options_generator.generate(
             options or OptimizationOptions(), topo)
         with self.metrics.timer("proposal-computation-timer").time():
+            if rung is SolverRung.MESH:
+                # the whole-mesh fused pipeline: the dispatch thread's
+                # mesh token governs (it OWNS the mesh the way it owns
+                # the device); outside a scheduled job — inline solves,
+                # disabled scheduler — the facade's own token applies.
+                # A degenerate token falls through to the single-chip
+                # fused path inside optimizations (mesh=None).
+                token = (sched_runtime.current_mesh_token()
+                         or self._mesh_token)
+                return optimizer.optimizations(
+                    state, topo, gen_options, warm_start=warm,
+                    eager_hard_abort=eager_hard_abort,
+                    mesh=token.mesh)
             if rung is SolverRung.FUSED:
                 return optimizer.optimizations(
                     state, topo, gen_options, warm_start=warm,
@@ -1016,10 +1068,12 @@ class CruiseControl:
         (scheduler control flow — the dispatch loop re-queues the job)
         all propagate immediately."""
         if not self._solver_degradation_enabled:
-            return self._solve_on_rung(SolverRung.FUSED, optimizer,
-                                       cacheable, options,
-                                       allow_capacity_estimation,
-                                       eager_hard_abort)
+            result = self._solve_on_rung(self._solver_top_rung, optimizer,
+                                         cacheable, options,
+                                         allow_capacity_estimation,
+                                         eager_hard_abort)
+            self._note_goal_self_regressions(result)
+            return result
         rung = self.solver_ladder.entry_rung()
         delays = self._solver_backoff.delays()
         attempts_on_rung = 0
@@ -1066,9 +1120,33 @@ class CruiseControl:
                 attempts_on_rung = 0
                 continue
             self.solver_ladder.on_success(rung)
-            if rung is not SolverRung.FUSED:
+            if rung > self._solver_top_rung:
                 LOG.info("solve served from degraded rung %s", rung.name)
+            self._note_goal_self_regressions(result)
             return result
+
+    def _note_goal_self_regressions(self, result) -> None:
+        """Track goals whose OWN pass worsened their violated-broker
+        count (after-own > at-own-entry): the goal-self-regressions
+        sensor — the bench fails loudly on it instead of the silent
+        drift BENCH_r04/r05 showed for LeaderBytesInDistributionGoal.
+        Entry counts (when the result carries them) separate true
+        self-regression from an earlier goal's interference; results
+        without them (CPU-rung fallback) compare against `before`."""
+        counts = getattr(result, "violated_broker_counts", None) or {}
+        entries = getattr(result, "entry_broker_counts", None) or {}
+        regressions = [g for g, (b, own, _a) in counts.items()
+                       if own > entries.get(g, b)]
+        if regressions:
+            self.metrics.meter("goal-self-regression-events").mark(
+                len(regressions))
+            LOG.warning("goal self-regression: %s worsened their own "
+                        "violated-broker counts (at-entry -> after-own: "
+                        "%s)",
+                        ", ".join(regressions),
+                        {g: (entries.get(g, counts[g][0]), counts[g][1])
+                         for g in regressions})
+        self._goal_self_regressions = regressions
 
     def _report_solver_degraded(self, from_rung: SolverRung,
                                 to_rung: Optional[SolverRung],
@@ -1500,7 +1578,9 @@ class CruiseControl:
                 "solverDegradation": {
                     **self.solver_ladder.to_json(),
                     "precomputeWedged": self.precompute_wedged(),
+                    "meshDevices": self._mesh_token.size,
                 },
+                "goalSelfRegressions": list(self._goal_self_regressions),
             }
         if "anomaly_detector" in want:
             out["AnomalyDetectorState"] = self.anomaly_detector.to_json()

@@ -289,6 +289,7 @@ class FleetRouter:
             final_state=None,
             duration_s=duration_s,
             violated_broker_counts=dict(outcome.violated_broker_counts),
+            entry_broker_counts=dict(outcome.entry_broker_counts),
             rounds_by_goal=dict(outcome.rounds_by_goal),
             hard_goal_names=frozenset(g.name for g in goals
                                       if g.is_hard),

@@ -167,6 +167,19 @@ def _slow_broker_config(config: CruiseControlConfig):
             "self.healing.slow.broker.removal.enabled"))
 
 
+def _mesh_enabled_of(config) -> Optional[bool]:
+    """mesh.enabled: 'auto' -> None (the facade enables the mesh only on
+    non-CPU multi-device backends), 'true'/'false' -> forced."""
+    raw = str(config.get("mesh.enabled") or "auto").strip().lower()
+    if raw in ("auto", ""):
+        return None
+    if raw in ("true", "1", "yes", "on"):
+        return True
+    if raw in ("false", "0", "no", "off"):
+        return False
+    raise ValueError(f"mesh.enabled must be auto/true/false, got {raw!r}")
+
+
 def build_cruise_control(config: CruiseControlConfig, admin,
                          sampler: Optional[MetricSampler] = None,
                          solve_scheduler=None,
@@ -325,6 +338,8 @@ def build_cruise_control(config: CruiseControlConfig, admin,
         scheduler_class_deadline_budgets_s=[
             float(x) / 1e3 for x in config.get_list(
                 "scheduler.class.deadline.budget.ms") if str(x).strip()],
+        mesh_enabled=_mesh_enabled_of(config),
+        mesh_max_devices=(config.get_int("mesh.max.devices") or None),
         solve_scheduler=solve_scheduler,
         fleet_binding=fleet_binding,
         monitor_kwargs=dict(
@@ -466,7 +481,11 @@ def build_fleet(config: CruiseControlConfig, fleet_config_path: str):
 
     # ONE scheduler for the whole fleet (the PR-4 gateway), policy from
     # the BASE config — per-tenant scheduler.* overrides are ignored by
-    # design: admission/priority over the one device is fleet policy
+    # design: admission/priority over the one device is fleet policy.
+    # The shared scheduler also owns the ONE fleet-wide mesh token
+    # (mesh.* from the base config): every tenant's solves run over the
+    # same device mesh.
+    from cruise_control_tpu.parallel.mesh import runtime_mesh
     scheduler = DeviceTimeScheduler(
         SchedulerPolicy.from_lists(
             weights=[float(x) for x in config.get_list(
@@ -477,7 +496,10 @@ def build_fleet(config: CruiseControlConfig, fleet_config_path: str):
                 "scheduler.class.deadline.budget.ms") if str(x).strip()],
             preemption_enabled=config.get_boolean(
                 "scheduler.preemption.enabled")),
-        enabled=config.get_boolean("scheduler.enabled"))
+        enabled=config.get_boolean("scheduler.enabled"),
+        mesh_token=runtime_mesh(
+            enabled=_mesh_enabled_of(config),
+            max_devices=(config.get_int("mesh.max.devices") or None)))
     registry = FleetRegistry(
         scheduler,
         bucket_floor=config.get_int("fleet.bucket.floor"),

@@ -95,6 +95,74 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (REPLICA_AXIS,))
 
 
+class MeshToken:
+    """First-class runtime handle to the solve mesh.
+
+    The PR-4 dispatch thread owns ONE of these instead of a bare device
+    token: every scheduled solve runs with the token in scope
+    (sched/runtime.mesh_token_scope), so the whole stack — the fused
+    goal pipeline, the scenario engine's lane batching, the fleet fold —
+    resolves its device topology through the scheduler rather than
+    acquiring devices ad hoc (the mesh half of the single-gateway rule,
+    tools/lint.py).
+
+    `mesh is None` is the DEGENERATE single-chip token: every consumer
+    must treat it exactly like the pre-mesh code path (no padding, no
+    sharding constraints, no program-key suffix), which is what keeps
+    the mesh=1 case byte-identical to the single-device pin — the same
+    trick as the scheduler's K=1 inline pin."""
+
+    __slots__ = ("mesh",)
+
+    def __init__(self, mesh: Optional[Mesh] = None) -> None:
+        self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
+
+    @property
+    def size(self) -> int:
+        return 1 if self.mesh is None else self.mesh.size
+
+    @property
+    def is_multichip(self) -> bool:
+        return self.mesh is not None
+
+    def to_json(self) -> dict:
+        return {
+            "devices": self.size,
+            "axis": REPLICA_AXIS if self.mesh is not None else None,
+            "platform": (self.mesh.devices.flat[0].platform
+                         if self.mesh is not None else None),
+        }
+
+
+def runtime_mesh(enabled: Optional[bool] = None,
+                 max_devices: Optional[int] = None,
+                 devices=None) -> MeshToken:
+    """Build the process's solve-mesh token.
+
+    `enabled=None` (the config default, mesh.enabled=auto) activates the
+    mesh only on non-CPU backends: >1 "CPU devices" in this codebase
+    means the virtual 8-device host-platform test rig
+    (testing/virtual_mesh.py), where the single-chip byte-identical pins
+    must keep running on the degenerate token unless a test FORCES the
+    mesh on (mesh_enabled=True).  On real multi-chip hardware (v5e-8)
+    auto resolves to enabled.
+
+    Degenerates to a single-chip token (mesh=None) whenever 0/1 devices
+    remain after the `max_devices` clip — single-chip stays the exact
+    pre-mesh code path."""
+    if enabled is False:
+        return MeshToken(None)
+    devices = list(devices if devices is not None else jax.devices())
+    if enabled is None and (not devices
+                            or devices[0].platform == "cpu"):
+        return MeshToken(None)
+    if max_devices is not None and max_devices > 0:
+        devices = devices[:max_devices]
+    if len(devices) <= 1:
+        return MeshToken(None)
+    return MeshToken(make_mesh(devices))
+
+
 def _pad_to_multiple(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
@@ -250,6 +318,18 @@ def state_shardings(state: ClusterState, mesh: Mesh) -> ClusterState:
         num_hosts=state.num_hosts,
         num_topics=state.num_topics,
     )
+
+
+def unpad_replica_axis(state: ClusterState, target: int) -> ClusterState:
+    """Drop mesh-padding rows so the replica axis is exactly `target`
+    rows again (the inverse of pad_state for a solve's FINAL state: a
+    warm-start seed must match the raw model's shapes, and padded rows
+    are dead by construction so slicing them off loses nothing).  The
+    slices are lazy device ops — nothing is fetched here."""
+    if state.num_replicas <= target:
+        return state
+    return state.replace(**{f: getattr(state, f)[:target]
+                            for f in REPLICA_AXIS_FIELDS})
 
 
 def shard_state(state: ClusterState, mesh: Optional[Mesh] = None

@@ -57,6 +57,7 @@ from cruise_control_tpu.scenario.compiler import (CompiledBatch,
                                                   compile_batch, materialize)
 from cruise_control_tpu.scenario.spec import ScenarioSpec
 from cruise_control_tpu.sched.runtime import (SolvePreempted,
+                                              current_mesh_token,
                                               segment_checkpoint)
 from cruise_control_tpu.utils import faults
 
@@ -97,6 +98,10 @@ class ScenarioOutcome:
         default_factory=list)
     violated_broker_counts: Dict[str, Tuple[int, int, int]] = \
         dataclasses.field(default_factory=dict)
+    #: per-goal violated count at the goal's own entry (see
+    #: OptimizerResult.entry_broker_counts)
+    entry_broker_counts: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
     rounds_by_goal: Dict[str, int] = dataclasses.field(default_factory=dict)
     stats_before: Optional[object] = None  #: host ClusterModelStats
     stats_after: Optional[object] = None
@@ -434,6 +439,7 @@ class ScenarioEngine:
             violated_goals_before=list(res.violated_goals_before),
             violated_goals_after=list(res.violated_goals_after),
             violated_broker_counts=dict(res.violated_broker_counts),
+            entry_broker_counts=dict(res.entry_broker_counts),
             rounds_by_goal=dict(res.rounds_by_goal),
             stats_before=res.stats_before, stats_after=res.stats_after,
             balancedness=res.balancedness_score(),
@@ -494,11 +500,35 @@ class ScenarioEngine:
             # sanctioned pre-dispatch host region (host-side variant
             # assembly reads the base model's device arrays)
             stacked_state, stacked_ctx = batch.stack()
+        # spare mesh capacity as a SECOND batching axis: when the
+        # dispatch thread holds a multi-chip mesh token (the scheduler
+        # owns the mesh, sched/runtime), the leading scenario/lane axis
+        # shards across the chips — K lanes x N devices, each lane's
+        # solve running whole on its chip(s), zero cross-lane
+        # collectives.  device_put needs the lane dim divisible by the
+        # shard count, so K pads up with copies of lane 0 (ignored on
+        # the way back out — every consumer below indexes i < K); the
+        # padded duplicates cost less than leaving chips idle would.
+        # Without a token (or K=1) nothing changes: the single-chip
+        # vmapped path stays bit-identical.
+        token = current_mesh_token()
+        mesh_k = 0
+        lane_pad = 0
+        if (token is not None
+                and getattr(token, "is_multichip", False) and k >= 2):
+            mesh_k = min(k, token.size)
+            lane_pad = -(-k // mesh_k) * mesh_k - k
+            if lane_pad:
+                stacked_state, stacked_ctx = _pad_lane_axis(
+                    k, lane_pad, stacked_state, stacked_ctx)
+            stacked_state, stacked_ctx = _shard_lane_axis(
+                token.mesh, k + lane_pad, mesh_k,
+                stacked_state, stacked_ctx)
         initial = stacked_state
         ctx0 = batch.contexts[0]
         shapes = (k, initial.replica_valid.shape[1], batch.num_brokers,
                   ctx0.table_slots, ctx0.rf_max, initial.num_racks,
-                  initial.num_hosts)
+                  initial.num_hosts, mesh_k, lane_pad)
 
         faults.inject("scenario.execute")
         (stats0_dev, vb_dev, state, cache, still_dev, maxc_dev,
@@ -508,6 +538,7 @@ class ScenarioEngine:
         seg = max(1, optimizer.pipeline_segment_size)
         prev_stats = stats0_dev
         stacked_parts, own_parts, rounds_parts, regr_parts = [], [], [], []
+        entry_parts = []
         for start in range(0, len(optimizer.goals), seg):
             # scheduler preemption checkpoint: a queued ANOMALY_HEAL /
             # USER_INTERACTIVE solve takes the device at the next
@@ -515,7 +546,8 @@ class ScenarioEngine:
             segment_checkpoint()
             stop = min(start + seg, len(optimizer.goals))
             (state, cache, prev_stats,
-             (stacked_seg, own_seg, rounds_seg, regr_seg, _hard)) = \
+             (stacked_seg, own_seg, rounds_seg, regr_seg, _hard,
+              entry_seg)) = \
                 self._run(optimizer, f"__seg_{start}_{stop}__",
                           optimizer._segment_fn(start, stop), shapes,
                           (0, 1), state, cache, prev_stats, stacked_ctx)
@@ -523,6 +555,7 @@ class ScenarioEngine:
             own_parts.append(own_seg)
             rounds_parts.append(rounds_seg)
             regr_parts.append(regr_seg)
+            entry_parts.append(entry_seg)
         va_dev = self._run(optimizer, "__post__", optimizer._post_fn(),
                            shapes, (), state, cache, stacked_ctx)
         moves_dev = self._run(optimizer, "__moves__", _movement_metrics,
@@ -533,12 +566,13 @@ class ScenarioEngine:
         with jax.transfer_guard_device_to_host("allow"):
             # fetch 1/2: every instrument of the whole batch in ONE
             # device_get — [K]- and [K, G]-shaped tables
-            (stats0_h, stacked_h, own_h, rounds_h, regr_h, vb_h, va_h,
-             still_h, maxc_h, broken_h, pre_rounds_h, invalid_h,
-             moves_h) = jax.device_get(
+            (stats0_h, stacked_h, own_h, rounds_h, regr_h, entry_h,
+             vb_h, va_h, still_h, maxc_h, broken_h, pre_rounds_h,
+             invalid_h, moves_h) = jax.device_get(
                 (stats0_dev, stacked_parts, own_parts, rounds_parts,
-                 regr_parts, vb_dev, va_dev, still_dev, maxc_dev,
-                 broken_dev, pre_rounds_dev, invalid_dev, moves_dev))
+                 regr_parts, entry_parts, vb_dev, va_dev, still_dev,
+                 maxc_dev, broken_dev, pre_rounds_dev, invalid_dev,
+                 moves_dev))
             slots = ctx0.table_slots
             max_count = int(np.max(maxc_h)) if k else 0
             if slots and max_count > slots:
@@ -579,6 +613,8 @@ class ScenarioEngine:
 
         own_all = np.concatenate(own_h, axis=1) if own_h else \
             np.zeros((k, 0), np.int32)
+        entry_all = np.concatenate(entry_h, axis=1) if entry_h else \
+            np.zeros((k, 0), np.int32)
         rounds_all = np.concatenate(rounds_h, axis=1) if rounds_h else \
             np.zeros((k, 0), np.int32)
         regr_all = np.concatenate(regr_h, axis=1) if regr_h else \
@@ -594,7 +630,8 @@ class ScenarioEngine:
                 batch, i, goals, traceable,
                 jax.tree.map(lambda x, i=i: x[i], stats0_h),
                 jax.tree.map(lambda x, i=i: x[i], stacked_all),
-                own_all[i], rounds_all[i], regr_all[i], vb_h[i], va_h[i],
+                own_all[i], entry_all[i], rounds_all[i], regr_all[i],
+                vb_h[i], va_h[i],
                 int(still_h[i]), bool(broken_h[i]), int(pre_rounds_h[i]),
                 bool(invalid_h[i]), tuple(m[i] for m in moves_h),
                 include_proposals,
@@ -607,7 +644,7 @@ class ScenarioEngine:
         return outcomes
 
     def _assemble_outcome(self, batch, i, goals, traceable, stats_before,
-                          stats_by_idx, own, rounds, regr, vb, va,
+                          stats_by_idx, own, entry, rounds, regr, vb, va,
                           still_offline, broken, pre_rounds, invalid,
                           moves, include_proposals, placements
                           ) -> ScenarioOutcome:
@@ -619,6 +656,7 @@ class ScenarioEngine:
         violated_after = [g.name for g, v in zip(goals, va) if v]
         counts = {g.name: (int(b), int(o), int(a))
                   for g, b, o, a in zip(goals, vb, own, va)}
+        entry_counts = {g.name: int(e) for g, e in zip(goals, entry)}
         rounds_by_goal = {g.name: int(r) for g, r in zip(goals, rounds)}
         if pre_rounds:
             rounds_by_goal["__prebalance__"] = pre_rounds
@@ -687,6 +725,7 @@ class ScenarioEngine:
             violated_goals_before=violated_before,
             violated_goals_after=violated_after,
             violated_broker_counts=counts,
+            entry_broker_counts=entry_counts,
             rounds_by_goal=rounds_by_goal,
             stats_before=stats_before, stats_after=stats_after,
             stats_by_goal=stats_by_goal,
@@ -733,6 +772,49 @@ class ScenarioEngine:
                 while len(self._programs) > self._max_programs:
                     self._programs.popitem(last=False)
         return entry[0](*args)
+
+
+def _pad_lane_axis(k: int, pad: int, *trees):
+    """Grow every [K, ...] array leaf of `trees` by `pad` duplicate
+    lanes (copies of lane 0) so the lane axis divides the mesh shard
+    count.  Duplicates solve real (lane-0) models, so no NaN/abort
+    garbage can leak into the shared instrument tables; every consumer
+    reads back only lanes < K."""
+    import jax
+    import jax.numpy as jnp
+
+    def place(x):
+        if (getattr(x, "ndim", 0) >= 1
+                and getattr(x, "shape", ())[0] == k):
+            x = jnp.asarray(x)
+            fill = jnp.broadcast_to(x[:1], (pad,) + tuple(x.shape[1:]))
+            return jnp.concatenate([x, fill], axis=0)
+        return x
+    out = tuple(jax.tree.map(place, t) for t in trees)
+    return out if len(out) > 1 else out[0]
+
+
+def _shard_lane_axis(mesh, k: int, n_devices: int, *trees):
+    """device_put every [K, ...] array leaf of `trees` sharded on its
+    leading lane axis over the first `n_devices` mesh devices (K is
+    padded to a multiple of n_devices first — _pad_lane_axis).
+    Non-array leaves and arrays whose leading dim is not the lane axis
+    replicate untouched."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from cruise_control_tpu.parallel.mesh import REPLICA_AXIS, make_mesh
+    sub = (mesh if n_devices == mesh.size
+           else make_mesh(list(mesh.devices.flat)[:n_devices]))
+    lanes = NamedSharding(sub, PartitionSpec(REPLICA_AXIS))
+
+    def place(x):
+        if (getattr(x, "ndim", 0) >= 1
+                and getattr(x, "shape", ())[0] == k):
+            return jax.device_put(x, lanes)
+        return x
+    out = tuple(jax.tree.map(place, t) for t in trees)
+    return out if len(out) > 1 else out[0]
 
 
 def _movement_metrics(initial: ClusterState, final: ClusterState):

@@ -79,6 +79,29 @@ def shielded():
         _TLS.preempt_check = prev
 
 
+@contextlib.contextmanager
+def mesh_token_scope(token):
+    """Put the scheduler's mesh token in scope for the duration of a
+    dispatched (or inline) solve job: the dispatch thread OWNS the mesh
+    the way it owns the device, and the solve paths below the facade
+    (degradation ladder rung selection, scenario lane batching) read it
+    back via `current_mesh_token()` instead of acquiring devices
+    themselves.  The token is opaque to this module (no package
+    dependencies here); `None` is a valid scope meaning single-chip."""
+    prev = getattr(_TLS, "mesh_token", None)
+    _TLS.mesh_token = token
+    try:
+        yield
+    finally:
+        _TLS.mesh_token = prev
+
+
+def current_mesh_token():
+    """The mesh token of the solve job executing on this thread (None
+    outside the gateway or under a scheduler with no mesh)."""
+    return getattr(_TLS, "mesh_token", None)
+
+
 def segment_checkpoint() -> None:
     """Called by the solver between goal segments (and by the scenario
     engine between batched segments): a no-op unless the scheduler

@@ -98,10 +98,19 @@ class DeviceTimeScheduler:
     def __init__(self, policy: Optional[SchedulerPolicy] = None,
                  enabled: bool = True,
                  max_fold: int = 8,
+                 mesh_token=None,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         import time as _time
         self.policy = policy or SchedulerPolicy.default()
         self.enabled = enabled
+        #: the scheduler's device topology (parallel/mesh.MeshToken or
+        #: None = single chip): the dispatch thread owns the WHOLE mesh
+        #: and puts the token in scope around every job it runs, so
+        #: high-priority solves get all chips while batch-shaped work
+        #: (scenario sweeps, fleet folds) uses the same mesh as a second
+        #: batching axis.  Under fleet serving the shared scheduler's
+        #: token governs every tenant.
+        self.mesh_token = mesh_token
         self._max_fold = max(1, max_fold)
         self._time = time_fn or _time.time
         self.queue = AdmissionQueue(self.policy, self._time)
@@ -145,7 +154,8 @@ class DeviceTimeScheduler:
             t0 = self._time()
             failed = True
             try:
-                with runtime.gateway():
+                with runtime.mesh_token_scope(self.mesh_token), \
+                        runtime.gateway():
                     result = job.run()
                 failed = False
                 return result
@@ -224,7 +234,8 @@ class DeviceTimeScheduler:
         t0 = self._time()
         try:
             faults.inject("sched.dispatch")
-            with runtime.gateway(check):
+            with runtime.mesh_token_scope(self.mesh_token), \
+                    runtime.gateway(check):
                 if len(entries) > 1:
                     results = job.fold_run(
                         [e.job.fold_payload for e in entries])
@@ -304,6 +315,9 @@ class DeviceTimeScheduler:
         depths = self.queue.depths()
         return {
             "enabled": self.enabled,
+            "mesh": (self.mesh_token.to_json()
+                     if self.mesh_token is not None
+                     else {"devices": 1, "axis": None, "platform": None}),
             "policy": self.policy.to_json(),
             "queueDepthByClass": {c.name: d for c, d in depths.items()},
             "queueDepth": sum(depths.values()),

@@ -30,7 +30,7 @@ FACADE_TEST_GOALS = ["RackAwareGoal", "DiskCapacityGoal",
 
 def make_stack(num_brokers=4, partitions=12, rf=2, skewed=True,
                notifier=None, assignment_pool=None, auto_warmup=False,
-               goal_names=None):
+               goal_names=None, **cc_kwargs):
     """assignment_pool limits which brokers initially host replicas (e.g.
     a freshly added broker starts empty).
 
@@ -70,7 +70,8 @@ def make_stack(num_brokers=4, partitions=12, rf=2, skewed=True,
                             sampling_interval_ms=5_000),
         executor_kwargs=dict(progress_check_interval_s=1.0),
         auto_warmup=auto_warmup,
-        goal_names=list(goal_names or FACADE_TEST_GOALS))
+        goal_names=list(goal_names or FACADE_TEST_GOALS),
+        **cc_kwargs)
     return sim, cc, clock
 
 

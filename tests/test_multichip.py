@@ -1,0 +1,319 @@
+"""Multichip production-solve tests (`multichip` marker).
+
+PR 6 promotes the mesh from a dryrun artifact (test_parallel.py jits the
+goal chain directly) to a first-class runtime resource: the PRODUCTION
+solve path — GoalOptimizer.optimizations, the facade's degradation
+ladder, the device-time scheduler's mesh token — dispatches over all
+visible devices.  These tests run it on the virtual 8-device CPU rig
+(conftest forces XLA host-platform devices, the same rig the multichip
+dryrun used), so tier CI exercises the mesh path without TPUs:
+
+* mesh=1 vs mesh=8 PROPOSAL EQUALITY at small scale, optimizer-level
+  (with replica padding actually engaged) and facade-level (the
+  acceptance pin: with >1 device the production path dispatches over
+  the mesh AND returns the single-chip proposals);
+* scheduler mesh-token semantics under a FORCED mesh>1 runtime:
+  K=1 scheduled-vs-inline byte-identical, heal-preempts-sweep ordering;
+* the ladder's MESH→FUSED rung: a mesh-path runtime failure descends to
+  the single-chip fused solve without tripping the breaker past FUSED,
+  and the next healthy solve probes back up to MESH.
+
+The DEFAULT test runtime stays single-chip (mesh.enabled=auto treats
+multiple CPU devices as the test rig, not a mesh), so every existing
+byte-identical pin runs unchanged; tests here force `mesh_enabled=True`.
+"""
+import threading
+import time as _real_time
+
+import conftest  # noqa: F401
+
+import jax
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.context import OptimizationOptions
+from cruise_control_tpu.analyzer.degradation import BreakerState, SolverRung
+from cruise_control_tpu.analyzer.goals.registry import default_goals
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.parallel.mesh import MeshToken, make_mesh, runtime_mesh
+from cruise_control_tpu.sched.policy import SchedulerClass
+from cruise_control_tpu.sched.runtime import segment_checkpoint
+from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
+                                                       random_cluster)
+from cruise_control_tpu.utils import faults
+
+from test_facade import feed_samples, make_stack
+
+pytestmark = [
+    pytest.mark.multichip,
+    pytest.mark.skipif(len(jax.devices()) < 8,
+                       reason="needs the 8-device CPU mesh"),
+]
+
+MESH_TEST_GOALS = ["RackAwareGoal", "DiskCapacityGoal",
+                   "DiskUsageDistributionGoal"]
+
+
+def proposal_key(p):
+    return (p.partition.topic, p.partition.partition,
+            tuple(r.broker_id for r in p.old_replicas),
+            tuple(r.broker_id for r in p.new_replicas))
+
+
+def test_runtime_mesh_token_resolution():
+    """auto on the CPU rig = degenerate single-chip token; forced =
+    all 8 devices; max_devices clips; 1 remaining device degenerates."""
+    assert runtime_mesh(enabled=None).size == 1          # auto on CPU rig
+    assert runtime_mesh(enabled=False).size == 1
+    forced = runtime_mesh(enabled=True)
+    assert forced.size == 8 and forced.is_multichip
+    assert forced.to_json()["axis"] == "replica"
+    assert runtime_mesh(enabled=True, max_devices=4).size == 4
+    assert runtime_mesh(enabled=True, max_devices=1).size == 1
+    assert not MeshToken(None).is_multichip
+
+
+def test_optimizer_mesh1_vs_mesh8_proposal_equality():
+    """The PRODUCTION pipeline (optimizations(): pre program, fused
+    segments, post sweep, diff) over the 8-device mesh returns the exact
+    single-chip proposals — with a replica count that does NOT divide
+    the mesh, so the dead-row padding path is engaged too."""
+    # 97 partitions x rf3 = 291 replicas -> pads to 296 on 8 devices
+    state, topo = random_cluster(RandomClusterSpec(
+        num_brokers=12, num_partitions=97, replication_factor=3,
+        num_racks=4, num_topics=4, seed=3, skew_fraction=0.3))
+    assert state.num_replicas % 8 != 0
+
+    opt1 = GoalOptimizer(default_goals(max_rounds=8,
+                                       names=MESH_TEST_GOALS))
+    r1 = opt1.optimizations(state, topo, OptimizationOptions())
+    assert r1.mesh_devices == 1
+
+    mesh = make_mesh(jax.devices()[:8])
+    opt8 = GoalOptimizer(default_goals(max_rounds=8,
+                                       names=MESH_TEST_GOALS))
+    r8 = opt8.optimizations(state, topo, OptimizationOptions(),
+                            mesh=mesh)
+    assert r8.mesh_devices == 8
+    assert sorted(map(proposal_key, r1.proposals)) == \
+        sorted(map(proposal_key, r8.proposals))
+    # final state un-padded back to the raw replica count (warm-start
+    # seeds must transplant row-for-row onto the next raw model)
+    assert r8.final_state.num_replicas == state.num_replicas
+    np.testing.assert_array_equal(
+        np.asarray(r1.final_state.replica_broker),
+        np.asarray(r8.final_state.replica_broker))
+    np.testing.assert_array_equal(
+        np.asarray(r1.final_state.replica_is_leader),
+        np.asarray(r8.final_state.replica_is_leader))
+
+
+def test_facade_forced_mesh_dispatches_over_mesh_same_proposals():
+    """The ACCEPTANCE pin: with >1 device visible and the mesh forced
+    on, the production solve path (facade -> scheduler -> ladder ->
+    optimizer) dispatches over the mesh — result.mesh_devices spans all
+    8 devices, the ladder rests at MESH, the scheduler reports the mesh
+    token — and the proposals equal the default single-chip stack's."""
+    sim1, cc1, clock1 = make_stack()
+    sim8, cc8, clock8 = make_stack(mesh_enabled=True)
+    try:
+        for cc, clock in ((cc1, clock1), (cc8, clock8)):
+            cc.start_up(do_sampling=False, start_detection=False)
+            feed_samples(cc, clock)
+        assert cc1._mesh_token.size == 1        # auto: CPU rig stays 1
+        assert cc8._mesh_token.size == 8
+        assert cc8._solver_top_rung is SolverRung.MESH
+        r1 = cc1.optimizations()
+        r8 = cc8.optimizations()
+        assert r1.mesh_devices == 1
+        assert r8.mesh_devices == 8             # sharded execution
+        assert cc8.solver_ladder.rung is SolverRung.MESH
+        assert cc8.solve_scheduler.to_json()["mesh"]["devices"] == 8
+        assert cc8.state(("analyzer",))["AnalyzerState"][
+            "solverDegradation"]["meshDevices"] == 8
+        assert sorted(map(proposal_key, r1.proposals)) == \
+            sorted(map(proposal_key, r8.proposals))
+        np.testing.assert_array_equal(
+            np.asarray(r1.final_state.replica_broker),
+            np.asarray(r8.final_state.replica_broker))
+    finally:
+        cc1.shutdown()
+        cc8.shutdown()
+
+
+def test_k1_scheduled_vs_inline_byte_identical_under_mesh():
+    """The K=1 scheduled-vs-inline pin re-run under a FORCED mesh>1
+    runtime: the dispatch thread's mesh token and the inline path's
+    facade token must produce byte-identical results."""
+    sim1, cc1, clock1 = make_stack(mesh_enabled=True)
+    sim2, cc2, clock2 = make_stack(mesh_enabled=True)
+    cc2.solve_scheduler.enabled = False
+    try:
+        for cc, clock in ((cc1, clock1), (cc2, clock2)):
+            cc.start_up(do_sampling=False, start_detection=False)
+            feed_samples(cc, clock)
+        r1 = cc1.optimizations()
+        r2 = cc2.optimizations()
+        assert r1.mesh_devices == r2.mesh_devices == 8
+        assert sorted(map(proposal_key, r1.proposals)) == \
+            sorted(map(proposal_key, r2.proposals))
+        np.testing.assert_array_equal(
+            np.asarray(r1.final_state.replica_broker),
+            np.asarray(r2.final_state.replica_broker))
+        np.testing.assert_array_equal(
+            np.asarray(r1.final_state.replica_is_leader),
+            np.asarray(r2.final_state.replica_is_leader))
+    finally:
+        cc1.shutdown()
+        cc2.shutdown()
+
+
+def test_mesh_ladder_descends_to_fused_without_breaker_trip():
+    """A collective/runtime failure on the mesh path descends MESH →
+    FUSED (single-chip fused solve serves the request) WITHOUT tripping
+    the breaker past FUSED; once the mesh heals, the next solve probes
+    one rung up and service returns to MESH."""
+    sim, cc, clock = make_stack(mesh_enabled=True)
+    try:
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        cc._sleep = lambda s: None          # skip retry backoff sleeps
+
+        plan = faults.FaultPlan()
+        plan.fail_always("optimizer.mesh")  # fires ONLY on the mesh path
+        faults.install(plan)
+        try:
+            r = cc.optimizations(ignore_proposal_cache=True)
+        finally:
+            faults.uninstall()
+        assert r.mesh_devices == 1                       # served FUSED
+        assert cc.solver_ladder.rung is SolverRung.FUSED
+        # descent did not cascade: the breaker is not open and nothing
+        # descended past FUSED (EAGER/CPU untouched)
+        assert cc.solver_breaker.state is BreakerState.CLOSED
+        assert cc.solver_ladder.entry_rung() is SolverRung.MESH  # probe
+        r2 = cc.optimizations(ignore_proposal_cache=True)
+        assert r2.mesh_devices == 8                      # recovered
+        assert cc.solver_ladder.rung is SolverRung.MESH
+        assert cc.solver_breaker.consecutive_failures == 0
+    finally:
+        cc.shutdown()
+
+
+@pytest.mark.slow
+def test_heal_preempts_sweep_under_mesh():
+    """Heal-preempts-sweep ordering re-run under a forced mesh>1
+    runtime: an ANOMALY_HEAL submitted while a SCENARIO_SWEEP holds the
+    mesh begins executing before the preempted sweep resumes, and both
+    classes run under the SAME mesh token (whole mesh each)."""
+    from cruise_control_tpu.scenario.spec import ScenarioSpec
+    from cruise_control_tpu.sched import runtime as sched_runtime
+    sim, cc, clock = make_stack(mesh_enabled=True)
+    try:
+        cc.start_up(do_sampling=False, start_detection=False)
+        feed_samples(cc, clock)
+        order = []
+        order_lock = threading.Lock()
+        heal_queued = threading.Event()
+        tokens = {}
+
+        def note(tag):
+            with order_lock:
+                order.append(tag)
+
+        orig_eval = cc.scenario_engine.evaluate
+
+        def hooked_eval(*a, **k):
+            tokens["sweep"] = sched_runtime.current_mesh_token()
+            note("sweep-solve")
+            assert heal_queued.wait(60.0)
+            segment_checkpoint()            # yields to the queued heal
+            note("sweep-complete")
+            return orig_eval(*a, **k)
+
+        cc.scenario_engine.evaluate = hooked_eval
+        orig_opt = cc.goal_optimizer.optimizations
+
+        def hooked_opt(*a, **k):
+            tokens["heal"] = sched_runtime.current_mesh_token()
+            note("heal-solve")
+            return orig_opt(*a, **k)
+
+        cc.goal_optimizer.optimizations = hooked_opt
+
+        sweep_out = {}
+
+        def sweep():
+            sweep_out["res"] = cc.evaluate_scenarios(
+                [ScenarioSpec(name="grow",
+                              load_scale={"disk": 1.2})])
+
+        sweep_thread = threading.Thread(target=sweep, daemon=True)
+        sweep_thread.start()
+        deadline = _real_time.monotonic() + 30.0
+        while not order and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        assert order == ["sweep-solve"]     # the sweep holds the mesh
+
+        heal_out = {}
+
+        def heal():
+            heal_out["res"] = cc.rebalance(
+                dryrun=True, reason="self-healing: goal violation",
+                _scheduler_class=SchedulerClass.ANOMALY_HEAL)
+
+        heal_thread = threading.Thread(target=heal, daemon=True)
+        heal_thread.start()
+        deadline = _real_time.monotonic() + 30.0
+        while cc.solve_scheduler.queue.depth() < 1 \
+                and _real_time.monotonic() < deadline:
+            _real_time.sleep(0.01)
+        heal_queued.set()
+        heal_thread.join(timeout=300.0)
+        sweep_thread.join(timeout=300.0)
+        assert heal_out["res"].proposals is not None
+        assert all(o.feasible for o in sweep_out["res"].outcomes)
+        # the preempted sweep yielded; the heal ran FIRST; the sweep
+        # then re-ran to completion
+        assert order == ["sweep-solve", "heal-solve", "sweep-solve",
+                         "sweep-complete"]
+        assert cc.solve_scheduler.stats.preemptions >= 1
+        # both classes ran under the scheduler's ONE mesh token
+        assert tokens["heal"] is cc.solve_scheduler.mesh_token
+        assert tokens["sweep"] is cc.solve_scheduler.mesh_token
+        assert tokens["heal"].size == 8
+    finally:
+        cc.shutdown()
+
+
+@pytest.mark.slow
+def test_full_default_stack_mesh_solve_matches_quality():
+    """The FULL default goal stack through the PRODUCTION pipeline over
+    the 8-device mesh (the promoted multichip dryrun): must execute end
+    to end, span all 8 devices, and land within the single-chip solve's
+    per-goal violated counts (exact equality is not required at the
+    full stack: sharded float reductions reorder sums)."""
+    from cruise_control_tpu.analyzer.goals.registry import \
+        DEFAULT_GOAL_ORDER
+    state, topo = random_cluster(RandomClusterSpec(
+        num_brokers=12, num_partitions=96, replication_factor=3,
+        num_racks=4, num_topics=4, seed=3, skew_fraction=0.3))
+    goals1 = default_goals(max_rounds=4, names=DEFAULT_GOAL_ORDER)
+    opt1 = GoalOptimizer(goals1, pipeline_segment_size=2)
+    r1 = opt1.optimizations(state, topo, OptimizationOptions())
+
+    mesh = make_mesh(jax.devices()[:8])
+    opt8 = GoalOptimizer(default_goals(max_rounds=4,
+                                       names=DEFAULT_GOAL_ORDER),
+                         pipeline_segment_size=2)
+    r8 = opt8.optimizations(state, topo, OptimizationOptions(),
+                            mesh=mesh)
+    assert r8.mesh_devices == 8
+    for g in DEFAULT_GOAL_ORDER:
+        _, _, after1 = r1.violated_broker_counts[g]
+        _, _, after8 = r8.violated_broker_counts[g]
+        assert after8 <= after1 + 2, (g, after1, after8)
+    # no goal's own pass worsened its own statistic on either path
+    for r in (r1, r8):
+        for g, (_, own, _a) in r.violated_broker_counts.items():
+            assert own <= r.entry_broker_counts[g], (g, r.mesh_devices)

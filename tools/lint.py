@@ -19,6 +19,10 @@ Checks:
     implementation itself — every device solve must enter through the
     device-time scheduler (the PR-4 invariant; its runtime half is the
     chaos stress test's under_gateway assertion);
+  * mesh single-gateway rule: no `Mesh(...)`/`make_mesh`/`jax.devices()`
+    acquisition outside sched/ + facade.py (and the solver
+    implementation) — the scheduler's mesh token is the only path to
+    multi-chip dispatch (the PR-6 invariant);
   * tenant-root rule: no mutable module-level state in fleet-reachable
     modules (cruise_control_tpu/fleet/) — the FleetRegistry INSTANCE is
     the only root of per-tenant state, so draining a tenant provably
@@ -159,6 +163,57 @@ def _gateway_violations(path: Path, tree: ast.AST) -> list:
     return findings
 
 
+#: package-relative paths allowed to construct a device Mesh or acquire
+#: devices directly: the mesh implementation itself, the solver
+#: implementations that consume a mesh, the scheduler that OWNS the
+#: token, the facade + composition root that build it from config, and
+#: the virtual-device test rig.  Everyone else reaches multi-chip
+#: dispatch only through the scheduler's mesh token
+#: (sched/runtime.current_mesh_token) — the mesh half of the
+#: single-gateway invariant.
+_MESH_ALLOWED_RELPATHS = {"facade.py", "main.py", "parallel/mesh.py",
+                          "analyzer/optimizer.py", "scenario/engine.py",
+                          "testing/virtual_mesh.py"}
+
+#: call names that construct a mesh or acquire the device topology
+_MESH_ACQUIRE_CALLS = {"Mesh", "make_mesh", "runtime_mesh", "shard_state",
+                       "devices", "local_devices", "device_count"}
+
+
+def _mesh_violations(path: Path, tree: ast.AST) -> list:
+    """Mesh single-gateway rule: no module outside sched/ + facade.py +
+    the solver implementation may construct a `Mesh` or acquire devices
+    (`jax.devices()` & co.) — the scheduler's mesh token is the only
+    path to multi-chip dispatch."""
+    parts = path.parts
+    if "cruise_control_tpu" not in parts:
+        return []
+    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
+    rel = "/".join(parts[pkg + 1:])
+    if rel.startswith("sched/") or rel in _MESH_ALLOWED_RELPATHS:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if name not in _MESH_ACQUIRE_CALLS:
+            continue
+        if name in ("devices", "local_devices", "device_count"):
+            # only the jax.* device-acquisition spellings count
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and _receiver_name(func.value) == "jax"):
+                continue
+        allowed = "sched/, " + ", ".join(sorted(_MESH_ALLOWED_RELPATHS))
+        findings.append(
+            f"{path}:{node.lineno}: direct mesh/device acquisition "
+            f"({name}) outside the allowed modules ({allowed}) — the "
+            f"scheduler's mesh token is the only path to multi-chip "
+            f"dispatch (mesh single-gateway rule)")
+    return findings
+
+
 #: constructor names whose module-scope call sites create MUTABLE
 #: containers (per-tenant state could silently accrete in them)
 _MUTABLE_CONSTRUCTORS = {"list", "dict", "set", "bytearray", "deque",
@@ -272,6 +327,7 @@ def lint_file(path: Path) -> list:
 
     findings.extend(_silent_swallows(path, tree))
     findings.extend(_gateway_violations(path, tree))
+    findings.extend(_mesh_violations(path, tree))
     findings.extend(_fleet_mutable_globals(path, tree))
 
     # unused imports: __init__.py files are re-export surfaces; a module
