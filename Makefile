@@ -1,6 +1,6 @@
 # One-command hygiene check (the reference's `analyze` + `build` CI steps,
 # .circleci/config.yml:18-35): `make check` = lint + full test suite.
-.PHONY: check lint test bench
+.PHONY: check lint test bench warm-cache
 
 check: lint test
 
@@ -18,3 +18,11 @@ test:
 
 bench:
 	python bench.py
+
+# pre-populate the persistent program cache for the default goal stacks
+# offline (docs/PROGRAM_CACHE.md): the next process/tenant with these
+# shapes cold-starts in seconds instead of paying the AOT compile.
+# Geometry via WARM_BROKERS / WARM_PARTITIONS; PROGCACHE_DIR overrides
+# the directory.
+warm-cache:
+	python tools/program_cache.py --dir $(or $(PROGCACHE_DIR),.progcache) warm

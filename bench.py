@@ -58,8 +58,29 @@ BENCH_CONFIG selects a BASELINE.json eval config:
                    p99, >1 = the scheduler wins via coalescing +
                    ordering)
 
+  coldstart        persistent-program-cache cold start
+                   (parallel/progcache.py): measures cold-process
+                   time-to-first-proposal twice in FRESH subprocesses —
+                   first with an EMPTY program cache (compiles + stores),
+                   then with the warm cache (hydrates) — and reports
+                   per-run warmup/solve seconds, progcache
+                   hit/miss/store counts and bytes, plus a
+                   proposal-digest equality check.  The warm run MUST
+                   perform zero source-program compiles
+                   (fresh_compiles == 0) and produce byte-identical
+                   proposals, or the bench exits 1 (the output JSON
+                   carries a "coldstart" block; value = warm
+                   time-to-first-proposal seconds, vs_baseline =
+                   cold / warm, >1 = the cache wins)
+
 Other knobs: BENCH_BROKERS, BENCH_PARTITIONS, BENCH_RF, BENCH_ROUNDS,
 BENCH_GOALS (comma list), BENCH_SEGMENT, BENCH_SKIP_WARMUP.
+
+BENCH_PROGCACHE governs the persistent program cache for the headline
+run: unset = ".progcache" next to this file, a path = that directory,
+"0"/"off" = disabled.  The headline JSON reports `warmup_s` and
+`progcache_hits` either way, so the ~300s cold-start number is tracked
+per round instead of living only in the log tail.
 
 BENCH_MESH governs the headline device topology: unset/auto = solve
 over ALL visible devices when the backend is not CPU (the v5e-8 path;
@@ -93,6 +114,19 @@ os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+
+def _configure_progcache():
+    """Wire the persistent program cache from BENCH_PROGCACHE (see the
+    module docstring); returns the cache (disabled cache when off)."""
+    from cruise_control_tpu.parallel import progcache
+    raw = os.environ.get("BENCH_PROGCACHE", "").strip()
+    if raw.lower() in ("0", "off", "false", "none"):
+        progcache.configure(enabled=False)
+        return progcache.get_cache()
+    path = raw or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".progcache")
+    return progcache.configure(enabled=True, cache_dir=path)
 
 
 def _resolve_mesh(jax, raw=None):
@@ -173,6 +207,8 @@ def main() -> None:
         return _fleet_bench()
     if config == "mesh":
         return _mesh_bench()
+    if config == "coldstart":
+        return _coldstart_bench()
     presets = {  # (brokers, partitions, goal subset, metric label)
         "north": (2600, 200_000, None, "full-stack proposal generation"),
         "1": (3, 30, None, "deterministic fixture"),
@@ -210,6 +246,9 @@ def main() -> None:
     goals = default_goals(max_rounds=rounds, names=names)
     segment = int(os.environ.get("BENCH_SEGMENT", 2))
     optimizer = GoalOptimizer(goals, pipeline_segment_size=segment)
+    progcache = _configure_progcache()
+    print(f"# progcache: {progcache.stats()['dir'] or 'disabled'}",
+          file=sys.stderr)
     mesh = _resolve_mesh(jax)
     n_devices = mesh.size if mesh is not None else 1
     print(f"# solve mesh: {n_devices} device(s)"
@@ -273,14 +312,17 @@ def main() -> None:
     # proposal-computation timer).  A first run-through also executes once
     # so one-off host work (weak-type promotions, transfer setup) is out
     # of the measured pass.
+    warmup_total_s = 0.0
     if not os.environ.get("BENCH_SKIP_WARMUP"):
         t0 = time.time()
         warm_s = optimizer.warmup(state, topo, OptimizationOptions(),
                                   mesh=mesh)
-        print(f"# warmup (parallel AOT compile) {warm_s:.1f}s",
-              file=sys.stderr)
+        print(f"# warmup (cache-first parallel AOT) {warm_s:.1f}s "
+              f"[progcache hits={progcache.hits} "
+              f"fresh={progcache.fresh_compiles}]", file=sys.stderr)
         run_with_retry("warmup")
-        print(f"# warmup (compile+first run) {time.time()-t0:.1f}s",
+        warmup_total_s = time.time() - t0
+        print(f"# warmup (compile+first run) {warmup_total_s:.1f}s",
               file=sys.stderr)
 
     if profiler is not None:
@@ -333,6 +375,13 @@ def main() -> None:
         "n_devices": n_devices,
         "mesh": ({"devices": n_devices, "axis": "replica"}
                  if mesh is not None else {"devices": 1, "axis": None}),
+        # cold-start attribution: the warmup cost that preceded the
+        # measured solve, and how much of it the persistent program
+        # cache served (tracked per round — the ~300s number used to
+        # live only in the log tail)
+        "warmup_s": round(warmup_total_s, 3),
+        "progcache_hits": progcache.hits,
+        "progcache_fresh_compiles": progcache.fresh_compiles,
     }
     if regressions:
         out["goal_self_regressions"] = regressions
@@ -342,6 +391,132 @@ def main() -> None:
     print(json.dumps(out))
     if regressions:
         sys.exit(1)
+
+
+def _coldstart_bench() -> None:
+    """BENCH_CONFIG=coldstart: cold-PROCESS time-to-first-proposal with
+    an empty vs warm persistent program cache (parallel/progcache.py).
+
+    Two fresh subprocesses share one temp cache (program cache + the
+    XLA persistent compilation cache as the lower tier): the first sees
+    an EMPTY cache (traces, compiles, stores), the second hydrates.
+    The warm run must perform ZERO source-program compiles
+    (fresh_compiles == 0, pinned via the gateway compile-count
+    instrumentation) and its proposals must be byte-identical to the
+    cold run's (sha256 digest) — any violation exits 1.  Geometry via
+    BENCH_BROKERS/BENCH_PARTITIONS/BENCH_GOALS; single-chip by design
+    (the mesh sweep is BENCH_CONFIG=mesh)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    if os.environ.get("BENCH_COLDSTART_CHILD"):
+        return _coldstart_child()
+    base = tempfile.mkdtemp(prefix="cc-coldstart-")
+    env = dict(os.environ)
+    env.update(BENCH_COLDSTART_CHILD="1",
+               BENCH_PROGCACHE=os.path.join(base, "progcache"),
+               JAX_COMPILATION_CACHE_DIR=os.path.join(base, "xla"),
+               JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0.5")
+    runs = {}
+    try:
+        for label in ("cold", "warm"):
+            t0 = time.time()
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True)
+            sys.stderr.write(proc.stderr)
+            if proc.returncode != 0 or not proc.stdout.strip():
+                sys.exit(f"coldstart {label} child failed "
+                         f"(rc={proc.returncode})")
+            runs[label] = json.loads(
+                proc.stdout.strip().splitlines()[-1])
+            runs[label]["process_s"] = round(time.time() - t0, 3)
+            print(f"# {label}: ttfp {runs[label]['ttfp_s']}s (warmup "
+                  f"{runs[label]['warmup_s']}s), compiles "
+                  f"{runs[label]['fresh_compiles']}, hits "
+                  f"{runs[label]['hits']}", file=sys.stderr)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    cold, warm = runs["cold"], runs["warm"]
+    zero_compiles = warm["fresh_compiles"] == 0
+    identical = warm["proposals_digest"] == cold["proposals_digest"]
+    if not zero_compiles:
+        print(f"# ERROR: warm run paid {warm['fresh_compiles']} source "
+              f"compiles (must be 0)", file=sys.stderr)
+    if not identical:
+        print("# ERROR: warm proposals differ from cold proposals",
+              file=sys.stderr)
+    print(json.dumps({
+        "metric": (f"cold-process time-to-first-proposal "
+                   f"{cold['brokers']}b/{cold['partitions'] / 1000:g}Kp "
+                   f"warm progcache"),
+        "value": warm["ttfp_s"],
+        "unit": "s",
+        "vs_baseline": round(cold["ttfp_s"] / max(warm["ttfp_s"], 1e-9),
+                             3),
+        "coldstart": {
+            "cold": cold,
+            "warm": warm,
+            "warm_zero_compiles": zero_compiles,
+            "proposals_identical": identical,
+        },
+    }))
+    if not (zero_compiles and identical):
+        sys.exit(1)
+
+
+def _coldstart_child() -> None:
+    """One cold-process measurement (see _coldstart_bench): build the
+    model, cache-first warmup, ONE solve; emit the run's JSON line."""
+    import hashlib
+
+    from cruise_control_tpu.analyzer.context import OptimizationOptions
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+
+    progcache = _configure_progcache()
+    num_b = int(os.environ.get("BENCH_BROKERS", 64))
+    num_p = int(os.environ.get("BENCH_PARTITIONS", 2000))
+    rf = int(os.environ.get("BENCH_RF", 3))
+    goal_names = os.environ.get("BENCH_GOALS")
+    t_start = time.time()
+    state, topo = _build("coldstart", num_b, num_p, rf)
+    goals = default_goals(
+        max_rounds=int(os.environ.get("BENCH_ROUNDS", 64)),
+        names=goal_names.split(",") if goal_names else None)
+    optimizer = GoalOptimizer(
+        goals,
+        pipeline_segment_size=int(os.environ.get("BENCH_SEGMENT", 4)))
+    t0 = time.time()
+    optimizer.warmup(state, topo, OptimizationOptions())
+    warmup_s = time.time() - t0
+    t0 = time.time()
+    result = optimizer.optimizations(state, topo, OptimizationOptions(),
+                                     check_sanity=False)
+    solve_s = time.time() - t0
+    ttfp_s = time.time() - t_start
+    digest = hashlib.sha256(repr(sorted(
+        (p.partition.topic, p.partition.partition,
+         tuple(p.new_replicas), p.new_leader)
+        for p in result.proposals)).encode()).hexdigest()
+    stats = progcache.stats()
+    print(json.dumps({
+        "brokers": state.num_brokers,
+        "partitions": state.num_partitions,
+        "ttfp_s": round(ttfp_s, 3),
+        "warmup_s": round(warmup_s, 3),
+        "solve_s": round(solve_s, 3),
+        "proposals": len(result.proposals),
+        "proposals_digest": digest,
+        "fresh_compiles": stats["freshCompiles"],
+        "hits": stats["hits"],
+        "misses": stats["misses"],
+        "stores": stats["stores"],
+        "cache_bytes": sum(
+            e.size_bytes
+            for e in progcache.entries(all_fingerprints=True)),
+    }))
 
 
 def _mesh_bench() -> None:

@@ -39,6 +39,7 @@ from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.sanity import sanity_check
 from cruise_control_tpu.parallel import mesh as mesh_mod
+from cruise_control_tpu.parallel import progcache as progcache_mod
 from cruise_control_tpu.sched.runtime import segment_checkpoint
 from cruise_control_tpu.model.state import ClusterState
 from cruise_control_tpu.model.stats import (ClusterModelStats, compute_stats,
@@ -107,6 +108,16 @@ _MAX_SHARED_GOAL_LISTS = 3
 _SHARED_LOCK = threading.Lock()
 
 
+#: process-wide registry of AOT EXECUTABLES stored to / hydrated from
+#: the persistent program cache, keyed (goal-list key, program key,
+#: input-tree signature).  This is the dedupe layer of the cache-first
+#: warmup: K tenants sharing a shape bucket + goal list hydrate ONE
+#: executable (the first warmup pays the deserialize+compile, the rest
+#: find it here).  Evicted together with _SHARED_PROGRAMS when a goal
+#: list ages out of the LRU.
+_SHARED_AOT: Dict[Tuple, object] = {}
+
+
 def _shared_program(key: str, gk: Tuple, make):
     full = (key, gk)
     with _SHARED_LOCK:
@@ -121,7 +132,23 @@ def _shared_program(key: str, gk: Tuple, make):
             old = _SHARED_LRU.pop(0)
             for k in [k for k in _SHARED_PROGRAMS if k[1] == old]:
                 del _SHARED_PROGRAMS[k]
+            for k in [k for k in _SHARED_AOT if k[0] == old]:
+                del _SHARED_AOT[k]
     return prog
+
+
+def _shared_aot_get(gk, key: str, shape_sig: str):
+    if gk is None:
+        return None
+    with _SHARED_LOCK:
+        return _SHARED_AOT.get((gk, key, shape_sig))
+
+
+def _shared_aot_put(gk, key: str, shape_sig: str, executable) -> None:
+    if gk is None:
+        return
+    with _SHARED_LOCK:
+        _SHARED_AOT[(gk, key, shape_sig)] = executable
 
 
 @dataclasses.dataclass
@@ -603,6 +630,14 @@ class GoalOptimizer:
         constraints, and the retained executables land under the
         mesh-suffixed program keys the mesh solve dispatches through.
 
+        CACHE-FIRST: every program first consults (a) the process-wide
+        shared AOT registry — tenants sharing a bucket + goal list
+        hydrate once and dedupe here — and (b) the persistent on-disk
+        program cache (parallel/progcache.py), which turns a ~300s cold
+        compile into a deserialize + XLA-cache-served recompile (seconds
+        after a process bounce).  Only true misses trace + compile, and
+        those exports are stored for the next process.
+
         Returns wall-clock seconds spent."""
         import concurrent.futures
         import contextlib
@@ -617,7 +652,8 @@ class GoalOptimizer:
                         "re-pays them")
         options = options or OptimizationOptions()
         mesh_active = mesh is not None and mesh.size > 1
-        sfx = f"@mesh{mesh.size}" if mesh_active else ""
+        sfx = ("" if not mesh_active
+               else mesh_mod.program_key("", mesh.size))
         if mesh_active:
             # idempotent for a caller that already sharded the state
             state = mesh_mod.shard_state(state, mesh)
@@ -638,6 +674,10 @@ class GoalOptimizer:
             jobs.append((f"__seg_{start}_{stop}__",
                          self._segment_fn(start, stop),
                          (state, cache_aval, stats_aval_in, ctx)))
+        if self._gk_cache is False:
+            self._gk_cache = self._goals_share_key()
+        gk = self._gk_cache
+        gsig = mesh_mod.goal_list_signature(gk)
 
         def compile_one(job):
             key, fn, args = job
@@ -648,23 +688,155 @@ class GoalOptimizer:
             scope = (mesh_mod.solver_mesh(mesh) if mesh_active
                      else contextlib.nullcontext())
             with scope:
+                shape_sig = mesh_mod.tree_signature(args)
+                shared = _shared_aot_get(gk, key, shape_sig)
+                if shared is not None:
+                    # another tenant in this bucket already compiled or
+                    # hydrated this exact program — zero work
+                    return key, shared
                 for attempt in range(attempts):
                     try:
-                        return key, self._jit_program(key, fn).lower(
-                            *args).compile()
+                        compiled = self._compile_through_cache(
+                            key, fn, args, gsig, shape_sig)
+                        break
                     except jax.errors.JaxRuntimeError as exc:
                         LOG.warning("warmup compile %s attempt %d "
                                     "failed: %s", key, attempt,
                                     str(exc).splitlines()[0][:120])
                         _time.sleep(5.0)
-                return key, self._jit_program(key, fn).lower(
-                    *args).compile()
+                else:
+                    compiled = self._compile_through_cache(
+                        key, fn, args, gsig, shape_sig)
+                _shared_aot_put(gk, key, shape_sig, compiled)
+                return key, compiled
 
         with concurrent.futures.ThreadPoolExecutor(max_workers) as pool:
             for key, compiled in pool.map(compile_one, jobs):
                 self._aot[key] = compiled
                 LOG.debug("warmed %s", key)
         return _time.time() - t0
+
+    def _compile_through_cache(self, key: str, fn, args,
+                               goal_sig: Optional[str], shape_sig: str):
+        """THE AOT compile gateway: every warmup/hydration compile goes
+        through here (the cache-gateway lint rule pins the call sites).
+
+        Persistent-cache HIT → deserialize the stored StableHLO and
+        recompile it (no tracing of the source program; the XLA
+        persistent compilation cache serves the backend compile as the
+        lower tier).  MISS → trace + export + store, then compile the
+        ROUND-TRIPPED module rather than the traced jit: the warm path
+        compiles exactly this module, so cold and warm runs share one
+        XLA-cache key and cached-vs-fresh results are trivially
+        byte-identical.  Donation is re-applied at compile time (the
+        serialized module does not carry input/output aliasing).  Any
+        cache-layer failure falls back to the plain compile path — a
+        bad entry is a miss, never a wrong answer."""
+        cache = progcache_mod.get_cache()
+        donate = self._donate_argnums(key)
+        exported = cache.load_exported(key, goal_sig, shape_sig)
+        if exported is not None:
+            try:
+                return jax.jit(exported.call,
+                               donate_argnums=donate).lower(
+                    *args).compile()
+            except Exception as exc:  # noqa: BLE001 - bad entry => miss
+                LOG.warning("progcache: compiling cached %s failed "
+                            "(%s); quarantining and recompiling from "
+                            "source", key,
+                            str(exc).splitlines()[0][:120])
+                cache.quarantine(key, goal_sig, shape_sig)
+        cache.count_fresh_compile()
+        program = self._jit_program(key, fn)
+        if cache.is_active(goal_sig):
+            from jax import export as jexport
+            try:
+                progcache_mod.ensure_export_registrations()
+                exported = jexport.export(program)(*args)
+                blob = exported.serialize()
+                cache.store(key, goal_sig, shape_sig, bytes(blob),
+                            progcache_mod.export_meta(exported))
+                return jax.jit(jexport.deserialize(bytearray(blob)).call,
+                               donate_argnums=donate).lower(
+                    *args).compile()
+            except Exception as exc:  # noqa: BLE001 - cache layer must
+                # never fail the compile it fronts
+                LOG.warning("progcache: export of %s failed (%s); "
+                            "compiling without the persistent tier",
+                            key, str(exc).splitlines()[0][:120])
+                cache.count_export_error()
+        return program.lower(*args).compile()
+
+    def _compile_exported(self, key: str, exported):
+        """Compile a deserialized export with NO model at hand: the
+        argument avals come from the export itself (in_tree + in_avals;
+        multi-chip entries rebuild their shardings against a mesh of the
+        recorded span).  Used by model-free hydration — process startup
+        and fleet register() run before any cluster model exists."""
+        nr = int(getattr(exported, "nr_devices", 1))
+        if nr > 1:
+            devices = jax.devices()
+            if len(devices) < nr:
+                raise ValueError(
+                    f"entry spans {nr} devices but only {len(devices)} "
+                    f"are visible")
+            m = mesh_mod.make_mesh(devices[:nr])
+            leaves = [jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
+                      for a, s in zip(exported.in_avals,
+                                      exported.in_shardings_jax(m))]
+        else:
+            leaves = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in exported.in_avals]
+        args, kwargs = jax.tree_util.tree_unflatten(exported.in_tree,
+                                                    leaves)
+        return jax.jit(exported.call,
+                       donate_argnums=self._donate_argnums(key)).lower(
+            *args, **kwargs).compile()
+
+    def hydrate_from_cache(self) -> int:
+        """Model-free hydration: load every persistent-cache entry for
+        this optimizer's goal list, compile the stored modules (XLA
+        persistent cache as the lower tier) and register the
+        executables — `_run` then dispatches through them with ZERO
+        source-program compiles.  This is how a process bounce, a fleet
+        `register()` and a ladder probe-recovery reach FUSED/MESH in
+        seconds instead of ~300s.  Returns the number of executables
+        registered; 0 when the cache is off, empty, or the goal list is
+        unshareable.  Failures skip the entry (logged) — hydration can
+        only ever leave the optimizer where it started: compiling on
+        demand."""
+        cache = progcache_mod.get_cache()
+        if self._gk_cache is False:
+            self._gk_cache = self._goals_share_key()
+        gk = self._gk_cache
+        gsig = mesh_mod.goal_list_signature(gk)
+        if not cache.is_active(gsig):
+            return 0
+        count = 0
+        for entry in cache.entries(goal_sig=gsig):
+            key, shape_sig = entry.program, entry.shape_sig
+            executable = _shared_aot_get(gk, key, shape_sig)
+            if executable is None:
+                exported = cache.load_exported(key, gsig, shape_sig)
+                if exported is None:
+                    continue
+                try:
+                    executable = self._compile_exported(key, exported)
+                except Exception as exc:  # noqa: BLE001 - skip entry,
+                    # hydration is strictly best-effort
+                    LOG.warning("progcache: hydration of %s skipped "
+                                "(%s)", key,
+                                str(exc).splitlines()[0][:120])
+                    continue
+                _shared_aot_put(gk, key, shape_sig, executable)
+            # newest entry wins the per-key instance slot; other shape
+            # buckets stay reachable through the shared registry
+            self._aot[key] = executable
+            count += 1
+        if count:
+            LOG.info("progcache: hydrated %d compiled programs for this "
+                     "goal list (zero source compiles)", count)
+        return count
 
     def optimizations(self, state: ClusterState, topology,
                       options: Optional[OptimizationOptions] = None,
@@ -744,7 +916,8 @@ class GoalOptimizer:
         eager = (self.eager_hard_abort if eager_hard_abort is None
                  else eager_hard_abort)
         mesh_active = mesh is not None and mesh.size > 1
-        sfx = f"@mesh{mesh.size}" if mesh_active else ""
+        sfx = ("" if not mesh_active
+               else mesh_mod.program_key("", mesh.size))
 
         def run_prog(key, fn, *args):
             # solver-mesh constraints matter at TRACE time only: scoping
@@ -1120,15 +1293,22 @@ class GoalOptimizer:
         ctx (shared by every program of the solve).  Donation is skipped
         on CPU (unsupported there; avoids a warning per compile)."""
         faults.inject("optimizer.compile")
-        donate = ()
-        # suffix-tolerant predicates: mesh-rung programs carry an
-        # "@mesh<N>" key suffix (separate trace: the solver-mesh table
-        # constraints only exist in the mesh programs)
+        return jax.jit(fn, donate_argnums=self._donate_argnums(key))
+
+    @staticmethod
+    def _donate_argnums(key: str) -> Tuple[int, ...]:
+        """Donation policy by program key (see _jit_program).  Shared
+        with the persistent-cache compile paths: serialized StableHLO
+        carries no input/output aliasing, so a cached program re-applies
+        the same donation when its module is recompiled.  Predicates
+        are suffix-tolerant: mesh-rung programs carry an "@mesh<N>" key
+        suffix (separate trace: the solver-mesh table constraints only
+        exist in the mesh programs)."""
         if (key.startswith("__seg_")
                 or (key.startswith("__goal_") and "_rounds__" in key)):
             if jax.default_backend() != "cpu":
-                donate = (0, 1)
-        return jax.jit(fn, donate_argnums=donate)
+                return (0, 1)
+        return ()
 
     def _get_compiled(self, key: str, fn):
         if not self._jit_goals:
@@ -1158,8 +1338,10 @@ class GoalOptimizer:
         return _shared_program(key, gk, lambda: self._jit_program(key, fn))
 
     def _run(self, key: str, fn, *args):
-        """Prefer a warmup-retained AOT executable; fall back to jit when
-        none exists or the argument shapes changed (an AOT executable is
+        """Prefer a warmup-retained AOT executable; then the process-wide
+        shared AOT registry (another shape bucket of this goal list may
+        have been hydrated from the persistent cache); fall back to jit
+        when neither matches the argument shapes (an AOT executable is
         pinned to the avals it was lowered for)."""
         faults.inject("optimizer.execute")
         aot = self._aot.get(key)
@@ -1167,6 +1349,18 @@ class GoalOptimizer:
             try:
                 return aot(*args)
             except (TypeError, ValueError) as exc:
-                LOG.debug("AOT %s rejected args (%s); falling back to jit",
+                LOG.debug("AOT %s rejected args (%s); falling back",
                           key, exc)
+        gk = self._gk_cache
+        if gk is False:
+            gk = self._gk_cache = self._goals_share_key()
+        if gk is not None and _SHARED_AOT:
+            shared = _shared_aot_get(gk, key,
+                                     mesh_mod.tree_signature(args))
+            if shared is not None:
+                try:
+                    return shared(*args)
+                except (TypeError, ValueError) as exc:
+                    LOG.debug("shared AOT %s rejected args (%s); "
+                              "falling back to jit", key, exc)
         return self._get_compiled(key, fn)(*args)

@@ -322,6 +322,36 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
              "(0 = use all).  Useful to reserve chips for other work or "
              "to A/B mesh scaling (BENCH_CONFIG=mesh automates the "
              "sweep).")
+    d.define("progcache.enabled", Type.BOOLEAN, True, None, _M,
+             "Route every pipeline compile through the persistent "
+             "compiled-program cache (parallel/progcache.py): warmup "
+             "becomes a cache-first hydrate (serialized StableHLO via "
+             "jax.export, the XLA persistent compilation cache as the "
+             "lower tier), so a process bounce, tenant register() or "
+             "ladder probe-recovery reaches FUSED/MESH in seconds "
+             "instead of re-paying the ~300s AOT compile.  The cache is "
+             "inert until progcache.dir names a directory; disabled, "
+             "every compile path is byte-identical to the pre-cache "
+             "behavior.")
+    d.define("progcache.dir", Type.STRING, "", None, _M,
+             "Directory of the persistent program cache (local disk or "
+             "a shared blob mount — entries are atomic "
+             "write-temp-then-rename, so concurrent writers are safe).  "
+             "Empty (the default) disables persistence; '.progcache' is "
+             "the conventional location (gitignored, `make warm-cache` "
+             "pre-populates it).")
+    d.define("progcache.max.bytes", Type.LONG, 2_147_483_648,
+             in_range(min_value=1), _L,
+             "Size cap of the program-cache directory; crossing it "
+             "evicts oldest entries first (age by mtime, all "
+             "fingerprint generations considered).")
+    d.define("progcache.fingerprint.override", Type.STRING, "", None, _L,
+             "Replaces the source-content term of the cache "
+             "fingerprint (jax/jaxlib version, backend and device kind "
+             "always apply).  Set a fixed label to share entries "
+             "across builds you know are program-equivalent; bump it "
+             "to force a cold generation.  A mismatched fingerprint is "
+             "a miss, never a wrong answer.")
     d.define("fleet.bucket.floor", Type.INT, 8, in_range(min_value=1), _M,
              "Smallest shape-bucket edge for fleet serving "
              "(fleet/buckets.py): every tenant's model pads each axis "

@@ -215,7 +215,12 @@ class CruiseControl:
                  mesh_enabled: Optional[bool] = None,
                  mesh_max_devices: Optional[int] = None,
                  solve_scheduler=None,
-                 fleet_binding=None) -> None:
+                 fleet_binding=None,
+                 progcache_enabled: Optional[bool] = None,
+                 progcache_dir: Optional[str] = None,
+                 progcache_max_bytes: Optional[int] = None,
+                 progcache_fingerprint_override: Optional[str] = None
+                 ) -> None:
         self._admin = admin
         self._time = time_fn or _time.time
         self._sleep = sleep_fn or _time.sleep
@@ -265,6 +270,24 @@ class CruiseControl:
         from cruise_control_tpu.cluster.admin import AdminTopicConfigProvider
         self.topic_config_provider = (topic_config_provider
                                       or AdminTopicConfigProvider(admin))
+
+        # persistent compiled-program cache (parallel/progcache.py): the
+        # process-wide singleton every compile gateway consults.  Only an
+        # EXPLICIT progcache_enabled (build_cruise_control always passes
+        # one from the progcache.* keys) touches it — direct facade
+        # construction leaves the global cache exactly as found, so
+        # embedding code and tests see no behavior change.  The cache is
+        # inert until a cache dir is configured; with it, warmup turns
+        # into a cache-first hydrate and a process bounce reaches
+        # FUSED/MESH with zero source-program compiles.
+        from cruise_control_tpu.parallel import progcache as _progcache
+        if progcache_enabled is not None:
+            _progcache.configure(
+                enabled=progcache_enabled,
+                cache_dir=progcache_dir,
+                max_bytes=progcache_max_bytes,
+                fingerprint_override=progcache_fingerprint_override)
+        self._progcache = _progcache.get_cache()
 
         # construction order mirrors the reference facade :100-113
         self.load_monitor = LoadMonitor(
@@ -450,6 +473,20 @@ class CruiseControl:
                            lambda: int(self.solver_ladder.rung))
         self.metrics.gauge("mesh-devices",
                            lambda: float(self._mesh_token.size))
+        # progcache-* sensors: the persistent program cache's counters
+        # (process-wide singleton — under fleet serving every tenant
+        # reports the same shared cache, which is the truth: there IS
+        # one cache)
+        self.metrics.gauge("progcache-hits",
+                           lambda: float(self._progcache.hits))
+        self.metrics.gauge("progcache-misses",
+                           lambda: float(self._progcache.misses))
+        self.metrics.gauge("progcache-stores",
+                           lambda: float(self._progcache.stores))
+        self.metrics.gauge("progcache-corrupt-entries",
+                           lambda: float(self._progcache.corrupt_entries))
+        self.metrics.gauge("progcache-fresh-compiles",
+                           lambda: float(self._progcache.fresh_compiles))
         self.metrics.gauge(
             "goal-self-regressions",
             lambda: float(len(self._goal_self_regressions)))
@@ -500,6 +537,27 @@ class CruiseControl:
                 target=self._precompute_loop, name="proposal-precompute",
                 daemon=True)
             self._precompute_thread.start()
+
+    def warm_programs_from_cache(self) -> int:
+        """Hydrate this facade's default goal stack from the persistent
+        program cache (no cluster model needed — entry avals come from
+        the serialized exports), so the FIRST solve after a process
+        bounce / tenant register() dispatches retained executables with
+        ZERO source-program compiles.  Returns the number of hydrated
+        executables; 0 (and never an exception) when the cache is
+        disabled, empty, or hydration fails — startup must not depend
+        on cache health."""
+        try:
+            count = self.goal_optimizer.hydrate_from_cache()
+        except Exception as exc:  # noqa: BLE001 - hydration is strictly
+            # best-effort; a broken cache must not block startup
+            LOG.warning("program-cache hydration failed (%s); programs "
+                        "will compile on demand", exc)
+            return 0
+        if count:
+            LOG.info("program-cache hydration: %d compiled programs "
+                     "ready before the first solve", count)
+        return count
 
     def shutdown(self) -> None:
         self._precompute_stop.set()

@@ -175,6 +175,20 @@ class FleetRegistry:
         LOG.info("fleet: registered tenant %r (default=%s, %d total)",
                  cluster_id, self._default_id == cluster_id,
                  len(self._tenants))
+        # tenant onboarding warms from the persistent program cache
+        # (outside the lock — hydration may compile deserialized
+        # modules): a tenant whose shape bucket + goal list another
+        # tenant (or a previous process) already compiled reaches
+        # FUSED/MESH with zero source-program compiles.  No-op when the
+        # cache is off/empty; best-effort by contract (the facade method
+        # never raises) — and tolerant of stub facades in tests.
+        warm = getattr(facade, "warm_programs_from_cache", None)
+        if warm is not None:
+            hydrated = warm()
+            if hydrated:
+                LOG.info("fleet: tenant %r hydrated %d compiled "
+                         "programs from the program cache", cluster_id,
+                         hydrated)
         return tenant
 
     def drain(self, cluster_id: str) -> Tenant:

@@ -342,6 +342,11 @@ def build_cruise_control(config: CruiseControlConfig, admin,
         mesh_max_devices=(config.get_int("mesh.max.devices") or None),
         solve_scheduler=solve_scheduler,
         fleet_binding=fleet_binding,
+        progcache_enabled=config.get_boolean("progcache.enabled"),
+        progcache_dir=config.get("progcache.dir") or "",
+        progcache_max_bytes=config.get_long("progcache.max.bytes"),
+        progcache_fingerprint_override=config.get(
+            "progcache.fingerprint.override") or "",
         monitor_kwargs=dict(
             sample_store=sample_store,
             num_windows=config.get_int("num.partition.metrics.windows"),
@@ -779,6 +784,14 @@ def main(argv=None) -> int:
         from cruise_control_tpu.common.config import resolve_class
         admin = resolve_class(admin_cls)()
         cc = build_cruise_control(config, admin)
+
+    if fleet is None:
+        # warm from the persistent program cache BEFORE serving: a
+        # process bounce re-enters FUSED/MESH with zero source-program
+        # compiles when the cache holds this stack's programs (fleet
+        # tenants warmed inside register()).  No-op when progcache.dir
+        # is unset or the cache is empty.
+        cc.warm_programs_from_cache()
 
     app = build_app(config, cc, fleet=fleet)
     startup_kwargs = dict(

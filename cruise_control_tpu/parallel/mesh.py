@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import hashlib
 import threading
 from typing import Optional
 
@@ -35,6 +36,107 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from cruise_control_tpu.model.state import ClusterState
 
 REPLICA_AXIS = "replica"
+
+
+# ----------------------------------------------------------------------
+# program-cache key anatomy (shared by every program keyspace)
+#
+# THREE subsystems cache compiled pipeline programs: the optimizer's
+# in-process `_aot`/`_SHARED_PROGRAMS` dicts (analyzer/optimizer.py),
+# the scenario engine's per-batch LRU (scenario/engine.py), and the
+# persistent on-disk cache (parallel/progcache.py).  They used to build
+# their keys independently ("@meshN" suffixes here, a shapes tuple
+# there), which is exactly how keyspaces drift apart; every key is now
+# assembled from the helpers below — (program key incl. mesh span,
+# goal-list signature, input-tree signature, environment fingerprint) —
+# so an entry written by one path is addressable by every other.
+# ----------------------------------------------------------------------
+
+def program_key(program: str, mesh_devices: int = 1) -> str:
+    """Canonical program name: the pipeline program id plus the
+    ``@meshN`` span suffix for multi-chip traces.  Single-chip programs
+    keep the bare name — mesh=1 must stay byte-identical to the
+    pre-mesh path, including its cache keys."""
+    return (program if mesh_devices <= 1
+            else f"{program}@mesh{int(mesh_devices)}")
+
+
+def goal_list_signature(share_key) -> Optional[str]:
+    """Stable digest of a GoalOptimizer._goals_share_key() tuple, or
+    None when the goal list cannot be shared (non-primitive goal state)
+    — an unshareable list is never persisted: a recycled in-memory id
+    must not address another process's entry."""
+    if share_key is None:
+        return None
+    return hashlib.sha256(repr(share_key).encode()).hexdigest()[:16]
+
+
+def tree_signature(*trees) -> str:
+    """Digest of the input pytrees' STRUCTURE and avals: treedef repr
+    (which carries every static dataclass field — register_dataclass
+    puts them in the aux data) plus per-leaf shape/dtype.  Two argument
+    sets with equal signatures lower to the same program, so this is
+    the shape-bucket axis of the persistent cache key."""
+    leaves, treedef = jax.tree_util.tree_flatten(trees)
+    parts = [repr(treedef)]
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            parts.append(f"{tuple(shape)}:{getattr(leaf, 'dtype', '?')}")
+        else:
+            parts.append(repr(leaf))
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+#: memoized (override -> fingerprint) — source hashing walks the solver
+#: packages once per process
+_FINGERPRINT_CACHE: dict = {}
+#: packages whose sources define what the pipeline programs COMPUTE —
+#: any edit must invalidate every cached executable (a stale entry is a
+#: miss, never a wrong answer)
+_FINGERPRINT_PACKAGES = ("analyzer", "model", "parallel", "scenario",
+                         "common")
+
+
+def _source_hash() -> str:
+    """Content hash over the kernel/goal/model program sources."""
+    import os
+    h = hashlib.sha256()
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for pkg in _FINGERPRINT_PACKAGES:
+        root = os.path.join(pkg_root, pkg)
+        for dirpath, dirnames, filenames in sorted(os.walk(root)):
+            dirnames.sort()
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                h.update(os.path.relpath(path, pkg_root).encode())
+                with open(path, "rb") as fh:
+                    h.update(fh.read())
+    return h.hexdigest()[:16]
+
+
+def program_fingerprint(override: Optional[str] = None) -> str:
+    """Environment fingerprint of a compiled program: jax + jaxlib
+    version, backend platform, device kind, and a content hash of the
+    solver sources.  Any mismatch makes every entry under the old
+    fingerprint a MISS — the cache can serve a stale executable only if
+    all five terms collide, i.e. never.  `override` (the
+    progcache.fingerprint.override key) replaces the source-hash term
+    so operators can pin sharing across builds they know are
+    program-equivalent (e.g. docs-only changes)."""
+    if override in _FINGERPRINT_CACHE:
+        return _FINGERPRINT_CACHE[override]
+    import jaxlib
+    devices = jax.devices()
+    dev_kind = (getattr(devices[0], "device_kind", devices[0].platform)
+                if devices else "none")
+    terms = (jax.__version__, jaxlib.__version__, jax.default_backend(),
+             str(dev_kind), override if override else _source_hash())
+    fp = hashlib.sha256("|".join(terms).encode()).hexdigest()[:16]
+    _FINGERPRINT_CACHE[override] = fp
+    return fp
 
 _ACTIVE = threading.local()
 

@@ -1,0 +1,459 @@
+"""Persistent compiled-program cache: the on-disk tier every pipeline
+compile goes through.
+
+BENCH_r05 put the warmup AOT compile at ~300s — 10x the solve it
+enables — and every process bounce, tenant register() and ladder
+re-probe re-paid it for programs compiled a thousand times before.
+PR-5's power-of-two shape buckets and PR-6's ``@meshN`` program keys
+already canonicalize geometry, so compiled executables are reusable
+across restarts, tenants and mesh spans; this module makes them
+DURABLE:
+
+* **upper tier** — serialized StableHLO (``jax.export``) keyed by
+  (program key incl. mesh span, goal-list signature, input-tree
+  signature, environment fingerprint — see parallel/mesh.py).  A hit
+  skips tracing the Python pipeline entirely;
+* **lower tier** — the XLA persistent compilation cache
+  (``jax_compilation_cache_dir``), which serves the backend compile of
+  the deserialized module.  The compile gateways deliberately compile
+  the ROUND-TRIPPED module even on a store (fresh compile), so the cold
+  and warm paths share one XLA-cache key and cached-vs-fresh results
+  are trivially identical.
+
+Safety contract: a stale or mismatched entry is a MISS, never a wrong
+answer.  The fingerprint covers jax/jaxlib version, backend + device
+kind, and a content hash of the solver sources; an entry that fails to
+deserialize is QUARANTINED (moved aside, ``progcache-corrupt-entries``
+meter) and the caller falls back to the compile path.  Stores are
+atomic (write-temp-then-rename), so two processes racing on one key
+leave exactly one valid entry.
+
+The process-wide singleton (`get_cache()`) starts DISABLED — nothing
+changes for code that never configures it.  The facade configures it
+from the ``progcache.*`` keys; ``progcache.dir`` empty keeps it off.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import tempfile
+import threading
+import time as _time
+from typing import Dict, List, Optional
+
+LOG = logging.getLogger(__name__)
+
+#: default size cap: 2 GiB of serialized StableHLO (entries at 2.6K-
+#: broker scale run single-digit MBs; the cap evicts oldest-first)
+DEFAULT_MAX_BYTES = 2 * 1024 * 1024 * 1024
+
+_BLOB_SUFFIX = ".hlo"
+_META_SUFFIX = ".json"
+_QUARANTINE_DIR = "quarantine"
+
+#: one-time jax.export pytree-serialization registration flag
+_EXPORT_REGISTERED = False
+
+
+def ensure_export_registrations() -> None:
+    """Register the solver's custom pytree dataclasses with jax.export
+    so their treedefs (including static aux fields: table widths,
+    topology counts, option flags) serialize into the StableHLO
+    envelope and round-trip exactly.  Idempotent; called lazily by the
+    load/store paths so plain (cache-off) runs never import
+    jax.export."""
+    global _EXPORT_REGISTERED
+    if _EXPORT_REGISTERED:
+        return
+    import pickle
+    from jax import export as jexport
+    from cruise_control_tpu.analyzer.context import (OptimizationContext,
+                                                     RoundCache)
+    from cruise_control_tpu.model.state import ClusterState
+    from cruise_control_tpu.model.stats import ClusterModelStats
+    for cls in (ClusterState, ClusterModelStats, OptimizationContext,
+                RoundCache):
+        try:
+            jexport.register_pytree_node_serialization(
+                cls,
+                serialized_name=f"cruise_control_tpu.{cls.__name__}",
+                serialize_auxdata=pickle.dumps,
+                deserialize_auxdata=pickle.loads)
+        except ValueError as exc:
+            # already registered (module reload) — registration is
+            # process-global in jax, the cache just needs it present
+            LOG.debug("progcache: export registration of %s skipped: "
+                      "%s", cls.__name__, exc)
+    _EXPORT_REGISTERED = True
+
+
+def _safe_name(program: str) -> str:
+    """Filesystem-safe spelling of a program key (``__seg_0_4__@mesh8``
+    is already safe; this guards plugin-provided names)."""
+    return "".join(c if (c.isalnum() or c in "_@.-") else "_"
+                   for c in program)
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """One on-disk entry (blob + sidecar meta) as the CLI sees it."""
+
+    path: str
+    program: str
+    goal_sig: str
+    shape_sig: str
+    fingerprint: str
+    size_bytes: int
+    age_s: float
+    hits: int
+    meta: dict
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "program": self.program,
+            "goalSig": self.goal_sig,
+            "shapeSig": self.shape_sig,
+            "fingerprint": self.fingerprint,
+            "sizeBytes": self.size_bytes,
+            "ageS": round(self.age_s, 1),
+            "hits": self.hits,
+        }
+
+
+class ProgramCache:
+    """Disk-backed program cache (see module docstring).
+
+    All methods are safe to call while disabled (they no-op / miss), so
+    the compile gateways need no enabled-checks of their own — the
+    byte-identical-when-disabled guarantee costs one attribute read."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.cache_dir: Optional[str] = None
+        self.max_bytes = DEFAULT_MAX_BYTES
+        self.fingerprint_override: Optional[str] = None
+        # counters (exported as progcache-* sensors by the facade)
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.corrupt_entries = 0
+        self.evictions = 0
+        self.export_errors = 0
+        #: compiles that had to TRACE a source program (cache miss or
+        #: cache off) — the coldstart bench pins this to 0 on a warm run
+        self.fresh_compiles = 0
+
+    # ------------------------------------------------------------------
+    # configuration
+    # ------------------------------------------------------------------
+    def configure(self, enabled: Optional[bool] = None,
+                  cache_dir: Optional[str] = None,
+                  max_bytes: Optional[int] = None,
+                  fingerprint_override: Optional[str] = None) -> None:
+        """Apply the progcache.* config; None leaves a field unchanged
+        (multi-tenant facades configure the shared singleton with
+        identical values, so re-configuration is idempotent)."""
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if cache_dir is not None:
+                self.cache_dir = cache_dir or None
+            if max_bytes is not None and max_bytes > 0:
+                self.max_bytes = int(max_bytes)
+            if fingerprint_override is not None:
+                self.fingerprint_override = fingerprint_override or None
+
+    @property
+    def active(self) -> bool:
+        return self.enabled and bool(self.cache_dir)
+
+    def is_active(self, goal_sig: Optional[str]) -> bool:
+        """Usable for this goal list?  A None signature (unshareable
+        goal state) never touches disk."""
+        return self.active and goal_sig is not None
+
+    def fingerprint(self) -> str:
+        from cruise_control_tpu.parallel.mesh import program_fingerprint
+        return program_fingerprint(self.fingerprint_override)
+
+    # ------------------------------------------------------------------
+    # paths
+    # ------------------------------------------------------------------
+    def _entry_base(self, program: str, goal_sig: str,
+                    shape_sig: str) -> str:
+        return os.path.join(self.cache_dir, self.fingerprint(), goal_sig,
+                            f"{_safe_name(program)}.{shape_sig}")
+
+    # ------------------------------------------------------------------
+    # load / store
+    # ------------------------------------------------------------------
+    def load_exported(self, program: str, goal_sig: Optional[str],
+                      shape_sig: str):
+        """The stored ``jax.export.Exported`` for a key, or None (miss).
+        Corrupt/undeserializable entries are quarantined, metered, and
+        reported as misses — the caller falls back to compiling."""
+        if not self.is_active(goal_sig):
+            return None
+        base = self._entry_base(program, goal_sig, shape_sig)
+        path = base + _BLOB_SUFFIX
+        if not os.path.exists(path):
+            with self._lock:
+                self.misses += 1
+            return None
+        try:
+            from jax import export as jexport
+            ensure_export_registrations()
+            with open(path, "rb") as fh:
+                blob = fh.read()
+            exported = jexport.deserialize(bytearray(blob))
+        except Exception as exc:  # noqa: BLE001 - ANY bad entry is a miss
+            LOG.warning("progcache: corrupt entry %s (%s): quarantined, "
+                        "falling back to compile", path,
+                        str(exc).splitlines()[0][:120])
+            self.quarantine(program, goal_sig, shape_sig)
+            with self._lock:
+                self.misses += 1
+            return None
+        with self._lock:
+            self.hits += 1
+        self._bump_meta_hits(base)
+        return exported
+
+    def store(self, program: str, goal_sig: Optional[str],
+              shape_sig: str, blob: bytes,
+              meta_extra: Optional[dict] = None) -> Optional[str]:
+        """Atomically persist one serialized export (+ sidecar meta).
+        write-temp-then-rename: concurrent writers of the same key each
+        publish a complete file and the LAST rename wins — a reader can
+        never observe a torn entry.  Returns the blob path, or None
+        when inactive or the write failed (disk full etc. must never
+        fail the solve that produced the program)."""
+        if not self.is_active(goal_sig):
+            return None
+        base = self._entry_base(program, goal_sig, shape_sig)
+        meta = {
+            "program": program,
+            "goalSig": goal_sig,
+            "shapeSig": shape_sig,
+            "fingerprint": self.fingerprint(),
+            "createdAt": _time.time(),
+            "sizeBytes": len(blob),
+            "hits": 0,
+        }
+        meta.update(meta_extra or {})
+        try:
+            os.makedirs(os.path.dirname(base), exist_ok=True)
+            self._atomic_write(base + _BLOB_SUFFIX, blob)
+            self._atomic_write(base + _META_SUFFIX,
+                               json.dumps(meta, indent=1).encode())
+        except OSError as exc:
+            LOG.warning("progcache: store of %s failed (%s); entry "
+                        "skipped (solve unaffected)", program, exc)
+            return None
+        with self._lock:
+            self.stores += 1
+        self._enforce_size_cap()
+        return base + _BLOB_SUFFIX
+
+    def _atomic_write(self, path: str, data: bytes) -> None:
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".tmp-", suffix="~")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _bump_meta_hits(self, base: str) -> None:
+        """Best-effort hit accounting in the sidecar (operator CLI
+        telemetry only; failures are irrelevant to correctness)."""
+        path = base + _META_SUFFIX
+        try:
+            with open(path) as fh:
+                meta = json.load(fh)
+            meta["hits"] = int(meta.get("hits", 0)) + 1
+            meta["lastHitAt"] = _time.time()
+            self._atomic_write(path, json.dumps(meta, indent=1).encode())
+        except (OSError, ValueError) as exc:
+            LOG.debug("progcache: hit-count update of %s skipped: %s",
+                      path, exc)
+
+    def quarantine(self, program: str, goal_sig: str,
+                   shape_sig: str) -> None:
+        """Move a bad entry (blob + meta) aside so it cannot be served
+        again; increments `corrupt_entries` (the
+        progcache-corrupt-entries meter)."""
+        base = self._entry_base(program, goal_sig, shape_sig)
+        qdir = os.path.join(self.cache_dir, _QUARANTINE_DIR)
+        try:
+            os.makedirs(qdir, exist_ok=True)
+            stamp = f"{int(_time.time() * 1e3):x}"
+            for suffix in (_BLOB_SUFFIX, _META_SUFFIX):
+                src = base + suffix
+                if os.path.exists(src):
+                    os.replace(src, os.path.join(
+                        qdir,
+                        f"{os.path.basename(base)}.{stamp}{suffix}"))
+        except OSError as exc:
+            LOG.warning("progcache: quarantine of %s failed: %s", base,
+                        exc)
+        with self._lock:
+            self.corrupt_entries += 1
+
+    # ------------------------------------------------------------------
+    # accounting used by the compile gateways
+    # ------------------------------------------------------------------
+    def count_fresh_compile(self) -> None:
+        with self._lock:
+            self.fresh_compiles += 1
+
+    def count_export_error(self) -> None:
+        with self._lock:
+            self.export_errors += 1
+
+    def reset_counters(self) -> None:
+        with self._lock:
+            self.hits = self.misses = self.stores = 0
+            self.corrupt_entries = self.evictions = 0
+            self.export_errors = self.fresh_compiles = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "dir": self.cache_dir,
+                "hits": self.hits,
+                "misses": self.misses,
+                "stores": self.stores,
+                "corruptEntries": self.corrupt_entries,
+                "evictions": self.evictions,
+                "exportErrors": self.export_errors,
+                "freshCompiles": self.fresh_compiles,
+            }
+
+    # ------------------------------------------------------------------
+    # enumeration / eviction (hydration + operator CLI)
+    # ------------------------------------------------------------------
+    def entries(self, goal_sig: Optional[str] = None,
+                all_fingerprints: bool = False) -> List[CacheEntry]:
+        """On-disk entries, oldest first.  By default only the CURRENT
+        fingerprint's entries (the addressable ones); the CLI passes
+        all_fingerprints=True to show stale generations too."""
+        if not self.active:
+            return []
+        now = _time.time()
+        out: List[CacheEntry] = []
+        try:
+            fingerprints = sorted(os.listdir(self.cache_dir))
+        except OSError:
+            return []
+        current = self.fingerprint()
+        for fp in fingerprints:
+            if fp == _QUARANTINE_DIR:
+                continue
+            if not all_fingerprints and fp != current:
+                continue
+            fp_dir = os.path.join(self.cache_dir, fp)
+            if not os.path.isdir(fp_dir):
+                continue
+            for gs in sorted(os.listdir(fp_dir)):
+                if goal_sig is not None and gs != goal_sig:
+                    continue
+                gdir = os.path.join(fp_dir, gs)
+                if not os.path.isdir(gdir):
+                    continue
+                for name in sorted(os.listdir(gdir)):
+                    if not name.endswith(_BLOB_SUFFIX):
+                        continue
+                    path = os.path.join(gdir, name)
+                    meta = {}
+                    try:
+                        with open(path[:-len(_BLOB_SUFFIX)]
+                                  + _META_SUFFIX) as fh:
+                            meta = json.load(fh)
+                    except (OSError, ValueError):
+                        pass
+                    stem = name[:-len(_BLOB_SUFFIX)]
+                    program, _, shape_sig = stem.rpartition(".")
+                    try:
+                        st = os.stat(path)
+                    except OSError:
+                        continue
+                    out.append(CacheEntry(
+                        path=path,
+                        program=meta.get("program", program),
+                        goal_sig=gs, shape_sig=shape_sig,
+                        fingerprint=fp, size_bytes=st.st_size,
+                        age_s=max(0.0, now - st.st_mtime),
+                        hits=int(meta.get("hits", 0)), meta=meta))
+        out.sort(key=lambda e: -e.age_s)
+        return out
+
+    def evict_entry(self, entry: CacheEntry) -> bool:
+        try:
+            os.unlink(entry.path)
+            meta = entry.path[:-len(_BLOB_SUFFIX)] + _META_SUFFIX
+            if os.path.exists(meta):
+                os.unlink(meta)
+        except OSError as exc:
+            LOG.warning("progcache: eviction of %s failed: %s",
+                        entry.path, exc)
+            return False
+        with self._lock:
+            self.evictions += 1
+        return True
+
+    def _enforce_size_cap(self) -> None:
+        entries = self.entries(all_fingerprints=True)
+        total = sum(e.size_bytes for e in entries)
+        if total <= self.max_bytes:
+            return
+        for entry in entries:          # oldest first
+            if total <= self.max_bytes:
+                break
+            if self.evict_entry(entry):
+                total -= entry.size_bytes
+                LOG.info("progcache: size cap %d exceeded; evicted %s "
+                         "(%d bytes)", self.max_bytes, entry.path,
+                         entry.size_bytes)
+
+
+#: process-wide singleton — one disk cache serves every optimizer,
+#: scenario engine and tenant in the process (sharing across tenants in
+#: one bucket is the whole point)
+_CACHE = ProgramCache()
+
+
+def get_cache() -> ProgramCache:
+    return _CACHE
+
+
+def configure(enabled: Optional[bool] = None,
+              cache_dir: Optional[str] = None,
+              max_bytes: Optional[int] = None,
+              fingerprint_override: Optional[str] = None) -> ProgramCache:
+    _CACHE.configure(enabled=enabled, cache_dir=cache_dir,
+                     max_bytes=max_bytes,
+                     fingerprint_override=fingerprint_override)
+    return _CACHE
+
+
+#: export-metadata helper shared by the optimizer/engine gateways
+def export_meta(exported) -> Dict[str, object]:
+    import jax
+    import jaxlib
+    return {
+        "jaxVersion": jax.__version__,
+        "jaxlibVersion": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "nrDevices": int(getattr(exported, "nr_devices", 1)),
+    }

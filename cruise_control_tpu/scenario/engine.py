@@ -755,8 +755,8 @@ class ScenarioEngine:
             if jax.default_backend() == "cpu":
                 donate = ()
             t0 = self._time()
-            prog = jax.jit(jax.vmap(fn),
-                           donate_argnums=donate).lower(*args).compile()
+            prog = self._compile_batched(gk, key, fn, donate, shapes,
+                                         args)
             dt = self._time() - t0
             self.last_compile_s += dt
             if self._metrics is not None:
@@ -772,6 +772,59 @@ class ScenarioEngine:
                 while len(self._programs) > self._max_programs:
                     self._programs.popitem(last=False)
         return entry[0](*args)
+
+    def _compile_batched(self, gk, key: str, fn, donate: tuple,
+                         shapes: tuple, args):
+        """Compile gateway for the vmapped programs — the same
+        persistent-cache protocol as GoalOptimizer's, under the SHARED
+        key helpers (parallel/mesh.py) so the engine's keyspace cannot
+        drift from the optimizer's: program key (the mesh-lane span
+        rides the '@meshN' suffix exactly like the optimizer's mesh
+        programs), goal-list signature, input-tree signature (which
+        subsumes the in-memory `shapes` tuple: lane count, padding and
+        table width are all argument avals), fingerprint.  Hit →
+        deserialize + recompile (zero tracing, donation re-applied);
+        miss → trace + export + store + compile the round-tripped
+        module (one XLA-cache key for cold and warm)."""
+        import jax
+        from cruise_control_tpu.parallel import mesh as mesh_mod
+        from cruise_control_tpu.parallel import progcache as progcache_mod
+        cache = progcache_mod.get_cache()
+        gsig = mesh_mod.goal_list_signature(gk)
+        mesh_k = shapes[-2] if len(shapes) >= 2 else 0
+        pkey = mesh_mod.program_key(f"__vmap{key}",
+                                    mesh_k if mesh_k else 1)
+        shape_sig = mesh_mod.tree_signature(args)
+        exported = cache.load_exported(pkey, gsig, shape_sig)
+        if exported is not None:
+            try:
+                return jax.jit(exported.call,
+                               donate_argnums=donate).lower(
+                    *args).compile()
+            except Exception as exc:  # noqa: BLE001 - bad entry => miss
+                LOG.warning("progcache: compiling cached %s failed "
+                            "(%s); quarantining and recompiling from "
+                            "source", pkey,
+                            str(exc).splitlines()[0][:120])
+                cache.quarantine(pkey, gsig, shape_sig)
+        cache.count_fresh_compile()
+        program = jax.jit(jax.vmap(fn), donate_argnums=donate)
+        if cache.is_active(gsig):
+            from jax import export as jexport
+            try:
+                progcache_mod.ensure_export_registrations()
+                blob = bytes(jexport.export(program)(*args).serialize())
+                cache.store(pkey, gsig, shape_sig, blob)
+                return jax.jit(jexport.deserialize(bytearray(blob)).call,
+                               donate_argnums=donate).lower(
+                    *args).compile()
+            except Exception as exc:  # noqa: BLE001 - the cache layer
+                # must never fail the compile it fronts
+                LOG.warning("progcache: export of %s failed (%s); "
+                            "compiling without the persistent tier",
+                            pkey, str(exc).splitlines()[0][:120])
+                cache.count_export_error()
+        return program.lower(*args).compile()
 
 
 def _pad_lane_axis(k: int, pad: int, *trees):

@@ -79,5 +79,6 @@ def _relieve_xla_process_pressure():
     with _opt._SHARED_LOCK:
         _opt._SHARED_PROGRAMS.clear()
         _opt._SHARED_LRU.clear()
+        _opt._SHARED_AOT.clear()
     jax.clear_caches()
     yield

@@ -1,0 +1,353 @@
+"""Persistent compiled-program cache (parallel/progcache.py).
+
+Tier-1 `progcache` marker coverage per the ISSUE-8 acceptance criteria:
+
+* cached-vs-fresh byte equality: a solve served by hydrated cache
+  entries returns proposals IDENTICAL to the fresh-compile run, and the
+  cache-enabled path is byte-identical to the cache-disabled path;
+* warm "cold start" performs ZERO source-program compiles (pinned via
+  the gateway compile-count instrumentation AND the empty shared
+  jit-program dict);
+* stale-fingerprint rejection: a bumped fingerprint term makes every
+  old entry a miss (recompile), never a wrong answer;
+* corrupt-entry quarantine: a truncated blob falls back to the compile
+  path, increments progcache-corrupt-entries, moves the entry aside,
+  and never crashes;
+* concurrent-writer safety: two writers storing the same key through
+  the atomic write-temp-then-rename leave exactly one valid entry.
+
+The pipeline rig runs ONCE per module (module fixture) on a tiny
+skewed 6-broker cluster with a 2-goal stack so the compile cost stays
+inside the tier-1 smoke budget.
+"""
+import os
+import threading
+
+import conftest  # noqa: F401  (forces the CPU platform before jax loads)
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cruise_control_tpu.analyzer import optimizer as opt_mod
+from cruise_control_tpu.analyzer.context import OptimizationOptions
+from cruise_control_tpu.analyzer.goals.registry import default_goals
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.parallel import mesh as mesh_mod
+from cruise_control_tpu.parallel import progcache
+from cruise_control_tpu.testing.random_cluster import (RandomClusterSpec,
+                                                       random_cluster)
+
+pytestmark = pytest.mark.progcache
+
+GOALS = ["ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+
+
+def _proposal_key(result):
+    return sorted(
+        (p.partition.topic, p.partition.partition,
+         tuple((r.broker_id, r.logdir) for r in p.new_replicas))
+        for p in result.proposals)
+
+
+def _make_optimizer():
+    return GoalOptimizer(default_goals(max_rounds=8, names=GOALS),
+                         pipeline_segment_size=4)
+
+
+def _simulate_restart():
+    """Drop every in-process compiled artifact, keeping only the disk
+    cache — the closest a test can get to a process bounce."""
+    with opt_mod._SHARED_LOCK:
+        opt_mod._SHARED_PROGRAMS.clear()
+        opt_mod._SHARED_LRU.clear()
+        opt_mod._SHARED_AOT.clear()
+    jax.clear_caches()
+    progcache.get_cache().reset_counters()
+
+
+@pytest.fixture()
+def cache_tmp(tmp_path):
+    """Configure the process-wide cache onto a fresh temp dir; restore
+    the disabled default afterwards so no other test sees it."""
+    cache = progcache.get_cache()
+    prev = (cache.enabled, cache.cache_dir, cache.max_bytes,
+            cache.fingerprint_override)
+    cache.configure(enabled=True, cache_dir=str(tmp_path))
+    cache.reset_counters()
+    yield cache
+    cache.enabled, cache.cache_dir, cache.max_bytes, \
+        cache.fingerprint_override = prev
+    cache.reset_counters()
+
+
+# ---------------------------------------------------------------------------
+# key / fingerprint helpers (parallel/mesh.py — the shared keyspace)
+# ---------------------------------------------------------------------------
+
+class TestKeyHelpers:
+    def test_program_key_mesh_suffix(self):
+        assert mesh_mod.program_key("__pre__") == "__pre__"
+        assert mesh_mod.program_key("__pre__", 1) == "__pre__"
+        assert mesh_mod.program_key("__pre__", 8) == "__pre__@mesh8"
+
+    def test_goal_list_signature(self):
+        assert mesh_mod.goal_list_signature(None) is None
+        a = mesh_mod.goal_list_signature((("m", "G", (("k", 1),)),))
+        b = mesh_mod.goal_list_signature((("m", "G", (("k", 1),)),))
+        c = mesh_mod.goal_list_signature((("m", "G", (("k", 2),)),))
+        assert a == b and a != c and len(a) == 16
+
+    def test_tree_signature_shapes_and_statics(self):
+        x = jnp.ones((4, 2))
+        assert (mesh_mod.tree_signature((x, 3))
+                == mesh_mod.tree_signature((jnp.zeros((4, 2)), 3)))
+        assert (mesh_mod.tree_signature((x, 3))
+                != mesh_mod.tree_signature((x, 4)))
+        assert (mesh_mod.tree_signature((x,))
+                != mesh_mod.tree_signature((jnp.ones((5, 2)),)))
+
+    def test_fingerprint_override_changes_one_term(self):
+        base = mesh_mod.program_fingerprint()
+        a = mesh_mod.program_fingerprint("vA")
+        assert mesh_mod.program_fingerprint("vA") == a
+        assert a != base != mesh_mod.program_fingerprint("vB")
+
+
+# ---------------------------------------------------------------------------
+# cache store/load mechanics (trivial exports; no pipeline compiles)
+# ---------------------------------------------------------------------------
+
+def _trivial_blob(scale=2.0):
+    from jax import export as jexport
+    progcache.ensure_export_registrations()
+    exported = jexport.export(jax.jit(lambda x: x * scale))(
+        jnp.ones((4,), jnp.float32))
+    return bytes(exported.serialize())
+
+
+class TestCacheMechanics:
+    def test_roundtrip_and_hit_accounting(self, cache_tmp):
+        blob = _trivial_blob()
+        path = cache_tmp.store("__t__", "g" * 16, "s" * 16, blob)
+        assert path is not None and os.path.exists(path)
+        exported = cache_tmp.load_exported("__t__", "g" * 16, "s" * 16)
+        assert exported is not None
+        out = jax.jit(exported.call)(jnp.full((4,), 3.0))
+        assert float(out[0]) == 6.0
+        assert cache_tmp.stats()["hits"] == 1
+        assert cache_tmp.stats()["stores"] == 1
+        [entry] = cache_tmp.entries()
+        assert entry.program == "__t__" and entry.hits == 1
+
+    def test_unshareable_goal_list_never_touches_disk(self, cache_tmp):
+        assert cache_tmp.store("__t__", None, "s" * 16,
+                               b"ignored") is None
+        assert cache_tmp.load_exported("__t__", None, "s" * 16) is None
+        assert cache_tmp.entries() == []
+
+    def test_disabled_is_inert(self, cache_tmp, tmp_path):
+        cache_tmp.configure(enabled=False)
+        assert cache_tmp.store("__t__", "g" * 16, "s" * 16,
+                               _trivial_blob()) is None
+        assert cache_tmp.load_exported("__t__", "g" * 16,
+                                       "s" * 16) is None
+        assert os.listdir(tmp_path) == []
+
+    def test_corrupt_entry_quarantined(self, cache_tmp, tmp_path):
+        path = cache_tmp.store("__t__", "g" * 16, "s" * 16,
+                               _trivial_blob())
+        with open(path, "wb") as fh:       # truncate to garbage
+            fh.write(b"not stablehlo")
+        assert cache_tmp.load_exported("__t__", "g" * 16,
+                                       "s" * 16) is None
+        assert cache_tmp.stats()["corruptEntries"] == 1
+        assert not os.path.exists(path)    # moved aside, not served
+        qdir = tmp_path / "quarantine"
+        assert qdir.is_dir() and len(list(qdir.iterdir())) >= 1
+        # second lookup: plain miss, no double-count
+        assert cache_tmp.load_exported("__t__", "g" * 16,
+                                       "s" * 16) is None
+        assert cache_tmp.stats()["corruptEntries"] == 1
+
+    def test_stale_fingerprint_is_a_miss(self, cache_tmp):
+        cache_tmp.configure(fingerprint_override="vA")
+        cache_tmp.store("__t__", "g" * 16, "s" * 16, _trivial_blob())
+        assert cache_tmp.load_exported("__t__", "g" * 16,
+                                       "s" * 16) is not None
+        # bumped source hash (simulated via the override term) => miss
+        cache_tmp.configure(fingerprint_override="vB")
+        assert cache_tmp.load_exported("__t__", "g" * 16,
+                                       "s" * 16) is None
+        assert cache_tmp.entries() == []   # current generation is empty
+        assert len(cache_tmp.entries(all_fingerprints=True)) == 1
+        # rolling back re-addresses the old generation losslessly
+        cache_tmp.configure(fingerprint_override="vA")
+        assert cache_tmp.load_exported("__t__", "g" * 16,
+                                       "s" * 16) is not None
+
+    def test_concurrent_writers_one_valid_entry(self, cache_tmp):
+        blob = _trivial_blob()
+        barrier = threading.Barrier(2)
+        errors = []
+
+        def writer():
+            try:
+                barrier.wait(timeout=10)
+                cache_tmp.store("__race__", "g" * 16, "s" * 16, blob)
+            except Exception as exc:  # noqa: BLE001 - the test fails on
+                # ANY writer error
+                errors.append(exc)
+        threads = [threading.Thread(target=writer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        assert cache_tmp.stats()["stores"] == 2
+        entries = [e for e in cache_tmp.entries()
+                   if e.program == "__race__"]
+        assert len(entries) == 1           # one key, one file
+        assert cache_tmp.load_exported("__race__", "g" * 16,
+                                       "s" * 16) is not None
+
+    def test_size_cap_evicts_oldest(self, cache_tmp):
+        blob = _trivial_blob()
+        for i in range(3):
+            path = cache_tmp.store(f"__e{i}__", "g" * 16, "s" * 16,
+                                   blob)
+            os.utime(path, (i + 1, i + 1))      # deterministic ages
+        cache_tmp.configure(max_bytes=2 * len(blob) + 1)
+        cache_tmp._enforce_size_cap()
+        kept = {e.program for e in cache_tmp.entries()}
+        assert "__e0__" not in kept            # oldest went first
+        assert cache_tmp.stats()["evictions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# the pipeline rig: cold store -> restart -> hydrated warm solve
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pipeline_rig(tmp_path_factory):
+    cache = progcache.get_cache()
+    prev = (cache.enabled, cache.cache_dir, cache.max_bytes,
+            cache.fingerprint_override)
+    cache_dir = str(tmp_path_factory.mktemp("progcache"))
+    # skewed leaders so the distribution goal actually proposes moves
+    # (the equality pins must compare real placements)
+    state, topo = random_cluster(RandomClusterSpec(
+        seed=3, num_brokers=6, num_partitions=40, replication_factor=2,
+        num_racks=3, num_topics=4, skew_fraction=0.5))
+    options = OptimizationOptions()
+    try:
+        # baseline: cache DISABLED — the exact pre-cache compile path
+        cache.configure(enabled=False)
+        baseline = _make_optimizer().optimizations(state, topo, options)
+        # the equality pins below must compare real placements, not
+        # empty lists — the skewed fixture must produce moves
+        assert baseline.proposals, "fixture produced no proposals"
+
+        # cold pass: cache enabled + empty — compiles, stores exports
+        _simulate_restart()
+        cache.configure(enabled=True, cache_dir=cache_dir)
+        cold_opt = _make_optimizer()
+        cold_opt.warmup(state, topo, options)
+        cold_stats = cache.stats()
+        cold = cold_opt.optimizations(state, topo, options)
+
+        # warm pass: fresh process state, hydrate from disk, solve
+        _simulate_restart()
+        warm_opt = _make_optimizer()
+        hydrated = warm_opt.hydrate_from_cache()
+        warm = warm_opt.optimizations(state, topo, options)
+        warm_stats = cache.stats()
+        warm_shared_programs = len(opt_mod._SHARED_PROGRAMS)
+
+        # corrupt pass: truncate one entry, hydrate again, solve — the
+        # bad program falls back to the compile path, nothing crashes
+        _simulate_restart()
+        victim = cache.entries()[0]
+        with open(victim.path, "r+b") as fh:
+            fh.truncate(16)
+        corrupt_opt = _make_optimizer()
+        corrupt_hydrated = corrupt_opt.hydrate_from_cache()
+        corrupt = corrupt_opt.optimizations(state, topo, options)
+        corrupt_stats = cache.stats()
+        yield {
+            "baseline": _proposal_key(baseline),
+            "cold": _proposal_key(cold), "cold_stats": cold_stats,
+            "warm": _proposal_key(warm), "warm_stats": warm_stats,
+            "hydrated": hydrated,
+            "warm_shared_programs": warm_shared_programs,
+            "corrupt": _proposal_key(corrupt),
+            "corrupt_hydrated": corrupt_hydrated,
+            "corrupt_stats": corrupt_stats,
+        }
+    finally:
+        cache.enabled, cache.cache_dir, cache.max_bytes, \
+            cache.fingerprint_override = prev
+        cache.reset_counters()
+        _simulate_restart()
+
+
+class TestPipelineColdWarm:
+    def test_cold_pass_stores_entries(self, pipeline_rig):
+        s = pipeline_rig["cold_stats"]
+        assert s["stores"] > 0 and s["freshCompiles"] > 0
+
+    def test_enabled_path_byte_identical_to_disabled(self, pipeline_rig):
+        assert pipeline_rig["cold"] == pipeline_rig["baseline"]
+
+    def test_warm_solve_byte_identical_and_zero_compiles(
+            self, pipeline_rig):
+        assert pipeline_rig["hydrated"] > 0
+        assert pipeline_rig["warm"] == pipeline_rig["cold"]
+        s = pipeline_rig["warm_stats"]
+        # THE acceptance pin: a warm cold-start traces/compiles no
+        # source program (gateway counter) and never even builds a
+        # shared jit wrapper (every dispatch served by hydrated AOTs)
+        assert s["freshCompiles"] == 0, s
+        assert s["hits"] >= pipeline_rig["hydrated"]
+        assert pipeline_rig["warm_shared_programs"] == 0
+
+    def test_corrupt_entry_falls_back_without_crash(self, pipeline_rig):
+        s = pipeline_rig["corrupt_stats"]
+        assert s["corruptEntries"] >= 1
+        assert pipeline_rig["corrupt"] == pipeline_rig["cold"]
+        # the surviving entries still hydrated
+        assert pipeline_rig["corrupt_hydrated"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# fleet onboarding warms from the cache (registry hook)
+# ---------------------------------------------------------------------------
+
+class TestFleetRegisterWarm:
+    def _registry(self):
+        from cruise_control_tpu.fleet import FleetRegistry
+        from cruise_control_tpu.sched.policy import SchedulerPolicy
+        from cruise_control_tpu.sched.scheduler import DeviceTimeScheduler
+        return FleetRegistry(DeviceTimeScheduler(SchedulerPolicy.default()))
+
+    def test_register_calls_warm_hook(self):
+        calls = []
+
+        class _Facade:
+            def warm_programs_from_cache(self):
+                calls.append(1)
+                return 3
+
+            def shutdown(self):
+                pass
+        fleet = self._registry()
+        fleet.register("a", _Facade(), default=True)
+        assert calls == [1]
+        fleet.shutdown()
+
+    def test_register_tolerates_stub_without_hook(self):
+        class _Stub:
+            def shutdown(self):
+                pass
+        fleet = self._registry()
+        fleet.register("a", _Stub(), default=True)   # must not raise
+        fleet.shutdown()

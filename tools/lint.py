@@ -23,6 +23,12 @@ Checks:
     acquisition outside sched/ + facade.py (and the solver
     implementation) — the scheduler's mesh token is the only path to
     multi-chip dispatch (the PR-6 invariant);
+  * cache-gateway rule: no `jax.jit(...)`, `.lower(...).compile()`
+    chain, or `jax.export` use in cruise_control_tpu/ outside the
+    shared persistent-cache helper (parallel/progcache.py) and the
+    optimizer/engine compile gateways — a compile that bypasses the
+    gateway is invisible to the persistent program cache and silently
+    re-pays the ~300s cold start (the PR-7 invariant);
   * tenant-root rule: no mutable module-level state in fleet-reachable
     modules (cruise_control_tpu/fleet/) — the FleetRegistry INSTANCE is
     the only root of per-tenant state, so draining a tenant provably
@@ -214,6 +220,62 @@ def _mesh_violations(path: Path, tree: ast.AST) -> list:
     return findings
 
 
+#: package-relative paths allowed to build XLA programs directly: the
+#: two compile gateways (GoalOptimizer._compile_through_cache /
+#: _jit_program and ScenarioEngine._compile_batched) and the persistent
+#: cache implementation itself.  Everything else must reach compilation
+#: through them — that is what makes the persistent program cache a
+#: true write-through tier: a compile that bypasses the gateway is
+#: invisible to the cache and silently re-pays the ~300s cold start.
+_PROGCACHE_ALLOWED_RELPATHS = {"analyzer/optimizer.py",
+                               "scenario/engine.py",
+                               "parallel/progcache.py"}
+
+
+def _progcache_violations(path: Path, tree: ast.AST) -> list:
+    """Cache-gateway rule: no `jax.jit(...)`, `.lower(...).compile()`
+    chain, or `jax.export` use in the package outside the shared cache
+    helper and the optimizer/engine compile paths — every program
+    compile must go through the persistent program cache (the PR-7
+    invariant, same pattern as the PR-4 single-gateway and PR-6 mesh
+    rules)."""
+    parts = path.parts
+    if "cruise_control_tpu" not in parts:
+        return []
+    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
+    rel = "/".join(parts[pkg + 1:])
+    if rel in _PROGCACHE_ALLOWED_RELPATHS:
+        return []
+    findings = []
+    allowed = ", ".join(sorted(_PROGCACHE_ALLOWED_RELPATHS))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        what = None
+        if (func.attr == "jit"
+                and _receiver_name(func.value) == "jax"):
+            what = "jax.jit"
+        elif (func.attr == "compile"
+              and isinstance(func.value, ast.Call)
+              and isinstance(func.value.func, ast.Attribute)
+              and func.value.func.attr == "lower"):
+            what = ".lower().compile()"
+        elif (func.attr in ("export", "deserialize",
+                            "register_pytree_node_serialization")
+              and _receiver_name(func.value) in ("export", "jexport")):
+            what = f"jax.export.{func.attr}"
+        if what is not None:
+            findings.append(
+                f"{path}:{node.lineno}: direct program compile ({what}) "
+                f"outside the compile gateways ({allowed}) — every XLA "
+                f"compile must go through the persistent program cache "
+                f"(cache-gateway rule)")
+    return findings
+
+
 #: constructor names whose module-scope call sites create MUTABLE
 #: containers (per-tenant state could silently accrete in them)
 _MUTABLE_CONSTRUCTORS = {"list", "dict", "set", "bytearray", "deque",
@@ -328,6 +390,7 @@ def lint_file(path: Path) -> list:
     findings.extend(_silent_swallows(path, tree))
     findings.extend(_gateway_violations(path, tree))
     findings.extend(_mesh_violations(path, tree))
+    findings.extend(_progcache_violations(path, tree))
     findings.extend(_fleet_mutable_globals(path, tree))
 
     # unused imports: __init__.py files are re-export surfaces; a module

@@ -1,0 +1,249 @@
+"""Operator CLI for the persistent compiled-program cache.
+
+Subcommands (all take ``--dir``, default ``.progcache``):
+
+  list     table of entries: program, goal/shape signature, fingerprint,
+           age, size, recorded hit count (current fingerprint only by
+           default; --all shows stale generations)
+  inspect  one entry's sidecar meta + the deserialized export's
+           input avals / device span
+  verify   deserialize every entry; corrupt ones are reported and (with
+           --quarantine) moved aside exactly like the serving path does
+  evict    delete entries: --all, --stale (non-current fingerprints),
+           --older-than SECONDS, or --max-bytes N (oldest-first down to
+           the cap)
+  warm     pre-populate the cache for the DEFAULT goal stack offline
+           (`make warm-cache`): builds a synthetic cluster of the given
+           geometry and runs the cache-first warmup, so the next
+           process/tenant with that shape bucket cold-starts in seconds
+
+Exit code 1 when verify finds corrupt entries; 0 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _cache(args):
+    from cruise_control_tpu.parallel import progcache
+    cache = progcache.get_cache()
+    cache.configure(enabled=True, cache_dir=args.dir)
+    return cache
+
+
+def _fmt_age(seconds: float) -> str:
+    if seconds < 120:
+        return f"{seconds:.0f}s"
+    if seconds < 7200:
+        return f"{seconds / 60:.0f}m"
+    if seconds < 172800:
+        return f"{seconds / 3600:.1f}h"
+    return f"{seconds / 86400:.1f}d"
+
+
+def cmd_list(args) -> int:
+    cache = _cache(args)
+    entries = cache.entries(all_fingerprints=args.all)
+    if args.json:
+        print(json.dumps([e.to_json() for e in entries], indent=1))
+        return 0
+    current = cache.fingerprint()
+    print(f"{'#':>3} {'program':<28} {'goal':<10} {'shapes':<10} "
+          f"{'fprint':<10} {'age':>6} {'size':>9} {'hits':>5}")
+    total = 0
+    for i, e in enumerate(entries):
+        stale = "" if e.fingerprint == current else " (stale)"
+        print(f"{i:>3} {e.program:<28} {e.goal_sig[:8]:<10} "
+              f"{e.shape_sig[:8]:<10} {e.fingerprint[:8]:<10}"
+              f"{stale} {_fmt_age(e.age_s):>6} {e.size_bytes:>9} "
+              f"{e.hits:>5}")
+        total += e.size_bytes
+    print(f"# {len(entries)} entries, {total} bytes "
+          f"(fingerprint {current})", file=sys.stderr)
+    return 0
+
+
+def _pick(args, cache):
+    entries = cache.entries(all_fingerprints=True)
+    sel = args.entry
+    if sel.isdigit() and int(sel) < len(entries):
+        return entries[int(sel)]
+    for e in entries:
+        if e.path == sel or e.program == sel:
+            return e
+    sys.exit(f"no entry matching {sel!r} (index, program name or path)")
+
+
+def cmd_inspect(args) -> int:
+    cache = _cache(args)
+    entry = _pick(args, cache)
+    out = entry.to_json()
+    out["meta"] = entry.meta
+    exported = cache.load_exported(entry.program, entry.goal_sig,
+                                   entry.shape_sig)
+    if exported is not None:
+        out["inAvals"] = [f"{tuple(a.shape)}:{a.dtype}"
+                          for a in exported.in_avals]
+        out["nrDevices"] = int(getattr(exported, "nr_devices", 1))
+        out["platforms"] = list(getattr(exported, "platforms", ()))
+    else:
+        out["deserialize"] = "FAILED (entry quarantined)"
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_verify(args) -> int:
+    cache = _cache(args)
+    entries = cache.entries(all_fingerprints=True)
+    bad = 0
+    for e in entries:
+        try:
+            from jax import export as jexport
+            from cruise_control_tpu.parallel.progcache import \
+                ensure_export_registrations
+            ensure_export_registrations()
+            with open(e.path, "rb") as fh:
+                jexport.deserialize(bytearray(fh.read()))
+            status = "ok"
+        except Exception as exc:  # noqa: BLE001 - verify reports ANY
+            # undeserializable entry, whatever broke it
+            status = f"CORRUPT ({type(exc).__name__})"
+            bad += 1
+            if args.quarantine:
+                cache.quarantine(e.program, e.goal_sig, e.shape_sig)
+                status += " -> quarantined"
+        print(f"{e.path}: {status}")
+    print(f"# {len(entries)} entries, {bad} corrupt", file=sys.stderr)
+    return 1 if bad else 0
+
+
+def cmd_evict(args) -> int:
+    cache = _cache(args)
+    entries = cache.entries(all_fingerprints=True)
+    current = cache.fingerprint()
+    victims = []
+    if args.all:
+        victims = entries
+    elif args.stale:
+        victims = [e for e in entries if e.fingerprint != current]
+    elif args.older_than is not None:
+        victims = [e for e in entries if e.age_s > args.older_than]
+    elif args.max_bytes is not None:
+        total = sum(e.size_bytes for e in entries)
+        for e in entries:  # oldest first
+            if total <= args.max_bytes:
+                break
+            victims.append(e)
+            total -= e.size_bytes
+    else:
+        sys.exit("evict needs one of --all / --stale / "
+                 "--older-than / --max-bytes")
+    removed = sum(1 for e in victims if cache.evict_entry(e))
+    print(f"# evicted {removed}/{len(victims)} entries",
+          file=sys.stderr)
+    return 0
+
+
+def cmd_warm(args) -> int:
+    import time
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(args.dir, "xla"))
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    cache = _cache(args)
+    from cruise_control_tpu.analyzer.context import OptimizationOptions
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.testing.random_cluster import (
+        RandomClusterSpec, random_cluster)
+
+    names = args.goals.split(",") if args.goals else None
+    state, topo = random_cluster(RandomClusterSpec(
+        num_brokers=args.brokers, num_partitions=args.partitions,
+        replication_factor=args.rf, seed=7))
+    if args.bucket_floor:
+        # pad to the fleet shape bucket so the warmed entries address
+        # the same keys tenant solves will (fleet/buckets.py geometry)
+        from cruise_control_tpu.fleet.buckets import BucketIndex
+        state = BucketIndex(floor=args.bucket_floor).pad(state)
+    optimizer = GoalOptimizer(default_goals(names=names),
+                              pipeline_segment_size=args.segment)
+    mesh = None
+    if args.mesh > 1:
+        from cruise_control_tpu.parallel.mesh import runtime_mesh
+        mesh = runtime_mesh(enabled=True, max_devices=args.mesh).mesh
+    t0 = time.time()
+    optimizer.warmup(state, topo, OptimizationOptions(), mesh=mesh)
+    stats = cache.stats()
+    print(json.dumps({
+        "warmS": round(time.time() - t0, 2),
+        "brokers": state.num_brokers,
+        "partitions": state.num_partitions,
+        "mesh": args.mesh,
+        "hits": stats["hits"],
+        "stores": stats["stores"],
+        "freshCompiles": stats["freshCompiles"],
+    }))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="program_cache",
+        description="inspect/maintain the persistent compiled-program "
+                    "cache (docs/PROGRAM_CACHE.md)")
+    parser.add_argument("--dir", default=".progcache",
+                        help="cache directory (progcache.dir)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("list", help="list entries")
+    p.add_argument("--all", action="store_true",
+                   help="include stale fingerprint generations")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_list)
+    p = sub.add_parser("inspect", help="show one entry's metadata")
+    p.add_argument("entry", help="index (from list), program name or path")
+    p.set_defaults(fn=cmd_inspect)
+    p = sub.add_parser("verify", help="deserialize every entry")
+    p.add_argument("--quarantine", action="store_true",
+                   help="move corrupt entries aside")
+    p.set_defaults(fn=cmd_verify)
+    p = sub.add_parser("evict", help="delete entries")
+    p.add_argument("--all", action="store_true")
+    p.add_argument("--stale", action="store_true",
+                   help="non-current fingerprints only")
+    p.add_argument("--older-than", type=float, default=None,
+                   metavar="SECONDS")
+    p.add_argument("--max-bytes", type=int, default=None)
+    p.set_defaults(fn=cmd_evict)
+    p = sub.add_parser("warm",
+                       help="pre-populate the cache for the default "
+                            "goal stack (make warm-cache)")
+    p.add_argument("--brokers", type=int,
+                   default=int(os.environ.get("WARM_BROKERS", 64)))
+    p.add_argument("--partitions", type=int,
+                   default=int(os.environ.get("WARM_PARTITIONS", 2000)))
+    p.add_argument("--rf", type=int, default=3)
+    p.add_argument("--segment", type=int, default=4)
+    p.add_argument("--goals", default="",
+                   help="comma-separated goal names (default stack "
+                        "when empty)")
+    p.add_argument("--mesh", type=int, default=1,
+                   help="warm the @meshN programs over N devices")
+    p.add_argument("--bucket-floor", type=int, default=0,
+                   help="pad the model to the fleet shape bucket first "
+                        "(fleet.bucket.floor) so fleet tenants hit the "
+                        "warmed entries")
+    p.set_defaults(fn=cmd_warm)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
