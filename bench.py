@@ -58,6 +58,25 @@ BENCH_CONFIG selects a BASELINE.json eval config:
                    p99, >1 = the scheduler wins via coalescing +
                    ordering)
 
+  incremental      device-resident incremental workload model
+                   (model/store.py + monitor/deltas.py): one live
+                   facade stack serves a BENCH_INCR_DELTAS-long
+                   (default 64) interactive delta stream (single-broker
+                   capacity changes + hot-partition load updates), each
+                   delta followed by a USER_INTERACTIVE rebalance —
+                   store-served, warm-started, dirty-region-restricted
+                   — vs a twin facade with incremental.enabled=false
+                   paying the full re-materialize + full-sweep per
+                   request.  Records p50/p99 per path, store
+                   hit/fallback/delta-apply counts and dirty sizes.
+                   EXITS 1 unless (a) the single-broker-delta p50 is
+                   >= 5x faster through the store than the full path
+                   and (b) the delta-applied resident model is
+                   byte-identical to a from-scratch rebuild after the
+                   whole stream (the output JSON carries an
+                   "incremental" block; value = incremental p50
+                   seconds, vs_baseline = full p50 / incremental p50)
+
   coldstart        persistent-program-cache cold start
                    (parallel/progcache.py): measures cold-process
                    time-to-first-proposal twice in FRESH subprocesses —
@@ -107,6 +126,15 @@ import sys
 import time
 
 TARGET_SECONDS = 5.0
+
+
+def _pct(values, q):
+    """Nearest-rank percentile (shared by the sched and incremental
+    latency benches)."""
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1,
+                       int(round(q * (len(ordered) - 1))))]
+
 
 # persistent compile cache: segment programs at 2.6K-broker scale take
 # minutes to compile; retries and re-runs must not pay that twice
@@ -209,6 +237,8 @@ def main() -> None:
         return _mesh_bench()
     if config == "coldstart":
         return _coldstart_bench()
+    if config == "incremental":
+        return _incremental_bench()
     presets = {  # (brokers, partitions, goal subset, metric label)
         "north": (2600, 200_000, None, "full-stack proposal generation"),
         "1": (3, 30, None, "deterministic fixture"),
@@ -390,6 +420,192 @@ def main() -> None:
               f"(at-entry -> after-own): {regressions}", file=sys.stderr)
     print(json.dumps(out))
     if regressions:
+        sys.exit(1)
+
+
+def _incremental_bench() -> None:
+    """BENCH_CONFIG=incremental: MEASURE the device-resident
+    incremental workload model (see the module docstring block).  Two
+    facades over byte-identical simulated clusters serve the SAME
+    interactive delta stream; the only difference is
+    incremental.enabled.  Gates (exit 1): single-broker-delta p50
+    speedup >= 5x, and store-resident-model == from-scratch-rebuild
+    byte equality after the stream."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(os.environ[
+                          "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+
+    from cruise_control_tpu.cluster.simulated import SimulatedCluster
+    from cruise_control_tpu.cluster.types import TopicPartition
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.monitor.deltas import (ModelDelta,
+                                                   PartitionLoadUpdate)
+    from cruise_control_tpu.monitor.sampling.sampler import (
+        SimulatedClusterSampler)
+
+    num_b = int(os.environ.get("BENCH_BROKERS", 64))
+    num_p = int(os.environ.get("BENCH_PARTITIONS", 6000))
+    rf = int(os.environ.get("BENCH_RF", 2))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 64))
+    n_deltas = int(os.environ.get("BENCH_INCR_DELTAS", 64))
+    goal_names = os.environ.get("BENCH_GOALS")
+    names = (goal_names.split(",") if goal_names
+             else ["RackAwareGoal", "DiskCapacityGoal",
+                   "ReplicaDistributionGoal",
+                   "DiskUsageDistributionGoal"])
+    backend = jax.devices()[0].platform
+
+    def build_stack(incremental: bool):
+        sim = SimulatedCluster()
+        clock = {"now": 10_000.0}
+        for b in range(num_b):
+            sim.add_broker(b, rack=f"rack{b % 4}")
+        assignments = [[(p + i) % num_b for i in range(rf)]
+                       for p in range(num_p)]
+        # sized so total disk load stays well under the static capacity
+        # (64 brokers x 1e6 x 0.8 threshold): the stream must measure
+        # latency, not manufacture capacity infeasibility
+        sim.create_topic("t0", assignments, size_bytes=1e3)
+        for p in range(num_p):
+            sim.set_partition_load(
+                TopicPartition("t0", p), leader_cpu=2.0 + (p % 7) * 0.2,
+                nw_in=100.0 + p % 13, nw_out=300.0)
+        cc = CruiseControl(
+            sim, SimulatedClusterSampler(sim),
+            time_fn=lambda: clock["now"],
+            sleep_fn=lambda s: (sim.advance(s), clock.__setitem__(
+                "now", clock["now"] + s)),
+            monitor_kwargs=dict(num_windows=3, window_ms=10_000,
+                                min_samples_per_window=1,
+                                sampling_interval_ms=5_000),
+            executor_kwargs=dict(progress_check_interval_s=1.0),
+            auto_warmup=False, goal_names=names,
+            max_optimization_rounds=rounds,
+            incremental_enabled=incremental)
+        cc.start_up(do_sampling=False, start_detection=False)
+        for _ in range(4):
+            cc.load_monitor.task_runner.sample_once()
+            sim.advance(5)
+            clock["now"] += 5
+        return cc
+
+    print(f"# incremental bench: B={num_b} P={num_p} rf={rf} "
+          f"goals={names} deltas={n_deltas} [{backend}]",
+          file=sys.stderr)
+    inc = build_stack(True)
+    base = build_stack(False)
+    # warm both: programs compile, proposal cache + warm seed prime
+    t0 = time.time()
+    inc.optimizations()
+    base.optimizations()
+    print(f"# warm solves done ({time.time()-t0:.1f}s)", file=sys.stderr)
+
+    rng = np.random.default_rng(11)
+
+    def delta_for(i: int):
+        """Alternate single-broker capacity tweaks and hot-partition
+        load updates (the two dominant production delta kinds)."""
+        if i % 2 == 0:
+            # jitter UP from the static default (1e6): a capacity delta
+            # must change the model, not starve it into infeasibility
+            b = int(rng.integers(0, num_b))
+            return ModelDelta(capacity_overrides={
+                b: {"disk": float(1e6 * (1.05 + 0.05 * (i % 5)))}}), "cap"
+        p = int(rng.integers(0, num_p))
+        return ModelDelta(load_updates=(PartitionLoadUpdate(
+            "t0", p, (3.0 + i % 3, 120.0, 320.0,
+                      1e4 * (1.0 + 0.2 * (i % 4)))),)), "load"
+
+    lat = {"inc": [], "base": []}
+    lat_cap = {"inc": [], "base": []}
+    for i in range(n_deltas):
+        delta, kind = delta_for(i)
+        for tag, cc in (("inc", inc), ("base", base)):
+            cc.load_monitor.apply_model_delta(delta)
+            t0 = time.time()
+            cc.optimizations()
+            dt = time.time() - t0
+            lat[tag].append(dt)
+            if kind == "cap":
+                lat_cap[tag].append(dt)
+
+    store = inc._model_store
+    store_json = store.to_json()
+    speedup_p50 = (_pct(lat["base"], 0.5) / _pct(lat["inc"], 0.5)
+                   if lat["inc"] else 0.0)
+    speedup_cap = (_pct(lat_cap["base"], 0.5) / _pct(lat_cap["inc"], 0.5)
+                   if lat_cap["inc"] else 0.0)
+    hit_rate = (store.hits / (store.hits + store.misses)
+                if store.hits + store.misses else 0.0)
+
+    # byte-equality gate: the delta-fast-forwarded resident model must
+    # equal a from-scratch rebuild of the same generation
+    resident = store._state
+    gen_ok = store.generation == inc.load_monitor.model_generation()
+    rebuilt, _ = inc.load_monitor.cluster_model()
+    byte_identical = bool(gen_ok and resident is not None)
+    if byte_identical:
+        for f in dataclasses.fields(type(resident)):
+            a, b = getattr(resident, f.name), getattr(rebuilt, f.name)
+            if hasattr(a, "shape"):
+                if not (np.asarray(a).shape == np.asarray(b).shape
+                        and np.array_equal(np.asarray(a),
+                                           np.asarray(b))):
+                    byte_identical = False
+                    print(f"# BYTE MISMATCH in {f.name}",
+                          file=sys.stderr)
+                    break
+            elif a != b:
+                byte_identical = False
+                break
+
+    result = {
+        "p50_s": round(_pct(lat["inc"], 0.5), 4),
+        "p99_s": round(_pct(lat["inc"], 0.99), 4),
+        "full_p50_s": round(_pct(lat["base"], 0.5), 4),
+        "full_p99_s": round(_pct(lat["base"], 0.99), 4),
+        "single_broker_delta_speedup_p50": round(speedup_cap, 2),
+        "stream_speedup_p50": round(speedup_p50, 2),
+        "store_hit_rate": round(hit_rate, 4),
+        "store_hits": store.hits,
+        "store_misses": store.misses,
+        "store_fallbacks": store.fallbacks,
+        "store_delta_applies": store.delta_applies,
+        "incremental_solve_fallbacks": int(inc.metrics.meter(
+            "incremental-solve-fallbacks").to_json()["count"]),
+        "last_dirty_brokers": store.last_dirty_brokers,
+        "byte_identical_after_stream": byte_identical,
+    }
+    print(f"# incremental p50/p99 {result['p50_s']}/{result['p99_s']}s "
+          f"vs full {result['full_p50_s']}/{result['full_p99_s']}s; "
+          f"cap-delta speedup {speedup_cap:.1f}x, hit rate "
+          f"{hit_rate:.2f}, fallbacks {store.fallbacks}, "
+          f"byte_identical={byte_identical}", file=sys.stderr)
+    inc.shutdown()
+    base.shutdown()
+
+    print(json.dumps({
+        "metric": (f"incremental {n_deltas}-delta interactive stream "
+                   f"{num_b}b/{num_p/1000:g}Kp rf{rf} [{backend}]"),
+        "value": result["p50_s"],
+        "unit": "s",
+        "vs_baseline": result["stream_speedup_p50"],
+        "incremental": result,
+    }))
+    if not byte_identical:
+        print("ERROR: delta-applied resident model != from-scratch "
+              "rebuild", file=sys.stderr)
+        sys.exit(1)
+    if speedup_cap < 5.0:
+        print(f"ERROR: single-broker delta solve speedup "
+              f"{speedup_cap:.2f}x < 5x gate", file=sys.stderr)
         sys.exit(1)
 
 
@@ -1010,11 +1226,6 @@ def _sched_bench() -> None:
             t.join()
         return latencies
 
-    def pct(values, q):
-        ordered = sorted(values)
-        return ordered[min(len(ordered) - 1,
-                           int(round(q * (len(ordered) - 1))))]
-
     results = {}
     for n in clients:
         base_lat = run_load(n, None)
@@ -1028,10 +1239,10 @@ def _sched_bench() -> None:
         coalesced = sched.stats.coalesced
         sched.stop()
         results[str(n)] = {
-            "unsched_p50_s": round(pct(base_lat, 0.50), 4),
-            "unsched_p99_s": round(pct(base_lat, 0.99), 4),
-            "sched_p50_s": round(pct(sched_lat, 0.50), 4),
-            "sched_p99_s": round(pct(sched_lat, 0.99), 4),
+            "unsched_p50_s": round(_pct(base_lat, 0.50), 4),
+            "unsched_p99_s": round(_pct(base_lat, 0.99), 4),
+            "sched_p50_s": round(_pct(sched_lat, 0.50), 4),
+            "sched_p99_s": round(_pct(sched_lat, 0.99), 4),
             "device_occupancy": round(occupancy, 4),
             "coalesced": coalesced,
         }

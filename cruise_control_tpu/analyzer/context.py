@@ -400,6 +400,45 @@ def make_round_cache(state: ClusterState, table_slots: int = 0,
     return constrain_cache(cache)
 
 
+def restrict_context_to_dirty(state: ClusterState,
+                              ctx: OptimizationContext,
+                              dirty_brokers: jax.Array
+                              ) -> OptimizationContext:
+    """Dirty-region solve restriction (the incremental interactive
+    path, model/store.py + facade): candidate replica SOURCES shrink to
+    the dirty brokers plus any broker above its upper balance threshold
+    (a delta's load has to be able to drain somewhere even when the
+    overload it causes sits outside the literal dirty set), and move
+    DESTINATIONS shrink to the dirty region plus its balance
+    neighborhood — alive brokers under the upper threshold on every
+    resource (they can absorb load without creating new violations).
+    Leadership eligibility is untouched: leadership transfers move no
+    data, and the warm-started leadership goals converge in a handful
+    of rounds anyway.
+
+    The all-dirty mask reproduces the unrestricted context value-for-
+    value (movable & true, dest & true) — the equality pin that makes
+    `incremental.enabled` safe to leave on: a full-coverage delta solve
+    is byte-identical to the full sweep.
+
+    Correctness is unaffected either way: the full pipeline (acceptance
+    stacking, hard-goal verification, stats guard) still runs, and the
+    facade retries the FULL sweep when a restricted solve returns an
+    optimization failure (metered fallback)."""
+    dirty = jnp.asarray(dirty_brokers, dtype=bool)
+    load = S.broker_load(state)
+    util = load / jnp.maximum(state.broker_capacity, 1e-9)
+    over = jnp.any(util > ctx.balance_upper_pct[None, :], axis=1)
+    under = (state.broker_alive
+             & jnp.all(util <= ctx.balance_upper_pct[None, :], axis=1))
+    src_ok = dirty | over
+    movable = ctx.replica_movable & src_ok[state.replica_broker]
+    return dataclasses.replace(
+        ctx,
+        replica_movable=movable,
+        broker_dest_ok=ctx.broker_dest_ok & (dirty | under))
+
+
 # ---------------------------------------------------------------------------
 # Cache threading across goals.
 #

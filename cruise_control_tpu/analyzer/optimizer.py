@@ -845,7 +845,8 @@ class GoalOptimizer:
                       warm_start: Optional[ClusterState] = None,
                       eager_hard_abort: Optional[bool] = None,
                       eager_driver: bool = False,
-                      mesh=None
+                      mesh=None,
+                      dirty_brokers=None
                       ) -> OptimizerResult:
         """Run all goals in priority order and diff out proposals
         (reference GoalOptimizer.optimizations :409-480).
@@ -994,6 +995,22 @@ class GoalOptimizer:
                     replica_broker=warm_start.replica_broker,
                     replica_is_leader=warm_start.replica_is_leader,
                     replica_disk=warm_start.replica_disk)
+            if dirty_brokers is not None:
+                # dirty-region solve (incremental interactive path):
+                # restrict candidate sources/destinations to the dirty
+                # brokers + their balance neighborhood.  Applied AFTER
+                # the warm-start validation above: the restriction is a
+                # SEARCH optimization, not a policy freeze — a seed
+                # that repositions replicas outside the dirty region is
+                # carrying over converged placement, not violating a
+                # request constraint.  Same array shapes, so every
+                # compiled program is reused verbatim; the all-dirty
+                # mask reproduces the unrestricted context value-for-
+                # value (byte-identical pin, tests/test_incremental.py)
+                from cruise_control_tpu.analyzer.context import \
+                    restrict_context_to_dirty
+                ctx = restrict_context_to_dirty(initial, ctx,
+                                                dirty_brokers)
 
         t0 = time.time()
         (stats0_dev, vb_dev, state, cache, still_dev, maxc_dev, broken_dev,
@@ -1146,7 +1163,8 @@ class GoalOptimizer:
                                           warm_start=warm_start,
                                           eager_hard_abort=eager,
                                           eager_driver=eager_driver,
-                                          mesh=mesh)
+                                          mesh=mesh,
+                                          dirty_brokers=dirty_brokers)
             stacked_h = (jax.tree.map(
                 lambda *xs: np.concatenate(xs), *stacked_h)
                 if stacked_h else None)

@@ -109,6 +109,7 @@ STATE = _obj({
     "AnomalyDetectorState": _obj({}, extra=True),
     "SchedulerState": _obj({}, extra=True),
     "FleetState": _obj({}, extra=True),
+    "IncrementalStoreState": _obj({}, extra=True),
     "version": _INT,
 }, required=["version"])
 

@@ -352,6 +352,32 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
              "across builds you know are program-equivalent; bump it "
              "to force a cold generation.  A mismatched fingerprint is "
              "a miss, never a wrong answer.")
+    d.define("incremental.enabled", Type.BOOLEAN, True, None, _M,
+             "Device-resident incremental workload model "
+             "(model/store.py + docs/INCREMENTAL.md): keep the current "
+             "cluster model on device keyed by model generation, "
+             "fast-forward it through structured monitor deltas "
+             "(LoadMonitor.apply_model_delta) instead of rebuilding "
+             "per solve, and let USER_INTERACTIVE default-stack solves "
+             "warm-start with a dirty-region restriction (candidate "
+             "sources/destinations limited to the delta's dirty "
+             "brokers + their balance neighborhood).  Disabled, every "
+             "solve re-materializes the full model and sweeps every "
+             "broker — the pre-incremental behavior, byte-identical.")
+    d.define("incremental.max.deltas", Type.INT, 64,
+             in_range(min_value=0), _L,
+             "Longest delta chain the store fast-forwards through "
+             "before preferring a full rebuild (a delta storm is "
+             "better served by one rebuild than by hundreds of "
+             "scatter programs; fallback metered as "
+             "incremental-store-fallbacks).")
+    d.define("incremental.max.dirty.broker.ratio", Type.DOUBLE, 0.5,
+             in_range(min_value=0.0, max_value=1.0), _L,
+             "Dirty-region ceiling: when the deltas since the warm "
+             "seed dirty more than this fraction of brokers, the "
+             "restricted solve cannot beat a full sweep — the solve "
+             "runs unrestricted (still store-served and warm-started; "
+             "metered).")
     d.define("fleet.bucket.floor", Type.INT, 8, in_range(min_value=1), _M,
              "Smallest shape-bucket edge for fleet serving "
              "(fleet/buckets.py): every tenant's model pads each axis "

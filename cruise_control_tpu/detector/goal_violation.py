@@ -63,8 +63,15 @@ class GoalViolationDetector:
                  options: Optional[OptimizationOptions] = None,
                  allow_capacity_estimation: bool = True,
                  anomaly_cls=None,
+                 model_fn: Optional[Callable] = None,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         self._load_monitor = load_monitor
+        #: model materializer: the facade injects its store-aware
+        #: gateway (facade._model_for_solve) so detection sweeps ride
+        #: the device-resident model instead of paying a rebuild per
+        #: sweep; standalone constructions default to the monitor's
+        #: builder (the single-store lint rule pins the CALL sites)
+        self._model_fn = model_fn or load_monitor.cluster_model
         #: reference anomaly.detection.allow.capacity.estimation
         self._allow_capacity_estimation = allow_capacity_estimation
         #: reference goal.violations.class
@@ -86,7 +93,7 @@ class GoalViolationDetector:
         from cruise_control_tpu.core.aggregator import (
             NotEnoughValidWindowsError)
         try:
-            state, topology = self._load_monitor.cluster_model(
+            state, topology = self._model_fn(
                 allow_capacity_estimation=self._allow_capacity_estimation)
         except NotEnoughValidWindowsError as exc:
             # expected during warm-up: not an error

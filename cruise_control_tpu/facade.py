@@ -219,7 +219,10 @@ class CruiseControl:
                  progcache_enabled: Optional[bool] = None,
                  progcache_dir: Optional[str] = None,
                  progcache_max_bytes: Optional[int] = None,
-                 progcache_fingerprint_override: Optional[str] = None
+                 progcache_fingerprint_override: Optional[str] = None,
+                 incremental_enabled: bool = True,
+                 incremental_max_deltas: int = 64,
+                 incremental_max_dirty_ratio: float = 0.5
                  ) -> None:
         self._admin = admin
         self._time = time_fn or _time.time
@@ -293,6 +296,18 @@ class CruiseControl:
         self.load_monitor = LoadMonitor(
             admin, sampler, capacity_resolver or StaticCapacityResolver(),
             time_fn=self._time, **(monitor_kwargs or {}))
+        # device-resident incremental workload model (model/store.py):
+        # the current ClusterState stays on device keyed by model
+        # generation; structured monitor deltas fast-forward it in place
+        # and _model_for_solve consults it before paying a host rebuild.
+        # The store exists even when incremental.enabled=false (sensors
+        # and STATE read it) — only the consult path is gated.
+        from cruise_control_tpu.model.store import DeviceModelStore
+        self._incremental_enabled = incremental_enabled
+        self._incremental_max_deltas = max(0, incremental_max_deltas)
+        self._incremental_max_dirty_ratio = min(
+            1.0, max(0.0, incremental_max_dirty_ratio))
+        self._model_store = DeviceModelStore(time_fn=self._time)
         self.executor = Executor(
             admin, load_monitor=self.load_monitor,
             notifier=executor_notifier, time_fn=self._time,
@@ -349,7 +364,14 @@ class CruiseControl:
         #: the deferred, O(1)-round-trip check; see
         #: GoalOptimizer.eager_hard_abort)
         self._precompute_eager_hard_abort = precompute_eager_hard_abort
-        self._warm_seed_state = None
+        #: warm-start seed: (final state, model generation it solved,
+        #: coalesce scope that produced it).  The generation tag drops
+        #: the seed the moment the model moves past a delta the seed
+        #: didn't see (deltas_between chain check), and the scope tag
+        #: pins a seed to its tenant — a seed may never warm-start a
+        #: different tenant or a stale generation (ROADMAP item-4
+        #: safety note; pinned in tests/test_incremental.py)
+        self._warm_seed: Optional[Tuple] = None
         self._precompute_stop = threading.Event()
         self._precompute_thread: Optional[threading.Thread] = None
         #: solve-deadline watchdog food: wall-clock of the precompute
@@ -487,6 +509,22 @@ class CruiseControl:
                            lambda: float(self._progcache.corrupt_entries))
         self.metrics.gauge("progcache-fresh-compiles",
                            lambda: float(self._progcache.fresh_compiles))
+        # incremental-store-* sensors: the device-resident model store's
+        # counters (hits = solves served without a host rebuild;
+        # fallbacks = consults that had to rebuild: gap, delta storm,
+        # quarantine, oversized dirty region)
+        self.metrics.gauge("incremental-store-hits",
+                           lambda: float(self._model_store.hits))
+        self.metrics.gauge("incremental-store-misses",
+                           lambda: float(self._model_store.misses))
+        self.metrics.gauge("incremental-store-fallbacks",
+                           lambda: float(self._model_store.fallbacks))
+        self.metrics.gauge(
+            "incremental-store-delta-applies",
+            lambda: float(self._model_store.delta_applies))
+        self.metrics.gauge(
+            "incremental-store-dirty-brokers",
+            lambda: float(self._model_store.last_dirty_brokers))
         self.metrics.gauge(
             "goal-self-regressions",
             lambda: float(len(self._goal_self_regressions)))
@@ -711,7 +749,10 @@ class CruiseControl:
             constraint=self._constraint, time_fn=self._time,
             allow_capacity_estimation=(
                 self._detection_allow_capacity_estimation),
-            anomaly_cls=cls_of("goal.violations"))
+            anomaly_cls=cls_of("goal.violations"),
+            # detection sweeps ride the device-resident model too: a
+            # store hit turns the per-sweep host rebuild into a no-op
+            model_fn=self._model_for_solve)
         self.broker_failure_detector = BrokerFailureDetector(
             self._admin, report, fix_fn=self._heal_broker_failure,
             time_fn=self._time,
@@ -900,9 +941,13 @@ class CruiseControl:
                 return
             with self._cache_lock:
                 if result.final_state is not None:
-                    # folded fleet results carry no final state: keep
-                    # the previous warm seed rather than clearing it
-                    self._warm_seed_state = result.final_state
+                    # the seed is TAGGED (generation, tenant scope):
+                    # fleet-folded results now carry per-lane final
+                    # states (fleet/router.py), and the tags are what
+                    # keep a folded seed from ever warming a different
+                    # tenant or a stale generation
+                    self._warm_seed = (result.final_state, generation,
+                                       self._coalesce_scope)
                 # drop the result if the cache was invalidated while
                 # the solve ran (an execution started mutating the
                 # cluster) — storing it would serve pre-execution
@@ -912,12 +957,36 @@ class CruiseControl:
                     self._cached_generation = generation
                     self._cached_at = self._time()
 
+        # the incremental dirty-region path serves INTERACTIVE default-
+        # stack requests: the precompute/heal classes keep the full
+        # sweep (precompute refreshes quality + the seed; healing runs
+        # on broken clusters where warm seeds stand down anyway)
+        allow_incremental = (self._incremental_enabled and cacheable
+                             and klass is SchedulerClass.USER_INTERACTIVE)
+
         def run_solve() -> OptimizerResult:
             with self._cache_lock:
                 epoch = self._cache_epoch
-            result = self._solve_with_ladder(optimizer, cacheable, options,
-                                             _allow_capacity_estimation,
-                                             _eager_hard_abort)
+            cell: Optional[Dict] = {} if allow_incremental else None
+            try:
+                result = self._solve_with_ladder(
+                    optimizer, cacheable, options,
+                    _allow_capacity_estimation, _eager_hard_abort,
+                    incremental=cell)
+            except OptimizationFailure:
+                if not (cell and cell.get("dirty")):
+                    raise
+                # a restricted solve may fail a verdict the full sweep
+                # can fix (a hard violation outside the dirty region):
+                # metered fallback, never an outage
+                self.metrics.meter("incremental-solve-fallbacks").mark()
+                self._model_store.record_fallback(
+                    "dirty-region solve verdict; full sweep retry")
+                LOG.info("dirty-region solve failed its verdict; "
+                         "retrying as a full sweep")
+                result = self._solve_with_ladder(
+                    optimizer, cacheable, options,
+                    _allow_capacity_estimation, _eager_hard_abort)
             from cruise_control_tpu.utils import profiling
             prof = profiling.active()
             if prof is not None and profiling.enabled():
@@ -965,7 +1034,7 @@ class CruiseControl:
         def materialize():
             with self._cache_lock:
                 epoch_cell["epoch"] = self._cache_epoch
-            state, topo, _warm = self._materialize_solve_inputs(
+            state, topo, _warm, _dirty = self._materialize_solve_inputs(
                 cacheable, allow_capacity_estimation, goal_key=goal_key)
             gen_options = self._options_generator.generate(
                 options or OptimizationOptions(), topo)
@@ -1040,42 +1109,164 @@ class CruiseControl:
     # ------------------------------------------------------------------
     # solver degradation ladder (analyzer/degradation.py)
     # ------------------------------------------------------------------
+    def _model_for_solve(self, allow_capacity_estimation=None):
+        """(state, topology) for any device work — THE model
+        materialization gateway (single-store lint rule): consults the
+        device-resident model store first, fast-forwards it through the
+        monitor's logged delta chain when the generation moved by
+        structured deltas only, and rebuilds from the monitor (then
+        re-installs) on any gap — generation jump the log does not
+        cover, too-long chain, shape-changing delta, capacity-flag
+        mismatch, quarantine.  A store hit skips the whole host-side
+        model build + device transfer (~3.2 s per solve ATTEMPT at
+        bench scale)."""
+        if allow_capacity_estimation is None:
+            allow_capacity_estimation = self._allow_capacity_estimation
+        store = self._model_store
+        if not self._incremental_enabled:
+            return self.cluster_model(
+                allow_capacity_estimation=allow_capacity_estimation)
+        generation = self.load_monitor.model_generation()
+        hit = store.get(generation, allow_capacity_estimation)
+        if hit is not None:
+            return hit
+        store_gen = store.generation
+        if store_gen is None:
+            store.count_miss()
+        elif store.capacity_flag != bool(allow_capacity_estimation):
+            # the resident model was built with the OTHER capacity-
+            # estimation flag: a delta fast-forward would preserve it,
+            # silently serving estimated capacities to a request that
+            # declined them — rebuild instead
+            store.record_fallback("capacity-estimation-flag")
+        else:
+            chain = self.load_monitor.deltas_between(store_gen,
+                                                     generation)
+            if chain and len(chain) <= self._incremental_max_deltas:
+                adv = store.advance(chain, generation)
+                if adv is not None:
+                    return adv
+            elif chain:
+                store.record_fallback(
+                    f"delta-chain too long ({len(chain)} > "
+                    f"{self._incremental_max_deltas})")
+            else:
+                # None = no contiguous chain; [] cannot happen here
+                # (same generation + same flag is a get() hit)
+                store.record_fallback("generation-gap")
+        # install only when the generation did not move underneath the
+        # build (samples landing mid-build would make the resident
+        # model newer than its claimed generation and a later delta
+        # fast-forward could double-apply a change)
+        state, topo = self.cluster_model(
+            allow_capacity_estimation=allow_capacity_estimation)
+        if self.load_monitor.model_generation() == generation:
+            store.install(generation, state, topo,
+                          allow_capacity_estimation,
+                          self.load_monitor.follower_cpu_estimator())
+        return state, topo
+
     def _materialize_solve_inputs(self, cacheable: bool,
                                   allow_capacity_estimation,
-                                  goal_key=None):
-        """(state, topology, warm seed) for ONE solve attempt.
+                                  goal_key=None, incremental=None):
+        """(state, topology, warm seed, dirty-broker mask) for ONE
+        solve attempt.
 
         Called per ATTEMPT, not per request: a failed attempt may have
         consumed its inputs (the goal programs donate the inter-goal
         ClusterState/RoundCache buffers on non-CPU backends, so a fault
         mid-pipeline leaves them invalidated) — the retry re-materializes
-        everything from the host-side model, which is why a retried solve
-        matches the fault-free result bit-for-bit (chaos pin,
-        tests/test_chaos.py).
+        everything, which is why a retried solve matches the fault-free
+        result bit-for-bit (chaos pin, tests/test_chaos.py).  The model
+        itself comes from the device store gateway (_model_for_solve);
+        a store hit makes the re-materialization O(1).
+
+        Warm seed: eligible only when tagged with THIS facade's scope
+        and a generation the monitor can account for — unchanged, or
+        reachable through the logged delta chain.  A generation move
+        the log does not cover DROPS the seed (it predates changes it
+        never saw).  `incremental` (a dict cell or None) additionally
+        requests the dirty-region mask: the union of the chain's
+        dirty-broker sets since the seed's generation, when it covers
+        no more than incremental.max.dirty.broker.ratio of the cluster
+        — the cell records engagement so the caller can fall back to a
+        full sweep on a solver verdict.
 
         Fleet tenants pad the state to the fleet shape bucket here
         (fleet/buckets.py dead-row padding: results identical, shapes
-        shared fleet-wide so tenants reuse one compiled program per
-        bucket); without a binding the state passes through untouched —
-        the single-tenant byte-identical pin."""
-        state, topo = self.cluster_model(
-            allow_capacity_estimation=allow_capacity_estimation)
+        shared fleet-wide); the dirty mask pads with False rows — a
+        padded broker is never dirty."""
+        generation = self.load_monitor.model_generation()
+        state, topo = self._model_for_solve(allow_capacity_estimation)
+        raw_brokers = state.num_brokers
         if self._fleet_binding is not None:
             state = self._fleet_binding.pad_state(state, goal_key)
         warm = None
+        dirty = None
         if cacheable and self._warm_start_enabled:
             with self._cache_lock:
-                seed = self._warm_seed_state
-            if seed is not None and _warm_start_compatible(seed, state):
-                warm = seed
-        return state, topo, warm
+                seed = self._warm_seed
+            if seed is not None:
+                seed_state, seed_gen, seed_scope = seed
+                ok = seed_scope == self._coalesce_scope
+                if ok and seed_gen != generation:
+                    chain = self.load_monitor.deltas_between(seed_gen,
+                                                             generation)
+                    if chain is None:
+                        # the model moved past a change the seed never
+                        # saw: the seed is stale, drop it for good
+                        with self._cache_lock:
+                            if self._warm_seed is seed:
+                                self._warm_seed = None
+                        ok = False
+                    elif incremental is not None:
+                        dirty = self._dirty_mask_for(seed_gen,
+                                                     raw_brokers)
+                if ok and _warm_start_compatible(seed_state, state):
+                    warm = seed_state
+        if warm is None:
+            dirty = None
+        if dirty is not None:
+            if state.num_brokers != raw_brokers:
+                import jax.numpy as jnp
+                dirty = jnp.concatenate([
+                    dirty, jnp.zeros(state.num_brokers - raw_brokers,
+                                     dtype=bool)])
+            incremental["dirty"] = True
+        return state, topo, warm, dirty
+
+    def _dirty_mask_for(self, seed_generation, num_brokers):
+        """Dirty-broker mask covering every delta between the seed's
+        generation and the resident model, or None when ineligible: no
+        coverage (a rebuild broke the chain) or a dirty region too
+        large to beat a full sweep (metered)."""
+        if not self._incremental_enabled:
+            return None
+        dirty = self._model_store.dirty_since(seed_generation)
+        if dirty is None:
+            return None
+        import jax
+        import jax.numpy as jnp
+        count = int(jax.device_get(jnp.sum(dirty.astype(jnp.int32))))
+        if count > self._incremental_max_dirty_ratio * num_brokers:
+            self._model_store.record_fallback(
+                f"dirty region too large ({count}/{num_brokers} "
+                f"brokers)")
+            return None
+        return dirty
 
     def _solve_on_rung(self, rung: SolverRung, optimizer: GoalOptimizer,
                        cacheable: bool, options, allow_capacity_estimation,
-                       eager_hard_abort) -> OptimizerResult:
-        state, topo, warm = self._materialize_solve_inputs(
+                       eager_hard_abort,
+                       incremental=None) -> OptimizerResult:
+        # the dirty-region path engages only on the full-fidelity rungs
+        # (MESH/FUSED): the degraded rungs re-materialize from the
+        # monitor and run the classic full sweep
+        incr = (incremental
+                if rung in (SolverRung.MESH, SolverRung.FUSED) else None)
+        state, topo, warm, dirty = self._materialize_solve_inputs(
             cacheable, allow_capacity_estimation,
-            goal_key=optimizer._goals_share_key())
+            goal_key=optimizer._goals_share_key(), incremental=incr)
         gen_options = self._options_generator.generate(
             options or OptimizationOptions(), topo)
         with self.metrics.timer("proposal-computation-timer").time():
@@ -1091,11 +1282,12 @@ class CruiseControl:
                 return optimizer.optimizations(
                     state, topo, gen_options, warm_start=warm,
                     eager_hard_abort=eager_hard_abort,
-                    mesh=token.mesh)
+                    mesh=token.mesh, dirty_brokers=dirty)
             if rung is SolverRung.FUSED:
                 return optimizer.optimizations(
                     state, topo, gen_options, warm_start=warm,
-                    eager_hard_abort=eager_hard_abort)
+                    eager_hard_abort=eager_hard_abort,
+                    dirty_brokers=dirty)
             if rung is SolverRung.EAGER:
                 # one goal per program + eager hard-abort sync: smaller
                 # programs survive segment-level compile failures and
@@ -1113,7 +1305,8 @@ class CruiseControl:
 
     def _solve_with_ladder(self, optimizer: GoalOptimizer, cacheable: bool,
                            options, allow_capacity_estimation,
-                           eager_hard_abort) -> OptimizerResult:
+                           eager_hard_abort,
+                           incremental=None) -> OptimizerResult:
         """Run one solve request through the degradation ladder: retry
         with exponential backoff + jitter on the entry rung, descend
         fused → eager → CPU when a rung exhausts its retries, and let the
@@ -1129,7 +1322,8 @@ class CruiseControl:
             result = self._solve_on_rung(self._solver_top_rung, optimizer,
                                          cacheable, options,
                                          allow_capacity_estimation,
-                                         eager_hard_abort)
+                                         eager_hard_abort,
+                                         incremental=incremental)
             self._note_goal_self_regressions(result)
             return result
         rung = self.solver_ladder.entry_rung()
@@ -1140,7 +1334,8 @@ class CruiseControl:
                 result = self._solve_on_rung(rung, optimizer, cacheable,
                                              options,
                                              allow_capacity_estimation,
-                                             eager_hard_abort)
+                                             eager_hard_abort,
+                                             incremental=incremental)
             except (OptimizationFailure, InvalidModelInputError,
                     SolvePreempted) as exc:
                 if isinstance(exc, InvalidModelInputError):
@@ -1170,6 +1365,13 @@ class CruiseControl:
                         self._report_solver_degraded(rung, None, kind, exc,
                                                      False)
                     raise
+                if nxt >= SolverRung.EAGER:
+                    # descent below FUSED: the EAGER/CPU rungs
+                    # re-materialize from the monitor anyway, and a
+                    # device sick enough to knock the fused pipeline
+                    # over is no place to trust resident buffers
+                    self._model_store.invalidate(
+                        f"ladder descent to {nxt.name}")
                 self.metrics.meter("solver-descents").mark()
                 if not tripped:
                     self._report_solver_degraded(rung, nxt, kind, exc,
@@ -1292,7 +1494,7 @@ class CruiseControl:
 
         def fold_run(spec_lists: List[List[ScenarioSpec]]
                      ) -> List[ScenarioBatchResult]:
-            state, topo = self.cluster_model()
+            state, topo = self._model_for_solve()
             # fleet tenants solve scenarios at the bucket shape too, so
             # one tenant's sweeps reuse shapes across model-generation
             # growth within a bucket (hypothetical broker adds still
@@ -1409,7 +1611,7 @@ class CruiseControl:
         if sets is not None:
             broker_ids = sets[0]
         self._sanity_check_execution(dryrun)
-        state, topo = self.cluster_model()
+        state, topo = self._model_for_solve()
         idx = topo.broker_index
         for b in broker_ids:
             state = S.set_broker_state(state, idx[b], new=True)
@@ -1443,7 +1645,7 @@ class CruiseControl:
         if sets is not None:
             broker_ids = sets[0]
         self._sanity_check_execution(dryrun)
-        state, topo = self.cluster_model()
+        state, topo = self._model_for_solve()
         idx = topo.broker_index
         for b in broker_ids:
             state = S.set_broker_state(state, idx[b], alive=False)
@@ -1472,7 +1674,7 @@ class CruiseControl:
         if sets is not None:
             broker_ids = sets[0]
         self._sanity_check_execution(dryrun)
-        state, topo = self.cluster_model()
+        state, topo = self._model_for_solve()
         idx = topo.broker_index
         for b in broker_ids:
             state = S.set_broker_state(state, idx[b], demoted=True)
@@ -1494,7 +1696,7 @@ class CruiseControl:
         """Relocate offline replicas to healthy brokers/disks (reference
         FixOfflineReplicasRunnable)."""
         self._sanity_check_execution(dryrun)
-        state, topo = self.cluster_model()
+        state, topo = self._model_for_solve()
         if not bool(np.asarray(S.self_healing_eligible(state)).any()):
             raise ValueError("no offline replicas to fix")
         optimizer = self._optimizer_for(goals)
@@ -1607,7 +1809,7 @@ class CruiseControl:
         want = {s.lower() for s in (substates or
                                     ("monitor", "executor", "analyzer",
                                      "anomaly_detector", "scenario",
-                                     "scheduler"))}
+                                     "scheduler", "incremental"))}
         out: dict = {}
         if "monitor" in want:
             ms = self.load_monitor.get_state()
@@ -1652,6 +1854,14 @@ class CruiseControl:
             # queue depth/wait, device occupancy, coalesce/preempt/
             # reject counters (sched/stats.py)
             out["SchedulerState"] = self.solve_scheduler.to_json()
+        if "incremental" in want:
+            # device-resident model store (model/store.py): residency,
+            # hit/fallback counters, last dirty region — the operator's
+            # first stop when interactive solves stop being sub-second
+            out["IncrementalStoreState"] = {
+                "enabled": self._incremental_enabled,
+                **self._model_store.to_json(),
+            }
         if "sensors" in want:
             out["Sensors"] = self.metrics.to_json()
         return out

@@ -33,9 +33,13 @@ then classifies ITS failure, so a fault injected into one tenant's solve
 degrades one rung in one tenant (tenant-isolation chaos pin,
 tests/test_fleet.py).
 
-Folded results carry ``final_state=None``: the warm-start seed is an
-optimization the inline path keeps, not a semantic (the facade skips
-seeding when it is absent).
+Folded results carry PER-LANE final states: the engine's fetched final
+placement planes are split back per lane (`ScenarioOutcome.
+final_placement`) and re-attached to each lane's own bucket-padded
+input state, so a folded solve seeds the tenant's warm start exactly
+like its inline solve would have.  The facade tags every stored seed
+with (tenant scope, model generation) — a seed can never warm-start a
+different tenant or a generation it did not see (facade._warm_seed).
 """
 from __future__ import annotations
 
@@ -239,7 +243,8 @@ class FleetRouter:
             payload = lane[0]
             try:
                 result = self._result_from_outcome(payload, outcome,
-                                                   telemetry.duration_s)
+                                                   telemetry.duration_s,
+                                                   lane_state=lane[1])
                 payload.commit(result)
                 out.append((payload, result))
             except BaseException as exc:  # noqa: BLE001 - one lane's
@@ -267,16 +272,35 @@ class FleetRouter:
 
     def _result_from_outcome(self, payload: FleetSolvePayload,
                              outcome: ScenarioOutcome,
-                             duration_s: float) -> OptimizerResult:
+                             duration_s: float,
+                             lane_state=None) -> OptimizerResult:
         """One lane's ScenarioOutcome as the OptimizerResult the inline
         path would have returned.  Lane VERDICTS re-raise exactly like
         the single-solve path raises them (the batched engine reports
         them as infeasibility so one doomed lane cannot poison the
-        batch; here each lane has its own ticket to fail)."""
+        batch; here each lane has its own ticket to fail).
+
+        `lane_state` (the lane's bucket-padded INPUT state) plus the
+        outcome's fetched final placement reconstruct this lane's final
+        ClusterState: membership/topology/capacity are solve-invariant,
+        only the placement planes moved — exactly the fields a warm
+        start transplants (GoalOptimizer.optimizations warm_start) and
+        the compatibility gate reads (facade._warm_start_compatible),
+        so the rebuilt seed behaves identically to an inline final
+        state."""
         if not outcome.feasible:
             if outcome.invalid_input:
                 raise InvalidModelInputError(outcome.reason)
             raise OptimizationFailure(outcome.reason)
+        final_state = None
+        if lane_state is not None and outcome.final_placement is not None:
+            import jax.numpy as jnp
+            fp = outcome.final_placement
+            final_state = lane_state.replace(
+                replica_broker=jnp.asarray(fp["replica_broker"]),
+                replica_is_leader=jnp.asarray(fp["replica_is_leader"]),
+                **({"replica_disk": jnp.asarray(fp["replica_disk"])}
+                   if "replica_disk" in fp else {}))
         goals = payload.optimizer.goals
         return OptimizerResult(
             proposals=list(outcome.proposals),
@@ -286,7 +310,7 @@ class FleetRouter:
             violated_goals_before=list(outcome.violated_goals_before),
             violated_goals_after=list(outcome.violated_goals_after),
             regressed_goals=list(outcome.regressed_goals),
-            final_state=None,
+            final_state=final_state,
             duration_s=duration_s,
             violated_broker_counts=dict(outcome.violated_broker_counts),
             entry_broker_counts=dict(outcome.entry_broker_counts),

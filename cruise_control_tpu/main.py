@@ -347,6 +347,10 @@ def build_cruise_control(config: CruiseControlConfig, admin,
         progcache_max_bytes=config.get_long("progcache.max.bytes"),
         progcache_fingerprint_override=config.get(
             "progcache.fingerprint.override") or "",
+        incremental_enabled=config.get_boolean("incremental.enabled"),
+        incremental_max_deltas=config.get_int("incremental.max.deltas"),
+        incremental_max_dirty_ratio=config.get_double(
+            "incremental.max.dirty.broker.ratio"),
         monitor_kwargs=dict(
             sample_store=sample_store,
             num_windows=config.get_int("num.partition.metrics.windows"),

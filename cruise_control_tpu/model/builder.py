@@ -156,15 +156,19 @@ class ClusterModelBuilder:
     def add_broker(self, broker_id: int, rack_id: str,
                    capacity: LoadLike, host: Optional[str] = None,
                    alive: bool = True, new: bool = False,
+                   demoted: bool = False,
                    disks: Optional[Mapping[str, float]] = None) -> int:
-        """reference ClusterModel.createBroker (ClusterModel.java:866-883)."""
+        """reference ClusterModel.createBroker (ClusterModel.java:866-883).
+        `demoted` pre-marks the broker demoted at build time (the monitor's
+        demote-delta overlay; request-scoped demotion still goes through
+        S.set_broker_state)."""
         if broker_id in self._brokers:
             raise ValueError(f"broker {broker_id} already exists")
         rack = self.add_rack(rack_id)
         host_name = host if host is not None else f"host-{broker_id}"
         host_idx = self._hosts.setdefault(host_name, len(self._hosts))
         broker = _Broker(broker_id, rack, host_idx, _load_vector(capacity),
-                         alive=alive, new=new)
+                         alive=alive, new=new, demoted=demoted)
         if disks:
             for logdir, disk_cap in disks.items():
                 disk_idx = len(self._disk_names)

@@ -349,6 +349,24 @@ def set_broker_state(state: ClusterState, broker: int, *, alive: bool = None,
     return state.replace(**updates)
 
 
+def set_broker_capacities(state: ClusterState, rows: jax.Array,
+                          mask: jax.Array, values: jax.Array
+                          ) -> ClusterState:
+    """Batched absolute capacity override: broker row `rows[i]` takes
+    `values[i]` where `mask[i]` names a resource, keeping the other
+    resources' built values.  Used identically by the monitor's rebuild
+    overlay and the device model store's delta application
+    (monitor/deltas.capacity_rows builds the inputs) so the two paths
+    stay byte-for-byte equal.  Rows must be unique."""
+    rows = jnp.asarray(rows, jnp.int32)
+    cur = state.broker_capacity[rows]
+    new_rows = jnp.where(jnp.asarray(mask),
+                         jnp.asarray(values,
+                                     state.broker_capacity.dtype), cur)
+    return state.replace(
+        broker_capacity=state.broker_capacity.at[rows].set(new_rows))
+
+
 def apply_disk_moves(state: ClusterState, replicas: jax.Array,
                      dest_disks: jax.Array, valid: jax.Array) -> ClusterState:
     """Batched intra-broker relocation: move K replicas between logdirs of

@@ -1,0 +1,457 @@
+"""Device-resident, generation-versioned workload model store.
+
+The paper's Load Monitor maintains ONE continuously-updated in-memory
+workload model; every solve reads the current model instead of
+rebuilding it.  Before this module, the tensor port rebuilt the whole
+host-side model per solve ATTEMPT (`facade._materialize_solve_inputs` →
+`load_monitor.cluster_model()`: ~3.2 s host build + a full device
+transfer at bench scale) even when the only change since the last solve
+was one broker's capacity or one hot partition.
+
+`DeviceModelStore` keeps the current `ClusterState` (device arrays) +
+`ClusterTopology` (host name↔index maps) resident, keyed by the
+monitor's `ModelGeneration`:
+
+* exact-generation hit → the resident model is returned as-is (zero
+  host build, zero transfer);
+* the generation moved through a CONTIGUOUS chain of structured model
+  deltas (monitor/deltas.py, logged by `LoadMonitor.apply_model_delta`)
+  → the chain is replayed as a jitted in-place tensor update
+  (`apply_delta` below: flag scatters, capacity row writes, leadership
+  load-split scatters) and the store fast-forwards — byte-identical to
+  a from-scratch rebuild (the `incremental` test pin);
+* anything else (generation gap, trimmed log, shape-changing or
+  unresolvable delta, a fault mid-apply) is a metered FALLBACK: the
+  store clears/quarantines and the caller rebuilds from the monitor.
+  A half-applied model is never served — delta chains commit
+  all-or-nothing, and any failure quarantines the resident model.
+
+The store also accumulates the per-advance DIRTY-BROKER masks (device
+bool[B]): `dirty_since(generation)` is the union of every delta's dirty
+region since `generation`, which the optimizer's dirty-region solve
+uses to restrict candidate sources/destinations around a warm-start
+seed of that generation (analyzer/context.restrict_context_to_dirty).
+
+Threading: one lock guards all store state; delta application runs
+under it (solves serialize on the device through the PR-4 scheduler
+anyway).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES
+from cruise_control_tpu.model.state import (ClusterState,
+                                            set_broker_capacities)
+from cruise_control_tpu.monitor.deltas import (capacity_rows,
+                                               leader_load_split)
+from cruise_control_tpu.utils import faults
+
+LOG = logging.getLogger(__name__)
+
+
+class UnsupportedDeltaError(ValueError):
+    """The delta cannot be applied to the resident tensors (names a
+    broker/partition the resident topology does not know) — a full
+    rebuild serves it instead (metered fallback, never an outage)."""
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DeltaPlan:
+    """Numeric, fixed-shape form of one ModelDelta (host-built,
+    device-applied).  Id arrays are padded to power-of-two lengths with
+    out-of-bounds fill (num_brokers / num_partitions) so the scatter
+    drops the padding and a handful of jitted program variants serve
+    every delta size."""
+
+    new_brokers: jax.Array         # i32[Nb], pad = num_brokers
+    removed_brokers: jax.Array     # i32[Nb]
+    demoted_brokers: jax.Array     # i32[Nb]
+    cap_rows: jax.Array            # i32[Nc], pad = num_brokers
+    cap_mask: jax.Array            # bool[Nc, RES]
+    cap_values: jax.Array          # f32[Nc, RES]
+    load_parts: jax.Array          # i32[Np], pad = num_partitions
+    load_leader_base: jax.Array    # f32[Np, RES]
+    load_follower_base: jax.Array  # f32[Np, RES]
+    load_bonus: jax.Array          # f32[Np, RES]
+
+
+def _pad_pow2(n: int, floor: int = 4) -> int:
+    if n <= floor:
+        return floor
+    return 1 << (n - 1).bit_length()
+
+
+def _id_array(ids, fill: int, width: int) -> np.ndarray:
+    out = np.full(width, fill, dtype=np.int32)
+    out[:len(ids)] = np.asarray(sorted(ids), dtype=np.int32)
+    return out
+
+
+def apply_delta(state: ClusterState, plan: DeltaPlan
+                ) -> Tuple[ClusterState, jax.Array]:
+    """(new state, dirty-broker mask bool[B]) — one delta applied to the
+    resident tensors, entirely on device (jitted by the store).
+
+    Each piece mirrors what a from-scratch rebuild would produce:
+    broker-flag scatters match the builder's alive/new/demoted columns,
+    capacity rows go through the SHARED set_broker_capacities op, and
+    load updates re-derive every affected replica's base load + the
+    partition's leadership bonus from the same host-side leader-load
+    split a rebuild performs (plan.load_* rows are precomputed by
+    monitor/deltas.leader_load_split)."""
+    num_b = state.num_brokers
+    num_p = state.num_partitions
+
+    new = state.broker_new.at[plan.new_brokers].set(True, mode="drop")
+    demoted = state.broker_demoted.at[plan.demoted_brokers].set(
+        True, mode="drop")
+    alive = state.broker_alive.at[plan.removed_brokers].set(
+        False, mode="drop")
+    removed_mask = jnp.zeros(num_b, dtype=bool).at[
+        plan.removed_brokers].set(True, mode="drop")
+    on_removed = removed_mask[state.replica_broker] & state.replica_valid
+    offline = state.replica_offline | on_removed
+    original_offline = state.replica_original_offline | on_removed
+
+    part_sel = jnp.zeros(num_p, dtype=bool).at[plan.load_parts].set(
+        True, mode="drop")
+    lb = jnp.zeros((num_p, NUM_RESOURCES), jnp.float32).at[
+        plan.load_parts].set(plan.load_leader_base, mode="drop")
+    fb = jnp.zeros((num_p, NUM_RESOURCES), jnp.float32).at[
+        plan.load_parts].set(plan.load_follower_base, mode="drop")
+    bn = jnp.zeros((num_p, NUM_RESOURCES), jnp.float32).at[
+        plan.load_parts].set(plan.load_bonus, mode="drop")
+    bonus = jnp.where(part_sel[:, None], bn,
+                      state.partition_leader_bonus)
+    p_of_r = state.replica_partition
+    r_sel = part_sel[p_of_r] & state.replica_valid
+    base_new = jnp.where(state.replica_is_leader[:, None],
+                         lb[p_of_r], fb[p_of_r])
+    base = jnp.where(r_sel[:, None], base_new, state.replica_base_load)
+
+    out = state.replace(
+        broker_new=new, broker_demoted=demoted, broker_alive=alive,
+        replica_offline=offline,
+        replica_original_offline=original_offline,
+        partition_leader_bonus=bonus, replica_base_load=base)
+    out = set_broker_capacities(out, plan.cap_rows, plan.cap_mask,
+                                plan.cap_values)
+
+    dirty = removed_mask
+    dirty = dirty.at[plan.new_brokers].set(True, mode="drop")
+    dirty = dirty.at[plan.demoted_brokers].set(True, mode="drop")
+    dirty = dirty.at[plan.cap_rows].set(True, mode="drop")
+    touched = jax.ops.segment_max(r_sel.astype(jnp.int32),
+                                  state.replica_broker,
+                                  num_segments=num_b)
+    dirty = dirty | (touched > 0)
+    return out, dirty
+
+
+class DeviceModelStore:
+    """See module docstring.  One per facade (per tenant under fleet
+    serving — each tenant's model is its own)."""
+
+    def __init__(self, max_dirty_entries: int = 256,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        import time as _t
+        self._lock = threading.RLock()
+        self._time = time_fn or _t.time
+        self._generation = None
+        self._cap_flag: Optional[bool] = None
+        self._state: Optional[ClusterState] = None
+        self._topology = None
+        self._follower_cpu = None
+        self._partition_index: Dict[tuple, int] = {}
+        #: (from_generation, to_generation, dirty bool[B] device) per
+        #: successful advance — dirty_since() walks this chain
+        self._dirty_log: List[tuple] = []
+        self._max_dirty_entries = max(1, max_dirty_entries)
+        # the ONE jitted apply program (jax caches per input shapes; the
+        # pow-of-two plan padding bounds the variant count)
+        self._apply_jit = jax.jit(apply_delta)
+        # telemetry (incremental-store-* sensors + STATE block)
+        self.hits = 0
+        self.misses = 0
+        self.fallbacks = 0
+        self.delta_applies = 0
+        self.invalidations = 0
+        self.quarantines = 0
+        self.last_dirty_brokers = 0
+        self.last_fallback_reason = ""
+        self.installed_at = 0.0
+
+    # ------------------------------------------------------------------
+    @property
+    def generation(self):
+        with self._lock:
+            return self._generation
+
+    @property
+    def capacity_flag(self):
+        """The allow_capacity_estimation flag the resident model was
+        built with (None when empty) — a consult with the other flag
+        must rebuild, never fast-forward (the delta chain preserves the
+        build flag, it cannot change it)."""
+        with self._lock:
+            return self._cap_flag
+
+    def get(self, generation, allow_capacity_estimation: bool):
+        """(state, topology) resident at exactly `generation` (and the
+        same capacity-estimation flag), else None.  Miss counting
+        happens in advance()/fallback() — a miss that fast-forwards is
+        still a hit for the caller."""
+        with self._lock:
+            if (self._state is not None
+                    and self._generation == generation
+                    and self._cap_flag == bool(allow_capacity_estimation)):
+                self.hits += 1
+                return self._state, self._topology
+            return None
+
+    def install(self, generation, state: ClusterState, topology,
+                allow_capacity_estimation: bool, follower_cpu) -> None:
+        """Adopt a freshly rebuilt model as the resident one.  Resets
+        the dirty chain: a rebuild may reflect changes no delta
+        described, so no earlier seed may claim a dirty region across
+        it."""
+        with self._lock:
+            self._generation = generation
+            self._cap_flag = bool(allow_capacity_estimation)
+            self._state = state
+            self._topology = topology
+            self._follower_cpu = follower_cpu
+            self._partition_index = {
+                (p.topic, p.partition): i
+                for i, p in enumerate(topology.partitions)}
+            self._dirty_log = []
+            self.installed_at = self._time()
+
+    def advance(self, records, to_generation):
+        """Fast-forward the resident model through a contiguous delta
+        chain (monitor.deltas_between output).  Returns (state,
+        topology) at `to_generation`, or None when any delta cannot be
+        applied — the store is then cleared (fallback) or quarantined
+        (fault mid-apply) and the caller rebuilds.  Commit is
+        all-or-nothing: the resident model never reflects half a
+        chain."""
+        with self._lock:
+            if self._state is None or not records \
+                    or records[0].from_generation != self._generation:
+                self._fallback("generation-gap")
+                return None
+            state = self._state
+            dirty_entries = []
+            try:
+                for rec in records:
+                    faults.inject("store.apply_delta")
+                    plan = self._build_plan(rec.delta)
+                    state, dirty = self._apply_jit(state, plan)
+                    dirty_entries.append(
+                        (rec.from_generation, rec.to_generation, dirty))
+            except UnsupportedDeltaError as exc:
+                self._fallback(f"unsupported-delta: {exc}")
+                return None
+            except Exception as exc:  # noqa: BLE001 - a fault mid-apply
+                # may have poisoned device buffers: quarantine the whole
+                # resident model, never serve a half-applied one
+                self.quarantine(f"{type(exc).__name__}: {exc}")
+                return None
+            self._state = state
+            self._generation = to_generation
+            self._dirty_log.extend(dirty_entries)
+            del self._dirty_log[:-self._max_dirty_entries]
+            self.delta_applies += len(records)
+            self.hits += 1
+            self.last_dirty_brokers = int(jax.device_get(
+                jnp.sum(dirty_entries[-1][2].astype(jnp.int32))))
+            return self._state, self._topology
+
+    def dirty_since(self, generation) -> Optional[jax.Array]:
+        """Union dirty-broker mask (device bool[B]) covering every delta
+        applied between `generation` and the resident generation, or
+        None when the chain does not cover `generation` (a rebuild or
+        trimming broke it — callers must full-sweep then).  The resident
+        generation itself yields the all-clean mask."""
+        with self._lock:
+            if self._state is None:
+                return None
+            num_b = self._state.num_brokers
+            if generation == self._generation:
+                return jnp.zeros(num_b, dtype=bool)
+            mask = None
+            cur = generation
+            for frm, to, dirty in self._dirty_log:
+                if frm == cur:
+                    mask = dirty if mask is None else (mask | dirty)
+                    cur = to
+                    if cur == self._generation:
+                        return mask
+                elif mask is not None:
+                    return None
+            return None
+
+    # ------------------------------------------------------------------
+    def invalidate(self, reason: str) -> None:
+        """Drop the resident model (kept for the operator's counters;
+        e.g. the solver ladder descending below FUSED — EAGER/CPU rungs
+        re-materialize from the monitor anyway, and a degraded device
+        is no place to trust resident buffers)."""
+        with self._lock:
+            if self._state is None:
+                return
+            self._clear()
+            self.invalidations += 1
+            LOG.info("device model store invalidated (%s)", reason)
+
+    def quarantine(self, reason: str) -> None:
+        """Invalidate because delta application FAILED: the resident
+        model may be inconsistent with the monitor's — metered
+        separately so a delta-storm of faults is visible."""
+        with self._lock:
+            self._clear()
+            self.quarantines += 1
+            self.fallbacks += 1
+            self.last_fallback_reason = f"quarantined: {reason}"
+            LOG.warning("device model store quarantined (%s); next solve "
+                        "rebuilds from the monitor", reason)
+
+    def record_fallback(self, reason: str) -> None:
+        """Count a consult that had a resident model but could not use
+        it (gap, over-long chain, flag mismatch, oversized dirty
+        region) — the operator's delta-storm / thrash signal."""
+        with self._lock:
+            self._fallback(reason)
+
+    def _fallback(self, reason: str) -> None:
+        self.misses += 1
+        self.fallbacks += 1
+        self.last_fallback_reason = reason
+
+    def count_miss(self) -> None:
+        with self._lock:
+            self.misses += 1
+
+    def _clear(self) -> None:
+        self._generation = None
+        self._cap_flag = None
+        self._state = None
+        self._topology = None
+        self._follower_cpu = None
+        self._partition_index = {}
+        self._dirty_log = []
+
+    # ------------------------------------------------------------------
+    def _build_plan(self, delta) -> DeltaPlan:
+        """Host-side numeric plan for ONE delta against the resident
+        topology.  Raises UnsupportedDeltaError when the delta names
+        anything the resident axes cannot address (a genuinely new
+        broker row, an unsampled partition) — those are shape changes
+        and rebuild territory."""
+        topo = self._topology
+        bidx = topo.broker_index
+        num_b = len(topo.broker_ids)
+        num_p = len(topo.partitions)
+
+        def rows_of(ids, what: str):
+            missing = [b for b in ids if b not in bidx]
+            if missing:
+                raise UnsupportedDeltaError(
+                    f"{what} names brokers {sorted(missing)} absent "
+                    f"from the resident model")
+            return [bidx[b] for b in ids]
+
+        new_rows = rows_of([a.broker_id for a in delta.add_brokers],
+                           "add_brokers")
+        removed_rows = rows_of(delta.remove_brokers, "remove_brokers")
+        demoted_rows = rows_of(delta.demote_brokers, "demote_brokers")
+
+        cap_rows, cap_mask, cap_values = capacity_rows(
+            delta.capacity_overrides, bidx)
+        if len(cap_rows) != len(delta.capacity_overrides):
+            raise UnsupportedDeltaError(
+                "capacity_overrides name brokers absent from the "
+                "resident model")
+
+        # last update per partition wins, matching the monitor overlay's
+        # dict semantics; unique rows keep the scatter well-defined
+        by_row: Dict[int, tuple] = {}
+        for u in delta.load_updates:
+            key = (u.topic, int(u.partition))
+            if key not in self._partition_index:
+                raise UnsupportedDeltaError(
+                    f"load update for {key[0]}-{key[1]}: partition "
+                    f"absent from the resident model (no samples at "
+                    f"build time)")
+            by_row[self._partition_index[key]] = leader_load_split(
+                u.load, self._follower_cpu)
+        load_rows = sorted(by_row)
+        l_lb = [by_row[r][0] for r in load_rows]
+        l_fb = [by_row[r][1] for r in load_rows]
+        l_bn = [by_row[r][2] for r in load_rows]
+
+        nb = _pad_pow2(max(len(new_rows), len(removed_rows),
+                           len(demoted_rows)))
+        nc = _pad_pow2(len(cap_rows))
+        np_ = _pad_pow2(len(load_rows))
+
+        def pad_f32(rows_list, width):
+            out = np.zeros((width, NUM_RESOURCES), dtype=np.float32)
+            if rows_list:
+                out[:len(rows_list)] = np.stack(rows_list)
+            return out
+
+        cap_rows_p = np.full(nc, num_b, dtype=np.int32)
+        cap_rows_p[:len(cap_rows)] = cap_rows
+        cap_mask_p = np.zeros((nc, NUM_RESOURCES), dtype=bool)
+        cap_mask_p[:len(cap_rows)] = cap_mask
+        cap_values_p = np.zeros((nc, NUM_RESOURCES), dtype=np.float32)
+        cap_values_p[:len(cap_rows)] = cap_values
+
+        return DeltaPlan(
+            new_brokers=jnp.asarray(_id_array(new_rows, num_b, nb)),
+            removed_brokers=jnp.asarray(
+                _id_array(removed_rows, num_b, nb)),
+            demoted_brokers=jnp.asarray(
+                _id_array(demoted_rows, num_b, nb)),
+            cap_rows=jnp.asarray(cap_rows_p),
+            cap_mask=jnp.asarray(cap_mask_p),
+            cap_values=jnp.asarray(cap_values_p),
+            load_parts=jnp.asarray(_id_array(load_rows, num_p, np_)),
+            load_leader_base=jnp.asarray(pad_f32(l_lb, np_)),
+            load_follower_base=jnp.asarray(pad_f32(l_fb, np_)),
+            load_bonus=jnp.asarray(pad_f32(l_bn, np_)))
+
+    # ------------------------------------------------------------------
+    def to_json(self) -> dict:
+        with self._lock:
+            gen = self._generation
+            return {
+                "resident": self._state is not None,
+                "generation": (None if gen is None else {
+                    "cluster": gen.cluster_generation,
+                    "load": gen.load_generation,
+                    "delta": gen.delta_generation}),
+                "numBrokers": (0 if self._state is None
+                               else self._state.num_brokers),
+                "numReplicas": (0 if self._state is None
+                                else self._state.num_replicas),
+                "hits": self.hits,
+                "misses": self.misses,
+                "fallbacks": self.fallbacks,
+                "deltaApplies": self.delta_applies,
+                "invalidations": self.invalidations,
+                "quarantines": self.quarantines,
+                "lastDirtyBrokers": self.last_dirty_brokers,
+                "lastFallbackReason": self.last_fallback_reason,
+                "dirtyChainLength": len(self._dirty_log),
+            }

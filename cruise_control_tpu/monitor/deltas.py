@@ -1,0 +1,237 @@
+"""Structured workload-model deltas.
+
+Production traffic is not cold full-model re-solves — it is thousands
+of small changes (one broker added, one topic hot, one rack drained).
+The reference's Load Monitor maintains ONE continuously-updated
+in-memory workload model (CC/monitor/LoadMonitor.java); the tensor
+equivalent here is a `ModelDelta` stream: each delta describes one
+small, structured change to the monitor's model, the LoadMonitor logs
+it against the model-generation chain (load_monitor.apply_model_delta),
+and the device-resident model store (model/store.py) replays it as a
+jitted in-place tensor update instead of paying the full host-side
+model re-materialization.
+
+The mutation vocabulary deliberately REUSES the PR-3 `ScenarioSpec`
+shapes (scenario/spec.py): broker add (`BrokerAdd` — an id already in
+the topology marks the existing broker as freshly-joined/new), broker
+remove (modeled dead so the solve drains it), broker demote, absolute
+per-broker capacity overrides, plus the one kind scenarios do not need:
+per-partition expected-load updates (the "topic went hot" delta).  A
+delta a scenario could express hypothetically is exactly a delta the
+monitor can ingest for real.
+
+Generation chaining: every applied delta advances the model generation
+by one `delta_generation` step and records (from_generation,
+to_generation) — the store may only fast-forward through a CONTIGUOUS
+chain.  Any unlogged change (metadata refresh found a new broker, fresh
+samples moved the load generation) breaks the chain and the store falls
+back to a full rebuild; a delta can make the resident model wrong only
+if its host-overlay application and its device application disagree,
+which the byte-equality pin (tests/test_incremental.py) forbids.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES
+from cruise_control_tpu.scenario.spec import (RESOURCE_NAMES, BrokerAdd,
+                                              ScenarioSpecError,
+                                              _check_resource_map)
+
+
+class ModelDeltaError(ValueError):
+    """Malformed or inapplicable model delta."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionLoadUpdate:
+    """New EXPECTED leader utilization for one partition (the value the
+    monitor's window collapse would produce — avg CPU/NW, latest DISK).
+    Follower loads and the leadership bonus re-derive from it exactly
+    like a full rebuild derives them (builder leader-load split)."""
+
+    topic: str
+    partition: int
+    #: leader expected utilization in Resource order (cpu, nw_in,
+    #: nw_out, disk)
+    load: Tuple[float, ...]
+
+    def __post_init__(self) -> None:
+        if len(self.load) != NUM_RESOURCES:
+            raise ModelDeltaError(
+                f"partition load needs {NUM_RESOURCES} entries "
+                f"({', '.join(RESOURCE_NAMES)}), got {len(self.load)}")
+        for v in self.load:
+            if not (float(v) >= 0.0):
+                raise ModelDeltaError(
+                    f"partition load must be finite and >= 0, got {v!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelDelta:
+    """One structured change to the monitor's workload model (pure
+    data; the ScenarioSpec mutation vocabulary plus load updates)."""
+
+    #: mark existing brokers as freshly joined (`broker_new`, the
+    #: ADD_BROKER immigration-target semantics).  Hypothetical rows are
+    #: NOT materialized by a delta — a broker unknown to the metadata
+    #: is a shape change and forces a full rebuild.
+    add_brokers: Tuple[BrokerAdd, ...] = ()
+    #: model these brokers dead (replicas drain via self-healing)
+    remove_brokers: Tuple[int, ...] = ()
+    demote_brokers: Tuple[int, ...] = ()
+    #: broker id -> {resource name: absolute capacity}
+    capacity_overrides: Dict[int, Dict[str, float]] = dataclasses.field(
+        default_factory=dict)
+    load_updates: Tuple[PartitionLoadUpdate, ...] = ()
+    reason: str = ""
+
+    def is_noop(self) -> bool:
+        return not (self.add_brokers or self.remove_brokers
+                    or self.demote_brokers or self.capacity_overrides
+                    or self.load_updates)
+
+    def validate(self) -> None:
+        if self.is_noop():
+            raise ModelDeltaError("empty model delta")
+        for a in self.add_brokers:
+            if a.rack is not None or a.capacity is not None:
+                raise ModelDeltaError(
+                    f"add_brokers[{a.broker_id}] carries rack/capacity: "
+                    f"a delta only marks an EXISTING broker as freshly "
+                    f"joined — materializing a hypothetical row is a "
+                    f"shape change (rebuild), and capacity belongs in "
+                    f"capacity_overrides")
+        try:
+            for b, caps in self.capacity_overrides.items():
+                _check_resource_map(f"capacityOverrides[{int(b)}]", caps,
+                                    allow_zero=False)
+        except ScenarioSpecError as exc:
+            raise ModelDeltaError(str(exc))
+        added = {a.broker_id for a in self.add_brokers}
+        overlap = added & set(self.remove_brokers)
+        if overlap:
+            raise ModelDeltaError(
+                f"brokers {sorted(overlap)} both added and removed in "
+                f"one delta")
+
+    def broker_ids_touched(self) -> Tuple[int, ...]:
+        """Broker ids DIRECTLY named by this delta (load updates dirty
+        the hosting brokers too — resolved against the resident model
+        by the store, which knows the placement)."""
+        ids = ({a.broker_id for a in self.add_brokers}
+               | set(self.remove_brokers) | set(self.demote_brokers)
+               | set(self.capacity_overrides))
+        return tuple(sorted(ids))
+
+    def describe(self) -> str:
+        parts = []
+        if self.add_brokers:
+            added = sorted(a.broker_id for a in self.add_brokers)
+            parts.append(f"add={added}")
+        if self.remove_brokers:
+            parts.append(f"remove={sorted(self.remove_brokers)}")
+        if self.demote_brokers:
+            parts.append(f"demote={sorted(self.demote_brokers)}")
+        if self.capacity_overrides:
+            parts.append(f"capacity={sorted(self.capacity_overrides)}")
+        if self.load_updates:
+            parts.append(f"loads={len(self.load_updates)}p")
+        return " ".join(parts) or "noop"
+
+
+@dataclasses.dataclass(frozen=True)
+class DeltaRecord:
+    """One applied delta on the model-generation chain: the monitor's
+    generation moved `from_generation` -> `to_generation` by applying
+    exactly `delta`.  `seq` is a monotonically increasing ordinal (log
+    trimming bookkeeping)."""
+
+    seq: int
+    from_generation: object          #: monitor.ModelGeneration
+    to_generation: object
+    delta: ModelDelta
+
+
+def capacity_rows(capacity_overrides: Dict[int, Dict[str, float]],
+                  broker_index: Dict[int, int]):
+    """(rows i32[N], mask bool[N, RES], values f32[N, RES]) — the
+    numeric form of per-broker capacity overrides, shared by the
+    monitor's rebuild overlay and the device store's delta application
+    so the two can never round differently.  Brokers absent from
+    `broker_index` are skipped (they left the metadata)."""
+    import numpy as np
+    rows, mask, values = [], [], []
+    for b in sorted(capacity_overrides):
+        if b not in broker_index:
+            continue
+        caps = capacity_overrides[b]
+        m = np.zeros(NUM_RESOURCES, dtype=bool)
+        v = np.zeros(NUM_RESOURCES, dtype=np.float32)
+        for name, value in caps.items():
+            r = RESOURCE_NAMES.index(name)
+            m[r] = True
+            v[r] = np.float32(value)
+        rows.append(broker_index[b])
+        mask.append(m)
+        values.append(v)
+    if not rows:
+        return (np.zeros(0, np.int32), np.zeros((0, NUM_RESOURCES), bool),
+                np.zeros((0, NUM_RESOURCES), np.float32))
+    return (np.asarray(rows, np.int32), np.stack(mask), np.stack(values))
+
+
+def leader_load_split(load, follower_cpu):
+    """(leader_base f32[RES], follower_base f32[RES], bonus f32[RES]) —
+    the builder's leader-load split (model/builder.py build(): follower
+    base + leadership bonus) applied to one partition's new expected
+    leader utilization, in the SAME float64-then-f32 arithmetic.
+
+    The leader's base CPU is the CLAMPED estimate (the builder wraps
+    the estimator in np.clip) while follower rows carry the monitor
+    loop's RAW estimate (LoadMonitor.cluster_model follower
+    attribution) — normally equal, but a custom estimator can make them
+    differ, so the two are kept separate exactly like a rebuild keeps
+    them."""
+    import numpy as np
+    from cruise_control_tpu.common.resources import Resource
+    vec = np.asarray(load, dtype=np.float64)
+    raw_f = float(follower_cpu(vec[Resource.CPU], vec[Resource.NW_IN],
+                               vec[Resource.NW_OUT]))
+    clipped_f = float(np.clip(raw_f, 0.0, vec[Resource.CPU]))
+    leader_base = vec.copy()
+    leader_base[Resource.CPU] = clipped_f
+    leader_base[Resource.NW_OUT] = 0.0
+    follower_base = vec.copy()
+    follower_base[Resource.CPU] = raw_f
+    follower_base[Resource.NW_OUT] = 0.0
+    bonus = np.zeros(NUM_RESOURCES, dtype=np.float64)
+    bonus[Resource.CPU] = vec[Resource.CPU] - clipped_f
+    bonus[Resource.NW_OUT] = vec[Resource.NW_OUT]
+    return (leader_base.astype(np.float32),
+            follower_base.astype(np.float32),
+            bonus.astype(np.float32))
+
+
+def chain_between(records, from_generation, to_generation
+                  ) -> Optional[list]:
+    """The CONTIGUOUS DeltaRecord chain taking `from_generation` to
+    `to_generation`, or None when no such chain exists (an unlogged
+    change interleaved, the log was trimmed past `from_generation`, or
+    the generations are unrelated).  `from == to` is the empty chain."""
+    if from_generation == to_generation:
+        return []
+    chain: list = []
+    cur = from_generation
+    for rec in records:
+        if rec.from_generation == cur:
+            chain.append(rec)
+            cur = rec.to_generation
+            if cur == to_generation:
+                return chain
+        elif chain:
+            # continuity broken mid-walk: something moved the
+            # generation without a record
+            return None
+    return None

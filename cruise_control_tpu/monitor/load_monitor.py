@@ -52,15 +52,23 @@ LOG = logging.getLogger(__name__)
 
 @dataclasses.dataclass(frozen=True, order=True)
 class ModelGeneration:
-    """(cluster metadata generation, load/aggregator generation) — staleness
-    key for model/proposal caches (reference CC/monitor/ModelGeneration.java)."""
+    """(cluster metadata generation, load/aggregator generation, applied
+    model-delta count) — staleness key for model/proposal caches
+    (reference CC/monitor/ModelGeneration.java).  `delta_generation`
+    counts structured model deltas applied to the monitor's overlay
+    (apply_model_delta): a delta changes what cluster_model() builds, so
+    it must move the generation exactly like a metadata or sample change
+    does — otherwise the proposal cache and the device model store would
+    serve pre-delta results as current."""
 
     cluster_generation: int
     load_generation: int
+    delta_generation: int = 0
 
     def is_stale(self, other: "ModelGeneration") -> bool:
         return (self.cluster_generation < other.cluster_generation
-                or self.load_generation < other.load_generation)
+                or self.load_generation < other.load_generation
+                or self.delta_generation < other.delta_generation)
 
 
 @dataclasses.dataclass
@@ -117,6 +125,7 @@ class LoadMonitor:
                  use_linear_regression_model: bool = True,
                  linear_regression_kwargs: Optional[dict] = None,
                  cpu_util_weights: Optional[tuple] = None,
+                 delta_log_size: int = 256,
                  time_fn: Callable[[], float] = time.time):
         self._admin = admin
         self._metadata = MetadataClient(admin, metadata_ttl_ms, time_fn)
@@ -179,6 +188,26 @@ class LoadMonitor:
         #: ModelParameters.java:22-30); None = module defaults
         self._cpu_util_weights = cpu_util_weights
 
+        # -- incremental workload model (monitor/deltas.py) --
+        # The monitor's host-side model OVERLAY: structured deltas
+        # (apply_model_delta) land here so a full rebuild reflects them
+        # exactly like the device store's in-place tensor application —
+        # the two paths must stay byte-identical (the incremental pin).
+        self._delta_lock = threading.Lock()
+        self._delta_generation = 0
+        self._delta_seq = 0
+        self._delta_log: list = []          #: deltas.DeltaRecord, oldest
+        self._delta_log_size = max(1, delta_log_size)   # first
+        self._overlay_new: set = set()      #: broker ids marked new
+        self._overlay_removed: set = set()  #: broker ids modeled dead
+        self._overlay_demoted: set = set()
+        #: broker id -> {resource name: absolute capacity}
+        self._overlay_capacity: Dict[int, Dict[str, float]] = {}
+        #: (topic, partition) -> (expected leader load f64[RES],
+        #: load-generation stamp) — superseded (and dropped) as soon as
+        #: fresh samples move the aggregator generation past the stamp
+        self._overlay_loads: Dict[Tuple[str, int], tuple] = {}
+
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
@@ -224,7 +253,112 @@ class LoadMonitor:
 
     def model_generation(self) -> ModelGeneration:
         return ModelGeneration(self._metadata.cluster_generation,
-                               self._partition_aggregator.generation)
+                               self._partition_aggregator.generation,
+                               self._delta_generation)
+
+    # ------------------------------------------------------------------
+    # incremental workload model: structured deltas (monitor/deltas.py)
+    # ------------------------------------------------------------------
+    def apply_model_delta(self, delta) -> ModelGeneration:
+        """Ingest one structured model delta: validate it against the
+        current metadata, apply it to the monitor's host-side overlay
+        (so every later cluster_model() rebuild reflects it) and log it
+        on the model-generation chain for the device model store's
+        fast-forward path.  Returns the new model generation.
+
+        Metadata is force-refreshed FIRST so a pending unlogged change
+        (a broker the admin already sees) moves the generation before
+        `from_generation` is captured — the chain then breaks at the
+        unlogged change, never across it, and the store can never
+        fast-forward past something it has no delta for."""
+        from cruise_control_tpu.monitor.deltas import (DeltaRecord,
+                                                       ModelDelta,
+                                                       ModelDeltaError)
+        if not isinstance(delta, ModelDelta):
+            raise ModelDeltaError(f"expected a ModelDelta, got "
+                                  f"{type(delta).__name__}")
+        delta.validate()
+        snapshot = self._metadata.refresh_metadata()
+        known = set(snapshot.all_broker_ids)
+        topics = {p.tp.topic for p in snapshot.partitions}
+        unknown = [b for b in delta.broker_ids_touched() if b not in known]
+        if unknown:
+            raise ModelDeltaError(
+                f"delta names brokers {sorted(unknown)} unknown to the "
+                f"cluster metadata (a genuinely new broker is a shape "
+                f"change: refresh metadata and rebuild instead)")
+        bad_topics = sorted({u.topic for u in delta.load_updates}
+                            - topics)
+        if bad_topics:
+            raise ModelDeltaError(
+                f"delta updates loads of unknown topics {bad_topics}")
+        with self._delta_lock:
+            frm = self.model_generation()
+            self._overlay_new.update(a.broker_id
+                                     for a in delta.add_brokers)
+            self._overlay_removed.update(delta.remove_brokers)
+            self._overlay_demoted.update(delta.demote_brokers)
+            for b, caps in delta.capacity_overrides.items():
+                merged = dict(self._overlay_capacity.get(int(b), {}))
+                merged.update({k: float(v) for k, v in caps.items()})
+                self._overlay_capacity[int(b)] = merged
+            load_gen = self._partition_aggregator.generation
+            for u in delta.load_updates:
+                self._overlay_loads[(u.topic, int(u.partition))] = (
+                    np.asarray(u.load, dtype=np.float64), load_gen)
+            self._delta_generation += 1
+            self._delta_seq += 1
+            # `to` derives from `frm` with ONLY the delta step applied —
+            # never re-read the live generation here: a concurrent
+            # sample/metadata bump between the two reads would fold an
+            # UNLOGGED change into this record and let the store
+            # fast-forward across it.  If something did move
+            # concurrently, the current generation simply won't match
+            # any record's to_generation and the store rebuilds — the
+            # chain breaks AT the unlogged change, never across it.
+            to = ModelGeneration(frm.cluster_generation,
+                                 frm.load_generation,
+                                 self._delta_generation)
+            self._delta_log.append(DeltaRecord(
+                seq=self._delta_seq, from_generation=frm,
+                to_generation=to, delta=delta))
+            del self._delta_log[:-self._delta_log_size]
+        LOG.info("model delta applied (%s): generation %s -> %s",
+                 delta.describe(), frm, to)
+        return to
+
+    def deltas_between(self, from_generation, to_generation):
+        """The contiguous DeltaRecord chain from_generation ->
+        to_generation, or None when no chain exists (unlogged change,
+        trimmed log) — the device store's fast-forward eligibility
+        check (model/store.py)."""
+        from cruise_control_tpu.monitor.deltas import chain_between
+        with self._delta_lock:
+            records = list(self._delta_log)
+        return chain_between(records, from_generation, to_generation)
+
+    def clear_model_overlay(self) -> ModelGeneration:
+        """Drop every overlay entry (operator reset: the next rebuild
+        reflects raw metadata + samples only).  Moves the generation —
+        clearing changes the model."""
+        with self._delta_lock:
+            self._overlay_new.clear()
+            self._overlay_removed.clear()
+            self._overlay_demoted.clear()
+            self._overlay_capacity.clear()
+            self._overlay_loads.clear()
+            self._delta_generation += 1
+            # an overlay clear is deliberately NOT a logged delta: the
+            # store must full-rebuild, never fast-forward over it
+            return self.model_generation()
+
+    def follower_cpu_estimator(self):
+        """The follower-CPU attribution function the next
+        cluster_model() build will use (trained regression, configured
+        static weights, or the module defaults) — the device model
+        store derives per-partition load splits with the SAME function
+        so delta application stays byte-identical to a rebuild."""
+        return self._follower_cpu_fn()
 
     def pause_metric_sampling(self, reason: str) -> None:
         self.task_runner.pause_sampling(reason)
@@ -303,10 +437,41 @@ class LoadMonitor:
                     float(vals[w, cpu]), float(vals[w, lin]),
                     float(vals[w, lout]), float(vals[w, rin]))
         self.cpu_model.train()
+        if self._use_linear_regression:
+            # training changes follower-CPU attribution, i.e. what the
+            # next build produces: move the model generation (UNLOGGED —
+            # the device store must full-rebuild with the new estimator,
+            # never fast-forward a load delta split with the stale one,
+            # and the proposal cache must not serve pre-TRAIN results
+            # as current).  With use.linear.regression.model=false the
+            # trained model is kept but unused: nothing changed.
+            with self._delta_lock:
+                self._delta_generation += 1
 
     # ------------------------------------------------------------------
     # model building
     # ------------------------------------------------------------------
+    def _follower_cpu_fn(self):
+        """Follower-CPU attribution for the next build: the trained
+        regression once TRAIN ran (clamped to [0, leader CPU] so a noisy
+        fit cannot attribute a follower more CPU than its leader uses),
+        else the configured static weights, else the module defaults."""
+        coefs = (self.cpu_model.coefficients
+                 if self._use_linear_regression else None)
+        if coefs is not None:
+            return (lambda cpu, nw_in, nw_out:
+                    min(max(coefs.estimate_follower_cpu(nw_in), 0.0),
+                        float(cpu)))
+        if self._cpu_util_weights is not None:
+            lw_in, lw_out, fw_in = self._cpu_util_weights
+            return (lambda cpu, nw_in, nw_out:
+                    estimate_follower_cpu(
+                        cpu, nw_in, nw_out,
+                        leader_in_weight=lw_in,
+                        leader_out_weight=lw_out,
+                        follower_in_weight=fw_in))
+        return estimate_follower_cpu
+
     def _expected_utilization(self, vae: ValuesAndExtrapolations
                               ) -> np.ndarray:
         """Collapse windows → one load vector: avg for CPU/NW, latest for
@@ -350,26 +515,25 @@ class LoadMonitor:
         # one read: per-partition consistency + no per-partition locking;
         # the builder's leader-load split must use the same follower-CPU
         # attribution as the follower loads assigned below
-        coefs = (self.cpu_model.coefficients
-                 if self._use_linear_regression else None)
-        if coefs is not None:
-            # clamped to [0, leader CPU] so a noisy fit cannot attribute a
-            # follower more CPU than its leader uses — keeps follower loads
-            # and the builder's leader base/bonus split identical
-            follower_cpu = (lambda cpu, nw_in, nw_out:
-                            min(max(coefs.estimate_follower_cpu(nw_in), 0.0),
-                                float(cpu)))
-        elif self._cpu_util_weights is not None:
-            lw_in, lw_out, fw_in = self._cpu_util_weights
-            follower_cpu = (lambda cpu, nw_in, nw_out:
-                            estimate_follower_cpu(
-                                cpu, nw_in, nw_out,
-                                leader_in_weight=lw_in,
-                                leader_out_weight=lw_out,
-                                follower_in_weight=fw_in))
-        else:
-            follower_cpu = estimate_follower_cpu
+        follower_cpu = self._follower_cpu_fn()
         builder = ClusterModelBuilder(follower_cpu_estimator=follower_cpu)
+        # consistent overlay snapshot for this build: structured deltas
+        # applied so far (monitor/deltas.py) — a rebuild must reflect
+        # them byte-for-byte like the device store's in-place delta
+        # application does (the incremental pin).  Load overrides whose
+        # aggregator-generation stamp aged out (fresh samples arrived)
+        # are superseded and pruned here.
+        with self._delta_lock:
+            load_gen_now = self._partition_aggregator.generation
+            self._overlay_loads = {
+                k: vs for k, vs in self._overlay_loads.items()
+                if vs[1] == load_gen_now}
+            ov_new = set(self._overlay_new)
+            ov_removed = set(self._overlay_removed)
+            ov_demoted = set(self._overlay_demoted)
+            ov_capacity = {b: dict(c)
+                           for b, c in self._overlay_capacity.items()}
+            ov_loads = {k: vs[0] for k, vs in self._overlay_loads.items()}
         # --- brokers with resolved capacity (populateClusterCapacity) ---
         logdirs_by_broker = self._admin.describe_log_dirs(
             sorted(snapshot.all_broker_ids))
@@ -388,7 +552,11 @@ class LoadMonitor:
                 jbod_dirs[binfo.broker_id] = frozenset(disks)
             builder.add_broker(
                 binfo.broker_id, rack_id=binfo.rack or binfo.host,
-                capacity=cap.capacity, host=binfo.host, alive=binfo.alive,
+                capacity=cap.capacity, host=binfo.host,
+                alive=binfo.alive
+                and binfo.broker_id not in ov_removed,
+                new=binfo.broker_id in ov_new,
+                demoted=binfo.broker_id in ov_demoted,
                 disks=disks)
 
         # --- per-partition replica loads (populatePartitionLoad) ---
@@ -399,7 +567,9 @@ class LoadMonitor:
             if vae is None:
                 n_skipped += 1
                 continue
-            leader_load = self._expected_utilization(vae)
+            override = ov_loads.get((pinfo.tp.topic, pinfo.tp.partition))
+            leader_load = (override if override is not None
+                           else self._expected_utilization(vae))
             offline = set(pinfo.offline_replicas)
             leader = pinfo.leader
             for broker_id in pinfo.replicas:
@@ -426,12 +596,30 @@ class LoadMonitor:
                     offline=broker_id in offline,
                     logdir=logdir if has_jbod else None)
         state, topology = builder.build()
+        if ov_capacity:
+            state = _apply_capacity_overlay(state, topology, ov_capacity)
         LOG.debug("generated cluster model in %.0f ms (B=%d P=%d R=%d, "
                   "%d partitions without samples)",
                   (time.time() - t0) * 1e3, state.num_brokers,
                   state.num_partitions,
                   int(np.asarray(state.replica_valid).sum()), n_skipped)
         return state, topology
+
+
+def _apply_capacity_overlay(state: ClusterState, topology,
+                            capacity_overrides) -> ClusterState:
+    """Apply absolute capacity overrides to a freshly built state with
+    EXACTLY the ops the device store's delta application uses
+    (deltas-to-rows in monitor/deltas.capacity_rows, scatter in
+    model/state.set_broker_capacities) — the shared helpers are what
+    makes rebuild-vs-delta byte equality hold by construction."""
+    from cruise_control_tpu.model.state import set_broker_capacities
+    from cruise_control_tpu.monitor.deltas import capacity_rows
+    rows, mask, values = capacity_rows(capacity_overrides,
+                                       topology.broker_index)
+    if rows.size == 0:
+        return state
+    return set_broker_capacities(state, rows, mask, values)
 
 
 class ModelBuildPermit:

@@ -114,6 +114,14 @@ class ScenarioOutcome:
     #: model), not a solver verdict — the router re-raises it as
     #: InvalidModelInputError to match the single-solve path
     invalid_input: bool = False
+    #: this lane's FINAL placement (host numpy: replica_broker,
+    #: replica_is_leader, optional replica_disk) — populated only for
+    #: per-lane-membership batches (fleet folds), where it is already
+    #: fetched for the proposal diff; the fleet router rebuilds a full
+    #: final ClusterState from it so folded solves seed warm starts
+    #: exactly like inline solves do (PR-5 left folded results with
+    #: final_state=None, starving warm starts)
+    final_placement: Optional[dict] = None
     balancedness: float = 0.0
     num_replica_moves: int = 0
     num_leadership_moves: int = 0
@@ -704,6 +712,19 @@ class ScenarioEngine:
             frozenset(g.name for g in goals if g.is_hard),
             violated_after, self.balancedness_weights)
 
+        final_placement = None
+        if not batch.shared_membership and feasible:
+            # per-lane-membership batch (fleet fold): the final
+            # placement planes are already fetched per lane — retain
+            # them so the router can rebuild this lane's final state
+            # (warm-start seeding).  Scenario batches share one base
+            # model and never seed warm starts: skip the retention.
+            final_placement = dict(
+                replica_broker=placements["fin_b"],
+                replica_is_leader=placements["fin_l"])
+            if placements["fin_d"] is not None:
+                final_placement["replica_disk"] = placements["fin_d"]
+
         proposals: List = []
         if include_proposals and feasible:
             from cruise_control_tpu.analyzer.proposals import \
@@ -731,6 +752,7 @@ class ScenarioEngine:
             stats_by_goal=stats_by_goal,
             regressed_goals=regressed,
             invalid_input=bool(invalid),
+            final_placement=final_placement,
             balancedness=balancedness,
             num_replica_moves=num_moves,
             num_leadership_moves=leader_moves,

@@ -373,8 +373,20 @@ class TestCrossTenantFold:
             ref_a.violated_goals_after
         assert results["b"].violated_goals_after == \
             ref_b.violated_goals_after
-        # folded results carry no final state (no warm seed) by design
-        assert results["a"].final_state is None
+        # folded results carry PER-LANE final states (split back from
+        # the batched placement fetch), so a folded solve seeds warm
+        # starts exactly like the inline path; each lane's state keeps
+        # its own bucket-padded shapes
+        for key, cc in (("a", cc_a), ("b", cc_b)):
+            final = results[key].final_state
+            assert final is not None
+            ref = (ref_a if key == "a" else ref_b).final_state
+            assert final.num_replicas == ref.num_replicas
+            assert final.num_brokers == ref.num_brokers
+            assert np.array_equal(np.asarray(final.replica_broker),
+                                  np.asarray(ref.replica_broker))
+            assert np.array_equal(np.asarray(final.replica_is_leader),
+                                  np.asarray(ref.replica_is_leader))
 
 
 @pytest.mark.chaos

@@ -1,0 +1,584 @@
+"""Device-resident incremental workload model (model/store.py +
+monitor/deltas.py + the facade's dirty-region warm-start solving).
+
+The pins here are the PR's contracts:
+
+* delta-applied resident model == from-scratch rebuild, byte for byte,
+  for EVERY delta kind (capacity, per-partition load, demote, add/new,
+  remove) and for chains of them;
+* an all-dirty mask solves byte-identically to the full sweep, and a
+  warm-started dirty-subset solve stays feasible and within the full
+  solve's balancedness;
+* generation gaps, over-long chains and ladder descents below FUSED
+  fall back to a full rebuild (metered), never a wrong answer;
+* a fault mid-`apply_delta` QUARANTINES the store (chaos pin): the next
+  solve rebuilds, a half-applied model is never served;
+* warm seeds are tagged (tenant scope, model generation): a seed never
+  warm-starts another tenant or a generation it did not see, and
+  fleet-folded results now carry per-lane final states that seed warm
+  starts exactly like inline solves (fleet/router.py).
+"""
+import dataclasses
+
+import conftest  # noqa: F401
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions,
+                                                 make_context,
+                                                 restrict_context_to_dirty)
+from cruise_control_tpu.analyzer.goals.registry import default_goals
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.cluster.simulated import SimulatedCluster
+from cruise_control_tpu.cluster.types import TopicPartition
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.model.store import DeviceModelStore
+from cruise_control_tpu.monitor.deltas import (BrokerAdd, ModelDelta,
+                                               ModelDeltaError,
+                                               PartitionLoadUpdate)
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.sampling.sampler import (
+    SimulatedClusterSampler)
+from cruise_control_tpu.sched.policy import SchedulerClass
+from cruise_control_tpu.utils import faults
+
+pytestmark = pytest.mark.incremental
+
+INCR_GOALS = ["RackAwareGoal", "DiskCapacityGoal",
+              "ReplicaDistributionGoal", "DiskUsageDistributionGoal"]
+
+
+def _build_sim(num_brokers=6, partitions=20, rf=3):
+    sim = SimulatedCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b, rack=f"rack{b % 3}")
+    assignments = [[(p + i) % num_brokers for i in range(rf)]
+                   for p in range(partitions)]
+    sim.create_topic("t0", assignments, size_bytes=1e4)
+    for p in range(partitions):
+        sim.set_partition_load(TopicPartition("t0", p),
+                               leader_cpu=2.0 + p * 0.1,
+                               nw_in=100.0 + p, nw_out=300.0)
+    return sim
+
+
+def _make_monitor(sim, clock):
+    mon = LoadMonitor(sim, SimulatedClusterSampler(sim), num_windows=3,
+                      window_ms=10_000, min_samples_per_window=1,
+                      time_fn=lambda: clock["now"])
+    mon.task_runner.start(do_sampling=False)
+    for _ in range(6):
+        mon.task_runner.sample_once()
+        sim.advance(5)
+        clock["now"] += 5
+    return mon
+
+
+def _states_equal(a, b) -> bool:
+    for f in dataclasses.fields(type(a)):
+        x, y = getattr(a, f.name), getattr(b, f.name)
+        if hasattr(x, "shape"):
+            if np.asarray(x).shape != np.asarray(y).shape \
+                    or not np.array_equal(np.asarray(x), np.asarray(y)):
+                return False
+        elif x != y:
+            return False
+    return True
+
+
+def make_stack(skewed=True, **cc_kwargs):
+    """A live facade over the simulated cluster (the incremental path's
+    real substrate: monitor generations, delta log, device store)."""
+    sim = SimulatedCluster()
+    clock = {"now": 10_000.0}
+    for b in range(4):
+        sim.add_broker(b, rack=f"rack{b % 2}")
+    assignments = [([0, 1] if skewed else [p % 4, (p + 1) % 4])
+                   for p in range(12)]
+    sim.create_topic("t0", assignments, size_bytes=1e4)
+    for p in range(12):
+        sim.set_partition_load(TopicPartition("t0", p), leader_cpu=2.0,
+                               nw_in=100.0, nw_out=300.0)
+    cc = CruiseControl(
+        sim, SimulatedClusterSampler(sim),
+        time_fn=lambda: clock["now"],
+        sleep_fn=lambda s: (sim.advance(s),
+                            clock.__setitem__("now", clock["now"] + s)),
+        monitor_kwargs=dict(num_windows=3, window_ms=10_000,
+                            min_samples_per_window=1,
+                            sampling_interval_ms=5_000),
+        executor_kwargs=dict(progress_check_interval_s=1.0),
+        auto_warmup=False, goal_names=list(INCR_GOALS), **cc_kwargs)
+    cc.start_up(do_sampling=False, start_detection=False)
+    for _ in range(8):
+        cc.load_monitor.task_runner.sample_once()
+        sim.advance(5)
+        clock["now"] += 5
+    return sim, cc, clock
+
+
+# ---------------------------------------------------------------------------
+# delta application == rebuild, byte for byte
+# ---------------------------------------------------------------------------
+class TestDeltaByteEquality:
+    @pytest.fixture()
+    def rig(self):
+        sim = _build_sim()
+        clock = {"now": 10_000.0}
+        mon = _make_monitor(sim, clock)
+        gen = mon.model_generation()
+        state, topo = mon.cluster_model()
+        store = DeviceModelStore()
+        store.install(gen, state, topo, True,
+                      mon.follower_cpu_estimator())
+        yield sim, mon, store
+        mon.shutdown()
+
+    @pytest.mark.parametrize("delta", [
+        ModelDelta(capacity_overrides={2: {"disk": 5e5, "cpu": 80.0}}),
+        ModelDelta(load_updates=(
+            PartitionLoadUpdate("t0", 5, (6.0, 140.0, 420.0, 3e4)),
+            PartitionLoadUpdate("t0", 11, (1.0, 10.0, 30.0, 1e3)))),
+        ModelDelta(demote_brokers=(4,)),
+        ModelDelta(add_brokers=(BrokerAdd(broker_id=1),)),
+        ModelDelta(remove_brokers=(5,)),
+    ], ids=["capacity", "load", "demote", "add-new", "remove"])
+    def test_every_delta_kind_byte_equals_rebuild(self, rig, delta):
+        _sim, mon, store = rig
+        g_from = store.generation
+        g_to = mon.apply_model_delta(delta)
+        chain = mon.deltas_between(g_from, g_to)
+        assert chain and len(chain) == 1
+        got = store.advance(chain, g_to)
+        assert got is not None, store.last_fallback_reason
+        rebuilt, _ = mon.cluster_model()
+        assert _states_equal(got[0], rebuilt)
+        assert store.last_dirty_brokers >= 1
+
+    def test_chain_of_deltas_byte_equals_rebuild(self, rig):
+        _sim, mon, store = rig
+        g0 = store.generation
+        for delta in (
+                ModelDelta(capacity_overrides={0: {"nw_in": 3e5}}),
+                ModelDelta(load_updates=(PartitionLoadUpdate(
+                    "t0", 2, (3.0, 50.0, 90.0, 2e4)),)),
+                ModelDelta(demote_brokers=(1,))):
+            g_to = mon.apply_model_delta(delta)
+        chain = mon.deltas_between(g0, g_to)
+        assert chain and len(chain) == 3
+        got = store.advance(chain, g_to)
+        assert got is not None
+        rebuilt, _ = mon.cluster_model()
+        assert _states_equal(got[0], rebuilt)
+        # the dirty union covers every delta since g0
+        dirty = store.dirty_since(g0)
+        assert dirty is not None
+        assert np.asarray(dirty)[[0, 1]].all()
+
+    def test_unlogged_change_breaks_the_chain(self, rig):
+        sim, mon, store = rig
+        g0 = store.generation
+        g1 = mon.apply_model_delta(
+            ModelDelta(capacity_overrides={0: {"disk": 9e5}}))
+        # fresh samples move the load generation with NO delta record
+        mon.task_runner.sample_once()
+        g2 = mon.model_generation()
+        assert g2 != g1
+        assert mon.deltas_between(g0, g2) is None
+        assert store.advance([], g2) is None
+        assert store.fallbacks >= 1
+
+    def test_capacity_flag_mismatch_never_fast_forwards(self, rig):
+        """Review finding: a delta chain preserves the resident build's
+        allow_capacity_estimation flag — a consult with the OTHER flag
+        must rebuild, not advance (the facade gateway's guard)."""
+        sim = _build_sim()
+        clock = {"now": 10_000.0}
+        mon = _make_monitor(sim, clock)
+        store = DeviceModelStore()
+        gen = mon.model_generation()
+        state, topo = mon.cluster_model()
+        store.install(gen, state, topo, True,
+                      mon.follower_cpu_estimator())
+        mon.apply_model_delta(
+            ModelDelta(capacity_overrides={0: {"disk": 9e5}}))
+        assert store.capacity_flag is True
+        # the facade-level guard is what prevents the advance; at store
+        # level the flag is exposed for exactly that comparison
+        assert store.get(mon.model_generation(), False) is None
+        mon.shutdown()
+
+    def test_train_moves_the_generation(self, rig):
+        """Review finding: TRAIN changes follower-CPU attribution (what
+        the next build produces) — the generation must move so neither
+        the store nor the proposal cache serves pre-TRAIN results."""
+        sim = _build_sim()
+        clock = {"now": 10_000.0}
+        mon = LoadMonitor(sim, SimulatedClusterSampler(sim),
+                          num_windows=3, window_ms=10_000,
+                          min_samples_per_window=1,
+                          use_linear_regression_model=True,
+                          time_fn=lambda: clock["now"])
+        mon.task_runner.start(do_sampling=False)
+        for _ in range(6):
+            mon.task_runner.sample_once()
+            sim.advance(5)
+            clock["now"] += 5
+        g0 = mon.model_generation()
+        mon.train()
+        assert mon.model_generation() != g0
+        # unlogged: the store must rebuild, never fast-forward
+        assert mon.deltas_between(g0, mon.model_generation()) is None
+        mon.shutdown()
+
+    def test_unknown_ids_are_rejected_or_unsupported(self, rig):
+        _sim, mon, store = rig
+        with pytest.raises(ModelDeltaError):
+            mon.apply_model_delta(ModelDelta(demote_brokers=(99,)))
+        with pytest.raises(ModelDeltaError):
+            # hypothetical broker rows are shape changes, not deltas
+            mon.apply_model_delta(ModelDelta(
+                add_brokers=(BrokerAdd(broker_id=1,
+                                       rack="somewhere"),)))
+        with pytest.raises(ModelDeltaError):
+            mon.apply_model_delta(ModelDelta())
+
+
+# ---------------------------------------------------------------------------
+# dirty-region solving
+# ---------------------------------------------------------------------------
+class TestDirtyRegionSolve:
+    @pytest.fixture(scope="class")
+    def cluster(self):
+        from cruise_control_tpu.testing.random_cluster import (
+            RandomClusterSpec, random_cluster)
+        return random_cluster(RandomClusterSpec(
+            num_brokers=8, num_partitions=60, replication_factor=2,
+            num_racks=2, num_topics=4, seed=7, skew_fraction=0.25))
+
+    @pytest.fixture(scope="class")
+    def optimizer(self):
+        return GoalOptimizer(default_goals(max_rounds=32,
+                                           names=INCR_GOALS),
+                             pipeline_segment_size=4)
+
+    def test_all_dirty_mask_is_byte_identical_to_full(self, cluster,
+                                                      optimizer):
+        state, topo = cluster
+        full = optimizer.optimizations(state, topo)
+        alld = optimizer.optimizations(
+            state, topo, dirty_brokers=jnp.ones(state.num_brokers, bool))
+
+        def keys(props):
+            return [(str(p.partition),
+                     tuple(r.broker_id for r in p.new_replicas))
+                    for p in props]
+        assert keys(full.proposals) == keys(alld.proposals)
+        assert np.array_equal(
+            np.asarray(full.final_state.replica_broker),
+            np.asarray(alld.final_state.replica_broker))
+        assert np.array_equal(
+            np.asarray(full.final_state.replica_is_leader),
+            np.asarray(alld.final_state.replica_is_leader))
+
+    def test_warm_dirty_subset_feasible_within_full_balancedness(
+            self, cluster, optimizer):
+        state, topo = cluster
+        full = optimizer.optimizations(state, topo)
+        # a delta: one broker's capacity moves; solve warm from the
+        # converged placement with only that broker dirty
+        state2 = state.replace(
+            broker_capacity=state.broker_capacity.at[2].set(
+                state.broker_capacity[2] * 1.5))
+        dirty = jnp.zeros(state.num_brokers, bool).at[2].set(True)
+        warm = optimizer.optimizations(state2, topo,
+                                       warm_start=full.final_state,
+                                       dirty_brokers=dirty)
+        ctrl = optimizer.optimizations(state2, topo,
+                                       warm_start=full.final_state)
+        hard = {g.name for g in optimizer.goals if g.is_hard}
+        assert not (set(warm.violated_goals_after) & hard)
+        assert warm.balancedness_score() >= \
+            ctrl.balancedness_score() - 1e-6
+        # the restricted search does no more work than the full sweep
+        assert (sum(warm.rounds_by_goal.values())
+                <= sum(ctrl.rounds_by_goal.values()))
+
+    def test_restrict_context_all_dirty_is_identity(self, cluster):
+        state, topo = cluster
+        ctx = make_context(state, BalancingConstraint(),
+                           OptimizationOptions(), topo)
+        rest = restrict_context_to_dirty(
+            state, ctx, jnp.ones(state.num_brokers, bool))
+        assert np.array_equal(np.asarray(rest.replica_movable),
+                              np.asarray(ctx.replica_movable))
+        assert np.array_equal(np.asarray(rest.broker_dest_ok),
+                              np.asarray(ctx.broker_dest_ok))
+
+    def test_restrict_context_subset_freezes_clean_sources(self,
+                                                           cluster):
+        state, topo = cluster
+        ctx = make_context(state, BalancingConstraint(),
+                           OptimizationOptions(), topo)
+        dirty = jnp.zeros(state.num_brokers, bool).at[0].set(True)
+        rest = restrict_context_to_dirty(state, ctx, dirty)
+        movable = np.asarray(rest.replica_movable)
+        rb = np.asarray(state.replica_broker)
+        # replicas on clean, non-overloaded brokers are frozen
+        load = np.asarray(
+            jax.device_get(jnp.asarray(ctx.balance_upper_pct)))
+        util = (np.asarray(jax.device_get(
+            __import__("cruise_control_tpu.model.state",
+                       fromlist=["broker_load"]).broker_load(state)))
+            / np.maximum(np.asarray(state.broker_capacity), 1e-9))
+        clean_cold = [b for b in range(state.num_brokers)
+                      if b != 0 and not (util[b] > load).any()]
+        for b in clean_cold:
+            assert not movable[(rb == b)
+                               & np.asarray(state.replica_valid)].any()
+
+
+# ---------------------------------------------------------------------------
+# facade: store consults, warm-seed tags, fallbacks
+# ---------------------------------------------------------------------------
+class TestFacadeIncremental:
+    def test_interactive_delta_solve_rides_the_store(self):
+        _sim, cc, _clock = make_stack()
+        try:
+            cc.optimizations()                     # cold: install + seed
+            store = cc._model_store
+            assert store.to_json()["resident"]
+            assert cc._warm_seed is not None
+            seed_state, seed_gen, seed_scope = cc._warm_seed
+            assert seed_gen == cc.load_monitor.model_generation()
+            assert seed_scope == cc._coalesce_scope
+
+            cc.load_monitor.apply_model_delta(
+                ModelDelta(capacity_overrides={2: {"disk": 9e5}}))
+            result = cc.optimizations()            # interactive default
+            assert store.delta_applies >= 1
+            assert store.hits >= 1
+            assert store.last_dirty_brokers == 1
+            assert result.proposals is not None
+            # the seed advanced to the new generation
+            assert cc._warm_seed[1] == cc.load_monitor.model_generation()
+        finally:
+            cc.shutdown()
+
+    def test_incremental_matches_full_solve_quality(self):
+        _sim, cc, _clock = make_stack()
+        try:
+            cc.optimizations()
+            cc.load_monitor.apply_model_delta(
+                ModelDelta(capacity_overrides={1: {"disk": 1.2e6}}))
+            incr = cc.optimizations()
+            # full-sweep control on the SAME model: incremental off
+            cc._incremental_enabled = False
+            full = cc.optimizations(ignore_proposal_cache=True)
+            assert incr.balancedness_score() >= \
+                full.balancedness_score() - 1e-6
+        finally:
+            cc.shutdown()
+
+    def test_generation_gap_falls_back_to_rebuild(self):
+        _sim, cc, _clock = make_stack()
+        try:
+            cc.optimizations()
+            store = cc._model_store
+            # load generation moves with NO delta: gap
+            cc.load_monitor.task_runner.sample_once()
+            cc.optimizations()
+            assert store.fallbacks >= 1
+            assert "generation-gap" in store.last_fallback_reason
+            # ... and the rebuild re-installed the store
+            assert store.to_json()["resident"]
+        finally:
+            cc.shutdown()
+
+    def test_stale_seed_dropped_when_generation_moves_unseen(self):
+        _sim, cc, _clock = make_stack()
+        try:
+            cc.optimizations()
+            assert cc._warm_seed is not None
+            cc.load_monitor.task_runner.sample_once()   # unlogged move
+            state, topo, warm, dirty = cc._materialize_solve_inputs(
+                True, None, incremental={})
+            assert warm is None and dirty is None
+            assert cc._warm_seed is None                # dropped for good
+        finally:
+            cc.shutdown()
+
+    def test_seed_never_crosses_scope(self):
+        _sim, cc, _clock = make_stack()
+        try:
+            cc.optimizations()
+            seed_state, seed_gen, _scope = cc._warm_seed
+            # a seed tagged for ANOTHER tenant must never warm this one
+            cc._warm_seed = (seed_state, seed_gen, "tenant-beta")
+            _state, _topo, warm, dirty = cc._materialize_solve_inputs(
+                True, None, incremental={})
+            assert warm is None and dirty is None
+        finally:
+            cc.shutdown()
+
+    def test_precompute_class_keeps_the_full_sweep(self):
+        _sim, cc, _clock = make_stack()
+        try:
+            cc.optimizations()
+            cc.load_monitor.apply_model_delta(
+                ModelDelta(capacity_overrides={3: {"disk": 1.1e6}}))
+            before = cc.metrics.meter(
+                "incremental-solve-fallbacks").to_json()["count"]
+            cc.optimizations(
+                _scheduler_class=SchedulerClass.PRECOMPUTE)
+            # precompute solves full-sweep: the dirty path never
+            # engaged, so no incremental fallback can have fired
+            assert cc.metrics.meter(
+                "incremental-solve-fallbacks").to_json()["count"] \
+                == before
+            # the store still served the materialization
+            assert cc._model_store.delta_applies >= 1
+        finally:
+            cc.shutdown()
+
+    def test_state_and_sensors_expose_the_store(self):
+        _sim, cc, _clock = make_stack()
+        try:
+            cc.optimizations()
+            out = cc.state()
+            block = out["IncrementalStoreState"]
+            assert block["enabled"] and block["resident"]
+            sensors = cc.state(substates=["sensors"])["Sensors"]
+            for name in ("incremental-store-hits",
+                         "incremental-store-misses",
+                         "incremental-store-fallbacks",
+                         "incremental-store-delta-applies",
+                         "incremental-store-dirty-brokers"):
+                assert name in sensors, name
+        finally:
+            cc.shutdown()
+
+    def test_disabled_flag_bypasses_the_store(self):
+        _sim, cc, _clock = make_stack(incremental_enabled=False)
+        try:
+            cc.optimizations()
+            st = cc._model_store
+            assert not st.to_json()["resident"]
+            assert st.hits == 0 and st.delta_applies == 0
+        finally:
+            cc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos: half-applied deltas, ladder descents
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+class TestIncrementalChaos:
+    def test_fault_mid_apply_quarantines_and_rebuilds(self):
+        _sim, cc, _clock = make_stack()
+        try:
+            clean = cc.optimizations()
+            store = cc._model_store
+            cc.load_monitor.apply_model_delta(
+                ModelDelta(capacity_overrides={0: {"disk": 1.3e6}}))
+            plan = faults.FaultPlan().fail_nth("store.apply_delta", 1)
+            with faults.injected(plan):
+                result = cc.optimizations()
+            # the store quarantined instead of serving half a model...
+            assert store.quarantines == 1
+            assert "quarantined" in store.last_fallback_reason
+            # ...and the solve was served from a full rebuild whose
+            # result matches a clean twin's on the same model
+            cc2_sim, cc2, _ = make_stack()
+            try:
+                cc2.load_monitor.apply_model_delta(ModelDelta(
+                    capacity_overrides={0: {"disk": 1.3e6}}))
+                twin = cc2.optimizations()
+                assert ([str(p.partition) for p in result.proposals]
+                        == [str(p.partition) for p in twin.proposals])
+            finally:
+                cc2.shutdown()
+            # the rebuild re-installed a fresh resident model
+            assert store.to_json()["resident"]
+        finally:
+            cc.shutdown()
+
+    def test_ladder_descent_below_fused_invalidates_store(self):
+        _sim, cc, _clock = make_stack(
+            solver_max_retries_per_rung=0,
+            solver_retry_backoff_base_s=0.0)
+        try:
+            cc.optimizations()
+            store = cc._model_store
+            assert store.to_json()["resident"]
+            plan = faults.FaultPlan().fail_nth("optimizer.execute",
+                                               (1, 2, 3, 4))
+            with faults.injected(plan):
+                cc.optimizations(ignore_proposal_cache=True)
+            assert store.invalidations >= 1
+            assert not store.to_json()["resident"] or \
+                store.invalidations >= 1
+        finally:
+            cc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fleet fold: per-lane final states seed warm starts
+# ---------------------------------------------------------------------------
+@pytest.mark.fleet
+class TestFoldedWarmSeeds:
+    def test_result_from_outcome_rebuilds_final_state(self):
+        from cruise_control_tpu.fleet.router import (FleetRouter,
+                                                     FleetSolvePayload)
+        from cruise_control_tpu.scenario.engine import ScenarioOutcome
+        from cruise_control_tpu.scenario.spec import ScenarioSpec
+        from cruise_control_tpu.testing.random_cluster import (
+            RandomClusterSpec, random_cluster)
+        state, _topo = random_cluster(RandomClusterSpec(
+            num_brokers=4, num_partitions=8, replication_factor=2,
+            num_racks=2, num_topics=2, seed=3))
+        router = FleetRouter()
+        payload = FleetSolvePayload(
+            tenant_id="alpha", optimizer=GoalOptimizer([]),
+            constraint=BalancingConstraint(),
+            balancedness_weights=(1.1, 1.5),
+            materialize=lambda: None, run_inline=lambda: None,
+            commit=lambda r: None)
+        fin_b = np.roll(np.asarray(state.replica_broker), 1)
+        outcome = ScenarioOutcome(
+            spec=ScenarioSpec(name="fleet:alpha"), feasible=True,
+            final_placement=dict(
+                replica_broker=fin_b,
+                replica_is_leader=np.asarray(state.replica_is_leader)))
+        result = router._result_from_outcome(payload, outcome, 0.1,
+                                             lane_state=state)
+        assert result.final_state is not None
+        assert np.array_equal(
+            np.asarray(result.final_state.replica_broker), fin_b)
+        # membership fields come from the lane's own input state
+        assert np.array_equal(
+            np.asarray(result.final_state.replica_partition),
+            np.asarray(state.replica_partition))
+
+    def test_outcome_without_placement_keeps_no_state(self):
+        from cruise_control_tpu.fleet.router import (FleetRouter,
+                                                     FleetSolvePayload)
+        from cruise_control_tpu.scenario.engine import ScenarioOutcome
+        from cruise_control_tpu.scenario.spec import ScenarioSpec
+        router = FleetRouter()
+        payload = FleetSolvePayload(
+            tenant_id="alpha", optimizer=GoalOptimizer([]),
+            constraint=BalancingConstraint(),
+            balancedness_weights=(1.1, 1.5),
+            materialize=lambda: None, run_inline=lambda: None,
+            commit=lambda r: None)
+        outcome = ScenarioOutcome(spec=ScenarioSpec(name="x"),
+                                  feasible=True)
+        result = router._result_from_outcome(payload, outcome, 0.1,
+                                             lane_state=None)
+        assert result.final_state is None

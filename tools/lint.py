@@ -29,6 +29,14 @@ Checks:
     optimizer/engine compile gateways — a compile that bypasses the
     gateway is invisible to the persistent program cache and silently
     re-pays the ~300s cold start (the PR-7 invariant);
+  * single-store rule: no direct `*.cluster_model(...)` materialization
+    on a LoadMonitor outside facade.py (the `_model_for_solve` /
+    `_materialize_solve_inputs` gateway), the device model store
+    (model/store.py) and the monitor itself — a solve path that
+    rebuilds the model directly bypasses the device-resident store and
+    silently re-pays the ~3.2s host build per request (the PR-9
+    incremental invariant, same pattern as the solve-gateway and
+    cache-gateway rules);
   * tenant-root rule: no mutable module-level state in fleet-reachable
     modules (cruise_control_tpu/fleet/) — the FleetRegistry INSTANCE is
     the only root of per-tenant state, so draining a tenant provably
@@ -229,7 +237,12 @@ def _mesh_violations(path: Path, tree: ast.AST) -> list:
 #: invisible to the cache and silently re-pays the ~300s cold start.
 _PROGCACHE_ALLOWED_RELPATHS = {"analyzer/optimizer.py",
                                "scenario/engine.py",
-                               "parallel/progcache.py"}
+                               "parallel/progcache.py",
+                               # the model store's delta-apply program:
+                               # a handful of tiny scatters (compiles in
+                               # ms, LRU'd by jit itself) — not worth a
+                               # persistent-cache tier
+                               "model/store.py"}
 
 
 def _progcache_violations(path: Path, tree: ast.AST) -> list:
@@ -273,6 +286,48 @@ def _progcache_violations(path: Path, tree: ast.AST) -> list:
                 f"outside the compile gateways ({allowed}) — every XLA "
                 f"compile must go through the persistent program cache "
                 f"(cache-gateway rule)")
+    return findings
+
+
+#: package-relative paths allowed to materialize the cluster model
+#: directly: the facade (its _model_for_solve gateway consults the
+#: device-resident store first), the store implementation, and the
+#: monitor that owns the builder.  Everyone else reaches a model
+#: through the facade gateway — the single-store half of the
+#: incremental-model invariant (PR 9).
+_MODEL_STORE_ALLOWED_RELPATHS = {"facade.py", "model/store.py",
+                                 "monitor/load_monitor.py"}
+
+
+def _model_store_violations(path: Path, tree: ast.AST) -> list:
+    """Single-store rule: no `<monitor>.cluster_model(...)` call in the
+    package outside the facade gateway, the store, and the monitor
+    itself.  Receiver-based: only calls whose receiver names a monitor
+    (`load_monitor`, `_load_monitor`, ...) count — the facade's public
+    `cc.cluster_model()` wrapper is itself gatewayed."""
+    parts = path.parts
+    if "cruise_control_tpu" not in parts:
+        return []
+    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
+    rel = "/".join(parts[pkg + 1:])
+    if rel in _MODEL_STORE_ALLOWED_RELPATHS:
+        return []
+    findings = []
+    allowed = ", ".join(sorted(_MODEL_STORE_ALLOWED_RELPATHS))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute) \
+                or func.attr != "cluster_model":
+            continue
+        recv = _receiver_name(func.value).lower()
+        if "monitor" in recv:
+            findings.append(
+                f"{path}:{node.lineno}: direct LoadMonitor model "
+                f"materialization outside the allowed modules "
+                f"({allowed}) — route it through the facade's "
+                f"store-aware gateway (single-store rule)")
     return findings
 
 
@@ -391,6 +446,7 @@ def lint_file(path: Path) -> list:
     findings.extend(_gateway_violations(path, tree))
     findings.extend(_mesh_violations(path, tree))
     findings.extend(_progcache_violations(path, tree))
+    findings.extend(_model_store_violations(path, tree))
     findings.extend(_fleet_mutable_globals(path, tree))
 
     # unused imports: __init__.py files are re-export surfaces; a module
