@@ -136,6 +136,34 @@ def _pct(values, q):
                        int(round(q * (len(ordered) - 1))))]
 
 
+def _with_trace_summary(out: dict, cluster=None) -> dict:
+    """Attach the run's per-phase trace attribution (obs/ flight
+    recorder: slowest + median request broken into span durations) to a
+    bench artifact, so every BENCH_r* round carries WHERE the time went,
+    not just totals.  `cluster` restricts to one facade's traces (the
+    incremental bench runs a baseline twin whose full re-solves must
+    not pose as the measured path's slowest request).  Never fails the
+    bench."""
+    try:
+        from cruise_control_tpu.obs import recorder as obs_recorder
+        traces = obs_recorder.get_recorder().snapshot()
+        if cluster is not None:
+            traces = [t for t in traces
+                      if t.get("tags", {}).get("cluster") == cluster]
+        out["trace_summary"] = obs_recorder.phase_summary(traces)
+    except Exception as exc:  # noqa: BLE001 - attribution is a bonus
+        print(f"# trace summary unavailable: {exc}", file=sys.stderr)
+    return out
+
+
+def _reset_traces():
+    """Drop every trace recorded so far (warmup / compile passes /
+    baseline runs) so the artifact's trace_summary attributes ONLY the
+    measured pass that follows."""
+    from cruise_control_tpu.obs import recorder as obs_recorder
+    obs_recorder.install()
+
+
 # persistent compile cache: segment programs at 2.6K-broker scale take
 # minutes to compile; retries and re-runs must not pay that twice
 os.environ.setdefault(
@@ -303,8 +331,14 @@ def main() -> None:
         profiler = profiling.install()
 
     def run_once(st, topo, options):
-        return optimizer.optimizations(st, topo, options,
-                                       check_sanity=False, mesh=mesh)
+        # each measured solve runs under its own trace (obs/): the
+        # instrument-fetch span (and, under CC_TPU_PROFILE, every
+        # profiler segment) lands in the flight recorder, which the
+        # trace_summary block of the output JSON aggregates
+        from cruise_control_tpu.obs import trace as obs_trace
+        with obs_trace.solve_trace("bench.solve", config=config):
+            return optimizer.optimizations(st, topo, options,
+                                           check_sanity=False, mesh=mesh)
 
     def run_config(st, topo):
         """One measured pass; config 4 chains add-broker then
@@ -358,6 +392,9 @@ def main() -> None:
     if profiler is not None:
         # drop warmup-run records so the table attributes the MEASURED run
         profiler.reset()
+    # likewise drop warmup traces: trace_summary must attribute the
+    # measured run, not the compile-laden warmup pass
+    _reset_traces()
     t0 = time.time()
     results = run_config(state, topo)
     elapsed = time.time() - t0
@@ -418,7 +455,7 @@ def main() -> None:
         print("# ERROR: goal self-regression — these goals' OWN passes "
               "worsened their own violated-broker counts "
               f"(at-entry -> after-own): {regressions}", file=sys.stderr)
-    print(json.dumps(out))
+    print(json.dumps(_with_trace_summary(out)))
     if regressions:
         sys.exit(1)
 
@@ -506,6 +543,9 @@ def _incremental_bench() -> None:
     inc.optimizations()
     base.optimizations()
     print(f"# warm solves done ({time.time()-t0:.1f}s)", file=sys.stderr)
+    # the measured delta stream starts here: its facade-minted traces
+    # (not the compile-laden warm solves above) feed trace_summary
+    _reset_traces()
 
     rng = np.random.default_rng(11)
 
@@ -591,14 +631,14 @@ def _incremental_bench() -> None:
     inc.shutdown()
     base.shutdown()
 
-    print(json.dumps({
+    print(json.dumps(_with_trace_summary({
         "metric": (f"incremental {n_deltas}-delta interactive stream "
                    f"{num_b}b/{num_p/1000:g}Kp rf{rf} [{backend}]"),
         "value": result["p50_s"],
         "unit": "s",
         "vs_baseline": result["stream_speedup_p50"],
         "incremental": result,
-    }))
+    }, cluster=inc._coalesce_scope)))
     if not byte_identical:
         print("ERROR: delta-applied resident model != from-scratch "
               "rebuild", file=sys.stderr)
@@ -663,7 +703,7 @@ def _coldstart_bench() -> None:
     if not identical:
         print("# ERROR: warm proposals differ from cold proposals",
               file=sys.stderr)
-    print(json.dumps({
+    print(json.dumps(_with_trace_summary({
         "metric": (f"cold-process time-to-first-proposal "
                    f"{cold['brokers']}b/{cold['partitions'] / 1000:g}Kp "
                    f"warm progcache"),
@@ -677,7 +717,7 @@ def _coldstart_bench() -> None:
             "warm_zero_compiles": zero_compiles,
             "proposals_identical": identical,
         },
-    }))
+    })))
     if not (zero_compiles and identical):
         sys.exit(1)
 
@@ -717,7 +757,7 @@ def _coldstart_child() -> None:
          tuple(p.new_replicas), p.new_leader)
         for p in result.proposals)).encode()).hexdigest()
     stats = progcache.stats()
-    print(json.dumps({
+    print(json.dumps(_with_trace_summary({
         "brokers": state.num_brokers,
         "partitions": state.num_partitions,
         "ttfp_s": round(ttfp_s, 3),
@@ -732,7 +772,7 @@ def _coldstart_child() -> None:
         "cache_bytes": sum(
             e.size_bytes
             for e in progcache.entries(all_fingerprints=True)),
-    }))
+    })))
 
 
 def _mesh_bench() -> None:
@@ -796,7 +836,17 @@ def _mesh_bench() -> None:
                   file=sys.stderr)
             continue
 
-        def solve():
+        def solve(traced=False):
+            # only the MEASURED pass runs under a trace: warmup and the
+            # profile pass would otherwise dominate trace_summary's
+            # "slowest" with non-comparable wall-clocks
+            if traced:
+                from cruise_control_tpu.obs import trace as obs_trace
+                with obs_trace.solve_trace("bench.mesh-solve",
+                                           meshDevices=n):
+                    return optimizer.optimizations(
+                        state, topo, OptimizationOptions(),
+                        check_sanity=False, mesh=mesh)
             return optimizer.optimizations(state, topo,
                                            OptimizationOptions(),
                                            check_sanity=False, mesh=mesh)
@@ -807,7 +857,7 @@ def _mesh_bench() -> None:
         solve()                                   # first-run host costs
         warm_total = time.time() - t0
         t0 = time.time()
-        r = solve()                               # the measured pass
+        r = solve(traced=True)                    # the measured pass
         solve_s = time.time() - t0
         entry = {
             "warmup_s": round(warm_total, 3),
@@ -841,7 +891,7 @@ def _mesh_bench() -> None:
     n_max = str(max(int(k) for k in results))
     base = results.get("1", results[min(results, key=int)])
     top = results[n_max]
-    print(json.dumps({
+    print(json.dumps(_with_trace_summary({
         "metric": (f"mesh-scaled full-stack {state.num_brokers}b/"
                    f"{state.num_partitions/1000:g}Kp rf{rf} "
                    f"mesh={n_max} [{backend}]"),
@@ -852,7 +902,7 @@ def _mesh_bench() -> None:
                         if top["solve_s"] else 0.0),
         "n_devices": top["n_devices"],
         "mesh": results,
-    }))
+    })))
 
 
 def _scenario_bench() -> None:
@@ -924,8 +974,10 @@ def _scenario_bench() -> None:
         specs = specs_for(k)
         cold = engine.evaluate(state, topo, specs,
                                include_proposals=False)
-        warm = engine.evaluate(state, topo, specs,
-                               include_proposals=False)
+        from cruise_control_tpu.obs import trace as obs_trace
+        with obs_trace.solve_trace("bench.scenario-batch", k=k):
+            warm = engine.evaluate(state, topo, specs,
+                                   include_proposals=False)
         infeasible = sum(1 for o in warm.outcomes if not o.feasible)
         results[str(k)] = {
             "compile_s": round(cold.compile_s, 3),
@@ -944,7 +996,7 @@ def _scenario_bench() -> None:
     k_max = str(max(batches))
     per_max = results[k_max]["per_scenario_s"]
     per_one = results["1"]["per_scenario_s"]
-    print(json.dumps({
+    print(json.dumps(_with_trace_summary({
         "metric": (f"scenario what-if batch K={k_max} "
                    f"{state.num_brokers}b/{state.num_partitions/1000:g}Kp "
                    f"rf{rf} [{backend}]"),
@@ -954,7 +1006,7 @@ def _scenario_bench() -> None:
         # per-scenario latency (>1 = batching wins)
         "vs_baseline": round(per_one / per_max, 3) if per_max else 0.0,
         "scenario": results,
-    }))
+    })))
 
 
 def _fleet_bench() -> None:
@@ -1029,7 +1081,15 @@ def _fleet_bench() -> None:
           f"{bucket.replicas}r, goals={names} [{backend}]",
           file=sys.stderr)
 
-    def solve(state, topo):
+    def solve(state, topo, traced=False):
+        # only WARM measured solves carry a trace (cold solves are
+        # compile-dominated and would skew trace_summary's "slowest")
+        if traced:
+            from cruise_control_tpu.obs import trace as obs_trace
+            with obs_trace.solve_trace("bench.fleet-solve"):
+                return optimizer.optimizations(state, topo,
+                                               OptimizationOptions(),
+                                               check_sanity=False)
         return optimizer.optimizations(state, topo,
                                        OptimizationOptions(),
                                        check_sanity=False)
@@ -1061,7 +1121,7 @@ def _fleet_bench() -> None:
             solve(state, topo)
             cold.append(time.time() - t0)
             t0 = time.time()
-            result = solve(state, topo)
+            result = solve(state, topo, traced=True)
             warm.append(time.time() - t0)
         return compiled_executables(), cold, warm, result
 
@@ -1101,7 +1161,7 @@ def _fleet_bench() -> None:
     top = results[str(k_max)]
     b, u = (top["bucketed_compiled_programs"],
             top["unbucketed_compiled_programs"])
-    print(json.dumps({
+    print(json.dumps(_with_trace_summary({
         "metric": (f"fleet {k_max} tenants {num_b}b/"
                    f"{num_p/1000:g}Kp rf{rf} bucket={bucket.brokers}b "
                    f"[{backend}]"),
@@ -1112,7 +1172,7 @@ def _fleet_bench() -> None:
         "vs_baseline": round(u / b, 3) if b else 0.0,
         "results_identical": identical,
         "fleet": results,
-    }))
+    })))
 
 
 def _sched_bench() -> None:
@@ -1208,13 +1268,20 @@ def _sched_bench() -> None:
                 if scheduler is None:
                     solve(variant)
                 else:
-                    scheduler.submit(SolveJob(
-                        klass=(SchedulerClass.USER_INTERACTIVE
-                               if interactive
-                               else SchedulerClass.PRECOMPUTE),
-                        run=lambda v=variant: solve(v),
-                        coalesce_key=("bench", variant),
-                        label=f"bench-{ci}-{r}"))
+                    # each scheduled request is its own trace, so
+                    # trace_summary decomposes p99 into queue-wait vs
+                    # device time (the ROADMAP-5 tuning signal)
+                    from cruise_control_tpu.obs import trace as obs_trace
+                    with obs_trace.solve_trace("bench.request",
+                                               variant=variant):
+                        scheduler.submit(SolveJob(
+                            klass=(SchedulerClass.USER_INTERACTIVE
+                                   if interactive
+                                   else SchedulerClass.PRECOMPUTE),
+                            run=lambda v=variant: solve(v),
+                            coalesce_key=("bench", variant),
+                            label=f"bench-{ci}-{r}",
+                            trace=obs_trace.current_context()))
                 with lat_lock:
                     latencies.append(time.time() - t0)
 
@@ -1232,6 +1299,9 @@ def _sched_bench() -> None:
         policy = SchedulerPolicy.from_lists(
             queue_caps=[max(64, n * per_client)] * 4)
         sched = DeviceTimeScheduler(policy)
+        # attribute the LAST scheduled load (largest N by convention):
+        # drop the unscheduled baseline's / smaller Ns' traces
+        _reset_traces()
         t0 = time.time()
         sched_lat = run_load(n, sched)
         wall = time.time() - t0
@@ -1257,7 +1327,7 @@ def _sched_bench() -> None:
     n_max = str(max(clients))
     p99_sched = results[n_max]["sched_p99_s"]
     p99_unsched = results[n_max]["unsched_p99_s"]
-    print(json.dumps({
+    print(json.dumps(_with_trace_summary({
         "metric": (f"sched {n_max} concurrent mixed clients "
                    f"{state.num_brokers}b/{state.num_partitions/1000:g}Kp "
                    f"rf{rf} [{backend}]"),
@@ -1269,7 +1339,7 @@ def _sched_bench() -> None:
         "vs_baseline": (round(p99_unsched / p99_sched, 3)
                         if p99_sched else 0.0),
         "sched": results,
-    }))
+    })))
 
 
 if __name__ == "__main__":

@@ -38,6 +38,7 @@ from cruise_control_tpu.analyzer.proposals import (ExecutionProposal,
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.sanity import sanity_check
+from cruise_control_tpu.obs import trace as obs_trace
 from cruise_control_tpu.parallel import mesh as mesh_mod
 from cruise_control_tpu.parallel import progcache as progcache_mod
 from cruise_control_tpu.sched.runtime import segment_checkpoint
@@ -1116,6 +1117,13 @@ class GoalOptimizer:
             if prof is not None:
                 prof.record("instrument fetch", "transfer",
                             time.time() - t_host)
+            # always-on trace attribution of the solve's ONE sanctioned
+            # fetch: two host clock reads, NO additional device_gets
+            # (pinned in tests/test_obs.py) — the opt-in segment
+            # profiler stays the fine-grained instrument
+            obs_trace.record_span("device.instrument-fetch", t_host,
+                                  time.time(),
+                                  programs=len(stacked_parts) + 2)
             LOG.debug("goal pipeline (%d programs) ran in %.0fms",
                       len(stacked_parts) + 2, (time.time() - t0) * 1e3)
             if bool(invalid_inp):

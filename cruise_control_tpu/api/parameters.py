@@ -61,6 +61,11 @@ VALID_PARAMS: Dict[str, Set[str]] = {
     # the scenario list rides in the JSON request BODY (see
     # scenario/spec.py SCENARIOS_REQUEST_SCHEMA), not the query string
     "SCENARIOS": {"verbose", "json", "reason", "review_id"},
+    # flight-recorder queries (framework extension, obs/): the span
+    # trees of recent solves — `?trace_id=` fetches the tree a solve
+    # response's `traceId` named, `?outcome=degraded` the pinned
+    # incident traces (docs/OBSERVABILITY.md)
+    "TRACES": {"trace_id", "outcome", "limit", "verbose", "json"},
 }
 
 #: fleet tenancy (framework extension, fleet/): EVERY endpoint accepts

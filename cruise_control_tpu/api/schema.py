@@ -213,6 +213,40 @@ SCENARIOS = _obj({
     "version": _INT,
 }, required=["scenarios", "batch", "dryRun", "version"])
 
+#: one span node in a trace tree (recursive via $ref-free nesting: the
+#: validator in tests walks `children` with the same shape)
+_TRACE_SPAN = _obj({
+    "spanId": _INT,
+    "name": _STR,
+    "startMs": _NUM,
+    "durationMs": _NUM,
+    "tags": _obj({}, extra=True),
+    "events": _arr(_obj({}, extra=True)),
+    "children": _arr(_obj({}, extra=True)),
+}, required=["spanId", "name", "durationMs"])
+
+_TRACE = _obj({
+    "traceId": _STR,
+    "name": _STR,
+    "outcome": {"enum": ["ok", "failed", "degraded", "fallback",
+                         "preempted", "rejected"]},
+    "tags": _obj({}, extra=True),
+    "startMs": _NUM,
+    "durationMs": _NUM,
+    "numSpans": _INT,
+    "droppedSpans": _INT,
+    "root": _TRACE_SPAN,
+}, required=["traceId", "outcome", "durationMs"])
+
+TRACES = _obj({
+    "traces": _arr(_TRACE),
+    "recorder": _obj({
+        "capacity": _INT, "retained": _INT, "pinned": _INT,
+        "recorded": _INT, "pinnedTotal": _INT, "exportedPins": _INT,
+    }),
+    "version": _INT,
+}, required=["traces", "version"])
+
 MESSAGE = _obj({"message": _STR, "version": _INT},
                required=["message", "version"])
 
@@ -268,6 +302,7 @@ ENDPOINT_SCHEMAS: Dict[str, dict] = {
     "TOPIC_CONFIGURATION": OPTIMIZATION_RESULT,
     "SCENARIOS": SCENARIOS,
     "FLEET": FLEET,
+    "TRACES": TRACES,
 }
 
 #: non-200 body schemas by meaning

@@ -36,6 +36,9 @@ from cruise_control_tpu.analyzer.context import OptimizationOptions
 from cruise_control_tpu.core.anomaly import AnomalyType
 from cruise_control_tpu.executor.strategy import strategy_from_names
 from cruise_control_tpu.facade import CruiseControl, OngoingExecutionError
+from cruise_control_tpu.obs import export as obs_export
+from cruise_control_tpu.obs import recorder as obs_recorder
+from cruise_control_tpu.obs import trace as obs_trace
 from cruise_control_tpu.sched.queue import QueueFullError
 
 LOG = logging.getLogger(__name__)
@@ -50,7 +53,12 @@ BASE_PATH = "/kafkacruisecontrol"
 #: endpoints answered synchronously (no user task)
 SYNC_ENDPOINTS = {"STATE", "KAFKA_CLUSTER_STATE", "USER_TASKS",
                   "REVIEW_BOARD", "REVIEW", "STOP_PROPOSAL_EXECUTION",
-                  "PAUSE_SAMPLING", "RESUME_SAMPLING", "ADMIN", "FLEET"}
+                  "PAUSE_SAMPLING", "RESUME_SAMPLING", "ADMIN", "FLEET",
+                  "TRACES"}
+
+#: the Prometheus scrape path, served OUTSIDE the API prefix (scrapers
+#: conventionally hit bare /metrics); still behind authentication
+METRICS_PATH = "/metrics"
 
 
 class HttpError(Exception):
@@ -103,7 +111,8 @@ class CruiseControlApp:
                  ui_diskpath: str = "",
                  ui_urlprefix: str = "/ui",
                  time_fn: Optional[Callable[[], float]] = None,
-                 fleet=None) -> None:
+                 fleet=None,
+                 metrics_endpoint_enabled: bool = True) -> None:
         self.cc = cruise_control
         #: fleet registry (fleet/registry.FleetRegistry) when this
         #: process serves multiple clusters: `?cluster=<id>` selects the
@@ -146,6 +155,9 @@ class CruiseControlApp:
         #: mount point (reference webserver.api.urlprefix)
         self.base_path = (url_prefix.rstrip("/") if url_prefix
                           else BASE_PATH)
+        #: serve the OpenMetrics scrape page at /metrics
+        #: (obs.metrics.endpoint.enabled)
+        self._metrics_endpoint_enabled = metrics_endpoint_enabled
         self._http: Optional[ThreadingHTTPServer] = None
 
     # ------------------------------------------------------------------
@@ -164,6 +176,22 @@ class CruiseControlApp:
         # (trusted.proxy.services.ip.regex) — OVERWRITE unconditionally: a
         # client-supplied value must never reach the address filter
         headers["X-Remote-Addr"] = client
+        if (method == "GET" and path == METRICS_PATH
+                and self._metrics_endpoint_enabled):
+            # OpenMetrics scrape: every sensor registry as one page,
+            # fleet tenants labeled cluster="<id>" (obs/export.py).
+            # Authenticated like everything else — sensor names leak
+            # topology
+            try:
+                self.security.authenticate(headers)
+            except AuthenticationError as exc:
+                status, hdrs, err = self._error(401, exc)
+                return status, {**hdrs,
+                                **self.security.auth_challenge_headers()}, \
+                    err
+            text = obs_export.render_for(self.cc, fleet=self.fleet)
+            return 200, {}, {"__raw__": text.encode("utf-8"),
+                             "__content_type__": obs_export.CONTENT_TYPE}
         if (method == "GET" and self._ui_diskpath
                 and (path == self._ui_urlprefix
                      or path.startswith(self._ui_urlprefix + "/"))):
@@ -396,6 +424,7 @@ class CruiseControlApp:
                                           client, body=body)
             if parked is not None:
                 return parked
+        trace = None
         if task_id is not None:
             # attach-only: get_or_create never runs the operation when a
             # task id is given (and a body-less re-poll must not trip
@@ -406,22 +435,51 @@ class CruiseControlApp:
                   else self._operation_for(endpoint, params, body=body,
                                            cc=cc))
             op = self._re_arming(op, endpoint, params)
-        info = self.user_tasks.get_or_create(endpoint, query_string, client,
-                                             op, task_id=task_id,
-                                             body=body)
+            # mint the request's TraceContext HERE — the transport edge
+            # (obs/trace.py).  The operation runs on a USER_TASKS pool
+            # worker, so the context crosses the thread hop inside
+            # `finishing`; the trace finishes (and lands in the flight
+            # recorder) when the OPERATION does, not when this poll
+            # returns
+            trace = obs_trace.start_detached(
+                f"rest.{endpoint}", endpoint=endpoint, client=client,
+                **({"cluster": params.get("cluster")}
+                   if params.get("cluster") else {}))
+            op = obs_trace.finishing(trace, op)
+        info = self.user_tasks.get_or_create(
+            endpoint, query_string, client, op, task_id=task_id,
+            body=body,
+            trace_id=trace.trace_id if trace is not None else "")
+        # attach re-polls report the ORIGINAL operation's trace id
+        trace_id = info.trace_id
         hdrs = {USER_TASK_ID_HEADER: info.task_id,
                 # async session cookie scoped to the configured path
                 # (reference webserver.session.path; the reference tracks
                 # async requests per servlet session)
                 "Set-Cookie": (f"CCSESSION={info.task_id}; "
                                f"Path={self.session_path}")}
+        if trace_id:
+            hdrs["Trace-Id"] = trace_id
+
+        def with_trace(payload: dict) -> dict:
+            # COPY instead of mutating: the payload may be the task's
+            # cached result dict, shared with a concurrent poll of the
+            # same (coalesced) task that is mid-serialization on
+            # another handler thread
+            if trace_id and isinstance(payload, dict) \
+                    and "__raw__" not in payload \
+                    and "traceId" not in payload:
+                return {**payload, "traceId": trace_id}
+            return payload
+
         try:
-            body = info.future.result(timeout=self._async_timeout)
-            return 200, hdrs, body
+            result = info.future.result(timeout=self._async_timeout)
+            return 200, hdrs, with_trace(result)
         except FutureTimeout:
-            return 202, hdrs, {"progress": [{"operation": endpoint,
-                                             "status": "InProgress"}],
-                               "version": 1}
+            return 202, hdrs, with_trace(
+                {"progress": [{"operation": endpoint,
+                               "status": "InProgress"}],
+                 "version": 1})
         except Exception as exc:  # noqa: BLE001 - operation failed
             LOG.warning("async %s operation failed: %s: %s", endpoint,
                         type(exc).__name__, exc)
@@ -433,11 +491,13 @@ class CruiseControlApp:
                 # inside the task itself (_re_arming): the rejection may
                 # surface on ANY poll of the task — or on none, if the
                 # client gives up — so the rollback cannot live here
-                return self._rate_limited(exc, extra_headers=hdrs)
+                status, rl_hdrs, rl_body = self._rate_limited(
+                    exc, extra_headers=hdrs)
+                return status, rl_hdrs, with_trace(rl_body)
             status = 409 if isinstance(exc, OngoingExecutionError) else 500
-            return status, hdrs, {"errorMessage":
-                                  f"{type(exc).__name__}: {exc}",
-                                  "version": 1}
+            return status, hdrs, with_trace(
+                {"errorMessage": f"{type(exc).__name__}: {exc}",
+                 "version": 1})
 
     # ------------------------------------------------------------------
     # per-endpoint operations
@@ -645,6 +705,35 @@ class CruiseControlApp:
                          "(start with --fleet-config)")
             return {**self.fleet.fleet_json(
                 verbose=params.get_bool("verbose")), "version": 1}
+        if endpoint == "TRACES":
+            # flight-recorder query (obs/recorder.py): pinned incident
+            # traces a query RETURNS count as exported and drop their
+            # pin.  Under a fleet, `?cluster=` was already validated by
+            # tenant resolution above; it filters by the trace's
+            # cluster tag here.
+            cluster = params.get("cluster")
+            limit = params.get_int("limit")
+            # a query only counts as an EXPORT (dropping pins) when it
+            # delivers the span trees — a compact listing that stripped
+            # them would unpin incident traces without ever handing
+            # their evidence over
+            deliver_trees = (params.get("trace_id") is not None
+                             or params.get_bool("verbose"))
+            traces = obs_recorder.get_recorder().query(
+                trace_id=params.get("trace_id"), cluster=cluster,
+                outcome=params.get("outcome"),
+                limit=limit if limit is not None else 32,
+                export=deliver_trees)
+            out = {"traces": traces,
+                   "recorder": obs_recorder.get_recorder().to_json(),
+                   "version": 1}
+            if not deliver_trees:
+                # compact listing: ids / outcomes / durations only (the
+                # tree of ONE trace is what ?trace_id= fetches)
+                out["traces"] = [
+                    {k: v for k, v in t.items() if k != "root"}
+                    for t in traces]
+            return out
         if endpoint == "STATE":
             substates = params.get_csv("substates")
             out = cc.state(substates)

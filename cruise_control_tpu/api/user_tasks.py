@@ -86,6 +86,10 @@ class UserTaskInfo:
     #: (sched/queue.SolveTicket): surfaces WHY a task is waiting —
     #: class, queue position, estimated start
     sched_ticket: Optional[object] = None
+    #: flight-recorder trace id of the operation (obs/trace.py): the
+    #: same id the solve response body carries as `traceId`, so a
+    #: USER_TASKS listing links straight into TRACES
+    trace_id: str = ""
 
     def to_json(self) -> dict:
         out = {
@@ -96,6 +100,8 @@ class UserTaskInfo:
             "StartMs": self.start_ms,
             "Status": self.status.value,
         }
+        if self.trace_id:
+            out["TraceId"] = self.trace_id
         if self.body_hash:
             out["RequestBodySha"] = self.body_hash
         if self.result_bytes is not None:
@@ -159,14 +165,17 @@ class UserTaskManager:
     def get_or_create(self, endpoint: str, query: str, client_id: str,
                       operation: Callable[[], Any],
                       task_id: Optional[str] = None,
-                      body: Optional[str] = None) -> UserTaskInfo:
+                      body: Optional[str] = None,
+                      trace_id: str = "") -> UserTaskInfo:
         """Attach to an existing task (by explicit id or same
         client+URL+body) or start `operation` on the pool.
 
         `body` is the raw POST body (endpoints like SCENARIOS carry
         their payload there): its hash joins the implicit dedup key so
         two different bodies behind identical query strings never
-        coalesce into one task."""
+        coalesce into one task.  `trace_id` is the flight-recorder
+        trace of the operation (used only when a NEW task starts;
+        attaching re-polls report the original task's trace)."""
         now_ms = self._time() * 1000.0
         body_hash = body_fingerprint(body)
         key = (client_id, f"{endpoint}?{query}", body_hash)
@@ -233,7 +242,7 @@ class UserTaskManager:
             # attaches to it immediately)
             info = UserTaskInfo(new_id, endpoint, query, client_id, now_ms,
                                 future=self._pool.submit(run),
-                                body_hash=body_hash)
+                                body_hash=body_hash, trace_id=trace_id)
             self._tasks[new_id] = info
             self._by_request[key] = new_id
         return info

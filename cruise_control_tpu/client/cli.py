@@ -125,6 +125,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--approve", type=_csv_ints)
     p.add_argument("--discard", type=_csv_ints)
     p.add_argument("--reason", default="")
+
+    p = add("traces", help="flight-recorder trace query (obs/)")
+    p.add_argument("--trace-id",
+                   help="fetch the span tree a solve response's traceId "
+                        "named")
+    p.add_argument("--outcome",
+                   choices=["ok", "failed", "degraded", "fallback",
+                            "preempted", "rejected"],
+                   help="filter by outcome (degraded/failed traces are "
+                        "pinned until exported)")
+    p.add_argument("--limit", type=int)
+    p.add_argument("--verbose", action="store_true",
+                   help="include full span trees in listings")
+
+    add("metrics", help="raw OpenMetrics page (/metrics scrape)")
     return parser
 
 
@@ -219,6 +234,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             out = client.admin(**params)
         elif cmd == "review":
             out = client.review(args.approve, args.discard, args.reason)
+        elif cmd == "traces":
+            out = client.traces(trace_id=args.trace_id,
+                                outcome=args.outcome, limit=args.limit,
+                                verbose=args.verbose)
+        elif cmd == "metrics":
+            print(client.metrics_text(), end="")
+            return 0
         else:  # pragma: no cover
             raise SystemExit(f"unhandled command {cmd}")
     except CruiseControlClientError as exc:

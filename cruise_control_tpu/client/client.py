@@ -296,6 +296,35 @@ class CruiseControlClient:
     def review_board(self) -> dict:
         return self.request("REVIEW_BOARD")
 
+    def traces(self, trace_id: Optional[str] = None,
+               outcome: Optional[str] = None,
+               limit: Optional[int] = None,
+               verbose: bool = False) -> dict:
+        """Flight-recorder query (obs/): the span trees of recent
+        solves.  Fetch the tree a solve response's `traceId` named with
+        `trace_id=`, the pinned incident traces with
+        `outcome="degraded"`."""
+        return self.request("TRACES", {
+            "trace_id": trace_id, "outcome": outcome, "limit": limit,
+            "verbose": verbose or None})
+
+    def metrics_text(self) -> str:
+        """The raw OpenMetrics page (`/metrics`) — what a Prometheus
+        scrape sees.  Served OUTSIDE the API prefix."""
+        # /metrics lives one level above the API prefix: strip ONLY the
+        # last path segment so a path-mounting reverse proxy
+        # ("https://proxy/cc/kafkacruisecontrol" -> ".../cc/metrics")
+        # keeps routing to the same backend
+        parsed = urllib.parse.urlsplit(self._base)
+        parent = parsed.path.rstrip("/").rsplit("/", 1)[0]
+        root = urllib.parse.urlunsplit(
+            (parsed.scheme, parsed.netloc, parent, "", ""))
+        req = urllib.request.Request(f"{root}/metrics", method="GET")
+        if self._auth:
+            req.add_header("Authorization", self._auth)
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.read().decode("utf-8")
+
     def scenarios(self, scenarios: Sequence[dict],
                   goals: Optional[Sequence[str]] = None,
                   include_base: bool = True,

@@ -17,6 +17,7 @@ from cruise_control_tpu.config import main_config as M
 GROUPS = [
     ("Monitor", M.monitor_config_def),
     ("Analyzer", M.analyzer_config_def),
+    ("Observability", M.obs_config_def),
     ("Executor", M.executor_config_def),
     ("Anomaly detector", M.anomaly_detector_config_def),
     ("Webserver", M.webserver_config_def),

@@ -438,6 +438,43 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
     return d
 
 
+def obs_config_def(d: ConfigDef) -> ConfigDef:
+    """observability (framework extension, cruise_control_tpu/obs/ +
+    docs/OBSERVABILITY.md): request-scoped tracing, the flight
+    recorder, and the OpenMetrics exporter"""
+    d.define("obs.tracing.enabled", Type.BOOLEAN, True, None, _M,
+             "Request-scoped solve tracing (obs/trace.py): a "
+             "TraceContext minted at the REST transport rides through "
+             "the scheduler, the degradation ladder, model "
+             "materialization and the device pipeline; every "
+             "solve-bearing response carries a `traceId` resolvable "
+             "via the TRACES endpoint.  Always-on by design (bounded "
+             "overhead: host clock reads only, zero device work); "
+             "disable only to rule tracing out during an incident.")
+    d.define("obs.flight.recorder.capacity", Type.INT, 256,
+             in_range(min_value=1), _L,
+             "Completed solve traces retained in the flight-recorder "
+             "ring (oldest evicted beyond it).")
+    d.define("obs.flight.recorder.max.pinned", Type.INT, 256,
+             in_range(min_value=0), _L,
+             "Failed/degraded/preempted/fallback traces PINNED past "
+             "ring eviction until a TRACES query exports them "
+             "(incident evidence survives healthy traffic); 0 disables "
+             "pinning.")
+    d.define("obs.trace.log.enabled", Type.BOOLEAN, False, None, _L,
+             "Emit one structured JSON log line per finished trace "
+             "through the `traceLogger` logger (route it to its own "
+             "file like the access log).")
+    d.define("obs.metrics.endpoint.enabled", Type.BOOLEAN, True, None,
+             _M,
+             "Serve the OpenMetrics scrape page at /metrics (outside "
+             "the API prefix, behind the same authentication): every "
+             "sensor registry, fleet tenants as cluster=\"<id>\" "
+             "labeled series, histogram families for queue-wait and "
+             "solve latency.")
+    return d
+
+
 def executor_config_def(d: ConfigDef) -> ConfigDef:
     """reference config/constants/ExecutorConfig.java (20 keys)"""
     d.define("num.concurrent.partition.movements.per.broker", Type.INT, 5,
@@ -769,6 +806,7 @@ def config_def() -> ConfigDef:
     d = ConfigDef()
     monitor_config_def(d)
     analyzer_config_def(d)
+    obs_config_def(d)
     executor_config_def(d)
     anomaly_detector_config_def(d)
     webserver_config_def(d)

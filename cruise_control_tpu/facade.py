@@ -56,6 +56,8 @@ from cruise_control_tpu.monitor.completeness import (
     ModelCompletenessRequirements)
 from cruise_control_tpu.monitor.load_monitor import LoadMonitor
 from cruise_control_tpu.monitor.sampling.sampler import MetricSampler
+from cruise_control_tpu.obs import recorder as obs_recorder
+from cruise_control_tpu.obs import trace as obs_trace
 from cruise_control_tpu.scenario.engine import (BASE_SCENARIO_NAME,
                                                 ScenarioBatchResult,
                                                 ScenarioEngine)
@@ -222,7 +224,11 @@ class CruiseControl:
                  progcache_fingerprint_override: Optional[str] = None,
                  incremental_enabled: bool = True,
                  incremental_max_deltas: int = 64,
-                 incremental_max_dirty_ratio: float = 0.5
+                 incremental_max_dirty_ratio: float = 0.5,
+                 obs_tracing_enabled: Optional[bool] = None,
+                 obs_trace_log_enabled: Optional[bool] = None,
+                 obs_flight_recorder_capacity: Optional[int] = None,
+                 obs_flight_recorder_max_pinned: Optional[int] = None
                  ) -> None:
         self._admin = admin
         self._time = time_fn or _time.time
@@ -283,6 +289,21 @@ class CruiseControl:
         # inert until a cache dir is configured; with it, warmup turns
         # into a cache-first hydrate and a process bounce reaches
         # FUSED/MESH with zero source-program compiles.
+        # observability (obs/): process-wide tracing + flight-recorder
+        # switches.  Same contract as the program cache below: only an
+        # EXPLICIT setting (build_cruise_control always passes the
+        # obs.* keys) reconfigures the process-wide state — direct
+        # facade construction (tests, embedding) leaves it as found.
+        if obs_tracing_enabled is not None \
+                or obs_trace_log_enabled is not None:
+            obs_trace.configure(enabled=obs_tracing_enabled,
+                                trace_log_enabled=obs_trace_log_enabled)
+        if obs_flight_recorder_capacity is not None \
+                or obs_flight_recorder_max_pinned is not None:
+            obs_recorder.configure(
+                capacity=obs_flight_recorder_capacity,
+                max_pinned=obs_flight_recorder_max_pinned)
+
         from cruise_control_tpu.parallel import progcache as _progcache
         if progcache_enabled is not None:
             _progcache.configure(
@@ -980,6 +1001,12 @@ class CruiseControl:
                 # can fix (a hard violation outside the dirty region):
                 # metered fallback, never an outage
                 self.metrics.meter("incremental-solve-fallbacks").mark()
+                # the trace is pinned in the flight recorder (outcome
+                # "fallback") — PR-9 shipped the counter, this answers
+                # WHICH request fell back and why
+                obs_trace.mark("fallback")
+                obs_trace.event("incremental.fallback",
+                                reason="dirty-region solve verdict")
                 self._model_store.record_fallback(
                     "dirty-region solve verdict; full sweep retry")
                 LOG.info("dirty-region solve failed its verdict; "
@@ -1098,13 +1125,22 @@ class CruiseControl:
         cap — the REST layer turns that into 429 + Retry-After).  EVERY
         device solve the facade performs goes through here: the
         single-gateway invariant the lint rule and the chaos stress test
-        pin."""
-        return self.solve_scheduler.submit(SolveJob(
-            klass=klass, run=run, label=label,
-            coalesce_key=coalesce_key,
-            preemptible=self.solve_scheduler.policy.is_preemptible(klass),
-            fold_key=fold_key, fold_payload=fold_payload,
-            fold_run=fold_run))
+        pin.
+
+        Tracing: a REST-minted TraceContext rides through (the solve's
+        spans land in the request's tree); request-less solves (the
+        precompute loop, detector heals) mint-and-finish their own
+        trace here, so EVERY solve is a flight-recorder entry."""
+        with obs_trace.solve_trace(f"solve.{label or 'solve'}",
+                                   cluster=self._coalesce_scope,
+                                   schedulerClass=klass.name):
+            return self.solve_scheduler.submit(SolveJob(
+                klass=klass, run=run, label=label,
+                coalesce_key=coalesce_key,
+                preemptible=self.solve_scheduler.policy.is_preemptible(
+                    klass),
+                fold_key=fold_key, fold_payload=fold_payload,
+                fold_run=fold_run, trace=obs_trace.current_context()))
 
     # ------------------------------------------------------------------
     # solver degradation ladder (analyzer/degradation.py)
@@ -1123,48 +1159,59 @@ class CruiseControl:
         if allow_capacity_estimation is None:
             allow_capacity_estimation = self._allow_capacity_estimation
         store = self._model_store
-        if not self._incremental_enabled:
-            return self.cluster_model(
-                allow_capacity_estimation=allow_capacity_estimation)
-        generation = self.load_monitor.model_generation()
-        hit = store.get(generation, allow_capacity_estimation)
-        if hit is not None:
-            return hit
-        store_gen = store.generation
-        if store_gen is None:
-            store.count_miss()
-        elif store.capacity_flag != bool(allow_capacity_estimation):
-            # the resident model was built with the OTHER capacity-
-            # estimation flag: a delta fast-forward would preserve it,
-            # silently serving estimated capacities to a request that
-            # declined them — rebuild instead
-            store.record_fallback("capacity-estimation-flag")
-        else:
-            chain = self.load_monitor.deltas_between(store_gen,
-                                                     generation)
-            if chain and len(chain) <= self._incremental_max_deltas:
-                adv = store.advance(chain, generation)
-                if adv is not None:
-                    return adv
-            elif chain:
-                store.record_fallback(
-                    f"delta-chain too long ({len(chain)} > "
-                    f"{self._incremental_max_deltas})")
+        with obs_trace.span("model.materialize") as sp:
+            if not self._incremental_enabled:
+                if sp is not None:
+                    sp.set_tag("outcome", "rebuild")
+                    sp.set_tag("store", "disabled")
+                return self.cluster_model(
+                    allow_capacity_estimation=allow_capacity_estimation)
+            generation = self.load_monitor.model_generation()
+            hit = store.get(generation, allow_capacity_estimation)
+            if hit is not None:
+                if sp is not None:
+                    sp.set_tag("outcome", "hit")
+                return hit
+            store_gen = store.generation
+            if store_gen is None:
+                store.count_miss()
+            elif store.capacity_flag != bool(allow_capacity_estimation):
+                # the resident model was built with the OTHER capacity-
+                # estimation flag: a delta fast-forward would preserve
+                # it, silently serving estimated capacities to a request
+                # that declined them — rebuild instead
+                store.record_fallback("capacity-estimation-flag")
             else:
-                # None = no contiguous chain; [] cannot happen here
-                # (same generation + same flag is a get() hit)
-                store.record_fallback("generation-gap")
-        # install only when the generation did not move underneath the
-        # build (samples landing mid-build would make the resident
-        # model newer than its claimed generation and a later delta
-        # fast-forward could double-apply a change)
-        state, topo = self.cluster_model(
-            allow_capacity_estimation=allow_capacity_estimation)
-        if self.load_monitor.model_generation() == generation:
-            store.install(generation, state, topo,
-                          allow_capacity_estimation,
-                          self.load_monitor.follower_cpu_estimator())
-        return state, topo
+                chain = self.load_monitor.deltas_between(store_gen,
+                                                         generation)
+                if chain and len(chain) <= self._incremental_max_deltas:
+                    adv = store.advance(chain, generation)
+                    if adv is not None:
+                        if sp is not None:
+                            sp.set_tag("outcome", "fast-forward")
+                            sp.set_tag("deltas", len(chain))
+                        return adv
+                elif chain:
+                    store.record_fallback(
+                        f"delta-chain too long ({len(chain)} > "
+                        f"{self._incremental_max_deltas})")
+                else:
+                    # None = no contiguous chain; [] cannot happen here
+                    # (same generation + same flag is a get() hit)
+                    store.record_fallback("generation-gap")
+            # install only when the generation did not move underneath
+            # the build (samples landing mid-build would make the
+            # resident model newer than its claimed generation and a
+            # later delta fast-forward could double-apply a change)
+            if sp is not None:
+                sp.set_tag("outcome", "rebuild")
+            state, topo = self.cluster_model(
+                allow_capacity_estimation=allow_capacity_estimation)
+            if self.load_monitor.model_generation() == generation:
+                store.install(generation, state, topo,
+                              allow_capacity_estimation,
+                              self.load_monitor.follower_cpu_estimator())
+            return state, topo
 
     def _materialize_solve_inputs(self, cacheable: bool,
                                   allow_capacity_estimation,
@@ -1279,29 +1326,37 @@ class CruiseControl:
                 # fused path inside optimizations (mesh=None).
                 token = (sched_runtime.current_mesh_token()
                          or self._mesh_token)
-                return optimizer.optimizations(
-                    state, topo, gen_options, warm_start=warm,
-                    eager_hard_abort=eager_hard_abort,
-                    mesh=token.mesh, dirty_brokers=dirty)
+                with obs_trace.span("device.solve", rung=rung.name,
+                                    meshDevices=token.size,
+                                    dirtyRegion=dirty is not None):
+                    return optimizer.optimizations(
+                        state, topo, gen_options, warm_start=warm,
+                        eager_hard_abort=eager_hard_abort,
+                        mesh=token.mesh, dirty_brokers=dirty)
             if rung is SolverRung.FUSED:
-                return optimizer.optimizations(
-                    state, topo, gen_options, warm_start=warm,
-                    eager_hard_abort=eager_hard_abort,
-                    dirty_brokers=dirty)
+                with obs_trace.span("device.solve", rung=rung.name,
+                                    dirtyRegion=dirty is not None):
+                    return optimizer.optimizations(
+                        state, topo, gen_options, warm_start=warm,
+                        eager_hard_abort=eager_hard_abort,
+                        dirty_brokers=dirty)
             if rung is SolverRung.EAGER:
                 # one goal per program + eager hard-abort sync: smaller
                 # programs survive segment-level compile failures and
                 # localize device faults (degradation.SolverRung.EAGER)
-                return optimizer.optimizations(
-                    state, topo, gen_options, warm_start=warm,
-                    eager_hard_abort=True, eager_driver=True)
+                with obs_trace.span("device.solve", rung=rung.name):
+                    return optimizer.optimizations(
+                        state, topo, gen_options, warm_start=warm,
+                        eager_hard_abort=True, eager_driver=True)
             # bottom rung: numpy-only self-healing repair, zero XLA
             # dispatch (balance goals stand down; broker-level exclusions
             # from the request options still hold — host_fallback_solve)
             from cruise_control_tpu.model.cpu_model import \
                 host_fallback_solve
-            return host_fallback_solve(state, topo, options=gen_options,
-                                       time_fn=self._time)
+            with obs_trace.span("device.solve", rung=rung.name):
+                return host_fallback_solve(state, topo,
+                                           options=gen_options,
+                                           time_fn=self._time)
 
     def _solve_with_ladder(self, optimizer: GoalOptimizer, cacheable: bool,
                            options, allow_capacity_estimation,
@@ -1319,11 +1374,15 @@ class CruiseControl:
         (scheduler control flow — the dispatch loop re-queues the job)
         all propagate immediately."""
         if not self._solver_degradation_enabled:
-            result = self._solve_on_rung(self._solver_top_rung, optimizer,
-                                         cacheable, options,
-                                         allow_capacity_estimation,
-                                         eager_hard_abort,
-                                         incremental=incremental)
+            with obs_trace.span("solve.rung-attempt",
+                                rung=self._solver_top_rung.name,
+                                retry=0):
+                result = self._solve_on_rung(self._solver_top_rung,
+                                             optimizer,
+                                             cacheable, options,
+                                             allow_capacity_estimation,
+                                             eager_hard_abort,
+                                             incremental=incremental)
             self._note_goal_self_regressions(result)
             return result
         rung = self.solver_ladder.entry_rung()
@@ -1331,11 +1390,13 @@ class CruiseControl:
         attempts_on_rung = 0
         while True:
             try:
-                result = self._solve_on_rung(rung, optimizer, cacheable,
-                                             options,
-                                             allow_capacity_estimation,
-                                             eager_hard_abort,
-                                             incremental=incremental)
+                with obs_trace.span("solve.rung-attempt",
+                                    rung=rung.name,
+                                    retry=attempts_on_rung):
+                    result = self._solve_on_rung(
+                        rung, optimizer, cacheable, options,
+                        allow_capacity_estimation, eager_hard_abort,
+                        incremental=incremental)
             except (OptimizationFailure, InvalidModelInputError,
                     SolvePreempted) as exc:
                 if isinstance(exc, InvalidModelInputError):
@@ -1343,6 +1404,12 @@ class CruiseControl:
                 raise
             except Exception as exc:  # noqa: BLE001 - ladder classifies
                 kind = classify_failure(exc)
+                # the attempt span (closed above, error-tagged) gets the
+                # classified kind as an event so a trace reads
+                # rung/failure-kind/retry without log correlation
+                obs_trace.event("solve.failure", rung=rung.name,
+                                kind=kind.value,
+                                retry=attempts_on_rung)
                 tripped = self.solver_ladder.on_failure(rung)
                 LOG.warning("solve failed at rung %s (%s): %s", rung.name,
                             kind.value, exc)
@@ -1373,6 +1440,9 @@ class CruiseControl:
                     self._model_store.invalidate(
                         f"ladder descent to {nxt.name}")
                 self.metrics.meter("solver-descents").mark()
+                obs_trace.mark("degraded")
+                obs_trace.event("solve.descend", from_rung=rung.name,
+                                to_rung=nxt.name, kind=kind.value)
                 if not tripped:
                     self._report_solver_degraded(rung, nxt, kind, exc,
                                                  False)
@@ -1381,6 +1451,9 @@ class CruiseControl:
                 continue
             self.solver_ladder.on_success(rung)
             if rung > self._solver_top_rung:
+                # served degraded: pin the trace even when the DESCENT
+                # happened in an earlier request (breaker-pinned rung)
+                obs_trace.mark("degraded")
                 LOG.info("solve served from degraded rung %s", rung.name)
             self._note_goal_self_regressions(result)
             return result
@@ -1416,6 +1489,20 @@ class CruiseControl:
         the configured notifier (webhook, self-healing) sees solver
         trouble exactly like cluster trouble."""
         from cruise_control_tpu.detector.anomalies import SolverDegraded
+        # incident self-capture: mark the trace degraded (pinning it in
+        # the flight recorder) and dump the recorder state as one
+        # structured log line — the evidence survives even if nobody
+        # queries TRACES before the ring turns over
+        obs_trace.mark("degraded")
+        active = obs_trace.current()
+        obs_recorder.get_recorder().dump(
+            reason=f"SolverDegraded {from_rung.name}->"
+                   f"{to_rung.name if to_rung is not None else 'none'} "
+                   f"({kind.value})",
+            # the triggering solve's trace is still IN FLIGHT (it
+            # reaches the ring only when the solve finishes) — dump its
+            # partial tree so the incident line carries its evidence
+            active=active.to_json() if active is not None else None)
         try:
             self.anomaly_detector.report(SolverDegraded(
                 from_rung=from_rung.name,

@@ -351,6 +351,13 @@ def build_cruise_control(config: CruiseControlConfig, admin,
         incremental_max_deltas=config.get_int("incremental.max.deltas"),
         incremental_max_dirty_ratio=config.get_double(
             "incremental.max.dirty.broker.ratio"),
+        obs_tracing_enabled=config.get_boolean("obs.tracing.enabled"),
+        obs_trace_log_enabled=config.get_boolean(
+            "obs.trace.log.enabled"),
+        obs_flight_recorder_capacity=config.get_int(
+            "obs.flight.recorder.capacity"),
+        obs_flight_recorder_max_pinned=config.get_int(
+            "obs.flight.recorder.max.pinned"),
         monitor_kwargs=dict(
             sample_store=sample_store,
             num_windows=config.get_int("num.partition.metrics.windows"),
@@ -719,7 +726,9 @@ def build_app(config: CruiseControlConfig,
         session_path=config.get("webserver.session.path") or "/",
         ui_diskpath=config.get("webserver.ui.diskpath") or "",
         ui_urlprefix=config.get("webserver.ui.urlprefix") or "/ui",
-        fleet=fleet)
+        fleet=fleet,
+        metrics_endpoint_enabled=config.get_boolean(
+            "obs.metrics.endpoint.enabled"))
 
 
 def main(argv=None) -> int:

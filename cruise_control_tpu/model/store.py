@@ -328,7 +328,11 @@ class DeviceModelStore:
     def record_fallback(self, reason: str) -> None:
         """Count a consult that had a resident model but could not use
         it (gap, over-long chain, flag mismatch, oversized dirty
-        region) — the operator's delta-storm / thrash signal."""
+        region) — the operator's delta-storm / thrash signal.  The
+        reason also lands on the active request's trace (obs/trace.py),
+        answering WHICH request fell back, not just how many did."""
+        from cruise_control_tpu.obs import trace as obs_trace
+        obs_trace.event("model-store.fallback", reason=reason)
         with self._lock:
             self._fallback(reason)
 

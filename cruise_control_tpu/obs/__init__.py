@@ -1,0 +1,17 @@
+"""Observability: request-scoped tracing, the flight recorder, and the
+OpenMetrics exporter (docs/OBSERVABILITY.md).
+
+* `obs.trace` — TraceContext minted at the REST transport (or by the
+  facade for request-less solves) and propagated through the USER_TASKS
+  pool and the device-time scheduler; spans for queue wait, ladder rung
+  attempts, model materialization and the device instrument fetch.
+* `obs.recorder` — fixed-size ring of completed traces with pinned
+  retention for failed/degraded/preempted/fallback ones; the TRACES
+  endpoint and `tools/trace_dump.py` read it; SolverDegraded anomalies
+  dump it.
+* `obs.export` — `/metrics` OpenMetrics page over every sensor
+  registry, `cluster.<id>.` tagging converted to labels.
+"""
+from cruise_control_tpu.obs import export, recorder, trace
+
+__all__ = ["export", "recorder", "trace"]

@@ -198,11 +198,13 @@ class ProgramCache:
         reported as misses — the caller falls back to compiling."""
         if not self.is_active(goal_sig):
             return None
+        from cruise_control_tpu.obs import trace as obs_trace
         base = self._entry_base(program, goal_sig, shape_sig)
         path = base + _BLOB_SUFFIX
         if not os.path.exists(path):
             with self._lock:
                 self.misses += 1
+            obs_trace.event("progcache.miss", program=program)
             return None
         try:
             from jax import export as jexport
@@ -220,6 +222,9 @@ class ProgramCache:
             return None
         with self._lock:
             self.hits += 1
+        # hit/miss/hydrate ride the active solve trace (no-op outside
+        # one): a cold-start trace shows WHICH programs compiled fresh
+        obs_trace.event("progcache.hit", program=program)
         self._bump_meta_hits(base)
         return exported
 

@@ -232,10 +232,18 @@ class ScenarioEngine:
         program."""
         for spec in specs:
             spec.validate(topology)
+        from cruise_control_tpu.obs import trace as obs_trace
         with self._eval_lock:
-            return self._evaluate_locked(base_state, topology, specs,
-                                         goals, options,
-                                         include_proposals)
+            with obs_trace.span("scenario.batch",
+                                scenarios=len(specs)) as sp:
+                result = self._evaluate_locked(base_state, topology,
+                                               specs, goals, options,
+                                               include_proposals)
+                if sp is not None:
+                    sp.set_tag("rung", getattr(result.rung, "name",
+                                               str(result.rung)))
+                    sp.set_tag("oomHalvings", result.oom_halvings)
+                return result
 
     def _evaluate_locked(self, base_state, topology, specs, goals,
                          options, include_proposals) -> ScenarioBatchResult:

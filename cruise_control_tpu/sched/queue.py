@@ -27,6 +27,11 @@ class QueueFullError(RuntimeError):
     is the backpressure hint (latency EWMA x queue depth) the REST layer
     forwards as the `Retry-After` header."""
 
+    #: obs/trace.py classification: backpressure, not failure — the
+    #: trace records outcome "rejected" (visible in the flight-recorder
+    #: ring, never pinned), matching the 429-not-500 REST semantics
+    trace_outcome = "rejected"
+
     def __init__(self, klass: SchedulerClass, depth: int, cap: int,
                  retry_after_s: float) -> None:
         super().__init__(
@@ -50,6 +55,10 @@ class SolveTicket:
         self.started_at: Optional[float] = None
         #: requests that attached to this solve beyond the first
         self.attach_count = 0
+        #: trace id of the job that created this ticket (obs/trace.py):
+        #: coalesced waiters link their own trace to the leader's solve
+        #: through it
+        self.trace_id: Optional[str] = None
         self._queue = queue
         self._done = threading.Event()
         self._result = None
@@ -156,6 +165,12 @@ class AdmissionQueue:
                 raise QueueFullError(job.klass, depth, cap,
                                      self._retry_after_locked(job.klass))
             ticket = SolveTicket(job.klass, self._time(), self)
+            trace_ctx = getattr(job, "trace", None)
+            if trace_ctx is not None:
+                # duck-typed (obs.trace.TraceContext): this module keeps
+                # zero obs dependencies, the id alone is what waiters
+                # link against
+                ticket.trace_id = getattr(trace_ctx, "trace_id", None)
             self._seq += 1
             entry = _Entry(job, ticket, self._seq)
             self._entries.append(entry)

@@ -37,6 +37,7 @@ import logging
 import threading
 from typing import Any, Callable, List, Optional
 
+from cruise_control_tpu.obs import trace as obs_trace
 from cruise_control_tpu.sched import runtime
 from cruise_control_tpu.sched.policy import SchedulerClass, SchedulerPolicy
 from cruise_control_tpu.sched.queue import (AdmissionQueue, QueueFullError,
@@ -82,6 +83,12 @@ class SolveJob:
     fold_key: Optional[tuple] = None
     fold_payload: Any = None
     fold_run: Optional[Callable[[List[Any]], List[Any]]] = None
+    #: obs.trace.TraceContext of the submitting request: the dispatch
+    #: thread activates it around the solve so queue-wait, dispatch,
+    #: fold and preemption land in the request's span tree.  Every
+    #: facade submission carries one (tools/lint.py trace rule); None =
+    #: untraced (tests, embedding code)
+    trace: Optional[object] = None
 
 
 class SchedulerStoppedError(RuntimeError):
@@ -166,12 +173,22 @@ class DeviceTimeScheduler:
         except QueueFullError:
             self.stats.record_rejected()
             self._mark("sched-rejected-requests")
+            obs_trace.event("sched.rejected", klass=job.klass.name,
+                            ctx=job.trace)
             raise
         if created:
             self._ensure_dispatcher()
         else:
             self.stats.record_coalesced()
             self._mark("sched-coalesced-requests")
+            # the waiter's own trace links the leader's solve: a
+            # coalesced request never runs its job, so this span is its
+            # whole device story
+            now = self._time()
+            obs_trace.record_span("sched.coalesced", now, now,
+                                  ctx=job.trace,
+                                  leaderTraceId=ticket.trace_id,
+                                  klass=job.klass.name)
         runtime.notify_submission(ticket)
         return ticket.wait(timeout)
 
@@ -207,7 +224,10 @@ class DeviceTimeScheduler:
         job = entries[0].job
         now = self._time()
         best = min(e.best_klass for e in entries)
-        for e in entries:
+        lead_trace = getattr(job, "trace", None)
+        lead_trace_id = (getattr(lead_trace, "trace_id", None)
+                         if lead_trace is not None else None)
+        for i, e in enumerate(entries):
             # wait sampled since the LAST (re)queue: a redispatch after
             # preemption logs only the incremental wait, not the full
             # original wait again
@@ -217,6 +237,21 @@ class DeviceTimeScheduler:
                 name = e.best_klass.name.lower().replace("_", "-")
                 self._metrics.update_timer(f"sched-wait-timer-{name}",
                                            now - e.last_queued_at)
+                self._metrics.update_histogram(
+                    f"sched-wait-hist-{name}", now - e.last_queued_at)
+            tc = getattr(e.job, "trace", None)
+            obs_trace.record_span("sched.queue-wait", e.last_queued_at,
+                                  now, ctx=tc,
+                                  klass=e.best_klass.name)
+            if i > 0:
+                # fold members: each folded tenant's trace records its
+                # LANE in the shared dispatch plus the leader it rode
+                obs_trace.record_span("sched.fold-member", now, now,
+                                      ctx=tc, lane=i,
+                                      leaderTraceId=lead_trace_id)
+        if len(entries) > 1:
+            obs_trace.event("sched.fold", ctx=lead_trace,
+                            members=len(entries))
         check = None
         if (job.preemptible and self.policy.preemption_enabled):
             # evaluate BOTH sides LIVE at each checkpoint: a more urgent
@@ -235,16 +270,21 @@ class DeviceTimeScheduler:
         try:
             faults.inject("sched.dispatch")
             with runtime.mesh_token_scope(self.mesh_token), \
-                    runtime.gateway(check):
-                if len(entries) > 1:
-                    results = job.fold_run(
-                        [e.job.fold_payload for e in entries])
-                    if len(results) != len(entries):
-                        raise RuntimeError(
-                            f"fold_run returned {len(results)} results "
-                            f"for {len(entries)} folded jobs")
-                else:
-                    results = [job.run()]
+                    runtime.gateway(check), \
+                    obs_trace.activate(lead_trace):
+                with obs_trace.span("sched.dispatch", klass=best.name,
+                                    label=job.label,
+                                    folded=len(entries)):
+                    if len(entries) > 1:
+                        results = job.fold_run(
+                            [e.job.fold_payload for e in entries])
+                        if len(results) != len(entries):
+                            raise RuntimeError(
+                                f"fold_run returned {len(results)} "
+                                f"results for {len(entries)} folded "
+                                f"jobs")
+                    else:
+                        results = [job.run()]
         except runtime.SolvePreempted:
             # the yielded segments really ran on the device: count them
             # busy (occupancy must not read idle under preemption
@@ -252,6 +292,13 @@ class DeviceTimeScheduler:
             self.stats.record_preempted(len(entries),
                                         busy_s=self._time() - t0)
             self._mark("sched-preemptions", len(entries))
+            for e in entries:
+                tc = getattr(e.job, "trace", None)
+                if tc is not None:
+                    tc.trace.mark("preempted")
+                obs_trace.record_span("sched.preempted", t0,
+                                      self._time(), ctx=tc,
+                                      klass=e.best_klass.name)
             LOG.info("preempted %s job %r at a segment boundary "
                      "(%d queued above it); re-queued",
                      best.name, job.label, self.queue.depth())
@@ -279,6 +326,10 @@ class DeviceTimeScheduler:
         self._mark("sched-dispatches")
         if self._metrics is not None:
             self._metrics.update_timer("sched-solve-timer", duration)
+            self._metrics.update_histogram("sched-solve-hist", duration)
+            busy = best.name.lower().replace("_", "-")
+            self._metrics.update_histogram(
+                f"sched-device-busy-hist-{busy}", duration)
         if len(entries) > 1:
             self.stats.record_folded(len(entries) - 1)
             self._mark("sched-folded-sweeps", len(entries) - 1)

@@ -12,11 +12,53 @@ from __future__ import annotations
 
 import logging
 import math
+import re
 import threading
 import time as _time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 LOG = logging.getLogger(__name__)
+
+#: prefix of every exported OpenMetrics family
+OPENMETRICS_PREFIX = "cc_tpu_"
+
+_INVALID_METRIC_CHARS = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def canonical_sensor_name(name: str) -> str:
+    """THE canonical mapping from an internal sensor name (dashed,
+    dotted, mixed-case — `proposal-computation-timer`,
+    `REBALANCE-request-rate`) to its OpenMetrics family name
+    (`cc_tpu_proposal_computation_timer`).  Dots and dashes would export
+    as invalid (or silently colliding) Prometheus names; this mapping is
+    applied ONCE, here, and checked for collisions at registry-register
+    time — export and scrape docs always agree with it."""
+    out = _INVALID_METRIC_CHARS.sub("_", name.strip()).lower()
+    out = out.strip("_") or "sensor"
+    if out[0].isdigit():
+        out = "_" + out
+    return OPENMETRICS_PREFIX + out
+
+
+def openmetrics_sensor(name: str) -> Tuple[str, Dict[str, str]]:
+    """(canonical family name, labels) for an EXPORT-side sensor key.
+    The fleet registry tags tenant sensors `cluster.<id>.<sensor>`
+    (fleet/registry.sensors_json); that prefix becomes a proper
+    `cluster` label so one scrape sees every tenant as labeled series of
+    one family instead of N differently-named metrics."""
+    labels: Dict[str, str] = {}
+    if name.startswith("cluster."):
+        # split on the LAST dot: registry sensor names are dashed and
+        # never dotted (the register-time canonical check would flag a
+        # dotted twin), while fleet tenant ids MAY contain dots
+        # ("kafka.prod.eu") — a first-dot split would truncate the
+        # cluster label and corrupt the family name
+        rest = name[len("cluster."):]
+        cluster_id, _, bare = rest.rpartition(".")
+        if cluster_id and bare:
+            labels["cluster"] = cluster_id
+            name = bare
+    return canonical_sensor_name(name), labels
 
 
 class Counter:
@@ -123,6 +165,50 @@ class _TimerContext:
         self._timer.update(self._timer._time() - self._t0)
 
 
+class Histogram:
+    """Fixed-bucket latency histogram (seconds).  Cumulative bucket
+    counts in `to_json` so the OpenMetrics exporter (obs/export.py) can
+    render a real `_bucket{le=...}` family; the STATE endpoint shows the
+    same JSON.  Buckets are fixed at construction — scrapes must never
+    see a histogram whose bucket boundaries move."""
+
+    #: default boundaries (seconds) spanning sub-ms queue waits to
+    #: multi-minute cold solves
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                       1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+    def __init__(self, buckets: Optional[Tuple[float, ...]] = None) -> None:
+        bounds = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
+        if not bounds or any(b <= 0 for b in bounds):
+            raise ValueError("histogram buckets must be positive")
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)     # +Inf tail
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value_s: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._sum += value_s
+            for i, bound in enumerate(self._bounds):
+                if value_s <= bound:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def to_json(self) -> dict:
+        with self._lock:
+            cumulative = {}
+            running = 0
+            for bound, n in zip(self._bounds, self._counts):
+                running += n
+                cumulative[repr(float(bound))] = running
+            cumulative["+Inf"] = running + self._counts[-1]
+            return {"type": "histogram", "count": self._count,
+                    "sum": self._sum, "buckets": cumulative}
+
+
 class Gauge:
     def __init__(self, fn: Callable[[], float],
                  on_error: Optional[Callable] = None,
@@ -151,9 +237,26 @@ class MetricRegistry:
         self._time = time_fn
         self._lock = threading.Lock()
         self._sensors: Dict[str, object] = {}
+        #: canonical OpenMetrics family -> the raw sensor name that
+        #: claimed it (collision check at register time: `a-b` and `a.b`
+        #: would silently merge on the /metrics page otherwise)
+        self._canonical: Dict[str, str] = {}
         #: gauge names whose export failure was already logged (log once
         #: per gauge — a broken gauge fires on EVERY export)
         self._gauge_errors_logged: set = set()
+
+    def _check_canonical_locked(self, name: str) -> None:
+        """Caller holds the lock with `name` not yet registered: reject
+        a sensor whose canonical export name collides with a DIFFERENT
+        already-registered sensor."""
+        canonical = canonical_sensor_name(name)
+        claimed = self._canonical.get(canonical)
+        if claimed is not None and claimed != name:
+            raise ValueError(
+                f"sensor {name!r} collides with {claimed!r}: both "
+                f"export as OpenMetrics family {canonical!r} — rename "
+                f"one (utils/metrics.canonical_sensor_name)")
+        self._canonical[canonical] = name
 
     def counter(self, name: str) -> Counter:
         return self._get(name, Counter)
@@ -171,10 +274,23 @@ class MetricRegistry:
         utils/profiling.SegmentProfiler.publish)."""
         self.timer(name).update(duration_s)
 
+    def histogram(self, name: str,
+                  buckets: Optional[Tuple[float, ...]] = None
+                  ) -> Histogram:
+        return self._get(name, lambda: Histogram(buckets))
+
+    def update_histogram(self, name: str, value_s: float) -> None:
+        """Record one observation (seconds) into the named histogram —
+        e.g. the scheduler's per-class queue-wait and solve-duration
+        histograms exported through /metrics."""
+        self.histogram(name).observe(value_s)
+
     def gauge(self, name: str, fn: Callable[[], float]) -> Gauge:
         with self._lock:
             g = self._sensors.get(name)
             if not isinstance(g, Gauge):
+                if name not in self._sensors:
+                    self._check_canonical_locked(name)
                 g = Gauge(fn, on_error=self._on_gauge_error, name=name)
                 self._sensors[name] = g
             return g
@@ -198,6 +314,7 @@ class MetricRegistry:
         with self._lock:
             s = self._sensors.get(name)
             if s is None:
+                self._check_canonical_locked(name)
                 s = factory()
                 self._sensors[name] = s
             return s

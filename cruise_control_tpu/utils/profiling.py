@@ -37,6 +37,7 @@ import dataclasses
 import logging
 import os
 import threading
+import time as _time
 from typing import Dict, List, Optional
 
 LOG = logging.getLogger(__name__)
@@ -103,6 +104,14 @@ class SegmentProfiler:
                 self._cum_totals.get(category, 0.0) + seconds)
             if len(self.records) > self.MAX_RECORDS:
                 del self.records[:len(self.records) // 2]
+        # attach the segment to the active solve span (obs/trace.py):
+        # host spans and device segment attribution land in ONE tree.
+        # Lazy import — utils/ stays importable before obs is; a no-op
+        # outside a trace (and profiling itself stays opt-in)
+        from cruise_control_tpu.obs import trace as _obs_trace
+        now = _time.time()
+        _obs_trace.record_span(f"segment:{name}", now - seconds, now,
+                               category=category, **meta)
         LOG.info("segment %-42s %-10s %8.0fms%s", name, category,
                  seconds * 1e3,
                  "".join(f" {k}={v}" for k, v in meta.items()))

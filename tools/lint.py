@@ -44,7 +44,17 @@ Checks:
     invariant).  Module-scope assignments of list/dict/set displays,
     comprehensions, or mutable-container constructor calls are
     findings; immutable constants (tuples, frozensets, strings,
-    numbers) are fine.
+    numbers) are fine;
+  * trace-propagation rule (the observability invariant): every
+    `SolveJob(...)` construction in the package must carry `trace=`
+    (scheduler submissions carry a TraceContext so queue wait, folds
+    and preemptions land in the request's span tree), every ladder
+    attempt (`_solve_on_rung(...)` call) must sit inside a `with`
+    whose context expression opens a span, and
+    Span/SpanRecord/Trace/TraceContext objects may be constructed only
+    inside cruise_control_tpu/obs/ — everyone else goes through the
+    obs.trace helpers, which are what keep parenting, span caps and
+    cross-thread activation coherent.
 
 Usage: python tools/lint.py [paths...]   (default: the package + tests)
 Exit code 1 when any finding is reported.
@@ -381,6 +391,72 @@ def _fleet_mutable_globals(path: Path, tree: ast.AST) -> list:
     return findings
 
 
+#: names whose CONSTRUCTION is reserved to cruise_control_tpu/obs/ —
+#: span/trace objects built anywhere else bypass the parenting, span-cap
+#: and cross-thread-activation logic of the obs.trace helpers
+_OBS_RESERVED_CONSTRUCTORS = {"Span", "SpanRecord", "Trace",
+                              "TraceContext", "_ActiveSpan"}
+
+
+def _span_scoped_calls(tree: ast.AST) -> set:
+    """id()s of every Call node lexically inside a `with` statement one
+    of whose context expressions opens a span (a call whose name
+    mentions 'span')."""
+    scoped = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        opens_span = any(
+            isinstance(sub, ast.Call)
+            and "span" in _call_name(sub.func).lower()
+            for item in node.items
+            for sub in ast.walk(item.context_expr))
+        if opens_span:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    scoped.add(id(sub))
+    return scoped
+
+
+def _trace_violations(path: Path, tree: ast.AST) -> list:
+    """Trace-propagation rule (see module docstring): SolveJob carries
+    trace=, ladder attempts run inside a span, span objects are built
+    only in obs/."""
+    parts = path.parts
+    if "cruise_control_tpu" not in parts:
+        return []
+    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
+    rel = "/".join(parts[pkg + 1:])
+    in_obs = rel.startswith("obs/")
+    findings = []
+    span_scoped = None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if name in _OBS_RESERVED_CONSTRUCTORS and not in_obs:
+            findings.append(
+                f"{path}:{node.lineno}: naked span/trace construction "
+                f"({name}) outside obs/ — go through the obs.trace "
+                f"helpers (trace-propagation rule)")
+        elif name == "SolveJob":
+            if not any(kw.arg == "trace" for kw in node.keywords):
+                findings.append(
+                    f"{path}:{node.lineno}: SolveJob(...) without "
+                    f"trace= — every scheduler submission must carry a "
+                    f"TraceContext (trace-propagation rule)")
+        elif name == "_solve_on_rung":
+            if span_scoped is None:
+                span_scoped = _span_scoped_calls(tree)
+            if id(node) not in span_scoped:
+                findings.append(
+                    f"{path}:{node.lineno}: ladder attempt "
+                    f"(_solve_on_rung) outside a span scope — wrap "
+                    f"rung attempts in obs.trace.span so every attempt "
+                    f"is attributable (trace-propagation rule)")
+    return findings
+
+
 def _imported_names(tree: ast.AST):
     """{local binding name: node} for every module-scope import."""
     out = {}
@@ -448,6 +524,7 @@ def lint_file(path: Path) -> list:
     findings.extend(_progcache_violations(path, tree))
     findings.extend(_model_store_violations(path, tree))
     findings.extend(_fleet_mutable_globals(path, tree))
+    findings.extend(_trace_violations(path, tree))
 
     # unused imports: __init__.py files are re-export surfaces; a module
     # __all__ also marks intentional re-exports; `annotations` is the

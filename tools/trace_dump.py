@@ -1,0 +1,212 @@
+"""Fetch and pretty-print solve traces from a running server's TRACES
+endpoint (the flight recorder, cruise_control_tpu/obs/).
+
+One-shot::
+
+    python tools/trace_dump.py --trace-id 5f1c9aa2b3d44e01
+    python tools/trace_dump.py --outcome degraded --limit 8
+    python tools/trace_dump.py --cluster alpha
+
+Operator drill (tail mode)::
+
+    python tools/trace_dump.py --follow --interval 2
+
+--follow polls the recorder and prints every NEW trace as it completes
+(newest last, like `tail -f`), so an operator can watch a drill's
+requests decompose into queue-wait / rung attempts / materialization /
+device segments live.  Exit with Ctrl-C.
+
+The tree rendering shows per-span wall-clock, tags, and events::
+
+    trace 5f1c9aa2 rest.REBALANCE ok 1243.2ms cluster=alpha
+      +- solve.optimizations                1240.1ms
+         +- sched.queue-wait                  12.4ms klass=USER_INTERACTIVE
+         +- sched.dispatch                  1220.9ms
+            +- solve.rung-attempt           1219.8ms rung=FUSED
+               +- model.materialize            3.1ms outcome=hit
+               +- device.solve              1210.2ms
+                  +- device.instrument-fetch  88.0ms
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+
+def fetch_traces(base: str, trace_id: Optional[str] = None,
+                 cluster: Optional[str] = None,
+                 outcome: Optional[str] = None,
+                 limit: Optional[int] = None,
+                 verbose: bool = True,
+                 auth: Optional[str] = None) -> dict:
+    params = {"verbose": "true" if verbose else "false"}
+    if trace_id:
+        params["trace_id"] = trace_id
+    if cluster:
+        params["cluster"] = cluster
+    if outcome:
+        params["outcome"] = outcome
+    if limit is not None:
+        params["limit"] = str(limit)
+    url = f"{base.rstrip('/')}/traces?{urllib.parse.urlencode(params)}"
+    req = urllib.request.Request(url, method="GET")
+    if auth:
+        req.add_header("Authorization", auth)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _fmt_tags(tags: Dict[str, object]) -> str:
+    if not tags:
+        return ""
+    return " " + " ".join(f"{k}={v}" for k, v in sorted(tags.items())
+                          if k != "error")
+
+
+def render_span(node: dict, indent: int, out: List[str]) -> None:
+    pad = "  " * indent + "+- "
+    name = node.get("name", "?")
+    dur = node.get("durationMs", 0.0)
+    line = f"{pad}{name:<{max(1, 46 - len(pad))}} {dur:9.1f}ms"
+    line += _fmt_tags(node.get("tags", {}))
+    if node.get("tags", {}).get("error"):
+        line += f"  ERROR: {node['tags']['error']}"
+    out.append(line)
+    for ev in node.get("events", []):
+        ev_tags = {k: v for k, v in ev.items()
+                   if k not in ("name", "atS")}
+        out.append("  " * (indent + 1) + f"*  {ev.get('name')}"
+                   + _fmt_tags(ev_tags))
+    for child in node.get("children", []):
+        render_span(child, indent + 1, out)
+
+
+def render_trace(doc: dict) -> str:
+    out: List[str] = []
+    tags = doc.get("tags", {})
+    head = (f"trace {doc.get('traceId')} {doc.get('name', '?')} "
+            f"{doc.get('outcome')} {doc.get('durationMs', 0.0):.1f}ms")
+    head += _fmt_tags(tags)
+    if doc.get("droppedSpans"):
+        head += f"  (+{doc['droppedSpans']} spans dropped)"
+    out.append(head)
+    root = doc.get("root")
+    if root:
+        for child in root.get("children", []):
+            render_span(child, 1, out)
+        for ev in root.get("events", []):
+            ev_tags = {k: v for k, v in ev.items()
+                       if k not in ("name", "atS")}
+            out.append("  " + f"*  {ev.get('name')}" + _fmt_tags(ev_tags))
+    else:
+        out.append("  (span tree not included — re-fetch with "
+                   "?trace_id= or --verbose)")
+    return "\n".join(out)
+
+
+def follow(args) -> int:
+    """Tail mode: poll and print every NEW trace as it completes.
+
+    Polls are COMPACT (verbose=false) so they never export — only the
+    per-trace tree fetch of a trace we actually PRINT unpins it; the
+    startup history-skip in particular must not silently unpin (and
+    thereby doom to eviction) incident traces it never displayed."""
+    seen: set = set()
+    first = True
+    while True:
+        try:
+            body = fetch_traces(args.address, cluster=args.cluster,
+                                outcome=args.outcome,
+                                limit=args.limit or 64,
+                                verbose=False, auth=args.auth)
+        except (urllib.error.URLError, OSError) as exc:
+            print(f"# fetch failed: {exc}", file=sys.stderr)
+            time.sleep(args.interval)
+            continue
+        fresh = [t for t in reversed(body.get("traces", []))
+                 if t.get("traceId") not in seen]
+        for doc in fresh:
+            tid = doc.get("traceId")
+            seen.add(tid)
+            if first:
+                continue           # don't replay history on startup
+            try:
+                full = fetch_traces(args.address, trace_id=tid,
+                                    auth=args.auth).get("traces", [])
+            except (urllib.error.URLError, OSError) as exc:
+                print(f"# fetch of {tid} failed: {exc}",
+                      file=sys.stderr)
+                full = []
+            print(render_trace(full[0] if full else doc))
+            print()
+        if first:
+            print(f"# following {args.address}/traces "
+                  f"({len(seen)} existing traces skipped); Ctrl-C to "
+                  f"stop", file=sys.stderr)
+            first = False
+        time.sleep(args.interval)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="trace_dump",
+        description="fetch/pretty-print solve traces from the TRACES "
+                    "endpoint (flight recorder)")
+    parser.add_argument("-a", "--address",
+                        default="http://127.0.0.1:9090/kafkacruisecontrol",
+                        help="base URL of the REST API")
+    parser.add_argument("--auth", help="Authorization header value")
+    parser.add_argument("--trace-id", help="fetch ONE trace's full tree")
+    parser.add_argument("--cluster", help="fleet tenant filter")
+    parser.add_argument("--outcome",
+                        choices=["ok", "failed", "degraded", "fallback",
+                                 "preempted", "rejected"])
+    parser.add_argument("--limit", type=int)
+    parser.add_argument("--json", action="store_true",
+                        help="raw JSON instead of the rendered tree")
+    parser.add_argument("--follow", action="store_true",
+                        help="tail mode: print new traces as they "
+                             "complete")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="--follow poll interval seconds")
+    args = parser.parse_args(argv)
+
+    if args.follow:
+        try:
+            return follow(args)
+        except KeyboardInterrupt:
+            return 0
+    try:
+        body = fetch_traces(args.address, trace_id=args.trace_id,
+                            cluster=args.cluster, outcome=args.outcome,
+                            limit=args.limit, verbose=True,
+                            auth=args.auth)
+    except (urllib.error.URLError, OSError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(body, indent=2, sort_keys=True))
+        return 0
+    traces = body.get("traces", [])
+    if not traces:
+        print("no matching traces", file=sys.stderr)
+        return 1
+    for doc in traces:
+        print(render_trace(doc))
+        print()
+    rec = body.get("recorder", {})
+    if rec:
+        print(f"# recorder: {rec.get('retained', 0)} retained, "
+              f"{rec.get('pinned', 0)} pinned, "
+              f"{rec.get('recorded', 0)} recorded", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
