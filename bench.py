@@ -77,6 +77,22 @@ BENCH_CONFIG selects a BASELINE.json eval config:
                    "incremental" block; value = incremental p50
                    seconds, vs_baseline = full p50 / incremental p50)
 
+  meshchaos        elastic mesh recovery (parallel/health.py): a live
+                   facade stack on the 8-device mesh takes an injected
+                   collective HANG mid-solve; the watchdog must release
+                   the dispatch thread within mesh.watchdog.ms
+                   (BENCH_MESHCHAOS_WATCHDOG_MS, default 2000), the
+                   supervisor shrinks the span 8->4, the re-queued
+                   solve completes on the survivor span, and probe
+                   recovery climbs back to 8.  Records wedge ->
+                   first-good-solve latency and the watchdog release
+                   time.  EXITS 1 if the dispatch thread ever blocked
+                   past the deadline (2x grace), the solve failed, or
+                   the span did not recover (the output JSON carries a
+                   "meshchaos" block; value = wedge-to-first-good-solve
+                   seconds, vs_baseline = clean solve / recovery, the
+                   recovery tax)
+
   coldstart        persistent-program-cache cold start
                    (parallel/progcache.py): measures cold-process
                    time-to-first-proposal twice in FRESH subprocesses —
@@ -265,6 +281,8 @@ def main() -> None:
         return _mesh_bench()
     if config == "coldstart":
         return _coldstart_bench()
+    if config == "meshchaos":
+        return _meshchaos_bench()
     if config == "incremental":
         return _incremental_bench()
     presets = {  # (brokers, partitions, goal subset, metric label)
@@ -903,6 +921,140 @@ def _mesh_bench() -> None:
         "n_devices": top["n_devices"],
         "mesh": results,
     })))
+
+
+def _meshchaos_bench() -> None:
+    """BENCH_CONFIG=meshchaos: MEASURE elastic mesh recovery (see the
+    module docstring block).  A live facade stack on the 8-device mesh
+    takes an injected collective hang on its first warm mesh-8
+    dispatch; records the wedge -> first-good-solve latency and the
+    watchdog release time.  Gates (exit 1): the dispatch thread never
+    blocked past mesh.watchdog.ms x 2, the solve completed on the
+    shrunk span, and probe recovery climbed back to the full span."""
+    import threading
+    import jax
+
+    from cruise_control_tpu.parallel import health
+    if jax.default_backend() == "cpu" and len(jax.devices()) < 8:
+        sys.exit("meshchaos needs >= 8 devices; run under the virtual "
+                 "rig (XLA_FLAGS=--xla_force_host_platform_device_"
+                 "count=8) or on multi-chip hardware")
+
+    from cruise_control_tpu.cluster.simulated import SimulatedCluster
+    from cruise_control_tpu.cluster.types import TopicPartition
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.monitor.sampling.sampler import (
+        SimulatedClusterSampler)
+    from cruise_control_tpu.utils import faults
+
+    num_b = int(os.environ.get("BENCH_BROKERS", 8))
+    num_p = int(os.environ.get("BENCH_PARTITIONS", 64))
+    rf = int(os.environ.get("BENCH_RF", 2))
+    watchdog_ms = float(os.environ.get("BENCH_MESHCHAOS_WATCHDOG_MS",
+                                       2000))
+    goal_names = os.environ.get("BENCH_GOALS")
+    names = (goal_names.split(",") if goal_names
+             else ["RackAwareGoal", "DiskCapacityGoal",
+                   "ReplicaDistributionGoal"])
+    backend = jax.devices()[0].platform
+
+    sim = SimulatedCluster()
+    clock = {"now": 10_000.0}
+    for b in range(num_b):
+        sim.add_broker(b, rack=f"rack{b % 4}")
+    # everything parked on two brokers: the solve must MOVE replicas,
+    # so an empty-proposal result can never fake a recovery
+    assignments = [[i % 2 for i in range(rf)] for _ in range(num_p)]
+    sim.create_topic("t0", assignments, size_bytes=1e4)
+    for p in range(num_p):
+        sim.set_partition_load(TopicPartition("t0", p), leader_cpu=2.0,
+                               nw_in=100.0, nw_out=300.0)
+    cc = CruiseControl(
+        sim, SimulatedClusterSampler(sim),
+        time_fn=lambda: clock["now"],
+        sleep_fn=lambda s: (sim.advance(s),
+                            clock.__setitem__("now", clock["now"] + s)),
+        monitor_kwargs=dict(num_windows=3, window_ms=10_000,
+                            min_samples_per_window=1,
+                            sampling_interval_ms=5_000),
+        auto_warmup=True, goal_names=names,
+        mesh_enabled=True, mesh_watchdog_ms=watchdog_ms,
+        mesh_probe_interval_ms=1e12)
+    _reset_traces()
+    for _ in range(8):
+        cc.load_monitor.task_runner.sample_once()
+        clock["now"] += 10.0
+    print(f"# meshchaos: B={num_b} P={num_p} goals={names} watchdog="
+          f"{watchdog_ms:.0f}ms [{backend}]", file=sys.stderr)
+
+    # clean warm pass: AOT-warms the mesh-8 programs and baselines the
+    # solve latency the recovery tax is measured against
+    t0 = time.time()
+    clean = cc.optimizations()
+    clean_s = time.time() - t0
+    sup = cc.mesh_supervisor
+    full_span = sup.span
+
+    # wedge: the next mesh dispatch hangs until released (it never is —
+    # the watchdog must do the releasing)
+    release = threading.Event()
+    plan = faults.FaultPlan().hang_nth("mesh.dispatch", 1, release)
+    t0 = time.time()
+    with faults.injected(plan):
+        recovered = cc.optimizations(ignore_proposal_cache=True)
+    recovery_s = time.time() - t0
+    release.set()
+    release_ms = health.last_fire_wait_s() * 1000.0
+    blocked_past_deadline = release_ms > watchdog_ms * 2
+    shrunk_span = sup.span
+    shrunk_ok = (shrunk_span < full_span
+                 and recovered.mesh_devices == shrunk_span
+                 and len(recovered.proposals) > 0)
+
+    # probe recovery: chips are healthy, one cycle climbs back
+    sup.probe_interval_ms = 0.0
+    clock["now"] += 60.0
+    again = cc.optimizations(ignore_proposal_cache=True)
+    recovered_span = sup.span
+    health.clear_quarantine()
+    cc.shutdown()
+
+    ok = shrunk_ok and not blocked_past_deadline \
+        and recovered_span == full_span and again.mesh_devices == full_span
+    out = {
+        "metric": (f"meshchaos wedge->first-good-solve {num_b}b/"
+                   f"{num_p}p span {full_span}->{shrunk_span}->"
+                   f"{recovered_span} [{backend}]"),
+        "value": round(recovery_s, 3),
+        "unit": "s",
+        # the recovery tax relative to a clean solve (<1 always; how
+        # much of the wedge window the watchdog + requeue gave back)
+        "vs_baseline": (round(clean_s / recovery_s, 3)
+                        if recovery_s else 0.0),
+        "meshchaos": {
+            "clean_solve_s": round(clean_s, 3),
+            "recovery_s": round(recovery_s, 3),
+            "watchdog_ms": watchdog_ms,
+            "watchdog_release_ms": round(release_ms, 1),
+            "watchdog_fires": health.watchdog_fires(),
+            "dispatch_blocked_past_deadline": blocked_past_deadline,
+            "shrinks": sup.shrinks,
+            "recoveries": sup.recoveries,
+            "span_shrunk": shrunk_span,
+            "span_recovered": recovered_span,
+        },
+    }
+    print(json.dumps(_with_trace_summary(out)))
+    if not ok:
+        print("# ERROR: meshchaos gate failed — "
+              + ("dispatch thread blocked past the watchdog deadline; "
+                 if blocked_past_deadline else "")
+              + ("solve did not complete on a shrunk span; "
+                 if not shrunk_ok else "")
+              + (f"span did not recover (at {recovered_span}, want "
+                 f"{full_span})" if recovered_span != full_span else ""),
+              file=sys.stderr)
+        sys.exit(1)
 
 
 def _scenario_bench() -> None:

@@ -50,6 +50,12 @@ class FailureKind(enum.Enum):
     INVALID_INPUT = "INVALID_INPUT"   # NaN/Inf/negative model inputs
     COMPILE = "COMPILE"               # program build / XLA compilation
     RUNTIME = "RUNTIME"               # device execution / everything else
+    #: a watched dispatch overran its watchdog deadline
+    #: (parallel/health.DispatchWedgedError): the device never answered
+    #: at all.  At the MESH rung the mesh supervisor handles it (span
+    #: shrink + requeue); elsewhere it retries/descends like RUNTIME —
+    #: but it is its own kind so anomalies and traces name the wedge.
+    WEDGE = "WEDGE"
 
 
 class SolverRung(enum.IntEnum):
@@ -59,10 +65,17 @@ class SolverRung(enum.IntEnum):
     value 0 stable for the solver-rung sensor and every existing pin):
     the fused pipeline pjit'ed over the scheduler's whole device mesh.
     It only exists as a rung where a multi-chip mesh token is live —
-    single-chip ladders top out at FUSED exactly as before.  A
-    collective/ICI/runtime failure on the mesh descends MESH→FUSED
-    (same search, one chip) before the classic FUSED→EAGER→CPU ladder
-    engages."""
+    single-chip ladders top out at FUSED exactly as before.
+
+    PR 12 generalized MESH into SPAN-parameterized rungs: the ONE enum
+    value covers the whole MESH8→MESH4→MESH2 ladder, with the live
+    span owned by the mesh supervisor (parallel/health.MeshSupervisor)
+    — a wedge or collective failure shrinks the span one rung (the
+    token the MESH rung resolves simply gets smaller; span 1 is the
+    degenerate token, i.e. exactly FUSED) and probe recovery climbs it
+    back, mirroring this ladder's one-rung-per-solve probe discipline.
+    Only when the supervisor cannot shrink (recovery disabled, span
+    exhausted) does the classic MESH→FUSED descent below engage."""
 
     MESH = -1
     FUSED = 0
@@ -81,8 +94,11 @@ def classify_failure(exc: BaseException) -> FailureKind:
     classify by the site they were injected at, so chaos scenarios
     exercise the same policy branches real failures take."""
     from cruise_control_tpu.utils.faults import FaultError
+    from cruise_control_tpu.parallel.health import DispatchWedgedError
     if isinstance(exc, InvalidModelInputError):
         return FailureKind.INVALID_INPUT
+    if isinstance(exc, DispatchWedgedError):
+        return FailureKind.WEDGE
     if isinstance(exc, FaultError):
         return (FailureKind.COMPILE if ".compile" in exc.site
                 else FailureKind.RUNTIME)

@@ -39,6 +39,7 @@ from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model import state as S
 from cruise_control_tpu.model.sanity import sanity_check
 from cruise_control_tpu.obs import trace as obs_trace
+from cruise_control_tpu.parallel import health
 from cruise_control_tpu.parallel import mesh as mesh_mod
 from cruise_control_tpu.parallel import progcache as progcache_mod
 from cruise_control_tpu.sched.runtime import segment_checkpoint
@@ -1368,12 +1369,22 @@ class GoalOptimizer:
         shared AOT registry (another shape bucket of this goal list may
         have been hydrated from the persistent cache); fall back to jit
         when neither matches the argument shapes (an AOT executable is
-        pinned to the avals it was lowered for)."""
+        pinned to the avals it was lowered for).
+
+        Every AOT invocation goes through the watched-dispatch gateway
+        (parallel/health.watched_call — the watchdog-gateway lint rule):
+        with the watchdog armed, a wedged dispatch (stuck collective,
+        dead chip) abandons the watched worker thread within
+        mesh.watchdog.ms instead of capturing this thread forever.  The
+        jit fallback stays inline ON PURPOSE: it may be a cold COMPILE
+        (legitimately minutes at bench scale) and a compile is not a
+        wedge — the persistent program cache keeps that path rare."""
         faults.inject("optimizer.execute")
         aot = self._aot.get(key)
         if aot is not None:
             try:
-                return aot(*args)
+                return health.watched_call(lambda: aot(*args),
+                                           program=key)
             except (TypeError, ValueError) as exc:
                 LOG.debug("AOT %s rejected args (%s); falling back",
                           key, exc)
@@ -1385,7 +1396,8 @@ class GoalOptimizer:
                                      mesh_mod.tree_signature(args))
             if shared is not None:
                 try:
-                    return shared(*args)
+                    return health.watched_call(lambda: shared(*args),
+                                               program=key)
                 except (TypeError, ValueError) as exc:
                     LOG.debug("shared AOT %s rejected args (%s); "
                               "falling back to jit", key, exc)

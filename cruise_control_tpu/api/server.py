@@ -158,7 +158,27 @@ class CruiseControlApp:
         #: serve the OpenMetrics scrape page at /metrics
         #: (obs.metrics.endpoint.enabled)
         self._metrics_endpoint_enabled = metrics_endpoint_enabled
+        #: graceful-drain state (main.py SIGTERM handler): Retry-After
+        #: seconds while draining, None while serving normally.  Writes
+        #: answer 503 + Retry-After (clients back off exactly like on a
+        #: 429); reads keep working so operators can watch the drain.
+        self._draining: Optional[float] = None
         self._http: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------------
+    # graceful drain (SIGTERM path)
+    # ------------------------------------------------------------------
+    def drain(self, retry_after_s: float = 30.0) -> None:
+        """Stop admitting WRITES: every POST answers 503 + Retry-After
+        (the same backpressure contract as the scheduler's 429 — the
+        client honors the hint and retries against the replacement
+        process).  Reads stay up so STATE/TRACES remain queryable
+        while the in-flight solve finishes."""
+        self._draining = max(1.0, float(retry_after_s))
+
+    @property
+    def draining(self) -> bool:
+        return self._draining is not None
 
     # ------------------------------------------------------------------
     # transport-free dispatch
@@ -209,6 +229,20 @@ class CruiseControlApp:
             endpoint = self._endpoint_of(method, path)
             principal = self.security.authenticate(headers)
             self.security.authorize(principal, endpoint)
+            if self._draining is not None and (
+                    endpoint in POST_ENDPOINTS or endpoint == "REVIEW"):
+                # graceful drain: no new mutations once shutdown began
+                # — clients treat the 503 + Retry-After like a 429 and
+                # resubmit to the replacement process.  REVIEW is a
+                # write too (the authz layer's definition): approving a
+                # purgatory request mutates state the exit would lose
+                import math
+                retry_after = max(1, int(math.ceil(self._draining)))
+                return 503, {"Retry-After": str(retry_after)}, {
+                    "errorMessage": "ServerDraining: shutting down; "
+                                    "retry against the replacement "
+                                    "process",
+                    "retryAfterSeconds": retry_after, "version": 1}
             req_cls, par_cls = self._endpoint_classes.get(
                 endpoint, (None, QueryParams))
             params = par_cls(

@@ -118,21 +118,30 @@ class CruiseControlClient:
             task_id = headers.get(USER_TASK_ID_HEADER, task_id)
             if status == 200:
                 return body
-            if status == 429:
-                # scheduler backpressure (solve queue at its cap): honor
-                # Retry-After with capped exponential backoff +
-                # deterministic jitter, then resubmit.  The 429 carries
-                # the FAILED task's User-Task-ID for diagnostics — drop
-                # it, or the retry would attach to the dead task (and
-                # replay its cached rejection) instead of resubmitting
+            if status == 429 or (status == 503
+                                 and self._is_draining(headers, body)):
+                # backpressure, both flavors: 429 = scheduler queue at
+                # its cap, 503-draining = the process is shutting down
+                # gracefully (api/server drain: Retry-After names when
+                # the replacement should be up).  Same discipline for
+                # both — honor Retry-After with capped exponential
+                # backoff + deterministic jitter, then resubmit.  A
+                # plain 503 WITHOUT a retry hint (e.g. a draining fleet
+                # tenant mid-rebalance of tenants) still surfaces as an
+                # error below.  The response carries the FAILED task's
+                # User-Task-ID for diagnostics — drop it, or the retry
+                # would attach to the dead task (and replay its cached
+                # rejection) instead of resubmitting
                 task_id = None
                 delay = self._retry_delay_429(endpoint, retries_429,
                                               headers, body)
                 if (retries_429 >= self._max_retries_429
                         or time.time() + delay > deadline):
                     raise CruiseControlClientError(
-                        429, body.get("errorMessage",
-                                      "rejected: solve queue full")
+                        status, body.get(
+                            "errorMessage",
+                            "rejected: solve queue full" if status == 429
+                            else "server draining")
                         + f" (gave up after {retries_429} retries)")
                 retries_429 += 1
                 self._sleep(delay)
@@ -152,6 +161,21 @@ class CruiseControlClient:
                 return body
             raise CruiseControlClientError(
                 status, body.get("errorMessage", str(body)))
+
+    @staticmethod
+    def _is_draining(headers: Mapping[str, str], body: Mapping) -> bool:
+        """A 503 is RETRYABLE only when the server says when to come
+        back (Retry-After header or retryAfterSeconds in the body) —
+        the graceful-drain signature.  A bare 503 (misconfigured
+        proxy, tenant drained for good) stays a hard error: blind
+        retries against those just hammer a server that never asked
+        for patience."""
+        if any(k.lower() == "retry-after" for k in headers):
+            return True
+        try:
+            return float(body.get("retryAfterSeconds", 0.0)) > 0
+        except (TypeError, ValueError, AttributeError):
+            return False
 
     def _retry_delay_429(self, endpoint: str, attempt: int,
                          headers: Mapping[str, str], body: Mapping

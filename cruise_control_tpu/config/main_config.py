@@ -322,6 +322,53 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
              "(0 = use all).  Useful to reserve chips for other work or "
              "to A/B mesh scaling (BENCH_CONFIG=mesh automates the "
              "sweep).")
+    d.define("mesh.recovery.enabled", Type.BOOLEAN, True, None, _M,
+             "Elastic mesh recovery (parallel/health.py): on a wedged "
+             "dispatch or collective failure the mesh supervisor "
+             "condemns probed-dead chips, shrinks the span one rung "
+             "down the MESH8-MESH4-MESH2-FUSED ladder, hydrates the "
+             "survivor span's programs from the persistent program "
+             "cache, and re-queues the in-flight solve — no process "
+             "bounce.  Probe recovery climbs the span back one rung "
+             "per probe cycle when the chips return.  false is the "
+             "manual override (docs/OPERATIONS.md §5): failures fall "
+             "through to the classic MESH->FUSED ladder descent and "
+             "the watchdog is disarmed.")
+    d.define("mesh.watchdog.ms", Type.LONG, 120_000,
+             in_range(min_value=0), _M,
+             "Watched-dispatch deadline: device execution runs on a "
+             "watched worker thread, and a dispatch that has not "
+             "answered within this many ms is declared WEDGED — the "
+             "worker is abandoned (Python cannot abort an XLA "
+             "dispatch), its executable quarantined, and the "
+             "scheduler's dispatch thread released to shrink the span "
+             "and re-queue the solve.  Must comfortably exceed the "
+             "slowest legitimate solve SEGMENT on your hardware "
+             "(compiles do not count — they run unwatched through the "
+             "program-cache gateway).  0 disarms the watchdog.")
+    d.define("mesh.probe.interval.ms", Type.LONG, 15_000,
+             in_range(min_value=0), _L,
+             "Minimum interval between per-chip health probes (the "
+             "tiny known-answer program parallel/health.probe_devices "
+             "runs per device).  While the span is shrunk or chips are "
+             "condemned, each mesh solve older than this re-probes and "
+             "climbs the span back ONE rung when the chips answer "
+             "again — the same one-rung-per-probe discipline as the "
+             "solver ladder.")
+    d.define("mesh.min.devices", Type.INT, 1, in_range(min_value=1), _L,
+             "Smallest mesh span worth its collectives: ladder rungs "
+             "below this device count are skipped and the span ladder "
+             "drops straight to the degenerate single-chip token "
+             "(FUSED).  1 keeps every halving rung.")
+    d.define("shutdown.drain.timeout.ms", Type.LONG, 30_000,
+             in_range(min_value=0), _L,
+             "Graceful-drain budget on SIGTERM/SIGINT: the REST layer "
+             "answers writes 503 + Retry-After while the in-flight "
+             "solve gets up to this many ms to finish; then pending "
+             "program-cache temp files are swept, the flight recorder "
+             "is dumped, and the process exits.  A wedged solve never "
+             "holds shutdown past this budget (the precompute-watchdog "
+             "rule applied to the whole process).")
     d.define("progcache.enabled", Type.BOOLEAN, True, None, _M,
              "Route every pipeline compile through the persistent "
              "compiled-program cache (parallel/progcache.py): warmup "

@@ -31,6 +31,12 @@ class AnomalyType(enum.Enum):
     #: degradation ladder, analyzer/degradation.py) — informational: the
     #: ladder already IS the fix, so notification-only, lowest priority
     SOLVER_DEGRADATION = 5
+    #: the solve MESH degraded (watchdog fire / chip condemnation /
+    #: span shrink in the mesh supervisor, parallel/health.py) —
+    #: notification-only like SOLVER_DEGRADATION: the span ladder is
+    #: the remediation, the anomaly routes the evidence (condemned
+    #: chips, span, flight-recorder dump) through the notifier plane
+    MESH_DEGRADATION = 6
 
 
 class Anomaly(abc.ABC):

@@ -184,6 +184,45 @@ class SolverDegraded(Anomaly):
 
 
 @dataclasses.dataclass
+class MeshDegraded(Anomaly):
+    """The solve mesh degraded: a watchdog fire (wedged dispatch), a
+    condemned chip, or a span shrink in the mesh supervisor
+    (parallel/health.MeshSupervisor).  Notification-only — the span
+    ladder MESH8→MESH4→MESH2→FUSED is itself the remediation and probe
+    recovery climbs back when the chips return; this anomaly routes
+    the event (and its flight-recorder dump) through the notifier
+    plane so operators see substrate trouble exactly like cluster
+    trouble."""
+
+    from_span: int
+    to_span: int
+    condemned_devices: List[int]
+    watchdog_fired: bool
+    failure_kind: str               # degradation.FailureKind value
+    description: str = ""
+    detected_ms: float = 0.0
+    _id: str = dataclasses.field(
+        default_factory=lambda: _new_id("mesh-degraded"))
+
+    @property
+    def anomaly_type(self) -> AnomalyType:
+        return AnomalyType.MESH_DEGRADATION
+
+    @property
+    def anomaly_id(self) -> str:
+        return self._id
+
+    def fix(self) -> bool:
+        return False   # the span ladder already shrank/recovered
+
+    def __str__(self) -> str:
+        return (f"MeshDegraded(span {self.from_span}->{self.to_span}, "
+                f"condemned={self.condemned_devices or []}, "
+                f"watchdogFired={self.watchdog_fired}, "
+                f"kind={self.failure_kind}, {self.description})")
+
+
+@dataclasses.dataclass
 class TopicAnomaly(Anomaly):
     """Topics violating a policy — e.g. replication factor != target
     (reference TopicReplicationFactorAnomaly.java) or oversized partitions

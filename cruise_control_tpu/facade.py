@@ -216,6 +216,10 @@ class CruiseControl:
                      Sequence[float]] = None,
                  mesh_enabled: Optional[bool] = None,
                  mesh_max_devices: Optional[int] = None,
+                 mesh_recovery_enabled: bool = True,
+                 mesh_watchdog_ms: Optional[float] = None,
+                 mesh_probe_interval_ms: float = 15_000.0,
+                 mesh_min_devices: int = 1,
                  solve_scheduler=None,
                  fleet_binding=None,
                  progcache_enabled: Optional[bool] = None,
@@ -442,12 +446,41 @@ class CruiseControl:
         # pre-mesh code path everywhere.
         from cruise_control_tpu.parallel.mesh import (MeshToken,
                                                       runtime_mesh)
+        from cruise_control_tpu.parallel import health as mesh_health
         if solve_scheduler is not None:
             self._mesh_token = (getattr(solve_scheduler, "mesh_token",
                                         None) or MeshToken(None))
+            # a SHARED (fleet) scheduler brings its own supervisor (one
+            # span ladder for the whole fleet, like the token itself)
+            self.mesh_supervisor = getattr(solve_scheduler,
+                                           "mesh_supervisor", None)
         else:
             self._mesh_token = runtime_mesh(enabled=mesh_enabled,
                                             max_devices=mesh_max_devices)
+            # mesh supervisor (parallel/health.py): condemnation + span
+            # shrink + probe recovery for the solve mesh.  Only a
+            # multi-chip token gets one — single-chip facades (every
+            # existing test and the whole CPU rig under mesh.enabled=
+            # auto) carry None and behave exactly as before.
+            self.mesh_supervisor = (mesh_health.MeshSupervisor(
+                self._mesh_token,
+                enabled=mesh_recovery_enabled,
+                watchdog_ms=(mesh_watchdog_ms
+                             if mesh_watchdog_ms is not None
+                             else 120_000.0),
+                probe_interval_ms=mesh_probe_interval_ms,
+                min_devices=mesh_min_devices,
+                time_fn=self._time)
+                if self._mesh_token.is_multichip else None)
+        # watchdog arming follows the progcache configure pattern: only
+        # an EXPLICIT mesh_watchdog_ms (build_cruise_control always
+        # passes mesh.watchdog.ms) touches the process-wide switch, so
+        # embedders and tests constructing facades directly see zero
+        # behavior change
+        if mesh_watchdog_ms is not None:
+            mesh_health.configure_watchdog(
+                enabled=mesh_recovery_enabled and mesh_watchdog_ms > 0,
+                deadline_ms=mesh_watchdog_ms)
 
         self._solver_degradation_enabled = solver_degradation_enabled
         self._solver_max_retries_per_rung = max(0,
@@ -492,6 +525,7 @@ class CruiseControl:
                 deadline_budgets_s=scheduler_class_deadline_budgets_s,
                 preemption_enabled=scheduler_preemption_enabled),
             enabled=scheduler_enabled, mesh_token=self._mesh_token,
+            mesh_supervisor=self.mesh_supervisor,
             time_fn=self._time)
         #: fleet tenancy (fleet/registry.FleetBinding): identifies this
         #: facade's tenant, pads every solve's model to the fleet shape
@@ -516,6 +550,31 @@ class CruiseControl:
                            lambda: int(self.solver_ladder.rung))
         self.metrics.gauge("mesh-devices",
                            lambda: float(self._mesh_token.size))
+        # mesh-recovery sensors (parallel/health.py): the LIVE span the
+        # next solve dispatches over, the condemned set, and the
+        # supervisor/watchdog counters.  Defined even without a
+        # supervisor (span = static token size, counters 0) so
+        # dashboards don't branch on topology.
+        _sup = lambda: self.mesh_supervisor  # noqa: E731
+        self.metrics.gauge(
+            "mesh-span",
+            lambda: float(_sup().span if _sup() is not None
+                          else self._mesh_token.size))
+        self.metrics.gauge(
+            "mesh-condemned-devices",
+            lambda: float(len(_sup().condemned)
+                          if _sup() is not None else 0))
+        self.metrics.gauge(
+            "mesh-shrinks",
+            lambda: float(_sup().shrinks if _sup() is not None else 0))
+        self.metrics.gauge(
+            "mesh-probe-failures",
+            lambda: float(_sup().probe_failures
+                          if _sup() is not None else 0))
+        from cruise_control_tpu.parallel import health as _health_mod
+        self.metrics.gauge(
+            "mesh-watchdog-fires",
+            lambda: float(_health_mod.watchdog_fires()))
         # progcache-* sensors: the persistent program cache's counters
         # (process-wide singleton — under fleet serving every tenant
         # reports the same shared cache, which is the truth: there IS
@@ -1323,9 +1382,18 @@ class CruiseControl:
                 # the device); outside a scheduled job — inline solves,
                 # disabled scheduler — the facade's own token applies.
                 # A degenerate token falls through to the single-chip
-                # fused path inside optimizations (mesh=None).
-                token = (sched_runtime.current_mesh_token()
-                         or self._mesh_token)
+                # fused path inside optimizations (mesh=None).  With a
+                # supervisor, ITS token is the live truth (survivor
+                # span after condemnation/shrink), and each mesh solve
+                # first gives probe recovery a chance to climb the
+                # span back (interval-gated; one rung per probe)
+                sup = self.mesh_supervisor
+                if sup is not None:
+                    sup.maybe_recover()
+                    token = sup.current_token()
+                else:
+                    token = (sched_runtime.current_mesh_token()
+                             or self._mesh_token)
                 with obs_trace.span("device.solve", rung=rung.name,
                                     meshDevices=token.size,
                                     dirtyRegion=dirty is not None):
@@ -1410,6 +1478,24 @@ class CruiseControl:
                 obs_trace.event("solve.failure", rung=rung.name,
                                 kind=kind.value,
                                 retry=attempts_on_rung)
+                if rung is SolverRung.MESH:
+                    # mesh-level recovery FIRST (parallel/health.py): a
+                    # wedge or collective failure at the MESH rung
+                    # shrinks the span instead of feeding the solver
+                    # ladder — the breaker must not open because a chip
+                    # died; a shrink IS the remediation.  Under an
+                    # async dispatch the job re-queues (aging intact)
+                    # so the dispatch thread is released immediately;
+                    # inline solves retry in place on the shrunk span.
+                    if self._try_mesh_recovery(kind, exc, optimizer):
+                        if sched_runtime.dispatch_is_async():
+                            from cruise_control_tpu.parallel.health \
+                                import MeshRecoveryRequeue
+                            raise MeshRecoveryRequeue(
+                                "mesh span shrunk under an in-flight "
+                                "solve; re-queue onto the survivor "
+                                "span") from exc
+                        continue
                 tripped = self.solver_ladder.on_failure(rung)
                 LOG.warning("solve failed at rung %s (%s): %s", rung.name,
                             kind.value, exc)
@@ -1480,6 +1566,74 @@ class CruiseControl:
                         {g: (entries.get(g, counts[g][0]), counts[g][1])
                          for g in regressions})
         self._goal_self_regressions = regressions
+
+    def _try_mesh_recovery(self, kind: FailureKind, exc: BaseException,
+                           optimizer: GoalOptimizer) -> Optional[dict]:
+        """Mesh-level recovery for a MESH-rung failure: shrink the span
+        one rung (condemning probed-dead chips on a collective failure)
+        and hydrate the survivor span's `@meshN` programs from the
+        persistent program cache, so the retry costs seconds — not a
+        recompile, not a process bounce.  Returns the shrink summary,
+        or None when the supervisor cannot help (recovery disabled, no
+        supervisor, span exhausted, or a failure kind that is not mesh
+        material) — the classic MESH→FUSED ladder then engages."""
+        sup = self.mesh_supervisor
+        if sup is None or not sup.recovery_enabled:
+            return None
+        if kind not in (FailureKind.WEDGE, FailureKind.RUNTIME):
+            return None
+        if kind is FailureKind.WEDGE:
+            summary = sup.handle_wedge(getattr(exc, "program", None))
+        else:
+            summary = sup.handle_collective_failure()
+        if summary is None:
+            return None
+        with obs_trace.span("mesh.shrink",
+                            fromSpan=summary["fromSpan"],
+                            toSpan=summary["toSpan"],
+                            condemned=len(summary["condemned"]),
+                            wedged=summary["wedged"]):
+            try:
+                # hydrate-only when @meshN entries exist (acceptance
+                # pin): zero source compiles to reach the shrunk span
+                summary["hydrated"] = optimizer.hydrate_from_cache()
+            except Exception as hyd_exc:  # noqa: BLE001 - best effort
+                LOG.warning("post-shrink program hydration failed "
+                            "(%s); survivor-span programs compile on "
+                            "demand", hyd_exc)
+                summary["hydrated"] = 0
+        self.metrics.meter("mesh-shrink-events").mark()
+        obs_trace.mark("degraded")
+        obs_trace.event("mesh.shrink", **{
+            k: (len(v) if k == "condemned" else v)
+            for k, v in summary.items() if k != "program"})
+        self._report_mesh_degraded(summary, kind, exc)
+        return summary
+
+    def _report_mesh_degraded(self, summary: dict, kind: FailureKind,
+                              exc: BaseException) -> None:
+        """Emit a MeshDegraded anomaly through the detector plane and
+        dump the flight recorder — the mesh twin of
+        _report_solver_degraded: chip trouble surfaces exactly like
+        cluster trouble, with the incident evidence self-captured."""
+        from cruise_control_tpu.detector.anomalies import MeshDegraded
+        active = obs_trace.current()
+        obs_recorder.get_recorder().dump(
+            reason=f"MeshDegraded span {summary['fromSpan']}->"
+                   f"{summary['toSpan']} ({kind.value}, condemned="
+                   f"{summary['condemned'] or 'none'})",
+            active=active.to_json() if active is not None else None)
+        try:
+            self.anomaly_detector.report(MeshDegraded(
+                from_span=summary["fromSpan"],
+                to_span=summary["toSpan"],
+                condemned_devices=list(summary["condemned"]),
+                watchdog_fired=bool(summary["wedged"]),
+                failure_kind=kind.value,
+                description=f"{type(exc).__name__}: {exc}",
+                detected_ms=self._time() * 1000.0))
+        except Exception:  # noqa: BLE001 - reporting must not mask exc
+            LOG.exception("failed to report MeshDegraded anomaly")
 
     def _report_solver_degraded(self, from_rung: SolverRung,
                                 to_rung: Optional[SolverRung],
@@ -1926,6 +2080,13 @@ class CruiseControl:
                     **self.solver_ladder.to_json(),
                     "precomputeWedged": self.precompute_wedged(),
                     "meshDevices": self._mesh_token.size,
+                    # span-shrink/condemnation/probe state (the
+                    # operator's first stop when mesh-span < full):
+                    # parallel/health.MeshSupervisor
+                    "meshRecovery": (self.mesh_supervisor.to_json()
+                                     if self.mesh_supervisor is not None
+                                     else {"enabled": False,
+                                           "span": self._mesh_token.size}),
                 },
                 "goalSelfRegressions": list(self._goal_self_regressions),
             }

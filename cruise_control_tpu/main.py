@@ -340,6 +340,11 @@ def build_cruise_control(config: CruiseControlConfig, admin,
                 "scheduler.class.deadline.budget.ms") if str(x).strip()],
         mesh_enabled=_mesh_enabled_of(config),
         mesh_max_devices=(config.get_int("mesh.max.devices") or None),
+        mesh_recovery_enabled=config.get_boolean("mesh.recovery.enabled"),
+        mesh_watchdog_ms=float(config.get_long("mesh.watchdog.ms")),
+        mesh_probe_interval_ms=float(
+            config.get_long("mesh.probe.interval.ms")),
+        mesh_min_devices=config.get_int("mesh.min.devices"),
         solve_scheduler=solve_scheduler,
         fleet_binding=fleet_binding,
         progcache_enabled=config.get_boolean("progcache.enabled"),
@@ -501,7 +506,21 @@ def build_fleet(config: CruiseControlConfig, fleet_config_path: str):
     # The shared scheduler also owns the ONE fleet-wide mesh token
     # (mesh.* from the base config): every tenant's solves run over the
     # same device mesh.
+    from cruise_control_tpu.parallel.health import MeshSupervisor
     from cruise_control_tpu.parallel.mesh import runtime_mesh
+    fleet_mesh_token = runtime_mesh(
+        enabled=_mesh_enabled_of(config),
+        max_devices=(config.get_int("mesh.max.devices") or None))
+    # ONE mesh supervisor for the whole fleet, like the token it wraps:
+    # a chip condemned under any tenant's solve shrinks the span every
+    # tenant dispatches over (there is only one set of chips to lose)
+    fleet_mesh_supervisor = (MeshSupervisor(
+        fleet_mesh_token,
+        enabled=config.get_boolean("mesh.recovery.enabled"),
+        watchdog_ms=float(config.get_long("mesh.watchdog.ms")),
+        probe_interval_ms=float(config.get_long("mesh.probe.interval.ms")),
+        min_devices=config.get_int("mesh.min.devices"))
+        if fleet_mesh_token.is_multichip else None)
     scheduler = DeviceTimeScheduler(
         SchedulerPolicy.from_lists(
             weights=[float(x) for x in config.get_list(
@@ -513,9 +532,8 @@ def build_fleet(config: CruiseControlConfig, fleet_config_path: str):
             preemption_enabled=config.get_boolean(
                 "scheduler.preemption.enabled")),
         enabled=config.get_boolean("scheduler.enabled"),
-        mesh_token=runtime_mesh(
-            enabled=_mesh_enabled_of(config),
-            max_devices=(config.get_int("mesh.max.devices") or None)))
+        mesh_token=fleet_mesh_token,
+        mesh_supervisor=fleet_mesh_supervisor)
     registry = FleetRegistry(
         scheduler,
         bucket_floor=config.get_int("fleet.bucket.floor"),
@@ -834,6 +852,28 @@ def main(argv=None) -> int:
         while not stop.wait(1.0):
             pass
     finally:
+        # graceful drain (SIGTERM/SIGINT): stop admitting writes (503 +
+        # Retry-After — clients back off like on a 429 and resubmit to
+        # the replacement process), give the in-flight solve a bounded
+        # window to finish, then settle the persistent program cache
+        # and dump the flight recorder so the incident evidence and the
+        # compiled programs survive the restart.  A wedged solve never
+        # holds the process past the budget — the precompute-watchdog
+        # rule applied to shutdown itself.
+        drain_s = config.get_long("shutdown.drain.timeout.ms") / 1e3
+        LOG.info("draining: writes now answer 503 + Retry-After "
+                 "(budget %.0fs)", drain_s)
+        app.drain(retry_after_s=drain_s)
+        if not cc.solve_scheduler.quiesce(drain_s):
+            LOG.warning("drain budget elapsed with a solve still in "
+                        "flight; shutting down around it")
+        from cruise_control_tpu.parallel import progcache as _progcache
+        swept = _progcache.get_cache().flush()
+        if swept:
+            LOG.info("program cache: swept %d orphaned temp files",
+                     swept)
+        from cruise_control_tpu.obs import recorder as _recorder
+        _recorder.get_recorder().dump(reason="shutdown drain")
         LOG.info("shutting down")
         app.stop()
         if fleet is not None:

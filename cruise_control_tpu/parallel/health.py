@@ -1,0 +1,634 @@
+"""Mesh supervisor: watched device dispatch, chip probing, and the
+span-shrink ladder that lets the serving loop ride through chip loss
+and wedged collectives without a process bounce.
+
+PR 6 made the production solve depend on every chip in the mesh, which
+multiplied the blast radius of one bad device: a wedged all-reduce
+captures the scheduler's single dispatch thread FOREVER (Python cannot
+abort an XLA dispatch), and a dead chip fails every whole-mesh solve
+until someone bounces the process.  This module applies the paper's
+detect→degrade→recover discipline to our own substrate — the mesh —
+in three pieces:
+
+* **watched dispatch** (`watched_call`): device execution runs on a
+  watched worker thread under a `mesh.watchdog.ms` deadline.  A wedged
+  dispatch is ABANDONED — the worker thread stays blocked (nothing can
+  unblock it) but is replaced, its executable is quarantined, and the
+  dispatch thread gets `DispatchWedgedError` within the deadline
+  instead of hanging forever.  Disarmed (the default, and whenever no
+  deadline is configured) the gateway is a plain call — byte-identical
+  behavior, one fault-site check of overhead.
+
+* **per-chip probe** (`probe_devices`): a tiny per-device program (the
+  degenerate single-chip case of the `('replica',)` all-reduce) run
+  under its own deadline on a fresh thread per device, so a dead or
+  wedged chip shows up as a probe failure instead of hanging the
+  prober.  Fault sites `mesh.probe` / `mesh.probe.dev<N>` make chip
+  loss scriptable on the virtual 8-CPU rig.
+
+* **span ladder** (`MeshSupervisor`): the PR-6 `SolverRung.MESH` rung
+  generalized to SPAN-parameterized rungs — MESH8→MESH4→MESH2→FUSED.
+  On a wedge or collective failure the supervisor condemns failing
+  devices and rebuilds the MeshToken over survivors one span down
+  (span 1 = the degenerate single-chip token, i.e. exactly FUSED);
+  the facade then hydrates the shrunk span's `@meshN` programs from
+  the persistent program cache (PR 7), so a shrink costs seconds, not
+  a 300s recompile.  Probe recovery climbs back one span per probe
+  cycle — the same one-rung-per-solve discipline as the PR-2 ladder.
+
+The supervisor is owned by the scheduler (one per process/fleet, like
+the mesh token it wraps) and consulted per dispatch, so every consumer
+— request solves, scenario lanes, fleet folds — re-shards over the
+surviving span automatically.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import queue as queue_mod
+import threading
+import time as _time
+from typing import Callable, List, Optional
+
+from cruise_control_tpu.obs import trace as obs_trace
+from cruise_control_tpu.parallel.mesh import MeshToken, make_mesh
+from cruise_control_tpu.sched.runtime import SolvePreempted
+from cruise_control_tpu.utils import faults
+
+LOG = logging.getLogger(__name__)
+
+
+class DispatchWedgedError(RuntimeError):
+    """A watched device dispatch overran its watchdog deadline: the
+    worker thread is presumed wedged (stuck collective, dead chip,
+    hung transport) and has been abandoned.  `program` names the
+    executable that wedged (now quarantined); classified as WEDGE by
+    the degradation ladder."""
+
+    def __init__(self, site: str, program: Optional[str] = None,
+                 deadline_ms: float = 0.0) -> None:
+        super().__init__(
+            f"device dispatch at {site} "
+            f"({program or 'unknown program'}) exceeded its "
+            f"{deadline_ms:.0f}ms watchdog deadline; worker abandoned")
+        self.site = site
+        self.program = program
+        self.deadline_ms = deadline_ms
+
+
+class MeshRecoveryRequeue(SolvePreempted):
+    """Control flow, not an error: the mesh supervisor shrank the span
+    under an in-flight scheduled solve — the dispatch loop re-queues
+    the job (aging intact, exactly the PR-4 preemption machinery) and
+    the redispatch solves on the surviving span.  Raised only under an
+    asynchronous dispatch; inline solves retry on the shrunk span in
+    place."""
+
+
+# ---------------------------------------------------------------------------
+# watched dispatch gateway
+# ---------------------------------------------------------------------------
+
+#: process-wide watchdog switch (progcache configure pattern: only an
+#: EXPLICIT facade/config setting touches it, so embedders and the
+#: existing test suite see zero behavior change)
+_WATCHDOG = {"enabled": False, "deadline_ms": 0.0}
+_WATCH_LOCK = threading.Lock()
+#: lifetime watchdog fires in this process (the mesh-watchdog-fires
+#: sensor reads it)
+_FIRES = 0
+#: wall seconds the dispatch thread was actually blocked at the last
+#: fire — the meshchaos bench's released-in-time assertion
+_LAST_FIRE_WAIT_S = 0.0
+#: program keys whose executable wedged a worker -> monotonic expiry:
+#: dispatching them again would likely wedge the replacement too, so
+#: they are refused for a bounded cooldown.  TIME-BOUNDED on purpose —
+#: on a single-chip facade there is no supervisor to clear the set, and
+#: a legitimate one-off overrun (deadline set too tight for the slowest
+#: segment) must not pin the process degraded until restart.  Probe
+#: recovery at full span still clears it early.
+_QUARANTINED: dict = {}
+#: quarantine cooldown = max(this floor, 4x deadline) — long enough
+#: that a genuinely wedged program is not immediately re-dispatched,
+#: short enough that a false fire self-heals
+_QUARANTINE_MIN_TTL_S = 60.0
+
+
+def configure_watchdog(enabled: Optional[bool] = None,
+                       deadline_ms: Optional[float] = None) -> None:
+    with _WATCH_LOCK:
+        if enabled is not None:
+            _WATCHDOG["enabled"] = bool(enabled)
+        if deadline_ms is not None:
+            _WATCHDOG["deadline_ms"] = float(deadline_ms)
+
+
+def watchdog_config() -> dict:
+    with _WATCH_LOCK:
+        return dict(_WATCHDOG)
+
+
+def watchdog_fires() -> int:
+    return _FIRES
+
+
+def last_fire_wait_s() -> float:
+    return _LAST_FIRE_WAIT_S
+
+
+def quarantine_program(key: Optional[str],
+                       deadline_ms: float = 0.0) -> None:
+    if key:
+        ttl = max(_QUARANTINE_MIN_TTL_S, 4.0 * deadline_ms / 1000.0)
+        with _WATCH_LOCK:
+            _QUARANTINED[key] = _time.monotonic() + ttl
+
+
+def is_quarantined(key: Optional[str]) -> bool:
+    if not key:
+        return False
+    with _WATCH_LOCK:
+        expiry = _QUARANTINED.get(key)
+        if expiry is None:
+            return False
+        if _time.monotonic() >= expiry:
+            del _QUARANTINED[key]
+            return False
+        return True
+
+
+def clear_quarantine() -> None:
+    with _WATCH_LOCK:
+        _QUARANTINED.clear()
+
+
+class _Call:
+    __slots__ = ("fn", "done", "result", "exc", "abandoned")
+
+    def __init__(self, fn) -> None:
+        self.fn = fn
+        self.done = threading.Event()
+        self.result = None
+        self.exc: Optional[BaseException] = None
+        self.abandoned = False
+
+
+class _Worker:
+    """One watched worker thread with its own queue.  A wedged worker
+    is abandoned in place (its thread stays blocked on the wedged
+    dispatch until the process exits — daemon) and replaced; when the
+    wedge eventually releases, the worker sees it was abandoned,
+    discards the result and exits instead of racing its successor."""
+
+    def __init__(self) -> None:
+        self.queue: "queue_mod.Queue[_Call]" = queue_mod.Queue()
+        self.abandoned = False
+        self.thread = threading.Thread(target=self._loop,
+                                       name="watched-dispatch",
+                                       daemon=True)
+        self.thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            call = self.queue.get()
+            try:
+                call.result = call.fn()
+            except BaseException as exc:  # noqa: BLE001 - relayed
+                call.exc = exc
+                LOG.debug("watched dispatch raised %s (relayed to the "
+                          "caller)", type(exc).__name__)
+            call.done.set()
+            if self.abandoned:
+                return
+
+
+#: one watched worker PER CALLING THREAD (not one global): concurrent
+#: inline solves (scheduler disabled, USER_TASKS pool threads) must not
+#: queue behind each other inside the gateway — a shared worker would
+#: both serialize previously-parallel dispatches and count the queue
+#: wait against the deadline, firing the watchdog on a healthy program
+#: that merely waited its turn.  The caller population is bounded (the
+#: dispatch thread, the USER_TASKS pool, the precompute thread), so the
+#: idle-worker cost is a handful of parked daemon threads.
+_WORKER_TLS = threading.local()
+
+
+def _current_worker() -> _Worker:
+    worker = getattr(_WORKER_TLS, "worker", None)
+    if worker is None or worker.abandoned \
+            or not worker.thread.is_alive():
+        worker = _Worker()
+        _WORKER_TLS.worker = worker
+    return worker
+
+
+def _abandon_worker(worker: _Worker) -> None:
+    worker.abandoned = True
+    if getattr(_WORKER_TLS, "worker", None) is worker:
+        _WORKER_TLS.worker = None
+
+
+def watched_call(fn: Callable[[], object], *,
+                 program: Optional[str] = None,
+                 site: str = "mesh.dispatch"):
+    """THE device-execution gateway (watchdog-gateway lint rule): every
+    compiled-program invocation — the optimizer's AOT/shared
+    executables, the scenario engine's batched programs — runs through
+    here.  Disarmed, it is the direct call plus one fault-site check;
+    armed (mesh.watchdog.ms via the facade), the call runs on the
+    watched worker under the deadline and a wedge surfaces as
+    `DispatchWedgedError` on the CALLING thread within the deadline.
+
+    The `site` fault point fires on whichever thread executes the
+    program, so a scripted hang (FaultPlan.hang_nth) wedges the worker
+    exactly like a stuck collective would."""
+    cfg = watchdog_config()
+    armed = cfg["enabled"] and cfg["deadline_ms"] > 0
+
+    def _invoke():
+        faults.inject(site)
+        return fn()
+
+    if not armed:
+        return _invoke()
+    if is_quarantined(program):
+        raise DispatchWedgedError(site, program, cfg["deadline_ms"])
+    worker = _current_worker()
+    call = _Call(_invoke)
+    t0 = _time.monotonic()
+    worker.queue.put(call)
+    if not call.done.wait(cfg["deadline_ms"] / 1000.0):
+        global _FIRES, _LAST_FIRE_WAIT_S
+        call.abandoned = True
+        _abandon_worker(worker)
+        with _WATCH_LOCK:
+            _FIRES += 1
+            _LAST_FIRE_WAIT_S = _time.monotonic() - t0
+        quarantine_program(program, deadline_ms=cfg["deadline_ms"])
+        LOG.error("watchdog: dispatch of %s at %s exceeded %.0fms; "
+                  "worker thread abandoned, executable quarantined",
+                  program or "<unknown>", site, cfg["deadline_ms"])
+        raise DispatchWedgedError(site, program, cfg["deadline_ms"])
+    if call.exc is not None:
+        raise call.exc
+    return call.result
+
+
+# ---------------------------------------------------------------------------
+# per-chip probe
+# ---------------------------------------------------------------------------
+
+_PROBE_FN = None
+
+
+def _probe_fn():
+    """The probe program, compiled once: the single-chip degenerate
+    case of the ('replica',) all-reduce — a tiny reduction whose known
+    answer proves the device still computes.  jax.jit here is
+    sanctioned (cache-gateway allowlist): a four-float reduction is
+    not persistent-cache material."""
+    global _PROBE_FN
+    if _PROBE_FN is None:
+        import jax
+        import jax.numpy as jnp
+        _PROBE_FN = jax.jit(lambda a: jnp.sum(a) * 2.0)
+    return _PROBE_FN
+
+
+def _probe_one(device) -> None:
+    import jax
+    import numpy as np
+    faults.inject("mesh.probe")
+    faults.inject(f"mesh.probe.dev{device.id}")
+    x = jax.device_put(np.arange(4, dtype=np.float32), device)
+    got = float(jax.device_get(_probe_fn()(x)))
+    if got != 12.0:
+        raise RuntimeError(f"probe on {device} computed {got} != 12.0")
+
+
+#: device id -> still-running probe thread from an earlier cycle: a
+#: chip wedged hard enough to HANG its probe (rather than raise) keeps
+#: exactly ONE abandoned thread parked per device — later probe cycles
+#: see the old thread still alive and fail the device immediately
+#: instead of leaking a fresh blocked thread every interval
+_PROBE_WEDGED: dict = {}
+_PROBE_LOCK = threading.Lock()
+
+
+def probe_devices(devices, deadline_ms: float = 2000.0):
+    """(healthy, failed) split of `devices`: each device runs the probe
+    program on its own daemon thread under `deadline_ms` — a wedged
+    chip times out (thread abandoned; at most one parked thread per
+    device, see _PROBE_WEDGED) instead of hanging the prober, and one
+    bad device cannot shadow the others' verdicts."""
+    results = {}
+    threads = {}
+
+    def run(d):
+        try:
+            _probe_one(d)
+            results[d.id] = None
+        except BaseException as exc:  # noqa: BLE001 - verdict, not crash
+            results[d.id] = exc
+            LOG.warning("mesh probe failed on device %s: %s: %s", d.id,
+                        type(exc).__name__, exc)
+
+    for d in devices:
+        with _PROBE_LOCK:
+            stuck = _PROBE_WEDGED.get(d.id)
+            if stuck is not None and stuck.is_alive():
+                continue             # prior probe still wedged: fail it
+            _PROBE_WEDGED.pop(d.id, None)
+        t = threading.Thread(target=run, args=(d,),
+                             name=f"mesh-probe-{d.id}", daemon=True)
+        t.start()
+        threads[d.id] = t
+    deadline = _time.monotonic() + deadline_ms / 1000.0
+    for t in threads.values():
+        t.join(max(0.0, deadline - _time.monotonic()))
+    healthy, failed = [], []
+    for d in devices:
+        t = threads.get(d.id)
+        if t is not None and t.is_alive():
+            with _PROBE_LOCK:
+                _PROBE_WEDGED[d.id] = t      # hung, not erroring
+        if d.id in results and results[d.id] is None:
+            healthy.append(d)
+        else:
+            failed.append(d)
+    return healthy, failed
+
+
+# ---------------------------------------------------------------------------
+# span ladder + supervisor
+# ---------------------------------------------------------------------------
+
+def span_ladder(n_devices: int, min_devices: int = 1) -> List[int]:
+    """Descending halving spans ending at the degenerate single chip:
+    8 → [8, 4, 2, 1].  Spans below `min_devices` are skipped (except
+    the terminal 1 — below the minimum the mesh is not worth its
+    collectives and service drops straight to single-chip FUSED)."""
+    spans: List[int] = []
+    s = max(1, n_devices)
+    while s > 1:
+        if s >= max(2, min_devices):
+            spans.append(s)
+        s //= 2
+    spans.append(1)
+    return spans
+
+
+class MeshSupervisor:
+    """Runtime health authority for one solve mesh.
+
+    Wraps the scheduler's base MeshToken: `current_token()` is the
+    LIVE topology — the first `span` healthy (non-condemned) devices —
+    and every dispatch resolves through it, so a shrink between
+    dispatches re-shards request solves, scenario lanes and fleet
+    folds alike.  Thread-safe; one instance per scheduler (fleet-wide
+    under shared scheduling, exactly like the token it supervises).
+
+    `mesh.recovery.enabled=false` is the manual override: the
+    supervisor still reports (probes can be run via tools), but
+    failures fall through to the classic MESH→FUSED ladder descent of
+    PR 6 — the pre-PR-12 behavior."""
+
+    def __init__(self, base_token: MeshToken, *,
+                 enabled: bool = True,
+                 watchdog_ms: float = 120_000.0,
+                 probe_interval_ms: float = 15_000.0,
+                 min_devices: int = 1,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self.recovery_enabled = bool(enabled)
+        self.watchdog_ms = float(watchdog_ms)
+        self.probe_interval_ms = float(probe_interval_ms)
+        self.min_devices = max(1, int(min_devices))
+        self._time = time_fn or _time.time
+        self._lock = threading.Lock()
+        self._base_token = base_token
+        self._devices = (list(base_token.mesh.devices.flat)
+                         if base_token.is_multichip else [])
+        self._ladder = span_ladder(len(self._devices) or 1,
+                                   self.min_devices)
+        self._span = self._ladder[0]
+        self._condemned: set = set()        # device ids
+        self._token = base_token
+        # counters (sensor food)
+        self.shrinks = 0
+        self.probe_failures = 0
+        self.recoveries = 0
+        self._last_change_at = -float("inf")
+        self._last_probe_at = -float("inf")
+
+    # -- topology ------------------------------------------------------
+    @property
+    def span(self) -> int:
+        with self._lock:
+            return self._span
+
+    @property
+    def condemned(self) -> List[int]:
+        with self._lock:
+            return sorted(self._condemned)
+
+    def current_token(self) -> MeshToken:
+        with self._lock:
+            return self._token
+
+    def _probe_deadline_ms(self) -> float:
+        """Per-chip probe deadline: capped by the watchdog deadline but
+        FLOORED at 250ms and defaulting to 5s when the watchdog is
+        disarmed (watchdog_ms=0 disables the DISPATCH watchdog, it must
+        not give probes a zero deadline that condemns every healthy
+        chip)."""
+        base = self.watchdog_ms if self.watchdog_ms > 0 else 5000.0
+        return max(250.0, min(base, 5000.0))
+
+    def _healthy_locked(self) -> list:
+        return [d for d in self._devices if d.id not in self._condemned]
+
+    def _rebuild_locked(self) -> None:
+        """Rebuild the live token AND normalize the span to a ladder
+        width the healthy set can actually fill: `healthy[:span]` with
+        fewer survivors than the span would silently build a
+        non-ladder-width mesh (e.g. 3 chips) that no `@meshN` cache
+        entry or warmup ever covered — the span steps down to the
+        largest feasible rung instead, so span and token never
+        disagree."""
+        healthy = self._healthy_locked()
+        target = 1
+        for s in self._ladder:               # descending: first fit =
+            if s <= self._span and s <= len(healthy):
+                target = s                   # largest feasible
+                break
+        self._span = target
+        if target <= 1 or len(healthy) < 2:
+            self._token = MeshToken(None)
+        else:
+            self._token = MeshToken(make_mesh(healthy[:target]))
+
+    def _feasible_below_locked(self, span: int,
+                               healthy: int) -> Optional[int]:
+        for s in self._ladder:
+            if s < span and s <= healthy:
+                return s
+        return None
+
+    # -- failure handling ----------------------------------------------
+    def handle_wedge(self, program: Optional[str] = None
+                     ) -> Optional[dict]:
+        """A watched dispatch wedged at the current span.  No probe
+        (nothing measurable failed — the wedge may be transient): step
+        ONE span down so the redispatch stops depending on whichever
+        chip/collective wedged.  Returns a shrink summary, or None
+        when recovery is disabled or the span is already degenerate
+        (the classic ladder takes over)."""
+        if not self.recovery_enabled:
+            return None
+        with self._lock:
+            if self._span <= 1:
+                return None
+            from_span = self._span
+            nxt = self._feasible_below_locked(from_span,
+                                              len(self._healthy_locked()))
+            self._span = nxt if nxt is not None else 1
+            self._rebuild_locked()
+            self.shrinks += 1
+            self._last_change_at = self._time()
+            to_span, condemned = self._span, sorted(self._condemned)
+        LOG.warning("mesh supervisor: wedged dispatch (%s) — span "
+                    "%d -> %d", program or "?", from_span, to_span)
+        return {"fromSpan": from_span, "toSpan": to_span,
+                "condemned": condemned, "wedged": True,
+                "program": program}
+
+    def handle_collective_failure(self) -> Optional[dict]:
+        """A mesh-rung solve FAILED (collective error, chip loss).
+        Probe every device, condemn the failures, and rebuild one span
+        down (lower still when survivors demand it).  Returns a shrink
+        summary, or None when recovery is disabled or there is nothing
+        left to shrink."""
+        if not self.recovery_enabled:
+            return None
+        with self._lock:
+            if self._span <= 1:
+                return None
+            devices = list(self._devices)
+            from_span = self._span
+        with obs_trace.span("mesh.probe", devices=len(devices)):
+            _healthy, failed = probe_devices(
+                devices, deadline_ms=self._probe_deadline_ms())
+        with self._lock:
+            newly = {d.id for d in failed} - self._condemned
+            self._condemned |= {d.id for d in failed}
+            self.probe_failures += len(newly)
+            self._last_probe_at = self._time()
+            if not newly:
+                # every chip answered: the failure was transient (or
+                # not mesh material at all) — shrinking would degrade
+                # capacity without fixing anything.  Hand the failure
+                # back to the classic ladder, which retries at the
+                # CURRENT span with backoff before descending
+                # MESH→FUSED (hangs are different: handle_wedge shrinks
+                # un-probed, because re-dispatching the same span
+                # likely re-wedges).
+                LOG.info("mesh supervisor: collective failure but every "
+                         "probe answered — span %d kept, classic ladder "
+                         "handles the retry", from_span)
+                return None
+            nxt = self._feasible_below_locked(
+                from_span, len(self._healthy_locked()))
+            self._span = nxt if nxt is not None else 1
+            self._rebuild_locked()
+            self.shrinks += 1
+            self._last_change_at = self._time()
+            to_span, condemned = self._span, sorted(self._condemned)
+        LOG.warning("mesh supervisor: collective failure — probe "
+                    "condemned %s; span %d -> %d",
+                    condemned or "none", from_span, to_span)
+        return {"fromSpan": from_span, "toSpan": to_span,
+                "condemned": condemned, "wedged": False,
+                "program": None}
+
+    # -- recovery ------------------------------------------------------
+    def maybe_recover(self) -> bool:
+        """Probe-gated climb-back, one span per probe cycle: when the
+        probe interval has elapsed since the last change, re-probe the
+        full device set; recovered chips leave the condemned set and
+        the span climbs ONE ladder rung if the healthy count supports
+        it.  Back at the full span with nothing condemned, the
+        program quarantine is cleared (the wedged executables' devices
+        proved healthy).  Returns True when the span climbed."""
+        if not self.recovery_enabled:
+            return False
+        with self._lock:
+            if self._span >= self._ladder[0] and not self._condemned:
+                return False
+            now = self._time()
+            since = (now - max(self._last_change_at,
+                               self._last_probe_at)) * 1000.0
+            if since < max(self.probe_interval_ms, 1.0):
+                return False
+            self._last_probe_at = now
+            devices = list(self._devices)
+            from_span = self._span
+        with obs_trace.span("mesh.probe", devices=len(devices),
+                            recovery=True):
+            healthy, failed = probe_devices(
+                devices, deadline_ms=self._probe_deadline_ms())
+        with self._lock:
+            newly = {d.id for d in failed} - self._condemned
+            self._condemned = {d.id for d in failed}
+            self.probe_failures += len(newly)
+            target = None
+            for s in self._ladder:           # descending
+                if s > from_span and s <= len(self._healthy_locked()):
+                    target = s               # keep the SMALLEST above
+            if target is None:
+                self._rebuild_locked()       # condemned set may have
+                return False                 # changed under same span
+            # one rung per probe cycle: the smallest feasible span
+            # above the current one
+            self._span = target
+            self._rebuild_locked()
+            self.recoveries += 1
+            self._last_change_at = self._time()
+            to_span = self._span
+            clear = (to_span >= self._ladder[0]
+                     and not self._condemned)
+        if clear:
+            clear_quarantine()
+        LOG.info("mesh supervisor: probe recovery — span %d -> %d "
+                 "(condemned now %s)", from_span, to_span,
+                 self.condemned or "none")
+        return True
+
+    # -- reporting -----------------------------------------------------
+    def to_json(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.recovery_enabled,
+                "span": self._span,
+                "fullSpan": self._ladder[0],
+                "spanLadder": list(self._ladder),
+                "condemnedDevices": sorted(self._condemned),
+                "shrinks": self.shrinks,
+                "probeFailures": self.probe_failures,
+                "recoveries": self.recoveries,
+                "watchdogMs": self.watchdog_ms,
+                "watchdogFires": watchdog_fires(),
+                "probeIntervalMs": self.probe_interval_ms,
+                "minDevices": self.min_devices,
+            }
+
+
+@contextlib.contextmanager
+def watchdog_armed(deadline_ms: float):
+    """Scoped watchdog arming for tests/tools: arm, yield, restore."""
+    prev = watchdog_config()
+    configure_watchdog(enabled=True, deadline_ms=deadline_ms)
+    try:
+        yield
+    finally:
+        configure_watchdog(enabled=prev["enabled"],
+                           deadline_ms=prev["deadline_ms"])

@@ -314,6 +314,46 @@ class ProgramCache:
         with self._lock:
             self.corrupt_entries += 1
 
+    def flush(self) -> int:
+        """Settle the cache directory for shutdown: stores are
+        write-through (atomic write-temp-then-rename at compile time),
+        so the only pending state is temp files orphaned by a writer
+        that died mid-store — sweep them and fsync the directory so
+        the rename journal reaches disk before the process exits (the
+        graceful-drain path calls this after the last solve).  Returns
+        the number of orphans swept; safe (0) when inactive."""
+        if not self.active:
+            return 0
+        swept = 0
+
+        def fsync_dir(path: str) -> None:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+
+        try:
+            # entries live in nested <fingerprint>/<goal_sig>/ dirs and
+            # _atomic_write creates its temp files NEXT TO the entry —
+            # walk the whole tree, and fsync each directory so the
+            # renames' journal entries reach disk where they happened
+            for dirpath, _dirnames, filenames in os.walk(self.cache_dir):
+                for name in filenames:
+                    if name.startswith(".tmp-") and name.endswith("~"):
+                        try:
+                            os.unlink(os.path.join(dirpath, name))
+                            swept += 1
+                        except OSError:
+                            pass
+                try:
+                    fsync_dir(dirpath)
+                except OSError:
+                    pass
+        except OSError as exc:
+            LOG.debug("progcache: flush skipped (%s)", exc)
+        return swept
+
     # ------------------------------------------------------------------
     # accounting used by the compile gateways
     # ------------------------------------------------------------------

@@ -52,6 +52,7 @@ from cruise_control_tpu.analyzer.degradation import (CircuitBreaker,
 from cruise_control_tpu.analyzer.goals.base import OptimizationFailure
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model.state import ClusterState
+from cruise_control_tpu.parallel import health
 from cruise_control_tpu.scenario.compiler import (CompiledBatch,
                                                   _batch_geometry,
                                                   compile_batch, materialize)
@@ -801,7 +802,11 @@ class ScenarioEngine:
                 self._programs.move_to_end(cache_key)
                 while len(self._programs) > self._max_programs:
                     self._programs.popitem(last=False)
-        return entry[0](*args)
+        # watched-dispatch gateway (parallel/health.py): a wedged lane
+        # batch releases the dispatch thread within mesh.watchdog.ms
+        # exactly like a wedged request solve (watchdog-gateway rule)
+        prog = entry[0]
+        return health.watched_call(lambda: prog(*args), program=key)
 
     def _compile_batched(self, gk, key: str, fn, donate: tuple,
                          shapes: tuple, args):

@@ -133,6 +133,10 @@ class AdmissionQueue:
         self._by_key: Dict[tuple, Tuple[SolveTicket, Optional[_Entry]]] = {}
         self._depth: Dict[SchedulerClass, int] = {c: 0
                                                   for c in SchedulerClass}
+        #: entries popped for service (take/take_fold_peers) but not
+        #: yet settled (done_serving/requeue): counted under the same
+        #: lock as the pop so idle() is race-free
+        self._in_service = 0
         self._seq = 0
         self._latency_ewma_s = 0.0
         self._latency_samples = 0
@@ -206,6 +210,11 @@ class AdmissionQueue:
     def _pop_locked(self, entry: _Entry) -> None:
         self._entries.remove(entry)
         self._depth[entry.klass] -= 1
+        # popped-for-service under the SAME lock as the removal, so
+        # depth()==0 can never race a just-taken entry past idle() (the
+        # graceful-drain quiesce reads it); the scheduler settles the
+        # count via done_serving()/requeue()
+        self._in_service += 1
         # the _by_key mapping STAYS: identical requests attach to the
         # in-flight solve until finish() severs it
 
@@ -232,7 +241,20 @@ class AdmissionQueue:
             entry.last_queued_at = self._time()
             self._entries.append(entry)
             self._depth[entry.klass] += 1
+            self._in_service -= 1     # back to queued, atomically
             self._cond.notify()
+
+    def done_serving(self, n: int = 1) -> None:
+        """The scheduler finished (resolved or failed) `n` entries it
+        had taken — the other half of _pop_locked's in-service count."""
+        with self._cond:
+            self._in_service -= n
+
+    def idle(self) -> bool:
+        """Nothing queued AND nothing taken-but-unfinished, read under
+        one lock — the race-free predicate the drain path polls."""
+        with self._cond:
+            return not self._entries and self._in_service == 0
 
     def finish(self, entry: _Entry) -> None:
         """Sever the coalesce binding once the solve resolved (call

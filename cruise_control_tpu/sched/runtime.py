@@ -48,19 +48,38 @@ def under_gateway() -> bool:
 
 
 @contextlib.contextmanager
-def gateway(preempt_check: Optional[Callable[[], bool]] = None):
+def gateway(preempt_check: Optional[Callable[[], bool]] = None,
+            async_dispatch: bool = False):
     """Mark the current thread as inside the solve gateway; when
     `preempt_check` is given, `segment_checkpoint()` consults it between
-    goal segments and raises SolvePreempted when it returns True."""
+    goal segments and raises SolvePreempted when it returns True.
+
+    `async_dispatch=True` marks a job running on the scheduler's OWN
+    dispatch thread (a separate thread from its submitter): re-queueing
+    machinery — preemption, mesh-recovery requeue — is only meaningful
+    there, because an inline job has no queue entry to re-queue and
+    must instead retry in place (`dispatch_is_async()` is how the solve
+    paths below the facade pick between the two)."""
     depth = getattr(_TLS, "gateway_depth", 0)
     prev_check = getattr(_TLS, "preempt_check", None)
+    prev_async = getattr(_TLS, "async_dispatch", False)
     _TLS.gateway_depth = depth + 1
     _TLS.preempt_check = preempt_check
+    _TLS.async_dispatch = async_dispatch
     try:
         yield
     finally:
         _TLS.gateway_depth = depth
         _TLS.preempt_check = prev_check
+        _TLS.async_dispatch = prev_async
+
+
+def dispatch_is_async() -> bool:
+    """True while the current thread runs a job the scheduler's
+    dispatch loop (not the submitter) is executing — i.e. raising a
+    SolvePreempted-family exception will RE-QUEUE the job instead of
+    surfacing to a caller."""
+    return getattr(_TLS, "async_dispatch", False)
 
 
 @contextlib.contextmanager

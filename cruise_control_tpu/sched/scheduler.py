@@ -106,6 +106,7 @@ class DeviceTimeScheduler:
                  enabled: bool = True,
                  max_fold: int = 8,
                  mesh_token=None,
+                 mesh_supervisor=None,
                  time_fn: Optional[Callable[[], float]] = None) -> None:
         import time as _time
         self.policy = policy or SchedulerPolicy.default()
@@ -118,7 +119,20 @@ class DeviceTimeScheduler:
         #: batching axis.  Under fleet serving the shared scheduler's
         #: token governs every tenant.
         self.mesh_token = mesh_token
+        #: mesh health authority (parallel/health.MeshSupervisor or
+        #: None): when present, every dispatch resolves its token
+        #: through the supervisor instead of the static `mesh_token`,
+        #: so a span shrink between dispatches re-shards the very next
+        #: job — request solves, scenario lanes and fleet folds alike —
+        #: without the scheduler restarting anything
+        self.mesh_supervisor = mesh_supervisor
         self._max_fold = max(1, max_fold)
+        #: INLINE jobs currently executing (disabled scheduler /
+        #: nested dispatcher submits — they never touch the queue, so
+        #: the queue's in-service count cannot see them): the drain
+        #: path's quiesce() reads it alongside queue.idle()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._time = time_fn or _time.time
         self.queue = AdmissionQueue(self.policy, self._time)
         self.stats = SchedulerStats(self._time)
@@ -160,13 +174,17 @@ class DeviceTimeScheduler:
                 or threading.current_thread() is self._thread):
             t0 = self._time()
             failed = True
+            with self._inflight_lock:
+                self._inflight += 1
             try:
-                with runtime.mesh_token_scope(self.mesh_token), \
+                with runtime.mesh_token_scope(self._current_mesh_token()), \
                         runtime.gateway():
                     result = job.run()
                 failed = False
                 return result
             finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
                 self.stats.record_done(self._time() - t0, failed)
         try:
             ticket, created = self.queue.offer(job)
@@ -191,6 +209,14 @@ class DeviceTimeScheduler:
                                   klass=job.klass.name)
         runtime.notify_submission(ticket)
         return ticket.wait(timeout)
+
+    def _current_mesh_token(self):
+        """The LIVE mesh token for the next job: the supervisor's
+        (survivor span after any shrink/recovery) when one is attached,
+        else the static construction-time token."""
+        if self.mesh_supervisor is not None:
+            return self.mesh_supervisor.current_token()
+        return self.mesh_token
 
     def _ensure_dispatcher(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -267,10 +293,14 @@ class DeviceTimeScheduler:
                     e.best_klass, now - e.enqueued_at) for e in entries)
                 return self.queue.has_effective_better_than(running)
         t0 = self._time()
+        # every taken entry must be settled exactly once: requeued
+        # entries settle inside queue.requeue (atomically with the
+        # re-add), everything else through done_serving in the finally
+        served = len(entries)
         try:
             faults.inject("sched.dispatch")
-            with runtime.mesh_token_scope(self.mesh_token), \
-                    runtime.gateway(check), \
+            with runtime.mesh_token_scope(self._current_mesh_token()), \
+                    runtime.gateway(check, async_dispatch=True), \
                     obs_trace.activate(lead_trace):
                 with obs_trace.span("sched.dispatch", klass=best.name,
                                     label=job.label,
@@ -285,25 +315,41 @@ class DeviceTimeScheduler:
                                 f"jobs")
                     else:
                         results = [job.run()]
-        except runtime.SolvePreempted:
+        except runtime.SolvePreempted as preempted:
             # the yielded segments really ran on the device: count them
             # busy (occupancy must not read idle under preemption
             # thrash), but not as a latency sample
+            from cruise_control_tpu.parallel.health import \
+                MeshRecoveryRequeue
+            mesh_requeue = isinstance(preempted, MeshRecoveryRequeue)
             self.stats.record_preempted(len(entries),
                                         busy_s=self._time() - t0)
-            self._mark("sched-preemptions", len(entries))
+            if mesh_requeue:
+                # not a preemption: the mesh supervisor shrank the span
+                # under this solve (watchdog fire / collective failure)
+                # and released the dispatch thread — the SAME requeue
+                # machinery redispatches the job on the survivor span
+                self._mark("sched-mesh-requeues", len(entries))
+                LOG.warning("mesh recovery released %s job %r; "
+                            "re-queued onto the shrunk span",
+                            best.name, job.label)
+            else:
+                self._mark("sched-preemptions", len(entries))
+                LOG.info("preempted %s job %r at a segment boundary "
+                         "(%d queued above it); re-queued",
+                         best.name, job.label, self.queue.depth())
             for e in entries:
                 tc = getattr(e.job, "trace", None)
                 if tc is not None:
-                    tc.trace.mark("preempted")
+                    tc.trace.mark("degraded" if mesh_requeue
+                                  else "preempted")
                 obs_trace.record_span("sched.preempted", t0,
                                       self._time(), ctx=tc,
-                                      klass=e.best_klass.name)
-            LOG.info("preempted %s job %r at a segment boundary "
-                     "(%d queued above it); re-queued",
-                     best.name, job.label, self.queue.depth())
+                                      klass=e.best_klass.name,
+                                      meshRequeue=mesh_requeue)
             for e in entries:
                 self.queue.requeue(e)
+            served = 0
             return
         except BaseException as exc:  # noqa: BLE001 - resolve the waiters
             duration = self._time() - t0
@@ -320,6 +366,8 @@ class DeviceTimeScheduler:
                 self.queue.finish(e)
                 e.ticket.fail(exc)
             return
+        finally:
+            self.queue.done_serving(served)
         duration = self._time() - t0
         self.stats.record_done(duration, failed=False)
         self.queue.observe_latency(duration)
@@ -339,6 +387,29 @@ class DeviceTimeScheduler:
                 e.ticket.fail(result.exc)
             else:
                 e.ticket.resolve(result)
+
+    # ------------------------------------------------------------------
+    def quiesce(self, timeout_s: float, poll_s: float = 0.05) -> bool:
+        """Bounded wait for the scheduler to go idle: no queued jobs
+        and nothing in flight (dispatch thread or inline).  The
+        graceful-drain path calls this AFTER admission has stopped
+        (REST 503-draining), so idleness is terminal.  Wall-clock
+        bounded with real time — a wedged in-flight solve must not
+        hold shutdown hostage (the same rule as the precompute
+        watchdog); returns False when the timeout elapsed first."""
+        import time as _real_time
+        deadline = _real_time.monotonic() + max(0.0, timeout_s)
+        while True:
+            with self._inflight_lock:
+                inline_busy = self._inflight
+            # queue.idle() counts taken-but-unfinished entries under
+            # the queue's own lock, so a job the dispatch loop has
+            # popped but not yet started can never slip past the drain
+            if self.queue.idle() and inline_busy == 0:
+                return True
+            if _real_time.monotonic() >= deadline:
+                return False
+            _real_time.sleep(poll_s)
 
     # ------------------------------------------------------------------
     def stop(self, join_timeout_s: float = 5.0) -> None:
@@ -364,11 +435,14 @@ class DeviceTimeScheduler:
     # ------------------------------------------------------------------
     def to_json(self) -> dict:
         depths = self.queue.depths()
+        live_token = self._current_mesh_token()
         return {
             "enabled": self.enabled,
-            "mesh": (self.mesh_token.to_json()
-                     if self.mesh_token is not None
+            "mesh": (live_token.to_json()
+                     if live_token is not None
                      else {"devices": 1, "axis": None, "platform": None}),
+            **({"meshSupervisor": self.mesh_supervisor.to_json()}
+               if self.mesh_supervisor is not None else {}),
             "policy": self.policy.to_json(),
             "queueDepthByClass": {c.name: d for c, d in depths.items()},
             "queueDepth": sum(depths.values()),

@@ -15,8 +15,19 @@ Scripting surface:
     plan.fail_nth("executor.admin.describe_cluster", (2, 3))
     plan.fail_probability("monitor.sampler.fetch", 0.25)  # seeded RNG
     plan.fail_always("optimizer.compile", until=4)   # calls 1-4 fail
+    plan.hang_nth("mesh.dispatch", 1, release)       # 1st call BLOCKS
     with faults.injected(plan):
         ...
+
+Hangs vs failures: a *failure* raises; a *hang* BLOCKS the calling
+thread — either for a fixed number of seconds or until a
+`threading.Event` the test holds is set.  Hangs simulate the failure
+mode exceptions cannot: a wedged XLA dispatch / stuck collective that
+never returns (the PR-12 mesh-recovery surface).  Production code
+wraps hang-capable sites in the watched-dispatch gateway
+(parallel/health.py), which is exactly what the hang exists to
+exercise: the watchdog must release the dispatch thread while the
+wedged worker thread stays blocked.
 
 Every injected exception is a `FaultError` carrying its `.site`, so the
 degradation ladder's failure classifier can bucket scripted faults by the
@@ -31,6 +42,7 @@ import contextlib
 import dataclasses
 import random
 import threading
+import time as _time
 from typing import Dict, Iterable, Optional, Tuple, Union
 
 #: every site that executed at least one inject() in this process —
@@ -55,6 +67,11 @@ class _SiteRule:
     fail_until: int = 0                      # calls 1..fail_until fail
     probability: float = 0.0
     exc_factory: Optional[object] = None     # callable(site) -> Exception
+    hang_calls: frozenset = frozenset()      # 1-based call numbers
+    hang_until: int = 0                      # calls 1..hang_until hang
+    #: how a triggered hang blocks: float seconds, or a threading.Event
+    #: the test sets to release the wedged thread
+    hang_on: Optional[object] = None
 
 
 class FaultPlan:
@@ -96,6 +113,38 @@ class FaultPlan:
             rule.exc_factory = exc_factory
         return self
 
+    def hang_nth(self, site: str, nth: Union[int, Iterable[int]],
+                 hang_on) -> "FaultPlan":
+        """HANG the nth call (1-based), or each call in an iterable:
+        the calling thread blocks for `hang_on` seconds (float) or
+        until `hang_on` (a threading.Event) is set.  This is the
+        chip-loss / wedged-collective injection: the call never raises
+        — it simply does not return in time."""
+        calls = frozenset((nth,) if isinstance(nth, int) else nth)
+        rule = self._rule(site)
+        rule.hang_calls = rule.hang_calls | calls
+        rule.hang_on = hang_on
+        return self
+
+    def hang_always(self, site: str, hang_on,
+                    until: Optional[int] = None) -> "FaultPlan":
+        """Hang every call, or calls 1..until when `until` is given."""
+        rule = self._rule(site)
+        rule.hang_until = (2 ** 31 if until is None else int(until))
+        rule.hang_on = hang_on
+        return self
+
+    def should_hang(self, site: str, call_number: int):
+        """The hang spec (seconds or Event) when this call hangs, else
+        None."""
+        rule = self._rules.get(site)
+        if rule is None or rule.hang_on is None:
+            return None
+        if (call_number in rule.hang_calls
+                or call_number <= rule.hang_until):
+            return rule.hang_on
+        return None
+
     def should_fail(self, site: str, call_number: int) -> bool:
         rule = self._rules.get(site)
         if rule is None:
@@ -120,16 +169,27 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._calls: Dict[str, int] = {}
         self._failures: Dict[str, int] = {}
+        self._hangs: Dict[str, int] = {}
 
     def fire(self, site: str) -> None:
         with self._lock:
             n = self._calls.get(site, 0) + 1
             self._calls[site] = n
             fail = self._plan.should_fail(site, n)
+            hang = None if fail else self._plan.should_hang(site, n)
             if fail:
                 self._failures[site] = self._failures.get(site, 0) + 1
+            elif hang is not None:
+                self._hangs[site] = self._hangs.get(site, 0) + 1
         if fail:
             raise self._plan.exception_for(site)
+        if hang is not None:
+            # block OUTSIDE the lock: the wedged thread must not stop
+            # other sites (or this site's counters) from firing
+            if isinstance(hang, (int, float)):
+                _time.sleep(float(hang))
+            else:
+                hang.wait()
 
     def call_count(self, site: str) -> int:
         with self._lock:
@@ -138,6 +198,10 @@ class FaultInjector:
     def failure_count(self, site: str) -> int:
         with self._lock:
             return self._failures.get(site, 0)
+
+    def hang_count(self, site: str) -> int:
+        with self._lock:
+            return self._hangs.get(site, 0)
 
     def counts(self) -> Dict[str, Tuple[int, int]]:
         """{site: (calls, failures)} for every site that fired."""

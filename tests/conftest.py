@@ -81,4 +81,11 @@ def _relieve_xla_process_pressure():
         _opt._SHARED_LRU.clear()
         _opt._SHARED_AOT.clear()
     jax.clear_caches()
+    # disarm the watched-dispatch watchdog and clear its executable
+    # quarantine at each module boundary: a module that armed it
+    # (test_meshhealth, chaos drills) must not leave the process-wide
+    # switch set for unrelated modules' byte-identical pins
+    from cruise_control_tpu.parallel import health as _health
+    _health.configure_watchdog(enabled=False, deadline_ms=0.0)
+    _health.clear_quarantine()
     yield

@@ -200,6 +200,91 @@ class TestRetry429:
         assert args.max_retries == 4
 
 
+class TestRetry503Draining:
+    """Graceful-drain handling (PR-12 satellite): a 503 WITH a
+    Retry-After hint (the api/server drain signature) gets the exact
+    429 treatment — capped backoff, deterministic jitter, resubmit
+    against the replacement process.  A bare 503 stays a hard error."""
+
+    make_client = TestRetry429.make_client
+
+    def test_503_draining_retries_like_429(self):
+        sleeps = []
+        draining = (503, {"Retry-After": "5"},
+                    {"errorMessage": "ServerDraining: shutting down",
+                     "retryAfterSeconds": 5, "version": 1})
+        ok = (200, {}, {"version": 1, "summary": {}})
+        client, calls = self.make_client([draining, draining, ok], sleeps)
+        out = client.request("REBALANCE")
+        assert out["version"] == 1
+        assert len(calls) == 3
+        assert len(sleeps) == 2
+        # Retry-After floors the backoff, jittered upward — the same
+        # contract the 429 path pins
+        for delay in sleeps:
+            assert 5.0 <= delay < 5.0 * 1.5
+
+    def test_503_draining_body_hint_suffices(self):
+        sleeps = []
+        draining = (503, {}, {"errorMessage": "ServerDraining",
+                              "retryAfterSeconds": 3, "version": 1})
+        ok = (200, {}, {"version": 1})
+        client, calls = self.make_client([draining, ok], sleeps)
+        assert client.request("PROPOSALS")["version"] == 1
+        assert len(calls) == 2 and len(sleeps) == 1
+
+    def test_bare_503_is_a_hard_error(self):
+        """No Retry-After hint = not draining (e.g. a fleet tenant
+        drained for good): retrying blind would hammer a server that
+        never asked for patience."""
+        sleeps = []
+        hard = (503, {}, {"errorMessage": "TenantDrainingError: gone",
+                          "version": 1})
+        client, calls = self.make_client([hard], sleeps)
+        with pytest.raises(CruiseControlClientError) as err:
+            client.request("REBALANCE")
+        assert err.value.status == 503
+        assert len(calls) == 1 and not sleeps
+
+    def test_503_draining_gives_up_after_max_retries(self):
+        sleeps = []
+        draining = (503, {"Retry-After": "1"},
+                    {"errorMessage": "ServerDraining",
+                     "retryAfterSeconds": 1, "version": 1})
+        client, calls = self.make_client([draining], sleeps)
+        client._max_retries_429 = 2
+        with pytest.raises(CruiseControlClientError) as err:
+            client.request("PROPOSALS")
+        assert err.value.status == 503
+        assert len(calls) == 3
+
+
+class TestServerDrain:
+    """The REST half of graceful shutdown: app.drain() turns every
+    mutating endpoint into 503 + Retry-After while reads keep
+    answering (operators watch the drain through STATE)."""
+
+    def test_drain_rejects_writes_keeps_reads(self, live_server):
+        _, cc, _url = live_server
+        app = CruiseControlApp(cc, async_response_timeout_s=5.0)
+        # serving normally: writes admitted
+        status, _, _ = app.handle_request(
+            "POST", "/kafkacruisecontrol/rebalance", "dryrun=true")
+        assert status in (200, 202)
+        app.drain(retry_after_s=17)
+        assert app.draining
+        status, headers, body = app.handle_request(
+            "POST", "/kafkacruisecontrol/rebalance", "dryrun=true")
+        assert status == 503
+        assert headers["Retry-After"] == "17"
+        assert body["retryAfterSeconds"] == 17
+        assert "ServerDraining" in body["errorMessage"]
+        # reads still serve (operators watch the drain through STATE)
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/state", "")
+        assert status == 200 and body
+
+
 class TestClusterFlag:
     """Fleet tenancy from the client side: `--cluster` threads
     `cluster=<id>` through every subcommand, and an unknown tenant's

@@ -1,0 +1,478 @@
+"""Elastic mesh recovery (`multichip` + `chaos` markers).
+
+PR 12: the serving loop must ride through chip loss and wedged
+collectives without a process bounce (parallel/health.py).  On the
+virtual 8-device CPU rig these tests pin:
+
+* the WATCHDOG: a scripted hang at the watched-dispatch fault site
+  wedges the worker thread, the dispatch thread gets
+  DispatchWedgedError within mesh.watchdog.ms, the executable is
+  quarantined and the worker replaced;
+* the SPAN LADDER: a wedge or collective failure shrinks
+  MESH8→MESH4→MESH2→FUSED; a condemned chip is excluded from the
+  rebuilt token (and therefore from scenario lanes); probe recovery
+  climbs back one rung per probe cycle when the chip returns;
+* the ACCEPTANCE pin: with a collective hang injected, the scheduler
+  dispatch thread is released, the job re-queues (PR-4 machinery), the
+  solve completes on the shrunk span with proposals byte-equal a clean
+  mesh-4 twin, and a MESH_DEGRADATION anomaly is emitted;
+* the PROGCACHE pin (slow): a span shrink with `@meshN` entries on
+  disk is hydrate-only — zero source compiles.
+"""
+import threading
+import time as _real_time
+
+import conftest  # noqa: F401
+
+import jax
+import pytest
+
+from cruise_control_tpu.core.anomaly import AnomalyType
+from cruise_control_tpu.detector.notifier import (AnomalyNotifier,
+                                                  NotificationAction)
+from cruise_control_tpu.parallel import health
+from cruise_control_tpu.parallel.mesh import MeshToken, make_mesh
+from cruise_control_tpu.sched.scheduler import DeviceTimeScheduler
+from cruise_control_tpu.utils import faults
+
+from test_facade import feed_samples, make_stack
+
+pytestmark = [
+    pytest.mark.multichip,
+    pytest.mark.chaos,
+    pytest.mark.skipif(len(jax.devices()) < 8,
+                       reason="needs the 8-device CPU mesh"),
+]
+
+MESH_GOALS = ["RackAwareGoal", "DiskCapacityGoal"]
+
+
+class RecordingNotifier(AnomalyNotifier):
+    def __init__(self):
+        self.anomalies = []
+
+    def on_anomaly(self, anomaly):
+        self.anomalies.append(anomaly)
+        return NotificationAction.ignore()
+
+    def self_healing_enabled(self):
+        return {}
+
+
+def proposal_key(p):
+    return (p.partition.topic, p.partition.partition,
+            tuple(r.broker_id for r in p.old_replicas),
+            tuple(r.broker_id for r in p.new_replicas))
+
+
+def forced_token(n=8):
+    return MeshToken(make_mesh(jax.devices()[:n]))
+
+
+def mesh_anomalies(cc, notifier):
+    cc.anomaly_detector.process_all()
+    return [a for a in notifier.anomalies
+            if a.anomaly_type is AnomalyType.MESH_DEGRADATION]
+
+
+# ---------------------------------------------------------------------------
+# units: span ladder + watchdog + supervisor
+# ---------------------------------------------------------------------------
+
+def test_span_ladder():
+    assert health.span_ladder(8) == [8, 4, 2, 1]
+    assert health.span_ladder(8, min_devices=4) == [8, 4, 1]
+    assert health.span_ladder(8, min_devices=3) == [8, 4, 1]
+    assert health.span_ladder(1) == [1]
+    assert health.span_ladder(5) == [5, 2, 1]
+
+
+def test_faults_hang_site():
+    release = threading.Event()
+    plan = faults.FaultPlan().hang_nth("unit.hang", 1, release)
+    done = []
+    with faults.injected(plan) as injector:
+        t = threading.Thread(
+            target=lambda: (faults.inject("unit.hang"), done.append(1)),
+            daemon=True)
+        t.start()
+        t.join(0.3)
+        assert t.is_alive() and not done      # wedged on the event
+        assert injector.hang_count("unit.hang") == 1
+        release.set()
+        t.join(2.0)
+        assert done                           # released
+        faults.inject("unit.hang")            # 2nd call: no hang
+
+
+def test_watchdog_releases_wedged_dispatch():
+    release = threading.Event()
+    fires0 = health.watchdog_fires()
+    plan = faults.FaultPlan().hang_nth("mesh.dispatch", 1, release)
+    try:
+        with health.watchdog_armed(250), faults.injected(plan):
+            t0 = _real_time.monotonic()
+            with pytest.raises(health.DispatchWedgedError):
+                health.watched_call(lambda: 1, program="__pre__@mesh8")
+            waited = _real_time.monotonic() - t0
+            # released within the deadline (generous slack for CI)
+            assert waited < 2.0
+            assert health.watchdog_fires() == fires0 + 1
+            assert health.is_quarantined("__pre__@mesh8")
+            # a quarantined program is refused BEFORE dispatch
+            with pytest.raises(health.DispatchWedgedError):
+                health.watched_call(lambda: 1, program="__pre__@mesh8")
+            # the replacement worker serves other programs immediately
+            assert health.watched_call(lambda: 41 + 1,
+                                       program="__post__") == 42
+    finally:
+        release.set()
+        health.clear_quarantine()
+
+
+def test_watchdog_disarmed_is_plain_call():
+    health.configure_watchdog(enabled=False, deadline_ms=0.0)
+    assert health.watched_call(lambda: "ok") == "ok"
+
+
+def test_supervisor_wedge_shrink_and_gated_recovery():
+    clock = {"now": 1000.0}
+    sup = health.MeshSupervisor(
+        forced_token(8), watchdog_ms=500.0, probe_interval_ms=10_000.0,
+        time_fn=lambda: clock["now"])
+    assert sup.span == 8 and sup.current_token().size == 8
+    summary = sup.handle_wedge("__pre__@mesh8")
+    assert summary["fromSpan"] == 8 and summary["toSpan"] == 4
+    assert sup.span == 4 and sup.current_token().size == 4
+    assert sup.shrinks == 1
+    # recovery is probe-interval gated: same instant -> no climb
+    assert not sup.maybe_recover()
+    clock["now"] += 11.0
+    assert sup.maybe_recover()
+    assert sup.span == 8 and sup.recoveries == 1
+    # healthy at full span: nothing to do
+    assert not sup.maybe_recover()
+
+
+def test_supervisor_condemns_probed_dead_chip():
+    clock = {"now": 1000.0}
+    sup = health.MeshSupervisor(
+        forced_token(8), watchdog_ms=500.0, probe_interval_ms=0.0,
+        time_fn=lambda: clock["now"])
+    dead = jax.devices()[5].id
+    plan = faults.FaultPlan().fail_always(f"mesh.probe.dev{dead}")
+    with faults.injected(plan):
+        summary = sup.handle_collective_failure()
+        assert summary["condemned"] == [dead]
+        assert sup.span == 4 and sup.probe_failures == 1
+        token = sup.current_token()
+        assert dead not in [d.id for d in token.mesh.devices.flat]
+        # chip still dead: probes re-run but the span cannot climb
+        clock["now"] += 1.0
+        assert not sup.maybe_recover()
+        assert sup.condemned == [dead]
+    # chip returns: one probe cycle climbs one rung back to full span
+    clock["now"] += 1.0
+    assert sup.maybe_recover()
+    assert sup.span == 8 and sup.condemned == []
+
+
+def test_supervisor_transient_failure_keeps_span():
+    """A collective FAILURE whose probe sweep condemns nothing is
+    transient (or not mesh material): the supervisor declines, keeping
+    the full span — the classic ladder's retry-with-backoff handles it
+    instead of degrading capacity for nothing."""
+    sup = health.MeshSupervisor(forced_token(8), probe_interval_ms=0.0,
+                                time_fn=lambda: 1000.0)
+    assert sup.handle_collective_failure() is None
+    assert sup.span == 8 and sup.shrinks == 0
+
+
+def test_supervisor_span_always_matches_a_ladder_width():
+    """Mass condemnation during a RECOVERY probe must step the span
+    down to a ladder width the survivors can fill — never a token
+    narrower than the reported span (a width-3 mesh has no @mesh3
+    programs anywhere)."""
+    clock = {"now": 1000.0}
+    sup = health.MeshSupervisor(
+        forced_token(8), probe_interval_ms=0.0,
+        time_fn=lambda: clock["now"])
+    sup.handle_wedge("__pre__@mesh8")
+    assert sup.span == 4
+    dead = [d.id for d in jax.devices()[:5]]
+    plan = faults.FaultPlan()
+    for i in dead:
+        plan.fail_always(f"mesh.probe.dev{i}")
+    with faults.injected(plan):
+        clock["now"] += 1.0
+        assert not sup.maybe_recover()       # 3 survivors: no climb
+    # ...but the span/token pair stayed consistent: 4 -> 2 (the
+    # largest ladder width three healthy chips can fill)
+    assert sup.span == 2
+    assert sup.current_token().size == 2
+    assert sorted(sup.condemned) == sorted(dead)
+
+
+def test_supervisor_disabled_is_manual_override():
+    sup = health.MeshSupervisor(forced_token(8), enabled=False)
+    assert sup.handle_wedge("x") is None
+    assert sup.handle_collective_failure() is None
+    assert not sup.maybe_recover()
+    assert sup.span == 8
+
+
+def test_shared_scheduler_supervisor_governs_fleet_tenants():
+    """The fleet half of the condemned-device exclusion pin: ONE
+    supervisor wraps the SHARED scheduler's token (main.build_fleet),
+    every dispatch — and therefore every cross-tenant fold — resolves
+    through it, and a tenant facade handed the shared scheduler adopts
+    the same supervisor instead of building its own."""
+    dead = jax.devices()[3].id
+    sup = health.MeshSupervisor(forced_token(8), probe_interval_ms=1e12)
+    sched = DeviceTimeScheduler(enabled=True, mesh_token=forced_token(8),
+                                mesh_supervisor=sup)
+    try:
+        with faults.injected(
+                faults.FaultPlan().fail_always(f"mesh.probe.dev{dead}")):
+            assert sup.handle_collective_failure() is not None
+        live = sched._current_mesh_token()
+        assert live.size == 4
+        assert dead not in [d.id for d in live.mesh.devices.flat]
+        assert sched.to_json()["meshSupervisor"]["condemnedDevices"] \
+            == [dead]
+        sim, cc, clock = make_stack(solve_scheduler=sched)
+        try:
+            assert cc.mesh_supervisor is sup
+        finally:
+            cc.shutdown()
+    finally:
+        sched.stop()
+
+
+def test_scheduler_quiesce_idle_and_busy():
+    sched = DeviceTimeScheduler(enabled=True)
+    assert sched.quiesce(1.0)
+    from cruise_control_tpu.sched.scheduler import SolveJob
+    from cruise_control_tpu.sched.policy import SchedulerClass
+    release = threading.Event()
+    t = threading.Thread(
+        target=lambda: sched.submit(SolveJob(
+            klass=SchedulerClass.USER_INTERACTIVE,
+            run=lambda: release.wait(10.0))),
+        daemon=True)
+    t.start()
+    deadline = _real_time.monotonic() + 5.0
+    while sched.quiesce(0.0) and _real_time.monotonic() < deadline:
+        _real_time.sleep(0.01)       # wait for the job to be picked up
+    assert not sched.quiesce(0.2)    # busy: bounded wait returns False
+    release.set()
+    assert sched.quiesce(5.0)        # drains back to idle
+    sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# integration: the acceptance pin
+# ---------------------------------------------------------------------------
+
+def test_collective_hang_recovers_on_shrunk_span():
+    """THE chaos pin: a collective hang wedges the first mesh-8
+    dispatch; the watchdog releases the dispatch thread within
+    mesh.watchdog.ms, the job re-queues, and the solve completes on
+    the shrunk 4-chip span WITHOUT a process restart — proposals
+    byte-equal a clean mesh-4 twin, MESH_DEGRADATION anomaly emitted,
+    flight-recorder dump taken."""
+    notifier = RecordingNotifier()
+    sim, cc, clock = make_stack(
+        goal_names=MESH_GOALS, notifier=notifier,
+        mesh_enabled=True, auto_warmup=True,
+        mesh_watchdog_ms=1500.0, mesh_probe_interval_ms=1e12)
+    sim4, cc4, clock4 = make_stack(goal_names=MESH_GOALS,
+                                   mesh_enabled=True, mesh_max_devices=4)
+    release = threading.Event()
+    fires0 = health.watchdog_fires()
+    try:
+        feed_samples(cc, clock)
+        feed_samples(cc4, clock4)
+        plan = faults.FaultPlan().hang_nth("mesh.dispatch", 1, release)
+        with faults.injected(plan) as injector:
+            result = cc.optimizations()
+        assert injector.hang_count("mesh.dispatch") == 1
+        # the dispatch thread was released by the watchdog, not by the
+        # hang clearing (the wedged worker is still blocked right now)
+        assert not release.is_set()
+        assert health.watchdog_fires() == fires0 + 1
+        assert health.last_fire_wait_s() < 1.5 * 3
+        # span shrank 8 -> 4 and the job re-queued through the PR-4
+        # machinery (aging intact) instead of failing
+        sup = cc.mesh_supervisor
+        assert sup is not None and sup.span == 4 and sup.shrinks == 1
+        assert result.mesh_devices == 4
+        requeues = cc.metrics.meter("sched-mesh-requeues").to_json()
+        assert requeues["count"] == 1
+        # byte-equal a clean mesh-4 twin
+        twin = cc4.optimizations()
+        assert twin.mesh_devices == 4
+        assert sorted(map(proposal_key, result.proposals)) == \
+            sorted(map(proposal_key, twin.proposals))
+        # the incident self-reported: MESH_DEGRADATION through the
+        # notifier plane, wedge evidence attached
+        found = mesh_anomalies(cc, notifier)
+        assert found and found[0].watchdog_fired
+        assert found[0].from_span == 8 and found[0].to_span == 4
+        # the solver breaker did NOT open: a chip problem is mesh
+        # material, not solver material
+        assert cc.solver_breaker.consecutive_failures == 0
+        # probe recovery: chips are healthy (the hang was transient) —
+        # one probe cycle climbs back toward the full span
+        sup.probe_interval_ms = 0.0
+        clock["now"] += 60.0
+        again = cc.optimizations(ignore_proposal_cache=True)
+        assert sup.span == 8
+        assert again.mesh_devices == 8
+        assert sorted(map(proposal_key, again.proposals)) == \
+            sorted(map(proposal_key, twin.proposals))
+    finally:
+        release.set()
+        health.clear_quarantine()
+        cc.shutdown()
+        cc4.shutdown()
+
+
+def test_chip_loss_condemns_and_excludes_device():
+    """Chip loss: a mesh-rung collective FAILURE triggers a probe
+    sweep; the dead chip is condemned, the token is rebuilt over
+    survivors (scenario lanes and folds shard over the shrunk span),
+    and recovery waits until the chip actually answers probes again."""
+    notifier = RecordingNotifier()
+    sim, cc, clock = make_stack(goal_names=MESH_GOALS, notifier=notifier,
+                                mesh_enabled=True,
+                                mesh_probe_interval_ms=1e12)
+    dead = jax.devices()[5].id
+    try:
+        feed_samples(cc, clock)
+        plan = (faults.FaultPlan()
+                .fail_always(f"mesh.probe.dev{dead}")
+                .fail_nth("optimizer.mesh", 1))
+        with faults.injected(plan):
+            result = cc.optimizations()
+            sup = cc.mesh_supervisor
+            assert sup.span == 4 and sup.condemned == [dead]
+            assert result.mesh_devices == 4
+            token = sup.current_token()
+            assert dead not in [d.id for d in token.mesh.devices.flat]
+            found = mesh_anomalies(cc, notifier)
+            assert found and not found[0].watchdog_fired
+            assert found[0].condemned_devices == [dead]
+            # scenario lanes re-shard over the survivor span: a sweep
+            # against the shrunk token completes and never touches the
+            # condemned chip
+            from cruise_control_tpu.scenario.spec import ScenarioSpec
+            batch = cc.evaluate_scenarios(
+                [ScenarioSpec(name="whatif", load_scale={"disk": 1.2})])
+            assert all(o.feasible is not None for o in batch.outcomes)
+            assert sup.condemned == [dead]
+        # the chip returns: probe recovery climbs back and clears the
+        # condemnation
+        sup.probe_interval_ms = 0.0
+        clock["now"] += 60.0
+        again = cc.optimizations(ignore_proposal_cache=True)
+        assert sup.span == 8 and sup.condemned == []
+        assert again.mesh_devices == 8
+    finally:
+        health.clear_quarantine()
+        cc.shutdown()
+
+
+@pytest.mark.slow
+def test_shrink_hydrates_from_progcache_zero_source_compiles(tmp_path):
+    """The coldstart-style pin for span shrink: with `@mesh8` AND
+    `@mesh4` entries in the persistent program cache, a wedge-driven
+    shrink is HYDRATE-ONLY — the whole wedge→shrink→re-solve cycle
+    performs zero source compiles."""
+    from cruise_control_tpu.analyzer import optimizer as opt_mod
+    from cruise_control_tpu.parallel import progcache
+
+    cache_kw = dict(progcache_enabled=True, progcache_dir=str(tmp_path),
+                    goal_names=MESH_GOALS, mesh_enabled=True,
+                    auto_warmup=True)
+    # populate: one process-life at mesh8, one at mesh4
+    for extra in (dict(), dict(mesh_max_devices=4)):
+        sim, cc, clock = make_stack(**cache_kw, **extra)
+        feed_samples(cc, clock)
+        cc.optimizations()
+        cc.shutdown()
+    # simulated restart: drop every in-memory executable
+    with opt_mod._SHARED_LOCK:
+        opt_mod._SHARED_PROGRAMS.clear()
+        opt_mod._SHARED_LRU.clear()
+        opt_mod._SHARED_AOT.clear()
+    jax.clear_caches()
+    pc = progcache.get_cache()
+    pc.reset_counters()
+
+    sim, cc, clock = make_stack(**cache_kw, mesh_watchdog_ms=1500.0,
+                                mesh_probe_interval_ms=1e12)
+    release = threading.Event()
+    try:
+        feed_samples(cc, clock)
+        plan = faults.FaultPlan().hang_nth("mesh.dispatch", 1, release)
+        with faults.injected(plan):
+            result = cc.optimizations()
+        assert cc.mesh_supervisor.span == 4
+        assert result.mesh_devices == 4
+        # hydrate-only: warmup AND the post-shrink mesh-4 programs all
+        # came from disk — zero source compiles in this whole process
+        assert pc.fresh_compiles == 0, pc.stats()
+        assert pc.hits > 0
+    finally:
+        release.set()
+        health.clear_quarantine()
+        cc.shutdown()
+
+
+def test_progcache_flush_sweeps_nested_orphans(tmp_path):
+    """The drain path's cache flush must find temp files where
+    _atomic_write actually leaves them — inside the nested
+    <fingerprint>/<goal_sig>/ entry directories, not the cache root."""
+    from cruise_control_tpu.parallel import progcache
+    pc = progcache.get_cache()
+    prev_enabled, prev_dir = pc.enabled, pc.cache_dir
+    nested = tmp_path / "fp0" / "gs0"
+    nested.mkdir(parents=True)
+    (nested / ".tmp-dead~").write_bytes(b"orphan")
+    (nested / "entry.stablehlo").write_bytes(b"keep")
+    try:
+        pc.configure(enabled=True, cache_dir=str(tmp_path))
+        assert pc.flush() == 1
+        assert not (nested / ".tmp-dead~").exists()
+        assert (nested / "entry.stablehlo").exists()
+    finally:
+        pc.configure(enabled=prev_enabled, cache_dir=prev_dir or "")
+
+
+# ---------------------------------------------------------------------------
+# lint rule
+# ---------------------------------------------------------------------------
+
+def test_watchdog_gateway_lint_rule(tmp_path):
+    import ast
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    bad = ("def _run(self, key, fn, *args):\n"
+           "    aot = self._aot.get(key)\n"
+           "    return aot(*args)\n")
+    good = ("def _run(self, key, fn, *args):\n"
+            "    aot = self._aot.get(key)\n"
+            "    return health.watched_call(lambda: aot(*args),\n"
+            "                               program=key)\n")
+    p = Path("cruise_control_tpu/analyzer/optimizer.py")
+    assert lint._watchdog_violations(p, ast.parse(bad))
+    assert not lint._watchdog_violations(p, ast.parse(good))
+    # outside the exec files the rule does not apply
+    other = Path("cruise_control_tpu/facade.py")
+    assert not lint._watchdog_violations(other, ast.parse(bad))

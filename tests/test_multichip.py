@@ -169,11 +169,16 @@ def test_k1_scheduled_vs_inline_byte_identical_under_mesh():
 
 
 def test_mesh_ladder_descends_to_fused_without_breaker_trip():
-    """A collective/runtime failure on the mesh path descends MESH →
-    FUSED (single-chip fused solve serves the request) WITHOUT tripping
-    the breaker past FUSED; once the mesh heals, the next solve probes
-    one rung up and service returns to MESH."""
-    sim, cc, clock = make_stack(mesh_enabled=True)
+    """Under the MANUAL OVERRIDE (mesh.recovery.enabled=false — the
+    pre-PR-12 behavior, kept as the operator runbook's escape hatch): a
+    collective/runtime failure on the mesh path descends MESH → FUSED
+    (single-chip fused solve serves the request) WITHOUT tripping the
+    breaker past FUSED; once the mesh heals, the next solve probes one
+    rung up and service returns to MESH.  With recovery ENABLED the
+    mesh supervisor absorbs the failure via the span ladder instead —
+    pinned in tests/test_meshhealth.py."""
+    sim, cc, clock = make_stack(mesh_enabled=True,
+                                mesh_recovery_enabled=False)
     try:
         cc.start_up(do_sampling=False, start_detection=False)
         feed_samples(cc, clock)

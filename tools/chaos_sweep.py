@@ -9,6 +9,11 @@ rungs visited, breaker transitions, anomalies emitted, quarantine
 counts.  Exit code 0 = every scenario behaved; 1 = a scenario deviated.
 
 Usage: JAX_PLATFORMS=cpu python tools/chaos_sweep.py [--json]
+       JAX_PLATFORMS=cpu python tools/chaos_sweep.py --drill mesh [--json]
+
+`--drill mesh` runs the PR-12 elastic-mesh drill on the virtual 8-CPU
+mesh: condemn a chip mid-solve, assert span shrink + recovery without a
+process bounce, and print time-to-first-good-solve.
 """
 from __future__ import annotations
 
@@ -51,7 +56,7 @@ class _Recorder(AnomalyNotifier):
         return {}
 
 
-def build_stack(num_brokers=4, partitions=12):
+def build_stack(num_brokers=4, partitions=12, **cc_kwargs):
     sim = SimulatedCluster()
     clock = {"now": 10_000.0}
     for b in range(num_brokers):
@@ -74,7 +79,7 @@ def build_stack(num_brokers=4, partitions=12):
         executor_kwargs=dict(progress_check_interval_s=1.0),
         auto_warmup=False,
         solver_breaker_cooldown_s=50.0,
-        goal_names=GOALS)
+        goal_names=GOALS, **cc_kwargs)
     cc.start_up(do_sampling=False, start_detection=False)
     return sim, cc, clock, notifier
 
@@ -184,14 +189,74 @@ def scenario_retry_bit_for_bit():
             "proposals": len(bp), "retries": retries}
 
 
+def scenario_mesh_drill():
+    """Operator mesh drill (`--drill mesh`): condemn a chip mid-solve
+    on the virtual 8-CPU mesh, assert the supervisor shrinks the span
+    and completes the solve without a restart, report time-to-first-
+    good-solve, then prove probe recovery climbs back once the chip
+    answers again.  The operational counterpart of
+    tests/test_meshhealth.py — run it against the CURRENT build before
+    trusting mesh.recovery.enabled in production."""
+    import time as _real_time
+    from cruise_control_tpu.parallel import health
+    from cruise_control_tpu.testing.virtual_mesh import force_cpu_devices
+    force_cpu_devices(8)
+    import jax
+    dead = jax.devices()[5].id
+    sim, cc, clock, notifier = build_stack(
+        num_brokers=6,
+        mesh_enabled=True, mesh_watchdog_ms=30_000.0,
+        mesh_probe_interval_ms=1e12)
+    try:
+        feed(cc, clock)
+        plan = (faults.FaultPlan()
+                .fail_always(f"mesh.probe.dev{dead}")
+                .fail_nth("optimizer.mesh", 1))
+        t0 = _real_time.monotonic()
+        with faults.injected(plan):
+            result = cc.optimizations()
+        recovery_s = _real_time.monotonic() - t0
+        sup = cc.mesh_supervisor
+        shrunk_ok = (sup is not None and sup.span == 4
+                     and sup.condemned == [dead]
+                     and result.mesh_devices == 4
+                     and len(result.proposals) > 0)
+        cc.anomaly_detector.process_all()
+        from cruise_control_tpu.detector.anomalies import MeshDegraded
+        anomalies = [str(a) for a in notifier.anomalies
+                     if isinstance(a, MeshDegraded)]
+        # the chip comes back: one probe cycle climbs the span home
+        sup.probe_interval_ms = 0.0
+        clock["now"] += 60.0
+        again = cc.optimizations(ignore_proposal_cache=True)
+        recovered = (sup.span == 8 and sup.condemned == []
+                     and again.mesh_devices == 8)
+        return {"scenario": "mesh-drill",
+                "ok": shrunk_ok and recovered and len(anomalies) >= 1,
+                "condemned": [dead], "spanPath": [8, 4, 8],
+                "timeToFirstGoodSolveS": round(recovery_s, 3),
+                "anomalies": anomalies}
+    finally:
+        cc.shutdown()
+
+
 SCENARIOS = [scenario_quarantine, scenario_ladder_descent_and_recovery,
              scenario_retry_bit_for_bit]
 
 
 def main(argv) -> int:
     as_json = "--json" in argv
+    scenarios = list(SCENARIOS)
+    if "--drill" in argv:
+        which = argv[argv.index("--drill") + 1] \
+            if argv.index("--drill") + 1 < len(argv) else ""
+        if which != "mesh":
+            print(f"unknown drill {which!r}; valid: mesh",
+                  file=sys.stderr)
+            return 2
+        scenarios = [scenario_mesh_drill]
     results = []
-    for fn in SCENARIOS:
+    for fn in scenarios:
         try:
             results.append(fn())
         except Exception as exc:  # noqa: BLE001 - a crash fails the sweep

@@ -29,6 +29,10 @@ Checks:
     optimizer/engine compile gateways — a compile that bypasses the
     gateway is invisible to the persistent program cache and silently
     re-pays the ~300s cold start (the PR-7 invariant);
+  * watchdog-gateway rule: in the solver execution modules, compiled
+    executables are only invoked inside `health.watched_call(lambda:
+    ...)` — a wedged XLA dispatch must fire the watchdog, never
+    capture the dispatch thread (PR-12 mesh recovery);
   * single-store rule: no direct `*.cluster_model(...)` materialization
     on a LoadMonitor outside facade.py (the `_model_for_solve` /
     `_materialize_solve_inputs` gateway), the device model store
@@ -196,6 +200,10 @@ def _gateway_violations(path: Path, tree: ast.AST) -> list:
 #: (sched/runtime.current_mesh_token) — the mesh half of the
 #: single-gateway invariant.
 _MESH_ALLOWED_RELPATHS = {"facade.py", "main.py", "parallel/mesh.py",
+                          # the mesh supervisor rebuilds the token over
+                          # probe survivors — it IS the token's health
+                          # authority (PR-12 elastic recovery)
+                          "parallel/health.py",
                           "analyzer/optimizer.py", "scenario/engine.py",
                           "testing/virtual_mesh.py"}
 
@@ -252,7 +260,11 @@ _PROGCACHE_ALLOWED_RELPATHS = {"analyzer/optimizer.py",
                                # a handful of tiny scatters (compiles in
                                # ms, LRU'd by jit itself) — not worth a
                                # persistent-cache tier
-                               "model/store.py"}
+                               "model/store.py",
+                               # the health probe's known-answer
+                               # program: a four-float reduction per
+                               # chip, compiled once per process
+                               "parallel/health.py"}
 
 
 def _progcache_violations(path: Path, tree: ast.AST) -> list:
@@ -338,6 +350,52 @@ def _model_store_violations(path: Path, tree: ast.AST) -> list:
                 f"materialization outside the allowed modules "
                 f"({allowed}) — route it through the facade's "
                 f"store-aware gateway (single-store rule)")
+    return findings
+
+
+#: files whose compiled-executable invocations must ride the watched-
+#: dispatch gateway, and the local names those executables are bound to
+#: at their call sites (GoalOptimizer._run's `aot`/`shared`, the
+#: scenario engine's `prog`)
+_WATCHED_EXEC_FILES = {"analyzer/optimizer.py", "scenario/engine.py"}
+_WATCHED_EXEC_NAMES = {"aot", "shared", "prog"}
+
+
+def _watchdog_violations(path: Path, tree: ast.AST) -> list:
+    """Watchdog-gateway rule: in the solver execution modules, every
+    invocation of a compiled executable (the AOT/shared/batched
+    program objects) must happen INSIDE a lambda handed to
+    `health.watched_call` — a bare `aot(*args)` would run on the
+    dispatch thread itself, and a wedged XLA dispatch there captures
+    the thread forever (mesh.watchdog.ms cannot save what never
+    entered the gateway; parallel/health.py)."""
+    parts = path.parts
+    if "cruise_control_tpu" not in parts:
+        return []
+    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
+    rel = "/".join(parts[pkg + 1:])
+    if rel not in _WATCHED_EXEC_FILES:
+        return []
+    covered = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and _call_name(node.func) == "watched_call"):
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    for sub in ast.walk(arg):
+                        covered.add(id(sub))
+    findings = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in _WATCHED_EXEC_NAMES
+                and id(node) not in covered):
+            findings.append(
+                f"{path}:{node.lineno}: compiled-executable call "
+                f"({node.func.id}(...)) outside the watched-dispatch "
+                f"gateway — wrap it in health.watched_call(lambda: "
+                f"...) so a wedged dispatch cannot capture the "
+                f"calling thread (watchdog-gateway rule)")
     return findings
 
 
@@ -523,6 +581,7 @@ def lint_file(path: Path) -> list:
     findings.extend(_mesh_violations(path, tree))
     findings.extend(_progcache_violations(path, tree))
     findings.extend(_model_store_violations(path, tree))
+    findings.extend(_watchdog_violations(path, tree))
     findings.extend(_fleet_mutable_globals(path, tree))
     findings.extend(_trace_violations(path, tree))
 
