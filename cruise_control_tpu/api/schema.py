@@ -102,9 +102,33 @@ KAFKA_CLUSTER_STATE = _obj({
     "version": _INT,
 }, required=["KafkaBrokerState", "KafkaPartitionState", "version"])
 
+#: crash-recovery telemetry inside ExecutorState (executor/journal.py +
+#: recovery.py): present only when journaling is configured or a
+#: recovery ran — journal-less deployments see the pre-journal body
+_EXECUTOR_RECOVERY = _obj({
+    "journalEnabled": _BOOL,
+    "recoveryInProgress": _BOOL,
+    "journal": _obj({
+        "directory": _STR, "broken": _BOOL, "writes": _INT,
+        "bytesWritten": _INT, "errors": _INT,
+    }),
+    "lastRecovery": _obj({
+        "mode": {"enum": ["resume", "abort"]},
+        "uuid": _STR,
+        "resumed": _BOOL,
+        "tasksTotal": _INT, "tasksTerminal": _INT,
+        "tasksAdopted": _INT, "tasksPending": _INT,
+        "clearedThrottleBrokers": _arr(_INT),
+        "cancelledReassignments": _INT,
+        "journalTruncated": _BOOL,
+        "phaseAtCrash": {"type": ["string", "null"]},
+        "recoveredAtMs": _NUM,
+    }),
+}, required=["journalEnabled", "recoveryInProgress"])
+
 STATE = _obj({
     "MonitorState": _obj({}, extra=True),
-    "ExecutorState": _obj({}, extra=True),
+    "ExecutorState": _obj({"recovery": _EXECUTOR_RECOVERY}, extra=True),
     "AnalyzerState": _obj({}, extra=True),
     "AnomalyDetectorState": _obj({}, extra=True),
     "SchedulerState": _obj({}, extra=True),

@@ -15,7 +15,7 @@ from typing import Any, Mapping
 
 from cruise_control_tpu.common.config import (AbstractConfig, ConfigDef,
                                               ConfigException, Importance,
-                                              Type, in_range)
+                                              Type, in_range, in_values)
 
 _H = Importance.HIGH
 _M = Importance.MEDIUM
@@ -70,6 +70,17 @@ def monitor_config_def(d: ConfigDef) -> ConfigDef:
     d.define("partition.sample.retention.ms", Type.LONG, 86_400_000 * 7,
              in_range(min_value=1), _L,
              "Partition-sample retention for stores.")
+    d.define("sample.store.fsync", Type.BOOLEAN, False, None, _L,
+             "fsync the sample-store files on every store call "
+             "(journal-grade deployments): stored samples survive a "
+             "host crash at the cost of one fsync per sampling "
+             "interval.")
+    d.define("sample.store.compaction.interval.ms", Type.LONG, -1, None,
+             _L,
+             "How often the file sample store applies retention ON "
+             "DISK (rewrite-temp-then-rename compaction; without it "
+             "the sample files grow unbounded).  -1 = a quarter of the "
+             "shortest configured retention.")
     d.define("sampling.allow.cpu.capacity.estimation", Type.BOOLEAN, True,
              None, _L, "Allow estimated capacities during sampling.")
     d.define("max.allowed.extrapolations.per.partition", Type.INT, 5,
@@ -574,6 +585,35 @@ def executor_config_def(d: ConfigDef) -> ConfigDef:
     d.define("logdir.response.timeout.ms", Type.LONG, 10_000,
              in_range(min_value=1), _L,
              "Timeout for logdir describe/alter calls to the cluster.")
+    d.define("executor.max.consecutive.poll.failures", Type.INT, 10,
+             in_range(min_value=1), _M,
+             "Consecutive execution-progress poll failures tolerated "
+             "before the execution fails (transient admin blips are "
+             "retried next interval; a permanently broken admin client "
+             "must not wedge has_ongoing_execution forever).  1 = "
+             "fail-fast: the second consecutive failure fails the run.")
+    d.define("executor.journal.dir", Type.STRING, "", None, _M,
+             "Directory of the durable executor journal (crash-safe "
+             "execution, docs/EXECUTOR.md): an append-only CRC-framed "
+             "WAL of execution state plus the removal/demotion history,"
+             " replayed at startup to resume or abort an execution a "
+             "process bounce interrupted.  Empty (the default) keeps "
+             "the executor in-memory only.  Fleet deployments get one "
+             "subdirectory per tenant.")
+    d.define("executor.journal.segment.max.bytes", Type.LONG, 4_194_304,
+             in_range(min_value=4096), _L,
+             "Rotate the executor journal to a fresh segment beyond "
+             "this size; settled segments are deleted when the next "
+             "execution starts.")
+    d.define("executor.recovery.mode", Type.STRING, "resume",
+             in_values("resume", "abort"), _M,
+             "What startup journal replay does with an execution the "
+             "previous process left in flight: `resume` restarts it "
+             "under the original uuid/caps/strategy (moves the cluster "
+             "finished are sealed, moves still running are adopted and "
+             "polled, never re-submitted); `abort` cancels the "
+             "in-flight reassignments and settles the journal.  Both "
+             "modes clear orphaned replication throttles first.")
     d.define("zookeeper.security.enabled", Type.BOOLEAN, False, None, _L,
              "Reference-compat flag: the reference secures its ZooKeeper "
              "sessions with this; this framework has no ZooKeeper — when "

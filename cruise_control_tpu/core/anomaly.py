@@ -37,6 +37,13 @@ class AnomalyType(enum.Enum):
     #: the remediation, the anomaly routes the evidence (condemned
     #: chips, span, flight-recorder dump) through the notifier plane
     MESH_DEGRADATION = 6
+    #: an interrupted execution was recovered at startup (crash
+    #: reconcile-and-resume, executor/recovery.py) or the executor
+    #: journal degraded to journal-less operation — notification-only:
+    #: recovery already ran; the anomaly routes the evidence (resumed
+    #: uuid, adopted/sealed task counts, cleared throttles,
+    #: flight-recorder dump) through the notifier plane
+    EXECUTION_RECOVERY = 7
 
 
 class Anomaly(abc.ABC):

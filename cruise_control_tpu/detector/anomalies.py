@@ -223,6 +223,52 @@ class MeshDegraded(Anomaly):
 
 
 @dataclasses.dataclass
+class ExecutionRecovery(Anomaly):
+    """An interrupted execution was reconciled at startup
+    (executor/recovery.py), or the executor journal degraded to
+    journal-less operation mid-execution.  Notification-only — the
+    recovery already resumed/aborted the execution; this anomaly routes
+    the evidence through the notifier plane so operators see a process
+    bounce mid-rebalance exactly like cluster trouble."""
+
+    uuid: str
+    mode: str                        # resume | abort | journal-degraded
+    resumed: bool
+    tasks_terminal: int = 0
+    tasks_adopted: int = 0
+    tasks_pending: int = 0
+    cleared_throttle_brokers: List[int] = dataclasses.field(
+        default_factory=list)
+    journal_degraded: bool = False
+    description: str = ""
+    detected_ms: float = 0.0
+    _id: str = dataclasses.field(
+        default_factory=lambda: _new_id("execution-recovery"))
+
+    @property
+    def anomaly_type(self) -> AnomalyType:
+        return AnomalyType.EXECUTION_RECOVERY
+
+    @property
+    def anomaly_id(self) -> str:
+        return self._id
+
+    def fix(self) -> bool:
+        return False   # recovery already settled the execution
+
+    def __str__(self) -> str:
+        if self.journal_degraded:
+            return (f"ExecutionRecovery(journal degraded to "
+                    f"journal-less execution: {self.description})")
+        return (f"ExecutionRecovery({self.uuid}, mode={self.mode}, "
+                f"resumed={self.resumed}, terminal={self.tasks_terminal}"
+                f", adopted={self.tasks_adopted}, "
+                f"pending={self.tasks_pending}, "
+                f"clearedThrottles={self.cleared_throttle_brokers}, "
+                f"{self.description})")
+
+
+@dataclasses.dataclass
 class TopicAnomaly(Anomaly):
     """Topics violating a policy — e.g. replication factor != target
     (reference TopicReplicationFactorAnomaly.java) or oversized partitions

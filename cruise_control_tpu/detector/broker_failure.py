@@ -12,13 +12,13 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import threading
 import time as _time
 from typing import Callable, Dict, Optional, Set
 
 from cruise_control_tpu.cluster.admin import ClusterAdminClient
 from cruise_control_tpu.detector.anomalies import BrokerFailures, FixFn
+from cruise_control_tpu.utils import persist
 
 LOG = logging.getLogger(__name__)
 
@@ -45,10 +45,10 @@ class FileFailedBrokerStore(FailedBrokerStore):
             return {}
 
     def save(self, failed: Dict[int, float]) -> None:
-        tmp = self._path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({str(k): v for k, v in failed.items()}, f)
-        os.replace(tmp, self._path)
+        # shared durable-write helper (utils/persist.py): atomic
+        # publication so a crash mid-save never truncates the table
+        persist.atomic_write_json(
+            self._path, {str(k): v for k, v in failed.items()})
 
 
 class BrokerFailureDetector:

@@ -4,7 +4,11 @@ Host-side, I/O-bound async engine (SURVEY.md §2.5); the reference's
 CC/executor/ package re-designed over the ClusterAdminClient SPI.
 """
 from cruise_control_tpu.executor.executor import (Executor, ExecutorNotifier)
+from cruise_control_tpu.executor.journal import (ExecutionJournal,
+                                                 JournalReplay)
 from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.recovery import (ReconcilePlan,
+                                                  RecoveryReport, reconcile)
 from cruise_control_tpu.executor.state import ExecutorPhase, ExecutorState
 from cruise_control_tpu.executor.strategy import (
     BaseReplicaMovementStrategy, PostponeUrpReplicaMovementStrategy,
@@ -18,6 +22,8 @@ from cruise_control_tpu.executor.task_manager import (ExecutionCounts,
 
 __all__ = [
     "Executor", "ExecutorNotifier", "ExecutorPhase", "ExecutorState",
+    "ExecutionJournal", "JournalReplay", "ReconcilePlan",
+    "RecoveryReport", "reconcile",
     "ExecutionTask", "ExecutionTaskManager", "ExecutionTaskPlanner",
     "ExecutionCounts", "TaskState", "TaskType",
     "ReplicaMovementStrategy", "BaseReplicaMovementStrategy",

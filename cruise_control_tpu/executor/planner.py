@@ -33,17 +33,23 @@ class ExecutionTaskPlanner:
     # ------------------------------------------------------------------
     def add_proposals(self, proposals: Sequence[ExecutionProposal]) -> None:
         """Decompose proposals into typed tasks
-        (ExecutionTaskPlanner.addExecutionProposal)."""
+        (ExecutionTaskPlanner.addExecutionProposal).  Stable keys are
+        assigned here, from proposal content — the decomposition is
+        deterministic, so a restarted process replaying the journaled
+        proposals derives the SAME keys (executor/journal.py)."""
         for p in proposals:
+            tp = f"{p.partition.topic}:{p.partition.partition}"
             if p.has_replica_action:
                 self._inter_broker_tasks.append(ExecutionTask(
                     ExecutionTask.next_id(), p,
-                    TaskType.INTER_BROKER_REPLICA_ACTION))
+                    TaskType.INTER_BROKER_REPLICA_ACTION,
+                    stable_key=f"INTER:{tp}"))
             if p.has_leader_action:
                 # runs in phase 3, after any replica movement has landed the
                 # new leader's replica (Executor.java execute() phase order)
                 self._leadership_tasks.append(ExecutionTask(
-                    ExecutionTask.next_id(), p, TaskType.LEADER_ACTION))
+                    ExecutionTask.next_id(), p, TaskType.LEADER_ACTION,
+                    stable_key=f"LEADER:{tp}"))
             for intra in self._intra_broker_moves(p):
                 self._intra_broker_tasks.append(intra)
         self._inter_broker_tasks = self._strategy.sorted_tasks(
@@ -61,7 +67,9 @@ class ExecutionTaskPlanner:
                     and old_dir is not None and r.logdir != old_dir):
                 tasks.append(ExecutionTask(
                     ExecutionTask.next_id(), p,
-                    TaskType.INTRA_BROKER_REPLICA_ACTION))
+                    TaskType.INTRA_BROKER_REPLICA_ACTION,
+                    stable_key=(f"INTRA:{p.partition.topic}:"
+                                f"{p.partition.partition}:{len(tasks)}")))
         return tasks
 
     # ------------------------------------------------------------------

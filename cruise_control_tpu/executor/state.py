@@ -38,15 +38,20 @@ class ExecutorState:
     leadership: Optional[ExecutionCounts] = None
     data_to_move_mb: float = 0.0
     data_moved_mb: float = 0.0
+    #: crash-recovery telemetry (executor/journal.py + recovery.py):
+    #: journal health and the last reconcile-and-resume outcome
+    recovery: Optional[Dict] = None
 
     @staticmethod
-    def idle() -> "ExecutorState":
-        return ExecutorState(ExecutorPhase.NO_TASK_IN_PROGRESS)
+    def idle(recovery: Optional[Dict] = None) -> "ExecutorState":
+        return ExecutorState(ExecutorPhase.NO_TASK_IN_PROGRESS,
+                             recovery=recovery)
 
     @staticmethod
     def snapshot(phase: ExecutorPhase, uuid: Optional[str],
                  reason: Optional[str],
-                 manager: ExecutionTaskManager) -> "ExecutorState":
+                 manager: ExecutionTaskManager,
+                 recovery: Optional[Dict] = None) -> "ExecutorState":
         return ExecutorState(
             phase=phase, uuid=uuid, reason=reason,
             inter_broker=manager.counts(TaskType.INTER_BROKER_REPLICA_ACTION),
@@ -54,10 +59,13 @@ class ExecutorState:
             leadership=manager.counts(TaskType.LEADER_ACTION),
             data_to_move_mb=manager.inter_broker_data_to_move / 1e6,
             data_moved_mb=manager.inter_broker_data_moved / 1e6,
+            recovery=recovery,
         )
 
     def to_json(self) -> Dict:
         out: Dict = {"state": self.phase.value}
+        if self.recovery is not None:
+            out["recovery"] = self.recovery
         if self.phase == ExecutorPhase.NO_TASK_IN_PROGRESS:
             return out
         out["triggeredUserTaskId"] = self.uuid

@@ -55,6 +55,17 @@ class ReplicaMovementStrategy(abc.ABC):
     def name(self) -> str:
         return type(self).__name__
 
+    def chain_names(self) -> List[str]:
+        """Every strategy name in chain order — the round-trippable
+        form the executor journal records so a resumed execution
+        rebuilds the SAME ordering via `strategy_from_names`."""
+        out: List[str] = []
+        node: Optional[ReplicaMovementStrategy] = self
+        while node is not None:
+            out.append(node.name())
+            node = node._next
+        return out
+
 
 class BaseReplicaMovementStrategy(ReplicaMovementStrategy):
     """Proposal order (task-id ascending) — the default."""

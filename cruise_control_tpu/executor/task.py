@@ -60,6 +60,12 @@ class ExecutionTask:
     end_time_ms: float = -1.0
     #: how often the executor has observed no progress and re-submitted
     reexecution_count: int = 0
+    #: process-independent identity for the durable journal: derived
+    #: from the proposal CONTENT by the planner (type:topic:partition
+    #: [:index]), so a restarted process decomposing the same journaled
+    #: proposals lines its tasks up with the crashed process's records
+    #: (task_id is a process-local counter and cannot)
+    stable_key: str = ""
 
     @staticmethod
     def next_id() -> int:

@@ -137,6 +137,64 @@ class ExecutionTaskManager:
                 task.aborting(now_ms)
 
     # ------------------------------------------------------------------
+    # crash recovery (executor/recovery.py reconcile plans)
+    # ------------------------------------------------------------------
+    def apply_recovery(self, resolutions, now_ms: float):
+        """Seal reconciled task states into a freshly-loaded manager.
+
+        Terminal resolutions walk the legal state machine (PENDING →
+        IN_PROGRESS → terminal) WITHOUT touching in-flight slot
+        accounting — those slots were never reserved in this process.
+        Adopted resolutions mark the task IN_PROGRESS (original start
+        time when the journal recorded one) AND reserve its slots, so
+        the resumed phase loops respect the concurrency caps and the
+        eventual `finish_task` decrement balances.  Returns the adopted
+        tasks by type for the phase loops to poll."""
+        # imported here, not at module top: recovery.py sits above this
+        # module in the executor package's layering (it imports the
+        # planner), and only this method needs its verdict constants
+        from cruise_control_tpu.executor.recovery import ADOPT, TERMINAL
+        adopted = {t: [] for t in TaskType}
+        with self._lock:
+            for task in self._planner.all_tasks():
+                res = resolutions.get(task.stable_key)
+                if res is None:
+                    continue
+                task.reexecution_count = res.reexecution_count
+                if res.action == TERMINAL:
+                    task.in_progress(now_ms)
+                    state = TaskState(res.state)
+                    if state is TaskState.COMPLETED:
+                        task.completed(now_ms)
+                        if task.task_type \
+                                is TaskType.INTER_BROKER_REPLICA_ACTION:
+                            self._inter_data_moved += (
+                                task.proposal.inter_broker_data_to_move)
+                    elif state is TaskState.ABORTED:
+                        task.aborting(now_ms)
+                        task.aborted(now_ms)
+                    else:
+                        task.kill(now_ms)
+                elif res.action == ADOPT:
+                    start = res.start_ms if res.start_ms > 0 else now_ms
+                    task.in_progress(start)
+                    if task.task_type \
+                            is TaskType.INTER_BROKER_REPLICA_ACTION:
+                        for b in task.participants():
+                            self._in_flight_inter[b] = (
+                                self._in_flight_inter.get(b, 0) + 1)
+                    elif task.task_type \
+                            is TaskType.INTRA_BROKER_REPLICA_ACTION:
+                        for b in task.intra_brokers():
+                            self._in_flight_intra[b] = (
+                                self._in_flight_intra.get(b, 0) + 1)
+                    else:
+                        self._in_flight_leaders += 1
+                    adopted[task.task_type].append(task)
+                # "pending": leave the task PENDING for normal serving
+        return adopted
+
+    # ------------------------------------------------------------------
     # queries
     # ------------------------------------------------------------------
     def counts(self, task_type: Optional[TaskType] = None) -> ExecutionCounts:

@@ -194,6 +194,9 @@ class CruiseControl:
                  sleep_fn: Optional[Callable[[float], None]] = None,
                  monitor_kwargs: Optional[dict] = None,
                  executor_kwargs: Optional[dict] = None,
+                 executor_journal_dir: Optional[str] = None,
+                 executor_recovery_mode: str = "resume",
+                 executor_journal_segment_max_bytes: Optional[int] = None,
                  auto_warmup: bool = True,
                  warm_start_proposals: bool = True,
                  precompute_eager_hard_abort: bool = False,
@@ -333,10 +336,31 @@ class CruiseControl:
         self._incremental_max_dirty_ratio = min(
             1.0, max(0.0, incremental_max_dirty_ratio))
         self._model_store = DeviceModelStore(time_fn=self._time)
+        # durable executor journal (executor/journal.py): with a
+        # journal dir every execution is a resumable WAL'd operation —
+        # a process bounce mid-rebalance replays, reconciles against
+        # live metadata and resumes (or aborts) at startup instead of
+        # leaving the cluster half-moved.  No dir (the default) keeps
+        # the executor in-memory only, byte for byte.
+        if executor_recovery_mode not in ("resume", "abort"):
+            raise ValueError(
+                f"executor.recovery.mode must be resume|abort, got "
+                f"{executor_recovery_mode!r}")
+        self._executor_recovery_mode = executor_recovery_mode
+        self._executor_recovery_done = False
+        from cruise_control_tpu.executor.journal import (
+            DEFAULT_SEGMENT_MAX_BYTES, ExecutionJournal)
+        self.executor_journal = (ExecutionJournal(
+            executor_journal_dir,
+            segment_max_bytes=(executor_journal_segment_max_bytes
+                               or DEFAULT_SEGMENT_MAX_BYTES),
+            time_fn=self._time)
+            if executor_journal_dir else None)
         self.executor = Executor(
             admin, load_monitor=self.load_monitor,
             notifier=executor_notifier, time_fn=self._time,
-            sleep_fn=sleep_fn, **(executor_kwargs or {}))
+            sleep_fn=sleep_fn, journal=self.executor_journal,
+            **(executor_kwargs or {}))
         self.goal_optimizer = GoalOptimizer(
             default_goals(names=self._goal_names,
                           max_rounds=max_optimization_rounds),
@@ -355,8 +379,18 @@ class CruiseControl:
             notifier,
             num_cached_recent_anomaly_states=num_cached_recent_anomaly_states,
             ready_fn=self._monitor_ready,
-            fix_in_progress_fn=lambda: self.executor.has_ongoing_execution,
+            # one mutation at a time: an ongoing execution AND an
+            # unsettled crash recovery both block self-healing — a
+            # heal over a half-moved, unreconciled cluster would
+            # conflict with the reassignments Kafka is still executing
+            fix_in_progress_fn=lambda: (
+                self.executor.has_ongoing_execution
+                or self.executor.recovery_in_progress),
             time_fn=self._time)
+        if self.executor_journal is not None:
+            # journal write failures degrade to journal-less execution;
+            # the anomaly plane hears about it exactly once
+            self.executor_journal.on_error = self._on_journal_error
         self._wire_detectors(goal_violation_interval_s,
                              disk_failure_interval_s,
                              topic_anomaly_interval_s,
@@ -612,6 +646,20 @@ class CruiseControl:
             "solver-breaker-open",
             lambda: 0.0 if self.solver_breaker.cooldown_remaining_s() == 0.0
             else 1.0)
+        # executor-journal-* sensors: WAL health (writes/bytes/errors
+        # read the journal's own counters; zeros without a journal so
+        # dashboards don't branch on deployment shape)
+        _jrn = lambda: self.executor_journal  # noqa: E731
+        self.metrics.gauge(
+            "executor-journal-writes",
+            lambda: float(_jrn().writes) if _jrn() is not None else 0.0)
+        self.metrics.gauge(
+            "executor-journal-bytes",
+            lambda: (float(_jrn().bytes_written)
+                     if _jrn() is not None else 0.0))
+        self.metrics.gauge(
+            "executor-journal-errors",
+            lambda: float(_jrn().errors) if _jrn() is not None else 0.0)
         self.metrics.gauge(
             "sampler-quarantined-samples",
             lambda: self.load_monitor.num_quarantined_samples)
@@ -644,6 +692,11 @@ class CruiseControl:
                  start_detection: bool = True,
                  skip_loading_samples: bool = False,
                  start_proposal_precompute: bool = False) -> None:
+        # crash recovery FIRST: an execution the previous process left
+        # in flight must be reconciled (resumed or aborted, throttles
+        # cleared) before the detectors wake up and could self-heal
+        # over a half-moved cluster
+        self.recover_interrupted_execution()
         self.load_monitor.start_up(do_sampling=do_sampling,
                                    skip_loading_samples=skip_loading_samples)
         self.broker_failure_detector.start()
@@ -676,6 +729,94 @@ class CruiseControl:
             LOG.info("program-cache hydration: %d compiled programs "
                      "ready before the first solve", count)
         return count
+
+    def recover_interrupted_execution(self) -> Optional[dict]:
+        """Replay the durable executor journal and settle whatever the
+        previous process left in flight (executor/recovery.py):
+        per `executor.recovery.mode` the interrupted execution is
+        RESUMED under its original uuid or ABORTED-and-cleaned; in both
+        modes orphaned replication throttles are removed and the
+        anomaly detector stays blocked until reconciliation settles.
+        Idempotent (first call wins — main.py startup and fleet
+        register() may both reach it) and best-effort by contract: a
+        failed recovery is reported, never raised into startup.
+        Returns the recovery report, or None when there was nothing to
+        recover (or journaling is off)."""
+        if self.executor_journal is None or self._executor_recovery_done:
+            return None
+        self._executor_recovery_done = True
+        mode = self._executor_recovery_mode
+        trace = obs_trace.start("executor.recovery", mode=mode)
+        try:
+            report = self.executor.recover(mode=mode)
+        except Exception as exc:  # noqa: BLE001 - startup must survive
+            # a sick journal/cluster; the evidence goes to the anomaly
+            # plane and the operator runbook (OPERATIONS.md §5)
+            LOG.exception("executor crash recovery failed; the journal "
+                          "is left in place for manual inspection")
+            obs_trace.finish(trace, error=exc)
+            self._report_execution_recovery(
+                None, mode, error=f"{type(exc).__name__}: {exc}")
+            return None
+        obs_trace.finish(trace)
+        if report is not None:
+            self.metrics.meter("executor-recoveries").mark()
+            if report.get("resumed"):
+                # abort-mode recoveries resume nothing — the meter
+                # counts work the resumed execution actually carries
+                self.metrics.meter("executor-resumed-tasks").mark(
+                    report.get("tasksAdopted", 0)
+                    + report.get("tasksPending", 0))
+            if report.get("clearedThrottleBrokers"):
+                self.metrics.meter(
+                    "executor-orphaned-throttles-cleared").mark(
+                    len(report["clearedThrottleBrokers"]))
+            self._report_execution_recovery(report, mode)
+        return report
+
+    def _report_execution_recovery(self, report: Optional[dict],
+                                   mode: str,
+                                   error: str = "") -> None:
+        """EXECUTION_RECOVERY anomaly + flight-recorder dump: a process
+        bounce mid-rebalance surfaces exactly like cluster trouble."""
+        from cruise_control_tpu.detector.anomalies import ExecutionRecovery
+        desc = error or (f"recovered execution "
+                         f"{report.get('uuid', '?')}" if report else "")
+        obs_recorder.get_recorder().dump(
+            reason=f"ExecutionRecovery mode={mode} "
+                   f"({desc or 'no report'})")
+        try:
+            self.anomaly_detector.report(ExecutionRecovery(
+                uuid=(report or {}).get("uuid", ""),
+                mode=mode,
+                resumed=bool((report or {}).get("resumed")),
+                tasks_terminal=(report or {}).get("tasksTerminal", 0),
+                tasks_adopted=(report or {}).get("tasksAdopted", 0),
+                tasks_pending=(report or {}).get("tasksPending", 0),
+                cleared_throttle_brokers=list(
+                    (report or {}).get("clearedThrottleBrokers", [])),
+                journal_degraded=False,
+                description=desc,
+                detected_ms=self._time() * 1000.0))
+        except Exception:  # noqa: BLE001 - reporting is best-effort
+            LOG.exception("failed to report ExecutionRecovery anomaly")
+
+    def _on_journal_error(self, exc: BaseException) -> None:
+        """The executor journal degraded to journal-less execution
+        (disk full, EIO): count it and route ONE anomaly through the
+        notifier plane — the rebalance itself continues unaffected."""
+        from cruise_control_tpu.detector.anomalies import ExecutionRecovery
+        self.metrics.meter("executor-journal-error-events").mark()
+        try:
+            self.anomaly_detector.report(ExecutionRecovery(
+                uuid=self.executor.state.uuid or "",
+                mode="journal-degraded",
+                resumed=False,
+                journal_degraded=True,
+                description=f"{type(exc).__name__}: {exc}",
+                detected_ms=self._time() * 1000.0))
+        except Exception:  # noqa: BLE001 - reporting is best-effort
+            LOG.exception("failed to report journal degradation")
 
     def shutdown(self) -> None:
         self._precompute_stop.set()
@@ -713,6 +854,8 @@ class CruiseControl:
         self.broker_failure_detector.shutdown()
         self.executor.stop_execution(force=True)
         self.executor.await_completion(timeout=30.0)
+        if self.executor_journal is not None:
+            self.executor_journal.close()
         self.load_monitor.shutdown()
 
     # ------------------------------------------------------------------

@@ -189,6 +189,21 @@ class FleetRegistry:
                 LOG.info("fleet: tenant %r hydrated %d compiled "
                          "programs from the program cache", cluster_id,
                          hydrated)
+        # crash recovery at onboarding: replay this tenant's executor
+        # journal (its own subdirectory of executor.journal.dir) and
+        # resume/abort whatever the previous process left in flight.
+        # Idempotent — start_up() reaches the same guard-flagged method
+        # — and best-effort by the facade's contract (never raises);
+        # tolerant of stub facades in tests.
+        recover = getattr(facade, "recover_interrupted_execution", None)
+        if recover is not None:
+            report = recover()
+            if report:
+                LOG.warning(
+                    "fleet: tenant %r recovered interrupted execution "
+                    "%s (mode=%s, resumed=%s)", cluster_id,
+                    report.get("uuid"), report.get("mode"),
+                    report.get("resumed"))
         return tenant
 
     def drain(self, cluster_id: str) -> Tenant:

@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import logging
 import logging.handlers
+import os
 import signal
 import sys
 import threading
@@ -404,7 +405,13 @@ def build_cruise_control(config: CruiseControlConfig, admin,
                 required_samples_per_bucket=config.get_int(
                     "linear.regression.model.required.samples.per.bucket")),
             cpu_util_weights=cpu_weights),
+        executor_journal_dir=(config.get("executor.journal.dir") or None),
+        executor_recovery_mode=config.get("executor.recovery.mode"),
+        executor_journal_segment_max_bytes=config.get_long(
+            "executor.journal.segment.max.bytes"),
         executor_kwargs=dict(
+            max_consecutive_poll_failures=config.get_int(
+                "executor.max.consecutive.poll.failures"),
             concurrent_inter_broker_moves_per_broker=config.get_int(
                 "num.concurrent.partition.movements.per.broker"),
             concurrent_intra_broker_moves_per_broker=config.get_int(
@@ -549,11 +556,21 @@ def build_fleet(config: CruiseControlConfig, fleet_config_path: str):
     if default_id not in ids:
         raise ConfigException(
             f"fleet default cluster {default_id!r} is not in {ids}")
+    base_journal_dir = config.get("executor.journal.dir") or ""
     for entry in clusters:
         cid = entry["id"]
         merged = dict(config.originals)
         merged.update({k: str(v)
                        for k, v in (entry.get("overrides") or {}).items()})
+        # per-tenant executor journal isolation: each cluster's WAL +
+        # removal/demotion history lives in its own subdirectory of the
+        # base executor.journal.dir (two tenants sharing one journal
+        # would replay each other's executions); an explicit per-tenant
+        # override wins
+        if base_journal_dir and "executor.journal.dir" not in (
+                entry.get("overrides") or {}):
+            merged["executor.journal.dir"] = os.path.join(
+                base_journal_dir, cid)
         tenant_config = CruiseControlConfig(merged)
         sampler = None
         if entry.get("demo"):

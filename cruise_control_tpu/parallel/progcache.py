@@ -38,10 +38,11 @@ import dataclasses
 import json
 import logging
 import os
-import tempfile
 import threading
 import time as _time
 from typing import Dict, List, Optional
+
+from cruise_control_tpu.utils import persist
 
 LOG = logging.getLogger(__name__)
 
@@ -265,18 +266,10 @@ class ProgramCache:
         return base + _BLOB_SUFFIX
 
     def _atomic_write(self, path: str, data: bytes) -> None:
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
-                                   prefix=".tmp-", suffix="~")
-        try:
-            with os.fdopen(fd, "wb") as fh:
-                fh.write(data)
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        # the shared durable-write helper (utils/persist.py): same
+        # write-temp-then-rename contract this cache always had, now
+        # one audited implementation for every store in the framework
+        persist.atomic_write(path, data)
 
     def _bump_meta_hits(self, base: str) -> None:
         """Best-effort hit accounting in the sidecar (operator CLI
@@ -305,7 +298,7 @@ class ProgramCache:
             for suffix in (_BLOB_SUFFIX, _META_SUFFIX):
                 src = base + suffix
                 if os.path.exists(src):
-                    os.replace(src, os.path.join(
+                    persist.replace(src, os.path.join(
                         qdir,
                         f"{os.path.basename(base)}.{stamp}{suffix}"))
         except OSError as exc:

@@ -1,0 +1,173 @@
+"""Durable-write helpers: the ONE place host state reaches disk.
+
+Every persistent store in the framework — the compiled-program cache
+(parallel/progcache.py), the broker-failure table
+(detector/broker_failure.py), the metric-sample store
+(monitor/sampling/sample_store.py) and the executor journal
+(executor/journal.py) — shares the same two disciplines:
+
+* **atomic publication**: `atomic_write` writes a temp file NEXT TO the
+  target and `os.replace`s it into place, so a reader (or a process
+  that crashes mid-write) can never observe a torn file; concurrent
+  writers each publish a complete file and the last rename wins;
+* **CRC-framed append logs**: `crc_frame`/`read_crc_json` give
+  append-only JSONL logs a per-record crc32 so replay can detect a
+  torn tail (the record a dying process half-wrote) and truncate at
+  the FIRST bad record instead of trusting garbage.
+
+tools/lint.py enforces the funnel (durable-write rule): `open(.., "w")`
+/ `os.rename` / `os.replace` outside this module fails `make lint` —
+a store that bypasses these helpers silently loses the crash-safety
+contract the executor journal depends on.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import zlib
+from typing import IO, Iterable, List, Optional, Tuple
+
+
+def fsync_file(fh) -> None:
+    """Flush + fsync one open file object."""
+    fh.flush()
+    os.fsync(fh.fileno())
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so renames/creates inside it reach the disk
+    journal (a rename is durable only once its directory entry is)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: bytes, fsync: bool = False) -> None:
+    """Write-temp-then-rename publication of one complete file.
+
+    The temp file lives NEXT TO the target (same filesystem, so the
+    rename is atomic); on any failure the temp file is removed and the
+    previous content of `path` is untouched.  With `fsync` the data
+    and the directory entry are forced to disk before returning —
+    journal-grade durability; without it the write is still atomic but
+    rides the page cache (the program-cache trade-off)."""
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               prefix=".tmp-", suffix="~")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            if fsync:
+                fsync_file(fh)
+        os.replace(tmp, path)
+        if fsync:
+            fsync_dir(os.path.dirname(path) or ".")
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_json(path: str, obj, fsync: bool = False) -> None:
+    atomic_write(path, json.dumps(obj, sort_keys=True,
+                                  separators=(",", ":")).encode(),
+                 fsync=fsync)
+
+
+def atomic_rewrite(path: str, chunks: Iterable[bytes],
+                   fsync: bool = False) -> int:
+    """Compaction primitive: stream `chunks` into a temp file and
+    atomically replace `path` with it (rewrite-temp-then-rename).
+    Returns the number of bytes written.  Used by retention compaction
+    (sample store) where the new content is a filtered stream of the
+    old — never loaded into memory at once."""
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               prefix=".tmp-", suffix="~")
+    written = 0
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            for chunk in chunks:
+                fh.write(chunk)
+                written += len(chunk)
+            if fsync:
+                fsync_file(fh)
+        os.replace(tmp, path)
+        if fsync:
+            fsync_dir(os.path.dirname(path) or ".")
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return written
+
+
+def replace(src: str, dst: str) -> None:
+    """Atomic move/overwrite (quarantine paths etc.) — funneled here so
+    the durable-write lint rule has one audited rename site."""
+    os.replace(src, dst)
+
+
+# ---------------------------------------------------------------------------
+# CRC-framed JSONL records (append-only WAL framing)
+# ---------------------------------------------------------------------------
+def crc_frame(payload: bytes) -> bytes:
+    """One framed record: `<8-hex-crc32> <payload>\\n`.  The payload
+    must not contain newlines (compact JSON never does)."""
+    return b"%08x %s\n" % (zlib.crc32(payload) & 0xFFFFFFFF, payload)
+
+
+def json_frame(record: dict) -> bytes:
+    return crc_frame(json.dumps(record, sort_keys=True,
+                                separators=(",", ":")).encode())
+
+
+def parse_crc_frame(line: bytes) -> Optional[bytes]:
+    """The payload of one framed line, or None when the frame is bad
+    (short line, bad hex, crc mismatch — all the torn-tail shapes)."""
+    line = line.rstrip(b"\n")
+    if len(line) < 10 or line[8:9] != b" ":
+        return None
+    try:
+        want = int(line[:8], 16)
+    except ValueError:
+        return None
+    payload = line[9:]
+    if zlib.crc32(payload) & 0xFFFFFFFF != want:
+        return None
+    return payload
+
+
+def read_crc_json(path: str) -> Tuple[List[dict], bool]:
+    """Replay one CRC-framed JSONL file: `(records, truncated)`.
+
+    Reading stops at the FIRST bad record (crc mismatch, unparseable
+    json, missing trailing newline on the last line): everything after
+    a torn record is untrustworthy even if it frames correctly, so the
+    tail is logically truncated — `truncated` tells the caller the
+    file did not end cleanly."""
+    records: List[dict] = []
+    if not os.path.exists(path):
+        return records, False
+    with open(path, "rb") as fh:
+        for raw in fh:
+            if not raw.endswith(b"\n"):
+                return records, True          # torn final record
+            payload = parse_crc_frame(raw)
+            if payload is None:
+                return records, True
+            try:
+                records.append(json.loads(payload))
+            except ValueError:
+                return records, True
+    return records, False
+
+
+def open_append(path: str) -> IO[bytes]:
+    """Open an append-only record log (the WAL segment handle)."""
+    return open(path, "ab")

@@ -1,0 +1,787 @@
+"""Crash-safe execution: durable journal + reconcile-and-resume.
+
+The PR-13 contract (docs/EXECUTOR.md): a process bounce mid-rebalance
+never leaves the cluster half-moved.  Pinned here with a
+kill-at-every-point crash/restart matrix on the virtual-time simulated
+cluster — crash at every executor sleep AND around every admin call —
+asserting for every crash point: no inter-broker move submitted twice,
+no replication throttle leaked, and the resumed execution (SAME uuid)
+ends byte-equal to an uncrashed twin.  Plus torn-tail/corrupt journal
+replay, abort-and-clean mode, per-tenant journal isolation, journal
+fault degradation (disk-full/EIO must never fail the rebalance), the
+poll-failure config satellite, sample-store compaction, and the
+durable-write lint rule.
+"""
+import os
+import struct
+import sys
+
+import conftest  # noqa: F401
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import (ExecutionProposal,
+                                                   ReplicaPlacement)
+from cruise_control_tpu.cluster.simulated import SimulatedCluster
+from cruise_control_tpu.cluster.types import TopicPartition
+from cruise_control_tpu.executor import Executor, ExecutionJournal
+from cruise_control_tpu.model.builder import PartitionId
+from cruise_control_tpu.utils import faults, persist
+
+pytestmark = [pytest.mark.recovery, pytest.mark.chaos]
+
+
+# ---------------------------------------------------------------------------
+# rig
+# ---------------------------------------------------------------------------
+def _proposal(topic, part, old, new, old_leader=None, size=0.0,
+              logdirs_old=None, logdirs_new=None):
+    olds = tuple(ReplicaPlacement(b, (logdirs_old or {}).get(b))
+                 for b in old)
+    news = tuple(ReplicaPlacement(b, (logdirs_new or {}).get(b))
+                 for b in new)
+    return ExecutionProposal(
+        partition=PartitionId(topic, part),
+        old_leader=old_leader if old_leader is not None else old[0],
+        old_replicas=olds, new_replicas=news, partition_size=size)
+
+
+def _sim(logdirs=("/d0", "/d1")):
+    sim = SimulatedCluster()  # virtual clock
+    sim._move_rate = 20e6     # several poll intervals per move
+    for b in range(4):
+        sim.add_broker(b, rack=f"r{b % 2}", logdirs=logdirs)
+    sim.create_topic("t", [[0, 1], [1, 2], [2, 3]], size_bytes=40e6)
+    return sim
+
+
+def _proposals():
+    """Replica moves + a logdir move + leader moves: all three phases."""
+    return [
+        _proposal("t", 0, [0, 1], [2, 1], old_leader=0, size=40e6),
+        _proposal("t", 1, [1, 2], [3, 2], old_leader=1, size=40e6),
+        _proposal("t", 2, [2, 3], [2, 3], old_leader=2, size=40e6,
+                  logdirs_old={2: "/d0"}, logdirs_new={2: "/d1"}),
+    ]
+
+
+def _placement(sim):
+    snap = sim.describe_cluster()
+    out = {}
+    for p in range(3):
+        info = snap.partition(TopicPartition("t", p))
+        out[p] = (list(info.replicas), info.leader,
+                  dict(sorted(info.logdir_by_broker.items())))
+    return out
+
+
+def _twin_placement():
+    """Final placement of an uncrashed run over an identical cluster."""
+    sim = SimulatedCluster()
+    sim._move_rate = 1e12     # twin speed is irrelevant to placement
+    for b in range(4):
+        sim.add_broker(b, rack=f"r{b % 2}", logdirs=("/d0", "/d1"))
+    sim.create_topic("t", [[0, 1], [1, 2], [2, 3]], size_bytes=40e6)
+    ex = Executor(sim, progress_check_interval_s=1.0,
+                  time_fn=lambda: sim.now_ms() / 1000.0,
+                  sleep_fn=sim.advance)
+    ex.execute_proposals(_proposals(), reason="twin", wait=True)
+    return _placement(sim)
+
+
+class _Killed(RuntimeError):
+    """The simulated SIGKILL."""
+
+
+class CrashyAdmin:
+    """Admin proxy with a power switch + duplicate-submission ledger.
+
+    While ON it forwards to the simulated cluster, counting every
+    alter_partition_reassignments target that ADDS brokers a partition
+    does not currently host (a growth submission — the thing that must
+    never happen twice per partition across crash + recovery).  It can
+    kill the "process" before or after the nth admin call.  While OFF
+    every call raises — the dead process cannot touch the cluster."""
+
+    def __init__(self, sim, growth_counts, journal=None,
+                 kill_before_call=None, kill_after_call=None):
+        self._sim = sim
+        self._growth = growth_counts
+        self._journal = journal
+        self._kill_before = kill_before_call
+        self._kill_after = kill_after_call
+        self.calls = 0
+        self.on = True
+
+    def _die(self):
+        self.on = False
+        if self._journal is not None:
+            # the dead process writes nothing more
+            self._journal.broken = True
+        raise _Killed("simulated process kill")
+
+    def __getattr__(self, name):
+        real = getattr(self._sim, name)
+        if not callable(real):
+            return real
+
+        def call(*args, **kwargs):
+            if not self.on:
+                raise _Killed("process is dead")
+            self.calls += 1
+            if self._kill_before is not None \
+                    and self.calls == self._kill_before:
+                self._die()
+            if name == "alter_partition_reassignments":
+                for tp, target in args[0].items():
+                    if target is None:
+                        continue
+                    current = set(
+                        self._sim._partitions[tp].replicas)
+                    if set(target) - current:
+                        self._growth[tp] = self._growth.get(tp, 0) + 1
+            out = real(*args, **kwargs)
+            if self._kill_after is not None \
+                    and self.calls == self._kill_after:
+                self._die()
+            return out
+        return call
+
+
+def _crashed_run(tmp_path, kill_sleep=None, kill_before_call=None,
+                 kill_after_call=None, throttle=None, removed=(),
+                 name="run"):
+    """One 'process': start the execution and crash it at the chosen
+    point.  Returns (sim, journal_dir, growth_counts, uuid_or_None)."""
+    sim = _sim()
+    jdir = str(tmp_path / name)
+    growth = {}
+    journal = ExecutionJournal(jdir,
+                               time_fn=lambda: sim.now_ms() / 1000.0)
+    proxy = CrashyAdmin(sim, growth, journal=journal,
+                        kill_before_call=kill_before_call,
+                        kill_after_call=kill_after_call)
+    ex = Executor(proxy, progress_check_interval_s=1.0, journal=journal,
+                  replication_throttle_bytes_per_s=throttle,
+                  time_fn=lambda: sim.now_ms() / 1000.0)
+    sleeps = {"n": 0}
+
+    def sleep(s):
+        sleeps["n"] += 1
+        if kill_sleep is not None and sleeps["n"] == kill_sleep:
+            proxy.on = False
+            journal.broken = True
+            raise _Killed("simulated process kill during sleep")
+        sim.advance(s)
+    ex._sleep = sleep
+    uuid = None
+    try:
+        uuid = ex.execute_proposals(_proposals(), reason="prod",
+                                    removed_brokers=list(removed),
+                                    wait=True)
+    except _Killed:
+        pass          # died before the runnable even started
+    return sim, jdir, growth, uuid
+
+
+def _recover(sim, jdir, growth, mode="resume"):
+    """The 'restarted process': fresh executor over the same journal
+    dir and the (powered-back-on) cluster."""
+    journal = ExecutionJournal(jdir,
+                               time_fn=lambda: sim.now_ms() / 1000.0)
+    proxy = CrashyAdmin(sim, growth)
+    ex = Executor(proxy, progress_check_interval_s=1.0, journal=journal,
+                  time_fn=lambda: sim.now_ms() / 1000.0,
+                  sleep_fn=sim.advance)
+    report = ex.recover(mode=mode, wait=True)
+    return ex, report
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance pin: kill at every point, resume, byte-equal twin
+# ---------------------------------------------------------------------------
+class TestCrashResumeMatrix:
+    def _assert_recovered(self, sim, jdir, growth, uuid, initial, twin,
+                          point):
+        ex2, report = _recover(sim, jdir, growth, mode="resume")
+        final = _placement(sim)
+        if report is None:
+            # crashed before the start record committed (nothing to
+            # recover) or after the finish record (nothing left): the
+            # cluster must be all-or-nothing, never half-moved
+            assert final in (initial, twin), point
+        else:
+            # the SAME execution resumed and completed
+            assert report["uuid"] == uuid, point
+            assert final == twin, point
+        # no inter-broker move was ever submitted twice
+        for tp, n in growth.items():
+            assert n <= 1, f"{point}: {tp} submitted {n} times"
+        # no replication throttle left behind
+        assert all(b.throttle is None
+                   for b in sim._brokers.values()), point
+        assert not ex2.has_ongoing_execution
+
+    def test_kill_at_every_sleep(self, tmp_path):
+        twin = _twin_placement()
+        initial = _placement(_sim())
+        # discover the clean run's sleep count
+        sim_c = _sim()
+        ex_c = Executor(sim_c, progress_check_interval_s=1.0,
+                        time_fn=lambda: sim_c.now_ms() / 1000.0)
+        count = {"n": 0}
+
+        def counting_sleep(s):
+            count["n"] += 1
+            sim_c.advance(s)
+        ex_c._sleep = counting_sleep
+        ex_c.execute_proposals(_proposals(), reason="count", wait=True)
+        clean_sleeps = count["n"]
+        assert clean_sleeps >= 4, "rig too fast to crash mid-flight"
+        for k in range(1, clean_sleeps + 1):
+            sim, jdir, growth, uuid = _crashed_run(
+                tmp_path, kill_sleep=k,
+                throttle=100e6, name=f"sleep{k}")
+            self._assert_recovered(sim, jdir, growth, uuid, initial,
+                                   twin, point=f"kill at sleep {k}")
+
+    def test_kill_around_every_admin_call(self, tmp_path):
+        twin = _twin_placement()
+        initial = _placement(_sim())
+        # clean call count
+        sim_c = _sim()
+        growth_c = {}
+        proxy_c = CrashyAdmin(sim_c, growth_c)
+        ex_c = Executor(proxy_c, progress_check_interval_s=1.0,
+                        time_fn=lambda: sim_c.now_ms() / 1000.0,
+                        sleep_fn=sim_c.advance)
+        ex_c.execute_proposals(_proposals(), reason="count", wait=True)
+        total = proxy_c.calls
+        assert total >= 8
+        for k in range(1, total + 1):
+            for where, kwargs in (("before", {"kill_before_call": k}),
+                                  ("after", {"kill_after_call": k})):
+                sim, jdir, growth, uuid = _crashed_run(
+                    tmp_path, name=f"call{k}{where}", **kwargs)
+                self._assert_recovered(
+                    sim, jdir, growth, uuid, initial, twin,
+                    point=f"kill {where} admin call {k}")
+
+    def test_mid_inter_phase_sigkill_resumes_same_uuid(self, tmp_path):
+        """The headline pin spelled out: SIGKILL mid-inter-broker phase
+        with a throttle applied -> restart -> the SAME uuid resumes,
+        adopted moves are polled (not re-submitted), final placement is
+        byte-equal to the uncrashed twin, zero throttles remain."""
+        twin = _twin_placement()
+        sim, jdir, growth, uuid = _crashed_run(
+            tmp_path, kill_sleep=2, throttle=100e6, name="headline")
+        # the crash left the cluster mid-move with throttles applied
+        assert any(b.throttle is not None
+                   for b in sim._brokers.values())
+        assert sim.list_partition_reassignments()
+        ex2, report = _recover(sim, jdir, growth, mode="resume")
+        assert report is not None and report["uuid"] == uuid
+        assert report["resumed"] is True
+        assert report["tasksAdopted"] >= 1
+        assert _placement(sim) == twin
+        assert all(n <= 1 for n in growth.values())
+        assert all(b.throttle is None for b in sim._brokers.values())
+        # the resumed run settled its journal: a SECOND restart finds
+        # nothing to recover
+        ex3, report3 = _recover(sim, jdir, growth)
+        assert report3 is None
+
+
+class TestDoubleCrash:
+    def test_crash_during_resume_recovers_again(self, tmp_path):
+        """A SECOND crash mid-resume must replay the re-journaled
+        segment correctly: sealed terminal states stay sealed (review
+        finding: the resume used to re-journal tasks as PENDING) and
+        the third process still converges to the twin."""
+        twin = _twin_placement()
+        sim, jdir, growth, uuid = _crashed_run(
+            tmp_path, kill_sleep=3, name="double")
+        # process 2: resume, but crash again on its first sleep
+        journal2 = ExecutionJournal(
+            jdir, time_fn=lambda: sim.now_ms() / 1000.0)
+        proxy2 = CrashyAdmin(sim, growth, journal=journal2)
+        ex2 = Executor(proxy2, progress_check_interval_s=1.0,
+                       journal=journal2,
+                       time_fn=lambda: sim.now_ms() / 1000.0)
+        sleeps = {"n": 0}
+
+        def crashing_sleep(s):
+            sleeps["n"] += 1
+            if sleeps["n"] == 1:
+                proxy2.on = False
+                journal2.broken = True
+                raise _Killed("second kill")
+            sim.advance(s)
+        ex2._sleep = crashing_sleep
+        report2 = ex2.recover(mode="resume", wait=True)
+        assert report2 is not None and report2["uuid"] == uuid
+        # process 3: recover again and finish
+        ex3, report3 = _recover(sim, jdir, growth)
+        if report3 is not None:
+            assert report3["uuid"] == uuid
+        assert _placement(sim) == twin
+        for tp, n in growth.items():
+            assert n <= 1, f"{tp} submitted {n} times across 3 processes"
+        assert all(b.throttle is None for b in sim._brokers.values())
+
+    def test_orphan_throttle_clear_is_attributed(self, tmp_path):
+        """The recovery-time throttle clear is journaled under the
+        replayed execution's uuid (review finding: uuid=None records
+        were dropped by replay, so every restart re-cleared)."""
+        sim, jdir, growth, uuid = _crashed_run(
+            tmp_path, kill_sleep=2, throttle=100e6, name="attrib")
+        ex2, report = _recover(sim, jdir, growth, mode="abort")
+        assert report is not None
+        assert report["clearedThrottleBrokers"]
+        # a later restart replays NO outstanding throttle
+        journal3 = ExecutionJournal(
+            jdir, time_fn=lambda: sim.now_ms() / 1000.0)
+        replay = journal3.replay()
+        assert replay.throttle_brokers == []
+
+
+class TestAbortAndClean:
+    def test_abort_cancels_clears_and_restores_history(self, tmp_path):
+        sim, jdir, growth, uuid = _crashed_run(
+            tmp_path, kill_sleep=2, throttle=100e6,
+            removed=[3], name="abort")
+        assert sim.list_partition_reassignments()
+        ex2, report = _recover(sim, jdir, growth, mode="abort")
+        assert report is not None and report["uuid"] == uuid
+        assert report["resumed"] is False
+        assert report["cancelledReassignments"] >= 1
+        # abort-and-clean: nothing in flight, nothing leaked
+        assert sim.list_partition_reassignments() == []
+        assert all(b.throttle is None for b in sim._brokers.values())
+        assert not ex2.has_ongoing_execution
+        # removal history survived the bounce (exclusion windows hold)
+        assert 3 in ex2.recently_removed_brokers()
+        # the journal is settled: a restart finds nothing to recover
+        ex3, report3 = _recover(sim, jdir, growth, mode="abort")
+        assert report3 is None
+
+
+class TestJournalReplay:
+    def _segments(self, jdir):
+        return sorted(p for p in os.listdir(jdir)
+                      if p.startswith("journal-"))
+
+    def test_torn_tail_truncated_at_first_bad_record(self, tmp_path):
+        twin = _twin_placement()
+        sim, jdir, growth, uuid = _crashed_run(tmp_path, kill_sleep=2,
+                                               name="torn")
+        seg = os.path.join(jdir, self._segments(jdir)[-1])
+        with open(seg, "ab") as fh:
+            fh.write(b"deadbeef {\"t\":\"garbage")   # torn tail
+        ex2, report = _recover(sim, jdir, growth)
+        assert report is not None
+        assert report["journalTruncated"] is True
+        assert report["uuid"] == uuid
+        assert _placement(sim) == twin
+
+    def test_corrupt_mid_record_stops_replay_there(self, tmp_path):
+        twin = _twin_placement()
+        sim, jdir, growth, uuid = _crashed_run(tmp_path, kill_sleep=3,
+                                               name="corrupt")
+        seg = os.path.join(jdir, self._segments(jdir)[-1])
+        with open(seg, "rb") as fh:
+            lines = fh.readlines()
+        assert len(lines) >= 3
+        # flip one byte inside a middle record's payload
+        mid = len(lines) // 2
+        corrupted = bytearray(lines[mid])
+        corrupted[12] ^= 0xFF
+        lines[mid] = bytes(corrupted)
+        with open(seg, "wb") as fh:     # test-only surgery
+            fh.writelines(lines)
+        # replay stops at the corrupt record; metadata reconciliation
+        # still recovers the execution to the twin placement
+        ex2, report = _recover(sim, jdir, growth)
+        assert report is not None
+        assert report["journalTruncated"] is True
+        assert _placement(sim) == twin
+        assert all(n <= 1 for n in growth.values())
+
+    def test_crc_framing_units(self, tmp_path):
+        path = str(tmp_path / "frames.jsonl")
+        with open(path, "ab") as fh:
+            fh.write(persist.json_frame({"a": 1}))
+            fh.write(persist.json_frame({"b": 2}))
+        records, truncated = persist.read_crc_json(path)
+        assert records == [{"a": 1}, {"b": 2}] and not truncated
+        with open(path, "ab") as fh:
+            fh.write(b"0000000 not-a-frame\n")
+            fh.write(persist.json_frame({"c": 3}))
+        records, truncated = persist.read_crc_json(path)
+        # truncation at the FIRST bad record: the valid frame after the
+        # garbage is NOT trusted
+        assert records == [{"a": 1}, {"b": 2}] and truncated
+
+    def test_per_tenant_journal_isolation(self, tmp_path):
+        """Two tenants, two journal dirs: tenant A's crash never leaks
+        into tenant B's recovery and vice versa."""
+        twin = _twin_placement()
+        sim_a, jdir_a, growth_a, uuid_a = _crashed_run(
+            tmp_path, kill_sleep=2, name="tenantA")
+        # tenant B: own dir, clean run to completion
+        sim_b = _sim()
+        jdir_b = str(tmp_path / "tenantB")
+        jb = ExecutionJournal(jdir_b,
+                              time_fn=lambda: sim_b.now_ms() / 1000.0)
+        ex_b = Executor(sim_b, progress_check_interval_s=1.0,
+                        journal=jb,
+                        time_fn=lambda: sim_b.now_ms() / 1000.0,
+                        sleep_fn=sim_b.advance)
+        ex_b.execute_proposals(_proposals(), reason="b", wait=True)
+        # B's recovery: nothing in flight (its journal is settled)
+        ex_b2, report_b = _recover(sim_b, jdir_b, {})
+        assert report_b is None
+        # A's recovery: resumes only its own execution
+        ex_a2, report_a = _recover(sim_a, jdir_a, growth_a)
+        assert report_a is not None and report_a["uuid"] == uuid_a
+        assert _placement(sim_a) == twin
+
+    def test_history_survives_restart(self, tmp_path):
+        sim = _sim()
+        jdir = str(tmp_path / "hist")
+        j = ExecutionJournal(jdir,
+                             time_fn=lambda: sim.now_ms() / 1000.0)
+        ex = Executor(sim, progress_check_interval_s=1.0, journal=j,
+                      time_fn=lambda: sim.now_ms() / 1000.0,
+                      sleep_fn=sim.advance)
+        ex.execute_proposals(_proposals(), reason="hist", wait=True,
+                             removed_brokers=[0], demoted_brokers=[1])
+        j2 = ExecutionJournal(jdir,
+                              time_fn=lambda: sim.now_ms() / 1000.0)
+        ex2 = Executor(sim, journal=j2,
+                       time_fn=lambda: sim.now_ms() / 1000.0)
+        assert ex2.recently_removed_brokers() == {0}
+        assert ex2.recently_demoted_brokers() == {1}
+        ex2.drop_recently_removed_brokers([0])
+        j3 = ExecutionJournal(jdir,
+                              time_fn=lambda: sim.now_ms() / 1000.0)
+        ex3 = Executor(sim, journal=j3,
+                       time_fn=lambda: sim.now_ms() / 1000.0)
+        assert ex3.recently_removed_brokers() == set()
+        assert ex3.recently_demoted_brokers() == {1}
+
+
+class TestJournalDegradation:
+    """Journal failure must degrade to journal-less execution — never
+    fail the rebalance (sites executor.journal.write/fsync)."""
+
+    def test_write_failure_degrades_not_fails(self, tmp_path):
+        sim = _sim()
+        jdir = str(tmp_path / "sick")
+        j = ExecutionJournal(jdir,
+                             time_fn=lambda: sim.now_ms() / 1000.0)
+        degraded = []
+        j.on_error = degraded.append
+        ex = Executor(sim, progress_check_interval_s=1.0, journal=j,
+                      time_fn=lambda: sim.now_ms() / 1000.0,
+                      sleep_fn=sim.advance)
+        plan = faults.FaultPlan().fail_always("executor.journal.write")
+        with faults.injected(plan):
+            ex.execute_proposals(_proposals(), reason="sick", wait=True)
+        # the rebalance completed despite the dead journal
+        assert _placement(sim) == _twin_placement()
+        assert j.broken and j.errors >= 1
+        assert len(degraded) == 1     # anomaly hook fired exactly once
+        assert not ex.has_ongoing_execution
+
+    def test_fsync_failure_degrades_not_fails(self, tmp_path):
+        sim = _sim()
+        j = ExecutionJournal(str(tmp_path / "fsync"),
+                             time_fn=lambda: sim.now_ms() / 1000.0)
+        ex = Executor(sim, progress_check_interval_s=1.0, journal=j,
+                      time_fn=lambda: sim.now_ms() / 1000.0,
+                      sleep_fn=sim.advance)
+        plan = faults.FaultPlan().fail_nth("executor.journal.fsync", 1)
+        with faults.injected(plan):
+            ex.execute_proposals(_proposals(), reason="eio", wait=True)
+        assert _placement(sim) == _twin_placement()
+        assert j.broken and j.errors >= 1
+
+
+class TestPollFailureConfig:
+    """Satellite: the hardcoded _max_consecutive_poll_failures=10 is
+    now executor.max.consecutive.poll.failures, with the =1 fail-fast
+    edge covered."""
+
+    def test_fail_fast_edge(self):
+        sim = _sim()
+        ex = Executor(sim, progress_check_interval_s=1.0,
+                      max_consecutive_poll_failures=1,
+                      time_fn=lambda: sim.now_ms() / 1000.0,
+                      sleep_fn=sim.advance)
+        finished = []
+
+        class Notifier:
+            def on_execution_finished(self, uuid, ok, msg):
+                finished.append((ok, msg))
+        ex._notifier = Notifier()
+        # two consecutive poll failures: the first is tolerated
+        # (1 allowed), the second fails the execution
+        plan = faults.FaultPlan().fail_nth(
+            "executor.admin.describe_cluster", (3, 4, 5, 6))
+        with faults.injected(plan):
+            ex.execute_proposals(
+                [_proposal("t", 0, [0, 1], [2, 1], size=40e6)],
+                wait=True)
+        assert finished and finished[0][0] is False
+        assert not ex.has_ongoing_execution
+
+    def test_single_blip_still_tolerated_at_one(self):
+        sim = _sim()
+        ex = Executor(sim, progress_check_interval_s=1.0,
+                      max_consecutive_poll_failures=1,
+                      time_fn=lambda: sim.now_ms() / 1000.0,
+                      sleep_fn=sim.advance)
+        plan = faults.FaultPlan().fail_nth(
+            "executor.admin.describe_cluster", 3)
+        with faults.injected(plan):
+            ex.execute_proposals(
+                [_proposal("t", 0, [0, 1], [2, 1], size=40e6)],
+                wait=True)
+        snap = sim.describe_cluster()
+        assert set(snap.partition(
+            TopicPartition("t", 0)).replicas) == {1, 2}
+        assert ex.num_poll_failures_tolerated == 1
+
+    def test_config_key_wiring(self, tmp_path):
+        from cruise_control_tpu.common.config import load_properties
+        from cruise_control_tpu.config.main_config import (
+            CruiseControlConfig)
+        from cruise_control_tpu.main import build_cruise_control
+        props = tmp_path / "cc.properties"
+        props.write_text(
+            "capacity.config.file=\n"
+            "sample.store.directory=" + str(tmp_path / "s") + "\n"
+            "executor.max.consecutive.poll.failures=3\n"
+            "executor.journal.dir=" + str(tmp_path / "jrn") + "\n"
+            "executor.recovery.mode=abort\n")
+        config = CruiseControlConfig(load_properties(str(props)))
+        sim = _sim()
+        cc = build_cruise_control(config, sim)
+        try:
+            assert cc.executor._max_consecutive_poll_failures == 3
+            assert cc.executor_journal is not None
+            assert cc.executor_journal.directory == str(tmp_path / "jrn")
+            assert cc._executor_recovery_mode == "abort"
+        finally:
+            cc.shutdown()
+
+
+class TestFacadeRecovery:
+    """The facade surface: EXECUTION_RECOVERY anomaly, STATE recovery
+    block, recovery sensors, and the detector's fix-in-progress gate."""
+
+    def _facade(self, sim, jdir, notifier=None):
+        from cruise_control_tpu.facade import CruiseControl
+        from cruise_control_tpu.monitor.sampling.sampler import (
+            SimulatedClusterSampler)
+        return CruiseControl(
+            sim, SimulatedClusterSampler(sim),
+            anomaly_notifier=notifier,
+            time_fn=lambda: sim.now_ms() / 1000.0,
+            sleep_fn=sim.advance,
+            executor_kwargs=dict(progress_check_interval_s=1.0),
+            executor_journal_dir=jdir,
+            auto_warmup=False, scheduler_enabled=False)
+
+    def test_recovery_surfaces_everywhere(self, tmp_path):
+        from cruise_control_tpu.detector.anomalies import (
+            ExecutionRecovery)
+        from cruise_control_tpu.detector.notifier import (
+            AnomalyNotifier, NotificationAction)
+
+        class Recorder(AnomalyNotifier):
+            def __init__(self):
+                self.anomalies = []
+
+            def on_anomaly(self, anomaly):
+                self.anomalies.append(anomaly)
+                return NotificationAction.ignore()
+
+            def self_healing_enabled(self):
+                return {}
+
+        twin = _twin_placement()
+        sim, jdir, growth, uuid = _crashed_run(tmp_path, kill_sleep=2,
+                                               name="facade")
+        rec = Recorder()
+        cc = self._facade(sim, jdir, notifier=rec)
+        try:
+            report = cc.recover_interrupted_execution()
+            assert report is not None and report["uuid"] == uuid
+            cc.executor.await_completion(timeout=60.0)
+            assert _placement(sim) == twin
+            # idempotent: the second call (start_up would make one)
+            # does nothing
+            assert cc.recover_interrupted_execution() is None
+            # anomaly routed through the notifier plane
+            cc.anomaly_detector.process_all()
+            recovered = [a for a in rec.anomalies
+                         if isinstance(a, ExecutionRecovery)]
+            assert recovered and recovered[0].uuid == uuid
+            # STATE recovery block
+            state = cc.state(substates=["executor"])["ExecutorState"]
+            assert state["recovery"]["journalEnabled"] is True
+            assert state["recovery"]["lastRecovery"]["uuid"] == uuid
+            # sensors
+            sensors = cc.metrics.to_json()
+            assert sensors["executor-recoveries"]["count"] == 1
+            assert sensors["executor-journal-writes"]["value"] > 0
+        finally:
+            cc.shutdown()
+
+    def test_detector_blocked_while_reconciling(self, tmp_path):
+        sim = _sim()
+        cc = self._facade(sim, str(tmp_path / "gate"))
+        try:
+            gate = cc.anomaly_detector._fix_in_progress
+            assert gate() is False
+            cc.executor._recovery_in_progress = True
+            assert gate() is True      # self-heal blocked mid-recovery
+            cc.executor._recovery_in_progress = False
+            assert gate() is False
+        finally:
+            cc.shutdown()
+
+
+class TestSampleStoreDurability:
+    """Satellite: retention compaction on the store cadence (the files
+    no longer grow unbounded) + the fsync-on-store option."""
+
+    def _samples(self, t_ms, n=4):
+        from cruise_control_tpu.monitor.sampling.holder import (
+            PartitionMetricSample)
+        from cruise_control_tpu.monitor.sampling.sampler import Samples
+        s = Samples()
+        for i in range(n):
+            s.partition_samples.append(PartitionMetricSample(
+                broker_id=0, tp=TopicPartition("t", i),
+                sample_time_ms=t_ms, values={0: 1.0}))
+        return s
+
+    def test_compaction_bounds_file_growth(self, tmp_path):
+        from cruise_control_tpu.monitor.sampling.sample_store import (
+            FileSampleStore)
+        clock = {"now": 1_000.0}
+        store = FileSampleStore(
+            str(tmp_path), partition_retention_ms=10_000.0,
+            compaction_interval_ms=1.0,
+            time_fn=lambda: clock["now"])
+        path = os.path.join(str(tmp_path),
+                            FileSampleStore.PARTITION_FILE)
+        store.store_samples(self._samples(clock["now"] * 1000.0))
+        size_1 = os.path.getsize(path)
+        # a long retention-window's worth of stores: without
+        # compaction the file would grow linearly forever
+        for _ in range(30):
+            clock["now"] += 5.0
+            store.store_samples(self._samples(clock["now"] * 1000.0))
+        assert store.compactions > 0
+        assert store.evicted_samples > 0
+        # bounded: at most ~ the retention window of samples remains
+        assert os.path.getsize(path) <= size_1 * 4
+        # survivors still load
+        loaded = []
+
+        class Loader:
+            def load_samples(self, samples):
+                loaded.append(samples)
+        store.load_samples(Loader())
+        assert loaded[0].partition_samples
+        assert all(s.sample_time_ms >= clock["now"] * 1000.0 - 10_000.0
+                   for s in loaded[0].partition_samples)
+        store.close()
+
+    def test_evict_samples_before_hook(self, tmp_path):
+        from cruise_control_tpu.monitor.sampling.sample_store import (
+            FileSampleStore)
+        store = FileSampleStore(str(tmp_path), fsync=True,
+                                time_fn=lambda: 100.0)
+        store.store_samples(self._samples(1_000.0))
+        store.store_samples(self._samples(50_000.0))
+        store.evict_samples_before(10_000.0)
+        loaded = []
+
+        class Loader:
+            def load_samples(self, samples):
+                loaded.append(samples)
+        store.load_samples(Loader())
+        times = {s.sample_time_ms
+                 for s in loaded[0].partition_samples}
+        assert times == {50_000.0}
+        store.close()
+
+    def test_compaction_drops_unreadable_records(self, tmp_path):
+        from cruise_control_tpu.monitor.sampling.sample_store import (
+            FileSampleStore)
+        store = FileSampleStore(str(tmp_path), time_fn=lambda: 100.0)
+        store.store_samples(self._samples(90_000.0))
+        path = os.path.join(str(tmp_path),
+                            FileSampleStore.PARTITION_FILE)
+        with open(path, "ab") as fh:   # a corrupt length-prefixed rec
+            fh.write(struct.pack("<I", 4) + b"\xff\xff\xff\xff")
+        store.store_samples(self._samples(95_000.0))
+        store.evict_samples_before(0.0)
+        assert store.evicted_samples >= 1   # the corrupt record
+        loaded = []
+
+        class Loader:
+            def load_samples(self, samples):
+                loaded.append(samples)
+        store.load_samples(Loader())
+        assert len(loaded[0].partition_samples) == 8
+        store.close()
+
+
+class TestDurableWriteLintRule:
+    def _lint(self, tmp_path, body):
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import lint as lint_mod
+        pkg = tmp_path / "cruise_control_tpu"
+        pkg.mkdir(exist_ok=True)
+        mod = pkg / "mod.py"
+        mod.write_text(body)
+        import ast as _ast
+        return lint_mod._durable_write_violations(
+            mod, _ast.parse(body))
+
+    def test_flags_truncating_open_and_rename(self, tmp_path):
+        findings = self._lint(tmp_path, (
+            "import os\n\n\n"
+            "def f(p):\n"
+            "    with open(p, \"w\") as fh:\n"
+            "        fh.write(\"x\")\n"
+            "    os.replace(p, p + \".bak\")\n"))
+        assert len(findings) == 2
+
+    def test_allows_append_and_reads(self, tmp_path):
+        findings = self._lint(tmp_path, (
+            "def f(p):\n"
+            "    with open(p) as fh:\n"
+            "        fh.read()\n"
+            "    with open(p, \"ab\") as fh:\n"
+            "        fh.write(b\"x\")\n"))
+        assert findings == []
+
+    def test_persist_module_is_exempt(self, tmp_path):
+        import ast as _ast
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import lint as lint_mod
+        pkg = tmp_path / "cruise_control_tpu" / "utils"
+        pkg.mkdir(parents=True, exist_ok=True)
+        mod = pkg / "persist.py"
+        body = "import os\n\n\ndef f(a, b):\n    os.replace(a, b)\n"
+        mod.write_text(body)
+        assert lint_mod._durable_write_violations(
+            mod, _ast.parse(body)) == []

@@ -10,10 +10,17 @@ counts.  Exit code 0 = every scenario behaved; 1 = a scenario deviated.
 
 Usage: JAX_PLATFORMS=cpu python tools/chaos_sweep.py [--json]
        JAX_PLATFORMS=cpu python tools/chaos_sweep.py --drill mesh [--json]
+       JAX_PLATFORMS=cpu python tools/chaos_sweep.py --drill executor-crash
 
 `--drill mesh` runs the PR-12 elastic-mesh drill on the virtual 8-CPU
 mesh: condemn a chip mid-solve, assert span shrink + recovery without a
 process bounce, and print time-to-first-good-solve.
+
+`--drill executor-crash` runs the PR-13 crash-recovery drill: kill a
+simulated process mid-rebalance (throttles applied, reassignments in
+flight), replay the executor journal in a fresh "process", and assert
+the resumed execution completes byte-equal to an uncrashed twin with
+no duplicate submissions and no leaked throttles (docs/EXECUTOR.md).
 """
 from __future__ import annotations
 
@@ -240,6 +247,104 @@ def scenario_mesh_drill():
         cc.shutdown()
 
 
+def scenario_executor_crash_drill():
+    """Operator crash-recovery drill (`--drill executor-crash`): the
+    operational counterpart of tests/test_executor_recovery.py — run
+    it against the CURRENT build before trusting executor.journal.dir
+    + executor.recovery.mode=resume in production."""
+    import tempfile
+    import time as _real_time
+    from cruise_control_tpu.analyzer.proposals import (ExecutionProposal,
+                                                       ReplicaPlacement)
+    from cruise_control_tpu.executor import ExecutionJournal, Executor
+    from cruise_control_tpu.model.builder import PartitionId
+
+    def proposal(part, old, new, size=40e6):
+        return ExecutionProposal(
+            partition=PartitionId("t", part), old_leader=old[0],
+            old_replicas=tuple(ReplicaPlacement(b) for b in old),
+            new_replicas=tuple(ReplicaPlacement(b) for b in new),
+            partition_size=size)
+
+    def make_sim():
+        sim = SimulatedCluster()
+        sim._move_rate = 20e6
+        for b in range(4):
+            sim.add_broker(b, rack=f"r{b % 2}")
+        sim.create_topic("t", [[0, 1], [1, 2]], size_bytes=40e6)
+        return sim
+
+    def placement(sim):
+        snap = sim.describe_cluster()
+        return {p: (list(snap.partition(TopicPartition("t", p)).replicas),
+                    snap.partition(TopicPartition("t", p)).leader)
+                for p in range(2)}
+
+    proposals = [proposal(0, [0, 1], [2, 1]), proposal(1, [1, 2], [3, 2])]
+    twin_sim = make_sim()
+    Executor(twin_sim, progress_check_interval_s=1.0,
+             time_fn=lambda: twin_sim.now_ms() / 1000.0,
+             sleep_fn=twin_sim.advance).execute_proposals(
+        proposals, reason="twin", wait=True)
+    twin = placement(twin_sim)
+
+    sim = make_sim()
+    with tempfile.TemporaryDirectory() as jdir:
+        journal = ExecutionJournal(
+            jdir, time_fn=lambda: sim.now_ms() / 1000.0)
+        dead = {"dead": False}
+
+        class Proxy:
+            def __getattr__(self, name):
+                real = getattr(sim, name)
+                if not callable(real):
+                    return real
+
+                def call(*a, **k):
+                    if dead["dead"]:
+                        raise RuntimeError("process is dead")
+                    return real(*a, **k)
+                return call
+
+        ex = Executor(Proxy(), progress_check_interval_s=1.0,
+                      journal=journal,
+                      replication_throttle_bytes_per_s=100e6,
+                      time_fn=lambda: sim.now_ms() / 1000.0)
+        sleeps = {"n": 0}
+
+        def crashing_sleep(s):
+            sleeps["n"] += 1
+            if sleeps["n"] == 2:      # mid-inter-broker phase
+                dead["dead"] = True
+                journal.broken = True
+                raise RuntimeError("SIGKILL (simulated)")
+            sim.advance(s)
+        ex._sleep = crashing_sleep
+        uuid = ex.execute_proposals(proposals, reason="drill", wait=True)
+        half_moved = placement(sim) != twin
+        in_flight = bool(sim.list_partition_reassignments())
+
+        dead["dead"] = False          # the replacement process boots
+        t0 = _real_time.monotonic()
+        journal2 = ExecutionJournal(
+            jdir, time_fn=lambda: sim.now_ms() / 1000.0)
+        ex2 = Executor(sim, progress_check_interval_s=1.0,
+                       journal=journal2,
+                       time_fn=lambda: sim.now_ms() / 1000.0,
+                       sleep_fn=sim.advance)
+        report = ex2.recover(mode="resume", wait=True)
+        recovery_s = _real_time.monotonic() - t0
+    resumed_ok = (report is not None and report["uuid"] == uuid
+                  and placement(sim) == twin
+                  and all(b.throttle is None
+                          for b in sim._brokers.values()))
+    return {"scenario": "executor-crash-drill",
+            "ok": half_moved and in_flight and resumed_ok,
+            "uuidPreserved": bool(report and report["uuid"] == uuid),
+            "report": report,
+            "timeToRecoveredS": round(recovery_s, 3)}
+
+
 SCENARIOS = [scenario_quarantine, scenario_ladder_descent_and_recovery,
              scenario_retry_bit_for_bit]
 
@@ -250,11 +355,13 @@ def main(argv) -> int:
     if "--drill" in argv:
         which = argv[argv.index("--drill") + 1] \
             if argv.index("--drill") + 1 < len(argv) else ""
-        if which != "mesh":
-            print(f"unknown drill {which!r}; valid: mesh",
-                  file=sys.stderr)
+        drills = {"mesh": scenario_mesh_drill,
+                  "executor-crash": scenario_executor_crash_drill}
+        if which not in drills:
+            print(f"unknown drill {which!r}; valid: "
+                  f"{', '.join(sorted(drills))}", file=sys.stderr)
             return 2
-        scenarios = [scenario_mesh_drill]
+        scenarios = [drills[which]]
     results = []
     for fn in scenarios:
         try:

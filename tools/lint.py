@@ -49,6 +49,13 @@ Checks:
     comprehensions, or mutable-container constructor calls are
     findings; immutable constants (tuples, frozensets, strings,
     numbers) are fine;
+  * durable-write rule: no `open(..., "w"/"wb")` / `os.rename` /
+    `os.replace` in cruise_control_tpu/ outside utils/persist.py — every
+    persistent-state write must go through the shared atomic
+    write-temp-then-rename / CRC-framing helpers, or a store silently
+    loses the crash-safety contract the executor journal depends on
+    (the PR-13 invariant; append-mode opens are fine — append-only
+    logs are the OTHER audited durability shape);
   * trace-propagation rule (the observability invariant): every
     `SolveJob(...)` construction in the package must carry `trace=`
     (scheduler submissions carry a TraceContext so queue wait, folds
@@ -449,6 +456,69 @@ def _fleet_mutable_globals(path: Path, tree: ast.AST) -> list:
     return findings
 
 
+#: package-relative paths allowed to write/rename files directly: the
+#: shared durable-write helper is the ONLY one — every other module
+#: reaches disk through persist.atomic_write / atomic_rewrite /
+#: replace / open_append (append-mode `open` stays legal everywhere:
+#: append-only logs are the other audited durability shape)
+_PERSIST_ALLOWED_RELPATHS = {"utils/persist.py"}
+
+
+def _write_mode_of(call: ast.Call):
+    """The constant mode string of an open()/os.fdopen() call, or None
+    when absent/dynamic."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+def _durable_write_violations(path: Path, tree: ast.AST) -> list:
+    """Durable-write rule: truncating writes (`open(.., "w"/"wb")`) and
+    renames (`os.rename`/`os.replace`) outside utils/persist.py fail
+    lint — persistent state must be published atomically through the
+    shared helpers (executor/journal.py's crash-recovery guarantees
+    only hold if every store keeps the same discipline)."""
+    parts = path.parts
+    if "cruise_control_tpu" not in parts:
+        return []
+    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
+    rel = "/".join(parts[pkg + 1:])
+    if rel in _PERSIST_ALLOWED_RELPATHS:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = _call_name(func)
+        if name in ("rename", "replace") \
+                and isinstance(func, ast.Attribute) \
+                and _receiver_name(func.value) == "os":
+            findings.append(
+                f"{path}:{node.lineno}: direct os.{name} outside "
+                f"utils/persist.py — publish state through "
+                f"persist.atomic_write/atomic_rewrite/replace "
+                f"(durable-write rule)")
+        elif name in ("open", "fdopen"):
+            if name == "open" and isinstance(func, ast.Attribute) \
+                    and _receiver_name(func.value) != "os":
+                continue          # some_obj.open(...): not file io
+            mode = _write_mode_of(node)
+            if mode is not None and "w" in mode:
+                findings.append(
+                    f"{path}:{node.lineno}: truncating file open "
+                    f"(mode={mode!r}) outside utils/persist.py — a "
+                    f"crash mid-write tears the file; publish through "
+                    f"persist.atomic_write (durable-write rule)")
+    return findings
+
+
 #: names whose CONSTRUCTION is reserved to cruise_control_tpu/obs/ —
 #: span/trace objects built anywhere else bypass the parenting, span-cap
 #: and cross-thread-activation logic of the obs.trace helpers
@@ -582,6 +652,7 @@ def lint_file(path: Path) -> list:
     findings.extend(_progcache_violations(path, tree))
     findings.extend(_model_store_violations(path, tree))
     findings.extend(_watchdog_violations(path, tree))
+    findings.extend(_durable_write_violations(path, tree))
     findings.extend(_fleet_mutable_globals(path, tree))
     findings.extend(_trace_violations(path, tree))
 
