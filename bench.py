@@ -108,6 +108,29 @@ BENCH_CONFIG selects a BASELINE.json eval config:
                    time-to-first-proposal seconds, vs_baseline =
                    cold / warm, >1 = the cache wins)
 
+  soak             trace-replay load harness + SLO gate
+                   (cruise_control_tpu/loadgen/ + tools/slo_gate.py):
+                   serves an in-process demo rig and replays the
+                   seeded `soak-mixed` profile (diurnal mixed-class
+                   traffic: interactive rebalances, scenario sweeps,
+                   precompute churn, heal storms, model-delta streams)
+                   through the REST surface for BENCH_SOAK_SECONDS
+                   (default 20) at BENCH_SOAK_RPS (default 3), seed
+                   BENCH_SOAK_SEED; emits the run ARTIFACT (per-class
+                   p50/p99/p99.9 + queue-wait vs device-time
+                   decomposition from real span trees + 429/occupancy/
+                   coalesce counts + sloStatus) to
+                   BENCH_SOAK_ARTIFACT (default .soak/artifact.json),
+                   self-baselines it, and EXITS 1 unless
+                   tools/slo_gate.py passes the clean run against its
+                   own baseline AND fails a second run with an
+                   injected sched.dispatch latency fault
+                   (BENCH_SOAK_FAULT_S, default 2.0) — proving the
+                   gate actually gates (the output JSON carries a
+                   "soak" block; value = clean USER_INTERACTIVE p99
+                   seconds, vs_baseline = faulted p99 / clean p99, the
+                   regression the gate caught)
+
 Other knobs: BENCH_BROKERS, BENCH_PARTITIONS, BENCH_RF, BENCH_ROUNDS,
 BENCH_GOALS (comma list), BENCH_SEGMENT, BENCH_SKIP_WARMUP.
 
@@ -271,6 +294,8 @@ def main() -> None:
     from cruise_control_tpu.model import state as S
 
     config = os.environ.get("BENCH_CONFIG", "north")
+    if config == "soak":
+        return _soak_bench()
     if config == "scenario":
         return _scenario_bench()
     if config == "sched":
@@ -1492,6 +1517,128 @@ def _sched_bench() -> None:
                         if p99_sched else 0.0),
         "sched": results,
     })))
+
+
+def _soak_bench():
+    """BENCH_CONFIG=soak: the trace-replay soak rig + SLO gate (see
+    the module docstring).  Two runs against fresh in-process demo
+    rigs: a CLEAN run whose artifact self-baselines and must pass
+    tools/slo_gate.py, then a FAULTED run (PR-2 harness:
+    hang_always('sched.dispatch', BENCH_SOAK_FAULT_S) inflates every
+    dispatch) that must FAIL the gate against the clean baseline —
+    the bench proves the gate gates, not just that the harness runs."""
+    import importlib.util
+
+    from cruise_control_tpu.loadgen import (LoadHarness, builtin_profile,
+                                            validate_artifact)
+    from cruise_control_tpu.loadgen.rig import build_demo_rig
+    from cruise_control_tpu.utils import faults
+
+    gate_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools", "slo_gate.py")
+    spec = importlib.util.spec_from_file_location("cc_slo_gate",
+                                                  gate_path)
+    slo_gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(slo_gate)
+
+    duration = float(os.environ.get("BENCH_SOAK_SECONDS", 20.0))
+    rps = float(os.environ.get("BENCH_SOAK_RPS", 3.0))
+    seed = int(os.environ.get("BENCH_SOAK_SEED", 1))
+    fault_s = float(os.environ.get("BENCH_SOAK_FAULT_S", 2.0))
+    out_dir = os.environ.get(
+        "BENCH_SOAK_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".soak"))
+    os.makedirs(out_dir, exist_ok=True)
+    profile = builtin_profile("soak-mixed", duration_s=duration,
+                              rps=rps, seed=seed)
+    print(f"# soak: profile={profile.name} seed={seed} "
+          f"duration={duration}s rps={rps} clients={profile.clients} "
+          f"fault={fault_s}s", file=sys.stderr)
+
+    def one_run(tag: str, fault_plan=None) -> dict:
+        _reset_traces()
+        # build_demo_rig(warm=True) pre-compiles every program shape
+        # BEFORE measuring (and before any fault installs): the soak
+        # measures serving, not first-compile luck
+        rig = build_demo_rig()
+        try:
+            harness = LoadHarness(rig.base_url, profile, rig=rig.rig)
+            if fault_plan is not None:
+                with faults.injected(fault_plan):
+                    artifact = harness.run()
+            else:
+                artifact = harness.run()
+        finally:
+            rig.shutdown()
+        path = os.path.join(out_dir, f"artifact-{tag}.json")
+        with open(path, "w") as fh:
+            json.dump(artifact, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"# soak: {tag} artifact -> {path} "
+              f"({artifact['requests']['total']} requests, "
+              f"{artifact['requests']['rejected']} rejected)",
+              file=sys.stderr)
+        return artifact
+
+    clean = one_run("clean")
+    problems = validate_artifact(clean)
+    clean_path = os.path.join(out_dir, "artifact-clean.json")
+    baseline_path = os.path.join(out_dir, "baseline.json")
+    rc_baseline = slo_gate.main(["--artifact", clean_path,
+                                 "--write-baseline", baseline_path])
+    rc_clean = slo_gate.main(["--artifact", clean_path,
+                              "--baseline", baseline_path])
+
+    plan = faults.FaultPlan()
+    plan.hang_always("sched.dispatch", fault_s)
+    faulted = one_run("faulted", fault_plan=plan)
+    rc_faulted = slo_gate.main(
+        ["--artifact", os.path.join(out_dir, "artifact-faulted.json"),
+         "--baseline", baseline_path])
+
+    clean_p99 = (clean.get("latency", {})
+                 .get("USER_INTERACTIVE", {}).get("p99Ms", 0.0)) / 1e3
+    fault_p99 = (faulted.get("latency", {})
+                 .get("USER_INTERACTIVE", {}).get("p99Ms", 0.0)) / 1e3
+    failures = []
+    if problems:
+        failures.append(f"artifact invalid: {problems}")
+    if rc_baseline != 0:
+        failures.append("baseline write failed")
+    if rc_clean != 0:
+        failures.append("gate FAILED the clean run (must pass)")
+    if rc_faulted == 0:
+        failures.append(f"gate PASSED the faulted run (a {fault_s}s "
+                        f"injected dispatch latency must breach)")
+    if not clean.get("decomposition"):
+        failures.append("per-class decomposition is empty (no span "
+                        "trees reached the artifact)")
+    print(json.dumps(_with_trace_summary({
+        "metric": (f"soak {profile.clients} clients {duration:g}s "
+                   f"mixed-class replay + SLO gate"),
+        "value": round(clean_p99, 4),
+        "unit": "s",
+        # the regression the gate caught: faulted p99 / clean p99
+        "vs_baseline": (round(fault_p99 / clean_p99, 3)
+                        if clean_p99 else 0.0),
+        "soak": {
+            "seed": seed,
+            "planDigest": clean.get("planDigest"),
+            "requests": clean.get("requests"),
+            "latency": clean.get("latency"),
+            "decomposition": clean.get("decomposition"),
+            "slo": {"clean": clean.get("slo", {}).get("status"),
+                    "faulted": faulted.get("slo", {}).get("status")},
+            "gate": {"clean_rc": rc_clean, "faulted_rc": rc_faulted},
+            "artifacts": out_dir,
+            **({"failures": failures} if failures else {}),
+        },
+    })))
+    if failures:
+        for f in failures:
+            print(f"# soak ERROR: {f}", file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

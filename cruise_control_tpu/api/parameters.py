@@ -65,7 +65,10 @@ VALID_PARAMS: Dict[str, Set[str]] = {
     # trees of recent solves — `?trace_id=` fetches the tree a solve
     # response's `traceId` named, `?outcome=degraded` the pinned
     # incident traces (docs/OBSERVABILITY.md)
-    "TRACES": {"trace_id", "outcome", "limit", "verbose", "json"},
+    # `since` (epoch ms) + `min_duration_ms` bound drill queries under
+    # load so --follow tails never page the full ring
+    "TRACES": {"trace_id", "outcome", "limit", "verbose", "json",
+               "since", "min_duration_ms"},
 }
 
 #: fleet tenancy (framework extension, fleet/): EVERY endpoint accepts

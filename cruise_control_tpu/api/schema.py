@@ -126,6 +126,32 @@ _EXECUTOR_RECOVERY = _obj({
     }),
 }, required=["journalEnabled", "recoveryInProgress"])
 
+#: per-class SLO burn state (obs/slo.py; substate `slo`)
+_SLO_CLASS = _obj({
+    "objective": _obj({
+        "latencyMs": _NUM, "queueWaitMs": _NUM, "errorBudget": _NUM,
+    }),
+    "windowSolves": _INT,
+    "queueWaitBurn": _NUM,
+    "deviceTimeBurn": _NUM,
+    "burn": _NUM,
+    "budgetRemaining": _NUM,
+    "status": {"enum": ["ok", "burning", "breach"]},
+}, required=["burn", "status"])
+
+SLO_STATUS = _obj({
+    "enabled": _BOOL,
+    "windowS": _NUM,
+    "alertThreshold": _NUM,
+    "status": {"enum": ["ok", "burning", "breach"]},
+    "worstBurn": _NUM,
+    "worstClass": {"type": ["string", "null"]},
+    "classes": {"type": "object", "additionalProperties": _SLO_CLASS},
+    "detector": _obj({
+        "breachedClasses": _arr(_STR), "reported": _INT,
+    }),
+}, required=["enabled", "status", "worstBurn"])
+
 STATE = _obj({
     "MonitorState": _obj({}, extra=True),
     "ExecutorState": _obj({"recovery": _EXECUTOR_RECOVERY}, extra=True),
@@ -134,6 +160,7 @@ STATE = _obj({
     "SchedulerState": _obj({}, extra=True),
     "FleetState": _obj({}, extra=True),
     "IncrementalStoreState": _obj({}, extra=True),
+    "sloStatus": SLO_STATUS,
     "version": _INT,
 }, required=["version"])
 
@@ -267,6 +294,7 @@ TRACES = _obj({
     "recorder": _obj({
         "capacity": _INT, "retained": _INT, "pinned": _INT,
         "recorded": _INT, "pinnedTotal": _INT, "exportedPins": _INT,
+        "sampledOut": _INT,
     }),
     "version": _INT,
 }, required=["traces", "version"])

@@ -757,7 +757,9 @@ class CruiseControlApp:
                 trace_id=params.get("trace_id"), cluster=cluster,
                 outcome=params.get("outcome"),
                 limit=limit if limit is not None else 32,
-                export=deliver_trees)
+                export=deliver_trees,
+                since_ms=params.get_float("since"),
+                min_duration_ms=params.get_float("min_duration_ms"))
             out = {"traces": traces,
                    "recorder": obs_recorder.get_recorder().to_json(),
                    "version": 1}

@@ -138,9 +138,97 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--limit", type=int)
     p.add_argument("--verbose", action="store_true",
                    help="include full span trees in listings")
+    p.add_argument("--since", type=float, metavar="EPOCH_MS",
+                   help="only traces started at/after this epoch-ms "
+                        "timestamp (drills under load: never page the "
+                        "whole ring)")
+    p.add_argument("--min-duration-ms", type=float,
+                   help="only traces at least this slow")
 
     add("metrics", help="raw OpenMetrics page (/metrics scrape)")
+
+    add("slo", help="per-class SLO burn status (STATE sloStatus: burn "
+                    "rate, queue-wait vs device-time, budget remaining)")
+
+    p = add("loadgen",
+            help="trace-replay load harness (cruise_control_tpu/"
+                 "loadgen/): replay a seeded workload profile against "
+                 "the server and print the run artifact")
+    p.add_argument("--profile", default="soak-mixed",
+                   help="built-in profile name (smoke, soak-mixed, "
+                        "fleet-churn; default soak-mixed)")
+    p.add_argument("--profile-file",
+                   help="JSON profile file (overrides --profile; see "
+                        "docs/LOADGEN.md for the schema)")
+    p.add_argument("--seed", type=int,
+                   help="replay seed: same seed + profile = "
+                        "byte-identical request sequence (default 1 "
+                        "for built-ins; a --profile-file keeps its own "
+                        "seed unless overridden)")
+    p.add_argument("--duration", type=float,
+                   help="rescale the built-in profile to this many "
+                        "seconds")
+    p.add_argument("--rps", type=float,
+                   help="rescale the built-in profile's base rate")
+    p.add_argument("--clients", type=int,
+                   help="override the profile's client count")
+    p.add_argument("--out", help="write the run artifact here (the "
+                                 "summary still prints)")
+    p.add_argument("--demo", action="store_true",
+                   help="serve an in-process demo rig instead of "
+                        "--address (enables the rig-only op kinds: "
+                        "heal storms, precompute churn, model-delta "
+                        "streams)")
     return parser
+
+
+def _run_loadgen(args, auth) -> int:
+    """The `cccli loadgen` subcommand: build/parse the profile, replay
+    it (against --address, or an in-process --demo rig), print the run
+    artifact (optionally to --out) plus a one-line summary."""
+    from cruise_control_tpu.loadgen import (LoadHarness, builtin_profile,
+                                            parse_profile,
+                                            validate_artifact)
+    if args.profile_file:
+        profile = parse_profile(open(args.profile_file).read())
+        if args.seed is not None:
+            profile = parse_profile({**profile.to_json(),
+                                     "seed": args.seed})
+    else:
+        profile = builtin_profile(
+            args.profile, duration_s=args.duration, rps=args.rps,
+            clients=args.clients,
+            seed=args.seed if args.seed is not None else 1)
+    demo = None
+    try:
+        if args.demo:
+            from cruise_control_tpu.loadgen.rig import build_demo_rig
+            print("# starting in-process demo rig...", file=sys.stderr)
+            demo = build_demo_rig()
+            base_url, rig = demo.base_url, demo.rig
+        else:
+            base_url, rig = args.address, None
+        print(f"# replaying {profile.name!r} (seed {profile.seed}, "
+              f"{profile.clients} clients, {profile.duration_s:.0f}s) "
+              f"against {base_url}", file=sys.stderr)
+        harness = LoadHarness(base_url, profile, rig=rig,
+                              auth_header=auth,
+                              max_retries=args.max_retries)
+        artifact = harness.run()
+    finally:
+        if demo is not None:
+            demo.shutdown()
+    problems = validate_artifact(artifact)
+    for p in problems:
+        print(f"# artifact problem: {p}", file=sys.stderr)
+    if args.out:
+        from cruise_control_tpu.utils import persist
+        persist.atomic_write(
+            args.out, (json.dumps(artifact, indent=2, sort_keys=True)
+                       + "\n").encode())
+        print(f"# artifact written to {args.out}", file=sys.stderr)
+    print(json.dumps(artifact, indent=2, sort_keys=True))
+    return 1 if problems else 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -237,10 +325,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif cmd == "traces":
             out = client.traces(trace_id=args.trace_id,
                                 outcome=args.outcome, limit=args.limit,
-                                verbose=args.verbose)
+                                verbose=args.verbose,
+                                since_ms=args.since,
+                                min_duration_ms=args.min_duration_ms)
         elif cmd == "metrics":
             print(client.metrics_text(), end="")
             return 0
+        elif cmd == "slo":
+            out = client.slo_status()
+        elif cmd == "loadgen":
+            return _run_loadgen(args, auth)
         else:  # pragma: no cover
             raise SystemExit(f"unhandled command {cmd}")
     except CruiseControlClientError as exc:

@@ -20,10 +20,17 @@ from cruise_control_tpu.api.user_tasks import USER_TASK_ID_HEADER
 
 
 class CruiseControlClientError(Exception):
-    def __init__(self, status: int, message: str) -> None:
+    def __init__(self, status: int, message: str,
+                 backpressure: bool = False) -> None:
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
         self.message = message
+        #: True when the failure was GENUINE backpressure the client
+        #: retried and gave up on (429, or 503 with a Retry-After
+        #: drain hint) — a bare 503 is a server fault, not
+        #: backpressure, and consumers like the load harness must not
+        #: score it against the lenient rejected-rate cap
+        self.backpressure = backpressure
 
 
 class CruiseControlClient:
@@ -40,7 +47,9 @@ class CruiseControlClient:
                  retry_backoff_max_s: float = 30.0,
                  retry_jitter_token: Optional[str] = None,
                  cluster: Optional[str] = None,
-                 sleep_fn: Optional[Callable[[float], None]] = None
+                 sleep_fn: Optional[Callable[[float], None]] = None,
+                 on_retry: Optional[Callable[[str, int, int, float],
+                                             None]] = None
                  ) -> None:
         self._base = base_url.rstrip("/")
         #: fleet tenant this client addresses: `cluster=<id>` rides on
@@ -66,6 +75,11 @@ class CruiseControlClient:
                               if retry_jitter_token is not None
                               else f"{os.getpid()}:{id(self):x}")
         self._sleep = sleep_fn or time.sleep
+        #: backpressure observer hook: called with (endpoint, status,
+        #: attempt, delay_s) BEFORE each 429/503-draining backoff sleep
+        #: — the load harness counts rejections per request through it;
+        #: exceptions are the caller's problem (None = no observer)
+        self._on_retry = on_retry
 
     # ------------------------------------------------------------------
     def request(self, endpoint: str,
@@ -142,8 +156,11 @@ class CruiseControlClient:
                             "errorMessage",
                             "rejected: solve queue full" if status == 429
                             else "server draining")
-                        + f" (gave up after {retries_429} retries)")
+                        + f" (gave up after {retries_429} retries)",
+                        backpressure=True)
                 retries_429 += 1
+                if self._on_retry is not None:
+                    self._on_retry(endpoint, status, retries_429, delay)
                 self._sleep(delay)
                 continue
             if status == 202 and "reviewResult" in body:
@@ -323,14 +340,25 @@ class CruiseControlClient:
     def traces(self, trace_id: Optional[str] = None,
                outcome: Optional[str] = None,
                limit: Optional[int] = None,
-               verbose: bool = False) -> dict:
+               verbose: bool = False,
+               since_ms: Optional[float] = None,
+               min_duration_ms: Optional[float] = None) -> dict:
         """Flight-recorder query (obs/): the span trees of recent
         solves.  Fetch the tree a solve response's `traceId` named with
         `trace_id=`, the pinned incident traces with
-        `outcome="degraded"`."""
+        `outcome="degraded"`.  `since_ms` (epoch ms) and
+        `min_duration_ms` bound drill queries under load so a tail
+        never pages the whole ring."""
         return self.request("TRACES", {
             "trace_id": trace_id, "outcome": outcome, "limit": limit,
-            "verbose": verbose or None})
+            "verbose": verbose or None, "since": since_ms,
+            "min_duration_ms": min_duration_ms})
+
+    def slo_status(self) -> dict:
+        """The per-class SLO burn block (obs/slo.py): STATE's
+        `sloStatus` substate — burn rate, queue-wait vs device-time
+        decomposition and budget remaining per scheduler class."""
+        return self.state(substates=["slo"]).get("sloStatus", {})
 
     def metrics_text(self) -> str:
         """The raw OpenMetrics page (`/metrics`) — what a Prometheus

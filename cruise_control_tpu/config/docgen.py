@@ -18,6 +18,7 @@ GROUPS = [
     ("Monitor", M.monitor_config_def),
     ("Analyzer", M.analyzer_config_def),
     ("Observability", M.obs_config_def),
+    ("SLO", M.slo_config_def),
     ("Executor", M.executor_config_def),
     ("Anomaly detector", M.anomaly_detector_config_def),
     ("Webserver", M.webserver_config_def),

@@ -523,6 +523,30 @@ def obs_config_def(d: ConfigDef) -> ConfigDef:
              "Emit one structured JSON log line per finished trace "
              "through the `traceLogger` logger (route it to its own "
              "file like the access log).")
+    d.define("obs.trace.sample.rate", Type.DOUBLE, 1.0,
+             in_range(min_value=0.0, max_value=1.0), _M,
+             "Fraction of OK traces handed to the flight recorder "
+             "(deterministic per trace id).  Non-ok traces "
+             "(failed/degraded/fallback/preempted/rejected) are ALWAYS "
+             "kept: at load-harness rates the ring churns in seconds, "
+             "and sampling must thin the healthy wash, never the "
+             "incident evidence.  Sampled-out traces are counted "
+             "(recorder `sampledOut`), and the obs.trace.log.enabled "
+             "stream is NOT sampled — the durable log still carries "
+             "every finished trace.  1.0 = record everything (the "
+             "pre-load-harness behavior).")
+    d.define("obs.metrics.buckets", Type.STRING, "", None, _L,
+             "Per-sensor histogram bucket boundaries: keys of the form "
+             "`obs.metrics.buckets.<sensor-name-or-prefix>` (this bare "
+             "key documents the family; set the SUFFIXED keys) map to "
+             "a CSV of boundaries in SECONDS, e.g. "
+             "`obs.metrics.buckets.sched-wait-hist=0.01,0.05,0.076,"
+             "0.1,0.25,0.7,1.0` — a prefix covers every per-class "
+             "histogram it prefixes.  Needed when the default "
+             "boundaries cannot resolve two latency populations (76 ms "
+             "incremental vs 700 ms full solves); align boundaries "
+             "with `slo.<class>.*` thresholds to make burn rates "
+             "exact.  Applied at histogram creation (startup) only.")
     d.define("obs.metrics.endpoint.enabled", Type.BOOLEAN, True, None,
              _M,
              "Serve the OpenMetrics scrape page at /metrics (outside "
@@ -530,6 +554,57 @@ def obs_config_def(d: ConfigDef) -> ConfigDef:
              "sensor registry, fleet tenants as cluster=\"<id>\" "
              "labeled series, histogram families for queue-wait and "
              "solve latency.")
+    return d
+
+
+def slo_config_def(d: ConfigDef) -> ConfigDef:
+    """service-level objectives (framework extension, obs/slo.py +
+    tools/slo_gate.py + docs/LOADGEN.md): per-scheduler-class latency
+    thresholds and error budgets, burn rate computed live from the
+    sched-* histograms, surfaced as STATE `sloStatus`, `/metrics`
+    `cc_tpu_slo_*` series and the SLO_BURN anomaly"""
+    d.define("slo.enabled", Type.BOOLEAN, True, None, _M,
+             "Evaluate per-class SLO burn rates (obs/slo.py) and "
+             "surface them in STATE `sloStatus`, the `slo-*` sensors "
+             "and the SLO_BURN anomaly.  Disabled, the sloStatus block "
+             "reports enabled=false and no SLO_BURN ever fires.")
+    d.define("slo.window.ms", Type.LONG, 300_000, in_range(min_value=1000),
+             _M,
+             "Sliding window the burn rate is computed over: burn = "
+             "(fraction of the window's observations over threshold) / "
+             "error budget, so a breach ages out once the window rolls "
+             "past it.")
+    d.define("slo.evaluation.interval.ms", Type.LONG, 15_000,
+             in_range(min_value=100), _L,
+             "Interval of the scheduled SLO_BURN detector "
+             "(detector/slo_burn.py); gauges and STATE refresh "
+             "opportunistically on read regardless.")
+    d.define("slo.burn.alert.threshold", Type.DOUBLE, 2.0,
+             in_range(min_value=1.0), _M,
+             "Burn rate at which a class enters `breach` status and "
+             "the SLO_BURN anomaly fires (2.0 = consuming budget at "
+             "twice the sustainable rate).  Between 1.0 and this the "
+             "class reports `burning` without alerting.")
+    for klass, latency_ms, wait_ms, budget in (
+            ("anomaly-heal", 5_000, 1_000, 0.01),
+            ("user-interactive", 2_000, 500, 0.02),
+            ("precompute", 30_000, 10_000, 0.05),
+            ("scenario-sweep", 60_000, 30_000, 0.05)):
+        d.define(f"slo.{klass}.latency.ms", Type.LONG, latency_ms,
+                 in_range(min_value=1), _M,
+                 f"Device-time objective for {klass.upper().replace('-', '_')} "
+                 f"solves: a dispatch slower than this consumes error "
+                 f"budget (measured on sched-device-busy-hist-{klass}).")
+        d.define(f"slo.{klass}.queue.wait.ms", Type.LONG, wait_ms,
+                 in_range(min_value=1), _M,
+                 f"Queue-wait objective for {klass.upper().replace('-', '_')}: "
+                 f"waiting longer than this before dispatch consumes "
+                 f"error budget (measured on sched-wait-hist-{klass}).")
+        d.define(f"slo.{klass}.error.budget", Type.DOUBLE, budget,
+                 in_range(min_value=1e-6, max_value=1.0), _M,
+                 f"Fraction of {klass.upper().replace('-', '_')} "
+                 f"observations allowed over threshold per window; "
+                 f"burn = actual over-threshold fraction / this.")
     return d
 
 
@@ -894,6 +969,7 @@ def config_def() -> ConfigDef:
     monitor_config_def(d)
     analyzer_config_def(d)
     obs_config_def(d)
+    slo_config_def(d)
     executor_config_def(d)
     anomaly_detector_config_def(d)
     webserver_config_def(d)

@@ -44,6 +44,13 @@ class AnomalyType(enum.Enum):
     #: uuid, adopted/sealed task counts, cleared throttles,
     #: flight-recorder dump) through the notifier plane
     EXECUTION_RECOVERY = 7
+    #: a per-class SLO error budget is burning faster than the alert
+    #: threshold (obs/slo.py burn rate over the sched-* histograms) —
+    #: notification-only: the remediation is operational (shed
+    #: SCENARIO_SWEEP load, raise capacity, investigate the slow
+    #: dimension), the anomaly routes the evidence (class, queue-wait
+    #: vs device-time burn, objective) through the notifier plane
+    SLO_BURN = 8
 
 
 class Anomaly(abc.ABC):

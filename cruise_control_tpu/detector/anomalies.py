@@ -269,6 +269,51 @@ class ExecutionRecovery(Anomaly):
 
 
 @dataclasses.dataclass
+class SloBurn(Anomaly):
+    """A scheduler class is burning its SLO error budget faster than
+    the alert threshold (obs/slo.py: burn computed live from the
+    sched-*-hist histograms over a sliding window).  Notification-only
+    — there is no automated fix; the runbook (docs/OPERATIONS.md §5
+    "SLO burn") distinguishes queue-wait burn (admission pressure:
+    shed SCENARIO_SWEEP, raise capacity) from device-time burn (solves
+    got slower: ladder rung, cache storms, model growth).  One anomaly
+    per breach EPISODE: the detector re-arms only after the burn drops
+    back under 1.0 (detector/slo_burn.py)."""
+
+    scheduler_class: str
+    burn: float
+    queue_wait_burn: float
+    device_time_burn: float
+    window_s: float
+    alert_threshold: float
+    objective: Dict[str, float] = dataclasses.field(default_factory=dict)
+    description: str = ""
+    detected_ms: float = 0.0
+    _id: str = dataclasses.field(
+        default_factory=lambda: _new_id("slo-burn"))
+
+    @property
+    def anomaly_type(self) -> AnomalyType:
+        return AnomalyType.SLO_BURN
+
+    @property
+    def anomaly_id(self) -> str:
+        return self._id
+
+    def fix(self) -> bool:
+        return False   # operational remediation only (runbook)
+
+    def __str__(self) -> str:
+        dominant = ("queue-wait" if self.queue_wait_burn
+                    >= self.device_time_burn else "device-time")
+        return (f"SloBurn({self.scheduler_class}: burn={self.burn:.2f}x "
+                f"budget over {self.window_s:.0f}s [{dominant}-driven: "
+                f"queueWait={self.queue_wait_burn:.2f} "
+                f"deviceTime={self.device_time_burn:.2f}], alert at "
+                f"{self.alert_threshold:.1f}x, {self.description})")
+
+
+@dataclasses.dataclass
 class TopicAnomaly(Anomaly):
     """Topics violating a policy — e.g. replication factor != target
     (reference TopicReplicationFactorAnomaly.java) or oversized partitions

@@ -1,0 +1,71 @@
+"""SLO burn detector: the obs/slo.py evaluator's anomaly-plane edge.
+
+A scheduled detector (anomaly_detector.register_detector, same contract
+as the goal-violation/disk/topic detectors): every tick it forces an
+SLO evaluation and reports ONE SloBurn anomaly per class per breach
+EPISODE — a class whose burn crossed `slo.burn.alert.threshold` fires
+once, then stays armed-off until its burn drops back under 1.0 (budget
+earning again), so a sustained incident does not spam the notifier on
+every tick while a relapse after recovery alerts again.
+
+Notification-only by design: the SelfHealingNotifier default leaves
+SLO_BURN self-healing disabled (there is nothing mechanical to heal —
+the runbook in docs/OPERATIONS.md §5 is the fix), so the anomaly lands
+as an alert with the queue-wait vs device-time decomposition operators
+triage from.
+"""
+from __future__ import annotations
+
+import logging
+import time as _time
+from typing import Callable, Optional
+
+from cruise_control_tpu.detector.anomalies import SloBurn
+
+LOG = logging.getLogger(__name__)
+
+
+class SloBurnDetector:
+    """See module docstring."""
+
+    def __init__(self, evaluator, report_fn: Callable[[SloBurn], None],
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._evaluator = evaluator
+        self._report = report_fn
+        self._time = time_fn or _time.time
+        #: classes currently inside a reported breach episode
+        self._breached: set = set()
+        self.reported = 0
+
+    def detect_now(self) -> None:
+        status = self._evaluator.evaluate(force=True)
+        if not status.get("enabled", False):
+            return
+        alert_at = status["alertThreshold"]
+        for klass, cls in status.get("classes", {}).items():
+            burn = float(cls.get("burn", 0.0))
+            if burn >= alert_at and klass not in self._breached:
+                self._breached.add(klass)
+                self.reported += 1
+                anomaly = SloBurn(
+                    scheduler_class=klass,
+                    burn=burn,
+                    queue_wait_burn=float(cls.get("queueWaitBurn", 0.0)),
+                    device_time_burn=float(cls.get("deviceTimeBurn", 0.0)),
+                    window_s=float(status.get("windowS", 0.0)),
+                    alert_threshold=float(alert_at),
+                    objective=dict(cls.get("objective", {})),
+                    description=(f"{cls.get('windowSolves', 0)} solves "
+                                 f"in window"),
+                    detected_ms=self._time() * 1000.0)
+                LOG.warning("SLO burn: %s", anomaly)
+                self._report(anomaly)
+            elif burn < 1.0:
+                # episode over only once the budget is earning again —
+                # hovering between 1.0 and the alert threshold neither
+                # re-fires nor re-arms
+                self._breached.discard(klass)
+
+    def to_json(self) -> dict:
+        return {"breachedClasses": sorted(self._breached),
+                "reported": self.reported}

@@ -235,7 +235,14 @@ class CruiseControl:
                  obs_tracing_enabled: Optional[bool] = None,
                  obs_trace_log_enabled: Optional[bool] = None,
                  obs_flight_recorder_capacity: Optional[int] = None,
-                 obs_flight_recorder_max_pinned: Optional[int] = None
+                 obs_flight_recorder_max_pinned: Optional[int] = None,
+                 obs_trace_sample_rate: Optional[float] = None,
+                 metrics_bucket_overrides: Optional[dict] = None,
+                 slo_enabled: bool = True,
+                 slo_objectives: Optional[dict] = None,
+                 slo_window_s: float = 300.0,
+                 slo_alert_threshold: float = 2.0,
+                 slo_evaluation_interval_s: float = 15.0
                  ) -> None:
         self._admin = admin
         self._time = time_fn or _time.time
@@ -302,9 +309,11 @@ class CruiseControl:
         # obs.* keys) reconfigures the process-wide state — direct
         # facade construction (tests, embedding) leaves it as found.
         if obs_tracing_enabled is not None \
-                or obs_trace_log_enabled is not None:
+                or obs_trace_log_enabled is not None \
+                or obs_trace_sample_rate is not None:
             obs_trace.configure(enabled=obs_tracing_enabled,
-                                trace_log_enabled=obs_trace_log_enabled)
+                                trace_log_enabled=obs_trace_log_enabled,
+                                sample_rate=obs_trace_sample_rate)
         if obs_flight_recorder_capacity is not None \
                 or obs_flight_recorder_max_pinned is not None:
             obs_recorder.configure(
@@ -575,8 +584,11 @@ class CruiseControl:
                                 if fleet_binding is not None
                                 else f"cc-{id(self):x}")
 
-        # sensors (reference dropwizard registry, SURVEY.md §5.1)
-        self.metrics = MetricRegistry(self._time)
+        # sensors (reference dropwizard registry, SURVEY.md §5.1).
+        # Bucket overrides (obs.metrics.buckets.<name>) install BEFORE
+        # any histogram exists — boundaries apply at creation only
+        self.metrics = MetricRegistry(
+            self._time, bucket_overrides=metrics_bucket_overrides)
         self.metrics.gauge(
             "balancedness-score",
             lambda: self.goal_violation_detector.last_balancedness_score)
@@ -683,6 +695,33 @@ class CruiseControl:
         # over one scheduler's meter bindings
         if self._owns_scheduler:
             self.solve_scheduler.attach_metrics(self.metrics)
+
+        # SLO layer (obs/slo.py): per-class burn rates over the
+        # scheduler's histograms, surfaced as STATE sloStatus, slo-*
+        # gauges on /metrics, and the SLO_BURN anomaly through the
+        # detector.  Under a SHARED (fleet) scheduler the histograms
+        # live on the fleet's registry — the evaluator reads wherever
+        # the scheduler's metrics actually land, while the gauges stay
+        # on THIS facade's registry.
+        from cruise_control_tpu.detector.slo_burn import SloBurnDetector
+        from cruise_control_tpu.obs.slo import SloEvaluator
+        sched_registry = (self.metrics if self._owns_scheduler
+                          else (getattr(self.solve_scheduler, "_metrics",
+                                        None) or self.metrics))
+        self.slo_evaluator = SloEvaluator(
+            sched_registry,
+            objectives=slo_objectives,
+            enabled=slo_enabled,
+            window_s=slo_window_s,
+            alert_threshold=slo_alert_threshold,
+            time_fn=self._time)
+        self.slo_evaluator.attach_metrics(self.metrics)
+        self.slo_burn_detector = SloBurnDetector(
+            self.slo_evaluator, self.anomaly_detector.report,
+            time_fn=self._time)
+        if slo_enabled:
+            self.anomaly_detector.register_detector(
+                self.slo_burn_detector, slo_evaluation_interval_s)
 
     # ------------------------------------------------------------------
     # lifecycle (reference startUp order :178-184)
@@ -2193,7 +2232,7 @@ class CruiseControl:
         want = {s.lower() for s in (substates or
                                     ("monitor", "executor", "analyzer",
                                      "anomaly_detector", "scenario",
-                                     "scheduler", "incremental"))}
+                                     "scheduler", "incremental", "slo"))}
         out: dict = {}
         if "monitor" in want:
             ms = self.load_monitor.get_state()
@@ -2252,6 +2291,15 @@ class CruiseControl:
             out["IncrementalStoreState"] = {
                 "enabled": self._incremental_enabled,
                 **self._model_store.to_json(),
+            }
+        if "slo" in want:
+            # per-class SLO burn (obs/slo.py): the operator's first
+            # stop when the load harness / a pager says an error
+            # budget is burning — queue-wait vs device-time burn per
+            # scheduler class, plus the breach-episode detector state
+            out["sloStatus"] = {
+                **self.slo_evaluator.evaluate(),
+                "detector": self.slo_burn_detector.to_json(),
             }
         if "sensors" in want:
             out["Sensors"] = self.metrics.to_json()

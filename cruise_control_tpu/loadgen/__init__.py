@@ -1,0 +1,30 @@
+"""Fleet-scale trace-replay load harness (ROADMAP item 5).
+
+Declarative workload profiles (loadgen/profile.py) compile into a
+byte-reproducible per-client request plan (loadgen/plan.py) that the
+harness (loadgen/harness.py) replays against the REST surface through
+the existing retrying client — honoring 429/503 Retry-After like real
+clients — while scraping `/metrics`, STATE and the TRACES endpoint;
+the run ends in ONE artifact (loadgen/artifact.py) carrying per-class
+p50/p99/p99.9, the queue-wait vs device-time decomposition from real
+span trees, 429 rates, occupancy, coalesce/fold/preempt counts, sensor
+deltas and the SLO status — the evidence `tools/slo_gate.py` gates on
+and every later perf PR cites (`BENCH_CONFIG=soak`).
+"""
+from cruise_control_tpu.loadgen.artifact import (ARTIFACT_VERSION,
+                                                 build_artifact,
+                                                 validate_artifact)
+from cruise_control_tpu.loadgen.harness import LoadHarness, LocalRig
+from cruise_control_tpu.loadgen.plan import (PlannedRequest, build_plan,
+                                             plan_digest)
+from cruise_control_tpu.loadgen.profile import (OP_CLASS, OP_KINDS,
+                                                LoadProfile, Phase,
+                                                builtin_profile,
+                                                parse_profile)
+
+__all__ = [
+    "ARTIFACT_VERSION", "LoadHarness", "LoadProfile", "LocalRig",
+    "OP_CLASS", "OP_KINDS", "Phase", "PlannedRequest", "build_artifact",
+    "build_plan", "builtin_profile", "parse_profile", "plan_digest",
+    "validate_artifact",
+]

@@ -1,0 +1,262 @@
+"""The run artifact: one JSON document per load-harness run.
+
+Everything `tools/slo_gate.py` gates on and a BENCH_r* round cites
+lives here: client-side per-class latency percentiles, the queue-wait
+vs device-time decomposition computed from REAL span trees (the TRACES
+endpoint, not client clocks), backpressure counts, scheduler
+occupancy/coalesce/fold/preempt counters, sensor deltas across the
+run, the sloStatus block, and enough provenance (profile, seed, plan
+digest) to reproduce the run byte for byte.
+
+`validate_artifact` is a dependency-free structural check (the repo
+deliberately has no jsonschema dependency): required keys, types, and
+cross-field sanity — the smoke test pins that a real run validates and
+the gate refuses artifacts that don't.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+LOG = logging.getLogger(__name__)
+
+ARTIFACT_VERSION = 1
+
+#: sensor-delta allowlist: counters whose run-over-run movement the
+#: artifact records (meters/counters diffed on their `count`)
+DELTA_SENSORS = (
+    "sched-dispatches", "sched-coalesced-requests",
+    "sched-folded-sweeps", "sched-preemptions",
+    "sched-rejected-requests", "sched-mesh-requeues",
+    "incremental-store-hits", "incremental-store-fallbacks",
+    "incremental-store-delta-applies", "progcache-hits",
+    "progcache-fresh-compiles", "solver-retries", "solver-descents",
+    "fleet-folded-solves",
+)
+
+
+def _pct(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (the bench.py convention)."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1,
+                       int(round(q * (len(ordered) - 1))))]
+
+
+def _latency_block(values_s: List[float]) -> dict:
+    return {
+        "count": len(values_s),
+        "p50Ms": round(_pct(values_s, 0.50) * 1e3, 3),
+        "p99Ms": round(_pct(values_s, 0.99) * 1e3, 3),
+        "p999Ms": round(_pct(values_s, 0.999) * 1e3, 3),
+        "maxMs": round(max(values_s) * 1e3, 3) if values_s else 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# span-tree decomposition
+# ---------------------------------------------------------------------------
+def _span_sum(node: dict, name: str) -> float:
+    """Total durationMs of every span named `name` in a trace tree."""
+    total = 0.0
+    if node.get("name") == name:
+        total += float(node.get("durationMs", 0.0))
+    for child in node.get("children", []):
+        total += _span_sum(child, name)
+    return total
+
+
+def decompose_traces(traces: List[dict]) -> Dict[str, dict]:
+    """Per-scheduler-class queue-wait vs device-time attribution from
+    span trees: `sched.queue-wait` is admission delay, `sched.dispatch`
+    is time ON the device token (the solve itself).  Only traces that
+    carry both a schedulerClass tag and a span tree participate."""
+    by_class: Dict[str, Dict[str, List[float]]] = {}
+    for doc in traces:
+        klass = doc.get("tags", {}).get("schedulerClass")
+        root = doc.get("root")
+        if not klass or not root:
+            continue
+        waits = _span_sum(root, "sched.queue-wait")
+        device = _span_sum(root, "sched.dispatch")
+        if waits == 0.0 and device == 0.0:
+            continue        # cache-served / coalesced-away: no solve
+        bucket = by_class.setdefault(klass, {"wait": [], "device": [],
+                                             "total": []})
+        bucket["wait"].append(waits)
+        bucket["device"].append(device)
+        bucket["total"].append(float(doc.get("durationMs", 0.0)))
+    out: Dict[str, dict] = {}
+    for klass, b in sorted(by_class.items()):
+        out[klass] = {
+            "traces": len(b["total"]),
+            "queueWaitMs": {"p50": round(_pct(b["wait"], 0.5), 3),
+                            "p99": round(_pct(b["wait"], 0.99), 3),
+                            "mean": round(sum(b["wait"])
+                                          / len(b["wait"]), 3)},
+            "deviceMs": {"p50": round(_pct(b["device"], 0.5), 3),
+                         "p99": round(_pct(b["device"], 0.99), 3),
+                         "mean": round(sum(b["device"])
+                                       / len(b["device"]), 3)},
+        }
+    return out
+
+
+def _sensor_deltas(before: dict, after: dict) -> dict:
+    out = {}
+    for name in DELTA_SENSORS:
+        b = before.get(name, {})
+        a = after.get(name, {})
+        if not isinstance(a, dict):
+            continue
+        delta = (a.get("count", 0) or 0) - ((b.get("count", 0) or 0)
+                                            if isinstance(b, dict) else 0)
+        if a or delta:
+            out[name] = delta
+    return out
+
+
+def _metrics_summary(text: str) -> dict:
+    """Proof-of-scrape summary of the OpenMetrics page: line/family
+    counts plus the slo_* family names (the acceptance surface)."""
+    if not text:
+        return {"scraped": False}
+    lines = text.splitlines()
+    families = [ln.split()[2] for ln in lines
+                if ln.startswith("# TYPE ") and len(ln.split()) >= 3]
+    return {
+        "scraped": True,
+        "lines": len(lines),
+        "families": len(families),
+        "sloSeries": sorted(f for f in families if "_slo_" in f
+                            or f.startswith("cc_tpu_slo_")),
+        "schedHistograms": sorted(
+            f for f in families
+            if f.startswith("cc_tpu_sched_") and f.endswith("_seconds")),
+    }
+
+
+# ---------------------------------------------------------------------------
+def build_artifact(profile, digest: str, plan, records, wall_s: float,
+                   started_at_ms: float,
+                   sensors_before: dict, sensors_after: dict,
+                   scheduler_state: dict, slo_status: dict,
+                   traces: List[dict], metrics_text: str = "") -> dict:
+    """Assemble the run artifact (see module docstring)."""
+    by_status: Dict[str, int] = {}
+    by_kind: Dict[str, int] = {}
+    latencies: Dict[str, List[float]] = {}
+    retries = 0
+    late: List[float] = []
+    for rec in records:
+        by_status[rec.status] = by_status.get(rec.status, 0) + 1
+        by_kind[rec.planned.kind] = by_kind.get(rec.planned.kind, 0) + 1
+        retries += rec.retries
+        late.append(rec.started_late_s)
+        if rec.status == "ok" and rec.planned.klass:
+            latencies.setdefault(rec.planned.klass,
+                                 []).append(rec.latency_s)
+    total = len(records)
+    rejected = by_status.get("rejected", 0)
+    # rates are over EXECUTED requests: rig-only kinds skipped against
+    # a remote server must not dilute the gate's error/rejection caps
+    executed = max(1, total - by_status.get("skipped", 0))
+    return {
+        "loadgenArtifact": ARTIFACT_VERSION,
+        "profile": profile.to_json(),
+        "seed": profile.seed,
+        "planDigest": digest,
+        "plannedRequests": len(plan),
+        "startedAtMs": round(started_at_ms, 3),
+        "wallS": round(wall_s, 3),
+        "requests": {
+            "total": total,
+            "executed": executed if total else 0,
+            "ok": by_status.get("ok", 0),
+            "errors": by_status.get("error", 0),
+            "rejected": rejected,
+            "skipped": by_status.get("skipped", 0),
+            "retries": retries,
+            "rejectedRate": (round(rejected / executed, 4)
+                             if total else 0.0),
+            "byKind": dict(sorted(by_kind.items())),
+            "schedulingLagP99Ms": round(_pct(late, 0.99) * 1e3, 3),
+        },
+        "latency": {klass: _latency_block(vals)
+                    for klass, vals in sorted(latencies.items())},
+        "decomposition": decompose_traces(traces),
+        "scheduler": {
+            k: scheduler_state.get(k) for k in
+            ("occupancy", "deviceBusySeconds", "coalesced", "folded",
+             "preemptions", "rejections", "completed", "failed")
+            if k in scheduler_state},
+        "sensorDeltas": _sensor_deltas(sensors_before, sensors_after),
+        "slo": slo_status,
+        "metricsScrape": _metrics_summary(metrics_text),
+        "errors": [
+            {"kind": r.planned.kind, "client": r.planned.client,
+             "seq": r.planned.seq, "error": r.error}
+            for r in records if r.status == "error"][:32],
+    }
+
+
+# ---------------------------------------------------------------------------
+# structural validation (dependency-free)
+# ---------------------------------------------------------------------------
+def validate_artifact(doc: dict) -> List[str]:
+    """Structural problems with a run artifact ([] = valid).  The gate
+    refuses artifacts with problems; the smoke test pins that a real
+    run produces none."""
+    problems: List[str] = []
+
+    def need(key: str, typ) -> Optional[object]:
+        if key not in doc:
+            problems.append(f"missing key {key!r}")
+            return None
+        if not isinstance(doc[key], typ):
+            problems.append(
+                f"{key!r} must be {getattr(typ, '__name__', typ)}, got "
+                f"{type(doc[key]).__name__}")
+            return None
+        return doc[key]
+
+    version = need("loadgenArtifact", int)
+    if version is not None and version != ARTIFACT_VERSION:
+        problems.append(f"unknown artifact version {version}")
+    need("profile", dict)
+    need("seed", int)
+    digest = need("planDigest", str)
+    if digest is not None and len(digest) != 64:
+        problems.append("planDigest must be a sha256 hex digest")
+    need("startedAtMs", (int, float))
+    need("wallS", (int, float))
+    requests = need("requests", dict)
+    if requests is not None:
+        for key in ("total", "ok", "errors", "rejected", "skipped"):
+            if not isinstance(requests.get(key), int):
+                problems.append(f"requests.{key} must be an int")
+    latency = need("latency", dict)
+    if latency is not None:
+        for klass, block in latency.items():
+            for key in ("count", "p50Ms", "p99Ms", "p999Ms"):
+                if not isinstance(block.get(key), (int, float)):
+                    problems.append(
+                        f"latency.{klass}.{key} must be numeric")
+    decomposition = need("decomposition", dict)
+    if decomposition is not None:
+        for klass, block in decomposition.items():
+            for dim in ("queueWaitMs", "deviceMs"):
+                sub = block.get(dim)
+                if not isinstance(sub, dict) \
+                        or not isinstance(sub.get("p99"), (int, float)):
+                    problems.append(
+                        f"decomposition.{klass}.{dim} must carry "
+                        f"numeric percentiles")
+    slo = need("slo", dict)
+    if slo is not None and slo:
+        if "classes" not in slo or "status" not in slo:
+            problems.append("slo block must carry status + classes")
+    need("sensorDeltas", dict)
+    need("metricsScrape", dict)
+    return problems

@@ -1,0 +1,277 @@
+"""Executes a compiled request plan against the REST surface.
+
+One worker thread per profile client, each with its OWN
+CruiseControlClient whose retry-jitter token derives from (seed,
+client) — so even backoff delays are reproducible — replaying its
+slice of the plan open-loop: a worker sleeps until each request's
+planned offset and fires, running late when the server is slower than
+the plan rather than silently thinning the load.  429/503 Retry-After
+is honored by the client exactly as production clients honor it; every
+backoff is counted per request through the client's `on_retry` hook.
+
+REST-less kinds (heal / precompute / model_delta / tenant_cycle) run
+through a LocalRig's callables when one is provided — the in-process
+demo rig (loadgen/rig.py) wires them to the facade — and are counted
+as `skipped` against a remote server, never silently dropped.
+
+The run ends in ONE artifact (loadgen/artifact.py): client-side
+per-class latency percentiles, the queue-wait vs device-time
+decomposition pulled from the TRACES endpoint's real span trees
+(`?since=` the run's start), sensor deltas from STATE, the scheduler
+block, the sloStatus block, and a `/metrics` scrape summary.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time as _time
+from typing import Callable, List, Optional
+
+from cruise_control_tpu.client.client import (CruiseControlClient,
+                                              CruiseControlClientError)
+from cruise_control_tpu.loadgen import artifact as artifact_mod
+from cruise_control_tpu.loadgen.plan import (PlannedRequest, build_plan,
+                                             plan_digest)
+from cruise_control_tpu.loadgen.profile import RIG_KINDS, LoadProfile
+
+LOG = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class LocalRig:
+    """In-process hooks for the kinds the REST surface does not expose.
+    Each callable runs ON the worker thread (its latency is measured
+    like any request); None = that kind is skipped-and-counted."""
+
+    heal: Optional[Callable[[], object]] = None
+    precompute: Optional[Callable[[], object]] = None
+    #: receives the planned op's params dict ({"partition", "cpu",
+    #: "nw_in", "nw_out", "disk"}) and applies a real ModelDelta
+    apply_model_delta: Optional[Callable[[dict], object]] = None
+    tenant_cycle: Optional[Callable[[], object]] = None
+
+    def hook_for(self, kind: str):
+        return {"heal": self.heal, "precompute": self.precompute,
+                "model_delta": self.apply_model_delta,
+                "tenant_cycle": self.tenant_cycle}.get(kind)
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """One executed (or skipped) planned request."""
+
+    planned: PlannedRequest
+    status: str            # ok | error | rejected | skipped
+    latency_s: float
+    started_late_s: float
+    retries: int = 0
+    error: str = ""
+    trace_id: str = ""
+
+
+class LoadHarness:
+    """See module docstring."""
+
+    def __init__(self, base_url: str, profile: LoadProfile,
+                 rig: Optional[LocalRig] = None,
+                 auth_header: Optional[str] = None,
+                 max_retries: int = 4,
+                 request_timeout_s: float = 120.0,
+                 poll_interval_s: float = 0.05,
+                 time_fn: Optional[Callable[[], float]] = None,
+                 sleep_fn: Optional[Callable[[float], None]] = None
+                 ) -> None:
+        self._base = base_url
+        self.profile = profile
+        self._rig = rig
+        self._auth = auth_header
+        self._max_retries = max_retries
+        self._timeout_s = request_timeout_s
+        self._poll_s = poll_interval_s
+        self._time = time_fn or _time.time
+        self._sleep = sleep_fn or _time.sleep
+        self.records: List[RequestRecord] = []
+        self._records_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def _client_for(self, client_idx: int,
+                    retry_counts: dict) -> CruiseControlClient:
+        def on_retry(endpoint: str, status: int, attempt: int,
+                     delay_s: float) -> None:
+            with self._records_lock:
+                key = "429" if status == 429 else "503"
+                retry_counts[key] = retry_counts.get(key, 0) + 1
+        return CruiseControlClient(
+            self._base, auth_header=self._auth,
+            poll_interval_s=self._poll_s,
+            timeout_s=self._timeout_s,
+            max_retries_429=self._max_retries,
+            # deterministic per-(seed, client) jitter identity: a
+            # rejected fleet's retry delays replay byte-identically
+            retry_jitter_token=f"loadgen:{self.profile.seed}:{client_idx}",
+            on_retry=on_retry)
+
+    def _execute(self, client: CruiseControlClient, req: PlannedRequest):
+        """Run one planned op; returns (status, trace_id)."""
+        kind = req.kind
+        if kind in RIG_KINDS:
+            hook = self._rig.hook_for(kind) if self._rig else None
+            if hook is None:
+                return "skipped", ""
+            if kind == "model_delta":
+                hook(dict(req.params))
+            else:
+                hook()
+            return "ok", ""
+        if kind == "rebalance":
+            body = client.rebalance(
+                dryrun=True,
+                ignore_proposal_cache=bool(
+                    req.params.get("ignore_proposal_cache")))
+        elif kind == "proposals":
+            body = client.proposals(
+                ignore_proposal_cache=bool(
+                    req.params.get("ignore_proposal_cache")))
+        elif kind == "fix_offline":
+            body = client.fix_offline_replicas(dryrun=True)
+        elif kind == "scenarios":
+            body = client.scenarios(
+                req.body.get("scenarios", []),
+                include_base=req.body.get("includeBase", True))
+        elif kind == "state":
+            body = client.state(
+                substates=str(req.params.get("substates", "")).split(","))
+        elif kind == "load":
+            body = client.load()
+        else:  # pragma: no cover - parse_profile rejects unknown kinds
+            raise ValueError(f"unhandled op kind {kind!r}")
+        return "ok", (body.get("traceId", "")
+                      if isinstance(body, dict) else "")
+
+    def _worker(self, client_idx: int, plan: List[PlannedRequest],
+                t0: float) -> None:
+        retry_counts: dict = {}
+        client = self._client_for(client_idx, retry_counts)
+        for req in plan:
+            due = t0 + req.at_s
+            now = self._time()
+            if due > now:
+                self._sleep(due - now)
+            started = self._time()
+            retry_counts.clear()
+            status, trace_id, error = "ok", "", ""
+            try:
+                status, trace_id = self._execute(client, req)
+            except CruiseControlClientError as exc:
+                # backpressure the client retried and gave up on (429,
+                # or the 503-draining signature) is REJECTED; a bare
+                # 503 or any other status is a server FAULT — scoring
+                # it as backpressure would let the gate's lenient
+                # rejected-rate cap hide a failing server
+                status = ("rejected" if exc.backpressure else "error")
+                error = exc.message
+                if status == "error":
+                    LOG.warning("loadgen client %d %s #%d failed: %s",
+                                client_idx, req.kind, req.seq, error)
+            except Exception as exc:  # noqa: BLE001 - a failed request
+                # is a data point, not a harness crash
+                status = "error"
+                error = f"{type(exc).__name__}: {exc}"
+                LOG.warning("loadgen client %d %s #%d failed: %s",
+                            client_idx, req.kind, req.seq, error)
+            record = RequestRecord(
+                planned=req, status=status,
+                latency_s=self._time() - started,
+                started_late_s=max(0.0, started - due),
+                retries=sum(retry_counts.values()),
+                error=error, trace_id=trace_id)
+            with self._records_lock:
+                self.records.append(record)
+
+    # ------------------------------------------------------------------
+    def run(self) -> dict:
+        """Replay the profile and return the run artifact."""
+        plan = build_plan(self.profile)
+        digest = plan_digest(plan)
+        missing = ([k for k in self.profile.rig_kinds_used()
+                    if self._rig is None
+                    or self._rig.hook_for(k) is None])
+        if missing:
+            LOG.warning("profile %s uses rig-only kinds %s without a "
+                        "rig hook; those requests will be counted as "
+                        "skipped", self.profile.name, missing)
+        scrape_client = self._client_for(-1, {})
+        sensors_before = self._sensors(scrape_client)
+        # establish the SLO evaluator's window base BEFORE load: burn
+        # is a delta between histogram snapshots, so without this the
+        # end-of-run evaluation would have nothing to diff against
+        self._slo(scrape_client)
+        self.records = []
+        by_client: dict = {}
+        for req in plan:
+            by_client.setdefault(req.client, []).append(req)
+        t0 = self._time()
+        threads = [threading.Thread(
+            target=self._worker, args=(ci, reqs, t0),
+            name=f"loadgen-client-{ci}", daemon=True)
+            for ci, reqs in sorted(by_client.items())]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_s = self._time() - t0
+        # post-run scrapes: sensors/scheduler/slo from STATE, span
+        # trees from TRACES (bounded to this run via ?since=), and the
+        # OpenMetrics page the artifact summarizes
+        sensors_after = self._sensors(scrape_client)
+        sched_state = self._scheduler_state(scrape_client)
+        slo_status = self._slo(scrape_client)
+        traces = self._traces(scrape_client, since_ms=t0 * 1000.0)
+        metrics_text = self._metrics_text(scrape_client)
+        return artifact_mod.build_artifact(
+            profile=self.profile, digest=digest, plan=plan,
+            records=self.records, wall_s=wall_s,
+            started_at_ms=t0 * 1000.0,
+            sensors_before=sensors_before, sensors_after=sensors_after,
+            scheduler_state=sched_state, slo_status=slo_status,
+            traces=traces, metrics_text=metrics_text)
+
+    # -- scrape helpers (every one best-effort: a scrape failure makes
+    # -- a poorer artifact, never a failed run) -------------------------
+    def _sensors(self, client) -> dict:
+        try:
+            return client.state(substates=["sensors"]).get("Sensors", {})
+        except Exception as exc:  # noqa: BLE001
+            LOG.warning("sensor scrape failed: %s", exc)
+            return {}
+
+    def _scheduler_state(self, client) -> dict:
+        try:
+            return client.state(substates=["scheduler"]).get(
+                "SchedulerState", {})
+        except Exception as exc:  # noqa: BLE001
+            LOG.warning("scheduler-state scrape failed: %s", exc)
+            return {}
+
+    def _slo(self, client) -> dict:
+        try:
+            return client.slo_status()
+        except Exception as exc:  # noqa: BLE001
+            LOG.warning("slo scrape failed: %s", exc)
+            return {}
+
+    def _traces(self, client, since_ms: float) -> List[dict]:
+        try:
+            return client.traces(since_ms=since_ms, limit=1024,
+                                 verbose=True).get("traces", [])
+        except Exception as exc:  # noqa: BLE001
+            LOG.warning("trace scrape failed: %s", exc)
+            return []
+
+    def _metrics_text(self, client) -> str:
+        try:
+            return client.metrics_text()
+        except Exception as exc:  # noqa: BLE001
+            LOG.warning("/metrics scrape failed: %s", exc)
+            return ""

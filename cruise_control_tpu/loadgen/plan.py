@@ -1,0 +1,159 @@
+"""Deterministic compilation of a LoadProfile into a request plan.
+
+The plan is a PURE function of (profile) — all randomness flows from
+`random.Random` instances seeded by sha256(profile seed, client index),
+so the same profile produces the same per-client request sequences,
+arrival offsets, parameter choices and scenario bodies byte for byte
+(pinned in tests/test_loadgen.py).  The harness only *executes* the
+plan; nothing about scheduling is decided at run time.
+
+Arrival model: per client, open-loop Poisson arrivals thinned from the
+phase's rate curve — inter-arrival gaps are drawn exponentially at the
+client's share of the instantaneous rate (`rate_at(curve, fraction) /
+clients`), so a diurnal curve produces a genuinely diurnal request
+stream, not a staircase.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import random
+from typing import List, Optional
+
+from cruise_control_tpu.loadgen.profile import (OP_CLASS, LoadProfile,
+                                                rate_at)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlannedRequest:
+    """One planned operation: WHEN (offset from run start), WHO (client
+    index / per-client sequence), WHAT (kind + parameters), and the
+    scheduler class the measurement attributes it to."""
+
+    at_s: float
+    client: int
+    seq: int
+    phase: str
+    kind: str
+    klass: Optional[str]
+    params: dict
+    body: Optional[dict] = None
+
+    def to_json(self) -> dict:
+        out = {"atMs": round(self.at_s * 1000.0, 3),
+               "client": self.client, "seq": self.seq,
+               "phase": self.phase, "kind": self.kind,
+               "class": self.klass, "params": self.params}
+        if self.body is not None:
+            out["body"] = self.body
+        return out
+
+
+def _client_rng(seed: int, client: int) -> random.Random:
+    digest = hashlib.sha256(f"loadgen:{seed}:{client}".encode()).digest()
+    return random.Random(int.from_bytes(digest[:8], "big"))
+
+
+def _pick_kind(rng: random.Random, mix) -> str:
+    total = sum(w for _, w in mix)
+    x = rng.random() * total
+    for kind, weight in mix:
+        x -= weight
+        if x <= 0:
+            return kind
+    return mix[-1][0]
+
+
+def _params_for(kind: str, rng: random.Random, ignore_cache_p: float,
+                client: int, seq: int):
+    """(params, body) for one planned op — every choice drawn from the
+    client's rng so the plan stays byte-reproducible."""
+    if kind == "rebalance":
+        # `ignoreCacheP` of the stampede busts the proposal cache —
+        # without it every rebalance after the first is answered from
+        # cache and the USER_INTERACTIVE histograms measure nothing
+        return ({"dryrun": True,
+                 "ignore_proposal_cache":
+                 rng.random() < ignore_cache_p}, None)
+    if kind == "proposals":
+        return ({"ignore_proposal_cache":
+                 rng.random() < ignore_cache_p}, None)
+    if kind == "fix_offline":
+        return {"dryrun": True}, None
+    if kind == "scenarios":
+        # a small what-if batch: 1-2 load-growth projections (distinct
+        # factors so identical requests don't coalesce away the sweep)
+        n = 1 + (rng.random() < 0.5)
+        factors = sorted(rng.choice((1.1, 1.2, 1.3, 1.5))
+                         for _ in range(n))
+        body = {"scenarios": [
+            {"name": f"lg-c{client}-s{seq}-{i}",
+             "loadScale": {"nw_in": f, "nw_out": f}}
+            for i, f in enumerate(factors)],
+            "includeBase": False}
+        return {}, body
+    if kind == "model_delta":
+        # a "topic went hot" load update: partition + leader load drawn
+        # from the rng; the rig maps these onto its real topic geometry
+        return ({"partition": rng.randrange(1 << 16),
+                 "cpu": round(rng.uniform(0.5, 4.0), 3),
+                 "nw_in": round(rng.uniform(20.0, 200.0), 3),
+                 "nw_out": round(rng.uniform(50.0, 500.0), 3),
+                 "disk": round(rng.uniform(1e3, 1e5), 3)}, None)
+    if kind == "state":
+        return {"substates": "scheduler,slo"}, None
+    # heal / precompute / tenant_cycle / load take no parameters
+    return {}, None
+
+
+def build_plan(profile: LoadProfile) -> List[PlannedRequest]:
+    """The full run plan, ordered by arrival offset (ties broken by
+    (client, seq) so the order itself is deterministic)."""
+    out: List[PlannedRequest] = []
+    for client in range(profile.clients):
+        rng = _client_rng(profile.seed, client)
+        seq = 0
+        phase_start = 0.0
+        for phase in profile.phases:
+            t = 0.0
+            while True:
+                fraction = t / phase.duration_s
+                client_rate = (rate_at(phase.rate, fraction)
+                               / profile.clients)
+                if client_rate <= 0.0:
+                    # zero-rate stretch: step forward 5% of the phase
+                    # and re-sample the curve
+                    t += 0.05 * phase.duration_s
+                    if t >= phase.duration_s:
+                        break
+                    continue
+                # exponential inter-arrival gap at the instantaneous
+                # per-client rate (u in (0, 1] so log() is defined)
+                u = 1.0 - rng.random()
+                t += -math.log(u) / client_rate
+                if t >= phase.duration_s:
+                    break
+                kind = _pick_kind(rng, phase.mix)
+                params, body = _params_for(kind, rng,
+                                           phase.ignore_cache_p,
+                                           client, seq)
+                out.append(PlannedRequest(
+                    at_s=round(phase_start + t, 6),
+                    client=client, seq=seq, phase=phase.name,
+                    kind=kind, klass=OP_CLASS[kind],
+                    params=params, body=body))
+                seq += 1
+            phase_start += phase.duration_s
+    out.sort(key=lambda r: (r.at_s, r.client, r.seq))
+    return out
+
+
+def plan_digest(plan: List[PlannedRequest]) -> str:
+    """sha256 over the canonical JSON of the plan — the reproducibility
+    pin: same profile => same digest, any drift in sequence, timing,
+    parameters or bodies changes it."""
+    canonical = json.dumps([r.to_json() for r in plan], sort_keys=True,
+                           separators=(",", ":"))
+    return hashlib.sha256(canonical.encode()).hexdigest()

@@ -1,0 +1,296 @@
+"""Declarative workload profiles for the trace-replay load harness.
+
+A profile is pure data (JSON): a seed, a client count, and a list of
+PHASES, each with a duration, an arrival-rate curve and a weighted mix
+of operation kinds.  The rate is either a constant rps or a piecewise-
+linear curve of `[phaseFraction, rps]` breakpoints — the diurnal shape
+("overnight trough, morning ramp, midday plateau") compressed into the
+phase's duration.  Profiles compile deterministically into a request
+plan (loadgen/plan.py): same profile + same seed = byte-identical
+request sequence, which is what makes a soak run reproducible evidence
+instead of an anecdote.
+
+Operation kinds and the scheduler class whose histograms/SLO they land
+in (OP_CLASS):
+
+===============  ==================  ==================================
+kind             class               what it drives
+===============  ==================  ==================================
+rebalance        USER_INTERACTIVE    POST REBALANCE dryrun (the
+                                     interactive dashboard stampede)
+proposals        USER_INTERACTIVE    POST PROPOSALS (cache-busting mix
+                                     governed by `ignoreCacheP`)
+fix_offline      USER_INTERACTIVE    POST FIX_OFFLINE_REPLICAS dryrun
+scenarios        SCENARIO_SWEEP      POST SCENARIOS (small what-if
+                                     batches; folds under load)
+precompute       PRECOMPUTE          rig hook: a PRECOMPUTE-class solve
+                                     (background churn)
+heal             ANOMALY_HEAL        rig hook: an ANOMALY_HEAL-class
+                                     solve (anomaly-heal storm)
+model_delta      —                   rig hook: LoadMonitor.
+                                     apply_model_delta stream feeding
+                                     the PR-9 incremental store
+tenant_cycle     —                   rig hook: fleet register → drain →
+                                     unregister churn
+state / load     —                   read-only GET noise
+===============  ==================  ==================================
+
+`heal`/`precompute`/`model_delta`/`tenant_cycle` need an in-process rig
+(loadgen/harness.LocalRig) because the REST surface deliberately does
+not expose them; against a remote server they are counted as skipped,
+never silently dropped.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+#: every legal operation kind
+OP_KINDS = ("rebalance", "proposals", "fix_offline", "scenarios",
+            "precompute", "heal", "model_delta", "tenant_cycle",
+            "state", "load")
+
+#: kind -> SchedulerClass name its solve is attributed to (None = not a
+#: solve: reads, deltas, tenant churn)
+OP_CLASS: Dict[str, Optional[str]] = {
+    "rebalance": "USER_INTERACTIVE",
+    "proposals": "USER_INTERACTIVE",
+    "fix_offline": "USER_INTERACTIVE",
+    "scenarios": "SCENARIO_SWEEP",
+    "precompute": "PRECOMPUTE",
+    "heal": "ANOMALY_HEAL",
+    "model_delta": None,
+    "tenant_cycle": None,
+    "state": None,
+    "load": None,
+}
+
+#: kinds that require an in-process rig (no REST surface)
+RIG_KINDS = frozenset(("precompute", "heal", "model_delta",
+                       "tenant_cycle"))
+
+
+class ProfileError(ValueError):
+    """Malformed workload profile."""
+
+
+RateCurve = Tuple[Tuple[float, float], ...]
+
+
+def _parse_rate(raw: Union[int, float, Sequence]) -> RateCurve:
+    """Normalize a rate spec to breakpoints ((fraction, rps), ...).
+    A scalar is a constant; a list of [fraction, rps] pairs is
+    piecewise-linear over the phase (fractions in [0, 1], ascending)."""
+    if isinstance(raw, (int, float)):
+        if raw < 0:
+            raise ProfileError(f"rps must be >= 0, got {raw}")
+        return ((0.0, float(raw)), (1.0, float(raw)))
+    points: List[Tuple[float, float]] = []
+    for pair in raw:
+        if not isinstance(pair, (list, tuple)) or len(pair) != 2:
+            raise ProfileError(
+                f"rps curve entries must be [fraction, rps] pairs, "
+                f"got {pair!r}")
+        frac, rps = float(pair[0]), float(pair[1])
+        if not (0.0 <= frac <= 1.0) or rps < 0:
+            raise ProfileError(
+                f"rps breakpoint out of range: [{frac}, {rps}]")
+        points.append((frac, rps))
+    if len(points) < 2 or [p[0] for p in points] != sorted(
+            p[0] for p in points):
+        raise ProfileError("rps curve needs >= 2 breakpoints with "
+                           "ascending fractions")
+    return tuple(points)
+
+
+def rate_at(curve: RateCurve, fraction: float) -> float:
+    """Linear interpolation of the rate curve at a phase fraction."""
+    fraction = min(1.0, max(0.0, fraction))
+    prev = curve[0]
+    for point in curve[1:]:
+        if fraction <= point[0]:
+            span = point[0] - prev[0]
+            if span <= 0:
+                return point[1]
+            t = (fraction - prev[0]) / span
+            return prev[1] + t * (point[1] - prev[1])
+        prev = point
+    return curve[-1][1]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase:
+    """One profile phase: a duration, a rate curve and an op mix."""
+
+    name: str
+    duration_s: float
+    rate: RateCurve
+    #: kind -> weight (relative; zero-weight entries are dropped)
+    mix: Tuple[Tuple[str, float], ...]
+    #: probability a `proposals` op busts the proposal cache
+    ignore_cache_p: float = 0.5
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "durationS": self.duration_s,
+                "rps": [list(p) for p in self.rate],
+                "mix": {k: w for k, w in self.mix},
+                "ignoreCacheP": self.ignore_cache_p}
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadProfile:
+    """See module docstring."""
+
+    name: str
+    seed: int
+    clients: int
+    phases: Tuple[Phase, ...]
+
+    @property
+    def duration_s(self) -> float:
+        return sum(p.duration_s for p in self.phases)
+
+    def rig_kinds_used(self) -> List[str]:
+        used = {k for p in self.phases for k, w in p.mix if w > 0}
+        return sorted(used & RIG_KINDS)
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "seed": self.seed,
+                "clients": self.clients,
+                "phases": [p.to_json() for p in self.phases]}
+
+
+def parse_profile(doc: Union[str, dict]) -> LoadProfile:
+    """Parse + validate a profile from JSON text or a dict — the ONE
+    parser shared by the harness, `cccli loadgen` and the soak bench."""
+    if isinstance(doc, str):
+        try:
+            doc = json.loads(doc)
+        except json.JSONDecodeError as exc:
+            raise ProfileError(f"profile is not valid JSON: {exc}")
+    if not isinstance(doc, dict):
+        raise ProfileError(f"profile must be an object, "
+                           f"got {type(doc).__name__}")
+    unknown = set(doc) - {"name", "seed", "clients", "phases"}
+    if unknown:
+        raise ProfileError(f"unknown profile fields {sorted(unknown)}")
+    phases_raw = doc.get("phases")
+    if not isinstance(phases_raw, list) or not phases_raw:
+        raise ProfileError("profile needs a non-empty phases list")
+    phases: List[Phase] = []
+    for i, ph in enumerate(phases_raw):
+        if not isinstance(ph, dict):
+            raise ProfileError(f"phases[{i}] must be an object")
+        unknown = set(ph) - {"name", "durationS", "rps", "mix",
+                             "ignoreCacheP"}
+        if unknown:
+            raise ProfileError(
+                f"phases[{i}]: unknown fields {sorted(unknown)}")
+        duration = float(ph.get("durationS", 0.0))
+        if duration <= 0:
+            raise ProfileError(f"phases[{i}]: durationS must be > 0")
+        mix_raw = ph.get("mix")
+        if not isinstance(mix_raw, dict) or not mix_raw:
+            raise ProfileError(f"phases[{i}]: needs a non-empty mix")
+        mix: List[Tuple[str, float]] = []
+        for kind, weight in sorted(mix_raw.items()):
+            if kind not in OP_KINDS:
+                raise ProfileError(
+                    f"phases[{i}]: unknown op kind {kind!r}; legal: "
+                    f"{list(OP_KINDS)}")
+            weight = float(weight)
+            if weight < 0:
+                raise ProfileError(
+                    f"phases[{i}]: negative weight for {kind!r}")
+            if weight > 0:
+                mix.append((kind, weight))
+        if not mix:
+            raise ProfileError(f"phases[{i}]: every mix weight is zero")
+        ignore_p = float(ph.get("ignoreCacheP", 0.5))
+        if not (0.0 <= ignore_p <= 1.0):
+            raise ProfileError(f"phases[{i}]: ignoreCacheP must be in "
+                               f"[0, 1]")
+        phases.append(Phase(
+            name=str(ph.get("name", f"phase{i}")),
+            duration_s=duration,
+            rate=_parse_rate(ph.get("rps", 1.0)),
+            mix=tuple(mix),
+            ignore_cache_p=ignore_p))
+    clients = int(doc.get("clients", 4))
+    if clients < 1:
+        raise ProfileError("clients must be >= 1")
+    return LoadProfile(
+        name=str(doc.get("name", "unnamed")),
+        seed=int(doc.get("seed", 0)),
+        clients=clients,
+        phases=tuple(phases))
+
+
+# ---------------------------------------------------------------------------
+# built-in profiles
+# ---------------------------------------------------------------------------
+def builtin_profile(name: str, duration_s: Optional[float] = None,
+                    rps: Optional[float] = None,
+                    clients: Optional[int] = None,
+                    seed: int = 1) -> LoadProfile:
+    """A named built-in profile, optionally rescaled.  `soak-mixed` is
+    the canonical BENCH_CONFIG=soak shape: a warm ramp, a diurnal mixed
+    plateau (every scheduler class + delta stream), and an anomaly-heal
+    storm spike.  `smoke` is the 2-second tier-1 shape."""
+    base_rps = rps if rps is not None else 4.0
+    if name == "smoke":
+        total = duration_s if duration_s is not None else 2.0
+        doc = {
+            "name": "smoke", "seed": seed,
+            "clients": clients if clients is not None else 2,
+            "phases": [{
+                "name": "mixed", "durationS": total, "rps": base_rps,
+                "mix": {"rebalance": 4, "proposals": 2, "scenarios": 1,
+                        "precompute": 1, "heal": 1, "model_delta": 2,
+                        "state": 1},
+                # the tiny smoke window must MEASURE solves, not cache
+                # hits: every interactive request busts the cache
+                "ignoreCacheP": 1.0,
+            }],
+        }
+        return parse_profile(doc)
+    if name == "soak-mixed":
+        total = duration_s if duration_s is not None else 60.0
+        doc = {
+            "name": "soak-mixed", "seed": seed,
+            "clients": clients if clients is not None else 4,
+            "phases": [
+                {"name": "warm", "durationS": max(1.0, 0.15 * total),
+                 "rps": 0.5 * base_rps, "mix": {"rebalance": 1}},
+                {"name": "diurnal-mixed",
+                 "durationS": max(1.0, 0.6 * total),
+                 # trough -> peak -> trough, compressed into the phase
+                 "rps": [[0.0, 0.4 * base_rps], [0.5, 1.5 * base_rps],
+                         [1.0, 0.4 * base_rps]],
+                 "mix": {"rebalance": 4, "proposals": 2, "scenarios": 2,
+                         "precompute": 2, "model_delta": 3, "state": 1,
+                         "load": 1}},
+                {"name": "heal-storm",
+                 "durationS": max(1.0, 0.25 * total),
+                 "rps": base_rps,
+                 "mix": {"heal": 3, "rebalance": 2, "model_delta": 1,
+                         "scenarios": 1}},
+            ],
+        }
+        return parse_profile(doc)
+    if name == "fleet-churn":
+        total = duration_s if duration_s is not None else 30.0
+        doc = {
+            "name": "fleet-churn", "seed": seed,
+            "clients": clients if clients is not None else 2,
+            "phases": [{
+                "name": "churn", "durationS": total, "rps": base_rps,
+                "mix": {"rebalance": 3, "tenant_cycle": 1,
+                        "model_delta": 1, "state": 1},
+            }],
+        }
+        return parse_profile(doc)
+    raise ProfileError(
+        f"unknown built-in profile {name!r}; "
+        f"available: smoke, soak-mixed, fleet-churn")

@@ -1,0 +1,188 @@
+"""In-process demo rig: a REAL serving stack for the load harness.
+
+Builds the same stack `--demo-cluster` serves — a SimulatedCluster, a
+full CruiseControl facade (scheduler enabled, tracing on), and the REST
+app on a real HTTP port — plus the LocalRig hooks for the kinds the
+REST surface does not expose: ANOMALY_HEAL / PRECOMPUTE class solves
+(storm and churn traffic) and `apply_model_delta` streams feeding the
+PR-9 incremental store.  Used by `BENCH_CONFIG=soak`, the tier-1
+loadgen smoke test, and `cccli loadgen --demo`.
+
+Everything runs on the wall clock (HTTP + scheduler threads need real
+time); the model is deliberately tiny — the rig measures the SERVING
+stack (admission, coalescing, tracing, SLO burn), not solve quality at
+scale, which is the headline bench's job.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Optional, Sequence
+
+from cruise_control_tpu.loadgen.harness import LocalRig
+
+LOG = logging.getLogger(__name__)
+
+#: trimmed goal stack (the tests' facade stack): fast to compile on the
+#: CPU rig while still exercising the full fused pipeline
+RIG_GOALS = ("RackAwareGoal", "DiskCapacityGoal",
+             "ReplicaDistributionGoal", "DiskUsageDistributionGoal")
+
+
+@dataclasses.dataclass
+class DemoRig:
+    """A running in-process stack: REST base URL + LocalRig hooks +
+    handles for assertions.  Always `shutdown()` (or use as a context
+    manager)."""
+
+    sim: object
+    cc: object
+    app: object
+    port: int
+    rig: LocalRig
+    topic: str
+    partitions: int
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/kafkacruisecontrol"
+
+    def __enter__(self) -> "DemoRig":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        try:
+            self.app.stop()
+        finally:
+            self.cc.shutdown()
+
+
+def build_demo_rig(num_brokers: int = 4, partitions: int = 12,
+                   goal_names: Optional[Sequence[str]] = None,
+                   slo_objectives: Optional[dict] = None,
+                   slo_window_s: float = 300.0,
+                   slo_alert_threshold: float = 2.0,
+                   async_response_timeout_s: float = 60.0,
+                   time_fn: Optional[Callable[[], float]] = None,
+                   warm: bool = True,
+                   **cc_kwargs) -> DemoRig:
+    """Build, start and serve the demo stack; see module docstring.
+    Extra `cc_kwargs` pass through to the CruiseControl facade (e.g.
+    tightened `slo_objectives` so a soak can breach on purpose).
+
+    `warm=True` (the default) pre-compiles every program shape the
+    built-in profiles touch — the fused pipeline plus the K=1/K=2
+    scenario batch programs — BEFORE the server starts, so a measured
+    replay exercises the serving stack, not first-compile luck (a cold
+    scenario compile is ~30s on the CPU rig and would block the single
+    dispatch thread mid-run, poisoning every class's queue-wait)."""
+    import time as _t
+
+    from cruise_control_tpu.api.server import CruiseControlApp
+    from cruise_control_tpu.cluster.simulated import SimulatedCluster
+    from cruise_control_tpu.cluster.types import TopicPartition
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.monitor.deltas import (ModelDelta,
+                                                   PartitionLoadUpdate)
+    from cruise_control_tpu.monitor.sampling.sampler import (
+        SimulatedClusterSampler)
+    from cruise_control_tpu.sched.policy import SchedulerClass
+
+    if time_fn is None:
+        # real wall time plus a bootstrap-only forward skew: sampling
+        # windows need time to MOVE between bootstrap rounds, and the
+        # serving threads need a live clock — so the rig's clock is
+        # wall time shifted by an offset that only ever grows (and
+        # only before serving starts), staying monotonic throughout
+        skew = {"s": 0.0}
+        time_fn = lambda: _t.time() + skew["s"]  # noqa: E731
+    else:
+        skew = None
+    topic = "lg0"
+    sim = SimulatedCluster(time_fn=time_fn)
+    for b in range(num_brokers):
+        sim.add_broker(b, rack=f"rack{b % 2}")
+    # skewed start (everything on two brokers) so rebalances have work
+    sim.create_topic(topic, [[b % 2, (b % 2) + 2 if num_brokers > 3
+                              else (b + 1) % num_brokers]
+                             for b in range(partitions)],
+                     size_bytes=1e4)
+    for p in range(partitions):
+        sim.set_partition_load(TopicPartition(topic, p), leader_cpu=2.0,
+                               nw_in=100.0, nw_out=300.0)
+    cc = CruiseControl(
+        sim, SimulatedClusterSampler(sim),
+        goal_names=list(goal_names or RIG_GOALS),
+        time_fn=time_fn,
+        monitor_kwargs=dict(num_windows=3, window_ms=10_000,
+                            min_samples_per_window=1,
+                            sampling_interval_ms=5_000),
+        auto_warmup=False,
+        scheduler_enabled=True,
+        slo_objectives=slo_objectives,
+        slo_window_s=slo_window_s,
+        slo_alert_threshold=slo_alert_threshold,
+        **cc_kwargs)
+    cc.start_up(do_sampling=False, start_detection=False)
+    # enough synchronous sampling rounds to fill every monitor window,
+    # the clock skewing forward one sampling interval per round (the
+    # BOOTSTRAP endpoint's job, compressed into construction)
+    rounds = 2 * (cc.load_monitor.partition_aggregator.num_windows + 1)
+    cc.load_monitor.task_runner.bootstrap(
+        rounds,
+        advance_fn=(None if skew is None
+                    else lambda s: skew.__setitem__("s",
+                                                    skew["s"] + s)))
+
+    if warm:
+        from cruise_control_tpu.scenario.spec import ScenarioSpec
+        cc.optimizations(ignore_proposal_cache=True,
+                         _scheduler_class=SchedulerClass.PRECOMPUTE)
+        for k in (1, 2):
+            try:
+                cc.evaluate_scenarios(
+                    [ScenarioSpec(name=f"warm{i}",
+                                  load_scale={"nw_in": 1.1 + 0.1 * i,
+                                              "nw_out": 1.1})
+                     for i in range(k)],
+                    include_base=False)
+            except Exception as exc:  # noqa: BLE001 - warm is
+                # best-effort: a cold scenario compile mid-run is a
+                # slower rig, not a broken one
+                LOG.warning("scenario warm (K=%d) failed: %s", k, exc)
+
+    app = CruiseControlApp(
+        cc, async_response_timeout_s=async_response_timeout_s,
+        access_log=False)
+    port = app.start(host="127.0.0.1", port=0)
+
+    def heal():
+        return cc.optimizations(
+            ignore_proposal_cache=True,
+            _scheduler_class=SchedulerClass.ANOMALY_HEAL)
+
+    def precompute():
+        return cc.optimizations(
+            ignore_proposal_cache=True,
+            _scheduler_class=SchedulerClass.PRECOMPUTE)
+
+    def apply_model_delta(params: dict):
+        update = PartitionLoadUpdate(
+            topic=topic,
+            partition=int(params.get("partition", 0)) % partitions,
+            load=(float(params.get("cpu", 1.0)),
+                  float(params.get("nw_in", 50.0)),
+                  float(params.get("nw_out", 100.0)),
+                  float(params.get("disk", 1e4))))
+        return cc.load_monitor.apply_model_delta(ModelDelta(
+            load_updates=(update,), reason="loadgen delta stream"))
+
+    rig = LocalRig(heal=heal, precompute=precompute,
+                   apply_model_delta=apply_model_delta)
+    LOG.info("demo rig serving on port %d (%d brokers / %d partitions)",
+             port, num_brokers, partitions)
+    return DemoRig(sim=sim, cc=cc, app=app, port=port, rig=rig,
+                   topic=topic, partitions=partitions)

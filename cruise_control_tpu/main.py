@@ -181,6 +181,51 @@ def _mesh_enabled_of(config) -> Optional[bool]:
     raise ValueError(f"mesh.enabled must be auto/true/false, got {raw!r}")
 
 
+#: the documented base key; the free-form per-sensor keys are
+#: `obs.metrics.buckets.<sensor-name-or-prefix>` = CSV of boundaries
+#: in seconds
+_BUCKETS_BASE = "obs.metrics.buckets"
+_BUCKETS_PREFIX = _BUCKETS_BASE + "."
+
+
+def _metrics_bucket_overrides(config) -> dict:
+    """{sensor name/prefix: (bounds...)} from the suffixed
+    obs.metrics.buckets.* keys in the raw properties (free-form keys:
+    the sensor namespace is open-ended, so these are prefix-scanned
+    from `originals` rather than individually defined)."""
+    out = {}
+    for key, raw in config.originals.items():
+        if not key.startswith(_BUCKETS_PREFIX) or key == _BUCKETS_PREFIX:
+            continue
+        name = key[len(_BUCKETS_PREFIX):]
+        try:
+            bounds = tuple(sorted(float(x) for x
+                                  in str(raw).split(",") if x.strip()))
+        except ValueError:
+            raise ValueError(
+                f"{key} must be a CSV of bucket boundaries in seconds, "
+                f"got {raw!r}")
+        if not bounds or any(b <= 0 for b in bounds):
+            raise ValueError(f"{key}: boundaries must be positive "
+                             f"seconds, got {raw!r}")
+        out[name] = bounds
+    return out
+
+
+def _slo_objectives(config) -> dict:
+    """Per-class SLO objectives from the slo.<class>.* keys
+    (obs/slo.ClassObjective per SchedulerClass)."""
+    from cruise_control_tpu.obs.slo import (CLASS_SENSOR_SUFFIX,
+                                            ClassObjective)
+    return {
+        klass: ClassObjective(
+            latency_s=config.get_long(f"slo.{suffix}.latency.ms") / 1e3,
+            queue_wait_s=config.get_long(
+                f"slo.{suffix}.queue.wait.ms") / 1e3,
+            error_budget=config.get_double(f"slo.{suffix}.error.budget"))
+        for klass, suffix in CLASS_SENSOR_SUFFIX.items()}
+
+
 def build_cruise_control(config: CruiseControlConfig, admin,
                          sampler: Optional[MetricSampler] = None,
                          solve_scheduler=None,
@@ -364,6 +409,14 @@ def build_cruise_control(config: CruiseControlConfig, admin,
             "obs.flight.recorder.capacity"),
         obs_flight_recorder_max_pinned=config.get_int(
             "obs.flight.recorder.max.pinned"),
+        obs_trace_sample_rate=config.get_double("obs.trace.sample.rate"),
+        metrics_bucket_overrides=_metrics_bucket_overrides(config),
+        slo_enabled=config.get_boolean("slo.enabled"),
+        slo_objectives=_slo_objectives(config),
+        slo_window_s=config.get_long("slo.window.ms") / 1e3,
+        slo_alert_threshold=config.get_double("slo.burn.alert.threshold"),
+        slo_evaluation_interval_s=config.get_long(
+            "slo.evaluation.interval.ms") / 1e3,
         monitor_kwargs=dict(
             sample_store=sample_store,
             num_windows=config.get_int("num.partition.metrics.windows"),

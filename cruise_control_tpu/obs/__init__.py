@@ -11,7 +11,11 @@ OpenMetrics exporter (docs/OBSERVABILITY.md).
   dump it.
 * `obs.export` — `/metrics` OpenMetrics page over every sensor
   registry, `cluster.<id>.` tagging converted to labels.
+* `obs.slo` — per-class latency/error-budget objectives with burn
+  rates computed live from the scheduler histograms: STATE `sloStatus`,
+  `cc_tpu_slo_*` series, and the SLO_BURN anomaly's math
+  (docs/LOADGEN.md).
 """
-from cruise_control_tpu.obs import export, recorder, trace
+from cruise_control_tpu.obs import export, recorder, slo, trace
 
-__all__ = ["export", "recorder", "trace"]
+__all__ = ["export", "recorder", "slo", "trace"]

@@ -61,6 +61,14 @@ class FlightRecorder:
         self.recorded = 0
         self.pinned_total = 0
         self.exported_pins = 0
+        #: OK traces dropped by obs.trace.sample.rate before reaching
+        #: the ring (obs/trace.py `_sampled_in`) — distinguishes a
+        #: quiet ring from a sampling-thinned one
+        self.sampled_out = 0
+
+    def record_sampled_out(self) -> None:
+        with self._lock:
+            self.sampled_out += 1
 
     # ------------------------------------------------------------------
     def record(self, trace) -> None:
@@ -87,10 +95,18 @@ class FlightRecorder:
               cluster: Optional[str] = None,
               outcome: Optional[str] = None,
               limit: Optional[int] = None,
-              export: bool = True) -> List[dict]:
+              export: bool = True,
+              since_ms: Optional[float] = None,
+              min_duration_ms: Optional[float] = None) -> List[dict]:
         """Matching traces, newest first.  Pinned traces a query RETURNS
         count as exported and drop their pin (they remain in the ring
-        subject to normal eviction); pass export=False to peek."""
+        subject to normal eviction); pass export=False to peek.
+
+        `since_ms` keeps only traces that STARTED at/after the given
+        epoch-milliseconds; `min_duration_ms` only traces at least that
+        slow — the drill filters (`?since=`, `?min_duration_ms=` on the
+        TRACES endpoint, `tools/trace_dump.py --follow`) so watching a
+        loaded server never pages the whole ring."""
         with self._lock:
             seen = set()
             docs: List[dict] = []
@@ -113,6 +129,12 @@ class FlightRecorder:
                     and doc.get("tags", {}).get("cluster") != cluster:
                 continue
             if outcome is not None and doc.get("outcome") != outcome:
+                continue
+            if since_ms is not None \
+                    and doc.get("startMs", 0.0) < since_ms:
+                continue
+            if min_duration_ms is not None \
+                    and doc.get("durationMs", 0.0) < min_duration_ms:
                 continue
             out.append(doc)
             if limit is not None and len(out) >= max(1, limit):
@@ -179,6 +201,7 @@ class FlightRecorder:
                 "recorded": self.recorded,
                 "pinnedTotal": self.pinned_total,
                 "exportedPins": self.exported_pins,
+                "sampledOut": self.sampled_out,
             }
 
 

@@ -61,23 +61,52 @@ OUTCOME_ORDER = ("failed", "degraded", "fallback", "preempted",
 
 _ENABLED = True
 _TRACE_LOG_ENABLED = False
+#: flight-recorder sampling under load (obs.trace.sample.rate): the
+#: fraction of OK traces handed to the recorder.  Non-ok outcomes
+#: (failed/degraded/fallback/preempted/rejected) are ALWAYS kept — at
+#: load-harness rates the ring churns in seconds, and sampling must
+#: thin the healthy wash, never the incident evidence.  The keep/drop
+#: decision hashes the trace id, so a given trace's fate is
+#: deterministic and reproducible.
+_SAMPLE_RATE = 1.0
 _CONFIG_LOCK = threading.Lock()
 
 
 def configure(enabled: Optional[bool] = None,
-              trace_log_enabled: Optional[bool] = None) -> None:
+              trace_log_enabled: Optional[bool] = None,
+              sample_rate: Optional[float] = None) -> None:
     """Process-wide switches (obs.tracing.enabled /
-    obs.trace.log.enabled); None leaves a switch as found."""
-    global _ENABLED, _TRACE_LOG_ENABLED
+    obs.trace.log.enabled / obs.trace.sample.rate); None leaves a
+    switch as found."""
+    global _ENABLED, _TRACE_LOG_ENABLED, _SAMPLE_RATE
     with _CONFIG_LOCK:
         if enabled is not None:
             _ENABLED = bool(enabled)
         if trace_log_enabled is not None:
             _TRACE_LOG_ENABLED = bool(trace_log_enabled)
+        if sample_rate is not None:
+            _SAMPLE_RATE = min(1.0, max(0.0, float(sample_rate)))
 
 
 def enabled() -> bool:
     return _ENABLED
+
+
+def sample_rate() -> float:
+    return _SAMPLE_RATE
+
+
+def _sampled_in(trace_id: str) -> bool:
+    """Deterministic keep decision for an OK trace: the trace id (16
+    random hex chars) hashes to a point in [0, 1) compared against the
+    sample rate — no RNG state, so replaying a run reproduces exactly
+    which traces the recorder kept."""
+    rate = _SAMPLE_RATE
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return (int(trace_id[:8], 16) / float(0x100000000)) < rate
 
 
 @dataclasses.dataclass
@@ -329,14 +358,24 @@ def finish(trace: Optional[Trace],
         trace.tags.setdefault("error",
                               f"{type(error).__name__}: {error}")
     from cruise_control_tpu.obs import recorder as _recorder
-    _recorder.get_recorder().record(trace)
     if _TRACE_LOG_ENABLED:
+        # the durable trace log sees EVERY finished trace — sampling
+        # scopes the flight recorder only (obs.trace.sample.rate docs);
+        # an audit stream that silently thinned with the ring would be
+        # a lie
         try:
             TRACE_LOG.info("%s", json.dumps(trace.to_json(),
                                             sort_keys=True))
         except (TypeError, ValueError) as exc:
             LOG.warning("trace %s not JSON-serializable: %s",
                         trace.trace_id, exc)
+    if trace.outcome == "ok" and not _sampled_in(trace.trace_id):
+        # sampled out: the recorder counts the drop so operators can
+        # tell "quiet ring" from "thinned ring"; non-ok traces never
+        # reach this branch (outcome check above)
+        _recorder.get_recorder().record_sampled_out()
+        return
+    _recorder.get_recorder().record(trace)
 
 
 def finishing(trace: Optional[Trace],

@@ -233,9 +233,19 @@ class Gauge:
 class MetricRegistry:
     """Named sensors; one registry per CruiseControl instance."""
 
-    def __init__(self, time_fn: Callable[[], float] = _time.time) -> None:
+    def __init__(self, time_fn: Callable[[], float] = _time.time,
+                 bucket_overrides: Optional[
+                     Dict[str, Tuple[float, ...]]] = None) -> None:
         self._time = time_fn
         self._lock = threading.Lock()
+        #: per-sensor histogram bucket boundaries (seconds), keyed by
+        #: sensor name or name PREFIX (config `obs.metrics.buckets.
+        #: <name>`): `sched-wait-hist` covers every per-class
+        #: `sched-wait-hist-<class>` histogram.  Applied at histogram
+        #: CREATION only — a live histogram's boundaries never move
+        #: under a scrape (set overrides before the first observation).
+        self._bucket_overrides: Dict[str, Tuple[float, ...]] = dict(
+            bucket_overrides or {})
         self._sensors: Dict[str, object] = {}
         #: canonical OpenMetrics family -> the raw sensor name that
         #: claimed it (collision check at register time: `a-b` and `a.b`
@@ -274,10 +284,41 @@ class MetricRegistry:
         utils/profiling.SegmentProfiler.publish)."""
         self.timer(name).update(duration_s)
 
+    def set_bucket_overrides(
+            self, overrides: Dict[str, Tuple[float, ...]]) -> None:
+        """Install per-sensor histogram bucket boundaries (seconds).
+        Only affects histograms created AFTER the call — existing
+        histograms keep their boundaries (scrapes must never see a
+        histogram whose bucket edges move)."""
+        with self._lock:
+            self._bucket_overrides.update(
+                {k: tuple(sorted(float(b) for b in v))
+                 for k, v in overrides.items()})
+
+    def buckets_for(self, name: str) -> Optional[Tuple[float, ...]]:
+        """The configured bucket boundaries for a histogram name: an
+        exact-name override wins, else the LONGEST override key that
+        prefixes the name (so `sched-wait-hist` covers
+        `sched-wait-hist-user-interactive`), else None (defaults)."""
+        with self._lock:
+            overrides = dict(self._bucket_overrides)
+        exact = overrides.get(name)
+        if exact is not None:
+            return exact
+        best = None
+        for key, bounds in overrides.items():
+            if name.startswith(key) and (best is None
+                                         or len(key) > len(best[0])):
+                best = (key, bounds)
+        return best[1] if best is not None else None
+
     def histogram(self, name: str,
                   buckets: Optional[Tuple[float, ...]] = None
                   ) -> Histogram:
-        return self._get(name, lambda: Histogram(buckets))
+        # resolve overrides BEFORE _get: the factory runs under the
+        # registry lock and buckets_for takes it too (non-reentrant)
+        resolved = buckets or self.buckets_for(name)
+        return self._get(name, lambda: Histogram(resolved))
 
     def update_histogram(self, name: str, value_s: float) -> None:
         """Record one observation (seconds) into the named histogram —
@@ -309,6 +350,14 @@ class MetricRegistry:
                         "null and counting into sensor-export-errors "
                         "(logged once per gauge)",
                         name, type(exc).__name__, exc)
+
+    def peek(self, name: str):
+        """The named sensor, or None WITHOUT creating it — read-side
+        consumers (the SLO evaluator polling histograms that may not
+        have observed anything yet) must not materialize empty sensors
+        as a side effect of looking."""
+        with self._lock:
+            return self._sensors.get(name)
 
     def _get(self, name: str, factory):
         with self._lock:

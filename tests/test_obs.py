@@ -46,12 +46,15 @@ SWEEP = SchedulerClass.SCENARIO_SWEEP
 
 @pytest.fixture(autouse=True)
 def fresh_obs():
-    """Fresh recorder + enabled tracing per test; restore after."""
-    obs_trace.configure(enabled=True, trace_log_enabled=False)
+    """Fresh recorder + enabled unsampled tracing per test; restore
+    after."""
+    obs_trace.configure(enabled=True, trace_log_enabled=False,
+                        sample_rate=1.0)
     obs_recorder.install(FlightRecorder())
     yield
     obs_recorder.install(FlightRecorder())
-    obs_trace.configure(enabled=True, trace_log_enabled=False)
+    obs_trace.configure(enabled=True, trace_log_enabled=False,
+                        sample_rate=1.0)
 
 
 def wait_until(cond, timeout_s=10.0):
@@ -215,6 +218,57 @@ class TestFlightRecorder:
         rec = FlightRecorder()
         rec.record(self.make_trace("x", outcome_flag="failed"))
         assert rec.dump(reason="test") >= 1
+
+    def test_sampling_thins_ok_flood_but_incident_survives(self):
+        """Satellite pin: with obs.trace.sample.rate engaged, a
+        degraded trace survives a 10x ring-capacity flood of ok
+        traces — the flood is thinned (sampledOut counted) while the
+        incident stays pinned and queryable."""
+        rec = FlightRecorder(capacity=32)
+        obs_recorder.install(rec)
+        obs_trace.configure(sample_rate=0.1)
+        bad = obs_trace.start("incident")
+        obs_trace.mark("degraded")
+        obs_trace.finish(bad)
+        for i in range(320):                 # 10x the ring capacity
+            tr = obs_trace.start(f"ok{i}")
+            obs_trace.finish(tr)
+        stats = rec.to_json()
+        assert stats["sampledOut"] > 0
+        # sampling kept roughly rate*320 ok traces, not all of them
+        assert stats["recorded"] < 321
+        assert stats["sampledOut"] + stats["recorded"] == 321
+        hit = rec.query(trace_id=bad.trace_id, export=False)
+        assert hit and hit[0]["outcome"] == "degraded"
+        # the keep decision is per-trace deterministic: re-deciding
+        # the same ids reproduces the exact split
+        from cruise_control_tpu.obs.trace import _sampled_in
+        decisions = [_sampled_in(t) for t in ("a1b2c3d400", "ffffffff00",
+                                              "0000000100")]
+        assert decisions == [_sampled_in(t) for t in
+                             ("a1b2c3d400", "ffffffff00", "0000000100")]
+
+    def test_query_since_and_min_duration_filters(self):
+        """Satellite pin: ?since= / ?min_duration_ms= bound drill
+        queries so a tail under load never pages the whole ring."""
+        rec = FlightRecorder()
+        rec.record({"traceId": "old-fast", "outcome": "ok",
+                    "startMs": 1_000.0, "durationMs": 5.0})
+        rec.record({"traceId": "old-slow", "outcome": "ok",
+                    "startMs": 2_000.0, "durationMs": 900.0})
+        rec.record({"traceId": "new-fast", "outcome": "ok",
+                    "startMs": 9_000.0, "durationMs": 3.0})
+        rec.record({"traceId": "new-slow", "outcome": "ok",
+                    "startMs": 9_500.0, "durationMs": 700.0})
+        since = {d["traceId"] for d in rec.query(since_ms=5_000.0,
+                                                 export=False)}
+        assert since == {"new-fast", "new-slow"}
+        slow = {d["traceId"] for d in rec.query(min_duration_ms=500.0,
+                                                export=False)}
+        assert slow == {"old-slow", "new-slow"}
+        both = {d["traceId"] for d in rec.query(
+            since_ms=5_000.0, min_duration_ms=500.0, export=False)}
+        assert both == {"new-slow"}
 
     def test_phase_summary(self):
         tr = obs_trace.start("solve.x")
@@ -734,6 +788,15 @@ class TestRestSurface:
         # compact listing drops the tree
         if body["traces"]:
             assert "root" not in body["traces"][0]
+        # drill filters: a far-future since / absurd floor match nothing
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/traces", "since=9e15", {},
+            client="test")
+        assert status == 200 and body["traces"] == []
+        status, _, body = app.handle_request(
+            "GET", "/kafkacruisecontrol/traces",
+            "min_duration_ms=9e9", {}, client="test")
+        assert status == 200 and body["traces"] == []
 
     def test_metrics_page(self, app):
         status, _, body = app.handle_request(

@@ -1,0 +1,235 @@
+"""SLO regression gate over load-harness run artifacts.
+
+The soak rig's pass/fail edge (docs/LOADGEN.md "Baseline workflow"):
+diff a run artifact (cruise_control_tpu/loadgen/artifact.py) against a
+recorded baseline and exit non-zero on breach, so a perf PR cites a
+green gate instead of eyeballed percentiles.
+
+Record a baseline from a known-good run::
+
+    python tools/slo_gate.py --artifact run.json --write-baseline \
+        baseline.json
+
+Gate a later run::
+
+    python tools/slo_gate.py --artifact run.json --baseline \
+        baseline.json
+    # exit 0 = within objectives AND within tolerance of the baseline
+    # exit 1 = breach (each breach printed on stderr)
+    # exit 2 = unusable input (invalid artifact / missing file)
+
+What breaches (each independently):
+
+* the artifact fails structural validation;
+* the run's own SLO block reports burn >= the alert threshold for any
+  class (`--max-burn` overrides the artifact's threshold);
+* the error rate exceeds `--max-error-rate`, or the 429-rejection rate
+  exceeds `--max-rejected-rate` (backpressure is by design — the cap
+  only catches a server that rejected the bulk of the load);
+* a per-class client p99 regressed beyond `--p99-tolerance` x baseline
+  (classes absent from the baseline are skipped: no silent cap);
+* a per-class DEVICE-TIME p99 (from span trees) regressed beyond the
+  same tolerance — catching a solver regression that queue-wait
+  improvements would otherwise mask.
+
+`BENCH_CONFIG=soak` (bench.py) runs a seeded profile, writes the
+artifact, self-baselines the clean run, and asserts this gate passes
+clean and fails under an injected `sched.dispatch` latency fault.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from cruise_control_tpu.loadgen.artifact import validate_artifact  # noqa: E402
+
+BASELINE_VERSION = 1
+
+#: default tolerances (CLI-overridable)
+DEFAULT_P99_TOLERANCE = 1.5
+DEFAULT_MAX_ERROR_RATE = 0.02
+DEFAULT_MAX_REJECTED_RATE = 0.5
+
+
+def distill_baseline(artifact: dict) -> dict:
+    """The gate-relevant slice of a known-good artifact: per-class
+    client p99 + device-time p99, plus provenance (profile, seed, plan
+    digest) so a baseline silently reused against a DIFFERENT workload
+    is detectable."""
+    classes = {}
+    for klass, block in artifact.get("latency", {}).items():
+        classes[klass] = {"p99Ms": block.get("p99Ms", 0.0),
+                          "count": block.get("count", 0)}
+    for klass, block in artifact.get("decomposition", {}).items():
+        classes.setdefault(klass, {})["deviceP99Ms"] = \
+            block.get("deviceMs", {}).get("p99", 0.0)
+    return {
+        "sloBaseline": BASELINE_VERSION,
+        "profile": artifact.get("profile", {}).get("name"),
+        "seed": artifact.get("seed"),
+        "planDigest": artifact.get("planDigest"),
+        "classes": classes,
+    }
+
+
+def gate(artifact: dict, baseline: Optional[dict] = None,
+         p99_tolerance: float = DEFAULT_P99_TOLERANCE,
+         max_error_rate: float = DEFAULT_MAX_ERROR_RATE,
+         max_rejected_rate: float = DEFAULT_MAX_REJECTED_RATE,
+         max_burn: Optional[float] = None) -> List[str]:
+    """Every breach as a human-readable string ([] = gate passes).
+    `baseline` may be a distilled baseline or a full prior artifact."""
+    breaches: List[str] = []
+    problems = validate_artifact(artifact)
+    if problems:
+        return [f"invalid artifact: {p}" for p in problems]
+
+    # 1. the run's own SLO burn
+    slo = artifact.get("slo") or {}
+    if slo.get("enabled"):
+        alert_at = (max_burn if max_burn is not None
+                    else float(slo.get("alertThreshold", 2.0)))
+        for klass, cls in sorted((slo.get("classes") or {}).items()):
+            burn = float(cls.get("burn", 0.0))
+            if burn >= alert_at:
+                dominant = ("queue-wait"
+                            if cls.get("queueWaitBurn", 0.0)
+                            >= cls.get("deviceTimeBurn", 0.0)
+                            else "device-time")
+                breaches.append(
+                    f"SLO burn: {klass} at {burn:.2f}x budget "
+                    f"(alert {alert_at:.1f}x, {dominant}-driven)")
+
+    # 2. error / rejection rates over EXECUTED requests (rig-only kinds
+    # skipped against a remote server must not dilute the caps)
+    requests = artifact.get("requests", {})
+    executed = max(1, requests.get(
+        "executed",
+        requests.get("total", 0) - requests.get("skipped", 0)))
+    error_rate = requests.get("errors", 0) / executed
+    if error_rate > max_error_rate:
+        breaches.append(f"error rate {error_rate:.3f} > "
+                        f"{max_error_rate} "
+                        f"({requests.get('errors')}/{executed})")
+    rejected_rate = requests.get("rejected", 0) / executed
+    if rejected_rate > max_rejected_rate:
+        breaches.append(f"rejected rate {rejected_rate:.3f} > "
+                        f"{max_rejected_rate}")
+
+    # 3. vs baseline
+    if baseline is not None:
+        base_classes = (baseline.get("classes")
+                        if "sloBaseline" in baseline
+                        else distill_baseline(baseline)["classes"])
+        if baseline.get("planDigest") \
+                and artifact.get("planDigest") \
+                and baseline["planDigest"] != artifact["planDigest"]:
+            breaches.append(
+                "baseline was recorded from a DIFFERENT plan "
+                f"(digest {str(baseline['planDigest'])[:12]}... vs "
+                f"{str(artifact['planDigest'])[:12]}...); re-record it "
+                "or run the matching profile/seed")
+        for klass, base in sorted((base_classes or {}).items()):
+            run = artifact.get("latency", {}).get(klass)
+            base_p99 = float(base.get("p99Ms", 0.0) or 0.0)
+            if run is not None and base_p99 > 0.0:
+                p99 = float(run.get("p99Ms", 0.0))
+                if p99 > base_p99 * p99_tolerance:
+                    breaches.append(
+                        f"{klass} client p99 regressed: {p99:.1f}ms vs "
+                        f"baseline {base_p99:.1f}ms "
+                        f"(> {p99_tolerance:.2f}x)")
+            base_dev = float(base.get("deviceP99Ms", 0.0) or 0.0)
+            run_dev = (artifact.get("decomposition", {})
+                       .get(klass, {}).get("deviceMs", {}).get("p99"))
+            if base_dev > 0.0 and run_dev is not None:
+                if float(run_dev) > base_dev * p99_tolerance:
+                    breaches.append(
+                        f"{klass} device-time p99 regressed: "
+                        f"{float(run_dev):.1f}ms vs baseline "
+                        f"{base_dev:.1f}ms (> {p99_tolerance:.2f}x)")
+    return breaches
+
+
+def _load(path: str) -> dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="slo_gate",
+        description="gate a loadgen run artifact against its SLOs and "
+                    "a recorded baseline (exit 0 pass / 1 breach)")
+    parser.add_argument("--artifact", required=True,
+                        help="run artifact JSON (loadgen harness output)")
+    parser.add_argument("--baseline",
+                        help="recorded baseline (or a prior artifact)")
+    parser.add_argument("--write-baseline", metavar="PATH",
+                        help="distill the artifact into a baseline at "
+                             "PATH and exit (no gating)")
+    parser.add_argument("--p99-tolerance", type=float,
+                        default=DEFAULT_P99_TOLERANCE,
+                        help="allowed p99 growth factor vs baseline "
+                             f"(default {DEFAULT_P99_TOLERANCE})")
+    parser.add_argument("--max-error-rate", type=float,
+                        default=DEFAULT_MAX_ERROR_RATE,
+                        help="allowed fraction of errored requests "
+                             f"(default {DEFAULT_MAX_ERROR_RATE})")
+    parser.add_argument("--max-rejected-rate", type=float,
+                        default=DEFAULT_MAX_REJECTED_RATE,
+                        help="allowed fraction of 429-rejected requests "
+                             f"(default {DEFAULT_MAX_REJECTED_RATE})")
+    parser.add_argument("--max-burn", type=float,
+                        help="burn threshold override (default: the "
+                             "artifact's own alert threshold)")
+    args = parser.parse_args(argv)
+
+    try:
+        artifact = _load(args.artifact)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"error: cannot read artifact: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        problems = validate_artifact(artifact)
+        if problems:
+            for p in problems:
+                print(f"error: invalid artifact: {p}", file=sys.stderr)
+            return 2
+        with open(args.write_baseline, "w") as fh:
+            json.dump(distill_baseline(artifact), fh, indent=2,
+                      sort_keys=True)
+            fh.write("\n")
+        print(f"baseline written to {args.write_baseline}")
+        return 0
+
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = _load(args.baseline)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"error: cannot read baseline: {exc}", file=sys.stderr)
+            return 2
+
+    breaches = gate(artifact, baseline,
+                    p99_tolerance=args.p99_tolerance,
+                    max_error_rate=args.max_error_rate,
+                    max_rejected_rate=args.max_rejected_rate,
+                    max_burn=args.max_burn)
+    if breaches:
+        for b in breaches:
+            print(f"BREACH: {b}", file=sys.stderr)
+        print(f"slo_gate: {len(breaches)} breach(es)", file=sys.stderr)
+        return 1
+    print("slo_gate: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

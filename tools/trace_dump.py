@@ -44,7 +44,9 @@ def fetch_traces(base: str, trace_id: Optional[str] = None,
                  outcome: Optional[str] = None,
                  limit: Optional[int] = None,
                  verbose: bool = True,
-                 auth: Optional[str] = None) -> dict:
+                 auth: Optional[str] = None,
+                 since_ms: Optional[float] = None,
+                 min_duration_ms: Optional[float] = None) -> dict:
     params = {"verbose": "true" if verbose else "false"}
     if trace_id:
         params["trace_id"] = trace_id
@@ -54,6 +56,10 @@ def fetch_traces(base: str, trace_id: Optional[str] = None,
         params["outcome"] = outcome
     if limit is not None:
         params["limit"] = str(limit)
+    if since_ms is not None:
+        params["since"] = repr(float(since_ms))
+    if min_duration_ms is not None:
+        params["min_duration_ms"] = repr(float(min_duration_ms))
     url = f"{base.rstrip('/')}/traces?{urllib.parse.urlencode(params)}"
     req = urllib.request.Request(url, method="GET")
     if auth:
@@ -116,21 +122,41 @@ def follow(args) -> int:
     Polls are COMPACT (verbose=false) so they never export — only the
     per-trace tree fetch of a trace we actually PRINT unpins it; the
     startup history-skip in particular must not silently unpin (and
-    thereby doom to eviction) incident traces it never displayed."""
+    thereby doom to eviction) incident traces it never displayed.
+
+    Under load-harness rates each poll is additionally BOUNDED with
+    `?since=` so a tail of a churning ring pages only the recent tail,
+    never the full ring.  Traces enter the ring at FINISH but filter by
+    START time, so the bound backs off a generous horizon (10 polls,
+    min 60s) behind the newest start seen — a solve slower than the
+    poll interval still shows up; only something slower than the whole
+    horizon could slip past, and `seen` keeps the overlap deduped."""
     seen: set = set()
+    newest_start_ms: Optional[float] = None
+    slack_ms = max(60_000.0, 10 * args.interval * 1000.0)
     first = True
     while True:
+        since = (None if newest_start_ms is None
+                 else newest_start_ms - slack_ms)
         try:
             body = fetch_traces(args.address, cluster=args.cluster,
                                 outcome=args.outcome,
                                 limit=args.limit or 64,
-                                verbose=False, auth=args.auth)
+                                verbose=False, auth=args.auth,
+                                since_ms=(args.since if first
+                                          else since),
+                                min_duration_ms=args.min_duration_ms)
         except (urllib.error.URLError, OSError) as exc:
             print(f"# fetch failed: {exc}", file=sys.stderr)
             time.sleep(args.interval)
             continue
         fresh = [t for t in reversed(body.get("traces", []))
                  if t.get("traceId") not in seen]
+        for t in body.get("traces", []):
+            start = t.get("startMs")
+            if start is not None:
+                newest_start_ms = max(newest_start_ms or 0.0,
+                                      float(start))
         for doc in fresh:
             tid = doc.get("traceId")
             seen.add(tid)
@@ -168,6 +194,13 @@ def main(argv=None) -> int:
                         choices=["ok", "failed", "degraded", "fallback",
                                  "preempted", "rejected"])
     parser.add_argument("--limit", type=int)
+    parser.add_argument("--since", type=float, metavar="EPOCH_MS",
+                        help="only traces started at/after this "
+                             "epoch-ms timestamp (drills under load "
+                             "never page the whole ring)")
+    parser.add_argument("--min-duration-ms", type=float,
+                        help="only traces at least this slow (the "
+                             "'show me the outliers' drill filter)")
     parser.add_argument("--json", action="store_true",
                         help="raw JSON instead of the rendered tree")
     parser.add_argument("--follow", action="store_true",
@@ -186,7 +219,8 @@ def main(argv=None) -> int:
         body = fetch_traces(args.address, trace_id=args.trace_id,
                             cluster=args.cluster, outcome=args.outcome,
                             limit=args.limit, verbose=True,
-                            auth=args.auth)
+                            auth=args.auth, since_ms=args.since,
+                            min_duration_ms=args.min_duration_ms)
     except (urllib.error.URLError, OSError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
