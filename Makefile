@@ -1,5 +1,12 @@
 # One-command hygiene check (the reference's `analyze` + `build` CI steps,
 # .circleci/config.yml:18-35): `make check` = lint + full test suite.
+#
+# `lint` is the whole-program static analyzer (tools/analysis/ — symbol
+# table + call graph; gateway reachability, concurrency lint,
+# config/sensor/fault-site drift; docs/ANALYSIS.md).  It enforces the
+# empty-or-shrinking baseline gate: unsuppressed findings AND stale
+# baseline entries both exit nonzero; `python tools/lint.py
+# --prune-baseline` is the only way the tooling writes the baseline.
 .PHONY: check lint test bench warm-cache
 
 check: lint test

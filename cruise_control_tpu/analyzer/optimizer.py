@@ -301,8 +301,12 @@ class GoalOptimizer:
         #: a hard violation, eager aborts where deferred succeeds — the
         #: reference aborts there too.
         self.eager_hard_abort = eager_hard_abort
-        #: lazy per-goal device-comparator flags (_regression_traceable)
-        self._device_cmp: Optional[Tuple[bool, ...]] = None
+        #: per-goal device-comparator flags, computed eagerly: the goal
+        #: list is fixed at construction, and a lazy memo here was a
+        #: benign-but-unlocked shared write (C203) once precompute and
+        #: request threads both reached it
+        self._device_cmp: Tuple[bool, ...] = tuple(
+            _regression_traceable(g) for g in self.goals)
         #: lazy cached _goals_share_key() (goal lists are fixed at
         #: construction); sentinel False = not yet computed
         self._gk_cache = False
@@ -521,9 +525,6 @@ class GoalOptimizer:
         """Per-goal: fuse the stats comparator on device (True) or fall
         back to a host evaluation post-fetch (False)?  Deterministic for
         a given goal list, so shared segment programs stay consistent."""
-        if self._device_cmp is None:
-            self._device_cmp = tuple(_regression_traceable(g)
-                                     for g in self.goals)
         return self._device_cmp
 
     # -- profile mode (CC_TPU_PROFILE=1): per-goal programs -------------

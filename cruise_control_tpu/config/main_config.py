@@ -694,6 +694,12 @@ def executor_config_def(d: ConfigDef) -> ConfigDef:
              "sessions with this; this framework has no ZooKeeper — when "
              "set, startup logs that security is the cluster admin "
              "client's responsibility (see docs/DECISIONS.md).")
+    d.define("cluster.admin.class", Type.CLASS, "", None, _H,
+             "ClusterAdminClient implementation providing the cluster "
+             "connection (metadata, topic configs, reassignment "
+             "execution).  Unset: main falls back to the "
+             "reference-compat alias `network.client.provider.class`, "
+             "then to --demo-cluster.")
     d.define("network.client.provider.class", Type.CLASS, "", None, _L,
              "Reference-compat alias for the cluster client factory: "
              "when `cluster.admin.class` is unset, this class (a "

@@ -49,6 +49,8 @@ class TopicReplicationFactorAnomalyFinder:
             # min.insync.replicas floors the acceptable RF (reference reads
             # topic configs for minISR before flagging under-replication)
             try:
+                # cc-lint: disable=D301 -- Kafka TOPIC config lookup on
+                # the admin client, not a framework ConfigDef key
                 min_isr = int(self._topic_configs(topic).get(
                     "min.insync.replicas", 1))
             except (TypeError, ValueError):

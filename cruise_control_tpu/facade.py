@@ -921,8 +921,11 @@ class CruiseControl:
         with self._cache_lock:
             if self._cache_valid(generation):
                 return "skipped"
-        self._precompute_solve_started_at = self._time()
-        self._precompute_ticket = None
+        # published under _cache_lock: precompute_wedged/shutdown read
+        # these from request threads while the precompute thread writes
+        with self._cache_lock:
+            self._precompute_solve_started_at = self._time()
+            self._precompute_ticket = None
         try:
             faults.inject("facade.precompute")
             # capture the scheduler ticket: the watchdog must clock the
@@ -930,7 +933,7 @@ class CruiseControl:
             # queued behind a long sweep is waiting, not wedged — and a
             # queued ticket fails fast on scheduler stop anyway)
             sched_runtime.set_submission_listener(
-                lambda ticket: setattr(self, "_precompute_ticket", ticket))
+                self._note_precompute_ticket)
             try:
                 self.optimizations(
                     _allow_capacity_estimation=(
@@ -947,8 +950,15 @@ class CruiseControl:
                         classify_failure(exc).value, exc)
             return "failed"
         finally:
-            self._precompute_solve_started_at = None
-            self._precompute_ticket = None
+            with self._cache_lock:
+                self._precompute_solve_started_at = None
+                self._precompute_ticket = None
+
+    def _note_precompute_ticket(self, ticket) -> None:
+        """Submission listener for the precompute solve (fires on the
+        precompute thread, outside any _cache_lock region)."""
+        with self._cache_lock:
+            self._precompute_ticket = ticket
 
     def precompute_wedged(self) -> bool:
         """True when the in-flight precompute SOLVE has overrun its
@@ -957,10 +967,11 @@ class CruiseControl:
         dispatch loop actually picks the solve up (ticket.started_at),
         falling back to submission time when the pass answered without
         a scheduler ticket (cache hit)."""
-        started = self._precompute_solve_started_at
+        with self._cache_lock:
+            started = self._precompute_solve_started_at
+            ticket = self._precompute_ticket
         if started is None:
             return False
-        ticket = self._precompute_ticket
         if ticket is not None:
             started = ticket.started_at
             if started is None:        # still queued (or re-queued after

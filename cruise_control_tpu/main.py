@@ -870,8 +870,9 @@ def main(argv=None) -> int:
         admin, sampler = _demo_admin()
         cc = build_cruise_control(config, admin, sampler=sampler)
     else:
-        admin_cls = config.get("cluster.admin.class") \
-            if "cluster.admin.class" in config.originals else None
+        # declared with default "" since ISSUE-15 (D301): a plain get
+        # works whether or not the overlay names it
+        admin_cls = config.get("cluster.admin.class") or None
         if not admin_cls:
             # reference-compat alias (network.client.provider.class)
             admin_cls = config.get("network.client.provider.class") or None

@@ -388,10 +388,11 @@ class LoadMonitor:
                 >= req.min_monitored_partitions_percentage)
 
     def get_state(self) -> LoadMonitorState:
-        if (self._state_cache is not None
-                and self._time_fn() - self._state_cache_at
-                < self._state_ttl_s):
-            return self._state_cache
+        with self._delta_lock:
+            cached, cached_at = self._state_cache, self._state_cache_at
+        if (cached is not None
+                and self._time_fn() - cached_at < self._state_ttl_s):
+            return cached
         snapshot = self._metadata.cluster()
         total = len(snapshot.partitions)
         try:
@@ -411,8 +412,11 @@ class LoadMonitor:
             num_total_partitions=total,
             reason_of_pause=self.task_runner.reason_of_pause,
             last_sampling_ms=self._fetcher.last_sampling_ms)
-        self._state_cache = state_out
-        self._state_cache_at = self._time_fn()
+        # publish cache + timestamp atomically: the detector thread and
+        # request threads both land here (C203)
+        with self._delta_lock:
+            self._state_cache = state_out
+            self._state_cache_at = self._time_fn()
         return state_out
 
     # ------------------------------------------------------------------

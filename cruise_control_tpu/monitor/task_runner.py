@@ -103,11 +103,17 @@ class LoadMonitorTaskRunner:
     def sample_once(self, mode: SamplingMode = SamplingMode.ALL) -> None:
         """One synchronous sampling round (also used by tests and by
         bootstrap)."""
-        now_ms = self._time_fn() * 1000.0
-        start_ms = self._last_sample_end_ms or now_ms - self._interval_s * 1e3
+        with self._lock:
+            now_ms = self._time_fn() * 1000.0
+            start_ms = (self._last_sample_end_ms
+                        or now_ms - self._interval_s * 1e3)
         cluster = self._metadata.refresh_metadata()
         self._fetcher.fetch_metrics_for_model(cluster, start_ms, now_ms, mode)
-        self._last_sample_end_ms = now_ms
+        # window handoff under the lock (the loop thread and bootstrap/
+        # test callers share it); only a SUCCESSFUL fetch consumes the
+        # window, so the two blocks stay separate
+        with self._lock:
+            self._last_sample_end_ms = now_ms
 
     def bootstrap(self, num_rounds: int, advance_fn: Optional[
             Callable[[float], None]] = None) -> None:

@@ -743,17 +743,21 @@ class TestSampleStoreDurability:
 
 
 class TestDurableWriteLintRule:
-    def _lint(self, tmp_path, body):
+    def _lint(self, tmp_path, body, relpath="cruise_control_tpu/mod.py"):
+        """Per-file G105 findings from the whole-program analyzer
+        (tools/analysis/ — the ISSUE-15 successor of the flat lint;
+        single-file parse set = the old per-file semantics)."""
         sys.path.insert(0, os.path.join(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))), "tools"))
-        import lint as lint_mod
-        pkg = tmp_path / "cruise_control_tpu"
-        pkg.mkdir(exist_ok=True)
-        mod = pkg / "mod.py"
+        try:
+            from analysis import cli
+        finally:
+            sys.path.pop(0)
+        mod = tmp_path / relpath
+        mod.parent.mkdir(parents=True, exist_ok=True)
         mod.write_text(body)
-        import ast as _ast
-        return lint_mod._durable_write_violations(
-            mod, _ast.parse(body))
+        return [f.render() for f in cli.analyze([mod], tmp_path)
+                if "durable-write" in f.message]
 
     def test_flags_truncating_open_and_rename(self, tmp_path):
         findings = self._lint(tmp_path, (
@@ -774,14 +778,7 @@ class TestDurableWriteLintRule:
         assert findings == []
 
     def test_persist_module_is_exempt(self, tmp_path):
-        import ast as _ast
-        sys.path.insert(0, os.path.join(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))), "tools"))
-        import lint as lint_mod
-        pkg = tmp_path / "cruise_control_tpu" / "utils"
-        pkg.mkdir(parents=True, exist_ok=True)
-        mod = pkg / "persist.py"
         body = "import os\n\n\ndef f(a, b):\n    os.replace(a, b)\n"
-        mod.write_text(body)
-        assert lint_mod._durable_write_violations(
-            mod, _ast.parse(body)) == []
+        assert self._lint(
+            tmp_path, body,
+            relpath="cruise_control_tpu/utils/persist.py") == []

@@ -455,14 +455,24 @@ def test_progcache_flush_sweeps_nested_orphans(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_watchdog_gateway_lint_rule(tmp_path):
-    import ast
+    """G106 via the whole-program analyzer (tools/analysis/ — the
+    ISSUE-15 successor of the flat lint; single-file parse set = the
+    old per-file semantics)."""
     import sys
     from pathlib import Path
     sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
     try:
-        import lint
+        from analysis import cli
     finally:
         sys.path.pop(0)
+
+    def findings(case, relpath, source):
+        path = tmp_path / case / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+        return [f.render() for f in cli.analyze([path], tmp_path / case)
+                if "watchdog-gateway" in f.message]
+
     bad = ("def _run(self, key, fn, *args):\n"
            "    aot = self._aot.get(key)\n"
            "    return aot(*args)\n")
@@ -470,9 +480,8 @@ def test_watchdog_gateway_lint_rule(tmp_path):
             "    aot = self._aot.get(key)\n"
             "    return health.watched_call(lambda: aot(*args),\n"
             "                               program=key)\n")
-    p = Path("cruise_control_tpu/analyzer/optimizer.py")
-    assert lint._watchdog_violations(p, ast.parse(bad))
-    assert not lint._watchdog_violations(p, ast.parse(good))
+    exec_file = "cruise_control_tpu/analyzer/optimizer.py"
+    assert findings("bad", exec_file, bad)
+    assert not findings("good", exec_file, good)
     # outside the exec files the rule does not apply
-    other = Path("cruise_control_tpu/facade.py")
-    assert not lint._watchdog_violations(other, ast.parse(bad))
+    assert not findings("other", "cruise_control_tpu/facade.py", bad)

@@ -515,18 +515,22 @@ class TestSchedulerTraces:
 # ---------------------------------------------------------------------------
 class TestTraceLintRule:
     def lint(self, tmp_path, relpath, source):
-        import importlib.util
+        """Per-file G108 findings from the whole-program analyzer
+        (tools/analysis/ — the ISSUE-15 successor of the flat lint;
+        single-file parse set = the old per-file semantics)."""
         import pathlib
-        spec = importlib.util.spec_from_file_location(
-            "cc_lint", pathlib.Path(conftest.__file__).parent.parent
-            / "tools" / "lint.py")
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
+        import sys
+        sys.path.insert(0, str(pathlib.Path(conftest.__file__)
+                               .parent.parent / "tools"))
+        try:
+            from analysis import cli
+        finally:
+            sys.path.pop(0)
         path = tmp_path / relpath
         path.parent.mkdir(parents=True, exist_ok=True)
         path.write_text(source)
-        return [f for f in mod.lint_file(path)
-                if "trace-propagation" in f]
+        return [f.render() for f in cli.analyze([path], tmp_path)
+                if "trace-propagation" in f.message]
 
     def test_solvejob_without_trace_flagged(self, tmp_path):
         bad = ("def f(sched, run):\n"
@@ -559,16 +563,18 @@ class TestTraceLintRule:
     def test_live_package_is_clean(self):
         """The shipped package passes its own rule (facade/sched)."""
         import pathlib
-        import importlib.util
+        import sys
         root = pathlib.Path(conftest.__file__).parent.parent
-        spec = importlib.util.spec_from_file_location(
-            "cc_lint", root / "tools" / "lint.py")
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
+        sys.path.insert(0, str(root / "tools"))
+        try:
+            from analysis import cli
+        finally:
+            sys.path.pop(0)
         for rel in ("cruise_control_tpu/facade.py",
                     "cruise_control_tpu/sched/scheduler.py"):
-            findings = [f for f in mod.lint_file(root / rel)
-                        if "trace-propagation" in f]
+            findings = [f.render()
+                        for f in cli.analyze([root / rel], root)
+                        if "trace-propagation" in f.message]
             assert not findings, findings
 
 

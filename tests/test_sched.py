@@ -504,17 +504,22 @@ class TestOptimizerCheckpoint:
 # ---------------------------------------------------------------------------
 class TestGatewayLintRule:
     def lint(self, tmp_path, relpath, source):
-        import importlib.util
+        """Per-file G101 findings from the whole-program analyzer
+        (tools/analysis/ — the ISSUE-15 successor of the flat lint;
+        single-file parse set = the old per-file semantics)."""
         import pathlib
-        spec = importlib.util.spec_from_file_location(
-            "cc_lint", pathlib.Path(conftest.__file__).parent.parent
-            / "tools" / "lint.py")
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
+        import sys
+        sys.path.insert(0, str(pathlib.Path(conftest.__file__)
+                               .parent.parent / "tools"))
+        try:
+            from analysis import cli
+        finally:
+            sys.path.pop(0)
         path = tmp_path / relpath
         path.parent.mkdir(parents=True, exist_ok=True)
         path.write_text(source)
-        return [f for f in mod.lint_file(path) if "single-gateway" in f]
+        return [f.render() for f in cli.analyze([path], tmp_path)
+                if "single-gateway" in f.message]
 
     def test_flags_direct_optimizer_solve_outside_gateway(self, tmp_path):
         bad = ("def f(optimizer, s, t):\n"

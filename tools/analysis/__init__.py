@@ -1,0 +1,15 @@
+"""Whole-program static analyzer for cruise_control_tpu (ISSUE 15).
+
+Subsumes the historical per-file `tools/lint.py`: same flat hygiene
+rules (byte-compatible output), plus what per-file lint cannot do — a
+project-wide symbol table and call graph (`project.py`) on which the
+nine gateway invariants become reachability checks (`gateway_rules.py`),
+a concurrency lint over extracted lock facts (`concurrency_rules.py`),
+and drift detection between code, config, docs and tests
+(`drift_rules.py`).  Rule catalog, suppression and baseline workflow:
+docs/ANALYSIS.md.
+
+Dependency-free by constraint: plain `ast`, no imports of the analyzed
+code, no third-party packages.
+"""
+from __future__ import annotations

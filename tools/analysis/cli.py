@@ -1,0 +1,127 @@
+"""Analyzer entry point (`make lint` / `python tools/lint.py`).
+
+Usage: python tools/lint.py [paths...] [options]
+
+  paths              files/directories to analyze (default: the package,
+                     tests/, tools/, bench.py, __graft_entry__.py)
+  --json             structured findings on stdout instead of flat lines
+  --baseline FILE    baseline file (default tools/analysis/baseline.json)
+  --no-baseline      ignore the baseline (report everything)
+  --prune-baseline   rewrite the baseline keeping only entries that
+                     still fire (the only way the tooling ever WRITES
+                     the baseline: it can shrink, never grow)
+
+Exit codes: 0 clean; 1 findings (or stale baseline entries); 2 usage or
+internal error.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import List
+
+from . import concurrency_rules, drift_rules, flat_rules, gateway_rules
+from .framework import (Finding, apply_baseline, apply_suppressions,
+                        load_baseline, scan_suppressions, write_baseline)
+from .project import Project
+
+DEFAULT_PATHS = ["cruise_control_tpu", "tests", "tools", "bench.py",
+                 "__graft_entry__.py"]
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def collect_files(roots: List[Path]) -> List[Path]:
+    files: List[Path] = []
+    for root in roots:
+        if root.is_dir():
+            files.extend(sorted(root.rglob("*.py")))
+        elif root.exists():
+            files.append(root)
+    return [f for f in files if "__pycache__" not in f.parts]
+
+
+def analyze(paths: List[Path], root: Path) -> List[Finding]:
+    """All findings (unsuppressed, un-baselined) for a parse set."""
+    project = Project.build(paths)
+    findings: List[Finding] = []
+    findings.extend(flat_rules.run(project))
+    findings.extend(gateway_rules.run(project))
+    findings.extend(concurrency_rules.run(project))
+    findings.extend(drift_rules.run(project, root))
+    suppressions = []
+    for mod in project.files:
+        suppressions.extend(scan_suppressions(str(mod.path), mod.text))
+    kept, _suppressed = apply_suppressions(findings, suppressions)
+    return kept
+
+
+def main(argv: List[str]) -> int:
+    args = list(argv)
+    as_json = "--json" in args
+    no_baseline = "--no-baseline" in args
+    prune = "--prune-baseline" in args
+    baseline_path = DEFAULT_BASELINE
+    for flag in ("--json", "--no-baseline", "--prune-baseline"):
+        while flag in args:
+            args.remove(flag)
+    if "--baseline" in args:
+        i = args.index("--baseline")
+        try:
+            baseline_path = Path(args[i + 1])
+        except IndexError:
+            print("lint: --baseline needs a file argument",
+                  file=sys.stderr)
+            return 2
+        del args[i:i + 2]
+    for a in args:
+        if a.startswith("-"):
+            print(f"lint: unknown option {a!r}", file=sys.stderr)
+            return 2
+
+    if no_baseline and prune:
+        print("lint: --no-baseline and --prune-baseline are mutually "
+              "exclusive (pruning against an ignored baseline would "
+              "empty it)", file=sys.stderr)
+        return 2
+
+    roots = [Path(p) for p in (args or DEFAULT_PATHS)]
+    files = collect_files(roots)
+    root = Path.cwd()
+    findings = analyze(files, root)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    entries = [] if no_baseline else load_baseline(baseline_path)
+    # staleness is judged only against files actually analyzed: a
+    # subset run (`lint.py cruise_control_tpu`) must neither fail on
+    # nor prune away entries for files outside its parse set
+    analyzed = {str(p) for p in files}
+    scoped = [e for e in entries if e.get("path") in analyzed]
+    kept, baselined, stale = apply_baseline(findings, scoped)
+
+    if prune:
+        remaining = [e for e in entries if e not in stale]
+        write_baseline(baseline_path, remaining)
+        print(f"lint: baseline pruned to {len(remaining)} entries "
+              f"(removed {len(stale)})", file=sys.stderr)
+        stale = []
+
+    if as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in kept],
+            "baselined": [f.to_json() for f in baselined],
+            "staleBaseline": stale,
+        }, indent=2, sort_keys=True))
+    else:
+        for f in kept:
+            print(f.render())
+        for e in stale:
+            print(f"{e.get('path')}: stale baseline entry for "
+                  f"{e.get('rule')} ({e.get('key')}) — the finding no "
+                  f"longer fires; run --prune-baseline to shrink the "
+                  f"baseline")
+    print(f"lint: {len(files)} files, {len(kept)} findings"
+          + (f", {len(baselined)} baselined" if baselined else "")
+          + (f", {len(stale)} stale baseline entries" if stale else ""),
+          file=sys.stderr)
+    return 1 if (kept or stale) else 0

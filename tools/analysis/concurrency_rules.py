@@ -1,0 +1,273 @@
+"""Concurrency lint on the project call graph.
+
+Three rules over the lock facts `project.py` extracts (lock-attribute
+identities with Condition aliasing, lexically-held sets at every call
+site and attribute write, thread spawn roots):
+
+  * C201 — lock-order cycles.  Holding A while acquiring B (directly,
+    or anywhere in the transitive callees of a call made under A) adds
+    the edge A->B to the fleet-wide lock-order graph; a cycle means two
+    paths acquire the same pair in opposite orders — the classic
+    AB/BA deadlock, invisible per-file because each side is locally
+    consistent.
+  * C202 — re-entry into a non-reentrant lock: holding `threading.Lock`
+    A and reaching (again: transitively) a second acquisition of A.
+    This is the registry self-deadlock class — a method that takes the
+    lock calling a sibling that takes it again.
+  * C203 — unlocked shared writes: an instance attribute of a
+    lock-owning class written with NO lock held, in a method reachable
+    from both a background thread (threading.Thread target) and the
+    request side (REST/facade entry points).  A class that owns a lock
+    has declared its state shared; a bare write to that state from a
+    dual-reachable method is either a missing `with self._lock:` or a
+    `_locked`-suffix contract violation.
+
+Precision notes (documented limitations, mirrored in the fixture
+tests): only statically-resolved call edges propagate lock facts (an
+unresolved dynamic call contributes nothing — under-approximation, no
+false cycles from wild attribution); `*_locked`-named methods and
+methods only ever called with a lock of their own class held are
+treated as lock-protected for C203; `__init__`/`__enter__`/`__exit__`
+and `start`/`stop`-shaped lifecycle setup is exempt from C203 (single-
+threaded by construction).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from .framework import Finding
+from .project import LockId, Project, lock_kind
+
+#: lifecycle methods whose writes are setup/teardown, not steady-state
+#: shared mutation
+_LIFECYCLE_METHODS = frozenset({
+    "__init__", "__enter__", "__exit__", "__del__", "close",
+})
+
+
+def _acquired_sets(project: Project) -> Dict[str, Set[LockId]]:
+    """Fixpoint: every lock a function may acquire, directly or through
+    resolved callees."""
+    acq: Dict[str, Set[LockId]] = {
+        q: {a.lock for a in fi.acquisitions}
+        for q, fi in project.functions.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, fi in project.functions.items():
+            cur = acq[q]
+            before = len(cur)
+            for callee in project.callees(q):
+                cur.update(acq.get(callee, ()))
+            if len(cur) != before:
+                changed = True
+    return acq
+
+
+def _fmt_lock(lock: LockId) -> str:
+    owner, attr = lock
+    short = owner.split(".", 1)[1] if "." in owner else owner
+    return f"{short}.{attr}"
+
+
+def lock_order_edges(project: Project) -> Dict[Tuple[LockId, LockId],
+                                               Tuple[str, int]]:
+    """{(held, acquired): (function qname, line)} — one witness per
+    ordered pair, from direct nesting and from calls made under a
+    lock into callees that acquire."""
+    acq = _acquired_sets(project)
+    edges: Dict[Tuple[LockId, LockId], Tuple[str, int]] = {}
+    for q, fi in project.functions.items():
+        for a in fi.acquisitions:
+            for held in a.held_before:
+                if held != a.lock:
+                    edges.setdefault((held, a.lock), (q, a.lineno))
+        for call in fi.calls:
+            if not call.held:
+                continue
+            inner: Set[LockId] = set()
+            for target in call.targets:
+                inner.update(acq.get(target, ()))
+            for held in call.held:
+                for got in inner:
+                    if got != held:
+                        edges.setdefault((held, got), (q, call.lineno))
+    return edges
+
+
+def lock_order_cycles(project: Project) -> List[List[LockId]]:
+    """Elementary cycles in the lock-order graph (DFS, deduplicated by
+    rotation)."""
+    edges = lock_order_edges(project)
+    graph: Dict[LockId, Set[LockId]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    cycles: List[List[LockId]] = []
+    seen_keys: Set[Tuple[LockId, ...]] = set()
+
+    def dfs(start: LockId, cur: LockId, path: List[LockId],
+            visited: Set[LockId]) -> None:
+        for nxt in sorted(graph.get(cur, ())):
+            if nxt == start and len(path) > 1:
+                rot = min(range(len(path)),
+                          key=lambda i: path[i])
+                key = tuple(path[rot:] + path[:rot])
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append(list(key))
+            elif nxt not in visited and nxt > start:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for node in sorted(graph):
+        dfs(node, node, [node], {node})
+    return cycles
+
+
+def _cycle_findings(project: Project) -> List[Finding]:
+    edges = lock_order_edges(project)
+    findings: List[Finding] = []
+    for cycle in lock_order_cycles(project):
+        pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+        witnesses = []
+        for a, b in pairs:
+            q, line = edges[(a, b)]
+            mod = project.functions[q].module.replace(".", "/")
+            witnesses.append(
+                f"{_fmt_lock(a)} -> {_fmt_lock(b)} at {q} "
+                f"({mod}.py:{line})")
+        first = edges[pairs[0]]
+        fi = project.functions[first[0]]
+        path = str(project.modules[fi.module].path)
+        findings.append(Finding(
+            "C201", path, first[1],
+            "lock-order cycle: "
+            + "; ".join(witnesses)
+            + " — pick one global order for these locks and acquire "
+              "them in it on every path [C201]",
+            symbol=first[0]))
+    return findings
+
+
+def _reentry_findings(project: Project) -> List[Finding]:
+    acq = _acquired_sets(project)
+    findings: List[Finding] = []
+    for q, fi in project.functions.items():
+        mod = project.modules.get(fi.module)
+        if mod is None:
+            continue
+        path = str(mod.path)
+        for a in fi.acquisitions:
+            if a.lock in a.held_before \
+                    and lock_kind(project, a.lock) == "lock":
+                findings.append(Finding(
+                    "C202", path, a.lineno,
+                    f"re-entry into non-reentrant lock "
+                    f"{_fmt_lock(a.lock)}: already held when acquired "
+                    f"again — this self-deadlocks; hoist the work out "
+                    f"of the locked region or split a _locked helper "
+                    f"[C202]",
+                    symbol=q))
+        for call in fi.calls:
+            for held in call.held:
+                if lock_kind(project, held) != "lock":
+                    continue
+                for target in call.targets:
+                    if held in acq.get(target, ()):
+                        findings.append(Finding(
+                            "C202", path, call.lineno,
+                            f"re-entry into non-reentrant lock "
+                            f"{_fmt_lock(held)}: held here while "
+                            f"calling {target.split('.', 1)[-1]} which "
+                            f"acquires it again — this self-deadlocks "
+                            f"[C202]",
+                            symbol=q))
+    return findings
+
+
+def _lock_protected_set(project: Project) -> Set[str]:
+    """Functions whose body always runs with a lock of their own class
+    held: `*_locked`-named methods (the package's contract), and —
+    propagated to a fixpoint — methods whose every resolved call edge
+    either lexically holds a lock of the same class or comes from an
+    already-protected same-class method.  This is how
+    `evaluate -> with _eval_lock: _evaluate_locked -> _solve_chunk`
+    extends the lock's cover to the helpers under it."""
+    by_name: Set[str] = {
+        q for q, fi in project.functions.items()
+        if fi.name.endswith("_locked")}
+    call_sites: Dict[str, List[Tuple[str, Tuple[LockId, ...]]]] = {}
+    for q, fi in project.functions.items():
+        for call in fi.calls:
+            for target in call.targets:
+                call_sites.setdefault(target, []).append((q, call.held))
+    # greatest fixpoint (optimistic init, then strip): recursion —
+    # `_solve_chunk` re-entering itself on OOM halving — must not block
+    # the cover from reaching a self-calling helper
+    protected: Set[str] = by_name | {
+        q for q, fi in project.functions.items()
+        if fi.cls is not None and call_sites.get(q)}
+    changed = True
+    while changed:
+        changed = False
+        for q in list(protected):
+            if q in by_name:
+                continue
+            fi = project.functions[q]
+            ok = all(any(h[0] == fi.cls for h in held)
+                     or (project.functions[caller].cls == fi.cls
+                         and caller in protected)
+                     for caller, held in call_sites.get(q, ()))
+            if not ok:
+                protected.discard(q)
+                changed = True
+    return protected
+
+
+def _shared_write_findings(project: Project) -> List[Finding]:
+    bg_roots: Set[str] = set()
+    for fi in project.functions.values():
+        bg_roots.update(fi.thread_targets)
+    req_roots = project.entry_points()
+    bg_reach = project.transitive_callees(bg_roots)
+    req_reach = project.transitive_callees(req_roots)
+    dual = bg_reach & req_reach
+    protected = _lock_protected_set(project)
+    findings: List[Finding] = []
+    for q in sorted(dual):
+        fi = project.functions.get(q)
+        if fi is None or fi.cls is None or not fi.writes:
+            continue
+        if fi.name in _LIFECYCLE_METHODS or q in protected:
+            continue
+        ci = project.classes.get(fi.cls)
+        if ci is None or not ci.lock_attrs:
+            continue              # class declares no lock: out of scope
+        mod = project.modules.get(fi.module)
+        path = str(mod.path) if mod else fi.module
+        for w in fi.writes:
+            if w.held:
+                continue
+            if w.attr in ci.lock_attrs:
+                continue          # binding the lock itself
+            if w.attr not in ci.instance_attrs:
+                continue
+            findings.append(Finding(
+                "C203", path, w.lineno,
+                f"unlocked write to shared attribute self.{w.attr} in "
+                f"{fi.cls.split('.')[-1]}.{fi.name} — reachable from "
+                f"both a background thread and request threads with "
+                f"no lock in scope; wrap it in `with "
+                f"self.{sorted(ci.lock_attrs)[0]}:` or move it behind "
+                f"a _locked helper [C203]",
+                symbol=q))
+    return findings
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    findings.extend(_cycle_findings(project))
+    findings.extend(_reentry_findings(project))
+    findings.extend(_shared_write_findings(project))
+    return findings

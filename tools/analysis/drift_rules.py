@@ -1,0 +1,328 @@
+"""Drift rules: three sources of truth, machine-checked to agree.
+
+  * D301/D302/D303 — config keys.  Declared = every `d.define("...")`
+    in the package, INCLUDING f-string defines (the per-class SLO loop
+    in `slo_config_def`): a JoinedStr define becomes a segment pattern
+    with `{...}` parts as wildcards, so `slo.precompute.latency.ms`
+    matches declared pattern `slo.*.latency.ms`.  Read = constant keys
+    at `get_long/get_int/...` use sites plus `.get("dotted.key")` on
+    config-named receivers (dict `.get` on non-config receivers is not
+    a config read).  Documented = the key column of
+    docs/CONFIGURATION.md's tables.  Any pairwise disagreement is a
+    finding at the offending site.
+  * D310/D311 — sensor names.  Every constant sensor name at a
+    registry call site (counter/meter/timer/histogram/gauge and their
+    update_* forms), plus constants flowing through first-order
+    forwarder helpers (`Scheduler._mark("sched-dispatches")`), is
+    mapped through THE canonical OpenMetrics transform (mirrored from
+    utils/metrics.canonical_sensor_name; a unit test pins the mirror
+    against the real one).  Two raw names on one canonical family are
+    a collision at analysis time instead of a register-time crash;
+    degenerate names that canonicalize to the empty fallback are
+    invalid.
+  * D320/D321 — fault sites.  Every `faults.inject("site")` armed in
+    the package must be exercised somewhere under tests/ and named in
+    docs/OPERATIONS.md — an injection point nobody scripts is dead
+    chaos coverage, and one operators cannot read about is a prod
+    footgun.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from .framework import Finding
+from .project import Project, _call_name, _terminal_name
+
+_GET_METHODS = {"get_long", "get_int", "get_string", "get_boolean",
+                "get_double", "get_list", "get_configured_instance",
+                "get_configured_instances"}
+
+_REGISTRY_METHODS = {"counter", "meter", "timer", "update_timer",
+                     "histogram", "update_histogram", "gauge"}
+
+#: mirror of utils/metrics.canonical_sensor_name — pinned against the
+#: real implementation by tests/test_analysis.py (the analyzer must not
+#: import the analyzed package)
+_INVALID_METRIC_CHARS = re.compile(r"[^a-zA-Z0-9_]")
+OPENMETRICS_PREFIX = "cc_tpu_"
+
+
+def canonical_sensor_name(name: str) -> str:
+    out = _INVALID_METRIC_CHARS.sub("_", name.strip()).lower()
+    out = out.strip("_") or "sensor"
+    if out[0].isdigit():
+        out = "_" + out
+    return OPENMETRICS_PREFIX + out
+
+
+# ----------------------------------------------------------------------
+# config keys
+# ----------------------------------------------------------------------
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _joinedstr_pattern(node: ast.JoinedStr) -> Optional[str]:
+    """Regex for an f-string key: literal parts escaped, `{...}` parts
+    wildcarded within a dotted segment."""
+    parts: List[str] = []
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(re.escape(v.value))
+        elif isinstance(v, ast.FormattedValue):
+            parts.append(r"[^.]+")
+        else:
+            return None
+    return "".join(parts)
+
+
+def _collect_config_decls(project: Project):
+    consts: Dict[str, Tuple[str, int]] = {}
+    patterns: List[Tuple[re.Pattern, str, int]] = []
+    for mod in project.files:
+        if mod.rel is None or mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node.func) != "define" or not node.args:
+                continue
+            arg = node.args[0]
+            key = _const_str(arg)
+            if key is not None:
+                consts.setdefault(key, (str(mod.path), node.lineno))
+            elif isinstance(arg, ast.JoinedStr):
+                pat = _joinedstr_pattern(arg)
+                if pat is not None:
+                    patterns.append((re.compile(pat + r"\Z"),
+                                     str(mod.path), node.lineno))
+    return consts, patterns
+
+
+def _collect_config_reads(project: Project):
+    reads: List[Tuple[str, str, int]] = []
+    for mod in project.files:
+        if mod.rel is None or mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            key = _const_str(node.args[0])
+            if key is None or "." not in key:
+                continue
+            if func.attr in _GET_METHODS:
+                reads.append((key, str(mod.path), node.lineno))
+            elif func.attr == "get":
+                recv = _terminal_name(func.value).lower()
+                if "config" in recv:
+                    reads.append((key, str(mod.path), node.lineno))
+    return reads
+
+
+def _documented_keys(doc_path: Path) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    if not doc_path.exists():
+        return out
+    for i, line in enumerate(doc_path.read_text().splitlines(), 1):
+        m = re.match(r"^\|\s*([A-Za-z0-9._]+)\s*\|", line)
+        if not m:
+            continue
+        key = m.group(1)
+        if key == "name" or set(key) <= {"-", "."}:
+            continue              # table header / separator rows
+        out.append((key, i))
+    return out
+
+
+def _config_rules(project: Project, root: Path) -> List[Finding]:
+    consts, patterns = _collect_config_decls(project)
+    if not consts:
+        return []                 # fixture trees without a config layer
+    declared_match = (lambda key: key in consts or any(
+        p.match(key) for p, _, _ in patterns))
+    findings: List[Finding] = []
+    for key, path, line in _collect_config_reads(project):
+        if not declared_match(key):
+            findings.append(Finding(
+                "D301", path, line,
+                f"config key '{key}' read here but never declared in "
+                f"the typed ConfigDef — declare it (with type, "
+                f"default, validator, doc) or the overlay silently "
+                f"accepts typos [D301]"))
+    doc_path = root / "docs" / "CONFIGURATION.md"
+    documented = _documented_keys(doc_path)
+    documented_set = {k for k, _ in documented}
+    for key, (path, line) in sorted(consts.items()):
+        if documented and key not in documented_set:
+            findings.append(Finding(
+                "D302", path, line,
+                f"config key '{key}' declared here but missing from "
+                f"docs/CONFIGURATION.md — regenerate it with "
+                f"`python -m cruise_control_tpu.config.docgen` [D302]"))
+    for key, line in documented:
+        if not declared_match(key):
+            findings.append(Finding(
+                "D303", str(doc_path), line,
+                f"config key '{key}' documented here but not declared "
+                f"in any ConfigDef — stale docs; regenerate with "
+                f"`python -m cruise_control_tpu.config.docgen` [D303]"))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# sensor names
+# ----------------------------------------------------------------------
+
+def _sensor_forwarders(project: Project) -> Dict[str, int]:
+    """{function qname: positional index of the sensor-name param}: a
+    helper whose body passes one of its parameters as the name argument
+    of a registry call (first-order indirection, e.g. Scheduler._mark).
+    """
+    out: Dict[str, int] = {}
+    for q, fi in project.functions.items():
+        if fi.node is None:
+            continue
+        params = [a.arg for a in fi.node.args.args]
+        if params and params[0] == "self":
+            params = params[1:]
+        if not params:
+            continue
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if _call_name(node.func) not in _REGISTRY_METHODS:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in params:
+                # positional index at the CALL SITE (self is not passed
+                # explicitly there)
+                out[q] = params.index(arg.id)
+                break
+    return out
+
+
+def _collect_sensor_names(project: Project):
+    """{raw name: (path, line) of first site}."""
+    forwarders = _sensor_forwarders(project)
+    sites: Dict[str, Tuple[str, int]] = {}
+    for mod in project.files:
+        if mod.rel is None or mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if _call_name(node.func) in _REGISTRY_METHODS:
+                raw = _const_str(node.args[0])
+                if raw is not None:
+                    sites.setdefault(raw, (str(mod.path), node.lineno))
+        fns = list(mod.functions.values())
+        for ci in mod.classes.values():
+            fns.extend(ci.methods.values())
+        for fi in fns:
+            for call in fi.calls:
+                for target in call.targets:
+                    idx = forwarders.get(target)
+                    if idx is None or len(call.node.args) <= idx:
+                        continue
+                    raw = _const_str(call.node.args[idx])
+                    if raw is not None:
+                        sites.setdefault(
+                            raw, (str(mod.path), call.lineno))
+    return sites
+
+
+def _sensor_rules(project: Project) -> List[Finding]:
+    sites = _collect_sensor_names(project)
+    findings: List[Finding] = []
+    by_canonical: Dict[str, List[str]] = {}
+    for raw, (path, line) in sorted(sites.items()):
+        canon = canonical_sensor_name(raw)
+        by_canonical.setdefault(canon, []).append(raw)
+        if canon == OPENMETRICS_PREFIX + "sensor" or raw != raw.strip():
+            findings.append(Finding(
+                "D310", path, line,
+                f"sensor name {raw!r} canonicalizes to a degenerate "
+                f"OpenMetrics family ({canon}) — use "
+                f"[a-z0-9-] words [D310]"))
+    for canon, raws in sorted(by_canonical.items()):
+        if len(raws) < 2:
+            continue
+        first = sites[raws[0]]
+        others = ", ".join(repr(r) for r in raws[1:])
+        findings.append(Finding(
+            "D311", first[0], first[1],
+            f"sensor names {raws[0]!r} and {others} collide on "
+            f"OpenMetrics family {canon} — they would export as one "
+            f"series; rename one [D311]"))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# fault sites
+# ----------------------------------------------------------------------
+
+def _armed_fault_sites(project: Project):
+    sites: Dict[str, Tuple[str, int]] = {}
+    for mod in project.files:
+        if mod.rel is None or mod.tree is None:
+            continue
+        if mod.rel == "utils/faults.py":
+            continue              # the harness itself
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            if _call_name(func) != "inject":
+                continue
+            if isinstance(func, ast.Attribute) \
+                    and _terminal_name(func.value) != "faults":
+                continue
+            site = _const_str(node.args[0])
+            if site is not None:
+                sites.setdefault(site, (str(mod.path), node.lineno))
+    return sites
+
+
+def _fault_rules(project: Project, root: Path) -> List[Finding]:
+    sites = _armed_fault_sites(project)
+    if not sites:
+        return []
+    tests_text = ""
+    tests_dir = root / "tests"
+    if tests_dir.is_dir():
+        for p in sorted(tests_dir.rglob("*.py")):
+            tests_text += p.read_text()
+    ops_path = root / "docs" / "OPERATIONS.md"
+    ops_text = ops_path.read_text() if ops_path.exists() else ""
+    findings: List[Finding] = []
+    for site, (path, line) in sorted(sites.items()):
+        if tests_text and site not in tests_text:
+            findings.append(Finding(
+                "D320", path, line,
+                f"fault site '{site}' armed here but never exercised "
+                f"under tests/ — script it in a chaos test or the "
+                f"injection point is dead coverage [D320]"))
+        if ops_text and site not in ops_text:
+            findings.append(Finding(
+                "D321", path, line,
+                f"fault site '{site}' armed here but absent from "
+                f"docs/OPERATIONS.md — operators must be able to look "
+                f"up every injection point [D321]"))
+    return findings
+
+
+def run(project: Project, root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    findings.extend(_config_rules(project, root))
+    findings.extend(_sensor_rules(project))
+    findings.extend(_fault_rules(project, root))
+    return findings

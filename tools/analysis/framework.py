@@ -1,0 +1,221 @@
+"""Finding/rule framework: stable IDs, suppressions, baseline, output.
+
+Contract (docs/ANALYSIS.md):
+
+  * every finding carries a stable rule id (F0xx flat per-file, G1xx
+    gateway reachability, C2xx concurrency, D3xx drift);
+  * `# cc-lint: disable=<RULE>[,<RULE>] -- <justification>` suppresses a
+    finding on its own line, or on the next line when the comment stands
+    alone.  The justification text after `--` is REQUIRED — a bare
+    disable is itself a finding (F008) — and a suppression that matches
+    nothing is a finding too (F009): suppressions cannot rot in place;
+  * a checked-in baseline (tools/analysis/baseline.json) grandfathers
+    pre-existing findings.  The gate is empty-or-shrinking: a baselined
+    finding that no longer fires is a STALE entry and fails the run
+    until pruned (`--prune-baseline`), and nothing in the tooling adds
+    entries — a new finding is fixed or suppressed inline with a
+    justification, never grandfathered;
+  * exit code 0 = clean (suppressed/baselined included), 1 = findings
+    or stale baseline entries, 2 = usage/internal error.
+
+Human output stays byte-compatible with the historical flat lint for
+the per-file rules (`path:line: message`); `--json` emits the full
+structured records.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+#: rule id -> one-line description (the catalog; docs/ANALYSIS.md is the
+#: prose version and tests assert the two agree)
+RULES: Dict[str, str] = {
+    "F001": "file does not parse (syntax error)",
+    "F002": "trailing whitespace",
+    "F003": "tab in indentation",
+    "F004": "line longer than the column budget",
+    "F005": "missing final newline",
+    "F006": "unused import (honoring __all__ and cross-module "
+            "re-export resolution)",
+    "F007": "fully-silent `except Exception` swallow",
+    "F008": "cc-lint suppression without a justification",
+    "F009": "cc-lint suppression that matches no finding",
+    "G101": "solve-gateway bypass: GoalOptimizer/scenario/host-fallback "
+            "solve reachable outside facade/sched gateway",
+    "G102": "mesh-gateway bypass: Mesh/device acquisition outside the "
+            "scheduler's mesh-token path",
+    "G103": "cache-gateway bypass: XLA compile outside the persistent "
+            "program-cache gateways",
+    "G104": "store-gateway bypass: LoadMonitor model materialization "
+            "outside the facade's store-aware gateway",
+    "G105": "durable-write bypass: truncating write/rename outside "
+            "utils/persist.py",
+    "G106": "watchdog-gateway bypass: compiled executable invoked "
+            "outside health.watched_call",
+    "G107": "tenant-root violation: mutable module-level state in "
+            "fleet-reachable modules",
+    "G108": "trace-propagation violation: naked span construction, "
+            "untraced SolveJob, or unspanned ladder attempt",
+    "C201": "lock-order cycle: two locks acquired in opposite orders "
+            "on different call paths",
+    "C202": "re-entry into a non-reentrant lock along a call path",
+    "C203": "shared attribute written without a lock while reachable "
+            "from both a background thread and request threads",
+    "D301": "config key read at a use site but never declared in the "
+            "typed ConfigDef",
+    "D302": "config key declared but missing from docs/CONFIGURATION.md",
+    "D303": "config key documented in docs/CONFIGURATION.md but not "
+            "declared",
+    "D310": "sensor name that canonicalizes to an invalid OpenMetrics "
+            "family",
+    "D311": "two sensor names colliding on one canonical OpenMetrics "
+            "family",
+    "D320": "fault site armed in code but never exercised by tests/",
+    "D321": "fault site armed in code but absent from "
+            "docs/OPERATIONS.md",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*cc-lint:\s*disable=([A-Z0-9,\s]+?)"
+    r"(?:\s*--\s*(.*?))?\s*$")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str                  #: full human text (byte-compatible for
+    #: the ported flat rules)
+    symbol: str = ""              #: enclosing qualname, for baselines
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "description": RULES.get(self.rule, "")}
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path,
+                self.symbol or _strip_positions(self.message))
+
+
+def _strip_positions(message: str) -> str:
+    return re.sub(r"\b\d+\b", "#", message)
+
+
+@dataclasses.dataclass
+class Suppression:
+    path: str
+    line: int                     #: line the comment sits on
+    rules: Tuple[str, ...]
+    justification: str
+    applies_to: Tuple[int, ...]   #: line numbers it covers
+    used: bool = False
+
+
+def scan_suppressions(path: str, text: str) -> List[Suppression]:
+    """All `# cc-lint: disable=...` comments in a file.  A trailing
+    comment covers its own line; a standalone comment line covers
+    itself and the next code line (continuation comment lines — a
+    multi-line justification — are skipped over, not targeted)."""
+    out: List[Suppression] = []
+    lines = text.splitlines()
+    for i, line in enumerate(lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",")
+                      if r.strip())
+        justification = (m.group(2) or "").strip()
+        applies = [i]
+        if line.lstrip().startswith("#"):
+            for j in range(i + 1, len(lines) + 1):
+                stripped = lines[j - 1].strip()
+                if stripped and not stripped.startswith("#"):
+                    applies.append(j)
+                    break
+        out.append(Suppression(path=path, line=i, rules=rules,
+                               justification=justification,
+                               applies_to=tuple(applies)))
+    return out
+
+
+def apply_suppressions(
+        findings: List[Finding],
+        suppressions: List[Suppression]) -> Tuple[List[Finding],
+                                                  List[Finding]]:
+    """(kept, suppressed).  Bare suppressions (F008) and unused ones
+    (F009) are appended to `kept` as findings of their own."""
+    index: Dict[Tuple[str, int], List[Suppression]] = {}
+    for sup in suppressions:
+        for line in sup.applies_to:
+            index.setdefault((sup.path, line), []).append(sup)
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        hits = [s for s in index.get((f.path, f.line), [])
+                if f.rule in s.rules and s.justification]
+        if hits:
+            for s in hits:
+                s.used = True
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    for sup in suppressions:
+        if not sup.justification:
+            kept.append(Finding(
+                rule="F008", path=sup.path, line=sup.line,
+                message=(f"cc-lint suppression of "
+                         f"{','.join(sup.rules)} without a "
+                         f"justification — append `-- <why>` [F008]")))
+        elif not sup.used:
+            kept.append(Finding(
+                rule="F009", path=sup.path, line=sup.line,
+                message=(f"cc-lint suppression of "
+                         f"{','.join(sup.rules)} matches no finding — "
+                         f"remove it [F009]")))
+    return kept, suppressed
+
+
+# ----------------------------------------------------------------------
+# baseline
+# ----------------------------------------------------------------------
+
+def load_baseline(path: Path) -> List[dict]:
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text())
+    return list(data.get("entries", []))
+
+
+def write_baseline(path: Path, entries: List[dict]) -> None:
+    payload = json.dumps({"version": 1, "entries": entries}, indent=2,
+                         sort_keys=True) + "\n"
+    path.write_text(payload)
+
+
+def apply_baseline(findings: List[Finding],
+                   entries: List[dict]) -> Tuple[List[Finding],
+                                                 List[Finding],
+                                                 List[dict]]:
+    """(kept, baselined, stale_entries)."""
+    keys = {(e.get("rule", ""), e.get("path", ""), e.get("key", "")): e
+            for e in entries}
+    matched: Set[Tuple[str, str, str]] = set()
+    kept: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in findings:
+        key = f.baseline_key()
+        if key in keys:
+            matched.add(key)
+            baselined.append(f)
+        else:
+            kept.append(f)
+    stale = [e for k, e in keys.items() if k not in matched]
+    return kept, baselined, stale

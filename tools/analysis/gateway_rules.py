@@ -1,0 +1,560 @@
+"""Gateway rules: the nine historical invariants, now whole-program.
+
+Each family keeps its module allowlist (the blessed gateways) and its
+historical name-heuristic detection — byte-compatible messages for
+everything the flat lint used to catch — and adds what per-file lint
+cannot do: sinks resolved SEMANTICALLY on the project call graph
+(class attribution, import aliases, first-order local type inference),
+so a bypass laundered through one helper function —
+
+    def _grab(cfg, state, topo):
+        opt = GoalOptimizer(cfg)          # receiver spells no 'optimizer'
+        return opt.optimizations(state, topo)
+
+— is a finding even though no identifier at the call site matches the
+old receiver-name patterns.  Where a semantic finding is reachable from
+a REST/facade entry point, the message carries the shortest
+entry-to-sink caller chain as evidence.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .framework import Finding
+from .project import (PACKAGE, FunctionInfo, ModuleInfo, Project,
+                      _call_name, _terminal_name)
+
+# -- allowlists (unchanged semantics from the flat lint) ---------------
+
+_GATEWAY_ALLOWED_RELPATHS = {"facade.py", "analyzer/optimizer.py",
+                             "scenario/engine.py", "testing/verifier.py"}
+
+_MESH_ALLOWED_RELPATHS = {"facade.py", "main.py", "parallel/mesh.py",
+                          "parallel/health.py",
+                          "analyzer/optimizer.py", "scenario/engine.py",
+                          "testing/virtual_mesh.py"}
+
+_MESH_ACQUIRE_CALLS = {"Mesh", "make_mesh", "runtime_mesh", "shard_state",
+                       "devices", "local_devices", "device_count"}
+
+_PROGCACHE_ALLOWED_RELPATHS = {"analyzer/optimizer.py",
+                               "scenario/engine.py",
+                               "parallel/progcache.py",
+                               "model/store.py",
+                               "parallel/health.py"}
+
+_MODEL_STORE_ALLOWED_RELPATHS = {"facade.py", "model/store.py",
+                                 "monitor/load_monitor.py"}
+
+_WATCHED_EXEC_FILES = {"analyzer/optimizer.py", "scenario/engine.py"}
+_WATCHED_EXEC_NAMES = {"aot", "shared", "prog"}
+
+_PERSIST_ALLOWED_RELPATHS = {"utils/persist.py"}
+
+_OBS_RESERVED_CONSTRUCTORS = {"Span", "SpanRecord", "Trace",
+                              "TraceContext", "_ActiveSpan"}
+
+_MUTABLE_CONSTRUCTORS = {"list", "dict", "set", "bytearray", "deque",
+                         "defaultdict", "OrderedDict", "Counter",
+                         "WeakValueDictionary", "WeakKeyDictionary"}
+
+#: semantic sink definitions: (family, defining module rel, qname tail)
+_SOLVE_SINKS = (("analyzer/optimizer.py", "GoalOptimizer.optimizations"),
+                ("scenario/engine.py", "ScenarioEngine.evaluate"),
+                ("model/cpu_model.py", "host_fallback_solve"))
+
+
+def _pkg_rel(mod: ModuleInfo) -> Optional[str]:
+    return mod.rel
+
+
+def _in_package(mod: ModuleInfo) -> bool:
+    return mod.rel is not None
+
+
+def _sink_qnames(project: Project, specs) -> Set[str]:
+    out: Set[str] = set()
+    for rel, tail in specs:
+        for mod in project.files:
+            if mod.rel != rel or mod.dotted is None:
+                continue
+            out.add(f"{mod.dotted}.{tail}")
+    return out
+
+
+def _chain_note(project: Project, fn: Optional[FunctionInfo],
+                entries: Set[str]) -> str:
+    if fn is None:
+        return ""
+    chain = project.shortest_caller_chain(fn.qname, entries)
+    if not chain:
+        return ""
+    short = [q.split(".", 1)[1] if q.startswith(PACKAGE + ".") else q
+             for q in chain]
+    return f" (reachable from entry point: {' -> '.join(short)})"
+
+
+def _enclosing_function(mod: ModuleInfo,
+                        lineno: int) -> Optional[FunctionInfo]:
+    best = None
+    fns = list(mod.functions.values())
+    for ci in mod.classes.values():
+        fns.extend(ci.methods.values())
+    for fi in fns:
+        node = fi.node
+        end = getattr(node, "end_lineno", node.lineno)
+        if node.lineno <= lineno <= end:
+            if best is None or node.lineno > best.node.lineno:
+                best = fi
+    return best
+
+
+# ----------------------------------------------------------------------
+# G101 solve gateway
+# ----------------------------------------------------------------------
+
+def _solve_rule(project: Project, entries: Set[str]) -> List[Finding]:
+    sinks = _sink_qnames(project, _SOLVE_SINKS)
+    findings: List[Finding] = []
+    for mod in project.files:
+        rel = _pkg_rel(mod)
+        if rel is None or mod.tree is None:
+            continue
+        if rel.startswith("sched/") or rel in _GATEWAY_ALLOWED_RELPATHS:
+            continue
+        path = str(mod.path)
+        flagged: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                recv = _terminal_name(func.value).lower()
+                if func.attr == "optimizations" and "optimizer" in recv:
+                    flagged.add(id(node))
+                    findings.append(Finding(
+                        "G101", path, node.lineno,
+                        "direct GoalOptimizer solve call outside "
+                        "facade.py/sched/ — route it through the "
+                        "device-time scheduler (single-gateway rule)"))
+                elif func.attr == "evaluate" and (
+                        "scenario_engine" in recv
+                        or recv == "scenarioengine"):
+                    flagged.add(id(node))
+                    findings.append(Finding(
+                        "G101", path, node.lineno,
+                        "direct scenario-engine solve call outside "
+                        "facade.py/sched/ — route it through the "
+                        "device-time scheduler (single-gateway rule)"))
+            elif isinstance(func, ast.Name) \
+                    and func.id == "host_fallback_solve":
+                flagged.add(id(node))
+                findings.append(Finding(
+                    "G101", path, node.lineno,
+                    "direct host_fallback_solve call outside "
+                    "facade.py/sched/ — route it through the "
+                    "device-time scheduler (single-gateway rule)"))
+        # semantic pass: resolved call edges into the sink set that the
+        # name heuristics above did not already flag (the laundering
+        # catch the flat lint provably missed)
+        fns = list(mod.functions.values())
+        for ci in mod.classes.values():
+            fns.extend(ci.methods.values())
+        for fi in fns:
+            for call in fi.calls:
+                if id(call.node) in flagged:
+                    continue
+                hit = sinks.intersection(call.targets)
+                if not hit:
+                    continue
+                sink = sorted(hit)[0]
+                findings.append(Finding(
+                    "G101", path, call.lineno,
+                    f"solve gateway bypass: call resolves to "
+                    f"{sink.split('.', 1)[1]} outside facade.py/sched/ "
+                    f"— route it through the device-time scheduler "
+                    f"(single-gateway rule)"
+                    + _chain_note(project, fi, entries),
+                    symbol=fi.qname))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# G102 mesh gateway
+# ----------------------------------------------------------------------
+
+def _mesh_rule(project: Project, entries: Set[str]) -> List[Finding]:
+    mesh_fn_sinks = _sink_qnames(project, (
+        ("parallel/mesh.py", "make_mesh"),
+        ("parallel/mesh.py", "runtime_mesh"),
+        ("parallel/mesh.py", "shard_state")))
+    findings: List[Finding] = []
+    for mod in project.files:
+        rel = _pkg_rel(mod)
+        if rel is None or mod.tree is None:
+            continue
+        if rel.startswith("sched/") or rel in _MESH_ALLOWED_RELPATHS:
+            continue
+        path = str(mod.path)
+        allowed = "sched/, " + ", ".join(sorted(_MESH_ALLOWED_RELPATHS))
+        flagged: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            aliased = mod.imports.get(name)
+            is_alias_mesh = (aliased is not None
+                             and aliased[1] == "Mesh"
+                             and aliased[0].startswith("jax"))
+            if name not in _MESH_ACQUIRE_CALLS and not is_alias_mesh:
+                continue
+            if name in ("devices", "local_devices", "device_count"):
+                func = node.func
+                if not (isinstance(func, ast.Attribute)
+                        and _terminal_name(func.value) == "jax"):
+                    continue
+            shown = "Mesh" if is_alias_mesh else name
+            flagged.add(id(node))
+            findings.append(Finding(
+                "G102", path, node.lineno,
+                f"direct mesh/device acquisition ({shown}) outside "
+                f"the allowed modules ({allowed}) — the scheduler's "
+                f"mesh token is the only path to multi-chip dispatch "
+                f"(mesh single-gateway rule)"))
+        fns = list(mod.functions.values())
+        for ci in mod.classes.values():
+            fns.extend(ci.methods.values())
+        for fi in fns:
+            for call in fi.calls:
+                if id(call.node) in flagged:
+                    continue
+                hit = mesh_fn_sinks.intersection(call.targets)
+                if not hit:
+                    continue
+                sink = sorted(hit)[0]
+                findings.append(Finding(
+                    "G102", path, call.lineno,
+                    f"mesh gateway bypass: call resolves to "
+                    f"{sink.split('.', 1)[1]} outside the allowed "
+                    f"modules — the scheduler's mesh token is the only "
+                    f"path to multi-chip dispatch (mesh single-gateway "
+                    f"rule)" + _chain_note(project, fi, entries),
+                    symbol=fi.qname))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# G103 cache gateway
+# ----------------------------------------------------------------------
+
+def _progcache_rule(project: Project, entries: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    allowed = ", ".join(sorted(_PROGCACHE_ALLOWED_RELPATHS))
+    for mod in project.files:
+        rel = _pkg_rel(mod)
+        if rel is None or mod.tree is None:
+            continue
+        if rel in _PROGCACHE_ALLOWED_RELPATHS:
+            continue
+        path = str(mod.path)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            what = None
+            if isinstance(func, ast.Attribute):
+                if (func.attr == "jit"
+                        and _terminal_name(func.value) == "jax"):
+                    what = "jax.jit"
+                elif (func.attr == "compile"
+                      and isinstance(func.value, ast.Call)
+                      and isinstance(func.value.func, ast.Attribute)
+                      and func.value.func.attr == "lower"):
+                    what = ".lower().compile()"
+                elif (func.attr in ("export", "deserialize",
+                                    "register_pytree_node_serialization")
+                      and _terminal_name(func.value) in ("export",
+                                                         "jexport")):
+                    what = f"jax.export.{func.attr}"
+            elif isinstance(func, ast.Name):
+                aliased = mod.imports.get(func.id)
+                if aliased == ("jax", "jit"):
+                    what = "jax.jit"
+            if what is not None:
+                fi = _enclosing_function(mod, node.lineno)
+                findings.append(Finding(
+                    "G103", path, node.lineno,
+                    f"direct program compile ({what}) outside the "
+                    f"compile gateways ({allowed}) — every XLA "
+                    f"compile must go through the persistent program "
+                    f"cache (cache-gateway rule)"
+                    + _chain_note(project, fi, entries),
+                    symbol=fi.qname if fi else ""))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# G104 model-store gateway
+# ----------------------------------------------------------------------
+
+def _model_store_rule(project: Project, entries: Set[str]) -> List[Finding]:
+    monitor_sinks = _sink_qnames(project, (
+        ("monitor/load_monitor.py", "LoadMonitor.cluster_model"),))
+    findings: List[Finding] = []
+    allowed = ", ".join(sorted(_MODEL_STORE_ALLOWED_RELPATHS))
+    for mod in project.files:
+        rel = _pkg_rel(mod)
+        if rel is None or mod.tree is None:
+            continue
+        if rel in _MODEL_STORE_ALLOWED_RELPATHS:
+            continue
+        path = str(mod.path)
+        flagged: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute) \
+                    or func.attr != "cluster_model":
+                continue
+            recv = _terminal_name(func.value).lower()
+            if "monitor" in recv:
+                flagged.add(id(node))
+                findings.append(Finding(
+                    "G104", path, node.lineno,
+                    f"direct LoadMonitor model materialization outside "
+                    f"the allowed modules ({allowed}) — route it "
+                    f"through the facade's store-aware gateway "
+                    f"(single-store rule)"))
+        fns = list(mod.functions.values())
+        for ci in mod.classes.values():
+            fns.extend(ci.methods.values())
+        for fi in fns:
+            for call in fi.calls:
+                if id(call.node) in flagged:
+                    continue
+                if not monitor_sinks.intersection(call.targets):
+                    continue
+                findings.append(Finding(
+                    "G104", path, call.lineno,
+                    f"store gateway bypass: call resolves to "
+                    f"LoadMonitor.cluster_model outside the allowed "
+                    f"modules ({allowed}) — route it through the "
+                    f"facade's store-aware gateway (single-store rule)"
+                    + _chain_note(project, fi, entries),
+                    symbol=fi.qname))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# G105 durable writes
+# ----------------------------------------------------------------------
+
+def _write_mode_of(call: ast.Call):
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+def _durable_write_rule(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.files:
+        rel = _pkg_rel(mod)
+        if rel is None or mod.tree is None:
+            continue
+        if rel in _PERSIST_ALLOWED_RELPATHS:
+            continue
+        path = str(mod.path)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = _call_name(func)
+            aliased = mod.imports.get(name) \
+                if isinstance(func, ast.Name) else None
+            os_rename = (
+                name in ("rename", "replace")
+                and ((isinstance(func, ast.Attribute)
+                      and _terminal_name(func.value) == "os")
+                     or aliased in (("os", "rename"), ("os", "replace"))))
+            if os_rename:
+                findings.append(Finding(
+                    "G105", path, node.lineno,
+                    f"direct os.{name} outside utils/persist.py — "
+                    f"publish state through persist.atomic_write/"
+                    f"atomic_rewrite/replace (durable-write rule)"))
+            elif name in ("open", "fdopen"):
+                if name == "open" and isinstance(func, ast.Attribute) \
+                        and _terminal_name(func.value) != "os":
+                    continue          # some_obj.open(...): not file io
+                mode = _write_mode_of(node)
+                if mode is not None and "w" in mode:
+                    findings.append(Finding(
+                        "G105", path, node.lineno,
+                        f"truncating file open (mode={mode!r}) outside "
+                        f"utils/persist.py — a crash mid-write tears "
+                        f"the file; publish through "
+                        f"persist.atomic_write (durable-write rule)"))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# G106 watchdog gateway
+# ----------------------------------------------------------------------
+
+def _watchdog_rule(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.files:
+        rel = _pkg_rel(mod)
+        if rel not in _WATCHED_EXEC_FILES or mod.tree is None:
+            continue
+        covered: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and _call_name(node.func) == "watched_call"):
+                for arg in node.args:
+                    if isinstance(arg, ast.Lambda):
+                        for sub in ast.walk(arg):
+                            covered.add(id(sub))
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in _WATCHED_EXEC_NAMES
+                    and id(node) not in covered):
+                findings.append(Finding(
+                    "G106", str(mod.path), node.lineno,
+                    f"compiled-executable call ({node.func.id}(...)) "
+                    f"outside the watched-dispatch gateway — wrap it "
+                    f"in health.watched_call(lambda: ...) so a wedged "
+                    f"dispatch cannot capture the calling thread "
+                    f"(watchdog-gateway rule)"))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# G107 tenant root
+# ----------------------------------------------------------------------
+
+def _is_mutable_value(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return _call_name(node.func) in _MUTABLE_CONSTRUCTORS
+    return False
+
+
+def _tenant_root_rule(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.files:
+        rel = _pkg_rel(mod)
+        if rel is None or not rel.startswith("fleet/") \
+                or mod.tree is None:
+            continue
+        for node in mod.tree.body:
+            targets, value = [], None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None or not _is_mutable_value(value):
+                continue
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if names and all(n.startswith("__") and n.endswith("__")
+                             for n in names):
+                continue          # __all__ and friends: module metadata
+            findings.append(Finding(
+                "G107", str(mod.path), node.lineno,
+                f"mutable module-level state {names or '<assignment>'} "
+                f"in a fleet module — per-tenant state may live only "
+                f"under the FleetRegistry instance (tenant-root rule)"))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# G108 trace propagation
+# ----------------------------------------------------------------------
+
+def _span_scoped_calls(tree: ast.AST) -> Set[int]:
+    scoped: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        opens_span = any(
+            isinstance(sub, ast.Call)
+            and "span" in _call_name(sub.func).lower()
+            for item in node.items
+            for sub in ast.walk(item.context_expr))
+        if opens_span:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    scoped.add(id(sub))
+    return scoped
+
+
+def _trace_rule(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.files:
+        rel = _pkg_rel(mod)
+        if rel is None or mod.tree is None:
+            continue
+        in_obs = rel.startswith("obs/")
+        path = str(mod.path)
+        span_scoped = None
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            reserved = name in _OBS_RESERVED_CONSTRUCTORS
+            if not reserved and isinstance(node.func, ast.Name):
+                aliased = mod.imports.get(name)
+                if aliased is not None \
+                        and aliased[0].endswith("obs.trace") \
+                        and aliased[1] in _OBS_RESERVED_CONSTRUCTORS:
+                    reserved, name = True, aliased[1]
+            if reserved and not in_obs:
+                findings.append(Finding(
+                    "G108", path, node.lineno,
+                    f"naked span/trace construction ({name}) outside "
+                    f"obs/ — go through the obs.trace helpers "
+                    f"(trace-propagation rule)"))
+            elif name == "SolveJob":
+                if not any(kw.arg == "trace" for kw in node.keywords):
+                    findings.append(Finding(
+                        "G108", path, node.lineno,
+                        "SolveJob(...) without trace= — every "
+                        "scheduler submission must carry a "
+                        "TraceContext (trace-propagation rule)"))
+            elif name == "_solve_on_rung":
+                if span_scoped is None:
+                    span_scoped = _span_scoped_calls(mod.tree)
+                if id(node) not in span_scoped:
+                    findings.append(Finding(
+                        "G108", path, node.lineno,
+                        "ladder attempt (_solve_on_rung) outside a "
+                        "span scope — wrap rung attempts in "
+                        "obs.trace.span so every attempt is "
+                        "attributable (trace-propagation rule)"))
+    return findings
+
+
+def run(project: Project) -> List[Finding]:
+    entries = project.entry_points()
+    findings: List[Finding] = []
+    findings.extend(_solve_rule(project, entries))
+    findings.extend(_mesh_rule(project, entries))
+    findings.extend(_progcache_rule(project, entries))
+    findings.extend(_model_store_rule(project, entries))
+    findings.extend(_durable_write_rule(project))
+    findings.extend(_watchdog_rule(project))
+    findings.extend(_tenant_root_rule(project))
+    findings.extend(_trace_rule(project))
+    return findings

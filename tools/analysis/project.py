@@ -1,0 +1,786 @@
+"""Whole-program model of the package: symbol table + call graph.
+
+One `Project` is built per analyzer run from plain `ast` parses (no
+third-party dependencies, no imports of the analyzed code).  It gives
+the rules what per-file lint fundamentally cannot have:
+
+  * a symbol table of every module / class / function, with import
+    resolution (absolute and relative, aliases included) so a name at a
+    use site maps back to its defining module;
+  * a call graph whose edges are resolved through (a) local names and
+    imports, (b) `self.`-methods with base-class lookup inside the
+    package, (c) unique-method-name class attribution (`x.optimizations()`
+    resolves to `GoalOptimizer.optimizations` when exactly one class
+    defines it), and (d) first-order local type inference
+    (`opt = GoalOptimizer(cfg); opt.optimizations(...)`, parameter
+    annotations, `x = self.attr` where the attr type is known from
+    `__init__`) — the indirection budget the gateway reachability rules
+    need to catch a bypass laundered through one helper;
+  * per-function concurrency facts: which locks a function acquires
+    (`with self._lock:` / module-level locks, `Condition(lock)`
+    aliased to its underlying lock), which locks are lexically held at
+    every call site and attribute write, and where threads are spawned
+    (`threading.Thread(target=...)` roots).
+
+Everything is lexical and conservative: unresolved calls get NO edge
+(rules that need more apply their own documented heuristics on the
+recorded receiver spelling).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+#: the package whose modules participate in whole-program analysis
+PACKAGE = "cruise_control_tpu"
+
+#: method names on a `self.<attr>.<m>(...)` receiver that mutate the
+#: container bound to the attribute (counted as attribute writes by the
+#: shared-state rule, same as `self.<attr>[k] = v`)
+MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "sort",
+})
+
+LockId = Tuple[str, str]          #: (owner qualname, attribute/global name)
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One call expression inside a function body."""
+
+    lineno: int
+    name: str                     #: called attr/function name (terminal)
+    recv: str                     #: terminal receiver identifier ("" if none)
+    targets: Tuple[str, ...]      #: resolved callee qnames (may be empty)
+    held: Tuple[LockId, ...]      #: locks lexically held at the call
+    node: ast.Call = dataclasses.field(repr=False, default=None)
+
+
+@dataclasses.dataclass
+class LockAcq:
+    lock: LockId
+    lineno: int
+    held_before: Tuple[LockId, ...]   #: locks already held when acquiring
+
+
+@dataclasses.dataclass
+class AttrWrite:
+    attr: str
+    lineno: int
+    held: Tuple[LockId, ...]
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qname: str                    #: module.Class.method / module.func
+    module: str                   #: dotted module
+    cls: Optional[str]            #: owning class qname, if a method
+    name: str
+    lineno: int
+    node: ast.AST = dataclasses.field(repr=False, default=None)
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    acquisitions: List[LockAcq] = dataclasses.field(default_factory=list)
+    writes: List[AttrWrite] = dataclasses.field(default_factory=list)
+    thread_targets: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    qname: str
+    module: str
+    name: str
+    lineno: int
+    bases: List[str] = dataclasses.field(default_factory=list)
+    methods: Dict[str, FunctionInfo] = dataclasses.field(
+        default_factory=dict)
+    #: lock-holding attributes: attr -> ("lock"|"rlock", aliased attr or
+    #: None) — `self._cond = threading.Condition(self._lock)` records
+    #: ("lock", "_lock") so `with self._cond:` resolves to the SAME
+    #: LockId as `with self._lock:` (sched/queue.py's shape; treating
+    #: them as two locks would fabricate order edges)
+    lock_attrs: Dict[str, Tuple[str, Optional[str]]] = dataclasses.field(
+        default_factory=dict)
+    #: instance attrs assigned `self.x = ClassName(...)` -> class qname
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+    instance_attrs: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: Path                    #: as given on the command line
+    rel: Optional[str]            #: package-relative posix path, or None
+    dotted: Optional[str]         #: dotted module name, or None
+    text: str = dataclasses.field(repr=False, default="")
+    tree: Optional[ast.AST] = dataclasses.field(repr=False, default=None)
+    syntax_error: Optional[SyntaxError] = None
+    #: local binding -> (module dotted, symbol or None for whole-module)
+    imports: Dict[str, Tuple[str, Optional[str]]] = dataclasses.field(
+        default_factory=dict)
+    import_nodes: Dict[str, ast.AST] = dataclasses.field(
+        default_factory=dict, repr=False)
+    classes: Dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+    functions: Dict[str, FunctionInfo] = dataclasses.field(
+        default_factory=dict)
+    module_locks: Dict[str, Tuple[str, Optional[str]]] = dataclasses.field(
+        default_factory=dict)
+    all_names: Optional[Set[str]] = None
+
+
+def _terminal_name(node) -> str:
+    """Terminal identifier of an expression: `self.goal_optimizer` ->
+    'goal_optimizer', `optimizer` -> 'optimizer', `Cls(...)` -> 'Cls'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return _terminal_name(node.func)
+    return ""
+
+
+def _call_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _attr_chain(node) -> Optional[List[str]]:
+    """['self', 'x', 'y'] for `self.x.y`; None for non-name chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _is_threading_call(node: ast.Call, mod: ModuleInfo, name: str) -> bool:
+    """Is this `threading.<name>(...)` / `<name>(...)` imported from
+    threading?"""
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr == name:
+        recv = _terminal_name(func.value)
+        tgt = mod.imports.get(recv)
+        return recv == "threading" or (
+            tgt is not None and tgt[0] == "threading")
+    if isinstance(func, ast.Name) and func.id == name:
+        tgt = mod.imports.get(func.id)
+        return tgt is not None and tgt == ("threading", name)
+    return False
+
+
+def _lock_kind_of_call(node: ast.Call, mod: ModuleInfo):
+    """("lock"|"rlock", aliased-attr-or-None) when the call constructs a
+    threading lock/condition, else None.  A bare `Condition()` owns an
+    RLock; `Condition(x)` aliases x."""
+    for name, kind in (("Lock", "lock"), ("RLock", "rlock")):
+        if _is_threading_call(node, mod, name):
+            return (kind, None)
+    if _is_threading_call(node, mod, "Condition"):
+        if node.args:
+            chain = _attr_chain(node.args[0])
+            if chain and len(chain) == 2 and chain[0] == "self":
+                return ("lock", chain[1])
+            if chain and len(chain) == 1:
+                return ("lock", chain[0])
+        return ("rlock", None)
+    return None
+
+
+class Project:
+    """See module docstring."""
+
+    def __init__(self, files: List[ModuleInfo]):
+        self.files = files
+        self.modules: Dict[str, ModuleInfo] = {
+            m.dotted: m for m in files if m.dotted}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        #: method name -> classes defining it (class attribution index)
+        self.method_index: Dict[str, List[ClassInfo]] = {}
+        #: (module dotted, symbol) imported anywhere in the parse set —
+        #: the re-export evidence the unused-import rule consults
+        self.imported_symbols: Set[Tuple[str, str]] = set()
+        self.callers: Dict[str, Set[str]] = {}
+        self._edges: Dict[str, Set[str]] = {}
+
+    # -- construction --------------------------------------------------
+
+    @classmethod
+    def build(cls, paths: List[Path]) -> "Project":
+        files = [_parse_one(p) for p in paths]
+        project = cls(files)
+        for mod in files:
+            if mod.tree is None:
+                continue
+            _collect_defs(mod, project)
+        for mod in files:
+            if mod.tree is None:
+                continue
+            for name, target in mod.imports.items():
+                tmod, tsym = target
+                if tsym is not None:
+                    project.imported_symbols.add((tmod, tsym))
+        project._index()
+        for mod in files:
+            if mod.tree is None or mod.dotted is None:
+                continue
+            _resolve_module(mod, project)
+        project._link()
+        return project
+
+    def _index(self) -> None:
+        for mod in self.files:
+            for ci in mod.classes.values():
+                self.classes[ci.qname] = ci
+                for mname, fi in ci.methods.items():
+                    self.functions[fi.qname] = fi
+                    self.method_index.setdefault(mname, []).append(ci)
+            for fi in mod.functions.values():
+                self.functions[fi.qname] = fi
+
+    def _link(self) -> None:
+        for fi in self.functions.values():
+            tset = self._edges.setdefault(fi.qname, set())
+            for call in fi.calls:
+                tset.update(call.targets)
+        for src, dsts in self._edges.items():
+            for dst in dsts:
+                self.callers.setdefault(dst, set()).add(src)
+
+    # -- queries -------------------------------------------------------
+
+    def callees(self, qname: str) -> Set[str]:
+        return self._edges.get(qname, set())
+
+    def transitive_callees(self, roots) -> Set[str]:
+        seen: Set[str] = set()
+        stack = list(roots)
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self._edges.get(cur, ()))
+        return seen
+
+    def shortest_caller_chain(self, qname: str,
+                              roots: Set[str]) -> Optional[List[str]]:
+        """Shortest entry-point -> ... -> qname chain, or None."""
+        if qname in roots:
+            return [qname]
+        prev: Dict[str, str] = {}
+        frontier = [qname]
+        seen = {qname}
+        while frontier:
+            nxt: List[str] = []
+            for cur in frontier:
+                for caller in sorted(self.callers.get(cur, ())):
+                    if caller in seen:
+                        continue
+                    seen.add(caller)
+                    prev[caller] = cur
+                    if caller in roots:
+                        chain = [caller]
+                        while chain[-1] != qname:
+                            chain.append(prev[chain[-1]])
+                        return chain
+                    nxt.append(caller)
+            frontier = nxt
+        return None
+
+    def class_of(self, qname: str) -> Optional[ClassInfo]:
+        fi = self.functions.get(qname)
+        if fi is None or fi.cls is None:
+            return None
+        return self.classes.get(fi.cls)
+
+    def resolve_method(self, ci: ClassInfo,
+                       name: str) -> Optional[FunctionInfo]:
+        """Method lookup through in-package base classes (by name)."""
+        seen: Set[str] = set()
+        stack = [ci]
+        while stack:
+            cur = stack.pop()
+            if cur.qname in seen:
+                continue
+            seen.add(cur.qname)
+            if name in cur.methods:
+                return cur.methods[name]
+            mod = self.modules.get(cur.module)
+            for base in cur.bases:
+                bci = self._class_named(base, mod)
+                if bci is not None:
+                    stack.append(bci)
+        return None
+
+    def _class_named(self, name: str,
+                     mod: Optional[ModuleInfo]) -> Optional[ClassInfo]:
+        if mod is not None:
+            if name in mod.classes:
+                return mod.classes[name]
+            tgt = mod.imports.get(name)
+            if tgt is not None and tgt[1] is not None:
+                tmod = self.modules.get(tgt[0])
+                if tmod is not None:
+                    return tmod.classes.get(tgt[1])
+        cands = [c for c in self.classes.values() if c.name == name]
+        return cands[0] if len(cands) == 1 else None
+
+    def entry_points(self) -> Set[str]:
+        """REST/facade/process entry points for reachability evidence:
+        every function in api/ modules + main.py, and the facade's
+        public methods."""
+        roots: Set[str] = set()
+        for mod in self.files:
+            if mod.rel is None:
+                continue
+            if mod.rel.startswith("api/") or mod.rel == "main.py":
+                for fi in mod.functions.values():
+                    roots.add(fi.qname)
+                for ci in mod.classes.values():
+                    roots.update(f.qname for f in ci.methods.values())
+            if mod.rel == "facade.py":
+                for ci in mod.classes.values():
+                    roots.update(f.qname for f in ci.methods.values()
+                                 if not f.name.startswith("_"))
+        return roots
+
+
+def _parse_one(path: Path) -> ModuleInfo:
+    text = path.read_text()
+    rel = dotted = None
+    parts = path.parts
+    if PACKAGE in parts:
+        pkg = len(parts) - 1 - parts[::-1].index(PACKAGE)
+        rel = "/".join(parts[pkg + 1:])
+        stem = [PACKAGE] + list(parts[pkg + 1:-1])
+        if path.name != "__init__.py":
+            stem.append(path.stem)
+        dotted = ".".join(stem)
+    elif "analysis" in parts and path.suffix == ".py":
+        # the analyzer self-analyzes: tools/analysis/ gets a synthetic
+        # dotted name so its own modules join the symbol table
+        pkg = len(parts) - 1 - parts[::-1].index("analysis")
+        stem = ["tools", "analysis"] + list(parts[pkg + 1:-1])
+        if path.name != "__init__.py":
+            stem.append(path.stem)
+        dotted = ".".join(stem)
+    mod = ModuleInfo(path=path, rel=rel, dotted=dotted, text=text)
+    try:
+        mod.tree = ast.parse(text, filename=str(path))
+    except SyntaxError as exc:
+        mod.syntax_error = exc
+    return mod
+
+
+def _resolve_import_module(mod: ModuleInfo, node: ast.ImportFrom) -> str:
+    if node.level == 0:
+        return node.module or ""
+    base = (mod.dotted or "").split(".")
+    if mod.path.name != "__init__.py":
+        base = base[:-1]
+    cut = node.level - 1
+    if cut:
+        base = base[:-cut] if cut <= len(base) else []
+    return ".".join(base + ([node.module] if node.module else []))
+
+
+def _collect_defs(mod: ModuleInfo, project: Project) -> None:
+    for node in mod.tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else name
+                mod.imports[name] = (target, None)
+                mod.import_nodes[name] = node
+        elif isinstance(node, ast.ImportFrom):
+            src = _resolve_import_module(mod, node)
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                name = alias.asname or alias.name
+                mod.imports[name] = (src, alias.name)
+                mod.import_nodes[name] = node
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    try:
+                        mod.all_names = set(ast.literal_eval(node.value))
+                    except ValueError:
+                        mod.all_names = set()
+            _collect_module_lock(mod, node)
+        elif isinstance(node, ast.ClassDef):
+            _collect_class(mod, node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _collect_function(mod, node, None)
+    # nested defs inside module functions
+    for fname, fi in list(mod.functions.items()):
+        _collect_nested(mod, fi)
+    for ci in mod.classes.values():
+        for fi in list(ci.methods.values()):
+            _collect_nested(mod, fi, ci)
+
+
+def _collect_module_lock(mod: ModuleInfo, node: ast.Assign) -> None:
+    if not isinstance(node.value, ast.Call):
+        return
+    kind = _lock_kind_of_call(node.value, mod)
+    if kind is None:
+        return
+    for t in node.targets:
+        if isinstance(t, ast.Name):
+            mod.module_locks[t.id] = kind
+
+
+def _collect_class(mod: ModuleInfo, node: ast.ClassDef) -> None:
+    qname = f"{mod.dotted}.{node.name}"
+    ci = ClassInfo(qname=qname, module=mod.dotted, name=node.name,
+                   lineno=node.lineno,
+                   bases=[_terminal_name(b) for b in node.bases])
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fi = FunctionInfo(qname=f"{qname}.{item.name}",
+                              module=mod.dotted, cls=qname,
+                              name=item.name, lineno=item.lineno,
+                              node=item)
+            ci.methods[item.name] = fi
+    # instance attributes + lock attrs + attr construction types, from
+    # every method body (locks are almost always bound in __init__ but
+    # lazy `_ensure_*` shapes exist too)
+    for fi in ci.methods.values():
+        for sub in ast.walk(fi.node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            for t in sub.targets:
+                chain = _attr_chain(t)
+                if not (chain and len(chain) == 2 and chain[0] == "self"):
+                    continue
+                attr = chain[1]
+                ci.instance_attrs.add(attr)
+                if isinstance(sub.value, ast.Call):
+                    kind = _lock_kind_of_call(sub.value, mod)
+                    if kind is not None:
+                        ci.lock_attrs[attr] = kind
+                    else:
+                        cname = _terminal_name(sub.value.func)
+                        if cname and cname[:1].isupper():
+                            ci.attr_types[attr] = cname
+    mod.classes[node.name] = ci
+
+
+def _collect_function(mod: ModuleInfo, node, cls_qname) -> None:
+    fi = FunctionInfo(qname=f"{mod.dotted}.{node.name}",
+                      module=mod.dotted, cls=cls_qname, name=node.name,
+                      lineno=node.lineno, node=node)
+    mod.functions[node.name] = fi
+
+
+def _collect_nested(mod: ModuleInfo, parent: FunctionInfo,
+                    ci: Optional[ClassInfo] = None) -> None:
+    """Nested `def`s become their own nodes (qname
+    parent.<locals>.name): a `threading.Thread(target=loop)` root must
+    not smear the parent's request-side reachability onto the
+    background thread."""
+    for sub in ast.walk(parent.node):
+        if sub is parent.node:
+            continue
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qname = f"{parent.qname}.<locals>.{sub.name}"
+            if qname in (f.qname for f in mod.functions.values()):
+                continue
+            fi = FunctionInfo(qname=qname, module=mod.dotted,
+                              cls=parent.cls, name=sub.name,
+                              lineno=sub.lineno, node=sub)
+            mod.functions[qname] = fi
+
+
+# ----------------------------------------------------------------------
+# pass 2: per-function resolution
+# ----------------------------------------------------------------------
+
+def _annotation_class(node, mod: ModuleInfo,
+                      project: Project) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value.split(".")[-1].split("[")[0]
+    else:
+        name = _terminal_name(node)
+    if not name or not name[:1].isupper():
+        return None
+    ci = project._class_named(name, mod)
+    return ci.qname if ci else None
+
+
+def _local_types(fi: FunctionInfo, mod: ModuleInfo,
+                 project: Project) -> Dict[str, str]:
+    """name -> class qname, from parameter annotations, constructor
+    assignments and `x = self.attr` aliases (first-order)."""
+    env: Dict[str, str] = {}
+    args = fi.node.args
+    for a in list(args.posonlyargs) + list(args.args) + list(
+            args.kwonlyargs):
+        if a.annotation is not None:
+            cq = _annotation_class(a.annotation, mod, project)
+            if cq:
+                env[a.arg] = cq
+    owner = project.classes.get(fi.cls) if fi.cls else None
+    for sub in ast.walk(fi.node):
+        if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+            continue
+        t = sub.targets[0]
+        if not isinstance(t, ast.Name):
+            continue
+        v = sub.value
+        if isinstance(v, ast.Call):
+            cname = _terminal_name(v.func)
+            ci = project._class_named(cname, mod) \
+                if cname[:1].isupper() else None
+            if ci is not None:
+                env[t.id] = ci.qname
+        elif owner is not None:
+            chain = _attr_chain(v)
+            if chain and len(chain) == 2 and chain[0] == "self":
+                cq = owner.attr_types.get(chain[1])
+                if cq:
+                    ci = project._class_named(cq, mod)
+                    if ci:
+                        env[t.id] = ci.qname
+    return env
+
+
+def _lock_id(expr, fi: FunctionInfo, mod: ModuleInfo,
+             project: Project) -> Optional[LockId]:
+    """Resolve a `with` context expression to a lock identity, chasing
+    Condition aliases to the underlying lock."""
+    chain = _attr_chain(expr)
+    if chain is None:
+        return None
+    if len(chain) == 1:
+        name = chain[0]
+        entry = mod.module_locks.get(name)
+        if entry is None:
+            return None
+        _, alias = entry
+        return (mod.dotted, alias or name)
+    if len(chain) == 2 and chain[0] == "self" and fi.cls:
+        ci = project.classes.get(fi.cls)
+        if ci is None:
+            return None
+        entry = ci.lock_attrs.get(chain[1])
+        if entry is None:
+            return None
+        _, alias = entry
+        return (fi.cls, alias if alias in ci.lock_attrs else chain[1]) \
+            if alias else (fi.cls, chain[1])
+    return None
+
+
+def lock_kind(project: Project, lock: LockId) -> str:
+    """'lock' (non-reentrant) or 'rlock' for a resolved LockId."""
+    owner, attr = lock
+    ci = project.classes.get(owner)
+    if ci is not None and attr in ci.lock_attrs:
+        return ci.lock_attrs[attr][0]
+    mod = project.modules.get(owner)
+    if mod is not None and attr in mod.module_locks:
+        return mod.module_locks[attr][0]
+    return "lock"
+
+
+def _resolve_call_targets(call: ast.Call, fi: FunctionInfo,
+                          mod: ModuleInfo, project: Project,
+                          env: Dict[str, str]) -> Tuple[str, ...]:
+    func = call.func
+    # plain name: local def, import, or constructor
+    if isinstance(func, ast.Name):
+        name = func.id
+        nested = mod.functions.get(f"{fi.qname}.<locals>.{name}")
+        if nested is not None:
+            return (nested.qname,)
+        if name in mod.functions:
+            return (mod.functions[name].qname,)
+        if name in mod.classes:
+            init = mod.classes[name].methods.get("__init__")
+            return (init.qname,) if init else (mod.classes[name].qname,)
+        tgt = mod.imports.get(name)
+        if tgt is not None and tgt[1] is not None:
+            tmod = project.modules.get(tgt[0])
+            if tmod is not None:
+                if tgt[1] in tmod.functions:
+                    return (tmod.functions[tgt[1]].qname,)
+                if tgt[1] in tmod.classes:
+                    ci = tmod.classes[tgt[1]]
+                    init = ci.methods.get("__init__")
+                    return (init.qname,) if init else (ci.qname,)
+        return ()
+    if not isinstance(func, ast.Attribute):
+        return ()
+    mname = func.attr
+    recv = func.value
+    # self.m(...)
+    chain = _attr_chain(recv)
+    if chain == ["self"] and fi.cls:
+        ci = project.classes.get(fi.cls)
+        if ci is not None:
+            target = project.resolve_method(ci, mname)
+            if target is not None:
+                return (target.qname,)
+        return ()
+    # module.func(...)
+    if isinstance(recv, ast.Name):
+        tgt = mod.imports.get(recv.id)
+        if tgt is not None and tgt[1] is None:
+            tmod = project.modules.get(tgt[0])
+            if tmod is not None:
+                if mname in tmod.functions:
+                    return (tmod.functions[mname].qname,)
+                if mname in tmod.classes:
+                    ci = tmod.classes[mname]
+                    init = ci.methods.get("__init__")
+                    return (init.qname,) if init else (ci.qname,)
+            return ()
+        # typed local: opt.m(...) with opt's class known
+        cq = env.get(recv.id)
+        if cq is not None:
+            ci = project.classes.get(cq)
+            if ci is not None:
+                target = project.resolve_method(ci, mname)
+                if target is not None:
+                    return (target.qname,)
+            return ()
+    # self.attr.m(...) with attr type known from __init__
+    if chain and len(chain) == 2 and chain[0] == "self" and fi.cls:
+        owner = project.classes.get(fi.cls)
+        if owner is not None:
+            cname = owner.attr_types.get(chain[1])
+            if cname:
+                ci = project._class_named(cname, mod)
+                if ci is not None:
+                    target = project.resolve_method(ci, mname)
+                    if target is not None:
+                        return (target.qname,)
+    # Cls(...).m(...)
+    if isinstance(recv, ast.Call):
+        cname = _terminal_name(recv.func)
+        if cname[:1].isupper():
+            ci = project._class_named(cname, mod)
+            if ci is not None:
+                target = project.resolve_method(ci, mname)
+                if target is not None:
+                    return (target.qname,)
+    # unique-method-name class attribution
+    cands = project.method_index.get(mname, ())
+    if len(cands) == 1:
+        return (cands[0].methods[mname].qname,)
+    return ()
+
+
+def _thread_target(call: ast.Call, fi: FunctionInfo, mod: ModuleInfo,
+                   project: Project) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg != "target":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Name):
+            nested = mod.functions.get(f"{fi.qname}.<locals>.{v.id}")
+            if nested is not None:
+                return nested.qname
+            if v.id in mod.functions:
+                return mod.functions[v.id].qname
+        chain = _attr_chain(v)
+        if chain and len(chain) == 2 and chain[0] == "self" and fi.cls:
+            ci = project.classes.get(fi.cls)
+            if ci is not None:
+                target = project.resolve_method(ci, chain[1])
+                if target is not None:
+                    return target.qname
+    return None
+
+
+def _resolve_module(mod: ModuleInfo, project: Project) -> None:
+    all_fns = list(mod.functions.values())
+    for ci in mod.classes.values():
+        all_fns.extend(ci.methods.values())
+    for fi in all_fns:
+        _resolve_function(fi, mod, project)
+
+
+def _resolve_function(fi: FunctionInfo, mod: ModuleInfo,
+                      project: Project) -> None:
+    env = _local_types(fi, mod, project)
+    nested_nodes = {f.node for f in mod.functions.values()
+                    if f.qname.startswith(fi.qname + ".<locals>.")}
+
+    def visit(node, held: Tuple[LockId, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node in nested_nodes:
+            return                # analyzed as its own function
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                lock = _lock_id(item.context_expr, fi, mod, project)
+                if lock is not None:
+                    fi.acquisitions.append(LockAcq(
+                        lock=lock, lineno=node.lineno,
+                        held_before=new_held))
+                    new_held = new_held + (lock,)
+                for sub in ast.iter_child_nodes(item.context_expr):
+                    visit(sub, held)
+                if isinstance(item.context_expr, ast.Call):
+                    visit_call(item.context_expr, held)
+            for sub in node.body:
+                visit(sub, new_held)
+            return
+        if isinstance(node, ast.Call):
+            visit_call(node, held)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                record_write(t, held, node.lineno)
+        elif isinstance(node, ast.AugAssign):
+            record_write(node.target, held, node.lineno)
+        for sub in ast.iter_child_nodes(node):
+            visit(sub, held)
+
+    def visit_call(node: ast.Call, held: Tuple[LockId, ...]) -> None:
+        targets = _resolve_call_targets(node, fi, mod, project, env)
+        func = node.func
+        recv = ""
+        if isinstance(func, ast.Attribute):
+            recv = _terminal_name(func.value)
+        fi.calls.append(CallSite(
+            lineno=node.lineno, name=_call_name(func), recv=recv,
+            targets=targets, held=held, node=node))
+        if _is_threading_call(node, mod, "Thread"):
+            tgt = _thread_target(node, fi, mod, project)
+            if tgt is not None:
+                fi.thread_targets.append(tgt)
+        # container mutation through self.<attr>.<mutator>(...)
+        if isinstance(func, ast.Attribute) \
+                and func.attr in MUTATOR_METHODS:
+            chain = _attr_chain(func.value)
+            if chain and len(chain) == 2 and chain[0] == "self":
+                fi.writes.append(AttrWrite(attr=chain[1],
+                                           lineno=node.lineno,
+                                           held=held))
+
+    def record_write(target, held: Tuple[LockId, ...],
+                     lineno: int) -> None:
+        chain = None
+        if isinstance(target, ast.Subscript):
+            chain = _attr_chain(target.value)
+        else:
+            chain = _attr_chain(target)
+        if chain and len(chain) == 2 and chain[0] == "self":
+            fi.writes.append(AttrWrite(attr=chain[1], lineno=lineno,
+                                       held=held))
+
+    for stmt in fi.node.body:
+        visit(stmt, ())

@@ -1,694 +1,21 @@
-"""Dependency-free lint for CI (the reference runs checkstyle+findbugs in
-its `analyze` CI step, .circleci/config.yml:18-20; this environment ships
-no Python linter and installs are forbidden, so the equivalent hygiene
-checks are implemented on `ast`).
+"""Lint entry point — now the whole-program analyzer (tools/analysis/).
 
-Checks:
-  * files parse (syntax);
-  * unused imports (module scope, honoring __all__ and re-export files);
-  * tabs in indentation, trailing whitespace, missing final newline;
-  * lines longer than 100 columns;
-  * no fully-silent `except Exception` swallows in cruise_control_tpu/:
-    every broad handler must log, re-raise, or increment a sensor (a
-    swallowed solver/sampler failure is invisible until it pages — the
-    PR-2 robustness rule);
-  * single-gateway rule: no direct GoalOptimizer solve
-    (`*.optimizations(...)` on an optimizer, `GoalOptimizer(...)
-    .optimizations(...)`, `host_fallback_solve(...)`) or scenario-engine
-    `.evaluate(...)` call outside facade.py / sched/ and the solver
-    implementation itself — every device solve must enter through the
-    device-time scheduler (the PR-4 invariant; its runtime half is the
-    chaos stress test's under_gateway assertion);
-  * mesh single-gateway rule: no `Mesh(...)`/`make_mesh`/`jax.devices()`
-    acquisition outside sched/ + facade.py (and the solver
-    implementation) — the scheduler's mesh token is the only path to
-    multi-chip dispatch (the PR-6 invariant);
-  * cache-gateway rule: no `jax.jit(...)`, `.lower(...).compile()`
-    chain, or `jax.export` use in cruise_control_tpu/ outside the
-    shared persistent-cache helper (parallel/progcache.py) and the
-    optimizer/engine compile gateways — a compile that bypasses the
-    gateway is invisible to the persistent program cache and silently
-    re-pays the ~300s cold start (the PR-7 invariant);
-  * watchdog-gateway rule: in the solver execution modules, compiled
-    executables are only invoked inside `health.watched_call(lambda:
-    ...)` — a wedged XLA dispatch must fire the watchdog, never
-    capture the dispatch thread (PR-12 mesh recovery);
-  * single-store rule: no direct `*.cluster_model(...)` materialization
-    on a LoadMonitor outside facade.py (the `_model_for_solve` /
-    `_materialize_solve_inputs` gateway), the device model store
-    (model/store.py) and the monitor itself — a solve path that
-    rebuilds the model directly bypasses the device-resident store and
-    silently re-pays the ~3.2s host build per request (the PR-9
-    incremental invariant, same pattern as the solve-gateway and
-    cache-gateway rules);
-  * tenant-root rule: no mutable module-level state in fleet-reachable
-    modules (cruise_control_tpu/fleet/) — the FleetRegistry INSTANCE is
-    the only root of per-tenant state, so draining a tenant provably
-    leaves nothing behind in process globals (the PR-5 isolation
-    invariant).  Module-scope assignments of list/dict/set displays,
-    comprehensions, or mutable-container constructor calls are
-    findings; immutable constants (tuples, frozensets, strings,
-    numbers) are fine;
-  * durable-write rule: no `open(..., "w"/"wb")` / `os.rename` /
-    `os.replace` in cruise_control_tpu/ outside utils/persist.py — every
-    persistent-state write must go through the shared atomic
-    write-temp-then-rename / CRC-framing helpers, or a store silently
-    loses the crash-safety contract the executor journal depends on
-    (the PR-13 invariant; append-mode opens are fine — append-only
-    logs are the OTHER audited durability shape);
-  * trace-propagation rule (the observability invariant): every
-    `SolveJob(...)` construction in the package must carry `trace=`
-    (scheduler submissions carry a TraceContext so queue wait, folds
-    and preemptions land in the request's span tree), every ladder
-    attempt (`_solve_on_rung(...)` call) must sit inside a `with`
-    whose context expression opens a span, and
-    Span/SpanRecord/Trace/TraceContext objects may be constructed only
-    inside cruise_control_tpu/obs/ — everyone else goes through the
-    obs.trace helpers, which are what keep parenting, span caps and
-    cross-thread activation coherent.
-
-Usage: python tools/lint.py [paths...]   (default: the package + tests)
-Exit code 1 when any finding is reported.
+The historical 694-line per-file lint lived here; ISSUE 15 replaced it
+with the project-wide analyzer, which keeps every old rule (byte-
+compatible flat output) and adds gateway reachability, concurrency
+lint and config/sensor/fault-site drift detection.  This shim keeps
+`python tools/lint.py [paths...]` as the single stable entry point for
+the Makefile, CI and muscle memory.  Rule catalog and workflow:
+docs/ANALYSIS.md.
 """
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-MAX_LINE = 100
-DEFAULT_PATHS = ["cruise_control_tpu", "tests", "tools", "bench.py",
-                 "__graft_entry__.py"]
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-#: a broad handler "signals" when its body calls something whose name
-#: carries one of these tokens (logging, alerting, sensor increments,
-#: error routing) — permissive by design: the rule exists to catch the
-#: FULLY silent `except Exception: pass/return` shape
-_HANDLER_SIGNAL_TOKENS = ("log", "warn", "error", "exception", "debug",
-                          "info", "alert", "critical", "mark", "inc",
-                          "update", "record", "report", "tolerate",
-                          "quarantine", "fail")
-
-
-def _catches_broad(handler_type) -> bool:
-    """Does this except clause catch Exception/BaseException?"""
-    types = (handler_type.elts if isinstance(handler_type, ast.Tuple)
-             else [handler_type])
-    return any(isinstance(t, ast.Name)
-               and t.id in ("Exception", "BaseException") for t in types)
-
-
-def _call_name(func) -> str:
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return ""
-
-
-def _handler_signals(handler: ast.ExceptHandler) -> bool:
-    for node in ast.walk(handler):
-        if isinstance(node, ast.Raise):
-            return True
-        if isinstance(node, ast.Call):
-            name = _call_name(node.func).lower()
-            if any(tok in name for tok in _HANDLER_SIGNAL_TOKENS):
-                return True
-    return False
-
-
-def _silent_swallows(path: Path, tree: ast.AST) -> list:
-    """Every `except Exception` in the package must log, re-raise, or
-    increment a sensor — no fully-silent swallows (robustness rule)."""
-    if "cruise_control_tpu" not in path.parts:
-        return []
-    findings = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) \
-                and node.type is not None \
-                and _catches_broad(node.type) \
-                and not _handler_signals(node):
-            findings.append(
-                f"{path}:{node.lineno}: silent `except Exception` "
-                f"swallow — log it, re-raise, or count it in a sensor")
-    return findings
-
-
-#: package-relative paths allowed to call the solver directly: the
-#: gateway itself (facade.py routes through sched/), the scheduler
-#: package, the solver implementation (analyzer/optimizer.py recurses,
-#: scenario/engine.py drives the degraded rungs), and the test-support
-#: verifier harness.  Full relative paths, not bare filenames: a future
-#: detector/engine.py or monitor/optimizer.py must NOT inherit the
-#: exemption just by sharing a name
-_GATEWAY_ALLOWED_RELPATHS = {"facade.py", "analyzer/optimizer.py",
-                             "scenario/engine.py", "testing/verifier.py"}
-
-
-def _receiver_name(node) -> str:
-    """Terminal identifier of a call receiver: `self.goal_optimizer`
-    -> 'goal_optimizer', `optimizer` -> 'optimizer', `GoalOptimizer(...)`
-    -> 'GoalOptimizer'."""
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Call):
-        return _receiver_name(node.func)
-    return ""
-
-
-def _gateway_violations(path: Path, tree: ast.AST) -> list:
-    """Single-gateway rule: solve entry points may only be called from
-    facade.py / sched/ (and the solver implementation itself) — the
-    static half of the every-solve-goes-through-the-scheduler invariant.
-    """
-    parts = path.parts
-    if "cruise_control_tpu" not in parts:
-        return []
-    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
-    rel = "/".join(parts[pkg + 1:])
-    if rel.startswith("sched/") or rel in _GATEWAY_ALLOWED_RELPATHS:
-        return []
-    findings = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        if isinstance(func, ast.Attribute):
-            recv = _receiver_name(func.value).lower()
-            if func.attr == "optimizations" and "optimizer" in recv:
-                findings.append(
-                    f"{path}:{node.lineno}: direct GoalOptimizer solve "
-                    f"call outside facade.py/sched/ — route it through "
-                    f"the device-time scheduler (single-gateway rule)")
-            elif func.attr == "evaluate" and (
-                    "scenario_engine" in recv
-                    or recv == "scenarioengine"):
-                findings.append(
-                    f"{path}:{node.lineno}: direct scenario-engine solve "
-                    f"call outside facade.py/sched/ — route it through "
-                    f"the device-time scheduler (single-gateway rule)")
-        elif isinstance(func, ast.Name) \
-                and func.id == "host_fallback_solve":
-            findings.append(
-                f"{path}:{node.lineno}: direct host_fallback_solve call "
-                f"outside facade.py/sched/ — route it through the "
-                f"device-time scheduler (single-gateway rule)")
-    return findings
-
-
-#: package-relative paths allowed to construct a device Mesh or acquire
-#: devices directly: the mesh implementation itself, the solver
-#: implementations that consume a mesh, the scheduler that OWNS the
-#: token, the facade + composition root that build it from config, and
-#: the virtual-device test rig.  Everyone else reaches multi-chip
-#: dispatch only through the scheduler's mesh token
-#: (sched/runtime.current_mesh_token) — the mesh half of the
-#: single-gateway invariant.
-_MESH_ALLOWED_RELPATHS = {"facade.py", "main.py", "parallel/mesh.py",
-                          # the mesh supervisor rebuilds the token over
-                          # probe survivors — it IS the token's health
-                          # authority (PR-12 elastic recovery)
-                          "parallel/health.py",
-                          "analyzer/optimizer.py", "scenario/engine.py",
-                          "testing/virtual_mesh.py"}
-
-#: call names that construct a mesh or acquire the device topology
-_MESH_ACQUIRE_CALLS = {"Mesh", "make_mesh", "runtime_mesh", "shard_state",
-                       "devices", "local_devices", "device_count"}
-
-
-def _mesh_violations(path: Path, tree: ast.AST) -> list:
-    """Mesh single-gateway rule: no module outside sched/ + facade.py +
-    the solver implementation may construct a `Mesh` or acquire devices
-    (`jax.devices()` & co.) — the scheduler's mesh token is the only
-    path to multi-chip dispatch."""
-    parts = path.parts
-    if "cruise_control_tpu" not in parts:
-        return []
-    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
-    rel = "/".join(parts[pkg + 1:])
-    if rel.startswith("sched/") or rel in _MESH_ALLOWED_RELPATHS:
-        return []
-    findings = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _call_name(node.func)
-        if name not in _MESH_ACQUIRE_CALLS:
-            continue
-        if name in ("devices", "local_devices", "device_count"):
-            # only the jax.* device-acquisition spellings count
-            func = node.func
-            if not (isinstance(func, ast.Attribute)
-                    and _receiver_name(func.value) == "jax"):
-                continue
-        allowed = "sched/, " + ", ".join(sorted(_MESH_ALLOWED_RELPATHS))
-        findings.append(
-            f"{path}:{node.lineno}: direct mesh/device acquisition "
-            f"({name}) outside the allowed modules ({allowed}) — the "
-            f"scheduler's mesh token is the only path to multi-chip "
-            f"dispatch (mesh single-gateway rule)")
-    return findings
-
-
-#: package-relative paths allowed to build XLA programs directly: the
-#: two compile gateways (GoalOptimizer._compile_through_cache /
-#: _jit_program and ScenarioEngine._compile_batched) and the persistent
-#: cache implementation itself.  Everything else must reach compilation
-#: through them — that is what makes the persistent program cache a
-#: true write-through tier: a compile that bypasses the gateway is
-#: invisible to the cache and silently re-pays the ~300s cold start.
-_PROGCACHE_ALLOWED_RELPATHS = {"analyzer/optimizer.py",
-                               "scenario/engine.py",
-                               "parallel/progcache.py",
-                               # the model store's delta-apply program:
-                               # a handful of tiny scatters (compiles in
-                               # ms, LRU'd by jit itself) — not worth a
-                               # persistent-cache tier
-                               "model/store.py",
-                               # the health probe's known-answer
-                               # program: a four-float reduction per
-                               # chip, compiled once per process
-                               "parallel/health.py"}
-
-
-def _progcache_violations(path: Path, tree: ast.AST) -> list:
-    """Cache-gateway rule: no `jax.jit(...)`, `.lower(...).compile()`
-    chain, or `jax.export` use in the package outside the shared cache
-    helper and the optimizer/engine compile paths — every program
-    compile must go through the persistent program cache (the PR-7
-    invariant, same pattern as the PR-4 single-gateway and PR-6 mesh
-    rules)."""
-    parts = path.parts
-    if "cruise_control_tpu" not in parts:
-        return []
-    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
-    rel = "/".join(parts[pkg + 1:])
-    if rel in _PROGCACHE_ALLOWED_RELPATHS:
-        return []
-    findings = []
-    allowed = ", ".join(sorted(_PROGCACHE_ALLOWED_RELPATHS))
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        if not isinstance(func, ast.Attribute):
-            continue
-        what = None
-        if (func.attr == "jit"
-                and _receiver_name(func.value) == "jax"):
-            what = "jax.jit"
-        elif (func.attr == "compile"
-              and isinstance(func.value, ast.Call)
-              and isinstance(func.value.func, ast.Attribute)
-              and func.value.func.attr == "lower"):
-            what = ".lower().compile()"
-        elif (func.attr in ("export", "deserialize",
-                            "register_pytree_node_serialization")
-              and _receiver_name(func.value) in ("export", "jexport")):
-            what = f"jax.export.{func.attr}"
-        if what is not None:
-            findings.append(
-                f"{path}:{node.lineno}: direct program compile ({what}) "
-                f"outside the compile gateways ({allowed}) — every XLA "
-                f"compile must go through the persistent program cache "
-                f"(cache-gateway rule)")
-    return findings
-
-
-#: package-relative paths allowed to materialize the cluster model
-#: directly: the facade (its _model_for_solve gateway consults the
-#: device-resident store first), the store implementation, and the
-#: monitor that owns the builder.  Everyone else reaches a model
-#: through the facade gateway — the single-store half of the
-#: incremental-model invariant (PR 9).
-_MODEL_STORE_ALLOWED_RELPATHS = {"facade.py", "model/store.py",
-                                 "monitor/load_monitor.py"}
-
-
-def _model_store_violations(path: Path, tree: ast.AST) -> list:
-    """Single-store rule: no `<monitor>.cluster_model(...)` call in the
-    package outside the facade gateway, the store, and the monitor
-    itself.  Receiver-based: only calls whose receiver names a monitor
-    (`load_monitor`, `_load_monitor`, ...) count — the facade's public
-    `cc.cluster_model()` wrapper is itself gatewayed."""
-    parts = path.parts
-    if "cruise_control_tpu" not in parts:
-        return []
-    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
-    rel = "/".join(parts[pkg + 1:])
-    if rel in _MODEL_STORE_ALLOWED_RELPATHS:
-        return []
-    findings = []
-    allowed = ", ".join(sorted(_MODEL_STORE_ALLOWED_RELPATHS))
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        if not isinstance(func, ast.Attribute) \
-                or func.attr != "cluster_model":
-            continue
-        recv = _receiver_name(func.value).lower()
-        if "monitor" in recv:
-            findings.append(
-                f"{path}:{node.lineno}: direct LoadMonitor model "
-                f"materialization outside the allowed modules "
-                f"({allowed}) — route it through the facade's "
-                f"store-aware gateway (single-store rule)")
-    return findings
-
-
-#: files whose compiled-executable invocations must ride the watched-
-#: dispatch gateway, and the local names those executables are bound to
-#: at their call sites (GoalOptimizer._run's `aot`/`shared`, the
-#: scenario engine's `prog`)
-_WATCHED_EXEC_FILES = {"analyzer/optimizer.py", "scenario/engine.py"}
-_WATCHED_EXEC_NAMES = {"aot", "shared", "prog"}
-
-
-def _watchdog_violations(path: Path, tree: ast.AST) -> list:
-    """Watchdog-gateway rule: in the solver execution modules, every
-    invocation of a compiled executable (the AOT/shared/batched
-    program objects) must happen INSIDE a lambda handed to
-    `health.watched_call` — a bare `aot(*args)` would run on the
-    dispatch thread itself, and a wedged XLA dispatch there captures
-    the thread forever (mesh.watchdog.ms cannot save what never
-    entered the gateway; parallel/health.py)."""
-    parts = path.parts
-    if "cruise_control_tpu" not in parts:
-        return []
-    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
-    rel = "/".join(parts[pkg + 1:])
-    if rel not in _WATCHED_EXEC_FILES:
-        return []
-    covered = set()
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and _call_name(node.func) == "watched_call"):
-            for arg in node.args:
-                if isinstance(arg, ast.Lambda):
-                    for sub in ast.walk(arg):
-                        covered.add(id(sub))
-    findings = []
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id in _WATCHED_EXEC_NAMES
-                and id(node) not in covered):
-            findings.append(
-                f"{path}:{node.lineno}: compiled-executable call "
-                f"({node.func.id}(...)) outside the watched-dispatch "
-                f"gateway — wrap it in health.watched_call(lambda: "
-                f"...) so a wedged dispatch cannot capture the "
-                f"calling thread (watchdog-gateway rule)")
-    return findings
-
-
-#: constructor names whose module-scope call sites create MUTABLE
-#: containers (per-tenant state could silently accrete in them)
-_MUTABLE_CONSTRUCTORS = {"list", "dict", "set", "bytearray", "deque",
-                         "defaultdict", "OrderedDict", "Counter",
-                         "WeakValueDictionary", "WeakKeyDictionary"}
-
-
-def _is_mutable_value(node) -> bool:
-    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
-                         ast.DictComp, ast.SetComp)):
-        return True
-    if isinstance(node, ast.Call):
-        name = _call_name(node.func)
-        return name in _MUTABLE_CONSTRUCTORS
-    return False
-
-
-def _fleet_mutable_globals(path: Path, tree: ast.AST) -> list:
-    """Tenant-root rule: fleet-reachable modules must hold NO mutable
-    module-level state — the registry instance is the only tenant root
-    (see module docstring)."""
-    parts = path.parts
-    if "cruise_control_tpu" not in parts:
-        return []
-    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
-    rel = "/".join(parts[pkg + 1:])
-    if not rel.startswith("fleet/"):
-        return []
-    findings = []
-    body = tree.body if isinstance(tree, ast.Module) else []
-    for node in body:
-        targets, value = [], None
-        if isinstance(node, ast.Assign):
-            targets, value = node.targets, node.value
-        elif isinstance(node, ast.AnnAssign) and node.value is not None:
-            targets, value = [node.target], node.value
-        if value is None or not _is_mutable_value(value):
-            continue
-        names = [t.id for t in targets if isinstance(t, ast.Name)]
-        if names and all(n.startswith("__") and n.endswith("__")
-                         for n in names):
-            continue          # __all__ and friends: module metadata
-        findings.append(
-            f"{path}:{node.lineno}: mutable module-level state "
-            f"{names or '<assignment>'} in a fleet module — per-tenant "
-            f"state may live only under the FleetRegistry instance "
-            f"(tenant-root rule)")
-    return findings
-
-
-#: package-relative paths allowed to write/rename files directly: the
-#: shared durable-write helper is the ONLY one — every other module
-#: reaches disk through persist.atomic_write / atomic_rewrite /
-#: replace / open_append (append-mode `open` stays legal everywhere:
-#: append-only logs are the other audited durability shape)
-_PERSIST_ALLOWED_RELPATHS = {"utils/persist.py"}
-
-
-def _write_mode_of(call: ast.Call):
-    """The constant mode string of an open()/os.fdopen() call, or None
-    when absent/dynamic."""
-    mode = None
-    if len(call.args) >= 2:
-        mode = call.args[1]
-    for kw in call.keywords:
-        if kw.arg == "mode":
-            mode = kw.value
-    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
-        return mode.value
-    return None
-
-
-def _durable_write_violations(path: Path, tree: ast.AST) -> list:
-    """Durable-write rule: truncating writes (`open(.., "w"/"wb")`) and
-    renames (`os.rename`/`os.replace`) outside utils/persist.py fail
-    lint — persistent state must be published atomically through the
-    shared helpers (executor/journal.py's crash-recovery guarantees
-    only hold if every store keeps the same discipline)."""
-    parts = path.parts
-    if "cruise_control_tpu" not in parts:
-        return []
-    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
-    rel = "/".join(parts[pkg + 1:])
-    if rel in _PERSIST_ALLOWED_RELPATHS:
-        return []
-    findings = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        name = _call_name(func)
-        if name in ("rename", "replace") \
-                and isinstance(func, ast.Attribute) \
-                and _receiver_name(func.value) == "os":
-            findings.append(
-                f"{path}:{node.lineno}: direct os.{name} outside "
-                f"utils/persist.py — publish state through "
-                f"persist.atomic_write/atomic_rewrite/replace "
-                f"(durable-write rule)")
-        elif name in ("open", "fdopen"):
-            if name == "open" and isinstance(func, ast.Attribute) \
-                    and _receiver_name(func.value) != "os":
-                continue          # some_obj.open(...): not file io
-            mode = _write_mode_of(node)
-            if mode is not None and "w" in mode:
-                findings.append(
-                    f"{path}:{node.lineno}: truncating file open "
-                    f"(mode={mode!r}) outside utils/persist.py — a "
-                    f"crash mid-write tears the file; publish through "
-                    f"persist.atomic_write (durable-write rule)")
-    return findings
-
-
-#: names whose CONSTRUCTION is reserved to cruise_control_tpu/obs/ —
-#: span/trace objects built anywhere else bypass the parenting, span-cap
-#: and cross-thread-activation logic of the obs.trace helpers
-_OBS_RESERVED_CONSTRUCTORS = {"Span", "SpanRecord", "Trace",
-                              "TraceContext", "_ActiveSpan"}
-
-
-def _span_scoped_calls(tree: ast.AST) -> set:
-    """id()s of every Call node lexically inside a `with` statement one
-    of whose context expressions opens a span (a call whose name
-    mentions 'span')."""
-    scoped = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.With, ast.AsyncWith)):
-            continue
-        opens_span = any(
-            isinstance(sub, ast.Call)
-            and "span" in _call_name(sub.func).lower()
-            for item in node.items
-            for sub in ast.walk(item.context_expr))
-        if opens_span:
-            for sub in ast.walk(node):
-                if isinstance(sub, ast.Call):
-                    scoped.add(id(sub))
-    return scoped
-
-
-def _trace_violations(path: Path, tree: ast.AST) -> list:
-    """Trace-propagation rule (see module docstring): SolveJob carries
-    trace=, ladder attempts run inside a span, span objects are built
-    only in obs/."""
-    parts = path.parts
-    if "cruise_control_tpu" not in parts:
-        return []
-    pkg = len(parts) - 1 - parts[::-1].index("cruise_control_tpu")
-    rel = "/".join(parts[pkg + 1:])
-    in_obs = rel.startswith("obs/")
-    findings = []
-    span_scoped = None
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _call_name(node.func)
-        if name in _OBS_RESERVED_CONSTRUCTORS and not in_obs:
-            findings.append(
-                f"{path}:{node.lineno}: naked span/trace construction "
-                f"({name}) outside obs/ — go through the obs.trace "
-                f"helpers (trace-propagation rule)")
-        elif name == "SolveJob":
-            if not any(kw.arg == "trace" for kw in node.keywords):
-                findings.append(
-                    f"{path}:{node.lineno}: SolveJob(...) without "
-                    f"trace= — every scheduler submission must carry a "
-                    f"TraceContext (trace-propagation rule)")
-        elif name == "_solve_on_rung":
-            if span_scoped is None:
-                span_scoped = _span_scoped_calls(tree)
-            if id(node) not in span_scoped:
-                findings.append(
-                    f"{path}:{node.lineno}: ladder attempt "
-                    f"(_solve_on_rung) outside a span scope — wrap "
-                    f"rung attempts in obs.trace.span so every attempt "
-                    f"is attributable (trace-propagation rule)")
-    return findings
-
-
-def _imported_names(tree: ast.AST):
-    """{local binding name: node} for every module-scope import."""
-    out = {}
-    for node in tree.body if isinstance(tree, ast.Module) else []:
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                name = alias.asname or alias.name.split(".")[0]
-                out[name] = node
-        elif isinstance(node, ast.ImportFrom):
-            for alias in node.names:
-                if alias.name == "*":
-                    continue
-                out[alias.asname or alias.name] = node
-    return out
-
-
-def _used_names(tree: ast.AST):
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            base = node
-            while isinstance(base, ast.Attribute):
-                base = base.value
-            if isinstance(base, ast.Name):
-                used.add(base.id)
-    return used
-
-
-def _exported(tree: ast.AST):
-    for node in tree.body if isinstance(tree, ast.Module) else []:
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "__all__":
-                    try:
-                        return set(ast.literal_eval(node.value))
-                    except ValueError:
-                        return set()
-    return None
-
-
-def lint_file(path: Path) -> list:
-    findings = []
-    text = path.read_text()
-    try:
-        tree = ast.parse(text, filename=str(path))
-    except SyntaxError as exc:
-        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
-
-    lines = text.splitlines()
-    for i, line in enumerate(lines, 1):
-        if line != line.rstrip():
-            findings.append(f"{path}:{i}: trailing whitespace")
-        if line[:len(line) - len(line.lstrip())].count("\t"):
-            findings.append(f"{path}:{i}: tab in indentation")
-        if len(line) > MAX_LINE:
-            findings.append(f"{path}:{i}: line longer than {MAX_LINE} cols")
-    if text and not text.endswith("\n"):
-        findings.append(f"{path}:{len(lines)}: missing final newline")
-
-    findings.extend(_silent_swallows(path, tree))
-    findings.extend(_gateway_violations(path, tree))
-    findings.extend(_mesh_violations(path, tree))
-    findings.extend(_progcache_violations(path, tree))
-    findings.extend(_model_store_violations(path, tree))
-    findings.extend(_watchdog_violations(path, tree))
-    findings.extend(_durable_write_violations(path, tree))
-    findings.extend(_fleet_mutable_globals(path, tree))
-    findings.extend(_trace_violations(path, tree))
-
-    # unused imports: __init__.py files are re-export surfaces; a module
-    # __all__ also marks intentional re-exports; `annotations` is the
-    # future import; `conftest` imports in tests exist for their side
-    # effect (forcing the CPU platform before jax initializes)
-    if path.name != "__init__.py":
-        exported = _exported(tree) or set()
-        used = _used_names(tree) | {"annotations", "conftest"}
-        for name, node in _imported_names(tree).items():
-            if name not in used and name not in exported:
-                findings.append(
-                    f"{path}:{node.lineno}: unused import '{name}'")
-    return findings
-
-
-def main(argv) -> int:
-    roots = [Path(p) for p in (argv or DEFAULT_PATHS)]
-    files = []
-    for root in roots:
-        if root.is_dir():
-            files.extend(sorted(root.rglob("*.py")))
-        elif root.exists():
-            files.append(root)
-    findings = []
-    for f in files:
-        if "__pycache__" in f.parts:
-            continue
-        findings.extend(lint_file(f))
-    for line in findings:
-        print(line)
-    print(f"lint: {len(files)} files, {len(findings)} findings",
-          file=sys.stderr)
-    return 1 if findings else 0
-
+from analysis.cli import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1:]))
