@@ -7,7 +7,7 @@
 # empty-or-shrinking baseline gate: unsuppressed findings AND stale
 # baseline entries both exit nonzero; `python tools/lint.py
 # --prune-baseline` is the only way the tooling writes the baseline.
-.PHONY: check lint test bench warm-cache
+.PHONY: check lint test bench bench-smoke warm-cache
 
 check: lint test
 
@@ -25,6 +25,12 @@ test:
 
 bench:
 	python bench.py
+
+# dispatch-budget smoke (ISSUE 16): fused megaprogram pipeline on a
+# tiny CPU cluster, asserting watched-dispatch count <= plan+2 and
+# >= 2x below the eager per-goal driver — fails loudly otherwise
+bench-smoke:
+	python tools/bench_smoke.py
 
 # pre-populate the persistent program cache for the default goal stacks
 # offline (docs/PROGRAM_CACHE.md): the next process/tenant with these

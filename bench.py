@@ -134,6 +134,17 @@ BENCH_CONFIG selects a BASELINE.json eval config:
 Other knobs: BENCH_BROKERS, BENCH_PARTITIONS, BENCH_RF, BENCH_ROUNDS,
 BENCH_GOALS (comma list), BENCH_SEGMENT, BENCH_SKIP_WARMUP.
 
+Dispatch-budget knobs (ISSUE 16): BENCH_FUSION=1 fuses same-group goal
+programs into megaprograms (analyzer/fusion.py — 15-goal default stack:
+3 segment programs instead of 8 at BENCH_SEGMENT=2), BENCH_HOST_SKIP=1
+elides whole segment dispatches whose member goals all report no work,
+BENCH_PRECISION=bfloat16 narrows the float load/capacity tables
+(analyzer/precision.py) and gates the result against an f32 baseline
+solve (exit 1 on gate failure; BENCH_PRECISION_EPS /
+BENCH_PRECISION_OVERLAP tune the gate).  The headline JSON reports
+`device_dispatches`, `dispatches_by_program`, `solver_goals_skipped`
+and `converged_at_by_goal` either way.
+
 BENCH_PROGCACHE governs the persistent program cache for the headline
 run: unset = ".progcache" next to this file, a path = that directory,
 "0"/"off" = disabled.  The headline JSON reports `warmup_s` and
@@ -346,7 +357,22 @@ def main() -> None:
 
     goals = default_goals(max_rounds=rounds, names=names)
     segment = int(os.environ.get("BENCH_SEGMENT", 2))
-    optimizer = GoalOptimizer(goals, pipeline_segment_size=segment)
+    # dispatch-budget knobs (ISSUE 16): BENCH_FUSION=1 fuses same-group
+    # goal programs into megaprograms (analyzer/fusion.py), BENCH_HOST_SKIP=1
+    # elides no-work segment dispatches host-side, BENCH_PRECISION=bfloat16
+    # narrows the float load/capacity tables (analyzer/precision.py) —
+    # a bf16 run ALSO solves the f32 baseline and must pass the
+    # proposals-equivalence gate or the bench exits 1
+    fused = os.environ.get("BENCH_FUSION", "") not in ("", "0")
+    host_skip = os.environ.get("BENCH_HOST_SKIP", "") not in ("", "0")
+    precision = os.environ.get("BENCH_PRECISION", "float32") or "float32"
+    optimizer = GoalOptimizer(goals, pipeline_segment_size=segment,
+                              fused_segments=fused,
+                              host_side_skip=host_skip)
+    state_f32 = state
+    if precision != "float32":
+        from cruise_control_tpu.analyzer.precision import cast_state_tables
+        state = cast_state_tables(state, precision)
     progcache = _configure_progcache()
     print(f"# progcache: {progcache.stats()['dir'] or 'disabled'}",
           file=sys.stderr)
@@ -438,9 +464,19 @@ def main() -> None:
     # likewise drop warmup traces: trace_summary must attribute the
     # measured run, not the compile-laden warmup pass
     _reset_traces()
+    # device-dispatch budget: watched_call invocations during the
+    # measured pass (parallel/health.py; warmup above hydrated the
+    # programs, so the measured run goes through the watched gateway)
+    from cruise_control_tpu.parallel import health as _health
+    disp0 = _health.dispatch_count()
+    disp_by0 = _health.dispatches_by_program()
     t0 = time.time()
     results = run_config(state, topo)
     elapsed = time.time() - t0
+    dispatches = _health.dispatch_count() - disp0
+    disp_by = {k: v - disp_by0.get(k, 0)
+               for k, v in _health.dispatches_by_program().items()
+               if v - disp_by0.get(k, 0)}
 
     if profiler is not None:
         print("# segment profile (CC_TPU_PROFILE: sync points inserted; "
@@ -463,10 +499,20 @@ def main() -> None:
           + (", ".join(f"{g}={b}->{entries.get(g, b)}->{o}->{a}"
                        for g, (b, o, a) in nonzero.items())
              or "none"), file=sys.stderr)
-    print("# rounds by goal: "
-          + (", ".join(f"{g}={r}" for g, r in
+    conv = getattr(results[-1], "converged_at_by_goal", {}) or {}
+    skipped = sorted({g for r in results
+                      for g in (getattr(r, "skipped_goals", []) or [])})
+    # rounds = the while_loop trip budget the goal consumed; converged-at
+    # = the round its own convergence predicate first held (0 = never,
+    # i.e. the round budget is the binding constraint) — a goal
+    # converging at round 3 of 146 reports 3/146, not 146
+    print("# rounds by goal (converged-at/rounds): "
+          + (", ".join(f"{g}={conv.get(g, 0)}/{r}" for g, r in
                        results[-1].rounds_by_goal.items()) or "n/a"),
           file=sys.stderr)
+    print(f"# dispatches={dispatches} (watched device programs in the "
+          f"measured pass) goals_skipped={len(skipped)}"
+          + (f" {skipped}" if skipped else ""), file=sys.stderr)
     # vs_baseline is a TARGET ratio (5 s north star / measured), not a
     # measured-reference comparison: no JVM exists in this environment to
     # run the reference GoalOptimizer (see BASELINE.md "measurement
@@ -492,7 +538,44 @@ def main() -> None:
         "warmup_s": round(warmup_total_s, 3),
         "progcache_hits": progcache.hits,
         "progcache_fresh_compiles": progcache.fresh_compiles,
+        # dispatch-budget attribution (ISSUE 16): how many device
+        # programs the measured pass dispatched, which ones, how many
+        # goal dispatches the host-side skip elided, and the round at
+        # which each goal's convergence predicate first held
+        "fusion": fused,
+        "host_skip": host_skip,
+        "precision": precision,
+        "device_dispatches": dispatches,
+        "dispatches_by_program": dict(sorted(disp_by.items())),
+        "solver_goals_skipped": len(skipped),
+        "skipped_goals": skipped,
+        "converged_at_by_goal": {g: int(c) for g, c in conv.items()},
+        "rounds_by_goal": {g: int(r) for g, r in
+                           results[-1].rounds_by_goal.items()},
     }
+    if precision != "float32":
+        # tolerance gate: a reduced-precision headline only counts if
+        # the f32 baseline agrees (analyzer/precision.py) — solve the
+        # same model at f32 and compare
+        from cruise_control_tpu.analyzer.precision import (
+            proposals_equivalent)
+        print("# precision gate: solving f32 baseline for comparison",
+              file=sys.stderr)
+        baseline = run_once(state_f32, topo, OptimizationOptions())
+        gate_ok, gate = proposals_equivalent(
+            baseline, results[-1],
+            balancedness_eps=float(
+                os.environ.get("BENCH_PRECISION_EPS", 0.5)),
+            min_move_overlap=float(
+                os.environ.get("BENCH_PRECISION_OVERLAP", 0.90)))
+        out["precision_gate"] = gate
+        print(f"# precision gate {'PASS' if gate_ok else 'FAIL'}: "
+              f"{gate}", file=sys.stderr)
+        if not gate_ok:
+            print(json.dumps(_with_trace_summary(out)))
+            print(f"# ERROR: {precision} solve failed the proposals-"
+                  f"equivalence gate vs the f32 baseline", file=sys.stderr)
+            sys.exit(1)
     if regressions:
         out["goal_self_regressions"] = regressions
         print("# ERROR: goal self-regression — these goals' OWN passes "

@@ -1,0 +1,122 @@
+"""Goal megaprogram fusion plan (ISSUE 16 tentpole 2).
+
+The fused pipeline compiles goals into `__seg_{start}_{stop}__`
+programs.  Before this module, segmentation was a fixed-width chunking
+(`pipeline_segment_size`) blind to goal affinity; here adjacent goals of
+the same FUSION GROUP fuse into one megaprogram regardless of width, so
+the 15-goal default stack dispatches ~3 segment programs instead of ~8
+(and instead of the eager driver's 2 per goal).  Dispatch count — not
+per-round FLOPs — is the serial axis the <5s headline needs (see
+PAPERS.md "Turbo-Charged Mapper": compile once, search many).
+
+Groups are defined over REGISTERED goal class names so the
+tools/analysis drift rule can cross-check them against
+`analyzer/goals/registry.GOAL_CLASSES` in both directions: a registered
+goal missing from every group (it would silently fall back to
+width-chunking) or a group member not in the registry (a typo that
+would never match) is a finding.
+
+Fusion changes only the program BOUNDARIES, never the per-goal work:
+each inner goal keeps its prev-stats threading, entry counts,
+self-regression gate, and segment-profiler hooks, and the existing
+`__seg_` key anatomy (parallel/mesh.py program keys, donation policy,
+progcache / _SHARED_PROGRAMS / scenario-LRU keyspaces) applies
+unchanged because a fusion plan is just a different (start, stop)
+sequence.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+#: fusion groups over registry class names.  Adjacent goals (in the
+#: configured priority order) sharing a group fuse into ONE compiled
+#: segment program.  The default order yields three megaprograms:
+#: capacity sextet → distribution sextet → leader trio.
+GOAL_FUSION_GROUPS: Dict[str, List[str]] = {
+    # hard capacity ladder: rack placement + the five capacity caps.
+    # Short per-goal programs (most converge in a handful of rounds at
+    # steady state) — exactly the "serial tail" fusion pays off on.
+    "capacity": [
+        "RackAwareGoal",
+        "ReplicaCapacityGoal",
+        "DiskCapacityGoal",
+        "NetworkInboundCapacityGoal",
+        "NetworkOutboundCapacityGoal",
+        "CpuCapacityGoal",
+    ],
+    # soft distribution band goals: count band + potential-nw-out cap +
+    # the four resource usage bands
+    "distribution": [
+        "ReplicaDistributionGoal",
+        "PotentialNwOutGoal",
+        "DiskUsageDistributionGoal",
+        "NetworkInboundUsageDistributionGoal",
+        "NetworkOutboundUsageDistributionGoal",
+        "CpuUsageDistributionGoal",
+    ],
+    # leadership-dominated tail: topic/leader count distribution + the
+    # leader-bytes-in sweep
+    "leader": [
+        "TopicReplicaDistributionGoal",
+        "LeaderReplicaDistributionGoal",
+        "LeaderBytesInDistributionGoal",
+    ],
+    # modes outside the default ladder (kafka_assigner, intra-broker,
+    # preferred-leader election) — grouped so a stack built from them
+    # still fuses, and so the registry↔fusion drift rule covers every
+    # registered goal
+    "auxiliary": [
+        "PreferredLeaderElectionGoal",
+        "KafkaAssignerEvenRackAwareGoal",
+        "KafkaAssignerDiskUsageDistributionGoal",
+        "IntraBrokerDiskCapacityGoal",
+        "IntraBrokerDiskUsageDistributionGoal",
+    ],
+}
+
+#: name → group key, derived
+GROUP_OF: Dict[str, str] = {
+    name: group
+    for group, names in GOAL_FUSION_GROUPS.items()
+    for name in names
+}
+
+
+def plan_segments(goal_names: Sequence[str], segment_size: int,
+                  fused: bool) -> List[Tuple[int, int]]:
+    """[(start, stop), ...] covering `goal_names` in order.
+
+    `fused=False` reproduces the historical fixed-width chunking exactly
+    (`range(0, G, segment_size)`), keeping every existing program key —
+    and therefore every persistent-cache entry — byte-stable for callers
+    that did not opt in.
+
+    `fused=True` fuses each maximal run of ADJACENT same-group goals
+    into one segment; goals without a group (unregistered/custom goals)
+    fall back to fixed-width chunking within their run.  Only adjacency
+    in the configured order fuses — fusion must never reorder goals,
+    acceptance stacking is order-sensitive."""
+    names = list(goal_names)
+    seg = max(1, int(segment_size))
+    if not names:
+        return []
+    if not fused:
+        return [(start, min(start + seg, len(names)))
+                for start in range(0, len(names), seg)]
+    plan: List[Tuple[int, int]] = []
+    start = 0
+    while start < len(names):
+        group = GROUP_OF.get(names[start])
+        stop = start + 1
+        if group is None:
+            # ungrouped run: chunk by width
+            while (stop < len(names) and stop - start < seg
+                   and GROUP_OF.get(names[stop]) is None):
+                stop += 1
+        else:
+            while (stop < len(names)
+                   and GROUP_OF.get(names[stop]) == group):
+                stop += 1
+        plan.append((start, stop))
+        start = stop
+    return plan

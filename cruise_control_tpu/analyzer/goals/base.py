@@ -176,6 +176,27 @@ class Goal(abc.ABC):
         goal-violation detector and by post-optimization hard-goal checks)."""
         return jnp.zeros(state.num_brokers, dtype=bool)
 
+    # ---- convergence early-exit ----
+    def no_work(self, state: ClusterState, ctx: OptimizationContext,
+                cache: RoundCache) -> Optional[jax.Array]:
+        """bool[] scalar — True when optimize_cached would provably be an
+        IDENTITY on (state, cache): no loop body runs, no pre-sweep does
+        work, and the goal reports 0 rounds.  The fused pipeline then
+        wraps the goal in a `lax.cond` whose taken branch never executes
+        the search kernels — a converged goal costs one predicate
+        evaluation instead of a full round-loop trace (ISSUE 16
+        tentpole 1).
+
+        Soundness contract: a goal may only return a predicate here when
+        ALL of its work (round loops AND pre-sweeps) is gated by
+        conditions implied by the predicate, and its loops report zero
+        rounds when that is so — the early-exit must be BYTE-IDENTICAL
+        to running the goal, instruments included.  Goals whose sweeps
+        do unconditional work (e.g. mean-seeking leadership sweeps that
+        rebalance even with zero violated brokers) must return None
+        (the default), which means "always run"."""
+        return None
+
     # ---- stats regression check ----
     def stats_not_worse(self, before, after):
         """Did optimization avoid regressing this goal's statistic?
@@ -217,11 +238,43 @@ def set_round_sink(sink) -> None:
     _ROUND_SINK.value = sink
 
 
-def note_rounds(rounds) -> None:
-    """Report a goal loop's final round counter (i32 scalar tracer)."""
+def note_rounds(rounds, converged_at=None) -> None:
+    """Report a goal loop's final round counter (i32 scalar tracer).
+
+    `converged_at` (optional i32 scalar) is the round index at which the
+    loop LAST COMMITTED work — the loop's useful prefix.  A loop that
+    spends 146 rounds but stops committing after round 3 reports
+    (146, 3); omitted, it defaults to `rounds` (every round useful),
+    which keeps pre-existing callers exact for loops whose cond already
+    exits on the first uncommitted round."""
     sink = getattr(_ROUND_SINK, "value", None)
     if sink is not None:
-        sink.append(rounds)
+        sink.append((rounds, rounds if converged_at is None
+                     else converged_at))
+
+
+def collapse_sink(sink):
+    """(total_rounds, converged_at) over a goal's sink entries.
+
+    Entries are `(rounds, converged_at)` tuples (note_rounds), possibly
+    from SEVERAL loops run in sequence (pre-sweep + main loop).  The
+    combined converged_at is the last loop-local converged_at offset by
+    the rounds of every loop before it — a later loop that committed
+    nothing (converged_at == 0) does not advance convergence past an
+    earlier loop's last commit.  Plain scalars (legacy entries) are
+    treated as (r, r)."""
+    total = jnp.zeros((), jnp.int32)
+    conv = jnp.zeros((), jnp.int32)
+    for entry in sink:
+        if isinstance(entry, tuple):
+            r, c = entry
+        else:
+            r, c = entry, entry
+        r = jnp.asarray(r, jnp.int32)
+        c = jnp.asarray(c, jnp.int32)
+        conv = jnp.where(c > 0, total + c, conv)
+        total = total + r
+    return total, conv
 
 
 def run_phase_sweeps(state: ClusterState, phases, max_rounds: int,
@@ -250,9 +303,9 @@ def run_phase_sweeps(state: ClusterState, phases, max_rounds: int,
     Compared to gating phases with lax.cond inside one combined round,
     sub-loops add no branch-carry copies of the R-sized state — measured
     ~12% faster at 2.6K brokers / 600K replicas."""
-    def run_phase(st, cache, rounds, body_fn, work_fn, cap):
+    def run_phase(st, cache, rounds, last_commit, body_fn, work_fn, cap):
         def cond(c):
-            st, cache, rounds, local, progressed, _ = c
+            st, cache, rounds, local, progressed, _, _ = c
             ok = (progressed & (rounds < max_rounds)
                   & work_fn(st, cache))
             if cap is not None:
@@ -260,37 +313,41 @@ def run_phase_sweeps(state: ClusterState, phases, max_rounds: int,
             return ok
 
         def body(c):
-            st, cache, rounds, local, _, any_committed = c
+            st, cache, rounds, local, _, any_committed, last_commit = c
             st, cache, committed = body_fn(st, cache)
+            last_commit = jnp.where(committed, rounds + 1, last_commit)
             return (st, cache, rounds + 1, local + 1, committed,
-                    any_committed | committed)
+                    any_committed | committed, last_commit)
 
-        st, cache, rounds, _, _, any_committed = jax.lax.while_loop(
+        (st, cache, rounds, _, _, any_committed,
+         last_commit) = jax.lax.while_loop(
             cond, body, (st, cache, rounds, jnp.zeros((), jnp.int32),
-                         jnp.ones((), bool), jnp.zeros((), bool)))
-        return st, cache, rounds, any_committed
+                         jnp.ones((), bool), jnp.zeros((), bool),
+                         last_commit))
+        return st, cache, rounds, any_committed, last_commit
 
     def outer_cond(c):
-        _, _, rounds, sweep_again = c
+        _, _, rounds, sweep_again, _ = c
         return sweep_again & (rounds < max_rounds)
 
     def outer_body(c):
-        st, cache, rounds, _ = c
+        st, cache, rounds, _, last_commit = c
         sweep_again = jnp.zeros((), bool)
         for entry in phases:
             body_fn, work_fn = entry[0], entry[1]
             cap = entry[2] if len(entry) > 2 else None
-            st, cache, rounds, committed = run_phase(st, cache, rounds,
-                                                     body_fn, work_fn, cap)
+            st, cache, rounds, committed, last_commit = run_phase(
+                st, cache, rounds, last_commit, body_fn, work_fn, cap)
             sweep_again = sweep_again | committed
-        return st, cache, rounds, sweep_again
+        return st, cache, rounds, sweep_again, last_commit
 
     if cache is None:
         cache = make_round_cache(state, table_slots, ctx)
-    state, cache, rounds, _ = jax.lax.while_loop(
+    state, cache, rounds, _, last_commit = jax.lax.while_loop(
         outer_cond, outer_body,
-        (state, cache, jnp.zeros((), jnp.int32), jnp.ones((), bool)))
-    note_rounds(rounds)
+        (state, cache, jnp.zeros((), jnp.int32), jnp.ones((), bool),
+         jnp.zeros((), jnp.int32)))
+    note_rounds(rounds, converged_at=last_commit)
     return state, cache
 
 

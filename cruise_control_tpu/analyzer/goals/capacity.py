@@ -69,7 +69,7 @@ class CapacityGoal(Goal):
             from cruise_control_tpu.analyzer.leadership import (
                 VALUE_WEIGHTED_SELECT_JITTER, limit_bounds,
                 run_sweep_threaded)
-            state, sweep_rounds, cache = run_sweep_threaded(
+            state, sweep_rounds, cache, sweep_conv = run_sweep_threaded(
                 state, ctx, prev_goals, cache,
                 measure=lambda cache: cache.broker_load[:, res],
                 value_r=bonus,
@@ -81,7 +81,7 @@ class CapacityGoal(Goal):
                 # remove-broker run aborted on an unconverged
                 # CpuCapacityGoal with full rotation here)
                 select_jitter=VALUE_WEIGHTED_SELECT_JITTER)
-            note_rounds(sweep_rounds)
+            note_rounds(sweep_rounds, converged_at=sweep_conv)
 
         def round_body(st: ClusterState, cache):
             committed = jnp.zeros((), dtype=bool)
@@ -139,22 +139,31 @@ class CapacityGoal(Goal):
             return st, cache, committed
 
         def cond(carry):
-            st, cache, rounds, progressed = carry
+            st, cache, rounds, progressed, _ = carry
             still_violated = jnp.any(
                 (cache.broker_load[:, res] > self._limit(st, ctx))
                 & st.broker_alive)
             return progressed & still_violated & (rounds < self.rounds_for(ctx))
 
         def body(carry):
-            st, cache, rounds, _ = carry
+            st, cache, rounds, _, last_commit = carry
             st, cache, committed = round_body(st, cache)
-            return st, cache, rounds + 1, committed
+            last_commit = jnp.where(committed, rounds + 1, last_commit)
+            return st, cache, rounds + 1, committed, last_commit
 
-        state, cache, rounds, _ = jax.lax.while_loop(
+        state, cache, rounds, _, last_commit = jax.lax.while_loop(
             cond, body, (state, ensure_full_cache(state, ctx, cache),
-                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
-        note_rounds(rounds)
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool),
+                         jnp.zeros((), jnp.int32)))
+        note_rounds(rounds, converged_at=last_commit)
         return state, cache
+
+    def no_work(self, state, ctx, cache):
+        """All work is violated-gated: the leadership pre-sweep's work
+        predicate is `load > limit` on alive brokers (limit_bounds) and
+        the round loop's cond requires a violated broker — both report 0
+        rounds when none is, so skipping is byte-identical."""
+        return ~jnp.any(self.violated_brokers(state, ctx, cache))
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         """Destination must stay under capacity threshold
@@ -282,21 +291,28 @@ class ReplicaCapacityGoal(Goal):
             return st, cache, jnp.any(cand_v)
 
         def cond(carry):
-            st, cache, rounds, progressed = carry
+            st, cache, rounds, progressed, _ = carry
             count = cache.replica_count.astype(jnp.float32)
             return (progressed & (rounds < self.rounds_for(ctx))
                     & jnp.any((count > limit) & st.broker_alive))
 
         def body(carry):
-            st, cache, rounds, _ = carry
+            st, cache, rounds, _, last_commit = carry
             st, cache, committed = round_body(st, cache)
-            return st, cache, rounds + 1, committed
+            last_commit = jnp.where(committed, rounds + 1, last_commit)
+            return st, cache, rounds + 1, committed, last_commit
 
-        state, cache, rounds, _ = jax.lax.while_loop(
+        state, cache, rounds, _, last_commit = jax.lax.while_loop(
             cond, body, (state, ensure_full_cache(state, ctx, cache),
-                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
-        note_rounds(rounds)
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool),
+                         jnp.zeros((), jnp.int32)))
+        note_rounds(rounds, converged_at=last_commit)
         return state, cache
+
+    def no_work(self, state, ctx, cache):
+        """The loop cond requires an over-limit alive broker; no
+        pre-sweep exists — 0 rounds at zero violated, so skippable."""
+        return ~jnp.any(self.violated_brokers(state, ctx, cache))
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         limit = ctx.max_replicas_per_broker

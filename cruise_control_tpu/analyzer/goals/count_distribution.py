@@ -136,6 +136,13 @@ class ReplicaDistributionGoal(Goal):
             self.rounds_for(ctx), table_slots=ctx.table_slots, ctx=ctx,
             cache=ensure_full_cache(state, ctx, cache))
 
+    def no_work(self, state, ctx, cache):
+        """Both phases' work predicates (over_exists, under_exists with
+        its destination filter) are subsets of the violated surface, and
+        run_phase_sweeps reports 0 rounds when no phase has work — so
+        zero violated brokers makes the goal an identity."""
+        return ~jnp.any(self.violated_brokers(state, ctx, cache))
+
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         counts = self._counts(cache)
         avg = self._avg(state, counts)
@@ -237,7 +244,7 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
         # floor-pinned brokers' imports are themselves vetoed or do not
         # unlock enough sheds — the residual is strict-priority
         # semantics, pinned by tests/test_leader_semantics.py.
-        state, sweep_rounds, cache = run_sweep_threaded(
+        state, sweep_rounds, cache, sweep_conv = run_sweep_threaded(
             state, ctx, prev_goals, cache,
             measure=lambda cache: cache.leader_count.astype(jnp.float32),
             value_r=jnp.ones(state.num_replicas, jnp.float32),
@@ -248,7 +255,7 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
             # LeaderBytesInDistributionGoal's surface instead of
             # scrambling it
             dest_tiebreak=lambda cache: -cache.leader_bytes_in)
-        note_rounds(sweep_rounds)
+        note_rounds(sweep_rounds, converged_at=sweep_conv)
 
         counts0 = S.broker_leader_count(state).astype(jnp.float32)
         avg = self._avg(state, counts0)
@@ -388,6 +395,13 @@ class LeaderReplicaDistributionGoal(ReplicaDistributionGoal):
             self.rounds_for(ctx), table_slots=ctx.table_slots, ctx=ctx,
             cache=ensure_full_cache(state, ctx, cache))
 
+    def no_work(self, state, ctx, cache):
+        """NOT skippable (overrides the parent's predicate back to None):
+        the mean-seeking re-election pre-sweep rebalances toward the
+        alive-broker average even when no broker violates the band, so
+        zero violated does not make the goal an identity."""
+        return None
+
     def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):
         counts = self._counts(cache)
         avg = self._avg(state, counts)
@@ -474,20 +488,40 @@ class TopicReplicaDistributionGoal(Goal):
                                                     cand_d, cand_v)
             return st, cache, jnp.any(cand_v)
 
+        def work_exists(st, cache):
+            # same surface as violated_brokers: some alive broker holds
+            # an over-bound (broker, topic) cell.  Without this gate the
+            # loop always burned (and REPORTED) one no-op round even on
+            # a fully satisfied cluster; a no-work round commits nothing
+            # (movable requires excess_r > 0), so gating it changes only
+            # the round count, identically in every driver.
+            tc = cache.broker_topic_count.astype(jnp.float32)
+            _, upper = self._bounds(st, tc)
+            return jnp.any(st.broker_alive
+                           & jnp.any(tc > upper[None, :], axis=1))
+
         def cond(carry):
-            _, _, rounds, progressed = carry
-            return progressed & (rounds < self.rounds_for(ctx))
+            st, cache, rounds, progressed, _ = carry
+            return (progressed & (rounds < self.rounds_for(ctx))
+                    & work_exists(st, cache))
 
         def body(carry):
-            st, cache, rounds, _ = carry
+            st, cache, rounds, _, last_commit = carry
             st, cache, committed = round_body(st, cache, rounds)
-            return st, cache, rounds + 1, committed
+            last_commit = jnp.where(committed, rounds + 1, last_commit)
+            return st, cache, rounds + 1, committed, last_commit
 
-        state, cache, rounds, _ = jax.lax.while_loop(
+        state, cache, rounds, _, last_commit = jax.lax.while_loop(
             cond, body, (state, ensure_full_cache(state, ctx, cache),
-                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
-        note_rounds(rounds)
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool),
+                         jnp.zeros((), jnp.int32)))
+        note_rounds(rounds, converged_at=last_commit)
         return state, cache
+
+    def no_work(self, state, ctx, cache):
+        """Matches the loop cond's work gate (same surface as
+        violated_brokers): no over-bound cell → 0 rounds, identity."""
+        return ~jnp.any(self.violated_brokers(state, ctx, cache))
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         tc = cache.broker_topic_count.astype(jnp.float32)

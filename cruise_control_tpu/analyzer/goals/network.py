@@ -91,15 +91,25 @@ class PotentialNwOutGoal(Goal):
                     & jnp.any((pot > self._limit(st, ctx)) & st.broker_alive))
 
         def body(carry):
-            st, cache, rounds, _ = carry
+            st, cache, rounds, _, last_commit = carry
             st, cache, committed = round_body(st, cache)
-            return st, cache, rounds + 1, committed
+            last_commit = jnp.where(committed, rounds + 1, last_commit)
+            return st, cache, rounds + 1, committed, last_commit
 
-        state, cache, rounds, _ = jax.lax.while_loop(
-            cond, body, (state, ensure_full_cache(state, ctx, cache),
-                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
-        note_rounds(rounds)
+        def cond5(carry):
+            return cond(carry[:4])
+
+        state, cache, rounds, _, last_commit = jax.lax.while_loop(
+            cond5, body, (state, ensure_full_cache(state, ctx, cache),
+                          jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool),
+                          jnp.zeros((), jnp.int32)))
+        note_rounds(rounds, converged_at=last_commit)
         return state, cache
+
+    def no_work(self, state, ctx, cache):
+        """The loop cond requires an over-potential alive broker; no
+        pre-sweep — 0 rounds at zero violated, so skippable."""
+        return ~jnp.any(self.violated_brokers(state, ctx, cache))
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         """Keep destinations under the potential-NW_OUT cap unless they are
@@ -211,13 +221,20 @@ class LeaderBytesInDistributionGoal(Goal):
         # (the model stores base loads per replica, builder.py)
         value_r = (state.replica_base_load[:, Resource.NW_IN]
                    * state.replica_valid)
-        swept, sweep_rounds, swept_cache = run_sweep_threaded(
+        swept, sweep_rounds, swept_cache, sweep_conv = run_sweep_threaded(
             state, ctx, prev_goals, cache,
             measure=lambda cache: cache.leader_bytes_in,
             value_r=value_r,
             bounds=mean_bounds(_upper_of), improve_gate=True,
-            max_rounds=128, select_jitter=VALUE_WEIGHTED_SELECT_JITTER)
-        note_rounds(sweep_rounds)
+            max_rounds=128, select_jitter=VALUE_WEIGHTED_SELECT_JITTER,
+            # ISSUE 16 satellite 6: the self-regression gate wired INTO
+            # the sweep's convergence predicate — a round that grows this
+            # goal's own violated count reverts and TERMINATES the sweep
+            # (r05 burned 49 rounds producing steps the outer gate then
+            # discarded wholesale).  The whole-sweep select below stays
+            # as belt-and-braces for the committed prefix.
+            regress_guard=lambda st, ca: self._violated_count(st, ctx, ca))
+        note_rounds(sweep_rounds, converged_at=sweep_conv)
         sweep_ok = (self._violated_count(swept, ctx, swept_cache)
                     <= v_enter)
         state, cache = _select(sweep_ok, (swept, swept_cache),
@@ -261,11 +278,11 @@ class LeaderBytesInDistributionGoal(Goal):
             return st, cache, jnp.any(cand_v)
 
         def cond(carry):
-            _, _, rounds, progressed = carry
+            _, _, rounds, progressed, _ = carry
             return progressed & (rounds < self.rounds_for(ctx))
 
         def body(carry):
-            st, cache, rounds, _ = carry
+            st, cache, rounds, _, last_commit = carry
             v0 = self._violated_count(st, ctx, cache)
             st2, cache2, committed = round_body(st, cache)
             # the fused self-regression gate: reject (and stop at) any
@@ -273,12 +290,15 @@ class LeaderBytesInDistributionGoal(Goal):
             # violated-broker count — see optimize_cached
             ok = self._violated_count(st2, ctx, cache2) <= v0
             st, cache = _select(ok, (st2, cache2), (st, cache))
-            return st, cache, rounds + 1, committed & ok
+            committed &= ok
+            last_commit = jnp.where(committed, rounds + 1, last_commit)
+            return st, cache, rounds + 1, committed, last_commit
 
-        state, cache, rounds, _ = jax.lax.while_loop(
+        state, cache, rounds, _, last_commit = jax.lax.while_loop(
             cond, body, (state, cache,
-                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
-        note_rounds(rounds)
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool),
+                         jnp.zeros((), jnp.int32)))
+        note_rounds(rounds, converged_at=last_commit)
         return state, cache
 
     def accept_leadership(self, state, ctx, cache, src_replica, dest_replica):

@@ -106,21 +106,29 @@ class RackAwareGoal(Goal):
             return st, cache, jnp.any(cand_v)
 
         def cond(carry):
-            st, cache, rounds, progressed = carry
+            st, cache, rounds, progressed, _ = carry
             return (progressed & (rounds < self.rounds_for(ctx))
                     & jnp.any(self._redundant_mask(
                         st, cache.partition_rack_count)))
 
         def body(carry):
-            st, cache, rounds, _ = carry
+            st, cache, rounds, _, last_commit = carry
             st, cache, committed = round_body(st, cache)
-            return st, cache, rounds + 1, committed
+            last_commit = jnp.where(committed, rounds + 1, last_commit)
+            return st, cache, rounds + 1, committed, last_commit
 
-        state, cache, rounds, _ = jax.lax.while_loop(
+        state, cache, rounds, _, last_commit = jax.lax.while_loop(
             cond, body, (state, ensure_full_cache(state, ctx, cache),
-                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool)))
-        note_rounds(rounds)
+                         jnp.zeros((), jnp.int32), jnp.ones((), dtype=bool),
+                         jnp.zeros((), jnp.int32)))
+        note_rounds(rounds, converged_at=last_commit)
         return state, cache
+
+    def no_work(self, state, ctx, cache):
+        """Exactly the loop cond's work term: no rack-redundant replica
+        → the loop body never runs and 0 rounds are reported."""
+        return ~jnp.any(self._redundant_mask(
+            state, cache.partition_rack_count))
 
     def accept_move(self, state, ctx, cache, replica, dest_broker):
         """A move may not place a second replica of the partition in the

@@ -93,14 +93,14 @@ class ResourceDistributionGoal(Goal):
             from cruise_control_tpu.analyzer.leadership import (
                 VALUE_WEIGHTED_SELECT_JITTER, limit_bounds,
                 run_sweep_threaded)
-            state, sweep_rounds, cache = run_sweep_threaded(
+            state, sweep_rounds, cache, sweep_conv = run_sweep_threaded(
                 state, ctx, prev_goals, cache,
                 measure=lambda cache: cache.broker_load[:, res],
                 value_r=bonus,
                 bounds=limit_bounds(upper, (upper + lower) / 2.0),
                 improve_gate=False,
                 select_jitter=VALUE_WEIGHTED_SELECT_JITTER)
-            note_rounds(sweep_rounds)
+            note_rounds(sweep_rounds, converged_at=sweep_conv)
 
         def phase_a(st, cache):
             W = cache.broker_load[:, res]
@@ -270,6 +270,15 @@ class ResourceDistributionGoal(Goal):
         return run_phase_sweeps(state, phases, self.rounds_for(ctx),
                                 table_slots=ctx.table_slots, ctx=ctx,
                                 cache=ensure_full_cache(state, ctx, cache))
+
+    def no_work(self, state, ctx, cache):
+        """Every phase's work predicate — over_exists, under_exists (with
+        its destination filter), both swap predicates, and the leadership
+        pre-sweep's limit_bounds work term (`load > upper` on alive
+        brokers) — is a subset of the violated surface, and both the
+        sweep and run_phase_sweeps report 0 rounds when no work exists:
+        zero violated brokers makes the goal an identity."""
+        return ~jnp.any(self.violated_brokers(state, ctx, cache))
 
     # -- acceptance (as a previously-optimized goal) -----------------------
     def accept_move(self, state, ctx, cache, replica, dest_broker):

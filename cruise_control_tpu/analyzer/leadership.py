@@ -86,7 +86,9 @@ def global_leadership_sweep(
         dest_tiebreak: Optional[Callable[[RoundCache], jax.Array]] = None,
         select_jitter: float = 1.0,
         cache0: Optional[RoundCache] = None,
-) -> Tuple[ClusterState, jax.Array, RoundCache]:
+        regress_guard: Optional[Callable[[ClusterState, RoundCache],
+                                         jax.Array]] = None,
+) -> Tuple[ClusterState, jax.Array, RoundCache, jax.Array]:
     """Run whole-cluster leadership re-election rounds.
 
     Args:
@@ -120,7 +122,18 @@ def global_leadership_sweep(
       cache0: optional TABLE-LESS RoundCache describing `state` (threaded
         from the caller; see run_sweep_threaded) — seeds the loop instead
         of a fresh make_round_cache.
-    Returns (state, rounds_used, final cache); traceable.
+      regress_guard: optional (state, cache) -> i32[] monotone badness
+        (e.g. the calling goal's own violated-broker count).  When set,
+        every round's result is accepted only if the guard did not GROW;
+        a regressing round reverts wholesale and TERMINATES the sweep
+        (the rounds are deterministic up to the salt schedule — letting
+        the loop continue just burns rounds re-proposing steps an outer
+        gate would discard; ISSUE 16 satellite 6, the r05
+        LeaderBytesInDistributionGoal 49-round burn).
+    Returns (state, rounds_used, final cache, converged_at); traceable.
+    `converged_at` is the 1-based round index of the LAST round that
+    committed accepted work (0 when none did) — the sweep's useful
+    prefix for the converged-at-round accounting.
 
     A floor-unblocking "refuel" sub-round (importing high-bonus
     leaderships into brokers pinned at a prior goal's band floor, fired
@@ -322,7 +335,7 @@ def global_leadership_sweep(
         return new_st, cache, cur, failed, jnp.any(valid)
 
     def cond(carry):
-        st, cache, cur, failed, rounds, dry = carry
+        st, cache, cur, failed, rounds, dry, _, _ = carry
         W = measure(cache)
         shed_to, _, _ = bounds(st, W)
         work = jnp.any(st.broker_alive & (W > shed_to))
@@ -336,46 +349,65 @@ def global_leadership_sweep(
         return (dry < 3) & work & (rounds < max_rounds)
 
     def body(carry):
-        st, cache, cur, failed, rounds, dry = carry
-        st, cache, cur, failed, committed = round_body(
+        st, cache, cur, failed, rounds, dry, vprev, last_commit = carry
+        st2, cache2, cur2, failed2, committed = round_body(
             st, cache, cur, failed, rounds.astype(jnp.float32) * 0.37)
-        dry = jnp.where(committed, 0, dry + 1)
-        return st, cache, cur, failed, rounds + 1, dry
+        if regress_guard is not None:
+            v_new = jnp.asarray(regress_guard(st2, cache2), jnp.int32)
+            ok = v_new <= vprev
+            st, cache, cur, failed = jax.tree.map(
+                lambda a, b: jnp.where(ok, a, b),
+                (st2, cache2, cur2, failed2), (st, cache, cur, failed))
+            vprev = jnp.where(ok, v_new, vprev)
+            committed = committed & ok
+            # a rejected round forces the dry-exit: its revert restores
+            # the exact pre-round surface, so the next rounds would
+            # re-derive the same (regressing) proposals up to jitter
+            dry = jnp.where(committed, 0,
+                            jnp.where(ok, dry + 1, jnp.int32(3)))
+        else:
+            st, cache, cur, failed = st2, cache2, cur2, failed2
+            dry = jnp.where(committed, 0, dry + 1)
+        last_commit = jnp.where(committed, rounds + 1, last_commit)
+        return st, cache, cur, failed, rounds + 1, dry, vprev, last_commit
 
     if cache0 is None:
         cache0 = make_round_cache(state, 0, ctx)
     cur0 = S.partition_leader_replica(state)            # once, not per round
-    state, cache0, _, _, rounds, _ = jax.lax.while_loop(
+    v0 = (jnp.asarray(regress_guard(state, cache0), jnp.int32)
+          if regress_guard is not None else jnp.zeros((), jnp.int32))
+    state, cache0, _, _, rounds, _, _, last_commit = jax.lax.while_loop(
         cond, body, (state, cache0, cur0,
                      jnp.zeros((num_p,), jnp.float32),
-                     jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)))
-    return state, rounds, cache0
+                     jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+                     v0, jnp.zeros((), jnp.int32)))
+    return state, rounds, cache0, last_commit
 
 
 def run_sweep_threaded(state: ClusterState, ctx: OptimizationContext,
                        prev_goals: Sequence, cache: Optional[RoundCache],
                        **sweep_kwargs):
-    """(state, rounds, cache') — global_leadership_sweep with RoundCache
-    threading.  The sweep itself runs table-less (per-commit slot lookups
-    would dominate its round cost); a carried FULL cache's table —
-    membership is transfer-invariant — is detached for the sweep and
-    reattached afterwards with the role-dependent planes re-gathered
-    (context.reattach_table), so the caller's table rounds skip the full
-    rebuild."""
+    """(state, rounds, cache', converged_at) — global_leadership_sweep
+    with RoundCache threading.  The sweep itself runs table-less
+    (per-commit slot lookups would dominate its round cost); a carried
+    FULL cache's table — membership is transfer-invariant — is detached
+    for the sweep and reattached afterwards with the role-dependent
+    planes re-gathered (context.reattach_table), so the caller's table
+    rounds skip the full rebuild."""
     from cruise_control_tpu.analyzer.context import (reattach_table,
                                                      strip_table)
     if cache is not None and cache.broker_table.shape[1]:
         tbl, fill = cache.broker_table, cache.table_fill
         t_bonus, t_ok = cache.table_bonus, cache.table_ok
         r_ok = cache.replica_ok
-        state, rounds, nt = global_leadership_sweep(
+        state, rounds, nt, conv = global_leadership_sweep(
             state, ctx, prev_goals, cache0=strip_table(cache),
             **sweep_kwargs)
         return state, rounds, reattach_table(state, nt, tbl, fill,
-                                             t_bonus, t_ok, r_ok)
-    state, rounds, nt = global_leadership_sweep(
+                                             t_bonus, t_ok, r_ok), conv
+    state, rounds, nt, conv = global_leadership_sweep(
         state, ctx, prev_goals, cache0=cache, **sweep_kwargs)
-    return state, rounds, nt
+    return state, rounds, nt, conv
 
 
 def mean_bounds(upper_of: Callable[[ClusterState, jax.Array], jax.Array]):

@@ -189,6 +189,20 @@ class OptimizerResult:
     #: means an EARLIER goal interfered — different bug, different fix.
     entry_broker_counts: Dict[str, int] = \
         dataclasses.field(default_factory=dict)
+    #: per-goal 1-based index of the LAST search round that committed
+    #: work — the loop's useful prefix.  rounds_by_goal counts every
+    #: round the loop SPENT; a goal that spends 146 rounds but stops
+    #: committing after round 3 reports converged_at 3 (0 = the goal
+    #: committed nothing).  This is the round-budget tuning instrument:
+    #: rounds far above converged_at are pure convergence-polling tail.
+    converged_at_by_goal: Dict[str, int] = \
+        dataclasses.field(default_factory=dict)
+    #: goals whose segment dispatch was elided host-side (opt-in
+    #: host_side_skip): every goal of the segment reported no_work on
+    #: the segment's input state, so the dispatch was skipped and its
+    #: instruments synthesized (0 rounds, unchanged stats).  Metered by
+    #: the facade as `solver-goals-skipped`.
+    skipped_goals: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def num_replica_movements(self) -> int:
@@ -280,7 +294,9 @@ class GoalOptimizer:
                  pipeline_segment_size: int = 4,
                  balancedness_weights: Tuple[float, float] = (1.1, 1.5),
                  auto_warmup: bool = False,
-                 eager_hard_abort: bool = False):
+                 eager_hard_abort: bool = False,
+                 fused_segments: bool = False,
+                 host_side_skip: bool = False):
         self.goals = list(goals)
         self.constraint = constraint or BalancingConstraint()
         self.balancedness_weights = balancedness_weights
@@ -324,6 +340,24 @@ class GoalOptimizer:
         self._warmup_lock = threading.Lock()
         #: goals per compiled program (see optimizations docstring)
         self.pipeline_segment_size = pipeline_segment_size
+        #: OPT-IN goal megaprograms (analyzer/fusion.py): segment
+        #: boundaries follow the fusion groups — each maximal run of
+        #: adjacent same-group goals compiles into ONE program — instead
+        #: of fixed-width chunking, cutting per-solve dispatches (the
+        #: default 15-goal stack: 3 segment programs instead of 4 at
+        #: width 4, vs the eager driver's 30).  Off (the default) keeps
+        #: every historical program key and persistent-cache entry
+        #: byte-stable.
+        self.fused_segments = fused_segments
+        #: OPT-IN host-side dispatch skip: before dispatching a fused
+        #: segment, evaluate every member goal's no_work predicate on
+        #: the threaded state and SKIP the dispatch entirely when all
+        #: report no work (instruments synthesized: 0 rounds, unchanged
+        #: stats; skipped names land in OptimizerResult.skipped_goals).
+        #: Costs one scalar device sync per segment boundary, which is
+        #: why it is off by default — the default zero-sync mechanism is
+        #: the device-side lax.cond skip inside the segment programs.
+        self.host_side_skip = host_side_skip
         #: when True, block after each segment and log its wall-clock
         #: (sync points cost transport latency — profiling only)
         self.profile_segments = False
@@ -335,6 +369,52 @@ class GoalOptimizer:
         #: executables and optimizations() calls them directly when the
         #: argument shapes match.
         self._aot: Dict[str, object] = {}
+
+    def _plan_segments(self):
+        """The solve's segment plan [(start, stop), ...] — fusion-group
+        megaprograms when `fused_segments` is on, the historical
+        fixed-width chunking otherwise (see analyzer/fusion.py).  Used
+        by BOTH warmup() and optimizations() so compiled keys and
+        dispatched keys can never drift."""
+        from cruise_control_tpu.analyzer.fusion import plan_segments
+        return plan_segments([g.name for g in self.goals],
+                             max(1, self.pipeline_segment_size),
+                             self.fused_segments)
+
+    def _segment_no_work(self, start: int, stop: int, state, ctx,
+                         cache) -> bool:
+        """Host-side skip verdict for goals[start:stop] on the threaded
+        `state`/`cache`: True iff EVERY goal in the segment defines a
+        no_work predicate and all hold.  One scalar device sync (the
+        opt-in host_side_skip cost).  A single predicate-less goal in
+        the segment vetoes the skip — its work cannot be ruled out
+        host-side."""
+        verdicts = []
+        for g in self.goals[start:stop]:
+            nw = g.no_work(state, ctx, cache)
+            if nw is None:
+                return False
+            verdicts.append(nw)
+        if not verdicts:
+            return False
+        with jax.transfer_guard_device_to_host("allow"):
+            all_nw = verdicts[0]
+            for v in verdicts[1:]:
+                all_nw = all_nw & v
+            return bool(jax.device_get(all_nw))
+
+    @staticmethod
+    def _skip_instruments(n: int, prev_stats):
+        """Synthesized instruments for a host-skipped segment of `n`
+        goals: stats unchanged (the previous goal's stats broadcast per
+        goal), zero rounds/converged-at/violated counts, no
+        regression."""
+        stacked = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (n,) + x.shape),
+            prev_stats)
+        zi = jnp.zeros((n,), jnp.int32)
+        zb = jnp.zeros((n,), bool)
+        return stacked, zi, zi, zb, zi, zi
 
     def _prebalance_dims(self):
         """(active_resources tuple[bool x RES], balance_counts,
@@ -435,8 +515,9 @@ class GoalOptimizer:
     def _segment_fn(self, start: int, stop: int):
         """(state, cache, prev_stats, ctx) -> (state, cache, last_stats,
         (stacked per-goal stats, own-violated counts, per-goal rounds,
-        regression flags, hard-violated predicate)) for
-        goals[start:stop], with acceptance stacking over ALL prior goals.
+        regression flags, hard-violated predicate, entry-violated
+        counts, per-goal converged-at rounds)) for goals[start:stop],
+        with acceptance stacking over ALL prior goals.
 
         The FULL per-goal epilogue is fused into this program: stats,
         own-violated counting, the AbstractGoal.java:92-101 non-regression
@@ -473,6 +554,7 @@ class GoalOptimizer:
             own_violated = []
             entry_violated = []
             rounds_used = []
+            conv_used = []
             regressed = []
             for i in range(start, stop):
                 # the goal's violated count at its OWN entry: own-vs-
@@ -482,21 +564,52 @@ class GoalOptimizer:
                       else make_round_cache(state))
                 entry_violated.append(goals[i].violated_brokers(
                     state, ctx, c0).sum(dtype=jnp.int32))
-                sink: List = []
-                goals_base.set_round_sink(sink)
-                try:
-                    state, cache = goals[i].optimize_cached(
-                        state, ctx, goals[:i], cache)
-                finally:
-                    goals_base.set_round_sink(None)
-                rounds_used.append(sum(sink)
-                                   if sink else jnp.zeros((), jnp.int32))
-                c = (cache if cache is not None
-                     else make_round_cache(state))
-                goal_stats = compute_stats_fresh_loads(state, c)
+
+                def run_goal(op, i=i):
+                    st, ca = op
+                    # the sink and its collapse both live INSIDE the
+                    # branch: round-counter tracers appended under a
+                    # lax.cond cannot escape it, so rounds/converged are
+                    # branch OUTPUTS
+                    sink: List = []
+                    goals_base.set_round_sink(sink)
+                    try:
+                        st, ca = goals[i].optimize_cached(
+                            st, ctx, goals[:i], ca)
+                    finally:
+                        goals_base.set_round_sink(None)
+                    r, cv = goals_base.collapse_sink(sink)
+                    # rebuild inside the branch: a goal that fell back
+                    # to the cache-less SPI returns None, and both cond
+                    # branches must return one pytree structure
+                    return st, ensure_full_cache(st, ctx, ca), r, cv
+
+                def skip_goal(op):
+                    st, ca = op
+                    z = jnp.zeros((), jnp.int32)
+                    return st, ensure_full_cache(st, ctx, ca), z, z
+
+                nw = goals[i].no_work(state, ctx, c0)
+                if nw is None:
+                    state, cache, g_rounds, g_conv = run_goal(
+                        (state, c0))
+                else:
+                    # device-side convergence early-exit: when the
+                    # goal's no_work predicate holds, the whole goal
+                    # body becomes a no-op cond branch — XLA skips its
+                    # search rounds instead of spinning them to their
+                    # (false) loop conds.  Byte-identical by the no_work
+                    # SPI contract: a goal only defines the predicate if
+                    # running at no-work is an identity that reports 0
+                    # rounds.
+                    state, cache, g_rounds, g_conv = jax.lax.cond(
+                        nw, skip_goal, run_goal, (state, c0))
+                rounds_used.append(g_rounds)
+                conv_used.append(g_conv)
+                goal_stats = compute_stats_fresh_loads(state, cache)
                 per_goal_stats.append(goal_stats)
                 own_violated.append(goals[i].violated_brokers(
-                    state, ctx, c).sum(dtype=jnp.int32))
+                    state, ctx, cache).sum(dtype=jnp.int32))
                 if traceable[i]:
                     regressed.append(~jnp.asarray(
                         goals[i].stats_not_worse(prev_stats, goal_stats),
@@ -512,13 +625,14 @@ class GoalOptimizer:
                         for i in range(start, stop) if goals[i].is_hard]
             hard_violated = (jnp.any(jnp.stack(hard_own) > 0) if hard_own
                              else jnp.zeros((), dtype=bool))
-            # a goal that fell back to the cache-less SPI returns None —
-            # rebuild so the segment's output structure stays fixed
+            # the per-goal branches already rebuilt through
+            # ensure_full_cache (identity on a full cache) — this final
+            # pass is a structural no-op kept for the empty-segment edge
             cache = ensure_full_cache(state, ctx, cache)
             return state, cache, prev_stats, (
                 stacked, jnp.stack(own_violated), jnp.stack(rounds_used),
                 jnp.stack(regressed), hard_violated,
-                jnp.stack(entry_violated))
+                jnp.stack(entry_violated), jnp.stack(conv_used))
         return run
 
     def _device_comparators(self) -> Tuple[bool, ...]:
@@ -542,9 +656,11 @@ class GoalOptimizer:
 
     def _goal_rounds_fn(self, i: int):
         """(state, cache, ctx) -> (state, cache, rounds i32[1],
-        entry-violated i32[1]) — goal i's search rounds only (profile
-        mode / eager driver); `entry` is the goal's violated-broker
-        count before its own run (self-regression instrument)."""
+        entry-violated i32[1], converged-at i32[1]) — goal i's search
+        rounds only (profile mode / eager driver); `entry` is the
+        goal's violated-broker count before its own run
+        (self-regression instrument), `converged-at` the 1-based index
+        of the last round that committed work."""
         goals = tuple(self.goals)
 
         def run(state: ClusterState, cache, ctx: OptimizationContext):
@@ -561,9 +677,10 @@ class GoalOptimizer:
                     state, ctx, goals[:i], cache)
             finally:
                 goals_base.set_round_sink(None)
-            rounds = sum(sink) if sink else jnp.zeros((), jnp.int32)
+            rounds, conv = goals_base.collapse_sink(sink)
             cache = ensure_full_cache(state, ctx, cache)
-            return state, cache, jnp.stack([rounds]), entry[None]
+            return (state, cache, jnp.stack([rounds]), entry[None],
+                    conv[None])
         return run
 
     def _goal_epilogue_fn(self, i: int):
@@ -661,7 +778,6 @@ class GoalOptimizer:
             # idempotent for a caller that already sharded the state
             state = mesh_mod.shard_state(state, mesh)
         ctx = make_context(state, self.constraint, options, topology)
-        seg = max(1, self.pipeline_segment_size)
         # segments take the threaded RoundCache as an input — lower
         # against its abstract shape (no device work)
         cache_aval = jax.eval_shape(
@@ -672,8 +788,7 @@ class GoalOptimizer:
         jobs = [("__stats__", compute_stats, (state,)),
                 ("__pre__", self._pre_fn(), (state, state, ctx)),
                 ("__post__", self._post_fn(), (state, cache_aval, ctx))]
-        for start in range(0, len(self.goals), seg):
-            stop = min(start + seg, len(self.goals))
+        for start, stop in self._plan_segments():
             jobs.append((f"__seg_{start}_{stop}__",
                          self._segment_fn(start, stop),
                          (state, cache_aval, stats_aval_in, ctx)))
@@ -1023,13 +1138,14 @@ class GoalOptimizer:
             jax.block_until_ready(state.replica_broker)
             prof.record("pre+heal+prebalance", "prebalance",
                         time.time() - t0)
-        seg = max(1, self.pipeline_segment_size)
         prev_stats = stats0_dev
         stacked_parts = []
         own_parts = []
         rounds_parts = []
         regr_parts = []
         entry_parts = []
+        conv_parts = []
+        skipped: List[str] = []
 
         def eager_check(hard_dev, goals_window, own_dev):
             # opt-in per-segment abort sync (see eager_hard_abort)
@@ -1055,14 +1171,22 @@ class GoalOptimizer:
                 # (sched/runtime.py; no-op outside a preemptible job)
                 segment_checkpoint()
                 t_seg = time.time()
-                state, cache, rounds_g, entry_g = run_prog(
+                state, cache, rounds_g, entry_g, conv_g = run_prog(
                     f"__goal_{i}_rounds__", self._goal_rounds_fn(i),
                     state, cache, ctx)
                 if prof is not None:
                     jax.block_until_ready(state.replica_broker)
+                    with jax.transfer_guard_device_to_host("allow"):
+                        # profile mode already syncs here; the
+                        # converged-at meta rides the goal's rounds
+                        # record into the segment table + trace span
+                        meta = {"converged_at":
+                                int(jax.device_get(conv_g[0])),
+                                "rounds":
+                                int(jax.device_get(rounds_g[0]))}
                     prof.record(f"goal:{g.name}:rounds",
                                 profiling.category_for_goal(g.name),
-                                time.time() - t_seg)
+                                time.time() - t_seg, **meta)
                 t_epi = time.time()
                 prev_stats, (stacked_g, own_g, regr_g, hard_g) = run_prog(
                     f"__goal_{i}_epi__", self._goal_epilogue_fn(i),
@@ -1076,26 +1200,46 @@ class GoalOptimizer:
                 rounds_parts.append(rounds_g)
                 regr_parts.append(regr_g)
                 entry_parts.append(entry_g)
+                conv_parts.append(conv_g)
                 if eager:
                     eager_check(hard_g, [g], own_g)
         else:
-            for start in range(0, len(self.goals), seg):
+            for start, stop in self._plan_segments():
                 # scheduler preemption checkpoint (see the eager loop)
                 segment_checkpoint()
-                stop = min(start + seg, len(self.goals))
-                (state, cache, prev_stats,
-                 (stacked_seg, own_seg, rounds_seg, regr_seg,
-                  hard_seg, entry_seg)) = run_prog(
-                    f"__seg_{start}_{stop}__",
-                    self._segment_fn(start, stop), state, cache,
-                    prev_stats, ctx)
+                if (self.host_side_skip
+                        and self._segment_no_work(start, stop, state,
+                                                  ctx, cache)):
+                    # host-side dispatch skip (opt-in): every goal of
+                    # the segment reported no_work on the segment's
+                    # INPUT state, and no_work goals are identities at
+                    # no work — the state cannot change mid-segment, so
+                    # the verdicts hold at every inner goal's entry and
+                    # the whole dispatch is elided.  Instruments are
+                    # synthesized: 0 rounds, unchanged stats, zero
+                    # violated counts (no_work == ~any(violated) for
+                    # every predicate-bearing goal).
+                    (stacked_seg, own_seg, rounds_seg, regr_seg,
+                     entry_seg, conv_seg) = self._skip_instruments(
+                        stop - start, prev_stats)
+                    skipped.extend(
+                        g.name for g in self.goals[start:stop])
+                else:
+                    (state, cache, prev_stats,
+                     (stacked_seg, own_seg, rounds_seg, regr_seg,
+                      hard_seg, entry_seg, conv_seg)) = run_prog(
+                        f"__seg_{start}_{stop}__",
+                        self._segment_fn(start, stop), state, cache,
+                        prev_stats, ctx)
+                    if eager:
+                        eager_check(hard_seg, self.goals[start:stop],
+                                    own_seg)
                 stacked_parts.append(stacked_seg)
                 own_parts.append(own_seg)
                 rounds_parts.append(rounds_seg)
                 regr_parts.append(regr_seg)
                 entry_parts.append(entry_seg)
-                if eager:
-                    eager_check(hard_seg, self.goals[start:stop], own_seg)
+                conv_parts.append(conv_seg)
         t_post = time.time()
         va_dev = run_prog("__post__", self._post_fn(), state, cache, ctx)
         if prof is not None:
@@ -1111,11 +1255,12 @@ class GoalOptimizer:
             # (diff/sanity/result), which reads device arrays only AFTER
             # this fetch has drained the pipeline.
             (stats_before, stacked_h, own_h, rounds_h, regr_h, entry_h,
-             vb_h, va_h, still_offline, broken, max_count,
+             conv_h, vb_h, va_h, still_offline, broken, max_count,
              pre_rounds, invalid_inp) = jax.device_get(
                 (stats0_dev, stacked_parts, own_parts, rounds_parts,
-                 regr_parts, entry_parts, vb_dev, va_dev, still_dev,
-                 broken_dev, maxc_dev, pre_rounds_dev, invalid_dev))
+                 regr_parts, entry_parts, conv_parts, vb_dev, va_dev,
+                 still_dev, broken_dev, maxc_dev, pre_rounds_dev,
+                 invalid_dev))
             if prof is not None:
                 prof.record("instrument fetch", "transfer",
                             time.time() - t_host)
@@ -1186,6 +1331,8 @@ class GoalOptimizer:
                       else np.zeros(0, bool))
             entry_h = (np.concatenate(entry_h) if entry_h
                        else np.zeros(0, np.int32))
+            conv_h = (np.concatenate(conv_h) if conv_h
+                      else np.zeros(0, np.int32))
 
             if int(still_offline):
                 raise OptimizationFailure(
@@ -1204,6 +1351,8 @@ class GoalOptimizer:
                             for g, e in zip(self.goals, entry_h)}
             rounds_by_goal = {g.name: int(r)
                               for g, r in zip(self.goals, rounds_h)}
+            converged_by_goal = {g.name: int(c)
+                                 for g, c in zip(self.goals, conv_h)}
             if int(pre_rounds):
                 rounds_by_goal["__prebalance__"] = int(pre_rounds)
 
@@ -1281,6 +1430,8 @@ class GoalOptimizer:
                 rounds_by_goal=rounds_by_goal,
                 mesh_devices=mesh.size if mesh_active else 1,
                 entry_broker_counts=entry_counts,
+                converged_at_by_goal=converged_by_goal,
+                skipped_goals=skipped,
             )
             result.hard_goal_names = frozenset(
                 g.name for g in self.goals if g.is_hard)

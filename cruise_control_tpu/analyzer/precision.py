@@ -1,0 +1,134 @@
+"""Reduced-precision load tables + the proposals-equivalence gate
+(ISSUE 16 tentpole 3).
+
+The solver's hot tables — per-replica loads, leadership bonuses, broker
+capacities — are f32 by default.  At TPU scale the search rounds are
+bandwidth-bound on these planes, and the VPU moves bf16 at twice the
+f32 rate, so `solver.precision=bfloat16` halves the table traffic of
+every round.  Integer planes (replica→broker assignment, counts, rack
+ids) are NEVER cast: placement arithmetic must stay exact.
+
+bf16 loads shift balance decisions at the margin, so byte-identity pins
+cannot gate this path.  Instead, an opted-in bf16 solve is accepted by
+`proposals_equivalent`: the candidate result must (a) keep every hard
+goal satisfied, (b) land its balancedness score within an epsilon of
+the f32 baseline, and (c) move a placement set that overlaps the
+baseline's by a minimum ratio.  Anything else is a gate failure — the
+caller falls back to f32 (the bench's tolerance-gate pin injects a
+wrong-answer kernel and asserts exactly that).
+
+Programs re-key automatically: the persistent-cache / shared-program
+shape signature (`parallel/mesh.tree_signature`) covers every leaf
+dtype, so bf16 and f32 solves can never collide on a compiled program.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.model.state import ClusterState
+
+#: accepted `solver.precision` values → table dtype
+PRECISIONS: Dict[str, jnp.dtype] = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+}
+
+#: float table fields of ClusterState that the precision knob casts.
+#: Everything else (assignment ids, validity masks, rack/host maps) is
+#: integral or boolean and stays exact.
+_FLOAT_TABLE_FIELDS: Tuple[str, ...] = (
+    "replica_base_load",
+    "partition_leader_bonus",
+    "broker_capacity",
+)
+
+
+def table_dtype(precision: str):
+    """The table dtype for a `solver.precision` config value."""
+    try:
+        return PRECISIONS[precision]
+    except KeyError:
+        raise ValueError(
+            f"solver.precision must be one of {sorted(PRECISIONS)}, "
+            f"got {precision!r}") from None
+
+
+def cast_state_tables(state: ClusterState,
+                      precision: str) -> ClusterState:
+    """`state` with its float load/capacity tables cast to `precision`.
+
+    float32 is the identity (no array touched, so warm-start seeds and
+    compiled-program keys are unchanged for the default config).  Only
+    the _FLOAT_TABLE_FIELDS planes are cast — int32 counts and ids stay
+    exact by construction."""
+    dtype = table_dtype(precision)
+    if dtype == jnp.float32:
+        return state
+    return dataclasses.replace(state, **{
+        f: getattr(state, f).astype(dtype)
+        for f in _FLOAT_TABLE_FIELDS
+    })
+
+
+def _move_set(proposals: Sequence) -> set:
+    """Hashable placement-change set of a proposal list: one
+    (partition, sorted new broker set, new leader) entry per changed
+    partition — insensitive to replica-list order."""
+    return {
+        (p.partition,
+         tuple(sorted(r.broker_id for r in p.new_replicas)),
+         p.new_leader)
+        for p in proposals
+    }
+
+
+def proposals_equivalent(baseline, candidate, *,
+                         balancedness_eps: float = 0.5,
+                         min_move_overlap: float = 0.90
+                         ) -> Tuple[bool, Dict[str, object]]:
+    """The reduced-precision acceptance gate: is `candidate` (a bf16
+    OptimizerResult) equivalent-for-serving to `baseline` (the f32
+    reference)?
+
+    Three conditions, all required:
+
+    * no hard-goal violations in the candidate (hard goals are never
+      relaxed — a capacity breach is wrong at any precision);
+    * candidate balancedness within `balancedness_eps` points of the
+      baseline (the [0, 100] score, so 0.5 ≈ half a point);
+    * the candidate's placement-change set overlaps the baseline's by
+      at least `min_move_overlap` (Jaccard on (partition, new replica
+      set, new leader) entries) — bf16 may re-rank near-tied candidate
+      moves, it must not invent a different plan.
+
+    Returns (ok, report); `report` carries every term for the bench
+    table / gate log.  Both empty move sets compare as full overlap
+    (two no-op solves are equivalent)."""
+    hard = set(getattr(candidate, "hard_goal_names", frozenset()))
+    hard_violated = sorted(
+        set(candidate.violated_goals_after) & hard)
+    base_score = baseline.balancedness_score()
+    cand_score = candidate.balancedness_score()
+    base_moves = _move_set(baseline.proposals)
+    cand_moves = _move_set(candidate.proposals)
+    union = base_moves | cand_moves
+    overlap = (len(base_moves & cand_moves) / len(union)
+               if union else 1.0)
+    ok = (not hard_violated
+          and abs(base_score - cand_score) <= balancedness_eps
+          and overlap >= min_move_overlap)
+    report = {
+        "ok": ok,
+        "hardViolated": hard_violated,
+        "balancednessBaseline": round(base_score, 4),
+        "balancednessCandidate": round(cand_score, 4),
+        "balancednessEps": balancedness_eps,
+        "moveOverlap": round(overlap, 4),
+        "minMoveOverlap": min_move_overlap,
+        "baselineMoves": len(base_moves),
+        "candidateMoves": len(cand_moves),
+    }
+    return ok, report

@@ -258,6 +258,45 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
              "Cooldown after the breaker trips; once elapsed the next "
              "solve probes one rung up and success re-closes the "
              "breaker.")
+    d.define("solver.fusion.enabled", Type.BOOLEAN, False, None, _M,
+             "Fuse adjacent same-group goals into single compiled "
+             "megaprograms (analyzer/fusion.py goal groups) instead of "
+             "fixed-width pipeline segments: the default 15-goal stack "
+             "drops from 4 to 3 goal programs per solve (the eager "
+             "driver dispatches 30), cutting the serial dispatch tail "
+             "the <5s headline needs.  Off keeps every historical "
+             "program key and persistent-cache entry byte-stable.")
+    d.define("solver.host.skip.enabled", Type.BOOLEAN, False, None, _L,
+             "Skip a fused segment's device dispatch entirely when "
+             "every goal in it reports no work (zero violated brokers "
+             "on its no_work surface) on the segment's input state; "
+             "skipped goals are metered as solver-goals-skipped.  "
+             "Costs one scalar device sync per segment boundary, so it "
+             "pays off only on transports where a dispatch is more "
+             "expensive than a sync (remote TPU).  The zero-sync "
+             "device-side early-exit inside the segment programs is "
+             "always on and needs no flag.")
+    d.define("solver.precision", Type.STRING, "float32",
+             in_values("float32", "bfloat16"), _M,
+             "Dtype of the solver's float load/capacity tables "
+             "(replica loads, leadership bonuses, broker capacities); "
+             "integer placement planes are always exact.  `bfloat16` "
+             "halves table bandwidth per search round on TPU; results "
+             "are accepted through the proposals-equivalence gate "
+             "(analyzer/precision.py) instead of byte-identity — see "
+             "solver.precision.balancedness.eps / "
+             "solver.precision.min.move.overlap.")
+    d.define("solver.precision.balancedness.eps", Type.DOUBLE, 0.5,
+             in_range(min_value=0.0), _L,
+             "Tolerance-gate term for reduced-precision solves: the "
+             "bf16 result's balancedness score ([0,100]) must land "
+             "within this many points of the f32 baseline when the "
+             "gate is evaluated (bench / opt-in validation).")
+    d.define("solver.precision.min.move.overlap", Type.DOUBLE, 0.90,
+             in_range(min_value=0.0, max_value=1.0), _L,
+             "Tolerance-gate term for reduced-precision solves: "
+             "minimum Jaccard overlap between the bf16 and f32 "
+             "placement-change sets.")
     d.define("scenario.engine.enabled", Type.BOOLEAN, True, None, _M,
              "Serve the SCENARIOS endpoint and multi-candidate broker "
              "operations through the batched what-if engine "

@@ -206,6 +206,11 @@ class CruiseControl:
                  solver_retry_backoff_max_s: float = 60.0,
                  solver_breaker_failure_threshold: int = 3,
                  solver_breaker_cooldown_s: float = 300.0,
+                 solver_fusion_enabled: bool = False,
+                 solver_host_skip_enabled: bool = False,
+                 solver_precision: str = "float32",
+                 solver_precision_balancedness_eps: float = 0.5,
+                 solver_precision_min_move_overlap: float = 0.90,
                  precompute_solve_deadline_s: float = 1800.0,
                  scenario_engine_enabled: bool = True,
                  scenario_max_batch_size: int = 32,
@@ -370,11 +375,25 @@ class CruiseControl:
             notifier=executor_notifier, time_fn=self._time,
             sleep_fn=sleep_fn, journal=self.executor_journal,
             **(executor_kwargs or {}))
+        # dispatch-budget knobs (reference none — TPU-side): fused goal
+        # megaprograms (solver.fusion.enabled) collapse the per-chunk
+        # segment programs into per-fusion-group ones, and the host-side
+        # skip (solver.host.skip.enabled) elides whole segment dispatches
+        # whose member goals all report no work.  Both default off —
+        # the historical segment keying and the 2-device_get pin hold
+        # byte for byte unless opted in.
+        from cruise_control_tpu.analyzer.precision import table_dtype
+        table_dtype(solver_precision)  # fail fast on unknown values
+        self._solver_precision = solver_precision
+        self._precision_balancedness_eps = solver_precision_balancedness_eps
+        self._precision_min_move_overlap = solver_precision_min_move_overlap
         self.goal_optimizer = GoalOptimizer(
             default_goals(names=self._goal_names,
                           max_rounds=max_optimization_rounds),
             self._constraint, balancedness_weights=balancedness_weights,
-            auto_warmup=auto_warmup)
+            auto_warmup=auto_warmup,
+            fused_segments=solver_fusion_enabled,
+            host_side_skip=solver_host_skip_enabled)
         self._ple_optimizer = GoalOptimizer(
             [make_goal("PreferredLeaderElectionGoal")], self._constraint)
 
@@ -1497,6 +1516,15 @@ class CruiseControl:
         padded broker is never dirty."""
         generation = self.load_monitor.model_generation()
         state, topo = self._model_for_solve(allow_capacity_estimation)
+        if self._solver_precision != "float32":
+            # reduced-precision load tables (solver.precision): cast at
+            # the solve boundary, NOT in the model store — the resident
+            # model, deltas, and sensors stay f32; only the goal programs
+            # see the narrowed planes.  tree_signature covers dtypes, so
+            # bf16 programs key separately from f32 ones.
+            from cruise_control_tpu.analyzer.precision import \
+                cast_state_tables
+            state = cast_state_tables(state, self._solver_precision)
         raw_brokers = state.num_brokers
         if self._fleet_binding is not None:
             state = self._fleet_binding.pad_state(state, goal_key)
@@ -1759,6 +1787,13 @@ class CruiseControl:
                         {g: (entries.get(g, counts[g][0]), counts[g][1])
                          for g in regressions})
         self._goal_self_regressions = regressions
+        # host-side skip accounting (solver.host.skip.enabled): goals
+        # whose segment dispatch was elided because every member
+        # reported no work — the bench reads the meter for its
+        # solver-goals-skipped column
+        skipped = getattr(result, "skipped_goals", None) or []
+        if skipped:
+            self.metrics.meter("solver-goals-skipped").mark(len(skipped))
 
     def _try_mesh_recovery(self, kind: FailureKind, exc: BaseException,
                            optimizer: GoalOptimizer) -> Optional[dict]:

@@ -315,6 +315,7 @@ class FleetRouter:
             violated_broker_counts=dict(outcome.violated_broker_counts),
             entry_broker_counts=dict(outcome.entry_broker_counts),
             rounds_by_goal=dict(outcome.rounds_by_goal),
+            converged_at_by_goal=dict(outcome.converged_at_by_goal),
             hard_goal_names=frozenset(g.name for g in goals
                                       if g.is_hard),
             balancedness_weights=payload.balancedness_weights)

@@ -363,6 +363,14 @@ def build_cruise_control(config: CruiseControlConfig, admin,
             "solver.circuit.breaker.failure.threshold"),
         solver_breaker_cooldown_s=config.get_long(
             "solver.circuit.breaker.cooldown.ms") / 1e3,
+        solver_fusion_enabled=config.get_boolean("solver.fusion.enabled"),
+        solver_host_skip_enabled=config.get_boolean(
+            "solver.host.skip.enabled"),
+        solver_precision=config.get("solver.precision"),
+        solver_precision_balancedness_eps=config.get_double(
+            "solver.precision.balancedness.eps"),
+        solver_precision_min_move_overlap=config.get_double(
+            "solver.precision.min.move.overlap"),
         precompute_solve_deadline_s=config.get_long(
             "proposal.precompute.solve.deadline.ms") / 1e3,
         scenario_engine_enabled=config.get_boolean(

@@ -97,6 +97,18 @@ _WATCH_LOCK = threading.Lock()
 #: lifetime watchdog fires in this process (the mesh-watchdog-fires
 #: sensor reads it)
 _FIRES = 0
+#: lifetime watched-dispatch count (armed or not) — the per-solve
+#: dispatch-budget instrument: every AOT program invocation goes
+#: through watched_call, so `dispatch_count()` deltas around a warmed
+#: solve measure its device dispatches (bench table + the
+#: dispatch-count pin in tests/test_dispatch_budget.py).  The inline
+#: jit fallback is NOT counted — it may be a cold compile, which is
+#: not a dispatch-budget question — so counters are only meaningful
+#: after warmup()/hydration.
+_DISPATCHES = 0
+#: per-program-key dispatch counts (bounded by the program keyspace:
+#: a few dozen pipeline keys per goal list)
+_DISPATCHES_BY_PROGRAM: dict = {}
 #: wall seconds the dispatch thread was actually blocked at the last
 #: fire — the meshchaos bench's released-in-time assertion
 _LAST_FIRE_WAIT_S = 0.0
@@ -130,6 +142,26 @@ def watchdog_config() -> dict:
 
 def watchdog_fires() -> int:
     return _FIRES
+
+
+def dispatch_count() -> int:
+    """Lifetime watched-dispatch count (see _DISPATCHES)."""
+    return _DISPATCHES
+
+
+def dispatches_by_program() -> dict:
+    """Snapshot of per-program-key watched-dispatch counts."""
+    with _WATCH_LOCK:
+        return dict(_DISPATCHES_BY_PROGRAM)
+
+
+def _count_dispatch(program: Optional[str]) -> None:
+    global _DISPATCHES
+    with _WATCH_LOCK:
+        _DISPATCHES += 1
+        if program:
+            _DISPATCHES_BY_PROGRAM[program] = \
+                _DISPATCHES_BY_PROGRAM.get(program, 0) + 1
 
 
 def last_fire_wait_s() -> float:
@@ -244,6 +276,7 @@ def watched_call(fn: Callable[[], object], *,
     exactly like a stuck collective would."""
     cfg = watchdog_config()
     armed = cfg["enabled"] and cfg["deadline_ms"] > 0
+    _count_dispatch(program)
 
     def _invoke():
         faults.inject(site)

@@ -104,6 +104,10 @@ class ScenarioOutcome:
     entry_broker_counts: Dict[str, int] = dataclasses.field(
         default_factory=dict)
     rounds_by_goal: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: per-goal last-committing round (see
+    #: OptimizerResult.converged_at_by_goal)
+    converged_at_by_goal: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
     stats_before: Optional[object] = None  #: host ClusterModelStats
     stats_after: Optional[object] = None
     #: per-goal stats snapshots (the fused path computes these anyway;
@@ -458,6 +462,7 @@ class ScenarioEngine:
             violated_broker_counts=dict(res.violated_broker_counts),
             entry_broker_counts=dict(res.entry_broker_counts),
             rounds_by_goal=dict(res.rounds_by_goal),
+            converged_at_by_goal=dict(res.converged_at_by_goal),
             stats_before=res.stats_before, stats_after=res.stats_after,
             balancedness=res.balancedness_score(),
             num_replica_moves=res.num_replica_movements,
@@ -552,19 +557,22 @@ class ScenarioEngine:
          broken_dev, pre_rounds_dev, invalid_dev) = self._run(
             optimizer, "__pre__", optimizer._pre_fn(), shapes, (),
             initial, stacked_state, stacked_ctx)
-        seg = max(1, optimizer.pipeline_segment_size)
         prev_stats = stats0_dev
         stacked_parts, own_parts, rounds_parts, regr_parts = [], [], [], []
         entry_parts = []
-        for start in range(0, len(optimizer.goals), seg):
+        conv_parts = []
+        # segment boundaries follow the optimizer's plan — fusion-group
+        # megaprograms when it opted in — so scenario lanes dispatch the
+        # same `__seg_` keys (and per-solve dispatch count) as request
+        # solves
+        for start, stop in optimizer._plan_segments():
             # scheduler preemption checkpoint: a queued ANOMALY_HEAL /
             # USER_INTERACTIVE solve takes the device at the next
             # segment boundary; the whole sweep re-queues
             segment_checkpoint()
-            stop = min(start + seg, len(optimizer.goals))
             (state, cache, prev_stats,
              (stacked_seg, own_seg, rounds_seg, regr_seg, _hard,
-              entry_seg)) = \
+              entry_seg, conv_seg)) = \
                 self._run(optimizer, f"__seg_{start}_{stop}__",
                           optimizer._segment_fn(start, stop), shapes,
                           (0, 1), state, cache, prev_stats, stacked_ctx)
@@ -573,6 +581,7 @@ class ScenarioEngine:
             rounds_parts.append(rounds_seg)
             regr_parts.append(regr_seg)
             entry_parts.append(entry_seg)
+            conv_parts.append(conv_seg)
         va_dev = self._run(optimizer, "__post__", optimizer._post_fn(),
                            shapes, (), state, cache, stacked_ctx)
         moves_dev = self._run(optimizer, "__moves__", _movement_metrics,
@@ -584,12 +593,12 @@ class ScenarioEngine:
             # fetch 1/2: every instrument of the whole batch in ONE
             # device_get — [K]- and [K, G]-shaped tables
             (stats0_h, stacked_h, own_h, rounds_h, regr_h, entry_h,
-             vb_h, va_h, still_h, maxc_h, broken_h, pre_rounds_h,
+             conv_h, vb_h, va_h, still_h, maxc_h, broken_h, pre_rounds_h,
              invalid_h, moves_h) = jax.device_get(
                 (stats0_dev, stacked_parts, own_parts, rounds_parts,
-                 regr_parts, entry_parts, vb_dev, va_dev, still_dev,
-                 maxc_dev, broken_dev, pre_rounds_dev, invalid_dev,
-                 moves_dev))
+                 regr_parts, entry_parts, conv_parts, vb_dev, va_dev,
+                 still_dev, maxc_dev, broken_dev, pre_rounds_dev,
+                 invalid_dev, moves_dev))
             slots = ctx0.table_slots
             max_count = int(np.max(maxc_h)) if k else 0
             if slots and max_count > slots:
@@ -634,6 +643,8 @@ class ScenarioEngine:
             np.zeros((k, 0), np.int32)
         rounds_all = np.concatenate(rounds_h, axis=1) if rounds_h else \
             np.zeros((k, 0), np.int32)
+        conv_all = np.concatenate(conv_h, axis=1) if conv_h else \
+            np.zeros((k, 0), np.int32)
         regr_all = np.concatenate(regr_h, axis=1) if regr_h else \
             np.zeros((k, 0), bool)
         stacked_all = jax.tree.map(
@@ -647,8 +658,8 @@ class ScenarioEngine:
                 batch, i, goals, traceable,
                 jax.tree.map(lambda x, i=i: x[i], stats0_h),
                 jax.tree.map(lambda x, i=i: x[i], stacked_all),
-                own_all[i], entry_all[i], rounds_all[i], regr_all[i],
-                vb_h[i], va_h[i],
+                own_all[i], entry_all[i], rounds_all[i], conv_all[i],
+                regr_all[i], vb_h[i], va_h[i],
                 int(still_h[i]), bool(broken_h[i]), int(pre_rounds_h[i]),
                 bool(invalid_h[i]), tuple(m[i] for m in moves_h),
                 include_proposals,
@@ -661,9 +672,9 @@ class ScenarioEngine:
         return outcomes
 
     def _assemble_outcome(self, batch, i, goals, traceable, stats_before,
-                          stats_by_idx, own, entry, rounds, regr, vb, va,
-                          still_offline, broken, pre_rounds, invalid,
-                          moves, include_proposals, placements
+                          stats_by_idx, own, entry, rounds, conv, regr,
+                          vb, va, still_offline, broken, pre_rounds,
+                          invalid, moves, include_proposals, placements
                           ) -> ScenarioOutcome:
         """Host tail for scenario i — the same evaluation order as the
         single-solve host tail in GoalOptimizer.optimizations, but
@@ -675,6 +686,7 @@ class ScenarioEngine:
                   for g, b, o, a in zip(goals, vb, own, va)}
         entry_counts = {g.name: int(e) for g, e in zip(goals, entry)}
         rounds_by_goal = {g.name: int(r) for g, r in zip(goals, rounds)}
+        converged_by_goal = {g.name: int(c) for g, c in zip(goals, conv)}
         if pre_rounds:
             rounds_by_goal["__prebalance__"] = pre_rounds
 
@@ -757,6 +769,7 @@ class ScenarioEngine:
             violated_broker_counts=counts,
             entry_broker_counts=entry_counts,
             rounds_by_goal=rounds_by_goal,
+            converged_at_by_goal=converged_by_goal,
             stats_before=stats_before, stats_after=stats_after,
             stats_by_goal=stats_by_goal,
             regressed_goals=regressed,

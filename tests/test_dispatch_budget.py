@@ -1,0 +1,353 @@
+"""Dispatch-budget pins (ISSUE 16): goal megaprogram fusion, device-side
+convergence early-exit, host-side no-work skip, and the reduced-precision
+tolerance gate.
+
+* fusion plans: fused=False reproduces the historical fixed-width
+  chunking byte-for-byte (key stability); fused=True groups adjacent
+  same-group goals and covers every goal exactly once;
+* byte-identity: the fused megaprogram pipeline (with the device-side
+  convergence early-exit inside every segment) reproduces the eager
+  per-goal reference driver's proposals/instruments at f32, on
+  single-chip AND on the forced 8-device virtual mesh;
+* dispatch count: a warm fused solve dispatches at most len(plan) + 2
+  watched device programs — at least 2x below the eager driver's
+  2 + 2G (parallel/health.py dispatch counter);
+* host-side skip: with every member goal reporting no work the segment
+  dispatch is elided entirely, the result is byte-identical, and the
+  elided goals land in OptimizerResult.skipped_goals;
+* precision gate: analyzer/precision.proposals_equivalent accepts an
+  equivalent bf16 result and REJECTS an injected wrong answer (hard
+  violation, balancedness drift, move-set divergence).
+"""
+from types import SimpleNamespace
+
+import numpy as np
+
+import conftest  # noqa: F401
+
+import jax
+import pytest
+
+from cruise_control_tpu.analyzer.context import OptimizationOptions
+from cruise_control_tpu.analyzer.fusion import (GOAL_FUSION_GROUPS,
+                                                GROUP_OF, plan_segments)
+from cruise_control_tpu.analyzer.goals.registry import (GOAL_CLASSES,
+                                                        default_goals)
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.analyzer.precision import (cast_state_tables,
+                                                   proposals_equivalent,
+                                                   table_dtype)
+from cruise_control_tpu.parallel import health
+from cruise_control_tpu.parallel.mesh import make_mesh
+from cruise_control_tpu.testing import fixtures
+
+from test_fused_pipeline import GOAL_SUBSET, _unfused_reference_solve
+
+
+def _proposal_key(p):
+    return (p.partition.topic, p.partition.partition,
+            tuple(r.broker_id for r in p.old_replicas),
+            tuple(r.broker_id for r in p.new_replicas))
+
+
+# ---------------------------------------------------------------- plans
+
+def test_unfused_plan_is_historical_chunking():
+    names = [f"g{i}" for i in range(15)]
+    assert plan_segments(names, 4, False) == [(0, 4), (4, 8), (8, 12),
+                                              (12, 15)]
+    assert plan_segments(names, 2, False) == [
+        (i, min(i + 2, 15)) for i in range(0, 15, 2)]
+    assert plan_segments([], 4, False) == []
+    assert plan_segments([], 4, True) == []
+
+
+def test_fused_plan_groups_default_stack():
+    from cruise_control_tpu.analyzer.goals.registry import (
+        DEFAULT_GOAL_ORDER)
+    plan = plan_segments(DEFAULT_GOAL_ORDER, 4, True)
+    # capacity sextet -> distribution sextet -> leader trio
+    assert plan == [(0, 6), (6, 12), (12, 15)]
+
+
+def test_fused_plan_covers_every_goal_once():
+    names = list(GOAL_SUBSET) + ["NotARegisteredGoal", "AlsoCustom"]
+    plan = plan_segments(names, 2, True)
+    covered = [i for start, stop in plan for i in range(start, stop)]
+    assert covered == list(range(len(names)))
+    # ungrouped goals fall back to width-chunking, never fuse into a
+    # neighboring group's megaprogram
+    for start, stop in plan:
+        groups = {GROUP_OF.get(n) for n in names[start:stop]}
+        assert len(groups) == 1
+
+
+def test_fusion_groups_match_registry_both_directions():
+    """The in-repo mirror of the tools/analysis drift rule: every
+    registered goal belongs to exactly one fusion group and every group
+    member is a registered goal."""
+    registered = set(GOAL_CLASSES)
+    grouped = [n for names in GOAL_FUSION_GROUPS.values() for n in names]
+    assert len(grouped) == len(set(grouped)), "goal in two fusion groups"
+    assert set(grouped) == registered
+
+
+# --------------------------------------------- byte-identity (tentpole)
+
+@pytest.mark.slow
+def test_fused_megaprograms_match_eager_reference():
+    """Fusion + device-side convergence early-exit at f32 reproduces the
+    eager per-goal driver bit-for-bit (same plan, same float-refresh
+    cadence)."""
+    state, topo = fixtures.small_cluster()
+    options = OptimizationOptions()
+    opt = GoalOptimizer(default_goals(max_rounds=24, names=GOAL_SUBSET),
+                        pipeline_segment_size=2, fused_segments=True)
+    assert opt._plan_segments() == [(0, 2), (2, 4), (4, 6)]
+    fused = opt.optimizations(state, topo, options, check_sanity=False)
+    ref = _unfused_reference_solve(opt, state, topo, options)
+
+    assert fused.violated_broker_counts == ref["counts"]
+    assert fused.rounds_by_goal == ref["rounds"]
+    assert fused.regressed_goals == ref["regressed"]
+    assert sorted(map(_proposal_key, fused.proposals)) == sorted(
+        map(_proposal_key, ref["proposals"]))
+    assert np.array_equal(
+        np.asarray(fused.final_state.replica_broker),
+        np.asarray(ref["final_state"].replica_broker))
+    # the early-exit instrument: converged-at never exceeds rounds used
+    for g, conv in fused.converged_at_by_goal.items():
+        assert 0 <= conv <= fused.rounds_by_goal.get(g, 0)
+
+
+@pytest.mark.slow
+def test_fused_mesh8_matches_single_chip():
+    """The fused megaprograms ride the 8-device virtual mesh (conftest
+    forces it) and agree with the single-chip fused solve."""
+    state, topo = fixtures.small_cluster()
+    options = OptimizationOptions()
+    opt = GoalOptimizer(default_goals(max_rounds=24, names=GOAL_SUBSET),
+                        pipeline_segment_size=2, fused_segments=True)
+    single = opt.optimizations(state, topo, options, check_sanity=False)
+    mesh = make_mesh(jax.devices()[:8])
+    meshed = opt.optimizations(state, topo, options, check_sanity=False,
+                               mesh=mesh)
+    assert meshed.mesh_devices == 8
+    assert sorted(map(_proposal_key, meshed.proposals)) == sorted(
+        map(_proposal_key, single.proposals))
+    assert meshed.rounds_by_goal == single.rounds_by_goal
+    assert meshed.converged_at_by_goal == single.converged_at_by_goal
+    assert np.array_equal(
+        np.asarray(meshed.final_state.replica_broker),
+        np.asarray(single.final_state.replica_broker))
+
+
+# --------------------------------------------------- dispatch-count pin
+
+@pytest.mark.slow
+def test_warm_fused_solve_dispatch_budget():
+    """A warm fused solve dispatches <= len(plan) + 2 device programs
+    (pre + segments + post) through the watched gateway — >= 2x below
+    the eager driver's 2 + 2G.  Counted AFTER warmup: the first-call
+    inline-jit fallback bypasses watched_call by design."""
+    state, topo = fixtures.small_cluster()
+    options = OptimizationOptions()
+    opt = GoalOptimizer(default_goals(max_rounds=24, names=GOAL_SUBSET),
+                        pipeline_segment_size=2, fused_segments=True)
+    opt.warmup(state, topo, options)
+    opt.optimizations(state, topo, options, check_sanity=False)
+
+    plan = opt._plan_segments()
+    budget = len(plan) + 2
+    before = health.dispatch_count()
+    opt.optimizations(state, topo, options, check_sanity=False)
+    used = health.dispatch_count() - before
+    eager_cost = 2 + 2 * len(GOAL_SUBSET)
+    assert 0 < used <= budget, (used, budget)
+    assert eager_cost >= 2 * used, (
+        f"fused solve used {used} dispatches; eager driver pays "
+        f"{eager_cost} — fusion must be >= 2x below")
+    by_prog = health.dispatches_by_program()
+    for start, stop in plan:
+        assert by_prog.get(f"__seg_{start}_{stop}__", 0) >= 1
+
+
+# ------------------------------------------------------ host-side skip
+
+@pytest.mark.slow
+def test_host_side_skip_elides_converged_segments():
+    """Re-solving an already-balanced cluster with host_side_skip must
+    elide every all-no-work segment dispatch, record the elided goals in
+    skipped_goals, and stay byte-identical to the unskipped solve."""
+    names = ["ReplicaCapacityGoal", "DiskCapacityGoal",
+             "ReplicaDistributionGoal", "DiskUsageDistributionGoal"]
+    state, topo = fixtures.small_cluster()
+    options = OptimizationOptions()
+    base = GoalOptimizer(default_goals(max_rounds=24, names=names),
+                         pipeline_segment_size=2, fused_segments=True)
+    balanced = base.optimizations(state, topo, options,
+                                  check_sanity=False).final_state
+
+    skip = GoalOptimizer(default_goals(max_rounds=24, names=names),
+                         pipeline_segment_size=2, fused_segments=True,
+                         host_side_skip=True)
+    r_skip = skip.optimizations(balanced, topo, options,
+                                check_sanity=False)
+    r_ref = base.optimizations(balanced, topo, options,
+                               check_sanity=False)
+
+    # the capacity segment has provably no work and is elided whole; the
+    # distribution segment must STILL dispatch because
+    # DiskUsageDistributionGoal honestly reports residual violated
+    # brokers on this fixture (it iterates and commits nothing) — the
+    # skip only ever elides segments whose every goal proves no_work
+    assert r_skip.skipped_goals == ["ReplicaCapacityGoal",
+                                    "DiskCapacityGoal"]
+    assert r_ref.skipped_goals == []
+    assert not r_skip.proposals and not r_ref.proposals
+    assert r_skip.rounds_by_goal == r_ref.rounds_by_goal
+    assert all(r_skip.rounds_by_goal[g] == 0
+               for g in r_skip.skipped_goals)
+    assert r_skip.violated_broker_counts == r_ref.violated_broker_counts
+    assert np.array_equal(
+        np.asarray(r_skip.final_state.replica_broker),
+        np.asarray(r_ref.final_state.replica_broker))
+
+
+@pytest.mark.slow
+def test_host_side_skip_noop_when_there_is_work():
+    """A dirty cluster must veto the skip: results identical to the
+    non-skipping optimizer, nothing in skipped_goals for segments that
+    did work."""
+    state, topo = fixtures.small_cluster()
+    options = OptimizationOptions()
+    kwargs = dict(pipeline_segment_size=2, fused_segments=True)
+    plain = GoalOptimizer(default_goals(max_rounds=24,
+                                        names=GOAL_SUBSET), **kwargs)
+    skip = GoalOptimizer(default_goals(max_rounds=24, names=GOAL_SUBSET),
+                         host_side_skip=True, **kwargs)
+    a = plain.optimizations(state, topo, options, check_sanity=False)
+    b = skip.optimizations(state, topo, options, check_sanity=False)
+    assert sorted(map(_proposal_key, a.proposals)) == sorted(
+        map(_proposal_key, b.proposals))
+    assert a.rounds_by_goal == b.rounds_by_goal
+    # the fixture's forced rack move lives in the first segment; that
+    # segment must not have been skipped
+    assert "RackAwareGoal" not in b.skipped_goals
+
+
+# ------------------------------------------------------ precision gate
+
+def _fake_result(moves, balancedness, violated=(), hard=()):
+    def mk(i, old, new):
+        return SimpleNamespace(
+            partition=("t", i),  # hashable, like the real partition key
+            old_replicas=[SimpleNamespace(broker_id=b) for b in old],
+            new_replicas=[SimpleNamespace(broker_id=b) for b in new],
+            new_leader=new[0])
+    return SimpleNamespace(
+        proposals=[mk(i, old, new) for i, (old, new) in enumerate(moves)],
+        violated_goals_after=list(violated),
+        hard_goal_names=frozenset(hard),
+        balancedness_score=lambda b=balancedness: b)
+
+
+def test_table_dtype_rejects_unknown_precision():
+    import jax.numpy as jnp
+    assert table_dtype("float32") == jnp.float32
+    assert table_dtype("bfloat16") == jnp.bfloat16
+    with pytest.raises(ValueError, match="solver.precision"):
+        table_dtype("float8")
+
+
+def test_cast_state_tables_targets_only_float_planes():
+    import jax.numpy as jnp
+    state, _ = fixtures.small_cluster()
+    assert cast_state_tables(state, "float32") is state
+    cast = cast_state_tables(state, "bfloat16")
+    assert cast.replica_base_load.dtype == jnp.bfloat16
+    assert cast.partition_leader_bonus.dtype == jnp.bfloat16
+    assert cast.broker_capacity.dtype == jnp.bfloat16
+    # integer planes stay exact
+    assert cast.replica_broker.dtype == state.replica_broker.dtype
+    np.testing.assert_array_equal(np.asarray(cast.replica_broker),
+                                  np.asarray(state.replica_broker))
+
+
+def test_proposals_equivalent_accepts_close_and_rejects_wrong():
+    moves = [((0, 1), (2, 1)), ((1, 2), (0, 2)), ((3, 0), (3, 1)),
+             ((2, 0), (2, 1)), ((0, 3), (1, 3)), ((1, 0), (2, 0)),
+             ((2, 3), (0, 3)), ((3, 2), (1, 2)), ((0, 2), (3, 2)),
+             ((1, 3), (0, 1))]
+    base = _fake_result(moves, 87.0)
+
+    ok, report = proposals_equivalent(base, _fake_result(moves, 86.8))
+    assert ok and report["moveOverlap"] == 1.0
+
+    # one re-ranked near-tie out of ten stays above the 0.90 overlap
+    # ... no: Jaccard with 1 differing move of 10 = 9/11 < 0.9 -> the
+    # gate is strict by default; loosened explicitly it passes
+    nearly = _fake_result(moves[:-1] + [((1, 3), (2, 3))], 86.9)
+    ok, report = proposals_equivalent(base, nearly)
+    assert not ok and report["moveOverlap"] < 0.9
+    ok, _ = proposals_equivalent(base, nearly, min_move_overlap=0.8)
+    assert ok
+
+    # injected wrong answers: hard violation / balance drift / plan
+    # divergence — each alone must fail the gate
+    bad_hard = _fake_result(moves, 87.0, violated=["DiskCapacityGoal"],
+                            hard=["DiskCapacityGoal"])
+    ok, report = proposals_equivalent(base, bad_hard)
+    assert not ok and report["hardViolated"] == ["DiskCapacityGoal"]
+
+    ok, report = proposals_equivalent(base, _fake_result(moves, 80.0))
+    assert not ok
+    assert abs(report["balancednessBaseline"]
+               - report["balancednessCandidate"]) > 0.5
+
+    different = _fake_result([((i, 9), (9, i)) for i in range(10)], 87.0)
+    ok, report = proposals_equivalent(base, different)
+    assert not ok and report["moveOverlap"] == 0.0
+
+    # two no-op solves are equivalent
+    ok, report = proposals_equivalent(_fake_result([], 90.0),
+                                      _fake_result([], 90.0))
+    assert ok and report["moveOverlap"] == 1.0
+
+
+@pytest.mark.slow
+def test_bfloat16_solve_passes_gate_on_fixture():
+    """End-to-end bf16: cast tables, solve the same model, pass the
+    proposals-equivalence gate against the f32 result.  (Byte identity
+    is NOT claimed — that is exactly what the gate is for.)"""
+    state, topo = fixtures.small_cluster()
+    options = OptimizationOptions()
+    opt = GoalOptimizer(default_goals(max_rounds=24, names=GOAL_SUBSET),
+                        pipeline_segment_size=2, fused_segments=True)
+    f32 = opt.optimizations(state, topo, options, check_sanity=False)
+    bf16 = opt.optimizations(cast_state_tables(state, "bfloat16"), topo,
+                             options, check_sanity=False)
+    ok, report = proposals_equivalent(f32, bf16)
+    assert ok, report
+
+
+# -------------------------------------------- converged-at instrument
+
+@pytest.mark.slow
+def test_converged_at_round_reported_not_round_budget():
+    """A goal that converges early reports the convergence round, not
+    the round budget it never used (the r05 table reported 146 for a
+    goal done at 3)."""
+    state, topo = fixtures.small_cluster()
+    opt = GoalOptimizer(default_goals(max_rounds=24, names=GOAL_SUBSET),
+                        pipeline_segment_size=2, fused_segments=True)
+    res = opt.optimizations(state, topo, OptimizationOptions(),
+                            check_sanity=False)
+    assert set(res.converged_at_by_goal) == set(GOAL_SUBSET)
+    for g in GOAL_SUBSET:
+        conv = res.converged_at_by_goal[g]
+        rounds = res.rounds_by_goal[g]
+        assert 0 <= conv <= rounds, (g, conv, rounds)
+    # at least one goal in the subset converges before its budget on
+    # the small fixture — the instrument must be able to say so
+    assert any(0 < res.converged_at_by_goal[g] for g in GOAL_SUBSET)

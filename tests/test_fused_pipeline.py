@@ -108,15 +108,16 @@ def _unfused_reference_solve(opt, state, topo, options):
                 st, ca = goals[i].optimize_cached(st, cx, goals[:i], ca)
             finally:
                 goals_base.set_round_sink(None)
-            rounds = sum(sink) if sink else jnp.zeros((), jnp.int32)
+            rounds, _ = goals_base.collapse_sink(sink)
             return st, ca, rounds
         return jax.jit(fn)
 
-    seg = max(1, opt.pipeline_segment_size)
     own, rounds, regressed = {}, {}, []
     prev_stats = stats_before
-    for start in range(0, len(goals), seg):
-        stop = min(start + seg, len(goals))
+    # the SAME segment plan as the fused pipeline (fusion megaprograms
+    # included): the float-refresh cadence at segment entry is part of
+    # the numerics being pinned
+    for start, stop in opt._plan_segments():
         cache = jax.jit(refresh_float_aggregates)(state, cache)
         for i in range(start, stop):
             state, cache, r_dev = goal_step(i)(state, cache, ctx)

@@ -116,7 +116,7 @@ def test_sweep_mean_mode_contracts_leader_imbalance():
         a = jnp.sum(W * alive) / jnp.maximum(jnp.sum(alive), 1)
         return jnp.full((st.num_brokers,), jnp.ceil(a * 1.09) + 1)
 
-    out, rounds, _ = global_leadership_sweep(
+    out, rounds, _, _ = global_leadership_sweep(
         state, ctx, [],
         measure=lambda c: c.leader_count.astype(jnp.float32),
         value_r=jnp.ones(state.num_replicas, jnp.float32),
@@ -139,7 +139,7 @@ def test_sweep_limit_mode_respects_hard_cap():
     limit = jnp.asarray(np.quantile(W0, 0.7) * np.ones(state.num_brokers,
                                                        np.float32))
     mid = limit * 0.8
-    out, rounds, _ = global_leadership_sweep(
+    out, rounds, _, _ = global_leadership_sweep(
         state, ctx, [],
         measure=lambda c: c.broker_load[:, res],
         value_r=(state.partition_leader_bonus[
@@ -173,7 +173,7 @@ def test_sweep_single_commit_fallback_for_opaque_prior_goal():
     def upper_of(st, W):
         return jnp.full((st.num_brokers,), jnp.inf)
 
-    out, rounds, _ = global_leadership_sweep(
+    out, rounds, _, _ = global_leadership_sweep(
         state, ctx, [_OpaqueLeadershipGoal()],
         measure=lambda c: c.leader_count.astype(jnp.float32),
         value_r=jnp.ones(state.num_replicas, jnp.float32),

@@ -25,6 +25,12 @@
     docs/OPERATIONS.md — an injection point nobody scripts is dead
     chaos coverage, and one operators cannot read about is a prod
     footgun.
+  * D330/D331 — goal fusion groups.  `analyzer/fusion.
+    GOAL_FUSION_GROUPS` and `goals/registry.GOAL_CLASSES` must cover
+    each other exactly: a registered goal in no group silently falls
+    back to width-chunking under solver.fusion.enabled (D330); a group
+    member that is not a registered goal — or sits in two groups — can
+    never match a stack (D331).
 """
 from __future__ import annotations
 
@@ -320,9 +326,90 @@ def _fault_rules(project: Project, root: Path) -> List[Finding]:
     return findings
 
 
+# ----------------------------------------------------------------------
+# goal registry <-> fusion groups
+# ----------------------------------------------------------------------
+
+def _assigned_dict(tree, name: str):
+    """The ast.Dict literal assigned to `name` at module level, or
+    None."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in targets):
+            continue
+        value = node.value
+        if isinstance(value, ast.Dict):
+            return value
+    return None
+
+
+def _fusion_rules(project: Project) -> List[Finding]:
+    """D330/D331: analyzer/fusion.GOAL_FUSION_GROUPS and
+    goals/registry.GOAL_CLASSES must cover each other exactly.  A
+    registered goal in no fusion group silently falls back to
+    width-chunking (the megaprogram never forms); a group member not in
+    the registry is a typo that can never match a stack.  Checked over
+    the AST (the analyzer never imports the analyzed package)."""
+    registry = fusion = None
+    for mod in project.files:
+        if mod.rel == "analyzer/goals/registry.py" and mod.tree:
+            registry = mod
+        elif mod.rel == "analyzer/fusion.py" and mod.tree:
+            fusion = mod
+    if registry is None or fusion is None:
+        return []
+    reg_dict = _assigned_dict(registry.tree, "GOAL_CLASSES")
+    grp_dict = _assigned_dict(fusion.tree, "GOAL_FUSION_GROUPS")
+    if reg_dict is None or grp_dict is None:
+        return []
+    registered: Dict[str, int] = {}
+    for k in reg_dict.keys:
+        name = _const_str(k)
+        if name is not None:
+            registered[name] = k.lineno
+    grouped: Dict[str, Tuple[str, int]] = {}
+    findings: List[Finding] = []
+    for group_key, members in zip(grp_dict.keys, grp_dict.values):
+        group = _const_str(group_key) or "?"
+        if not isinstance(members, (ast.List, ast.Tuple)):
+            continue
+        for elt in members.elts:
+            name = _const_str(elt)
+            if name is None:
+                continue
+            if name in grouped:
+                findings.append(Finding(
+                    "D331", str(fusion.path), elt.lineno,
+                    f"goal '{name}' appears in fusion groups "
+                    f"'{grouped[name][0]}' and '{group}' — a goal "
+                    f"fuses under exactly one group [D331]"))
+                continue
+            grouped[name] = (group, elt.lineno)
+            if name not in registered:
+                findings.append(Finding(
+                    "D331", str(fusion.path), elt.lineno,
+                    f"fusion group '{group}' names '{name}' which is "
+                    f"not in goals/registry.GOAL_CLASSES — a typo here "
+                    f"never matches a goal stack [D331]"))
+    for name, line in sorted(registered.items()):
+        if name not in grouped:
+            findings.append(Finding(
+                "D330", str(registry.path), line,
+                f"registered goal '{name}' belongs to no "
+                f"analyzer/fusion.GOAL_FUSION_GROUPS entry — with "
+                f"solver.fusion.enabled it silently falls back to "
+                f"width-chunking; add it to a group [D330]"))
+    return findings
+
+
 def run(project: Project, root: Path) -> List[Finding]:
     findings: List[Finding] = []
     findings.extend(_config_rules(project, root))
     findings.extend(_sensor_rules(project))
     findings.extend(_fault_rules(project, root))
+    findings.extend(_fusion_rules(project))
     return findings

@@ -1,0 +1,103 @@
+"""`make bench-smoke`: a minutes-not-hours dispatch-budget gate.
+
+Runs the fused goal pipeline (solver.fusion.enabled semantics:
+analyzer/fusion.py megaprograms + the device-side convergence
+early-exit) on a tiny CPU-sized cluster, then ASSERTS the ISSUE 16
+dispatch economics on the warm solve:
+
+  * watched device dispatches per solve <= len(fusion plan) + 2
+    (pre + one per megaprogram + post — parallel/health.py counter);
+  * at least 2x below the eager per-goal driver's 2 + 2G budget;
+  * every fused `__seg_{start}_{stop}__` program actually dispatched;
+  * the fused result carries the converged-at instrument for every goal.
+
+Exit 0 = all gates hold (one JSON summary line on stdout); exit 1 with
+the violated gate on stderr otherwise.  Geometry via SMOKE_BROKERS /
+SMOKE_PARTITIONS / SMOKE_ROUNDS; default is small enough for a CI CPU
+(~a minute of compiles, seconds of solve).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    t_start = time.time()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax  # noqa: F401  (initialize before the package imports)
+
+    from cruise_control_tpu.analyzer.context import OptimizationOptions
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.parallel import health
+    from cruise_control_tpu.testing.random_cluster import (
+        RandomClusterSpec, random_cluster)
+
+    num_b = int(os.environ.get("SMOKE_BROKERS", 12))
+    num_p = int(os.environ.get("SMOKE_PARTITIONS", 240))
+    rounds = int(os.environ.get("SMOKE_ROUNDS", 24))
+    names = ["RackAwareGoal", "DiskCapacityGoal",
+             "ReplicaDistributionGoal", "DiskUsageDistributionGoal",
+             "LeaderReplicaDistributionGoal",
+             "LeaderBytesInDistributionGoal"]
+    state, topo = random_cluster(RandomClusterSpec(
+        num_brokers=num_b, num_partitions=num_p, replication_factor=2,
+        num_racks=4, num_topics=4, seed=7, skew_fraction=0.2))
+    opt = GoalOptimizer(default_goals(max_rounds=rounds, names=names),
+                        pipeline_segment_size=2, fused_segments=True)
+    plan = opt._plan_segments()
+    options = OptimizationOptions()
+
+    t0 = time.time()
+    opt.warmup(state, topo, options)
+    opt.optimizations(state, topo, options, check_sanity=False)
+    warm_s = time.time() - t0
+
+    before = health.dispatch_count()
+    t0 = time.time()
+    result = opt.optimizations(state, topo, options, check_sanity=False)
+    solve_s = time.time() - t0
+    used = health.dispatch_count() - before
+    budget = len(plan) + 2
+    eager_cost = 2 + 2 * len(names)
+    by_prog = health.dispatches_by_program()
+
+    failures = []
+    if not 0 < used <= budget:
+        failures.append(f"dispatches {used} outside (0, {budget}] "
+                        f"(plan {plan})")
+    if eager_cost < 2 * used:
+        failures.append(f"dispatches {used} not >=2x below the eager "
+                        f"driver's {eager_cost}")
+    for start, stop in plan:
+        if by_prog.get(f"__seg_{start}_{stop}__", 0) < 1:
+            failures.append(f"megaprogram __seg_{start}_{stop}__ never "
+                            f"dispatched")
+    conv = getattr(result, "converged_at_by_goal", {}) or {}
+    if set(conv) != set(names):
+        failures.append(f"converged-at instrument incomplete: "
+                        f"{sorted(conv)} != {sorted(names)}")
+
+    print(json.dumps({
+        "metric": f"bench-smoke dispatch budget {num_b}b/{num_p}p",
+        "dispatches": used,
+        "budget": budget,
+        "eager_dispatches": eager_cost,
+        "plan": [list(p) for p in plan],
+        "warmup_s": round(warm_s, 2),
+        "solve_s": round(solve_s, 3),
+        "total_s": round(time.time() - t_start, 2),
+        "converged_at_by_goal": {g: int(c) for g, c in conv.items()},
+        "ok": not failures,
+    }))
+    for f in failures:
+        print(f"# bench-smoke GATE FAILED: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
