@@ -23,6 +23,23 @@ BENCH_CONFIG selects a BASELINE.json eval config:
                    "scenario" block; value = per-scenario solve seconds
                    at the largest K, vs_baseline = K=1-per-scenario /
                    largest-K-per-scenario, >1 = batching wins)
+  portfolio        device-parallel portfolio search (portfolio/): for
+                   each K in BENCH_PORTFOLIO_KS (default 1,8,32) builds
+                   a seeded K-candidate perturbation portfolio over the
+                   greedy solve's goal stack (mutate.py) and solves ALL
+                   lanes in one batched FUSED pass (engine.py), vs the
+                   single greedy GoalOptimizer solve on the same pinned
+                   48b/1.5Kp fixture.  EXITS 1 unless the K=1 portfolio
+                   is byte-identical to greedy (the identity pin), the
+                   winner is never worse than greedy at every K, and
+                   the errors are clean (the output JSON carries a
+                   "portfolio" block; value = best balancedness gain
+                   over greedy at K>=8, vs_baseline = winner fitness /
+                   greedy fitness at the largest K, >1 = the
+                   population beats the single solver).  Knobs:
+                   BENCH_PORTFOLIO_SEED, BENCH_PORTFOLIO_WEIGHT
+                   (movement-cost weight), BENCH_PORTFOLIO_PROGRAMS
+                   (max distinct goal orders per portfolio)
   fleet            shape-bucketed fleet serving (fleet/buckets.py):
                    K = BENCH_FLEET_TENANTS (default 1,4,16) tenants with
                    DIFFERENT broker counts inside one power-of-two
@@ -309,6 +326,8 @@ def main() -> None:
         return _soak_bench()
     if config == "scenario":
         return _scenario_bench()
+    if config == "portfolio":
+        return _portfolio_bench()
     if config == "sched":
         return _sched_bench()
     if config == "fleet":
@@ -1267,6 +1286,192 @@ def _scenario_bench() -> None:
         "vs_baseline": round(per_one / per_max, 3) if per_max else 0.0,
         "scenario": results,
     })))
+
+
+def _portfolio_bench() -> None:
+    """BENCH_CONFIG=portfolio: MEASURE the population-of-solvers claim
+    (ISSUE 19) — K perturbed solver configs batched into one vmapped
+    solve vs the single greedy ladder, on the pinned bench fixture.
+
+    Per width K (BENCH_PORTFOLIO_KS, default 1,8,32) the portfolio runs
+    TWICE (cold pays the per-trace-group compiles, warm is the measured
+    pass) and records the winner's balancedness, movement cost and
+    fitness against the greedy baseline solve.  EXITS 1 when
+    (a) any portfolio winner's fitness is below greedy's — the
+    winner-never-worse invariant — or (b) the K=1 identity candidate is
+    not byte-identical to the greedy solve (same proposals, same
+    balancedness, same movement counts)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(os.environ[
+                          "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+
+    import numpy as np
+
+    from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                     OptimizationOptions)
+    from cruise_control_tpu.analyzer.goals.registry import default_goals
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.portfolio.engine import PortfolioEngine
+    from cruise_control_tpu.portfolio.mutate import make_portfolio
+    from cruise_control_tpu.scenario.engine import ScenarioEngine
+
+    num_b = int(os.environ.get("BENCH_BROKERS", 48))
+    num_p = int(os.environ.get("BENCH_PARTITIONS", 1500))
+    rf = int(os.environ.get("BENCH_RF", 3))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 48))
+    seed = int(os.environ.get("BENCH_PORTFOLIO_SEED", 19))
+    weight = float(os.environ.get("BENCH_PORTFOLIO_WEIGHT", "1.0"))
+    max_programs = int(os.environ.get("BENCH_PORTFOLIO_PROGRAMS", 4))
+    widths = [int(k) for k in os.environ.get(
+        "BENCH_PORTFOLIO_KS", "1,8,32").split(",") if k.strip()]
+    goal_env = os.environ.get("BENCH_GOALS")
+    names = goal_env.split(",") if goal_env else None
+
+    backend = jax.devices()[0].platform
+    state, topo = _build("portfolio", num_b, num_p, rf)
+    segment = int(os.environ.get("BENCH_SEGMENT", 2))
+    constraint = BalancingConstraint()
+    goals = default_goals(max_rounds=rounds, names=names)
+    base_order = [g.name for g in goals]
+    optimizer = GoalOptimizer(goals, constraint,
+                              pipeline_segment_size=segment)
+
+    def factory(g):
+        if g is None or list(g) == base_order:
+            return optimizer
+        return GoalOptimizer(default_goals(max_rounds=rounds,
+                                           names=list(g)),
+                             constraint, pipeline_segment_size=segment)
+
+    scenario = ScenarioEngine(factory, constraint,
+                              max_batch_size=max(widths))
+    engine = PortfolioEngine(scenario, factory, constraint=constraint,
+                             movement_cost_weight=weight)
+
+    print(f"# portfolio bench: B={state.num_brokers} "
+          f"P={state.num_partitions} R={state.num_replicas} "
+          f"goals={len(base_order)} widths={widths} seed={seed} "
+          f"weight={weight} max_programs={max_programs} [{backend}]",
+          file=sys.stderr)
+
+    t0 = time.time()
+    greedy = optimizer.optimizations(state, topo, OptimizationOptions(),
+                                     check_sanity=False)
+    greedy_s = time.time() - t0
+    with jax.transfer_guard_device_to_host("allow"):
+        num_replicas = int(np.asarray(state.replica_valid).sum())
+    greedy_bal = greedy.balancedness_score()
+    greedy_fit = engine.greedy_fitness(greedy, num_replicas)
+    greedy_moves = (greedy.num_replica_movements,
+                    greedy.num_leadership_movements)
+    print(f"# greedy: balancedness {greedy_bal:.4f} fitness "
+          f"{greedy_fit:.4f} moves {greedy_moves} ({greedy_s:.1f}s, "
+          f"includes compile)", file=sys.stderr)
+
+    errors = []
+    results = {}
+    k1_identical = None
+    for k in widths:
+        cands = make_portfolio(base_order, seed, k,
+                               max_programs=max_programs)
+        t0 = time.time()
+        engine.search(state, topo, cands, seed,
+                      options=OptimizationOptions())
+        cold_s = time.time() - t0
+        from cruise_control_tpu.obs import trace as obs_trace
+        with obs_trace.solve_trace("bench.portfolio", k=k):
+            t0 = time.time()
+            res = engine.search(state, topo, cands, seed,
+                                options=OptimizationOptions())
+            warm_s = time.time() - t0
+        w = res.winner
+        if w is None or not w.feasible:
+            errors.append(f"K={k}: no feasible portfolio winner")
+            continue
+        w_out = w.outcome
+        w_bal = (w_out.balancedness if w_out is not None
+                 else w.result.balancedness_score())
+        # count moves by the proposal definitions (same as the greedy
+        # OptimizerResult properties), not the device move epilogue —
+        # apples to apples with greedy_moves
+        w_props = (w_out.proposals if w_out is not None
+                   else w.result.proposals)
+        w_moves = (sum(len(p.replicas_to_add) for p in w_props),
+                   sum(1 for p in w_props
+                       if p.has_leader_action
+                       and not p.has_replica_action))
+        if w.fitness < greedy_fit - 1e-9:
+            errors.append(f"K={k}: winner fitness {w.fitness:.4f} worse "
+                          f"than greedy {greedy_fit:.4f}")
+        if k == 1:
+            # the identity candidate must reproduce the greedy solve
+            # byte for byte: same balancedness, moves, proposals
+            same_props = ([repr(p) for p in w_props]
+                          == [repr(p) for p in greedy.proposals])
+            k1_identical = (abs(w_bal - greedy_bal) < 1e-9
+                            and w_moves == greedy_moves and same_props)
+            if not k1_identical:
+                errors.append(
+                    f"K=1 identity not byte-identical: balancedness "
+                    f"{w_bal:.6f} vs {greedy_bal:.6f}, moves {w_moves} "
+                    f"vs {greedy_moves}, proposals_equal={same_props}")
+        results[str(k)] = {
+            "rung": res.rung,
+            "cold_search_s": round(cold_s, 3),
+            "warm_search_s": round(warm_s, 3),
+            "per_candidate_s": round(warm_s / k, 4),
+            "winner_index": w.candidate.index,
+            "winner_perturbation": w.candidate.description,
+            "winner_balancedness": round(w_bal, 4),
+            "winner_fitness": round(w.fitness, 4),
+            "winner_moves": list(w_moves),
+            "balancedness_gain": round(w_bal - greedy_bal, 4),
+            "fitness_gain": round(w.fitness - greedy_fit, 4),
+        }
+        print(f"# K={k}: winner idx {w.candidate.index} balancedness "
+              f"{w_bal:.4f} (greedy {greedy_bal:.4f}) fitness "
+              f"{w.fitness:.4f} moves {w_moves} rung={res.rung} warm "
+              f"{warm_s:.1f}s", file=sys.stderr)
+
+    wide = [results[str(k)] for k in widths
+            if k >= 8 and str(k) in results]
+    improved_at_8plus = bool(wide) and any(
+        e["balancedness_gain"] > 0 for e in wide)
+    best_gain = max((e["balancedness_gain"] for e in wide), default=0.0)
+    k_max = str(max(widths))
+    print(json.dumps(_with_trace_summary({
+        "metric": (f"portfolio best-vs-greedy balancedness gain "
+                   f"K={k_max} {state.num_brokers}b/"
+                   f"{state.num_partitions/1000:g}Kp rf{rf} [{backend}]"),
+        "value": best_gain,
+        "unit": "balancedness",
+        # the plateau metric: winner fitness / greedy fitness at the
+        # widest portfolio (>1 = the population beat the single ladder)
+        "vs_baseline": (round(results[k_max]["winner_fitness"]
+                              / greedy_fit, 4)
+                        if k_max in results and greedy_fit else 0.0),
+        "config": (f"BENCH_CONFIG=portfolio {state.num_brokers}b/"
+                   f"{state.num_partitions/1000:g}Kp rf{rf} "
+                   f"rounds={rounds} seed={seed} weight={weight} "
+                   f"max_programs={max_programs}"),
+        "greedy": {"balancedness": round(greedy_bal, 4),
+                   "fitness": round(greedy_fit, 4),
+                   "moves": list(greedy_moves),
+                   "solve_s": round(greedy_s, 3)},
+        "portfolio": results,
+        "k1_identical": k1_identical,
+        "never_worse": not any("worse" in e for e in errors),
+        "improved_at_k8plus": improved_at_8plus,
+        "engine": engine.to_json(),
+    })))
+    if errors:
+        for e in errors:
+            print(f"# ERROR: {e}", file=sys.stderr)
+        sys.exit(1)
 
 
 def _fleet_bench() -> None:

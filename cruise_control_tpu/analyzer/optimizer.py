@@ -224,6 +224,12 @@ class OptimizerResult:
     #: goal.balancedness.strictness.weight, GoalOptimizer.java:121-122;
     #: defaults match AnalyzerConfig 1.1 / 1.5)
     balancedness_weights: Tuple[float, float] = (1.1, 1.5)
+    #: which solver produced this result (portfolio/): None for a plain
+    #: greedy solve with no portfolio in play (responses omit the block);
+    #: otherwise the solverProvenance dict — solver greedy|portfolio,
+    #: portfolio seed, winning candidate index + perturbation, fitness of
+    #: both contenders, model generation searched
+    solver_provenance: Optional[dict] = None
 
     def balancedness_score(self) -> float:
         """[0, 100] gauge: 100 minus the summed rank-weighted cost of the

@@ -23,7 +23,7 @@ VALID_PARAMS: Dict[str, Set[str]] = {
     "PARTITION_LOAD": {"resource", "entries", "topic", "min_valid_partition_ratio",
                        "max_load", "json"},
     "PROPOSALS": {"goals", "ignore_proposal_cache", "verbose",
-                  "excluded_topics", "json"},
+                  "excluded_topics", "portfolio_width", "json"},
     "KAFKA_CLUSTER_STATE": {"verbose", "json"},
     "USER_TASKS": {"user_task_ids", "json"},
     "REVIEW_BOARD": {"review_ids", "json"},
@@ -34,7 +34,7 @@ VALID_PARAMS: Dict[str, Set[str]] = {
                   "concurrent_leader_movements", "json", "reason",
                   "ignore_proposal_cache", "destination_broker_ids",
                   "replication_throttle", "replica_movement_strategies",
-                  "kafka_assigner", "review_id"},
+                  "kafka_assigner", "portfolio_width", "review_id"},
     "ADD_BROKER": {"brokerid", "goals", "dryrun", "verbose", "json",
                    "reason", "throttle_added_broker",
                    "replication_throttle", "review_id"},

@@ -190,6 +190,11 @@ def optimization_result(result: OptimizerResult,
         "violatedGoalsBefore": result.violated_goals_before,
         "violatedGoalsAfter": result.violated_goals_after,
     }
+    if result.solver_provenance is not None:
+        # which solver actually produced this answer (portfolio/):
+        # absent entirely for a plain greedy solve with no portfolio in
+        # play, keeping pre-portfolio response bodies byte-identical
+        out["solverProvenance"] = dict(result.solver_provenance)
     if verbose:
         out["proposals"] = [p.to_json() for p in result.proposals]
     return out

@@ -70,6 +70,22 @@ _PROPOSAL = _obj({
     "newReplicas": _arr(_INT),
 }, required=["topicPartition", "newReplicas"])
 
+#: which solver produced an optimization result (portfolio/): absent
+#: entirely for a plain greedy solve with no portfolio in play, so
+#: pre-portfolio response bodies stay byte-identical
+_SOLVER_PROVENANCE = _obj({
+    "solver": {"enum": ["greedy", "portfolio"]},
+    "portfolioWidth": _INT,
+    "portfolioSeed": _INT,
+    "generation": {},
+    "rung": {"enum": ["FUSED", "EAGER", "CPU"]},
+    "candidateIndex": _INT,
+    "perturbation": _STR,
+    "greedyFitness": _NUM,
+    "bestCandidateFitness": {"type": ["number", "null"]},
+    "error": _STR,
+}, required=["solver", "portfolioWidth", "portfolioSeed"])
+
 OPTIMIZATION_RESULT = _obj({
     "summary": _obj({
         "numReplicaMovements": _INT,
@@ -87,6 +103,7 @@ OPTIMIZATION_RESULT = _obj({
     }, required=["goal", "status"])),
     "violatedGoalsBefore": _arr(_STR),
     "violatedGoalsAfter": _arr(_STR),
+    "solverProvenance": _SOLVER_PROVENANCE,
     "proposals": _arr(_PROPOSAL),
 }, required=["summary", "goalSummary"])
 
@@ -157,6 +174,7 @@ STATE = _obj({
     "ExecutorState": _obj({"recovery": _EXECUTOR_RECOVERY}, extra=True),
     "AnalyzerState": _obj({}, extra=True),
     "AnomalyDetectorState": _obj({}, extra=True),
+    "PortfolioState": _obj({}, extra=True),
     "SchedulerState": _obj({}, extra=True),
     "FleetState": _obj({}, extra=True),
     "IncrementalStoreState": _obj({}, extra=True),
@@ -211,6 +229,7 @@ _USER_TASK = _obj({
                                 "PRECOMPUTE", "SCENARIO_SWEEP"]},
     "QueuePosition": {"type": "integer", "minimum": 0},
     "EstimatedStartMs": _NUM,
+    "SolverProvenance": _SOLVER_PROVENANCE,
 }, required=["UserTaskId", "Status"])
 
 USER_TASKS = _obj({

@@ -571,12 +571,14 @@ class CruiseControlApp:
             verbose = params.get_bool("verbose")
             ignore_cache = params.get_bool("ignore_proposal_cache")
             excluded = params.get_csv("excluded_topics")
+            portfolio_width = params.get_int("portfolio_width")
             options = (OptimizationOptions(
                 excluded_topics=frozenset(excluded)) if excluded else None)
 
             def proposals_op() -> dict:
                 result = cc.optimizations(goals, options,
-                                          ignore_proposal_cache=ignore_cache)
+                                          ignore_proposal_cache=ignore_cache,
+                                          portfolio_width=portfolio_width)
                 return R.optimization_result(result, verbose=verbose)
             return proposals_op
 
@@ -680,6 +682,8 @@ class CruiseControlApp:
                                       "ignore_proposal_cache"),
                                   kafka_assigner=params.get_bool(
                                       "kafka_assigner"),
+                                  portfolio_width=params.get_int(
+                                      "portfolio_width"),
                                   **exec_kwargs)
             elif endpoint == "ADD_BROKER":
                 op = cc.add_brokers(broker_ids, goals=goals, dryrun=dryrun,

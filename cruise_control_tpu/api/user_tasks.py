@@ -90,6 +90,10 @@ class UserTaskInfo:
     #: same id the solve response body carries as `traceId`, so a
     #: USER_TASKS listing links straight into TRACES
     trace_id: str = ""
+    #: which solver produced the completed result (portfolio/): the
+    #: response body's solverProvenance block, lifted so a USER_TASKS
+    #: listing shows portfolio wins without fetching each result
+    solver_provenance: Optional[dict] = None
 
     def to_json(self) -> dict:
         out = {
@@ -102,6 +106,8 @@ class UserTaskInfo:
         }
         if self.trace_id:
             out["TraceId"] = self.trace_id
+        if self.solver_provenance is not None:
+            out["SolverProvenance"] = dict(self.solver_provenance)
         if self.body_hash:
             out["RequestBodySha"] = self.body_hash
         if self.result_bytes is not None:
@@ -270,12 +276,16 @@ class UserTaskManager:
                 result: Any = None) -> None:
         size = (self._result_size_bytes(result)
                 if status is TaskStatus.COMPLETED else None)
+        provenance = (result.get("solverProvenance")
+                      if isinstance(result, dict) else None)
         with self._lock:
             info = self._tasks.get(task_id)
             if info is not None:
                 info.status = status
                 info.end_ms = self._time() * 1000.0
                 info.result_bytes = size
+                if provenance is not None:
+                    info.solver_provenance = provenance
 
     def _retention_for(self, endpoint: str) -> float:
         cat = ENDPOINT_CATEGORY.get(endpoint)

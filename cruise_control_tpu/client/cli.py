@@ -64,6 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--goals", type=_csv)
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--ignore-proposal-cache", action="store_true")
+    p.add_argument("--portfolio-width", type=int, metavar="K",
+                   help="search K perturbed solver candidates in one "
+                        "batched device solve and answer with the "
+                        "best-by-fitness winner (portfolio/); the "
+                        "response's solverProvenance says which solver "
+                        "won (server default when omitted)")
 
     add("kafka_cluster_state", help="raw cluster metadata")
     add("user_tasks", help="async task history")
@@ -86,6 +92,10 @@ def build_parser() -> argparse.ArgumentParser:
         if name in ("rebalance", "add_broker", "remove_broker",
                     "fix_offline_replicas"):
             p.add_argument("--goals", type=_csv)
+        if name == "rebalance":
+            p.add_argument("--portfolio-width", type=int, metavar="K",
+                           help="device-parallel portfolio search width "
+                                "(see `proposals --portfolio-width`)")
         p.add_argument("--verbose", action="store_true")
         p.add_argument("--reason")
         p.add_argument("--review-id", type=int)
@@ -149,6 +159,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     add("slo", help="per-class SLO burn status (STATE sloStatus: burn "
                     "rate, queue-wait vs device-time, budget remaining)")
+
+    add("portfolio", help="portfolio-search status (STATE "
+                          "PortfolioState: width/seed, ladder rung, "
+                          "improvement/stale-drop counters, "
+                          "portfolio-vs-greedy fitness gap)")
 
     p = add("loadgen",
             help="trace-replay load harness (cruise_control_tpu/"
@@ -252,7 +267,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                         args.topic)
         elif cmd == "proposals":
             out = client.proposals(args.goals, args.verbose,
-                                   args.ignore_proposal_cache)
+                                   args.ignore_proposal_cache,
+                                   portfolio_width=args.portfolio_width)
         elif cmd == "kafka_cluster_state":
             out = client.kafka_cluster_state()
         elif cmd == "user_tasks":
@@ -272,6 +288,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.review_id is not None:
                 params["review_id"] = args.review_id
             if cmd == "rebalance":
+                if args.portfolio_width is not None:
+                    params["portfolio_width"] = args.portfolio_width
                 out = client.rebalance(**params)
             elif cmd == "fix_offline_replicas":
                 out = client.fix_offline_replicas(**params)
@@ -333,6 +351,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 0
         elif cmd == "slo":
             out = client.slo_status()
+        elif cmd == "portfolio":
+            out = client.portfolio_status()
         elif cmd == "loadgen":
             return _run_loadgen(args, auth)
         else:  # pragma: no cover

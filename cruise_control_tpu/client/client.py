@@ -269,10 +269,12 @@ class CruiseControlClient:
 
     def proposals(self, goals: Optional[Sequence[str]] = None,
                   verbose: bool = False,
-                  ignore_proposal_cache: bool = False) -> dict:
+                  ignore_proposal_cache: bool = False,
+                  portfolio_width: Optional[int] = None) -> dict:
         return self.request("PROPOSALS", {
             "goals": goals, "verbose": verbose,
-            "ignore_proposal_cache": ignore_proposal_cache})
+            "ignore_proposal_cache": ignore_proposal_cache,
+            "portfolio_width": portfolio_width})
 
     def kafka_cluster_state(self) -> dict:
         return self.request("KAFKA_CLUSTER_STATE")
@@ -359,6 +361,14 @@ class CruiseControlClient:
         `sloStatus` substate — burn rate, queue-wait vs device-time
         decomposition and budget remaining per scheduler class."""
         return self.state(substates=["slo"]).get("sloStatus", {})
+
+    def portfolio_status(self) -> dict:
+        """The portfolio-search block (portfolio/): STATE's `portfolio`
+        substate — width/seed config, search + ladder telemetry, the
+        improvement/stale-drop counters and the portfolio-vs-greedy
+        fitness gap."""
+        return self.state(substates=["portfolio"]).get(
+            "PortfolioState", {})
 
     def metrics_text(self) -> str:
         """The raw OpenMetrics page (`/metrics`) — what a Prometheus

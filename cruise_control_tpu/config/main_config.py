@@ -317,6 +317,58 @@ def analyzer_config_def(d: ConfigDef) -> ConfigDef:
     d.define("scenario.include.base.solve", Type.BOOLEAN, True, None, _L,
              "Prepend a no-op base scenario to every SCENARIOS batch so "
              "the report diffs each what-if against doing nothing.")
+    d.define("portfolio.width", Type.INT, 1, in_range(min_value=1), _M,
+             "Candidates per device-parallel portfolio search on the "
+             "proposals/rebalance path (portfolio/): K perturbed solver "
+             "candidates (goal-order shuffles, balance-threshold jitter, "
+             "tie-break salts) ride one batched device solve and the "
+             "best-by-fitness winner is served when STRICTLY better than "
+             "greedy.  1 (default) disables the portfolio entirely — the "
+             "greedy path stays byte-identical.")
+    d.define("portfolio.seed", Type.INT, 0, None, _L,
+             "Base seed for the candidate-perturbation streams; every "
+             "candidate is a pure function of (base config, this seed, "
+             "candidate index), so equal seeds replay bit-for-bit.")
+    d.define("portfolio.movement.cost.weight", Type.DOUBLE, 4.0,
+             in_range(min_value=0.0), _L,
+             "Fitness = balancedness - weight x normalized movement "
+             "(replica moves + 0.5 x leadership moves, per replica): how "
+             "many balancedness points one cluster's-worth of movement "
+             "costs a candidate.  0 ranks on balancedness alone.")
+    d.define("portfolio.max.programs", Type.INT, 4,
+             in_range(min_value=1), _L,
+             "Distinct (goal order, fast-mode) trace programs a "
+             "portfolio may compile; candidates beyond this share the "
+             "pooled orders and differ only in batchable perturbations "
+             "(thresholds, salts), keeping compile cost bounded while "
+             "the width scales.")
+    d.define("portfolio.max.eager.candidates", Type.INT, 4,
+             in_range(min_value=1), _L,
+             "Candidate budget at the portfolio's degraded EAGER rung "
+             "(sequential per-candidate solves after a fused-batch "
+             "failure); candidates beyond the budget are skipped.")
+    d.define("portfolio.background.enabled", Type.BOOLEAN, False, None,
+             _M,
+             "Run the background refinement job: a SCENARIO_SWEEP-class "
+             "loop that keeps searching for a better-than-cached "
+             "proposal and installs winners through the compare-and-swap "
+             "cache gate (stale generations dropped, never clobbering a "
+             "fresher precompute).")
+    d.define("portfolio.background.interval.ms", Type.LONG, 300000,
+             in_range(min_value=1000), _L,
+             "Delay between background refinement sweeps; failures back "
+             "off exponentially (capped at 32 intervals) like the "
+             "precompute loop.")
+    d.define("portfolio.background.width", Type.INT, 8,
+             in_range(min_value=2), _L,
+             "Candidates per background refinement sweep (independent "
+             "of the request-path portfolio.width).")
+    d.define("portfolio.background.generations", Type.INT, 1,
+             in_range(min_value=1), _L,
+             "Evolutionary generations per background sweep: 1 is a "
+             "one-shot search; >1 breeds each next population from the "
+             "elite half (truncation selection + tier-respecting "
+             "goal-order crossover + mutation).")
     d.define("scheduler.enabled", Type.BOOLEAN, True, None, _M,
              "Route every device solve (REST operations, proposal "
              "precompute, anomaly self-healing, scenario sweeps) through "

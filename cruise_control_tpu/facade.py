@@ -216,6 +216,15 @@ class CruiseControl:
                  scenario_max_batch_size: int = 32,
                  scenario_max_oom_halvings: int = 4,
                  scenario_include_base: bool = True,
+                 portfolio_width: int = 1,
+                 portfolio_seed: int = 0,
+                 portfolio_movement_cost_weight: float = 4.0,
+                 portfolio_max_programs: int = 4,
+                 portfolio_max_eager_candidates: int = 4,
+                 portfolio_background_enabled: bool = False,
+                 portfolio_background_interval_s: float = 300.0,
+                 portfolio_background_width: int = 8,
+                 portfolio_background_generations: int = 1,
                  scheduler_enabled: bool = True,
                  scheduler_preemption_enabled: bool = True,
                  scheduler_class_weights: Optional[Sequence[float]] = None,
@@ -497,6 +506,41 @@ class CruiseControl:
             balancedness_weights=balancedness_weights,
             time_fn=self._time)
 
+        # device-parallel portfolio search (portfolio/): K perturbed
+        # solver candidates ride the scenario engine's batched pipeline;
+        # the best-by-fitness winner replaces the greedy answer only
+        # when strictly better.  Width 1 disables the whole subsystem —
+        # the greedy path stays byte-identical.  The portfolio owns its
+        # OWN ladder (FUSED -> EAGER) so a failing search degrades the
+        # portfolio, never the request-path solver.
+        from cruise_control_tpu.portfolio.engine import PortfolioEngine
+        self._portfolio_width = max(1, int(portfolio_width))
+        self._portfolio_seed = int(portfolio_seed)
+        self._portfolio_max_programs = max(1, int(portfolio_max_programs))
+        self._portfolio_background_enabled = bool(
+            portfolio_background_enabled)
+        self._portfolio_background_interval_s = float(
+            portfolio_background_interval_s)
+        self._portfolio_background_width = max(
+            2, int(portfolio_background_width))
+        self._portfolio_background_generations = max(
+            1, int(portfolio_background_generations))
+        self.portfolio_engine = PortfolioEngine(
+            self.scenario_engine, self._optimizer_for,
+            constraint=self._constraint,
+            movement_cost_weight=portfolio_movement_cost_weight,
+            max_eager_candidates=portfolio_max_eager_candidates,
+            breaker_failure_threshold=solver_breaker_failure_threshold,
+            breaker_cooldown_s=solver_breaker_cooldown_s,
+            time_fn=self._time)
+        self._portfolio_improvements = 0
+        self._portfolio_stale_drops = 0
+        self._portfolio_background_sweeps = 0
+        self._portfolio_last_best_fitness: Optional[float] = None
+        self._portfolio_last_greedy_fitness: Optional[float] = None
+        self._portfolio_stop = threading.Event()
+        self._portfolio_thread: Optional[threading.Thread] = None
+
         # solve-mesh token (parallel/mesh.py): the device topology every
         # solve of this facade runs through.  An OWNED scheduler gets a
         # token built from the visible devices (mesh.enabled=auto turns
@@ -706,6 +750,25 @@ class CruiseControl:
                            lambda: self.scenario_engine.last_batch_size)
         self.metrics.gauge("scenario-rung",
                            lambda: int(self.scenario_engine.ladder.rung))
+        # portfolio-* sensors: the engine marks portfolio-descents and
+        # times portfolio-search-timer itself; the facade marks the
+        # lifecycle meters (generations / improvements / stale-drops) at
+        # event time and exports the fitness gauges so an operator can
+        # watch the portfolio-vs-greedy gap without pulling STATE
+        self.portfolio_engine.attach_metrics(self.metrics)
+        self.metrics.gauge("portfolio-candidates",
+                           lambda: float(self.portfolio_engine.last_width))
+        self.metrics.gauge("portfolio-rung",
+                           lambda: int(self.portfolio_engine.ladder.rung))
+        self.metrics.gauge(
+            "portfolio-fitness-best",
+            lambda: float(self._portfolio_last_best_fitness or 0.0))
+        self.metrics.gauge(
+            "portfolio-fitness-greedy",
+            lambda: float(self._portfolio_last_greedy_fitness or 0.0))
+        self.metrics.meter("portfolio-generations")
+        self.metrics.meter("portfolio-improvements")
+        self.metrics.meter("portfolio-stale-drops")
         # sched-* sensors: per-class queue depth/wait gauges,
         # device-busy-seconds, occupancy; the scheduler marks its own
         # coalesce/preempt/reject/fold meters as events happen.  A
@@ -766,6 +829,12 @@ class CruiseControl:
                 target=self._precompute_loop, name="proposal-precompute",
                 daemon=True)
             self._precompute_thread.start()
+        if self._portfolio_background_enabled:
+            self._portfolio_stop.clear()
+            self._portfolio_thread = threading.Thread(
+                target=self._portfolio_loop, name="portfolio-refine",
+                daemon=True)
+            self._portfolio_thread.start()
 
     def warm_programs_from_cache(self) -> int:
         """Hydrate this facade's default goal stack from the persistent
@@ -878,6 +947,7 @@ class CruiseControl:
 
     def shutdown(self) -> None:
         self._precompute_stop.set()
+        self._portfolio_stop.set()
         # stop the solve scheduler first: queued tickets fail fast (a
         # precompute pass blocked on one unblocks and sees the stop
         # event), and nothing new is admitted during teardown.  A fleet
@@ -908,6 +978,11 @@ class CruiseControl:
                     # race visible instead of silent
                     LOG.warning("proposal-precompute still running after "
                                 "5s join timeout; shutting down around it")
+        if self._portfolio_thread is not None:
+            self._portfolio_thread.join(timeout=5.0)
+            if self._portfolio_thread.is_alive():
+                LOG.warning("portfolio-refine still running after 5s join "
+                            "timeout; shutting down around it")
         self.anomaly_detector.shutdown()
         self.broker_failure_detector.shutdown()
         self.executor.stop_execution(force=True)
@@ -1020,6 +1095,120 @@ class CruiseControl:
                 consecutive_failures += 1
             else:
                 consecutive_failures = 0
+
+    # ------------------------------------------------------------------
+    # background portfolio refinement (portfolio/): a SCENARIO_SWEEP
+    # class job that keeps searching for a better-than-cached proposal
+    # and installs winners through the compare-and-swap cache gate
+    # ------------------------------------------------------------------
+    def portfolio_refine_once(self) -> str:
+        """One refinement pass; 'improved' when a winner landed in the
+        proposal cache, 'computed' when the search ran but found nothing
+        strictly better, 'stale' when the winner was dropped by the CAS
+        gate, 'skipped' / 'failed' as for the precompute pass."""
+        return self._portfolio_refine_once_status()
+
+    def _portfolio_refine_once_status(self) -> str:
+        if not self._monitor_ready():
+            return "skipped"
+        if self.executor.has_ongoing_execution:
+            return "skipped"
+        generation = self.load_monitor.model_generation()
+        with self._cache_lock:
+            baseline = (self._cached_result
+                        if self._cached_generation == generation else None)
+            epoch = self._cache_epoch
+        if baseline is None:
+            # nothing to refine against yet: the precompute loop owns
+            # warming the cache; refinement only ever IMPROVES it
+            return "skipped"
+        from cruise_control_tpu.portfolio.evolve import evolve
+        width = self._portfolio_background_width
+        # vary the seed by generation so repeated sweeps at one
+        # generation replay bit-for-bit while fresh models explore
+        # fresh perturbations
+        seed = self._portfolio_seed + self._generation_int(generation)
+
+        def run_sweep():
+            state, topo = self._model_for_solve()
+            state = self._fleet_pad(state)
+            gen_options = self._options_generator.generate(
+                OptimizationOptions(), topo)
+
+            def still_current(_gen) -> bool:
+                # staleness probe between generations: a sweep whose
+                # model moved stops breeding dead candidates
+                return (self.load_monitor.model_generation() == generation
+                        and not self._portfolio_stop.is_set())
+
+            res = evolve(self.portfolio_engine, state, topo,
+                         list(self._goal_names), seed=seed, width=width,
+                         generations=self._portfolio_background_generations,
+                         max_programs=self._portfolio_max_programs,
+                         options=gen_options,
+                         on_generation=still_current)
+            return state, res
+
+        try:
+            state, res = self._scheduled_solve(
+                SchedulerClass.SCENARIO_SWEEP, run_sweep,
+                coalesce_key=("portfolio-refine", self._coalesce_scope,
+                              generation),
+                label="portfolio-refine")
+        except Exception as exc:  # noqa: BLE001 - keep the loop alive
+            LOG.warning("portfolio refinement failed (%s): %s",
+                        classify_failure(exc).value, exc)
+            return "failed"
+        with self._cache_lock:
+            self._portfolio_background_sweeps += 1
+        if res.generations:
+            self.metrics.meter("portfolio-generations").mark(
+                res.generations)
+        winner = res.winner
+        if winner is None or not winner.feasible:
+            return "computed"
+        num_replicas = self._num_replicas(state)
+        baseline_fit = self.portfolio_engine.greedy_fitness(
+            baseline, num_replicas)
+        with self._cache_lock:
+            self._portfolio_last_greedy_fitness = baseline_fit
+            self._portfolio_last_best_fitness = max(winner.fitness,
+                                                    baseline_fit)
+        if winner.fitness <= baseline_fit:
+            return "computed"
+        improved = self._portfolio_to_result(winner, state, res.duration_s)
+        if improved is None:
+            return "computed"
+        improved.solver_provenance = {
+            "solver": "portfolio", "portfolioWidth": width,
+            "portfolioSeed": seed,
+            "generation": self._generation_json(generation),
+            "rung": res.rung, "candidateIndex": winner.candidate.index,
+            "perturbation": winner.candidate.description,
+            "greedyFitness": round(baseline_fit, 6),
+            "bestCandidateFitness": round(winner.fitness, 6)}
+        landed = self.install_portfolio_winner(
+            improved, generation, winner.fitness, num_replicas,
+            epoch=epoch)
+        return "improved" if landed else "stale"
+
+    def _portfolio_loop(self) -> None:
+        # NO immediate first pass (unlike precompute): refinement needs
+        # a warm cache baseline, which the precompute loop provides —
+        # the first interval lets startup solves land first
+        consecutive_failures = 0
+        while True:
+            delay = self._portfolio_background_interval_s * min(
+                2 ** consecutive_failures, 32)
+            if self._portfolio_stop.wait(delay):
+                return
+            try:
+                status = self._portfolio_refine_once_status()
+            except Exception:  # noqa: BLE001 - loop must survive
+                LOG.exception("portfolio refinement pass crashed")
+                status = "failed"
+            consecutive_failures = (consecutive_failures + 1
+                                    if status == "failed" else 0)
 
     # ------------------------------------------------------------------
     # detector wiring (self-healing fix runnables, SURVEY.md §3.5)
@@ -1198,6 +1387,7 @@ class CruiseControl:
                       goals: Optional[Sequence[str]] = None,
                       options: Optional[OptimizationOptions] = None,
                       ignore_proposal_cache: bool = False,
+                      portfolio_width: Optional[int] = None,
                       _allow_capacity_estimation: Optional[bool] = None,
                       _eager_hard_abort: Optional[bool] = None,
                       _scheduler_class: Optional[SchedulerClass] = None
@@ -1214,12 +1404,22 @@ class CruiseControl:
         identical concurrent requests coalesce into one compile+solve.
         `_scheduler_class` picks the priority class (default
         USER_INTERACTIVE; the precompute loop and the self-healing fix
-        paths pass their own)."""
+        paths pass their own).
+
+        `portfolio_width` > 1 runs the device-parallel portfolio search
+        (portfolio/) after the greedy solve and answers with the winner
+        when it is STRICTLY better by fitness; None inherits the
+        configured default width.  An explicit width > 1 skips the
+        cache-hit shortcut (the caller asked for a fresh search), but
+        the winner still lands in the proposal cache."""
         klass = (_scheduler_class if _scheduler_class is not None
                  else SchedulerClass.USER_INTERACTIVE)
+        width = (self._portfolio_width if portfolio_width is None
+                 else max(1, int(portfolio_width)))
         cacheable = goals is None and options is None
         generation = self.load_monitor.model_generation()
-        if cacheable and not ignore_proposal_cache:
+        explicit_portfolio = portfolio_width is not None and width > 1
+        if cacheable and not ignore_proposal_cache and not explicit_portfolio:
             with self._cache_lock:
                 if self._cache_valid(generation):
                     return self._cached_result
@@ -1285,6 +1485,14 @@ class CruiseControl:
                 result = self._solve_with_ladder(
                     optimizer, cacheable, options,
                     _allow_capacity_estimation, _eager_hard_abort)
+            if width > 1:
+                # greedy is candidate 0 of the portfolio by construction:
+                # the search only adds perturbed candidates, and the
+                # winner replaces greedy only when STRICTLY better — so
+                # width>1 can never serve a worse answer than width=1
+                result = self._portfolio_improve(
+                    result, goals, options, width,
+                    _allow_capacity_estimation, generation)
             from cruise_control_tpu.utils import profiling
             prof = profiling.active()
             if prof is not None and profiling.enabled():
@@ -1298,10 +1506,17 @@ class CruiseControl:
         key = ("optimizations", self._coalesce_scope,
                tuple(goals) if goals is not None else None,
                generation, _options_fingerprint(options),
-               _allow_capacity_estimation, _eager_hard_abort)
-        fold_key, fold_payload, fold_run = self._fleet_fold_spec(
-            optimizer, cacheable, options, _allow_capacity_estimation,
-            _eager_hard_abort, run_solve, store_cacheable)
+               _allow_capacity_estimation, _eager_hard_abort,
+               width if width > 1 else None)
+        # a portfolio request cannot ride the fleet fold: the folded
+        # batch runs ONE greedy lane per tenant and commits it directly,
+        # bypassing the candidate search entirely
+        if width > 1:
+            fold_key, fold_payload, fold_run = None, None, None
+        else:
+            fold_key, fold_payload, fold_run = self._fleet_fold_spec(
+                optimizer, cacheable, options, _allow_capacity_estimation,
+                _eager_hard_abort, run_solve, store_cacheable)
         return self._scheduled_solve(klass, run_solve, coalesce_key=key,
                                      label="optimizations",
                                      fold_key=fold_key,
@@ -1370,6 +1585,190 @@ class CruiseControl:
         goal_key = (optimizer._goals_share_key()
                     if optimizer is not None else None)
         return self._fleet_binding.pad_state(state, goal_key)
+
+    # ------------------------------------------------------------------
+    # device-parallel portfolio search (portfolio/): sync improvement
+    # path + cache install for the background refinement job
+    # ------------------------------------------------------------------
+    def _num_replicas(self, state) -> int:
+        import jax
+        with jax.transfer_guard_device_to_host("allow"):
+            return int(np.asarray(state.replica_valid).sum())
+
+    @staticmethod
+    def _generation_int(generation) -> int:
+        """A deterministic integer image of a model generation (the
+        background portfolio seed varies by generation; ModelGeneration
+        is a 3-int dataclass, not an int)."""
+        try:
+            return int(generation)
+        except (TypeError, ValueError):
+            return (int(getattr(generation, "cluster_generation", 0))
+                    * 1_000_003
+                    + int(getattr(generation, "load_generation", 0)) * 1_009
+                    + int(getattr(generation, "delta_generation", 0)))
+
+    @staticmethod
+    def _generation_json(generation):
+        """A JSON-safe image of a model generation for provenance
+        blocks (ModelGeneration serializes as its 3-int list)."""
+        if generation is None or isinstance(generation, (int, str)):
+            return generation
+        try:
+            return [int(generation.cluster_generation),
+                    int(generation.load_generation),
+                    int(generation.delta_generation)]
+        except AttributeError:
+            return str(generation)
+
+    def _portfolio_improve(self, greedy: OptimizerResult, goals, options,
+                           width: int, allow_capacity_estimation,
+                           generation) -> OptimizerResult:
+        """Run a width-K candidate search and return the winner when it
+        STRICTLY beats the greedy result's fitness; the greedy result
+        (annotated with provenance) otherwise.  Best-effort: any
+        portfolio failure serves greedy — the portfolio must never turn
+        a working solve into an outage.  SolvePreempted propagates (the
+        scheduler owns requeue)."""
+        from cruise_control_tpu.portfolio.mutate import make_portfolio
+        try:
+            state, topo = self._model_for_solve(allow_capacity_estimation)
+            state = self._fleet_pad(state)
+            gen_options = self._options_generator.generate(
+                options or OptimizationOptions(), topo)
+            base_order = (list(goals) if goals is not None
+                          else list(self._goal_names))
+            # greedy IS the identity candidate and already solved:
+            # include_identity=False keeps indices 1..K-1 stable while
+            # skipping the duplicate lane
+            candidates = make_portfolio(
+                base_order, self._portfolio_seed, width,
+                max_programs=self._portfolio_max_programs,
+                include_identity=False)
+            pres = self.portfolio_engine.search(
+                state, topo, candidates, self._portfolio_seed,
+                options=gen_options)
+            num_replicas = self._num_replicas(state)
+            greedy_fit = self.portfolio_engine.greedy_fitness(
+                greedy, num_replicas)
+            winner = pres.winner
+            best_fit = (winner.fitness
+                        if winner is not None and winner.feasible else None)
+            self._portfolio_last_greedy_fitness = greedy_fit
+            if best_fit is not None:
+                self._portfolio_last_best_fitness = max(best_fit,
+                                                        greedy_fit)
+            prov = {"solver": "greedy",
+                    "portfolioWidth": width,
+                    "portfolioSeed": self._portfolio_seed,
+                    "generation": self._generation_json(generation),
+                    "rung": pres.rung,
+                    "greedyFitness": round(greedy_fit, 6),
+                    "bestCandidateFitness": (round(best_fit, 6)
+                                             if best_fit is not None
+                                             else None)}
+            if best_fit is not None and best_fit > greedy_fit:
+                improved = self._portfolio_to_result(winner, state,
+                                                     pres.duration_s)
+                if improved is not None:
+                    improved.solver_provenance = dict(
+                        prov, solver="portfolio",
+                        candidateIndex=winner.candidate.index,
+                        perturbation=winner.candidate.description)
+                    self._portfolio_improvements += 1
+                    self.metrics.meter("portfolio-improvements").mark()
+                    return improved
+            greedy.solver_provenance = prov
+            return greedy
+        except SolvePreempted:
+            raise
+        except Exception as exc:  # noqa: BLE001 - portfolio is additive
+            LOG.warning("portfolio search failed (%s): %s; serving the "
+                        "greedy result",
+                        classify_failure(exc).value, exc)
+            greedy.solver_provenance = {
+                "solver": "greedy", "portfolioWidth": width,
+                "portfolioSeed": self._portfolio_seed,
+                "generation": self._generation_json(generation),
+                "error": str(exc)}
+            return greedy
+
+    def _portfolio_to_result(self, winner, lane_state,
+                             duration_s: float) -> Optional[OptimizerResult]:
+        """The winning CandidateOutcome as the OptimizerResult the
+        inline path would have returned (fleet/router.py conversion):
+        placement planes from the engine-retained per-lane final
+        placement transplanted onto the UNPERTURBED input state — the
+        move-seed load noise must not leak into the served model."""
+        if winner.result is not None:        # EAGER rung: already one
+            return winner.result
+        outcome = winner.outcome
+        if outcome is None or not outcome.feasible:
+            return None
+        final_state = None
+        if outcome.final_placement is not None:
+            import jax.numpy as jnp
+            fp = outcome.final_placement
+            final_state = lane_state.replace(
+                replica_broker=jnp.asarray(fp["replica_broker"]),
+                replica_is_leader=jnp.asarray(fp["replica_is_leader"]),
+                **({"replica_disk": jnp.asarray(fp["replica_disk"])}
+                   if "replica_disk" in fp else {}))
+        goals = self.portfolio_engine.optimizer_for(
+            winner.candidate.goal_order).goals
+        return OptimizerResult(
+            proposals=list(outcome.proposals),
+            stats_before=outcome.stats_before,
+            stats_after=outcome.stats_after,
+            stats_by_goal=dict(outcome.stats_by_goal),
+            violated_goals_before=list(outcome.violated_goals_before),
+            violated_goals_after=list(outcome.violated_goals_after),
+            regressed_goals=list(outcome.regressed_goals),
+            final_state=final_state,
+            duration_s=duration_s,
+            violated_broker_counts=dict(outcome.violated_broker_counts),
+            entry_broker_counts=dict(outcome.entry_broker_counts),
+            rounds_by_goal=dict(outcome.rounds_by_goal),
+            converged_at_by_goal=dict(outcome.converged_at_by_goal),
+            hard_goal_names=frozenset(g.name for g in goals if g.is_hard),
+            balancedness_weights=self._balancedness_weights)
+
+    def install_portfolio_winner(self, result: OptimizerResult,
+                                 generation, fitness: float,
+                                 num_replicas: int,
+                                 epoch: Optional[int] = None) -> bool:
+        """Compare-and-swap a portfolio winner into the proposal cache,
+        keyed by (model generation, fitness): the install is DROPPED
+        when the model generation moved while the search ran, when the
+        cache epoch was bumped (an execution started), or when the
+        cached result is already at least as fit — a stale or worse
+        winner must never clobber a fresher greedy precompute.  Returns
+        True only when the winner actually landed."""
+        current = self.load_monitor.model_generation()
+        stale = False
+        with self._cache_lock:
+            if (generation != current
+                    or (epoch is not None and epoch != self._cache_epoch)):
+                stale = True
+                self._portfolio_stale_drops += 1
+            elif (self._cached_result is not None
+                  and self._cached_generation == generation
+                  and self.portfolio_engine.greedy_fitness(
+                      self._cached_result, num_replicas) >= fitness):
+                pass                         # not stale, just not better
+            else:
+                if result.final_state is not None:
+                    self._warm_seed = (result.final_state, generation,
+                                       self._coalesce_scope)
+                self._cached_result = result
+                self._cached_generation = generation
+                self._cached_at = self._time()
+                self._portfolio_improvements += 1
+                self.metrics.meter("portfolio-improvements").mark()
+                return True
+        if stale:
+            self.metrics.meter("portfolio-stale-drops").mark()
+        return False
 
     def _cache_valid(self, generation) -> bool:
         """Caller holds _cache_lock."""
@@ -1906,6 +2305,7 @@ class CruiseControl:
                   strategy: Optional[ReplicaMovementStrategy] = None,
                   ignore_proposal_cache: bool = False,
                   kafka_assigner: bool = False,
+                  portfolio_width: Optional[int] = None,
                   _scheduler_class: Optional[SchedulerClass] = None,
                   **execute_kwargs) -> OperationResult:
         self._sanity_check_execution(dryrun)
@@ -1917,6 +2317,7 @@ class CruiseControl:
             goals, options,
             ignore_proposal_cache=ignore_proposal_cache
             or options is not None or kafka_assigner,
+            portfolio_width=portfolio_width,
             _scheduler_class=_scheduler_class)
         return self._maybe_execute(result, dryrun, reason, strategy,
                                    **execute_kwargs)
@@ -2278,7 +2679,8 @@ class CruiseControl:
         want = {s.lower() for s in (substates or
                                     ("monitor", "executor", "analyzer",
                                      "anomaly_detector", "scenario",
-                                     "scheduler", "incremental", "slo"))}
+                                     "portfolio", "scheduler",
+                                     "incremental", "slo"))}
         out: dict = {}
         if "monitor" in want:
             ms = self.load_monitor.get_state()
@@ -2324,6 +2726,25 @@ class CruiseControl:
             out["ScenarioEngineState"] = {
                 "enabled": self._scenario_enabled,
                 **self.scenario_engine.to_json(),
+            }
+        if "portfolio" in want:
+            # population-of-solvers search (portfolio/): width/seed
+            # config, search + ladder telemetry, improvement/stale-drop
+            # counters, the portfolio-vs-greedy fitness gap — the
+            # operator's first stop when the portfolio stops landing
+            # winners
+            out["PortfolioState"] = {
+                "enabled": (self._portfolio_width > 1
+                            or self._portfolio_background_enabled),
+                "width": self._portfolio_width,
+                "seed": self._portfolio_seed,
+                "backgroundEnabled": self._portfolio_background_enabled,
+                "backgroundSweeps": self._portfolio_background_sweeps,
+                "improvements": self._portfolio_improvements,
+                "staleDrops": self._portfolio_stale_drops,
+                "fitnessBest": self._portfolio_last_best_fitness,
+                "fitnessGreedy": self._portfolio_last_greedy_fitness,
+                **self.portfolio_engine.to_json(),
             }
         if "scheduler" in want:
             # the operator's first stop when requests wait: per-class

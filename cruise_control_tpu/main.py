@@ -380,6 +380,21 @@ def build_cruise_control(config: CruiseControlConfig, admin,
             "scenario.max.oom.halvings"),
         scenario_include_base=config.get_boolean(
             "scenario.include.base.solve"),
+        portfolio_width=config.get_int("portfolio.width"),
+        portfolio_seed=config.get_int("portfolio.seed"),
+        portfolio_movement_cost_weight=config.get_double(
+            "portfolio.movement.cost.weight"),
+        portfolio_max_programs=config.get_int("portfolio.max.programs"),
+        portfolio_max_eager_candidates=config.get_int(
+            "portfolio.max.eager.candidates"),
+        portfolio_background_enabled=config.get_boolean(
+            "portfolio.background.enabled"),
+        portfolio_background_interval_s=config.get_long(
+            "portfolio.background.interval.ms") / 1e3,
+        portfolio_background_width=config.get_int(
+            "portfolio.background.width"),
+        portfolio_background_generations=config.get_int(
+            "portfolio.background.generations"),
         scheduler_enabled=config.get_boolean("scheduler.enabled"),
         scheduler_preemption_enabled=config.get_boolean(
             "scheduler.preemption.enabled"),

@@ -1,0 +1,35 @@
+"""Device-parallel portfolio search: a population of perturbed solver
+configurations solved as ONE batched program (ISSUE 19).
+
+The greedy goal ladder — not raw speed — pinned balancedness at 85.1
+for three bench rounds (BENCH_r03–r05).  This package points the
+scenario engine's vmapped batch axis (PR 3) and the mesh lane-sharding
+(PR 6) at hypothetical *solver configs* instead of hypothetical
+*clusters*: K seeded perturbations of the solver configuration
+(`mutate.py`) solve side by side in one dispatch (`engine.py`), an
+on-device fitness epilogue scores them, and the best strictly-better
+candidate replaces the greedy answer — optionally refined over G
+generations (`evolve.py`).
+
+Determinism contract: every candidate is a pure function of
+`(base config, portfolio seed, candidate index)`; candidate 0 is the
+identity perturbation, and a width-1 portfolio never runs at all, so
+K=1 is byte-identical to today's greedy solve.
+"""
+from cruise_control_tpu.portfolio.engine import (CandidateOutcome,
+                                                 PortfolioEngine,
+                                                 PortfolioResult)
+from cruise_control_tpu.portfolio.evolve import evolve
+from cruise_control_tpu.portfolio.mutate import (SolverCandidate,
+                                                 make_portfolio,
+                                                 mutate_candidate)
+
+__all__ = [
+    "CandidateOutcome",
+    "PortfolioEngine",
+    "PortfolioResult",
+    "SolverCandidate",
+    "evolve",
+    "make_portfolio",
+    "mutate_candidate",
+]

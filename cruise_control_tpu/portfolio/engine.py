@@ -1,0 +1,469 @@
+"""Portfolio engine: K perturbed solver configs as ONE batched solve.
+
+Candidates group by their TRACE key (goal order, fast_mode) — the only
+knobs that change the compiled program — and each group rides the
+scenario engine's caller-assembled batch path
+(`ScenarioEngine.solve_compiled`): one vmapped dispatch per group,
+lane-sharded across the mesh when the dispatch thread holds a
+multi-chip token, OOM-halving and broker-table re-widening inherited
+from the scenario engine, preemption checkpoints at every segment
+boundary.  Lane-level perturbations (balance-threshold jitter via a
+per-candidate jittered BalancingConstraint, move-seed load noise)
+stack along the batch axis like any other scenario variant.
+
+Fitness needs NO extra host round-trips: its inputs — the per-goal
+violated masks behind the balancedness score and the movement counters
+from the on-device `__moves__` epilogue — already ride the scenario
+engine's single end-of-batch instrument fetch; combining them into one
+scalar is host arithmetic on already-fetched values.
+
+    fitness = balancedness
+              − movement_cost_weight · (replica_moves + ½·leader_moves)
+                                       / num_replicas
+    fitness = −inf when any hard goal is still violated (the hard-goal
+              feasibility mask: infeasible lanes can never win)
+
+Failure policy: the portfolio owns its OWN degradation ladder,
+separate from both the facade request ladder and the scenario engine's
+(a failing portfolio sweep must not pin either).  FUSED = the batched
+group solves; EAGER = a bounded per-candidate loop through
+`GoalOptimizer.optimizations(eager_driver=True)`; below EAGER the
+search returns no winner and the greedy result serves the request —
+portfolio search degrades to "no improvement", never to an error.
+Fault site: ``portfolio.search`` (armed before the first group
+dispatch).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time as _time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions,
+                                                 make_context,
+                                                 partition_replica_index)
+from cruise_control_tpu.analyzer.degradation import (CircuitBreaker,
+                                                     DegradationLadder,
+                                                     SolverRung,
+                                                     classify_failure)
+from cruise_control_tpu.model.state import ClusterState
+from cruise_control_tpu.portfolio.mutate import MOVE_SEED_EPS, SolverCandidate
+from cruise_control_tpu.scenario.compiler import CompiledBatch, materialize
+from cruise_control_tpu.scenario.engine import ScenarioOutcome
+from cruise_control_tpu.scenario.spec import ScenarioSpec
+from cruise_control_tpu.sched.runtime import SolvePreempted
+from cruise_control_tpu.utils import faults
+
+LOG = logging.getLogger(__name__)
+
+
+def portfolio_fitness(balancedness: float, replica_moves: int,
+                      leader_moves: int, num_replicas: int,
+                      movement_cost_weight: float) -> float:
+    """The shared fitness formula — used for candidates AND for scoring
+    the greedy baseline, so the strictly-better comparison is apples to
+    apples."""
+    cost = (replica_moves + 0.5 * leader_moves) / max(1, num_replicas)
+    return balancedness - movement_cost_weight * cost
+
+
+@dataclasses.dataclass
+class CandidateOutcome:
+    """One candidate's verdict: the declarative perturbation, its
+    fitness, and whichever result form the serving rung produced
+    (`outcome` from the fused batch, `result` from the eager loop)."""
+
+    candidate: SolverCandidate
+    fitness: float
+    rung: str = "FUSED"
+    outcome: Optional[ScenarioOutcome] = None
+    result: Optional[object] = None          #: eager-rung OptimizerResult
+
+    @property
+    def feasible(self) -> bool:
+        return self.fitness != float("-inf")
+
+    def to_json(self) -> dict:
+        return {
+            "candidate": self.candidate.to_json(),
+            "fitness": (round(self.fitness, 4) if self.feasible
+                        else None),
+            "feasible": self.feasible,
+            "rung": self.rung,
+        }
+
+
+@dataclasses.dataclass
+class PortfolioResult:
+    """One portfolio search: every candidate scored, best first."""
+
+    seed: int
+    width: int
+    candidates: List[CandidateOutcome]
+    winner: Optional[CandidateOutcome] = None
+    duration_s: float = 0.0
+    rung: str = "FUSED"
+    generations: int = 0
+
+    def to_json(self) -> dict:
+        return {
+            "seed": self.seed,
+            "width": self.width,
+            "rung": self.rung,
+            "generations": self.generations,
+            "durationS": round(self.duration_s, 3),
+            "winner": (self.winner.to_json() if self.winner is not None
+                       else None),
+            "candidates": [c.to_json() for c in self.candidates],
+        }
+
+
+def select_winner(candidates: Sequence[CandidateOutcome]
+                  ) -> Optional[CandidateOutcome]:
+    """Best fitness wins; ties break toward the LOWEST candidate index
+    (closest to the identity), so same-fitness runs are deterministic
+    and biased toward the least-perturbed config."""
+    best: Optional[CandidateOutcome] = None
+    for c in candidates:
+        if not c.feasible:
+            continue
+        if (best is None or c.fitness > best.fitness
+                or (c.fitness == best.fitness
+                    and c.candidate.index < best.candidate.index)):
+            best = c
+    return best
+
+
+class PortfolioEngine:
+    """Population-of-solvers search over one base model.
+
+    `scenario_engine` supplies the batched execution substrate
+    (solve_compiled); `optimizer_factory(goal_names_or_None)` builds the
+    goal stack for a candidate's order — the facade passes its own
+    factory so portfolio programs share the process-wide trace cache
+    with request solves."""
+
+    def __init__(self, scenario_engine, optimizer_factory: Callable,
+                 constraint: Optional[BalancingConstraint] = None,
+                 movement_cost_weight: float = 4.0,
+                 max_eager_candidates: int = 4,
+                 breaker_failure_threshold: int = 3,
+                 breaker_cooldown_s: float = 300.0,
+                 metrics=None,
+                 time_fn: Optional[Callable[[], float]] = None) -> None:
+        self._scenario_engine = scenario_engine
+        self._optimizer_factory = optimizer_factory
+        self._constraint = constraint or BalancingConstraint()
+        self.movement_cost_weight = movement_cost_weight
+        self.max_eager_candidates = max(1, max_eager_candidates)
+        self._metrics = metrics
+        self._time = time_fn or _time.time
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_failure_threshold,
+            cooldown_s=breaker_cooldown_s, time_fn=self._time)
+        self.ladder = DegradationLadder(self.breaker)
+        self._lock = threading.Lock()
+        #: per-goal-order optimizer cache: repeated searches over the
+        #: same pool reuse goal stacks (and through them the process-
+        #: wide program caches) instead of re-instantiating per sweep
+        self._optimizers: "OrderedDict[tuple, object]" = OrderedDict()
+        self._max_optimizers = 8
+        # telemetry (STATE PortfolioState + portfolio-* sensors)
+        self.total_searches = 0
+        self.total_candidates = 0
+        self.total_descents = 0
+        self.last_width = 0
+        self.last_duration_s = 0.0
+        self.last_best_fitness: Optional[float] = None
+
+    # ------------------------------------------------------------------
+    def attach_metrics(self, registry) -> None:
+        self._metrics = registry
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {
+                "rung": self.ladder.rung.name,
+                "breaker": self.breaker.to_json(),
+                "totalSearches": self.total_searches,
+                "totalCandidates": self.total_candidates,
+                "totalDescents": self.total_descents,
+                "lastWidth": self.last_width,
+                "lastDurationS": round(self.last_duration_s, 3),
+                "lastBestFitness": (
+                    None if self.last_best_fitness is None
+                    else round(self.last_best_fitness, 4)),
+            }
+
+    # ------------------------------------------------------------------
+    def greedy_fitness(self, result, num_replicas: int) -> float:
+        """Score an inline greedy OptimizerResult with the candidate
+        formula (the strictly-better bar a winner must clear)."""
+        return portfolio_fitness(
+            result.balancedness_score(), result.num_replica_movements,
+            result.num_leadership_movements, num_replicas,
+            self.movement_cost_weight)
+
+    # ------------------------------------------------------------------
+    def search(self, base_state: ClusterState, topology,
+               candidates: Sequence[SolverCandidate], seed: int,
+               options: Optional[OptimizationOptions] = None,
+               include_proposals: bool = True) -> PortfolioResult:
+        """Solve every candidate, score, select.  Never raises for
+        solver-side failures (the portfolio degrades to winner=None);
+        SolvePreempted always propagates — the scheduler re-queues the
+        sweep."""
+        t0 = self._time()
+        candidates = list(candidates)
+        options = options or OptimizationOptions()
+        result = PortfolioResult(seed=seed, width=len(candidates),
+                                 candidates=[])
+        if not candidates:
+            return result
+
+        rung = self.ladder.entry_rung()
+        if rung <= SolverRung.FUSED:
+            try:
+                faults.inject("portfolio.search")
+                result.candidates = self._search_fused(
+                    base_state, topology, candidates, options,
+                    include_proposals)
+                self.ladder.on_success(SolverRung.FUSED)
+                result.rung = "FUSED"
+            except SolvePreempted:
+                raise
+            except Exception as exc:  # noqa: BLE001 - ladder classifies
+                kind = classify_failure(exc)
+                self.ladder.on_failure(SolverRung.FUSED)
+                self._descend_metered()
+                LOG.warning(
+                    "batched portfolio search of %d candidates failed "
+                    "(%s): %s; descending to bounded EAGER loop",
+                    len(candidates), kind.value, exc)
+                rung = SolverRung.EAGER
+        if rung >= SolverRung.EAGER and not result.candidates:
+            result.rung = rung.name
+            result.candidates = self._search_eager(
+                base_state, topology, candidates, options, rung)
+
+        result.winner = select_winner(result.candidates)
+        result.duration_s = self._time() - t0
+        with self._lock:
+            self.total_searches += 1
+            self.total_candidates += len(candidates)
+            self.last_width = len(candidates)
+            self.last_duration_s = result.duration_s
+            if result.winner is not None:
+                self.last_best_fitness = result.winner.fitness
+        if self._metrics is not None:
+            self._metrics.update_timer("portfolio-search-timer",
+                                       result.duration_s)
+        return result
+
+    # ------------------------------------------------------------------
+    def optimizer_for(self, order):
+        """The (LRU-cached) optimizer for a candidate goal order —
+        public so winner-result conversion reuses the exact optimizer
+        (and its hard-goal set) that solved the candidate."""
+        return self._optimizer_for(tuple(order))
+
+    def _optimizer_for(self, order: Tuple[str, ...]):
+        with self._lock:
+            opt = self._optimizers.get(order)
+            if opt is not None:
+                self._optimizers.move_to_end(order)
+                return opt
+        opt = self._optimizer_factory(list(order))
+        with self._lock:
+            self._optimizers[order] = opt
+            while len(self._optimizers) > self._max_optimizers:
+                self._optimizers.popitem(last=False)
+        return opt
+
+    def _search_fused(self, base_state, topology, candidates, options,
+                      include_proposals) -> List[CandidateOutcome]:
+        import jax
+
+        groups: "OrderedDict[tuple, List[SolverCandidate]]" = OrderedDict()
+        for cand in candidates:
+            groups.setdefault(cand.trace_key(), []).append(cand)
+
+        # each trace group compiles one program per goal segment (plus
+        # prologue/epilogue); reserve room for the whole sweep so
+        # repeated searches don't thrash the scenario engine's LRU
+        self._scenario_engine.reserve_program_capacity(len(groups) * 16)
+
+        # one no-op materialization serves every lane: portfolio
+        # candidates never touch the cluster, only the solver config
+        noop = ScenarioSpec(name="__portfolio_base__")
+        rack_index = {r: i for i, r in enumerate(topology.rack_ids)}
+        with jax.transfer_guard_device_to_host("allow"):
+            # sanctioned pre-dispatch host region (variant assembly
+            # reads the base model's device arrays)
+            mat_state, mat_topo, _opts = materialize(
+                base_state, topology, noop, base_state.num_brokers,
+                rack_index, base_state.num_racks, base_state.num_hosts)
+
+            out: Dict[int, CandidateOutcome] = {}
+            num_replicas = int(np.asarray(mat_state.replica_valid).sum())
+            for (order, fast), group in groups.items():
+                optimizer = self._optimizer_for(order)
+                batch = self._build_batch(mat_state, mat_topo, group,
+                                          options, fast)
+                telemetry = self._scenario_engine.solve_compiled(
+                    optimizer, batch,
+                    include_proposals=include_proposals)
+                for cand, outcome in zip(group, telemetry.outcomes):
+                    out[cand.index] = self._score(cand, outcome,
+                                                  num_replicas)
+        return [out[c.index] for c in candidates]
+
+    def _build_batch(self, mat_state: ClusterState, mat_topo,
+                     group: Sequence[SolverCandidate],
+                     options: OptimizationOptions,
+                     fast: bool) -> CompiledBatch:
+        import jax.numpy as jnp
+
+        lane_options = (options if options.fast_mode == fast
+                        else dataclasses.replace(options, fast_mode=fast))
+        specs, states, contexts, topologies = [], [], [], []
+        for cand in group:
+            state = mat_state
+            if cand.move_seed:
+                # ppm-scale load noise re-rolls every load-derived
+                # tie-break salt (kernels.rotation_salt and the pairwise
+                # jitters hash load columns) — the move-seed mutation
+                noise = 1.0 + MOVE_SEED_EPS * np.random.RandomState(
+                    cand.move_seed).uniform(
+                        -1.0, 1.0,
+                        size=np.asarray(mat_state.replica_base_load).shape)
+                state = dataclasses.replace(
+                    mat_state,
+                    replica_base_load=jnp.asarray(
+                        np.asarray(mat_state.replica_base_load)
+                        * noise, dtype=jnp.float32))
+            specs.append(ScenarioSpec(name=f"portfolio:{cand.index}",
+                                      goals=cand.goal_order))
+            states.append(state)
+            contexts.append(make_context(
+                state, cand.jittered_constraint(self._constraint),
+                lane_options, mat_topo))
+            topologies.append(mat_topo)
+        slots = max(c.table_slots for c in contexts)
+        contexts = [c if c.table_slots == slots
+                    else dataclasses.replace(c, table_slots=slots)
+                    for c in contexts]
+        rows = partition_replica_index(states[0],
+                                       rf_max=contexts[0].rf_max)
+        # per-lane membership (fleet-fold mode) even though membership is
+        # shared: it makes the engine retain each feasible lane's FINAL
+        # placement, which the facade needs to rebuild the winner's
+        # final state (warm-seed parity with inline solves)
+        return CompiledBatch(
+            specs=specs, states=states, contexts=contexts,
+            topologies=topologies, num_brokers=mat_state.num_brokers,
+            partition_rows=rows, shared_membership=False,
+            partition_rows_per=[rows] * len(group))
+
+    def _score(self, cand: SolverCandidate, outcome: ScenarioOutcome,
+               num_replicas: int) -> CandidateOutcome:
+        if not outcome.feasible:
+            return CandidateOutcome(candidate=cand,
+                                    fitness=float("-inf"),
+                                    outcome=outcome)
+        # count moves by the PROPOSAL definitions (replicas added;
+        # leadership = leader-only proposals) whenever the lane carried
+        # proposals — the device `__moves__` epilogue counts every
+        # leader flip, including ones induced by replica relocation, so
+        # scoring candidates by epilogue counts while greedy_fitness
+        # scores the baseline by proposal counts would bias the
+        # strictly-better bar against candidates.  Proposals are host
+        # arithmetic on the already-fetched placement planes: no extra
+        # device round-trip.
+        if outcome.proposals:
+            replica_moves = sum(len(p.replicas_to_add)
+                                for p in outcome.proposals)
+            leader_moves = sum(1 for p in outcome.proposals
+                               if p.has_leader_action
+                               and not p.has_replica_action)
+        else:
+            replica_moves = outcome.num_replica_moves
+            leader_moves = outcome.num_leadership_moves
+        fitness = portfolio_fitness(
+            outcome.balancedness, replica_moves, leader_moves,
+            num_replicas, self.movement_cost_weight)
+        return CandidateOutcome(candidate=cand, fitness=fitness,
+                                outcome=outcome)
+
+    # ------------------------------------------------------------------
+    def _search_eager(self, base_state, topology, candidates, options,
+                      rung: SolverRung) -> List[CandidateOutcome]:
+        """Bounded per-candidate fallback: the first
+        `max_eager_candidates` candidates run through the eager driver;
+        the rest are reported infeasible (never solved).  The EAGER rung
+        realizes goal-order / fast-mode / move-seed perturbations only —
+        the balance-threshold jitter lives in the batched context build
+        and is dropped here (a degraded rung searches a narrower
+        portfolio, it does not fail).  A total EAGER wash returns an
+        empty feasible set — the greedy result serves."""
+        import jax
+        import jax.numpy as jnp
+
+        out: List[CandidateOutcome] = []
+        with jax.transfer_guard_device_to_host("allow"):
+            num_replicas = int(np.asarray(base_state.replica_valid).sum())
+            base_load = np.asarray(base_state.replica_base_load)
+        budget = self.max_eager_candidates
+        for cand in candidates:
+            if budget <= 0:
+                out.append(CandidateOutcome(candidate=cand,
+                                            fitness=float("-inf"),
+                                            rung=rung.name))
+                continue
+            budget -= 1
+            try:
+                optimizer = self._optimizer_for(cand.goal_order)
+                lane_options = dataclasses.replace(
+                    options, fast_mode=cand.fast_mode)
+                lane_state = base_state
+                if cand.move_seed:
+                    noise = 1.0 + MOVE_SEED_EPS * np.random.RandomState(
+                        cand.move_seed).uniform(-1.0, 1.0,
+                                                size=base_load.shape)
+                    lane_state = dataclasses.replace(
+                        base_state, replica_base_load=jnp.asarray(
+                            base_load * noise, dtype=jnp.float32))
+                result = optimizer.optimizations(
+                    lane_state, topology, lane_options,
+                    check_sanity=False, eager_driver=True)
+                fitness = portfolio_fitness(
+                    result.balancedness_score(),
+                    result.num_replica_movements,
+                    result.num_leadership_movements, num_replicas,
+                    self.movement_cost_weight)
+                out.append(CandidateOutcome(
+                    candidate=cand, fitness=fitness, rung=rung.name,
+                    result=result))
+                self.ladder.on_success(SolverRung.EAGER)
+            except SolvePreempted:
+                raise
+            except Exception as exc:  # noqa: BLE001 - one lane fails
+                LOG.warning("eager portfolio candidate %d failed: %s",
+                            cand.index, exc)
+                self.ladder.on_failure(SolverRung.EAGER)
+                out.append(CandidateOutcome(candidate=cand,
+                                            fitness=float("-inf"),
+                                            rung=rung.name))
+        return out
+
+    def _descend_metered(self) -> None:
+        with self._lock:
+            self.total_descents += 1
+        if self._metrics is not None:
+            self._metrics.meter("portfolio-descents").mark()

@@ -1,0 +1,129 @@
+"""Optional G-generation refinement loop over the portfolio.
+
+Truncation selection + tier-respecting goal-order crossover + mutation
+(mutate.py) on top of the one-shot portfolio search: generation 0 is
+exactly `make_portfolio(seed, width)`, every later generation keeps the
+elite half and breeds the other half from parents chosen by fitness —
+with the PER-GOAL entry/exit violated-broker counts (threaded through
+ScenarioOutcome/OptimizerResult since PR 6) as the parent-selection
+decomposition: among equal-fitness parents, the one whose own passes
+REDUCED more per-goal violated-broker count ranks first, so crossover
+prefers orders whose early goals actually retired violations rather
+than orders that merely coasted to the same score.
+
+Everything is a pure function of (base config, seed, width,
+generations): candidate indices keep growing across generations
+(generation g child j has index g*width + j), so `random.Random(
+f"{seed}:{index}")` never reuses a stream and the whole evolution
+replays bit-for-bit.
+"""
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Sequence
+
+from cruise_control_tpu.portfolio.engine import (CandidateOutcome,
+                                                 PortfolioEngine,
+                                                 PortfolioResult,
+                                                 select_winner)
+from cruise_control_tpu.portfolio.mutate import (SolverCandidate,
+                                                 crossover_orders,
+                                                 make_portfolio,
+                                                 mutate_candidate)
+
+
+def _violation_reduction(c: CandidateOutcome) -> int:
+    """Sum over goals of (violated brokers at the goal's own entry −
+    after its own pass): how much of the score each goal's own work
+    earned.  0 when the serving rung carried no decomposition."""
+    source = c.outcome if c.outcome is not None else c.result
+    if source is None:
+        return 0
+    entry = getattr(source, "entry_broker_counts", None) or {}
+    counts = getattr(source, "violated_broker_counts", None) or {}
+    total = 0
+    for goal, triple in counts.items():
+        own = int(triple[1])
+        total += max(0, int(entry.get(goal, triple[0])) - own)
+    return total
+
+
+def _parent_rank(c: CandidateOutcome):
+    # fitness first; the per-goal violation-reduction decomposition
+    # breaks fitness ties; candidate index last for determinism
+    return (-c.fitness, -_violation_reduction(c), c.candidate.index)
+
+
+def evolve(engine: PortfolioEngine, base_state, topology, base_order,
+           seed: int, width: int, generations: int,
+           max_programs: int = 4, options=None,
+           include_proposals: bool = True,
+           on_generation=None) -> PortfolioResult:
+    """Run `generations` rounds of search-select-breed; returns the best
+    PortfolioResult shape seen across ALL generations (winner = global
+    best, candidates = final generation's scored population,
+    generations = rounds actually completed).
+
+    `on_generation(gen_index)` (when given) runs between generations —
+    the background refinement job passes a staleness probe so a sweep
+    whose model generation moved stops breeding dead candidates."""
+    if generations < 1 or width < 1:
+        return PortfolioResult(seed=seed, width=width, candidates=[])
+
+    population: List[SolverCandidate] = make_portfolio(
+        base_order, seed, width, max_programs=max_programs)
+    best: Optional[CandidateOutcome] = None
+    result: Optional[PortfolioResult] = None
+    next_index = width
+    duration = 0.0
+
+    for gen in range(generations):
+        result = engine.search(base_state, topology, population, seed,
+                               options=options,
+                               include_proposals=include_proposals)
+        duration += result.duration_s
+        result.generations = gen + 1
+        gen_best = select_winner(result.candidates)
+        if gen_best is not None and (best is None
+                                     or gen_best.fitness > best.fitness):
+            best = gen_best
+        if gen + 1 >= generations:
+            break
+        if on_generation is not None and not on_generation(gen):
+            break
+        population, next_index = _breed(result.candidates, base_order,
+                                        seed, width, next_index)
+
+    assert result is not None
+    result.winner = best
+    result.duration_s = duration
+    return result
+
+
+def _breed(scored: Sequence[CandidateOutcome], base_order, seed: int,
+           width: int, next_index: int):
+    """Next generation: elite half survives unchanged, the rest are
+    crossover+mutation children of rank-adjacent parents.  Indices keep
+    growing so RNG streams never repeat."""
+    ranked = sorted(scored, key=_parent_rank)
+    feasible = [c for c in ranked if c.feasible] or list(ranked)
+    elite_n = max(1, width // 2)
+    elite = [c.candidate for c in feasible[:elite_n]]
+    children: List[SolverCandidate] = []
+    parent_i = 0
+    while len(elite) + len(children) < width:
+        a = elite[parent_i % len(elite)]
+        b = elite[(parent_i + 1) % len(elite)]
+        parent_i += 1
+        rng = random.Random(f"{seed}:x:{next_index}")
+        child_order = crossover_orders(a.goal_order, b.goal_order, rng)
+        template = SolverCandidate(
+            index=a.index, goal_order=child_order,
+            fast_mode=a.fast_mode if rng.random() < 0.5 else b.fast_mode,
+            threshold_scale=(a.threshold_scale + b.threshold_scale) / 2.0,
+            move_seed=a.move_seed,
+            description=f"x({a.index},{b.index})")
+        children.append(mutate_candidate(template, seed, next_index,
+                                         base_order=base_order))
+        next_index += 1
+    return elite + children, next_index

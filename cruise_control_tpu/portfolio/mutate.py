@@ -1,0 +1,266 @@
+"""Seeded, declarative perturbation vocabulary over solver configs.
+
+Every candidate is a PURE function of `(base config, portfolio seed,
+candidate index)`: the RNG for candidate i is `random.Random(f"{seed}:{i}")`
+(string seeding hashes through SHA-512, stable across platforms and
+Python versions), so the same (seed, index) always yields the same
+perturbation and a portfolio run is reproducible end to end.
+
+The vocabulary (ISSUE 19):
+
+* **goal-order shuffle** — hard goals shuffle among themselves, soft
+  goals among themselves, and the hard tier always precedes the soft
+  tier, so hard-goal precedence is preserved (the same constraint
+  tests/test_random_goal_order.py pins for arbitrary orders);
+* **balance-threshold jitter** — one scalar scale on every balance
+  margin above 1.0 (resource / replica / leader / topic percentages),
+  realized as a jittered BalancingConstraint so it lands in the
+  context's batchable `balance_upper_pct`/`balance_lower_pct` array
+  planes: candidates with different thresholds still share one program.
+  The scale only ever TIGHTENS (`THRESHOLD_SCALE_RANGE` tops out at
+  1.0) because each lane is scored against its own constraint — a
+  tightened winner provably also satisfies the base margins, whereas a
+  loosened one could beat greedy merely by grading itself on a curve;
+* **rotation-salt / move-seed mutation** — the solver's tie-break salts
+  are derived from load columns (kernels.rotation_salt is a state
+  hash), so a ppm-scale multiplicative noise on `replica_base_load`
+  re-rolls every rotation salt and pairwise jitter without materially
+  changing the optimization problem.  `move_seed=0` applies no noise;
+* **round-budget reallocation** — `fast_mode=True` quarters every soft
+  goal's round budget (hard goals are unaffected), trading soft-goal
+  polish on early goals for the chance that a different order converges
+  better overall.
+
+Goal order and fast_mode are TRACE-TIME properties (each distinct pair
+compiles its own program), so `make_portfolio` draws them from a pool
+capped at `max_programs` distinct (order, fast_mode) keys; width beyond
+the pool varies only the lane-batchable knobs (threshold jitter, move
+seed).  Candidate 0 is always the identity.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import List, Optional, Sequence, Tuple
+
+from cruise_control_tpu.analyzer.context import BalancingConstraint
+from cruise_control_tpu.analyzer.goals.registry import GOAL_CLASSES
+
+#: bounds of the balance-threshold jitter: the margin above 1.0 scales
+#: by a factor drawn from this range (identity = 1.0).  Tighten-only
+#: (<= 1.0) on purpose: a candidate's verdicts and balancedness are
+#: evaluated under its OWN jittered constraint, so a placement that
+#: satisfies tighter margins also satisfies the operator's base margins
+#: and its reported balancedness lower-bounds the base-margin value —
+#: "winner never worse" stays sound.  A loosening scale (> 1.0) would
+#: let a candidate "win" by relaxing the very thresholds it is scored
+#: against.
+THRESHOLD_SCALE_RANGE = (0.7, 1.0)
+
+#: multiplicative amplitude of the move-seed load noise — ppm scale, far
+#: below any capacity/balance decision threshold but enough to re-roll
+#: every load-derived tie-break salt
+MOVE_SEED_EPS = 1e-5
+
+
+def _is_hard(name: str) -> bool:
+    cls = GOAL_CLASSES.get(name)
+    return bool(cls is not None and cls.is_hard)
+
+
+def split_tiers(order: Sequence[str]) -> Tuple[List[str], List[str]]:
+    """(hard tier, soft tier) of `order`, each in its original order."""
+    hard = [g for g in order if _is_hard(g)]
+    soft = [g for g in order if not _is_hard(g)]
+    return hard, soft
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverCandidate:
+    """One perturbed solver configuration, fully declarative.
+
+    `index` is the candidate's position in its portfolio; together with
+    the portfolio seed it reproduces the perturbation exactly.
+    `description` is the human-readable provenance string surfaced in
+    responses (`solverProvenance.perturbation`)."""
+
+    index: int
+    goal_order: Tuple[str, ...]
+    fast_mode: bool = False
+    threshold_scale: float = 1.0
+    move_seed: int = 0
+    description: str = "identity"
+
+    @property
+    def is_identity(self) -> bool:
+        return (self.fast_mode is False and self.threshold_scale == 1.0
+                and self.move_seed == 0 and self.description == "identity")
+
+    def trace_key(self) -> Tuple[Tuple[str, ...], bool]:
+        """Candidates sharing a trace key share one compiled program:
+        goal order and fast_mode are the only trace-time knobs."""
+        return (self.goal_order, self.fast_mode)
+
+    def jittered_constraint(self,
+                            base: BalancingConstraint
+                            ) -> BalancingConstraint:
+        """`base` with every balance margin above 1.0 scaled by
+        `threshold_scale` (identity returns `base` unchanged, so the
+        K=1 path reuses the exact same constraint object)."""
+        s = self.threshold_scale
+        if s == 1.0:
+            return base
+
+        def _scale(pct: float) -> float:
+            return 1.0 + (pct - 1.0) * s
+
+        return dataclasses.replace(
+            base,
+            resource_balance_percentage=tuple(
+                _scale(p) for p in base.resource_balance_percentage),
+            replica_balance_percentage=_scale(
+                base.replica_balance_percentage),
+            leader_replica_balance_percentage=_scale(
+                base.leader_replica_balance_percentage),
+            topic_replica_balance_percentage=_scale(
+                base.topic_replica_balance_percentage))
+
+    def to_json(self) -> dict:
+        return {
+            "index": self.index,
+            "goalOrder": list(self.goal_order),
+            "fastMode": self.fast_mode,
+            "thresholdScale": round(self.threshold_scale, 4),
+            "moveSeed": self.move_seed,
+            "description": self.description,
+        }
+
+
+def shuffled_order(order: Sequence[str], rng: random.Random
+                   ) -> Tuple[str, ...]:
+    """Shuffle hard and soft tiers independently; hard tier first.
+
+    Hard-goal precedence is structural: a hard goal can never end up
+    after a soft goal, whatever the draw."""
+    hard, soft = split_tiers(order)
+    rng.shuffle(hard)
+    rng.shuffle(soft)
+    return tuple(hard + soft)
+
+
+def _candidate_rng(seed: int, index: int) -> random.Random:
+    return random.Random(f"{seed}:{index}")
+
+
+def _trace_pool(base_order: Sequence[str], seed: int, width: int,
+                max_programs: int) -> List[Tuple[Tuple[str, ...], bool]]:
+    """The capped pool of distinct (goal order, fast_mode) trace keys.
+
+    Key 0 is always the base order without fast mode.  Additional keys
+    alternate shuffled orders and fast-mode variants; the pool never
+    exceeds `max_programs` so a K=32 portfolio does not compile 32
+    programs — candidates past the pool recycle keys and differ only in
+    lane-batchable knobs."""
+    pool: List[Tuple[Tuple[str, ...], bool]] = [(tuple(base_order), False)]
+    j = 1
+    while len(pool) < min(width, max(1, max_programs)):
+        rng = _candidate_rng(seed, -j)  # order pool draws: negative
+        # indices so candidate RNG streams never collide with pool draws
+        order = shuffled_order(base_order, rng)
+        fast = bool(j % 3 == 0)  # every third pool entry reallocates
+        # round budget (fast_mode) on top of its shuffle
+        key = (order, fast)
+        if key not in pool:
+            pool.append(key)
+        else:
+            pool.append((shuffled_order(base_order, rng), fast))
+        j += 1
+    return pool
+
+
+def make_portfolio(base_order: Sequence[str], seed: int, width: int,
+                   max_programs: int = 4,
+                   include_identity: bool = True) -> List[SolverCandidate]:
+    """The width-K portfolio for (base config, seed): candidate 0 is the
+    identity, candidates 1..K-1 are seeded perturbations.
+
+    `include_identity=False` drops candidate 0 (the facade's sync path
+    already holds the greedy result — re-solving the identity lane
+    would waste a lane) while keeping indices 1..K-1 IDENTICAL to the
+    included-identity portfolio, so provenance indices mean the same
+    thing either way."""
+    base_order = tuple(base_order)
+    candidates: List[SolverCandidate] = []
+    if include_identity:
+        candidates.append(SolverCandidate(index=0, goal_order=base_order))
+    pool = _trace_pool(base_order, seed, width, max_programs)
+    for i in range(1, width):
+        rng = _candidate_rng(seed, i)
+        order, fast = pool[i % len(pool)]
+        scale = round(rng.uniform(*THRESHOLD_SCALE_RANGE), 4)
+        move_seed = rng.randrange(1, 2**31 - 1)
+        parts = []
+        if order != base_order:
+            hard, _ = split_tiers(base_order)
+            soft_part = [g for g in order if not _is_hard(g)]
+            parts.append("order=" + ",".join(
+                g.replace("Goal", "") for g in
+                (list(order[:len(hard)]) + soft_part)[:3]) + "…")
+        if fast:
+            parts.append("fast-rounds")
+        parts.append(f"thresh×{scale}")
+        parts.append(f"salt:{move_seed % 10_000}")
+        candidates.append(SolverCandidate(
+            index=i, goal_order=order, fast_mode=fast,
+            threshold_scale=scale, move_seed=move_seed,
+            description=" ".join(parts)))
+    return candidates
+
+
+def mutate_candidate(parent: SolverCandidate, seed: int, index: int,
+                     base_order: Optional[Sequence[str]] = None
+                     ) -> SolverCandidate:
+    """One mutation step for the evolve loop: re-jitter the threshold,
+    re-roll the move seed, and with probability 1/3 swap two goals
+    within one tier of the parent's order.  Pure in (parent, seed,
+    index)."""
+    rng = _candidate_rng(seed, index)
+    order = list(parent.goal_order)
+    mutated_order = False
+    if rng.random() < (1.0 / 3.0):
+        hard, soft = split_tiers(order)
+        tier = soft if (len(soft) >= 2 and
+                        (len(hard) < 2 or rng.random() < 0.7)) else hard
+        if len(tier) >= 2:
+            a, b = rng.sample(range(len(tier)), 2)
+            tier[a], tier[b] = tier[b], tier[a]
+            order = hard + soft
+            mutated_order = True
+    drift = rng.uniform(0.85, 1.15)
+    lo, hi = THRESHOLD_SCALE_RANGE
+    scale = round(min(hi, max(lo, parent.threshold_scale * drift)), 4)
+    move_seed = rng.randrange(1, 2**31 - 1)
+    desc = (f"mut({parent.index})"
+            + (" swap" if mutated_order else "")
+            + f" thresh×{scale} salt:{move_seed % 10_000}")
+    return SolverCandidate(
+        index=index, goal_order=tuple(order), fast_mode=parent.fast_mode,
+        threshold_scale=scale, move_seed=move_seed, description=desc)
+
+
+def crossover_orders(a: Sequence[str], b: Sequence[str],
+                     rng: random.Random) -> Tuple[str, ...]:
+    """Tier-respecting order crossover: per tier, keep a random prefix
+    of parent A's tier order and fill the remainder in parent B's
+    relative order (classic OX restricted within each tier, so the
+    child still satisfies hard-goal precedence)."""
+    def _cross(ta: List[str], tb: List[str]) -> List[str]:
+        if len(ta) < 2:
+            return list(ta)
+        cut = rng.randrange(1, len(ta))
+        head = ta[:cut]
+        return head + [g for g in tb if g not in head]
+
+    hard_a, soft_a = split_tiers(a)
+    hard_b, soft_b = split_tiers(b)
+    return tuple(_cross(hard_a, hard_b) + _cross(soft_a, soft_b))

@@ -211,6 +211,14 @@ class ScenarioEngine:
         before the registry during facade construction)."""
         self._metrics = registry
 
+    def reserve_program_capacity(self, n: int) -> None:
+        """Grow (never shrink) the AOT program LRU to hold at least `n`
+        entries.  A portfolio sweep streams `trace groups x per-segment
+        programs` distinct keys per search; below that the LRU thrashes
+        and every "warm" search re-hydrates its whole working set."""
+        with self._lock:
+            self._max_programs = max(self._max_programs, int(n))
+
     def to_json(self) -> dict:
         return {
             "rung": self.ladder.rung.name,

@@ -1,0 +1,442 @@
+"""Portfolio-search subsystem tests (ISSUE 19 pins).
+
+Engine-level pins run against the scenario-test rig (small cluster,
+three goals, max_rounds=16 — one batched compile serves the module);
+facade-level pins share one module-scope stack with
+`portfolio_max_programs=1` so every candidate rides the base-order
+program and the only portfolio compile is the two-lane batched solve.
+"""
+import conftest  # noqa: F401
+
+import threading
+import time as _real_time
+
+import pytest
+
+from cruise_control_tpu.analyzer.context import (BalancingConstraint,
+                                                 OptimizationOptions)
+from cruise_control_tpu.analyzer.goals.registry import default_goals
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.portfolio.engine import (PortfolioEngine,
+                                                 PortfolioResult,
+                                                 portfolio_fitness,
+                                                 select_winner)
+from cruise_control_tpu.portfolio.mutate import (THRESHOLD_SCALE_RANGE,
+                                                 SolverCandidate,
+                                                 crossover_orders,
+                                                 make_portfolio,
+                                                 mutate_candidate,
+                                                 split_tiers)
+from cruise_control_tpu.scenario import ScenarioEngine
+from cruise_control_tpu.sched import runtime as sched_runtime
+from cruise_control_tpu.sched.policy import SchedulerClass
+from cruise_control_tpu.sched.runtime import SolvePreempted
+from cruise_control_tpu.testing import fixtures
+from cruise_control_tpu.utils import faults
+
+from test_facade import feed_samples, make_stack
+
+pytestmark = pytest.mark.portfolio
+
+PORTFOLIO_GOALS = ["RackAwareGoal", "DiskCapacityGoal",
+                   "ReplicaDistributionGoal"]
+
+
+# ---------------------------------------------------------------------------
+# mutate: the declarative perturbation vocabulary (pure, no device work)
+# ---------------------------------------------------------------------------
+
+class TestMutate:
+    def test_candidates_are_pure_functions_of_seed_and_index(self):
+        a = make_portfolio(PORTFOLIO_GOALS, seed=7, width=6, max_programs=3)
+        b = make_portfolio(PORTFOLIO_GOALS, seed=7, width=6, max_programs=3)
+        assert a == b
+        assert a[0].is_identity and a[0].index == 0
+        # a different seed perturbs differently (beyond the identity)
+        c = make_portfolio(PORTFOLIO_GOALS, seed=8, width=6, max_programs=3)
+        assert a[1:] != c[1:]
+
+    def test_dropping_identity_keeps_indices_stable(self):
+        with_id = make_portfolio(PORTFOLIO_GOALS, seed=7, width=5,
+                                 max_programs=3)
+        without = make_portfolio(PORTFOLIO_GOALS, seed=7, width=5,
+                                 max_programs=3, include_identity=False)
+        assert [c.index for c in without] == [1, 2, 3, 4]
+        assert with_id[1:] == without
+
+    def test_perturbations_respect_bounds_and_hard_precedence(self):
+        cands = make_portfolio(PORTFOLIO_GOALS, seed=3, width=16,
+                               max_programs=4)
+        lo, hi = THRESHOLD_SCALE_RANGE
+        hard_base, soft_base = split_tiers(PORTFOLIO_GOALS)
+        trace_keys = set()
+        for c in cands:
+            assert sorted(c.goal_order) == sorted(PORTFOLIO_GOALS)
+            hard, soft = split_tiers(c.goal_order)
+            # hard tier always precedes the soft tier, whatever the draw
+            assert list(c.goal_order[:len(hard)]) == hard
+            assert sorted(hard) == sorted(hard_base)
+            assert lo <= c.threshold_scale <= hi
+            trace_keys.add(c.trace_key())
+        # trace-time knobs capped: width 16 never compiles >4 programs
+        assert len(trace_keys) <= 4
+
+    def test_mutation_and_crossover_respect_tiers(self):
+        import random
+        base = make_portfolio(PORTFOLIO_GOALS, seed=5, width=4,
+                              max_programs=4)
+        for parent in base:
+            for i in (7, 8, 9):
+                child = mutate_candidate(parent, seed=5, index=i)
+                assert child == mutate_candidate(parent, seed=5, index=i)
+                assert sorted(child.goal_order) == sorted(PORTFOLIO_GOALS)
+                hard, _ = split_tiers(child.goal_order)
+                assert list(child.goal_order[:len(hard)]) == hard
+                lo, hi = THRESHOLD_SCALE_RANGE
+                assert lo <= child.threshold_scale <= hi
+        rng = random.Random(1)
+        for _ in range(8):
+            child = crossover_orders(base[1].goal_order,
+                                     base[2].goal_order, rng)
+            assert sorted(child) == sorted(PORTFOLIO_GOALS)
+            hard, _ = split_tiers(child)
+            assert list(child[:len(hard)]) == hard
+
+    def test_select_winner_prefers_low_index_on_ties(self):
+        def out(i, fit):
+            return type("O", (), {
+                "candidate": SolverCandidate(index=i,
+                                             goal_order=("RackAwareGoal",)),
+                "fitness": fit, "feasible": fit != float("-inf")})()
+        assert select_winner([]) is None
+        picked = select_winner([out(2, 5.0), out(0, 5.0),
+                                out(1, float("-inf"))])
+        assert picked.candidate.index == 0
+        assert select_winner([out(0, 1.0), out(3, 2.0)]).candidate.index == 3
+
+
+# ---------------------------------------------------------------------------
+# engine: batched search, determinism, chaos descent, preemption
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def rig():
+    """Shared (state, topo, scenario engine, factory): one batched
+    compile serves the engine-level tests."""
+    state, topo = fixtures.small_cluster()
+    constraint = BalancingConstraint()
+    base_opt = GoalOptimizer(
+        default_goals(max_rounds=16, names=PORTFOLIO_GOALS), constraint,
+        pipeline_segment_size=2)
+
+    def factory(names):
+        if names is None or list(names) == PORTFOLIO_GOALS:
+            return base_opt
+        return GoalOptimizer(default_goals(max_rounds=16, names=names),
+                             constraint)
+
+    scenario = ScenarioEngine(factory, constraint)
+    return state, topo, scenario, factory, constraint
+
+
+def _make_engine(rig, **kwargs):
+    state, topo, scenario, factory, constraint = rig
+    return PortfolioEngine(scenario, factory, constraint=constraint,
+                           **kwargs)
+
+
+class TestEngine:
+    def test_same_seed_same_portfolio_bit_for_bit(self, rig):
+        """Same-seed determinism pin: two searches over the same model
+        score every candidate identically and pick the same winner."""
+        state, topo, scenario, factory, constraint = rig
+        engine = _make_engine(rig)
+        cands = make_portfolio(PORTFOLIO_GOALS, seed=7, width=4,
+                               max_programs=2)
+
+        def run():
+            res = engine.search(state, topo, cands, seed=7,
+                                options=OptimizationOptions())
+            return res
+
+        r1, r2 = run(), run()
+        assert r1.rung == r2.rung == "FUSED"
+        key1 = [(c.candidate.index, c.feasible, round(c.fitness, 6))
+                for c in r1.candidates]
+        key2 = [(c.candidate.index, c.feasible, round(c.fitness, 6))
+                for c in r2.candidates]
+        assert key1 == key2
+        assert r1.winner is not None and r2.winner is not None
+        assert r1.winner.candidate == r2.winner.candidate
+        assert engine.total_searches == 2
+        assert engine.total_candidates == 8
+        assert engine.last_width == 4
+
+    def test_chaos_descends_to_eager_with_isolated_ladder(self, rig):
+        """Chaos pin: an armed `portfolio.search` fault fails the fused
+        batch; the search descends to the bounded EAGER loop and still
+        returns a feasible winner.  The portfolio's degradation ladder
+        is its OWN — the scenario engine's request-path ladder must not
+        move."""
+        state, topo, scenario, factory, constraint = rig
+        engine = _make_engine(rig, max_eager_candidates=2)
+        scenario_rung_before = scenario.ladder.rung
+        cands = make_portfolio(PORTFOLIO_GOALS, seed=7, width=3,
+                               max_programs=1)
+        plan = faults.FaultPlan().fail_nth("portfolio.search", (1,))
+        with faults.injected(plan) as inj:
+            res = engine.search(state, topo, cands, seed=7,
+                                options=OptimizationOptions())
+        assert inj.counts().get("portfolio.search") == (1, 1)
+        assert res.rung == "EAGER"
+        assert res.winner is not None and res.winner.feasible
+        assert res.winner.result is not None      # eager lanes carry full
+        # results so the facade can serve them without a rebuild
+        # bounded budget: only the first 2 candidates solved eagerly
+        solved = [c for c in res.candidates if c.feasible]
+        assert len(solved) == 2
+        assert engine.total_descents == 1
+        # ladder isolation: the portfolio's failure never touches the
+        # request path's ladder
+        assert scenario.ladder.rung == scenario_rung_before
+
+    def test_preemption_propagates_without_descending(self, rig, monkeypatch):
+        """SolvePreempted is NOT a solver failure: it must propagate to
+        the scheduler (which requeues the sweep) without burning a
+        ladder descent or a breaker failure."""
+        state, topo, scenario, factory, constraint = rig
+        engine = _make_engine(rig)
+
+        def boom(*a, **k):
+            raise SolvePreempted("preempted by ANOMALY_HEAL")
+
+        monkeypatch.setattr(engine, "_search_fused", boom)
+        cands = make_portfolio(PORTFOLIO_GOALS, seed=7, width=3,
+                               max_programs=1)
+        with pytest.raises(SolvePreempted):
+            engine.search(state, topo, cands, seed=7,
+                          options=OptimizationOptions())
+        assert engine.total_descents == 0
+        assert engine.ladder.rung.name == "FUSED"
+
+    def test_fitness_formula_penalizes_movement(self):
+        free = portfolio_fitness(90.0, 0, 0, 24, movement_cost_weight=4.0)
+        costly = portfolio_fitness(90.0, 12, 4, 24,
+                                   movement_cost_weight=4.0)
+        assert free == 90.0
+        assert costly == pytest.approx(90.0 - 4.0 * (12 + 2.0) / 24)
+        assert costly < free
+
+
+# ---------------------------------------------------------------------------
+# facade: K=1 identity, winner-never-worse, CAS install, refinement job
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def stack():
+    """One facade stack for every facade-level pin.
+    `portfolio_max_programs=1` keeps every candidate on the base goal
+    order (perturbations are lane-batchable knobs only), so the module
+    compiles exactly one extra (two-lane) program."""
+    sim, cc, clock = make_stack(
+        portfolio_seed=11, portfolio_max_programs=1,
+        portfolio_background_width=2, portfolio_background_generations=1)
+    cc.start_up(do_sampling=False, start_detection=False)
+    feed_samples(cc, clock)
+    yield sim, cc, clock
+    cc.shutdown()
+
+
+def _num_replicas(cc):
+    """The same replica count the facade's fitness comparisons use."""
+    state, _ = cc._model_for_solve()
+    return cc._num_replicas(cc._fleet_pad(state))
+
+
+class TestFacadePortfolio:
+    def test_k1_identity_never_consults_the_engine(self, stack,
+                                                   monkeypatch):
+        """K=1 identity pin: at the default width the portfolio engine
+        is never invoked and the response carries NO solverProvenance
+        block — byte-identical to the pre-portfolio greedy path."""
+        from cruise_control_tpu.api.responses import optimization_result
+        sim, cc, clock = stack
+
+        def boom(*a, **k):
+            raise AssertionError("portfolio engine consulted at K=1")
+
+        monkeypatch.setattr(cc.portfolio_engine, "search", boom)
+        r = cc.optimizations(ignore_proposal_cache=True)
+        assert r.solver_provenance is None
+        body = optimization_result(r)
+        assert "solverProvenance" not in body
+        assert not r.violated_goals_after
+        # cache hit still served engine-free
+        assert cc.optimizations() is r
+
+    def test_width3_winner_never_worse_with_provenance(self, stack):
+        """Winner-never-worse pin: a width-3 sync search serves a result
+        whose fitness is >= greedy's, and the response says which solver
+        produced it (and why)."""
+        sim, cc, clock = stack
+        num = _num_replicas(cc)
+        greedy = cc.optimizations(ignore_proposal_cache=True)
+        wide = cc.optimizations(ignore_proposal_cache=True,
+                                portfolio_width=3)
+        prov = wide.solver_provenance
+        assert prov is not None
+        assert prov["solver"] in ("greedy", "portfolio")
+        assert prov["portfolioWidth"] == 3
+        assert prov["portfolioSeed"] == 11
+        assert prov["rung"] in ("FUSED", "EAGER", "CPU")
+        assert "error" not in prov
+        fit_greedy = cc.portfolio_engine.greedy_fitness(greedy, num)
+        fit_wide = cc.portfolio_engine.greedy_fitness(wide, num)
+        assert fit_wide >= fit_greedy - 1e-9
+        if prov["solver"] == "portfolio":
+            assert prov["bestCandidateFitness"] > prov["greedyFitness"]
+            assert "candidateIndex" in prov and "perturbation" in prov
+        assert not wide.violated_goals_after
+        # provenance must survive JSON encoding (REST responses)
+        import json
+        from cruise_control_tpu.api.responses import optimization_result
+        json.dumps(optimization_result(wide))
+
+    def test_state_block_and_sensors(self, stack):
+        sim, cc, clock = stack
+        block = cc.state(substates=["portfolio"])["PortfolioState"]
+        assert block["width"] == 1          # config default: disabled
+        assert block["seed"] == 11
+        assert block["backgroundEnabled"] is False
+        assert block["rung"] in ("FUSED", "EAGER", "CPU")
+        assert block["totalSearches"] >= 1  # the width-3 request above
+        for key in ("improvements", "staleDrops", "fitnessBest",
+                    "fitnessGreedy", "backgroundSweeps", "breaker"):
+            assert key in block
+        # portfolio sensors registered on the shared registry
+        sensors = cc.metrics.to_json()
+        for name in ("portfolio-candidates", "portfolio-rung",
+                     "portfolio-fitness-best", "portfolio-improvements",
+                     "portfolio-stale-drops"):
+            assert name in sensors, name
+
+    def test_install_winner_cas_gate(self, stack):
+        """Stale-generation drop pin: the CAS install drops winners from
+        a moved generation or a bumped cache epoch, refuses not-better
+        winners without counting them stale, and lands strictly-better
+        ones."""
+        sim, cc, clock = stack
+        num = _num_replicas(cc)
+        baseline = cc.optimizations(ignore_proposal_cache=True)
+        gen = cc.load_monitor.model_generation()
+        base_fit = cc.portfolio_engine.greedy_fitness(baseline, num)
+        stale0, imp0 = cc._portfolio_stale_drops, cc._portfolio_improvements
+
+        import dataclasses as _dc
+        wrong_gen = _dc.replace(gen, load_generation=gen.load_generation + 1)
+        assert cc.install_portfolio_winner(baseline, wrong_gen,
+                                           base_fit + 5, num) is False
+        assert cc._portfolio_stale_drops == stale0 + 1
+        # bumped epoch (an execution started mid-search) also drops
+        assert cc.install_portfolio_winner(baseline, gen, base_fit + 5,
+                                           num,
+                                           epoch=cc._cache_epoch + 1) is False
+        assert cc._portfolio_stale_drops == stale0 + 2
+        # not-better: refused silently (no stale count)
+        assert cc.install_portfolio_winner(baseline, gen, base_fit - 1.0,
+                                           num) is False
+        assert cc._portfolio_stale_drops == stale0 + 2
+        assert cc._portfolio_improvements == imp0
+        # strictly better: lands, becomes the served cache entry
+        assert cc.install_portfolio_winner(baseline, gen, base_fit + 1.0,
+                                           num) is True
+        assert cc._portfolio_improvements == imp0 + 1
+        assert cc.optimizations() is baseline
+
+    def test_background_refinement_statuses(self, stack):
+        """The SCENARIO_SWEEP refinement pass: 'skipped' without a warm
+        baseline, then a real evolve pass that either improves the cache
+        or confirms greedy."""
+        sim, cc, clock = stack
+        cc._invalidate_proposal_cache()
+        assert cc.portfolio_refine_once() == "skipped"
+        baseline = cc.optimizations()      # warm the cache baseline
+        status = cc.portfolio_refine_once()
+        assert status in ("improved", "computed", "stale")
+        served = cc.optimizations()        # same generation: cache serve
+        if status == "improved":
+            assert served is not baseline
+            assert served.solver_provenance["solver"] == "portfolio"
+            num = _num_replicas(cc)
+            assert (cc.portfolio_engine.greedy_fitness(served, num)
+                    > cc.portfolio_engine.greedy_fitness(baseline, num))
+        else:
+            assert served is baseline
+
+    def test_refinement_yields_to_anomaly_heal(self, stack, monkeypatch):
+        """Background-job preemption pin: an ANOMALY_HEAL submitted while
+        the SCENARIO_SWEEP refinement runs preempts it at the next
+        segment checkpoint; the scheduler runs the heal first, requeues
+        the sweep, and the refine pass still completes."""
+        import importlib
+        # the package __init__ re-exports the evolve FUNCTION under the
+        # same name, so a plain `import ... as` binds the function —
+        # import_module returns the real submodule to patch
+        evolve_mod = importlib.import_module(
+            "cruise_control_tpu.portfolio.evolve")
+        sim, cc, clock = stack
+        cc.optimizations()                 # warm baseline (else skipped)
+
+        order, order_lock = [], threading.Lock()
+        entered, release = threading.Event(), threading.Event()
+        calls = {"n": 0}
+
+        def note(tag):
+            with order_lock:
+                order.append(tag)
+
+        def fake_evolve(engine, base_state, topology, base_order, seed,
+                        width, generations, max_programs=4, options=None,
+                        include_proposals=True, on_generation=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                entered.set()
+                assert release.wait(30.0)
+                sched_runtime.segment_checkpoint()  # raises SolvePreempted
+            note("sweep")
+            return PortfolioResult(seed=seed, width=width, candidates=[])
+
+        monkeypatch.setattr(evolve_mod, "evolve", fake_evolve)
+        preempt0 = cc.solve_scheduler.stats.preemptions
+
+        refine_out = {}
+        t = threading.Thread(
+            target=lambda: refine_out.update(
+                status=cc.portfolio_refine_once()), daemon=True)
+        t.start()
+        assert entered.wait(30.0)
+
+        heal_out = {}
+
+        def heal():
+            heal_out["v"] = cc._scheduled_solve(
+                SchedulerClass.ANOMALY_HEAL,
+                lambda: (note("heal"), "healed")[1], label="heal-stub")
+
+        ht = threading.Thread(target=heal, daemon=True)
+        ht.start()
+        deadline = _real_time.monotonic() + 10.0
+        while (cc.solve_scheduler.queue.depth() < 1
+               and _real_time.monotonic() < deadline):
+            _real_time.sleep(0.01)
+        release.set()
+        ht.join(timeout=60.0)
+        t.join(timeout=60.0)
+        assert heal_out.get("v") == "healed"
+        # the sweep was preempted, the heal ran first, the sweep re-ran
+        assert order == ["heal", "sweep"]
+        assert calls["n"] == 2
+        assert refine_out["status"] == "computed"  # empty fake portfolio
+        assert cc.solve_scheduler.stats.preemptions >= preempt0 + 1
+        # preemption is not failure: the portfolio ladder never moved
+        assert cc.portfolio_engine.ladder.rung.name == "FUSED"

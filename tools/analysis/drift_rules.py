@@ -25,6 +25,11 @@
     docs/OPERATIONS.md — an injection point nobody scripts is dead
     chaos coverage, and one operators cannot read about is a prod
     footgun.
+  * D322 — required fault sites.  The inverse direction:
+    REQUIRED_FAULT_SITES lists injection points a subsystem's
+    degradation contract PROMISES (`portfolio.search` since ISSUE 19);
+    when the subsystem's modules are present but nothing arms the
+    site, the chaos tests that script it silently inject nothing.
   * D330/D331 — goal fusion groups.  `analyzer/fusion.
     GOAL_FUSION_GROUPS` and `goals/registry.GOAL_CLASSES` must cover
     each other exactly: a registered goal in no group silently falls
@@ -276,6 +281,16 @@ def _sensor_rules(project: Project) -> List[Finding]:
 # fault sites
 # ----------------------------------------------------------------------
 
+#: subsystem-contract fault sites: injection points the architecture
+#: PROMISES (each subsystem's degradation story depends on the site
+#: existing).  If nothing in the package arms the site, the chaos tests
+#: that script it silently stop injecting anywhere — D322 makes that a
+#: finding at the module that is supposed to arm it.
+REQUIRED_FAULT_SITES: Dict[str, str] = {
+    "portfolio.search": "portfolio/engine.py",
+}
+
+
 def _armed_fault_sites(project: Project):
     sites: Dict[str, Tuple[str, int]] = {}
     for mod in project.files:
@@ -323,6 +338,21 @@ def _fault_rules(project: Project, root: Path) -> List[Finding]:
                 f"fault site '{site}' armed here but absent from "
                 f"docs/OPERATIONS.md — operators must be able to look "
                 f"up every injection point [D321]"))
+    for site, expected_rel in sorted(REQUIRED_FAULT_SITES.items()):
+        if site in sites:
+            continue
+        subsystem = expected_rel.rsplit("/", 1)[0] + "/"
+        owner = next((mod for mod in project.files
+                      if mod.rel is not None
+                      and mod.rel.startswith(subsystem)), None)
+        if owner is None:
+            continue              # subsystem absent (fixture trees)
+        findings.append(Finding(
+            "D322", str(owner.path), 1,
+            f"required fault site '{site}' is armed nowhere in the "
+            f"package — the subsystem contract promises this "
+            f"injection point (expected in {expected_rel}); chaos "
+            f"tests that script it inject nothing [D322]"))
     return findings
 
 

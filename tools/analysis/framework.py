@@ -76,6 +76,8 @@ RULES: Dict[str, str] = {
     "D320": "fault site armed in code but never exercised by tests/",
     "D321": "fault site armed in code but absent from "
             "docs/OPERATIONS.md",
+    "D322": "subsystem-contract fault site armed nowhere in the "
+            "package (REQUIRED_FAULT_SITES)",
 }
 
 _SUPPRESS_RE = re.compile(

@@ -28,7 +28,9 @@ from .project import (PACKAGE, FunctionInfo, ModuleInfo, Project,
 # -- allowlists (unchanged semantics from the flat lint) ---------------
 
 _GATEWAY_ALLOWED_RELPATHS = {"facade.py", "analyzer/optimizer.py",
-                             "scenario/engine.py", "testing/verifier.py"}
+                             "scenario/engine.py",
+                             "portfolio/engine.py",
+                             "testing/verifier.py"}
 
 _MESH_ALLOWED_RELPATHS = {"facade.py", "main.py", "parallel/mesh.py",
                           "parallel/health.py",

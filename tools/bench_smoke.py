@@ -11,6 +11,11 @@ dispatch economics on the warm solve:
   * every fused `__seg_{start}_{stop}__` program actually dispatched;
   * the fused result carries the converged-at instrument for every goal.
 
+Also runs the ISSUE 19 portfolio gate: a width-3 seeded portfolio over
+a tiny 3-goal stack must solve FUSED in one batched pass, produce a
+feasible winner never below the identity lane, and replay bit-for-bit
+across two searches.
+
 Exit 0 = all gates hold (one JSON summary line on stdout); exit 1 with
 the violated gate on stderr otherwise.  Geometry via SMOKE_BROKERS /
 SMOKE_PARTITIONS / SMOKE_ROUNDS; default is small enough for a CI CPU
@@ -82,6 +87,49 @@ def main() -> int:
         failures.append(f"converged-at instrument incomplete: "
                         f"{sorted(conv)} != {sorted(names)}")
 
+    # portfolio gate (ISSUE 19): width-3 seeded portfolio, 3-goal stack,
+    # max_programs=1 so all lanes share ONE batched program
+    from cruise_control_tpu.analyzer.context import BalancingConstraint
+    from cruise_control_tpu.portfolio.engine import PortfolioEngine
+    from cruise_control_tpu.portfolio.mutate import make_portfolio
+    from cruise_control_tpu.scenario.engine import ScenarioEngine
+
+    p_names = ["RackAwareGoal", "DiskCapacityGoal",
+               "ReplicaDistributionGoal"]
+    constraint = BalancingConstraint()
+    p_opt = GoalOptimizer(default_goals(max_rounds=rounds, names=p_names),
+                          constraint, pipeline_segment_size=2)
+
+    def p_factory(g):
+        if g is None or list(g) == p_names:
+            return p_opt
+        return GoalOptimizer(default_goals(max_rounds=rounds,
+                                           names=list(g)), constraint)
+
+    engine = PortfolioEngine(ScenarioEngine(p_factory, constraint),
+                             p_factory, constraint=constraint)
+    cands = make_portfolio(p_names, seed=19, width=3, max_programs=1)
+    t0 = time.time()
+    p1 = engine.search(state, topo, cands, 19, options=options)
+    p2 = engine.search(state, topo, cands, 19, options=options)
+    portfolio_s = time.time() - t0
+    ident = next(c for c in p1.candidates if c.candidate.index == 0)
+    if p1.rung != "FUSED":
+        failures.append(f"portfolio smoke did not run FUSED: {p1.rung}")
+    if p1.winner is None or not p1.winner.feasible:
+        failures.append("portfolio smoke found no feasible winner")
+    elif ident.feasible and p1.winner.fitness < ident.fitness - 1e-9:
+        failures.append(
+            f"portfolio winner {p1.winner.fitness:.4f} worse than the "
+            f"identity lane {ident.fitness:.4f}")
+
+    def _fits(r):
+        return [(c.candidate.index, round(c.fitness, 6))
+                for c in r.candidates]
+
+    if _fits(p1) != _fits(p2):
+        failures.append("portfolio smoke not deterministic across runs")
+
     print(json.dumps({
         "metric": f"bench-smoke dispatch budget {num_b}b/{num_p}p",
         "dispatches": used,
@@ -92,6 +140,17 @@ def main() -> int:
         "solve_s": round(solve_s, 3),
         "total_s": round(time.time() - t_start, 2),
         "converged_at_by_goal": {g: int(c) for g, c in conv.items()},
+        "portfolio": {
+            "width": len(cands),
+            "rung": p1.rung,
+            "winner_index": (p1.winner.candidate.index
+                             if p1.winner is not None else None),
+            "winner_fitness": (round(p1.winner.fitness, 4)
+                               if p1.winner is not None else None),
+            "identity_fitness": (round(ident.fitness, 4)
+                                 if ident.feasible else None),
+            "search_s": round(portfolio_s, 2),
+        },
         "ok": not failures,
     }))
     for f in failures:
